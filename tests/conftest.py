@@ -1,0 +1,18 @@
+"""Test bootstrap.
+
+JAX-touching tests run on a virtual 8-device CPU mesh (the multi-chip
+analog of the reference's "test multi-node at the intent level" strategy,
+SURVEY.md §4.2) — flags must be set before jax first imports.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

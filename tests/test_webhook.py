@@ -1,0 +1,150 @@
+"""Webhook logic tests — mirrors ref
+``api/v1alpha1/networkconfiguration_webhook_test.go:23-154``
+(defaulting, selector good/bad tables, update, delete) and adds tpu-so
+coverage."""
+
+import pytest
+
+from tpu_network_operator.api.v1alpha1 import (
+    AdmissionError,
+    NetworkClusterPolicy,
+    default_policy,
+    validate_create,
+    validate_delete,
+    validate_update,
+)
+from tpu_network_operator.api.v1alpha1 import types as t
+
+
+def gaudi_policy(selector=None):
+    p = NetworkClusterPolicy()
+    p.spec.configuration_type = t.CONFIG_TYPE_GAUDI_SO
+    p.spec.gaudi_scale_out.layer = "L3"
+    p.spec.node_selector = selector if selector is not None else {"foo": "bar"}
+    return p
+
+
+def tpu_policy(selector=None):
+    p = NetworkClusterPolicy()
+    p.spec.configuration_type = t.CONFIG_TYPE_TPU_SO
+    p.spec.node_selector = selector if selector is not None else {"foo": "bar"}
+    return p
+
+
+class TestDefaulting:
+    # ref webhook_test.go:26-35
+    def test_gaudi_image_default(self):
+        p = gaudi_policy()
+        default_policy(p)
+        assert p.spec.gaudi_scale_out.image == t.DEFAULT_GAUDI_AGENT_IMAGE
+
+    def test_gaudi_image_not_overwritten(self):
+        p = gaudi_policy()
+        p.spec.gaudi_scale_out.image = "custom:1"
+        default_policy(p)
+        assert p.spec.gaudi_scale_out.image == "custom:1"
+
+    def test_tpu_defaults(self):
+        p = tpu_policy()
+        default_policy(p)
+        so = p.spec.tpu_scale_out
+        assert so.image == t.DEFAULT_TPU_AGENT_IMAGE
+        assert so.layer == "L2"
+        assert so.topology_source == "auto"
+        assert so.coordinator_port == t.DEFAULT_COORDINATOR_PORT
+        assert so.bootstrap_path == t.DEFAULT_BOOTSTRAP_PATH
+
+
+class TestValidation:
+    # ref webhook_test.go:39-45
+    def test_deny_empty_node_selector(self):
+        with pytest.raises(AdmissionError, match="empty node-selector"):
+            validate_create(gaudi_policy(selector={}))
+
+    # ref webhook_test.go:47-56
+    def test_deny_unknown_configuration_type(self):
+        p = gaudi_policy()
+        p.spec.configuration_type = "foo bar"
+        with pytest.raises(AdmissionError, match="unknown configuration type"):
+            validate_create(p)
+
+    # ref webhook_test.go:58-79
+    @pytest.mark.parametrize(
+        "selector",
+        [
+            {"intel.feature.node.kubernetes.io/gaudi-ready": "true"},
+            {"gpu.intel.com": "xpu"},
+            {"tpunet.dev/tpu-scale-out": "true"},
+            {"foo": "bar"},
+        ],
+    )
+    def test_accept_good_node_selectors(self, selector):
+        assert validate_create(gaudi_policy(selector=selector)) == []
+
+    # ref webhook_test.go:81-110
+    @pytest.mark.parametrize(
+        "selector",
+        [
+            {"__.com/foo": "bar"},
+            {"foo.com_": "bar"},
+            {"foo.com": "_bar"},
+            {"foo.com": "???foo"},
+            {"foo.com": "foo_"},
+            {"foo.com": "0" * 64},
+            {"foo.com/bar/plaaplaa_": "ok"},
+            {"foo.com_/bar": "ok"},
+            {"foobar.com?foo": "bar"},
+            {"x" * 254: "ok"},
+        ],
+    )
+    def test_deny_bad_node_selectors(self, selector):
+        with pytest.raises(AdmissionError):
+            validate_create(gaudi_policy(selector=selector))
+
+    # ref webhook_test.go:112-136
+    def test_update_good_then_bad(self):
+        p = gaudi_policy()
+        p2 = p.deepcopy()
+        assert validate_update(p2, p) == []
+        p2.spec.node_selector = {"foobar.com?foo": "bar"}
+        with pytest.raises(AdmissionError):
+            validate_update(p2, p)
+
+    # ref webhook_test.go:138-152
+    def test_delete_always_accepted(self):
+        p = gaudi_policy()
+        p.spec.gaudi_scale_out.layer = "L3"
+        assert validate_delete(p) == ([], None)
+
+    def test_mtu_range_enforced(self):
+        p = gaudi_policy()
+        p.spec.gaudi_scale_out.mtu = 1000
+        with pytest.raises(AdmissionError, match="mtu"):
+            validate_create(p)
+        p.spec.gaudi_scale_out.mtu = 9001
+        with pytest.raises(AdmissionError, match="mtu"):
+            validate_create(p)
+        p.spec.gaudi_scale_out.mtu = 8000
+        assert validate_create(p) == []
+
+    def test_log_level_range(self):
+        p = gaudi_policy()
+        p.spec.log_level = 9
+        with pytest.raises(AdmissionError, match="logLevel"):
+            validate_create(p)
+
+    def test_tpu_spec_validation(self):
+        p = tpu_policy()
+        p.spec.tpu_scale_out.coordinator_port = 80
+        with pytest.raises(AdmissionError, match="coordinatorPort"):
+            validate_create(p)
+        p.spec.tpu_scale_out.coordinator_port = 8476
+        p.spec.tpu_scale_out.bootstrap_path = "relative/path.json"
+        with pytest.raises(AdmissionError, match="bootstrapPath"):
+            validate_create(p)
+        p.spec.tpu_scale_out.bootstrap_path = "/etc/tpu/jax-coordinator.json"
+        p.spec.tpu_scale_out.topology_source = "magic"
+        with pytest.raises(AdmissionError, match="topologySource"):
+            validate_create(p)
+        p.spec.tpu_scale_out.topology_source = "metadata"
+        assert validate_create(p) == []

@@ -1,0 +1,35 @@
+"""tpu_network_operator — a TPU-native Kubernetes network operator framework.
+
+A from-scratch rebuild of the capabilities of Intel's network-operator
+(reference: /root/reference, `github.com/intel/network-operator`): a
+cluster-scoped policy CRD, an operator/reconciler that projects policy into
+per-node privileged agent DaemonSets, and a node agent that discovers
+accelerator scale-out interconnects, configures host networking, emits the
+bootstrap artifact the accelerator runtime consumes, and advertises node
+readiness via NFD labels.
+
+Two backends:
+
+* ``gaudi-so`` — parity with the reference: sysfs discovery of Gaudi NICs,
+  LLDP-aided L3 addressing (switch-port /30 trick), ``gaudinet.json``
+  emission for HCCL (ref ``cmd/discover``, ``pkg/lldp``).
+* ``tpu-so``   — the TPU-native backend: ICI mesh topology from GCE
+  metadata/libtpu, DCN host-NIC bring-up + routes, ``jax-coordinator.json``
+  (a ``jax.distributed`` bootstrap) emission, ``tpu-scale-out=true`` NFD
+  label, so JAX/XLA collectives run over ICI (intra-slice) and DCN
+  (inter-slice).
+
+Layer map (mirrors SURVEY.md §1):
+
+* L5 ``deploy/``   — Helm chart, kustomize-style config, NFD rules.
+* L4 ``api/``      — CRD types + admission webhooks.
+* L3 ``controller/`` + ``kube/`` — reconciler over a minimal k8s machinery.
+* L2 ``agent/``    — per-node configurator (discovery, netlink, writers).
+* L1 ``lldp/`` + ``agent/netlink.py`` + ``native/`` — wire/OS primitives.
+
+The validation workload and benchmark harness (``parallel/``, ``models/``,
+``ops/``) are the JAX jobs that consume the emitted bootstrap config — the
+framework's analog of the HCCL E2E tests the reference leans on.
+"""
+
+__version__ = "0.1.0"

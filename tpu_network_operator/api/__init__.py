@@ -1,0 +1,1 @@
+"""Cluster API layer (L4): CRD types, apimachinery, admission webhooks."""

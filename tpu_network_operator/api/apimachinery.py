@@ -1,0 +1,171 @@
+"""Minimal Kubernetes apimachinery: typed meta, generic serde, deepcopy.
+
+The reference gets TypeMeta/ObjectMeta and JSON round-tripping from
+``k8s.io/apimachinery`` and generated ``zz_generated.deepcopy.go``
+(ref ``api/v1alpha1/zz_generated.deepcopy.go``).  Here the same contract is a
+small dataclass-based serde: every API type is a dataclass whose fields carry
+their wire (camelCase JSON) name in metadata; ``to_dict``/``from_dict`` walk
+the dataclass recursively, omitting empty values on output and tolerating
+unknown keys on input (k8s server-side behavior).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import typing
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+def j(
+    json_name: str, default: Any = None, *, factory: Any = None, required: bool = False
+) -> Any:
+    """Declare a dataclass field with its JSON wire name.
+
+    ``required=True`` disables omit-empty for the field (the analog of a Go
+    json tag without ``omitempty`` — the reference's status fields,
+    ref ``networkconfiguration_types.go:69-74``).
+    """
+    meta = {"json": json_name, "required": required}
+    if factory is not None:
+        return field(default_factory=factory, metadata=meta)
+    return field(default=default, metadata=meta)
+
+
+def _is_empty(v: Any) -> bool:
+    # Go encoding/json omitempty semantics: zero values are omitted.
+    return v is None or v == "" or v == 0 or v is False or v == {} or v == []
+
+
+def to_dict(obj: Any, *, omit_empty: bool = True) -> Any:
+    """Serialize a dataclass (or container) to plain JSON-able values."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out: Dict[str, Any] = {}
+        for f in dataclasses.fields(obj):
+            name = f.metadata.get("json", f.name)
+            val = to_dict(getattr(obj, f.name), omit_empty=omit_empty)
+            if omit_empty and _is_empty(val) and not f.metadata.get("required"):
+                continue
+            out[name] = val
+        return out
+    if isinstance(obj, dict):
+        return {k: to_dict(v, omit_empty=omit_empty) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v, omit_empty=omit_empty) for v in obj]
+    return obj
+
+
+def _strip_optional(tp: Any) -> Any:
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def from_dict(cls: Any, data: Any) -> Any:
+    """Deserialize ``data`` into dataclass ``cls`` (recursive, tolerant)."""
+    if data is None:
+        return cls() if dataclasses.is_dataclass(cls) else None
+    if not dataclasses.is_dataclass(cls):
+        return data
+    hints = typing.get_type_hints(cls)
+    kwargs: Dict[str, Any] = {}
+    for f in dataclasses.fields(cls):
+        name = f.metadata.get("json", f.name)
+        if name not in data:
+            continue
+        raw = data[name]
+        tp = _strip_optional(hints.get(f.name, Any))
+        origin = typing.get_origin(tp)
+        if dataclasses.is_dataclass(tp):
+            kwargs[f.name] = from_dict(tp, raw)
+        elif origin is list and raw is not None:
+            (item_tp,) = typing.get_args(tp) or (Any,)
+            if dataclasses.is_dataclass(item_tp):
+                kwargs[f.name] = [from_dict(item_tp, it) for it in raw]
+            else:
+                kwargs[f.name] = list(raw)
+        elif origin is dict and raw is not None:
+            kwargs[f.name] = dict(raw)
+        else:
+            kwargs[f.name] = raw
+    return cls(**kwargs)
+
+
+@dataclass
+class OwnerReference:
+    """metav1.OwnerReference — drives fake-apiserver garbage collection."""
+
+    api_version: str = j("apiVersion", "")
+    kind: str = j("kind", "")
+    name: str = j("name", "")
+    uid: str = j("uid", "")
+    controller: Optional[bool] = j("controller")
+    block_owner_deletion: Optional[bool] = j("blockOwnerDeletion")
+
+
+@dataclass
+class ObjectMeta:
+    """metav1.ObjectMeta (the subset the framework uses)."""
+
+    name: str = j("name", "")
+    namespace: str = j("namespace", "")
+    labels: Dict[str, str] = j("labels", factory=dict)
+    annotations: Dict[str, str] = j("annotations", factory=dict)
+    uid: str = j("uid", "")
+    resource_version: str = j("resourceVersion", "")
+    generation: int = j("generation", 0)
+    creation_timestamp: str = j("creationTimestamp", "")
+    deletion_timestamp: str = j("deletionTimestamp", "")
+    owner_references: List[OwnerReference] = j("ownerReferences", factory=list)
+    finalizers: List[str] = j("finalizers", factory=list)
+
+
+class KubeObject:
+    """Mixin for top-level API objects (TypeMeta + helpers).
+
+    Subclasses set class attrs ``API_VERSION`` and ``KIND`` (the reference's
+    scheme registration, ref ``api/v1alpha1/groupversion_info.go:27``).
+    """
+
+    API_VERSION: str = ""
+    KIND: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = to_dict(self)
+        d["apiVersion"] = self.API_VERSION
+        d["kind"] = self.KIND
+        # key order: apiVersion, kind first (cosmetic parity with kubectl)
+        return {
+            "apiVersion": d.pop("apiVersion"),
+            "kind": d.pop("kind"),
+            **d,
+        }
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "KubeObject":
+        obj = from_dict(cls, data)
+        return obj
+
+    def deepcopy(self):
+        """zz_generated.deepcopy analog."""
+        return copy.deepcopy(self)
+
+
+def set_controller_reference(owner: Any, controlled_meta: ObjectMeta) -> None:
+    """controllerutil.SetControllerReference analog
+    (ref ``internal/controller/networkconfiguration_controller.go:222``)."""
+    ref = OwnerReference(
+        api_version=owner.API_VERSION,
+        kind=owner.KIND,
+        name=owner.metadata.name,
+        uid=owner.metadata.uid,
+        controller=True,
+        block_owner_deletion=True,
+    )
+    controlled_meta.owner_references = [
+        r for r in controlled_meta.owner_references if not r.controller
+    ] + [ref]

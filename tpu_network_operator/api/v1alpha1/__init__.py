@@ -1,0 +1,26 @@
+"""tpunet.dev/v1alpha1 — the framework's public cluster API.
+
+Mirrors the reference group ``intel.com/v1alpha1``
+(ref ``api/v1alpha1/groupversion_info.go:27``).
+"""
+
+from .types import (  # noqa: F401
+    GROUP,
+    VERSION,
+    API_VERSION,
+    CONFIG_TYPE_GAUDI_SO,
+    CONFIG_TYPE_TPU_SO,
+    GaudiScaleOutSpec,
+    TpuScaleOutSpec,
+    NetworkClusterPolicy,
+    NetworkClusterPolicyList,
+    NetworkClusterPolicySpec,
+    NetworkClusterPolicyStatus,
+)
+from .webhook import (  # noqa: F401
+    AdmissionError,
+    default_policy,
+    validate_create,
+    validate_delete,
+    validate_update,
+)

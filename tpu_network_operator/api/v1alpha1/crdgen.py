@@ -1,0 +1,172 @@
+"""CRD generation — the controller-gen `manifests` analog.
+
+The reference generates
+``config/operator/crd/bases/intel.com_networkclusterpolicies.yaml`` from
+kubebuilder markers (enums, min/max) on the Go types
+(ref ``networkconfiguration_types.go:27,52,59,63-64``, ``Makefile`` target
+``manifests``).  Here the same constraints produce the CustomResourceDefinition
+dict/YAML; ``deploy/crd/`` is written by ``make manifests``
+(see repo ``Makefile``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import yaml
+
+from . import types as t
+
+PLURAL = "networkclusterpolicies"
+SINGULAR = "networkclusterpolicy"
+CRD_NAME = f"{PLURAL}.{t.GROUP}"
+
+
+def _so_common_props(layer_desc: str) -> Dict[str, Any]:
+    return {
+        "disableNetworkManager": {
+            "type": "boolean",
+            "description": "Detach the scale-out interfaces from host NetworkManager.",
+        },
+        "layer": {
+            "type": "string",
+            "enum": [t.LAYER_L2, t.LAYER_L3],
+            "description": layer_desc,
+        },
+        "image": {
+            "type": "string",
+            "description": "Agent container image for the per-node DaemonSet.",
+        },
+        "pullPolicy": {
+            "type": "string",
+            "enum": ["Never", "Always", "IfNotPresent"],
+        },
+        "mtu": {
+            "type": "integer",
+            "minimum": t.MTU_MIN,
+            "maximum": t.MTU_MAX,
+            "description": "MTU for the scale-out interfaces.",
+        },
+    }
+
+
+def openapi_schema() -> Dict[str, Any]:
+    """OpenAPI v3 schema for NetworkClusterPolicy (validation tier 1 of the
+    three-stage pipeline: schema -> webhook -> agent re-sanitize)."""
+    return {
+        "type": "object",
+        "properties": {
+            "apiVersion": {"type": "string"},
+            "kind": {"type": "string"},
+            "metadata": {"type": "object"},
+            "spec": {
+                "type": "object",
+                "required": ["configurationType", "nodeSelector"],
+                "properties": {
+                    "configurationType": {
+                        "type": "string",
+                        "enum": list(t.CONFIG_TYPES),
+                        "description": "Backend the operator configures onto nodes.",
+                    },
+                    "nodeSelector": {
+                        "type": "object",
+                        "additionalProperties": {"type": "string"},
+                        "minProperties": 1,
+                        "description": "Nodes to target; align with NFD labels.",
+                    },
+                    "logLevel": {
+                        "type": "integer",
+                        "minimum": t.LOG_LEVEL_MIN,
+                        "maximum": t.LOG_LEVEL_MAX,
+                    },
+                    "gaudiScaleOut": {
+                        "type": "object",
+                        "properties": _so_common_props(
+                            "L2: links up + MTU. L3: + LLDP-derived /30 addressing."
+                        ),
+                    },
+                    "tpuScaleOut": {
+                        "type": "object",
+                        "properties": {
+                            **_so_common_props(
+                                "DCN provisioning layer. L2: host-NIC up + MTU. "
+                                "L3: + LLDP-aided addressing/routes."
+                            ),
+                            "topologySource": {
+                                "type": "string",
+                                "enum": ["auto", "metadata", "libtpu"],
+                            },
+                            "coordinatorPort": {
+                                "type": "integer",
+                                "minimum": 1024,
+                                "maximum": 65535,
+                            },
+                            "bootstrapPath": {
+                                "type": "string",
+                                "pattern": "^/",
+                            },
+                        },
+                    },
+                },
+            },
+            "status": {
+                "type": "object",
+                "properties": {
+                    "targets": {"type": "integer", "format": "int32"},
+                    "ready": {"type": "integer", "format": "int32"},
+                    "state": {"type": "string"},
+                    "errors": {"type": "array", "items": {"type": "string"}},
+                },
+            },
+        },
+    }
+
+
+def crd() -> Dict[str, Any]:
+    """Full CustomResourceDefinition object (cluster-scoped, status
+    subresource — ref ``intel.com_networkclusterpolicies.yaml:1-124``)."""
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {
+            "name": CRD_NAME,
+            "annotations": {"controller-gen.kubebuilder.io/version": "tpunet-crdgen"},
+        },
+        "spec": {
+            "group": t.GROUP,
+            "names": {
+                "kind": t.NetworkClusterPolicy.KIND,
+                "listKind": t.NetworkClusterPolicyList.KIND,
+                "plural": PLURAL,
+                "singular": SINGULAR,
+            },
+            "scope": "Cluster",
+            "versions": [
+                {
+                    "name": t.VERSION,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "additionalPrinterColumns": [
+                        {"name": "Type", "type": "string",
+                         "jsonPath": ".spec.configurationType"},
+                        {"name": "Targets", "type": "integer",
+                         "jsonPath": ".status.targets"},
+                        {"name": "Ready", "type": "integer",
+                         "jsonPath": ".status.ready"},
+                        {"name": "State", "type": "string",
+                         "jsonPath": ".status.state"},
+                    ],
+                    "schema": {"openAPIV3Schema": openapi_schema()},
+                }
+            ],
+        },
+    }
+
+
+def crd_yaml() -> str:
+    return yaml.safe_dump(crd(), sort_keys=False)
+
+
+if __name__ == "__main__":
+    print(crd_yaml())

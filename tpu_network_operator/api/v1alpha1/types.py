@@ -1,0 +1,151 @@
+"""NetworkClusterPolicy CRD types (cluster-scoped).
+
+Rebuild of the reference's ``api/v1alpha1/networkconfiguration_types.go:24-96``
+with a second, TPU-native configuration backend:
+
+* ``gaudi-so`` — parity spec (layer, image, pullPolicy, MTU,
+  disableNetworkManager), ref ``networkconfiguration_types.go:45-66``.
+* ``tpu-so``   — the TPU backend: ICI topology discovery source, DCN
+  (data-center network) host-NIC provisioning layer/MTU, ``jax.distributed``
+  coordinator bootstrap settings.
+
+Validation constraints are declared in field metadata (``schema`` keys) and
+compiled into the CRD OpenAPI schema by :mod:`..crdgen` — the controller-gen
+analog — so the same source feeds the webhook, the CRD YAML, and the agent's
+re-sanitization (defense in depth, ref SURVEY.md §5.6).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from ..apimachinery import KubeObject, ObjectMeta, j
+
+GROUP = "tpunet.dev"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"
+
+# Configuration types (ref webhook const `gaudi-so`,
+# networkconfiguration_webhook.go:32; `tpu-so` is this framework's addition).
+CONFIG_TYPE_GAUDI_SO = "gaudi-so"
+CONFIG_TYPE_TPU_SO = "tpu-so"
+CONFIG_TYPES = (CONFIG_TYPE_GAUDI_SO, CONFIG_TYPE_TPU_SO)
+
+LAYER_L2 = "L2"
+LAYER_L3 = "L3"
+
+MTU_MIN, MTU_MAX = 1500, 9000          # ref networkconfiguration_types.go:62-65
+LOG_LEVEL_MIN, LOG_LEVEL_MAX = 0, 8    # ref networkconfiguration_types.go:38-41
+
+DEFAULT_GAUDI_AGENT_IMAGE = "ghcr.io/tpunet/network-linkdiscovery:latest"
+DEFAULT_TPU_AGENT_IMAGE = "ghcr.io/tpunet/tpu-linkdiscovery:latest"
+DEFAULT_COORDINATOR_PORT = 8476        # jax.distributed default port
+DEFAULT_BOOTSTRAP_PATH = "/etc/tpu/jax-coordinator.json"
+
+
+@dataclass
+class GaudiScaleOutSpec:
+    """Gaudi scale-out settings (parity with
+    ref ``networkconfiguration_types.go:45-66``)."""
+
+    # Prevent host NetworkManager from fighting the agent over the
+    # scale-out interfaces (ref internal/nm/networkmanager.go).
+    disable_network_manager: bool = j("disableNetworkManager", False)
+    # L2: links up + MTU only.  L3: + LLDP-derived /30 addressing + routes.
+    layer: str = j("layer", "")
+    # Agent container image for the resulting DaemonSet.
+    image: str = j("image", "")
+    pull_policy: str = j("pullPolicy", "")
+    # MTU for the scale-out interfaces (jumbo target).
+    mtu: int = j("mtu", 0)
+
+
+@dataclass
+class TpuScaleOutSpec:
+    """TPU scale-out settings — the TPU-native backend (no reference analog;
+    designed per SURVEY.md §5.8's TPU-equivalent contract).
+
+    ICI (inter-chip interconnect) is pre-wired and needs no bring-up; the
+    agent *discovers* its topology (GCE metadata / libtpu) and publishes it.
+    DCN host NICs get the netlink treatment the reference gives Gaudi NICs.
+    """
+
+    disable_network_manager: bool = j("disableNetworkManager", False)
+    # DCN provisioning layer.  L2: host-NIC up + MTU.  L3: + LLDP-aided
+    # addressing/routes for inter-slice traffic (ref network.go:311-379 analog).
+    layer: str = j("layer", "")
+    image: str = j("image", "")
+    pull_policy: str = j("pullPolicy", "")
+    # MTU for DCN host NICs (GCP supports up to 8896 on gVNIC; clamp 1500-9000).
+    mtu: int = j("mtu", 0)
+    # Where the ICI topology comes from: "metadata" (GCE metadata server),
+    # "libtpu" (local runtime probe), or "auto" (metadata then libtpu).
+    topology_source: str = j("topologySource", "")
+    # jax.distributed coordinator: worker 0 of the slice binds this port.
+    coordinator_port: int = j("coordinatorPort", 0)
+    # Host path where the agent writes the jax.distributed bootstrap config
+    # (the gaudinet.json analog, ref cmd/discover/gaudinet.go:78-89).
+    bootstrap_path: str = j("bootstrapPath", "")
+
+
+@dataclass
+class NetworkClusterPolicySpec:
+    """Desired state (ref ``networkconfiguration_types.go:24-42``)."""
+
+    # Which backend the operator configures onto the nodes.
+    configuration_type: str = j("configurationType", "")
+    # Which nodes to target; align with NFD-created labels.
+    node_selector: Dict[str, str] = j("nodeSelector", factory=dict)
+    # Backend-specific settings; only the one matching configurationType
+    # is consulted.
+    gaudi_scale_out: GaudiScaleOutSpec = j("gaudiScaleOut", factory=GaudiScaleOutSpec)
+    tpu_scale_out: TpuScaleOutSpec = j("tpuScaleOut", factory=TpuScaleOutSpec)
+    # Agent log verbosity (propagated as --v=N, ref controller :182-184).
+    log_level: int = j("logLevel", 0)
+
+
+@dataclass
+class NetworkClusterPolicyStatus:
+    """Observed state (ref ``networkconfiguration_types.go:69-74``)."""
+
+    # No omit-empty: the reference's status json tags lack omitempty, so
+    # zeroes serialize (kubectl printer columns rely on it).
+    targets: int = j("targets", 0, required=True)
+    ready_nodes: int = j("ready", 0, required=True)
+    state: str = j("state", "", required=True)
+    errors: List[str] = j("errors", factory=list, required=True)
+
+
+@dataclass
+class NetworkClusterPolicy(KubeObject):
+    """The Schema for the networkclusterpolicies API (cluster-scoped,
+    ref ``networkconfiguration_types.go:76-87``)."""
+
+    API_VERSION = API_VERSION
+    KIND = "NetworkClusterPolicy"
+
+    metadata: ObjectMeta = j("metadata", factory=ObjectMeta)
+    spec: NetworkClusterPolicySpec = j("spec", factory=NetworkClusterPolicySpec)
+    status: NetworkClusterPolicyStatus = j(
+        "status", factory=NetworkClusterPolicyStatus
+    )
+
+
+@dataclass
+class NetworkClusterPolicyList(KubeObject):
+    """List type (ref ``networkconfiguration_types.go:89-96``)."""
+
+    API_VERSION = API_VERSION
+    KIND = "NetworkClusterPolicyList"
+
+    items: List[NetworkClusterPolicy] = j("items", factory=list)
+
+
+def active_backend_spec(policy: NetworkClusterPolicy):
+    """Return the backend sub-spec selected by ``configurationType``."""
+    if policy.spec.configuration_type == CONFIG_TYPE_GAUDI_SO:
+        return policy.spec.gaudi_scale_out
+    if policy.spec.configuration_type == CONFIG_TYPE_TPU_SO:
+        return policy.spec.tpu_scale_out
+    return None

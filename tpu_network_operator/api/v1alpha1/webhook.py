@@ -1,0 +1,148 @@
+"""Admission webhook logic: defaulting + validation.
+
+Rebuild of ref ``api/v1alpha1/networkconfiguration_webhook.go:60-153``:
+
+* mutating webhook: fill the default agent image per backend
+  (ref ``Default()`` :65-74);
+* validating webhook: node-selector label syntax (three regexes, length
+  limits, ref :83-119), known configurationType (ref :126-131), plus the
+  TPU-backend checks this framework adds (enum/range validation that in the
+  reference lives only in the CRD OpenAPI schema — here enforced in both
+  places, see :mod:`.crdgen`).
+
+Transport (AdmissionReview HTTP serving, TLS) lives in
+:mod:`tpu_network_operator.controller.webhook_server`; this module is the
+pure logic so it is unit-testable exactly like the reference's
+``networkconfiguration_webhook_test.go``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+from . import types as t
+from .types import NetworkClusterPolicy, NetworkClusterPolicySpec
+
+
+class AdmissionError(Exception):
+    """Validation failure; message is returned to the API client."""
+
+
+# ref networkconfiguration_webhook.go:83-85
+LABEL_HOST_RE = re.compile(r"^([A-Za-z0-9][A-Za-z0-9_\.]*)?[A-Za-z0-9]$")
+LABEL_PATH_RE = re.compile(r"^([A-Za-z0-9][A-Za-z0-9-\._\/]*)?[A-Za-z0-9]$")
+LABEL_VALUE_RE = re.compile(r"^(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?$")
+
+PULL_POLICIES = ("", "Never", "Always", "IfNotPresent")
+TOPOLOGY_SOURCES = ("", "auto", "metadata", "libtpu")
+
+
+def default_policy(policy: NetworkClusterPolicy) -> NetworkClusterPolicy:
+    """Mutating admission: fill defaults in place, return the policy.
+
+    Ref ``Default()`` ``networkconfiguration_webhook.go:65-74`` (image only);
+    the TPU backend additionally defaults layer, topology source, coordinator
+    port and bootstrap path so the agent's contract is fully pinned by the
+    time the DaemonSet is projected.
+    """
+    spec = policy.spec
+    if spec.configuration_type == t.CONFIG_TYPE_GAUDI_SO:
+        if not spec.gaudi_scale_out.image:
+            spec.gaudi_scale_out.image = t.DEFAULT_GAUDI_AGENT_IMAGE
+    elif spec.configuration_type == t.CONFIG_TYPE_TPU_SO:
+        so = spec.tpu_scale_out
+        if not so.image:
+            so.image = t.DEFAULT_TPU_AGENT_IMAGE
+        if not so.layer:
+            so.layer = t.LAYER_L2
+        if not so.topology_source:
+            so.topology_source = "auto"
+        if not so.coordinator_port:
+            so.coordinator_port = t.DEFAULT_COORDINATOR_PORT
+        if not so.bootstrap_path:
+            so.bootstrap_path = t.DEFAULT_BOOTSTRAP_PATH
+    return policy
+
+
+def validate_node_selector(node_selector) -> None:
+    """Ref ``validateNodeSelector()`` ``networkconfiguration_webhook.go:91-119``."""
+    if not node_selector:
+        raise AdmissionError("empty node-selector")
+    for k, v in node_selector.items():
+        if len(k) > 253 or len(v) > 63:
+            raise AdmissionError("invalid node selector")
+        if not LABEL_VALUE_RE.match(v):
+            raise AdmissionError("invalid node selector")
+        parts = k.split("/", 1)
+        if len(parts) == 1:
+            if not LABEL_HOST_RE.match(parts[0]):
+                raise AdmissionError("invalid node selector")
+        else:
+            if not LABEL_HOST_RE.match(parts[0]):
+                raise AdmissionError("invalid node selector")
+            if not LABEL_PATH_RE.match(parts[1]):
+                raise AdmissionError("invalid node selector")
+
+
+def _validate_common_so(layer: str, mtu: int, pull_policy: str, what: str) -> None:
+    if layer not in ("", t.LAYER_L2, t.LAYER_L3):
+        raise AdmissionError(f"{what}: layer must be L2 or L3")
+    if mtu and not (t.MTU_MIN <= mtu <= t.MTU_MAX):
+        raise AdmissionError(
+            f"{what}: mtu must be within {t.MTU_MIN}-{t.MTU_MAX}"
+        )
+    if pull_policy not in PULL_POLICIES:
+        raise AdmissionError(f"{what}: invalid pullPolicy")
+
+
+def validate_gaudi_so_spec(s: t.GaudiScaleOutSpec) -> None:
+    """Ref ``validateGaudiSoSpec()`` :87-89 (no-op there; schema-only).
+    Here the schema ranges are enforced webhook-side too."""
+    _validate_common_so(s.layer, s.mtu, s.pull_policy, "gaudiScaleOut")
+
+
+def validate_tpu_so_spec(s: t.TpuScaleOutSpec) -> None:
+    _validate_common_so(s.layer, s.mtu, s.pull_policy, "tpuScaleOut")
+    if s.topology_source not in TOPOLOGY_SOURCES:
+        raise AdmissionError("tpuScaleOut: invalid topologySource")
+    if s.coordinator_port and not (1024 <= s.coordinator_port <= 65535):
+        raise AdmissionError("tpuScaleOut: coordinatorPort must be 1024-65535")
+    if s.bootstrap_path and not s.bootstrap_path.startswith("/"):
+        raise AdmissionError("tpuScaleOut: bootstrapPath must be absolute")
+
+
+def validate_spec(spec: NetworkClusterPolicySpec) -> List[str]:
+    """Ref ``validateSpec()`` ``networkconfiguration_webhook.go:121-132``.
+    Returns admission warnings (always empty today, like the reference)."""
+    validate_node_selector(spec.node_selector)
+    if not (t.LOG_LEVEL_MIN <= spec.log_level <= t.LOG_LEVEL_MAX):
+        raise AdmissionError(
+            f"logLevel must be within {t.LOG_LEVEL_MIN}-{t.LOG_LEVEL_MAX}"
+        )
+    if spec.configuration_type == t.CONFIG_TYPE_GAUDI_SO:
+        validate_gaudi_so_spec(spec.gaudi_scale_out)
+    elif spec.configuration_type == t.CONFIG_TYPE_TPU_SO:
+        validate_tpu_so_spec(spec.tpu_scale_out)
+    else:
+        raise AdmissionError(
+            f"unknown configuration type {spec.configuration_type!r}"
+        )
+    return []
+
+
+def validate_create(policy: NetworkClusterPolicy) -> List[str]:
+    """Ref ``ValidateCreate()`` :135-139."""
+    return validate_spec(policy.spec)
+
+
+def validate_update(
+    policy: NetworkClusterPolicy, old: Optional[NetworkClusterPolicy] = None
+) -> List[str]:
+    """Ref ``ValidateUpdate()`` :142-146 (old object unused, as there)."""
+    return validate_spec(policy.spec)
+
+
+def validate_delete(policy: NetworkClusterPolicy) -> Tuple[List[str], None]:
+    """Ref ``ValidateDelete()`` :149-153 — always allowed."""
+    return [], None
