@@ -95,6 +95,9 @@ class TestValidation:
             {"foo.com_/bar": "ok"},
             {"foobar.com?foo": "bar"},
             {"x" * 254: "ok"},
+            # Go regexp `$` is end-of-text; Python `$` would admit these
+            {"foo.com": "bar\n"},
+            {"foo.com\n": "bar"},
         ],
     )
     def test_deny_bad_node_selectors(self, selector):
@@ -115,6 +118,15 @@ class TestValidation:
         p = gaudi_policy()
         p.spec.gaudi_scale_out.layer = "L3"
         assert validate_delete(p) == ([], None)
+
+    def test_gaudi_layer_required(self):
+        # ref schema marks gaudiScaleOut.layer Required
+        # (networkconfiguration_types.go:50-53); without it the projection
+        # would emit an empty --mode= arg
+        p = gaudi_policy()
+        p.spec.gaudi_scale_out.layer = ""
+        with pytest.raises(AdmissionError, match="layer is required"):
+            validate_create(p)
 
     def test_mtu_range_enforced(self):
         p = gaudi_policy()

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import copy
 import dataclasses
+import functools
 import typing
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -65,13 +66,20 @@ def _strip_optional(tp: Any) -> Any:
     return tp
 
 
+@functools.lru_cache(maxsize=None)
+def _type_hints(cls: Any) -> Dict[str, Any]:
+    # get_type_hints re-evals PEP-563 string annotations; cache per class —
+    # every admission request and reconcile parses these types
+    return typing.get_type_hints(cls)
+
+
 def from_dict(cls: Any, data: Any) -> Any:
     """Deserialize ``data`` into dataclass ``cls`` (recursive, tolerant)."""
     if data is None:
         return cls() if dataclasses.is_dataclass(cls) else None
     if not dataclasses.is_dataclass(cls):
         return data
-    hints = typing.get_type_hints(cls)
+    hints = _type_hints(cls)
     kwargs: Dict[str, Any] = {}
     for f in dataclasses.fields(cls):
         name = f.metadata.get("json", f.name)

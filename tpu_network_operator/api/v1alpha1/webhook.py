@@ -29,10 +29,11 @@ class AdmissionError(Exception):
     """Validation failure; message is returned to the API client."""
 
 
-# ref networkconfiguration_webhook.go:83-85
-LABEL_HOST_RE = re.compile(r"^([A-Za-z0-9][A-Za-z0-9_\.]*)?[A-Za-z0-9]$")
-LABEL_PATH_RE = re.compile(r"^([A-Za-z0-9][A-Za-z0-9-\._\/]*)?[A-Za-z0-9]$")
-LABEL_VALUE_RE = re.compile(r"^(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?$")
+# ref networkconfiguration_webhook.go:83-85.  \Z not $: Go regexp `$` is
+# end-of-text but Python `$` would admit a trailing newline.
+LABEL_HOST_RE = re.compile(r"^([A-Za-z0-9][A-Za-z0-9_\.]*)?[A-Za-z0-9]\Z")
+LABEL_PATH_RE = re.compile(r"^([A-Za-z0-9][A-Za-z0-9-\._\/]*)?[A-Za-z0-9]\Z")
+LABEL_VALUE_RE = re.compile(r"^(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?\Z")
 
 PULL_POLICIES = ("", "Never", "Always", "IfNotPresent")
 TOPOLOGY_SOURCES = ("", "auto", "metadata", "libtpu")
@@ -98,7 +99,12 @@ def _validate_common_so(layer: str, mtu: int, pull_policy: str, what: str) -> No
 
 def validate_gaudi_so_spec(s: t.GaudiScaleOutSpec) -> None:
     """Ref ``validateGaudiSoSpec()`` :87-89 (no-op there; schema-only).
-    Here the schema ranges are enforced webhook-side too."""
+    Here the schema constraints are enforced webhook-side too — including
+    the reference schema's Required marker on layer
+    (ref networkconfiguration_types.go:50-53): without it the projection
+    would emit a malformed empty ``--mode=`` agent arg."""
+    if not s.layer:
+        raise AdmissionError("gaudiScaleOut: layer is required")
     _validate_common_so(s.layer, s.mtu, s.pull_policy, "gaudiScaleOut")
 
 

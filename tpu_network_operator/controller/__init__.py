@@ -1,0 +1,3 @@
+"""Operator / reconciler layer (L3): cluster-side control loop."""
+
+from .reconciler import NetworkClusterPolicyReconciler, Result  # noqa: F401

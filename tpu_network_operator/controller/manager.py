@@ -1,0 +1,167 @@
+"""Controller manager: watch-driven reconcile loop with a dedup workqueue.
+
+controller-runtime analog (ref ``cmd/operator/main.go:169-229`` +
+``SetupWithManager`` ``For(NetworkClusterPolicy).Owns(DaemonSet)``): watches
+the CR and its owned DaemonSets, maps DaemonSet events back to the owning CR
+(the ``Owns`` relationship), deduplicates into a workqueue, and runs the
+reconciler per item.  The hot loop is the workqueue drain, exactly as in the
+reference (SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Optional
+
+from ..api.v1alpha1.types import API_VERSION, NetworkClusterPolicy
+from .reconciler import NetworkClusterPolicyReconciler, controller_of
+
+log = logging.getLogger("tpunet.manager")
+
+
+class Manager:
+    def __init__(self, client, namespace: str, is_openshift: bool = False):
+        self.client = client
+        self.namespace = namespace
+        self.reconciler = NetworkClusterPolicyReconciler(
+            client, namespace, is_openshift
+        )
+        self._queue: "queue.Queue[str]" = queue.Queue()
+        self._pending = set()
+        self._pending_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = []
+        # rate-limited requeue (controller-runtime's default item backoff:
+        # 5ms base, exponential, capped) — without it a permanently-failing
+        # item spins the worker hot
+        self._failures: dict = {}
+        self._backoff_base = 0.005
+        self._backoff_max = 30.0
+        # watches start at construction so no event is missed between
+        # manager creation and start()/drain() (informer semantics)
+        self._w_policies = client.watch(API_VERSION, NetworkClusterPolicy.KIND)
+        self._w_daemonsets = client.watch("apps/v1", "DaemonSet")
+
+    # -- workqueue with dedup (controller-runtime workqueue analog) ----------
+
+    def enqueue(self, name: str) -> None:
+        with self._pending_lock:
+            if name in self._pending:
+                return
+            self._pending.add(name)
+        self._queue.put(name)
+
+    def _pop(self, timeout: Optional[float]) -> Optional[str]:
+        try:
+            name = self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        with self._pending_lock:
+            self._pending.discard(name)
+        return name
+
+    # -- event sources --------------------------------------------------------
+
+    def _handle_policy_event(self, ev) -> None:
+        _, obj = ev
+        self.enqueue(obj["metadata"]["name"])
+
+    def _handle_daemonset_event(self, ev) -> None:
+        """Owns(DaemonSet): map the event to the owning CR (ref
+        SetupWithManager :425-428)."""
+        _, obj = ev
+        owner = controller_of(obj)
+        if (
+            owner
+            and owner.get("apiVersion") == API_VERSION
+            and owner.get("kind") == NetworkClusterPolicy.KIND
+        ):
+            self.enqueue(owner["name"])
+
+    def _watch_policies(self) -> None:
+        while not self._stop.is_set():
+            ev = self._w_policies.next(timeout=0.2)
+            if ev is not None:
+                self._handle_policy_event(ev)
+        self._w_policies.stop()
+
+    def _watch_daemonsets(self) -> None:
+        while not self._stop.is_set():
+            ev = self._w_daemonsets.next(timeout=0.2)
+            if ev is not None:
+                self._handle_daemonset_event(ev)
+        self._w_daemonsets.stop()
+
+    # -- run ------------------------------------------------------------------
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            name = self._pop(timeout=0.2)
+            if name is None:
+                continue
+            self._reconcile_one(name)
+
+    def _requeue_after_failure(self, name: str) -> None:
+        count = self._failures.get(name, 0) + 1
+        self._failures[name] = count
+        delay = min(self._backoff_base * (2 ** count), self._backoff_max)
+        timer = threading.Timer(delay, self.enqueue, args=(name,))
+        timer.daemon = True
+        timer.start()
+
+    def _reconcile_one(self, name: str) -> None:
+        try:
+            result = self.reconciler.reconcile(name)
+            self._failures.pop(name, None)
+            if result.requeue:
+                self.enqueue(name)
+        except Exception:
+            log.exception("reconcile failed for %s; requeueing with backoff", name)
+            self._requeue_after_failure(name)
+
+    def start(self) -> None:
+        """Start watches + one worker in the background (mgr.Start analog)."""
+        self.reconciler.setup()
+        # seed: reconcile everything that already exists (informer initial list)
+        for obj in self.client.list(API_VERSION, NetworkClusterPolicy.KIND):
+            self.enqueue(obj["metadata"]["name"])
+        for fn in (self._watch_policies, self._watch_daemonsets, self._worker):
+            th = threading.Thread(target=fn, daemon=True)
+            th.start()
+            self._threads.append(th)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for th in self._threads:
+            th.join(timeout=2)
+
+    # -- synchronous drive for tests ------------------------------------------
+
+    def _pump_events(self) -> None:
+        """Move all immediately-available watch events into the workqueue."""
+        while True:
+            ev = self._w_policies.next(timeout=0)
+            if ev is None:
+                break
+            self._handle_policy_event(ev)
+        while True:
+            ev = self._w_daemonsets.next(timeout=0)
+            if ev is None:
+                break
+            self._handle_daemonset_event(ev)
+
+    def drain(self, max_iters: int = 100) -> int:
+        """Pump watch events + process queued work synchronously until quiet.
+        Tests use this instead of sleeping on background threads."""
+        self.reconciler.setup()
+        n = 0
+        while n < max_iters:
+            self._pump_events()
+            name = self._pop(timeout=0)
+            if name is None:
+                return n
+            self._reconcile_one(name)
+            n += 1
+        return n

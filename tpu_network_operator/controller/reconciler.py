@@ -1,0 +1,370 @@
+"""NetworkClusterPolicy reconciler.
+
+Rebuild of ref ``internal/controller/networkconfiguration_controller.go``:
+watch the cluster-scoped CR, own exactly one agent DaemonSet per CR in the
+operator namespace, project the CR spec into agent CLI args + host volumes,
+and maintain the CR status from DaemonSet scheduling counts.  This version
+adds the ``tpu-so`` projection alongside the reference's ``gaudi-so``.
+
+Flow (ref ``Reconcile()`` :313-362): get CR → list owned DaemonSets via the
+field index → create if none → else re-project + update only on template
+drift → recompute status {No targets | Working on it.. | All good}.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import os.path
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from ..api import apimachinery as am
+from ..api.v1alpha1 import types as t
+from ..api.v1alpha1.types import NetworkClusterPolicy
+from ..kube import errors as kerr
+from . import templates
+
+log = logging.getLogger("tpunet.controller")
+
+OWNER_KEY = ".metadata.controller"   # ref controller :58
+
+# gaudinet host/container paths (ref controller :65-67)
+GAUDINET_PATH_HOST = "/etc/habanalabs/gaudinet.json"
+GAUDINET_PATH_CONTAINER = "/host" + GAUDINET_PATH_HOST
+
+STATE_NO_TARGETS = "No targets"      # ref controller :290
+STATE_WORKING = "Working on it.."    # ref controller :292
+STATE_ALL_GOOD = "All good"          # ref controller :294
+
+
+@dataclass
+class Result:
+    """ctrl.Result analog."""
+
+    requeue: bool = False
+
+
+def controller_of(obj: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """metav1.GetControllerOf analog."""
+    for ref in obj.get("metadata", {}).get("ownerReferences", []) or []:
+        if ref.get("controller"):
+            return ref
+    return None
+
+
+def add_host_volume(
+    ds: Dict[str, Any],
+    volume_type: str,
+    volume_name: str,
+    host_path: str,
+    container_path: str,
+) -> None:
+    """ref ``addHostVolume()`` controller :69-107 (idempotent by name)."""
+    pod_spec = ds["spec"]["template"]["spec"]
+    volumes = pod_spec.setdefault("volumes", [])
+    if any(v.get("name") == volume_name for v in volumes):
+        return
+    volumes.append(
+        {
+            "name": volume_name,
+            "hostPath": {"path": host_path, "type": volume_type},
+        }
+    )
+    containers = pod_spec.get("containers", [])
+    if containers:
+        containers[0].setdefault("volumeMounts", []).append(
+            {
+                "name": volume_name,
+                "readOnly": False,
+                "mountPath": container_path,
+            }
+        )
+
+
+def update_gaudi_scale_out_daemonset(
+    ds: Dict[str, Any], policy: NetworkClusterPolicy, namespace: str
+) -> None:
+    """CR → DaemonSet projection for gaudi-so
+    (ref ``updateGaudiScaleOutDaemonSet()`` controller :164-204)."""
+    spec = policy.spec
+    so = spec.gaudi_scale_out
+
+    ds["metadata"]["name"] = policy.metadata.name
+    ds["metadata"]["namespace"] = namespace
+    pod_spec = ds["spec"]["template"]["spec"]
+    container = pod_spec["containers"][0]
+
+    if spec.node_selector:
+        pod_spec["nodeSelector"] = dict(spec.node_selector)
+    if so.image:
+        container["image"] = so.image
+    if so.pull_policy:
+        container["imagePullPolicy"] = so.pull_policy
+
+    args = ["--configure=true", "--keep-running", f"--mode={so.layer}"]
+    if spec.log_level > 0:
+        args.append(f"--v={spec.log_level}")
+    if so.mtu > 0:
+        args.append(f"--mtu={so.mtu}")
+    if so.disable_network_manager:
+        args.append("--disable-networkmanager")
+        add_host_volume(
+            ds, "DirectoryOrCreate", "var-run-dbus", "/var/run/dbus", "/var/run/dbus"
+        )
+        add_host_volume(
+            ds,
+            "DirectoryOrCreate",
+            "networkmanager",
+            "/etc/NetworkManager",
+            "/etc/NetworkManager",
+        )
+    if so.layer == t.LAYER_L3:
+        args += ["--wait=90s", f"--gaudinet={GAUDINET_PATH_CONTAINER}"]
+        add_host_volume(
+            ds,
+            "DirectoryOrCreate",
+            "gaudinetpath",
+            os.path.dirname(GAUDINET_PATH_HOST),
+            os.path.dirname(GAUDINET_PATH_CONTAINER),
+        )
+    container["args"] = args
+
+
+def update_tpu_scale_out_daemonset(
+    ds: Dict[str, Any], policy: NetworkClusterPolicy, namespace: str
+) -> None:
+    """CR → DaemonSet projection for tpu-so (no reference analog; designed
+    per SURVEY.md §5.8: topology discovery always runs; DCN L3 additionally
+    gets the LLDP wait budget; the bootstrap file replaces gaudinet.json)."""
+    spec = policy.spec
+    so = spec.tpu_scale_out
+
+    ds["metadata"]["name"] = policy.metadata.name
+    ds["metadata"]["namespace"] = namespace
+    pod_spec = ds["spec"]["template"]["spec"]
+    container = pod_spec["containers"][0]
+
+    if spec.node_selector:
+        pod_spec["nodeSelector"] = dict(spec.node_selector)
+    if so.image:
+        container["image"] = so.image
+    if so.pull_policy:
+        container["imagePullPolicy"] = so.pull_policy
+
+    bootstrap_host = so.bootstrap_path or t.DEFAULT_BOOTSTRAP_PATH
+    bootstrap_container = "/host" + bootstrap_host
+
+    args = [
+        "--configure=true",
+        "--keep-running",
+        "--backend=tpu",
+        f"--mode={so.layer or t.LAYER_L2}",
+    ]
+    if spec.log_level > 0:
+        args.append(f"--v={spec.log_level}")
+    if so.mtu > 0:
+        args.append(f"--mtu={so.mtu}")
+    if so.disable_network_manager:
+        args.append("--disable-networkmanager")
+        add_host_volume(
+            ds, "DirectoryOrCreate", "var-run-dbus", "/var/run/dbus", "/var/run/dbus"
+        )
+        add_host_volume(
+            ds,
+            "DirectoryOrCreate",
+            "networkmanager",
+            "/etc/NetworkManager",
+            "/etc/NetworkManager",
+        )
+    args += [
+        f"--topology-source={so.topology_source or 'auto'}",
+        f"--coordinator-port={so.coordinator_port or t.DEFAULT_COORDINATOR_PORT}",
+        f"--bootstrap={bootstrap_container}",
+    ]
+    if so.layer == t.LAYER_L3:
+        args.append("--wait=90s")
+    add_host_volume(
+        ds,
+        "DirectoryOrCreate",
+        "bootstrappath",
+        os.path.dirname(bootstrap_host),
+        os.path.dirname(bootstrap_container),
+    )
+    container["args"] = args
+
+
+class NetworkClusterPolicyReconciler:
+    """ref ``NetworkClusterPolicyReconciler`` controller :50-55."""
+
+    def __init__(self, client, namespace: str, is_openshift: bool = False):
+        self.client = client
+        self.namespace = namespace
+        self.is_openshift = is_openshift
+
+    # -- setup ----------------------------------------------------------------
+
+    def setup(self) -> None:
+        """Register field indexers (ref ``SetupWithManager`` :407-429;
+        ``indexDaemonSets`` :364-383, ``indexPods`` :385-404)."""
+
+        def index_daemonsets(obj: Dict[str, Any]) -> List[str]:
+            owner = controller_of(obj)
+            if not owner:
+                return []
+            if (
+                owner.get("apiVersion") != t.API_VERSION
+                or owner.get("kind") != NetworkClusterPolicy.KIND
+            ):
+                return []
+            return [owner["name"]]
+
+        def index_pods(obj: Dict[str, Any]) -> List[str]:
+            owner = controller_of(obj)
+            if not owner:
+                return []
+            if owner.get("apiVersion") != "apps/v1" or owner.get("kind") != "DaemonSet":
+                return []
+            return [owner["name"]]
+
+        self.client.register_index("apps/v1", "DaemonSet", OWNER_KEY, index_daemonsets)
+        self.client.register_index("v1", "Pod", OWNER_KEY, index_pods)
+
+    # -- create path ----------------------------------------------------------
+
+    def _create_openshift_collateral(
+        self, policy: NetworkClusterPolicy, sa_name: str
+    ) -> None:
+        """ref ``createOpenShiftCollateral()`` :109-162."""
+        sa = templates.linkdiscovery_service_account()
+        sa["metadata"]["name"] = sa_name
+        sa["metadata"]["namespace"] = self.namespace
+        self._own(policy, sa)
+        try:
+            self.client.create(sa)
+        except kerr.AlreadyExistsError:
+            pass
+
+        rb = templates.openshift_role_binding()
+        rb["metadata"]["name"] = sa_name + "-rb"
+        rb["metadata"]["namespace"] = self.namespace
+        rb["subjects"] = [
+            {
+                "kind": "ServiceAccount",
+                "name": sa_name,
+                "namespace": self.namespace,
+            }
+        ]
+        self._own(policy, rb)
+        try:
+            self.client.create(rb)
+        except kerr.AlreadyExistsError:
+            pass
+
+    def _own(self, policy: NetworkClusterPolicy, obj: Dict[str, Any]) -> None:
+        meta = am.ObjectMeta()
+        am.set_controller_reference(policy, meta)
+        obj.setdefault("metadata", {})["ownerReferences"] = [
+            am.to_dict(r) for r in meta.owner_references
+        ]
+
+    def _create_daemonset(self, policy: NetworkClusterPolicy) -> Result:
+        """ref ``createDaemonSet`` :243-254 + ``createGaudiScaleOutDaemonset``
+        :206-241 (switch on configurationType)."""
+        ctype = policy.spec.configuration_type
+        if ctype == t.CONFIG_TYPE_GAUDI_SO:
+            ds = templates.gaudi_discovery_daemonset()
+            project = update_gaudi_scale_out_daemonset
+        elif ctype == t.CONFIG_TYPE_TPU_SO:
+            ds = templates.tpu_discovery_daemonset()
+            project = update_tpu_scale_out_daemonset
+        else:
+            log.error("unknown configuration type %r, this shouldn't happen", ctype)
+            raise kerr.ApiError(f"unknown configuration type {ctype!r}")
+
+        sa_name = policy.metadata.name + "-sa" if self.is_openshift else ""
+        ds["spec"]["template"]["spec"]["serviceAccountName"] = sa_name
+
+        project(ds, policy, self.namespace)
+        self._own(policy, ds)
+        self.client.create(ds)
+        log.info("scale-out daemonset created: %s", ds["metadata"]["name"])
+
+        if sa_name:
+            self._create_openshift_collateral(policy, sa_name)
+        return Result()
+
+    # -- update path ----------------------------------------------------------
+
+    def _update_daemonset(
+        self, ds: Dict[str, Any], policy: NetworkClusterPolicy
+    ) -> None:
+        """ref ``updateDaemonSet`` :256-265."""
+        ctype = policy.spec.configuration_type
+        if ctype == t.CONFIG_TYPE_GAUDI_SO:
+            update_gaudi_scale_out_daemonset(ds, policy, self.namespace)
+        elif ctype == t.CONFIG_TYPE_TPU_SO:
+            update_tpu_scale_out_daemonset(ds, policy, self.namespace)
+        else:
+            raise AssertionError("unknown configuration type, this shouldn't happen!")
+
+    # -- status ---------------------------------------------------------------
+
+    def _update_status(
+        self, policy: NetworkClusterPolicy, ds: Dict[str, Any]
+    ) -> Result:
+        """ref ``updateStatus()`` :267-307: status from DaemonSet counts;
+        conflict → requeue."""
+        ds_status = ds.get("status", {}) or {}
+        targets = int(ds_status.get("desiredNumberScheduled", 0))
+        ready = int(ds_status.get("numberReady", 0))
+
+        updated = (
+            policy.status.targets != targets
+            or policy.status.ready_nodes != ready
+            or not policy.status.state
+        )
+        policy.status.targets = targets
+        policy.status.ready_nodes = ready
+        policy.status.errors = []
+        if targets == 0:
+            policy.status.state = STATE_NO_TARGETS
+        elif ready < targets:
+            policy.status.state = STATE_WORKING
+        else:
+            policy.status.state = STATE_ALL_GOOD
+
+        if updated:
+            try:
+                self.client.update_status(policy.to_dict())
+            except kerr.ConflictError:
+                return Result(requeue=True)
+        return Result()
+
+    # -- entry point ----------------------------------------------------------
+
+    def reconcile(self, name: str) -> Result:
+        """ref ``Reconcile()`` :313-362."""
+        try:
+            raw = self.client.get(t.API_VERSION, NetworkClusterPolicy.KIND, name)
+        except kerr.NotFoundError:
+            return Result()   # IgnoreNotFound (ref :320-326)
+        policy = NetworkClusterPolicy.from_dict(raw)
+
+        owned = self.client.list(
+            "apps/v1",
+            "DaemonSet",
+            namespace=self.namespace,
+            field_index={OWNER_KEY: name},
+        )
+        if not owned:
+            return self._create_daemonset(policy)
+
+        ds = owned[0]
+        original_spec = copy.deepcopy(ds["spec"]["template"]["spec"])
+        self._update_daemonset(ds, policy)
+        if ds["spec"]["template"]["spec"] != original_spec:
+            log.info("DS template drift; updating %s", ds["metadata"]["name"])
+            self.client.update(ds)
+
+        return self._update_status(policy, ds)

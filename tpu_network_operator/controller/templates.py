@@ -1,0 +1,193 @@
+"""Embedded deployment templates — the go:embed analog.
+
+The reference embeds its agent DaemonSet / ServiceAccount / RoleBinding YAML
+into the operator binary and panics at startup on a bad embed, making the
+template a build-time guarantee (ref ``config/discovery/discovery.go:35-57``,
+``base/daemonset.yaml``).  Here the YAML lives in-module and is parsed at
+import time — a bad template fails the import, the same guarantee.
+
+Template shape mirrors ``config/discovery/base/daemonset.yaml:1-57``:
+hostNetwork, NET_ADMIN+NET_RAW (and nothing else), read-only rootfs, NFD
+features.d hostPath, NODE_NAME downward-API env, tight resource envelope.
+The TPU variant differs only where the hardware does: the agent needs the
+GCE metadata server (host network covers it) and writes the jax.distributed
+bootstrap file instead of gaudinet.json.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+import yaml
+
+GAUDI_DAEMONSET_YAML = """
+apiVersion: apps/v1
+kind: DaemonSet
+metadata:
+  name: tpunet-network-tools
+  labels:
+    app: tpunet-network-tools
+spec:
+  selector:
+    matchLabels:
+      app: tpunet-network-tools
+  updateStrategy:
+    type: RollingUpdate
+    rollingUpdate:
+      maxSurge: 0
+      maxUnavailable: 1
+  template:
+    metadata:
+      labels:
+        app: tpunet-network-tools
+    spec:
+      hostNetwork: true
+      volumes:
+      - name: nfd-features
+        hostPath:
+          path: /etc/kubernetes/node-feature-discovery/features.d/
+          type: DirectoryOrCreate
+      containers:
+      - env:
+        - name: NODE_NAME
+          valueFrom:
+            fieldRef:
+              apiVersion: v1
+              fieldPath: spec.nodeName
+        image: ghcr.io/tpunet/network-linkdiscovery:latest
+        imagePullPolicy: IfNotPresent
+        name: configurator
+        resources:
+          limits:
+            cpu: 100m
+            memory: 90Mi
+          requests:
+            cpu: 40m
+            memory: 45Mi
+        volumeMounts:
+        - mountPath: /etc/kubernetes/node-feature-discovery/features.d/
+          name: nfd-features
+        securityContext:
+          allowPrivilegeEscalation: false
+          readOnlyRootFilesystem: true
+          capabilities:
+            drop:
+            - ALL
+            add:
+            - NET_ADMIN
+            - NET_RAW
+      terminationGracePeriodSeconds: 10
+"""
+
+TPU_DAEMONSET_YAML = """
+apiVersion: apps/v1
+kind: DaemonSet
+metadata:
+  name: tpunet-tpu-network-tools
+  labels:
+    app: tpunet-tpu-network-tools
+spec:
+  selector:
+    matchLabels:
+      app: tpunet-tpu-network-tools
+  updateStrategy:
+    type: RollingUpdate
+    rollingUpdate:
+      maxSurge: 0
+      maxUnavailable: 1
+  template:
+    metadata:
+      labels:
+        app: tpunet-tpu-network-tools
+    spec:
+      hostNetwork: true
+      volumes:
+      - name: nfd-features
+        hostPath:
+          path: /etc/kubernetes/node-feature-discovery/features.d/
+          type: DirectoryOrCreate
+      containers:
+      - env:
+        - name: NODE_NAME
+          valueFrom:
+            fieldRef:
+              apiVersion: v1
+              fieldPath: spec.nodeName
+        image: ghcr.io/tpunet/tpu-linkdiscovery:latest
+        imagePullPolicy: IfNotPresent
+        name: configurator
+        resources:
+          limits:
+            cpu: 100m
+            memory: 128Mi
+          requests:
+            cpu: 40m
+            memory: 64Mi
+        volumeMounts:
+        - mountPath: /etc/kubernetes/node-feature-discovery/features.d/
+          name: nfd-features
+        securityContext:
+          allowPrivilegeEscalation: false
+          readOnlyRootFilesystem: true
+          capabilities:
+            drop:
+            - ALL
+            add:
+            - NET_ADMIN
+            - NET_RAW
+      terminationGracePeriodSeconds: 10
+"""
+
+SERVICEACCOUNT_YAML = """
+apiVersion: v1
+kind: ServiceAccount
+metadata:
+  name: linkdiscovery-sa
+"""
+
+OPENSHIFT_ROLEBINDING_YAML = """
+apiVersion: rbac.authorization.k8s.io/v1
+kind: RoleBinding
+metadata:
+  name: linkdiscovery-openshift-privileged
+roleRef:
+  apiGroup: rbac.authorization.k8s.io
+  kind: ClusterRole
+  name: system:openshift:scc:privileged
+subjects:
+- kind: ServiceAccount
+  name: linkdiscovery-sa
+  namespace: tobechangedincontroller
+"""
+
+
+def _parse(doc: str) -> Dict[str, Any]:
+    obj = yaml.safe_load(doc)
+    if not isinstance(obj, dict) or "kind" not in obj:
+        raise ValueError("embedded template is not a k8s object")
+    return obj
+
+
+# import-time parse = build-time guarantee (discovery.go panics likewise)
+_GAUDI_DS = _parse(GAUDI_DAEMONSET_YAML)
+_TPU_DS = _parse(TPU_DAEMONSET_YAML)
+_SA = _parse(SERVICEACCOUNT_YAML)
+_RB = _parse(OPENSHIFT_ROLEBINDING_YAML)
+
+
+def gaudi_discovery_daemonset() -> Dict[str, Any]:
+    """ref ``GaudiDiscoveryDaemonSet()`` discovery.go:35-37."""
+    return copy.deepcopy(_GAUDI_DS)
+
+
+def tpu_discovery_daemonset() -> Dict[str, Any]:
+    return copy.deepcopy(_TPU_DS)
+
+
+def linkdiscovery_service_account() -> Dict[str, Any]:
+    return copy.deepcopy(_SA)
+
+
+def openshift_role_binding() -> Dict[str, Any]:
+    return copy.deepcopy(_RB)

@@ -1,0 +1,22 @@
+"""Minimal Kubernetes machinery (client-go / controller-runtime analog).
+
+The reference leans on controller-runtime + envtest; neither exists here, so
+this package provides the same seams from scratch:
+
+* :mod:`.errors`  — typed API errors (NotFound/Conflict/AlreadyExists/...).
+* :mod:`.fake`    — in-memory apiserver with watches, admission hooks,
+  owner-reference GC, field indexers and a DaemonSet/node simulator; the
+  test-time integration surface (envtest analog, SURVEY.md §4.2).
+* :mod:`.client`  — a real HTTP API client (in-cluster or kubeconfig) with
+  the same interface, for production use.
+"""
+
+from .errors import (  # noqa: F401
+    ApiError,
+    NotFoundError,
+    AlreadyExistsError,
+    ConflictError,
+    AdmissionDeniedError,
+    ignore_not_found,
+)
+from .fake import FakeCluster  # noqa: F401

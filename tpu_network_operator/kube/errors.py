@@ -1,0 +1,58 @@
+"""Typed API errors (k8s.io/apimachinery/pkg/api/errors analog)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ApiError(Exception):
+    """Base API error with an HTTP-ish status code."""
+
+    code = 500
+    reason = "InternalError"
+
+    def __init__(self, message: str = ""):
+        super().__init__(message or self.reason)
+
+
+class NotFoundError(ApiError):
+    code = 404
+    reason = "NotFound"
+
+
+class AlreadyExistsError(ApiError):
+    code = 409
+    reason = "AlreadyExists"
+
+
+class ConflictError(ApiError):
+    """resourceVersion mismatch on update (optimistic concurrency)."""
+
+    code = 409
+    reason = "Conflict"
+
+
+class AdmissionDeniedError(ApiError):
+    """A validating webhook rejected the request."""
+
+    code = 403
+    reason = "AdmissionDenied"
+
+
+def is_not_found(err: Exception) -> bool:
+    return isinstance(err, NotFoundError)
+
+
+def is_conflict(err: Exception) -> bool:
+    return isinstance(err, ConflictError)
+
+
+def is_already_exists(err: Exception) -> bool:
+    return isinstance(err, AlreadyExistsError)
+
+
+def ignore_not_found(err: Optional[Exception]) -> Optional[Exception]:
+    """client.IgnoreNotFound analog."""
+    if err is None or is_not_found(err):
+        return None
+    return err

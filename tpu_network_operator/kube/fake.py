@@ -1,0 +1,399 @@
+"""In-memory fake apiserver — the framework's envtest analog.
+
+The reference tests its reconciler against real apiserver+etcd binaries
+(envtest, ref ``internal/controller/suite_test.go:61-102``); that toolchain
+does not exist here, so this module provides the equivalent integration
+surface from scratch: object storage with resourceVersions and optimistic
+concurrency, admission hook invocation, watch streams, owner-reference
+garbage collection, field indexers, and — going beyond envtest, which never
+schedules DaemonSet pods (ref SURVEY.md §4.2) — an optional node/DaemonSet
+simulator so status math can be exercised above zero.
+
+Objects are plain dicts in k8s wire form ({apiVersion, kind, metadata, ...});
+typed API objects convert via their ``to_dict``/``from_dict``.
+"""
+
+from __future__ import annotations
+
+import copy
+import fnmatch
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from .errors import (
+    AdmissionDeniedError,
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+)
+
+GVK = Tuple[str, str]          # (apiVersion, kind)
+Key = Tuple[str, str]          # (namespace, name); "" namespace = cluster-scoped
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+def _meta(obj: Dict[str, Any]) -> Dict[str, Any]:
+    return obj.setdefault("metadata", {})
+
+
+def _key(obj: Dict[str, Any]) -> Key:
+    m = _meta(obj)
+    return (m.get("namespace", ""), m.get("name", ""))
+
+
+def match_labels(labels: Dict[str, str], selector: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class Watch:
+    """A single watch stream; events are (type, object) tuples."""
+
+    def __init__(self):
+        self._q: "queue.Queue[Tuple[str, Dict[str, Any]]]" = queue.Queue()
+        self.stopped = False
+
+    def push(self, ev_type: str, obj: Dict[str, Any]) -> None:
+        if not self.stopped:
+            self._q.put((ev_type, copy.deepcopy(obj)))
+
+    def next(self, timeout: Optional[float] = None):
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self) -> None:
+        self.stopped = True
+
+
+class FakeCluster:
+    """The fake apiserver.  Thread-safe; watches are per-GVK fan-out."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._store: Dict[GVK, Dict[Key, Dict[str, Any]]] = {}
+        self._rv = 0
+        self._uid = 0
+        self._watches: Dict[GVK, List[Watch]] = {}
+        self._indexers: Dict[Tuple[GVK, str], Callable] = {}
+        self._mutators: Dict[GVK, List[Callable]] = {}
+        self._validators: Dict[GVK, List[Callable]] = {}
+
+    # -- admission + indexer registration ------------------------------------
+
+    def register_admission(
+        self,
+        api_version: str,
+        kind: str,
+        mutate: Optional[Callable] = None,
+        validate: Optional[Callable] = None,
+    ) -> None:
+        """Plug webhook logic into the request path (envtest's
+        WebhookInstallOptions analog, ref webhook_suite_test.go:58-136).
+
+        ``mutate(obj_dict) -> obj_dict|None``; ``validate(obj_dict, old|None)``
+        raises to deny (mapped to AdmissionDeniedError)."""
+        gvk = (api_version, kind)
+        if mutate:
+            self._mutators.setdefault(gvk, []).append(mutate)
+        if validate:
+            self._validators.setdefault(gvk, []).append(validate)
+
+    def register_index(
+        self, api_version: str, kind: str, name: str, fn: Callable
+    ) -> None:
+        """Field indexer seam (mgr.GetFieldIndexer analog,
+        ref networkconfiguration_controller.go:364-404).
+        ``fn(obj_dict) -> list[str]``."""
+        self._indexers[((api_version, kind), name)] = fn
+
+    # -- internals -----------------------------------------------------------
+
+    def _bump_rv(self, obj: Dict[str, Any]) -> None:
+        self._rv += 1
+        _meta(obj)["resourceVersion"] = str(self._rv)
+
+    def _notify(self, ev: str, obj: Dict[str, Any]) -> None:
+        gvk = (obj["apiVersion"], obj["kind"])
+        for w in self._watches.get(gvk, []):
+            w.push(ev, obj)
+
+    def _admit(self, obj: Dict[str, Any], old: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        gvk = (obj["apiVersion"], obj["kind"])
+        for m in self._mutators.get(gvk, []):
+            obj = m(obj) or obj
+        for v in self._validators.get(gvk, []):
+            try:
+                v(obj, old)
+            except AdmissionDeniedError:
+                raise
+            except Exception as e:  # webhook logic raises its own types
+                raise AdmissionDeniedError(str(e)) from e
+        return obj
+
+    def _bucket(self, api_version: str, kind: str) -> Dict[Key, Dict[str, Any]]:
+        return self._store.setdefault((api_version, kind), {})
+
+    # -- CRUD (client.Client analog) -----------------------------------------
+
+    def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            obj = self._admit(obj, None)
+            bucket = self._bucket(obj["apiVersion"], obj["kind"])
+            key = _key(obj)
+            if key in bucket:
+                raise AlreadyExistsError(f"{obj['kind']} {key} already exists")
+            self._uid += 1
+            m = _meta(obj)
+            m["uid"] = f"fake-uid-{self._uid}"
+            m["generation"] = 1
+            m["creationTimestamp"] = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            )
+            self._bump_rv(obj)
+            bucket[key] = obj
+            self._notify(ADDED, obj)
+            return copy.deepcopy(obj)
+
+    def get(
+        self, api_version: str, kind: str, name: str, namespace: str = ""
+    ) -> Dict[str, Any]:
+        with self._lock:
+            bucket = self._bucket(api_version, kind)
+            obj = bucket.get((namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def update(self, obj: Dict[str, Any], *, subresource: str = "") -> Dict[str, Any]:
+        """Update; ``subresource="status"`` only replaces .status
+        (r.Status().Update analog, ref controller :298)."""
+        with self._lock:
+            bucket = self._bucket(obj["apiVersion"], obj["kind"])
+            key = _key(obj)
+            stored = bucket.get(key)
+            if stored is None:
+                raise NotFoundError(f"{obj['kind']} {key} not found")
+            new_rv = _meta(obj).get("resourceVersion", "")
+            if new_rv and new_rv != stored["metadata"].get("resourceVersion"):
+                raise ConflictError(
+                    f"{obj['kind']} {key}: resourceVersion mismatch"
+                )
+            if subresource == "status":
+                merged = copy.deepcopy(stored)
+                merged["status"] = copy.deepcopy(obj.get("status", {}))
+            else:
+                merged = self._admit(copy.deepcopy(obj), stored)
+                # generation bumps only on spec change (apiserver behavior)
+                if merged.get("spec") != stored.get("spec"):
+                    _meta(merged)["generation"] = (
+                        stored["metadata"].get("generation", 1) + 1
+                    )
+                else:
+                    _meta(merged)["generation"] = stored["metadata"].get(
+                        "generation", 1
+                    )
+                merged["metadata"]["uid"] = stored["metadata"]["uid"]
+                merged["metadata"]["creationTimestamp"] = stored["metadata"][
+                    "creationTimestamp"
+                ]
+                # status is a subresource: plain updates cannot change it
+                if "status" in stored:
+                    merged["status"] = copy.deepcopy(stored["status"])
+            self._bump_rv(merged)
+            bucket[key] = merged
+            self._notify(MODIFIED, merged)
+            return copy.deepcopy(merged)
+
+    def update_status(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self.update(obj, subresource="status")
+
+    def delete(
+        self, api_version: str, kind: str, name: str, namespace: str = ""
+    ) -> None:
+        with self._lock:
+            bucket = self._bucket(api_version, kind)
+            obj = bucket.pop((namespace, name), None)
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            self._notify(DELETED, obj)
+            self._gc(obj)
+
+    def _gc(self, owner: Dict[str, Any]) -> None:
+        """Owner-reference garbage collection: cascade-delete dependents
+        (the reference relies on this for DaemonSet removal on CR delete,
+        ref SURVEY.md §3.2 'Deletion is implicit')."""
+        owner_uid = _meta(owner).get("uid")
+        if not owner_uid:
+            return
+        doomed: List[Tuple[str, str, str, str]] = []
+        for (api_version, kind), bucket in self._store.items():
+            for (ns, name), obj in bucket.items():
+                refs = _meta(obj).get("ownerReferences", []) or []
+                if any(r.get("uid") == owner_uid for r in refs):
+                    doomed.append((api_version, kind, name, ns))
+        for api_version, kind, name, ns in doomed:
+            try:
+                self.delete(api_version, kind, name, ns)
+            except NotFoundError:
+                pass
+
+    def list(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        field_index: Optional[Dict[str, str]] = None,
+    ) -> List[Dict[str, Any]]:
+        """List with optional namespace / label selector / field-index match
+        (client.InNamespace + client.MatchingFields analog,
+        ref controller :331)."""
+        with self._lock:
+            out = []
+            for (ns, _), obj in sorted(self._bucket(api_version, kind).items()):
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector and not match_labels(
+                    _meta(obj).get("labels", {}) or {}, label_selector
+                ):
+                    continue
+                if field_index:
+                    ok = True
+                    for idx_name, want in field_index.items():
+                        fn = self._indexers.get(((api_version, kind), idx_name))
+                        if fn is None:
+                            # client-go behavior: querying an unregistered
+                            # index is a programming error, not "no match"
+                            raise KeyError(
+                                f"no field index {idx_name!r} registered for "
+                                f"{kind}; call register_index() first"
+                            )
+                        if want not in (fn(obj) or []):
+                            ok = False
+                            break
+                    if not ok:
+                        continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def watch(self, api_version: str, kind: str) -> Watch:
+        with self._lock:
+            w = Watch()
+            self._watches.setdefault((api_version, kind), []).append(w)
+            return w
+
+    # -- cluster simulation ---------------------------------------------------
+    # envtest never schedules DaemonSet pods (SURVEY.md §4.2); these helpers
+    # close that gap so the status machine is testable above zero.
+
+    def add_node(self, name: str, labels: Optional[Dict[str, str]] = None) -> None:
+        self.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Node",
+                "metadata": {"name": name, "labels": labels or {}},
+                "status": {"conditions": [{"type": "Ready", "status": "True"}]},
+            }
+        )
+
+    def simulate_daemonset_controller(
+        self, ready_nodes: Optional[Iterable[str]] = None
+    ) -> None:
+        """Recompute every DaemonSet's status from current Nodes.
+
+        desiredNumberScheduled = nodes matching the pod template's
+        nodeSelector; numberReady = those of them in ``ready_nodes`` (all, if
+        None).  Also materializes one fake agent Pod per scheduled node, owned
+        by the DaemonSet (feeds the pod field indexer, ref controller
+        :385-404)."""
+        with self._lock:
+            nodes = self.list("v1", "Node")
+            for ds in self.list("apps/v1", "DaemonSet"):
+                sel = (
+                    ds.get("spec", {})
+                    .get("template", {})
+                    .get("spec", {})
+                    .get("nodeSelector", {})
+                    or {}
+                )
+                matched = [
+                    n["metadata"]["name"]
+                    for n in nodes
+                    if match_labels(n["metadata"].get("labels", {}) or {}, sel)
+                ]
+                ready = [
+                    n for n in matched
+                    if ready_nodes is None or n in set(ready_nodes)
+                ]
+                ds["status"] = {
+                    "desiredNumberScheduled": len(matched),
+                    "currentNumberScheduled": len(matched),
+                    "numberReady": len(ready),
+                }
+                self.update_status(ds)
+                self._materialize_pods(ds, matched, set(ready))
+
+    def _materialize_pods(
+        self, ds: Dict[str, Any], node_names: List[str], ready: set
+    ) -> None:
+        ns = ds["metadata"].get("namespace", "")
+        ds_name = ds["metadata"]["name"]
+        wanted = {f"{ds_name}-{n}" for n in node_names}
+        for pod in self.list("v1", "Pod", namespace=ns):
+            refs = _meta(pod).get("ownerReferences", []) or []
+            if any(r.get("uid") == ds["metadata"]["uid"] for r in refs):
+                if pod["metadata"]["name"] not in wanted:
+                    self.delete("v1", "Pod", pod["metadata"]["name"], ns)
+        for node in node_names:
+            pod_name = f"{ds_name}-{node}"
+            try:
+                self.get("v1", "Pod", pod_name, ns)
+                continue
+            except NotFoundError:
+                pass
+            self.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {
+                        "name": pod_name,
+                        "namespace": ns,
+                        "labels": dict(
+                            ds["spec"]["template"]["metadata"].get("labels", {})
+                        ),
+                        "ownerReferences": [
+                            {
+                                "apiVersion": "apps/v1",
+                                "kind": "DaemonSet",
+                                "name": ds_name,
+                                "uid": ds["metadata"]["uid"],
+                                "controller": True,
+                            }
+                        ],
+                    },
+                    "spec": {"nodeName": node},
+                    "status": {
+                        "phase": "Running" if node in ready else "Pending"
+                    },
+                }
+            )
+
+    # -- test conveniences ----------------------------------------------------
+
+    def dump(self, pattern: str = "*") -> List[str]:
+        """Sorted 'kind/namespace/name' listing for assertions."""
+        with self._lock:
+            out = []
+            for (_, kind), bucket in self._store.items():
+                for (ns, name) in bucket:
+                    s = f"{kind}/{ns}/{name}"
+                    if fnmatch.fnmatch(s, pattern):
+                        out.append(s)
+            return sorted(out)
