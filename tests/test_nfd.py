@@ -1,0 +1,31 @@
+"""NFD label file tests (ref cmd/discover/main.go:240-246 behavior)."""
+
+import os
+
+from tpu_network_operator.nfd import (
+    TPU_READY_LABEL,
+    remove_readiness_label,
+    write_readiness_label,
+)
+
+
+def test_write_when_nfd_present(tmp_path):
+    d = tmp_path / "etc/kubernetes/node-feature-discovery/features.d"
+    d.mkdir(parents=True)
+    assert write_readiness_label(TPU_READY_LABEL, root=str(tmp_path))
+    content = (d / "scale-out-readiness.txt").read_text()
+    assert content == "tpunet.dev/tpu-scale-out=true\n"
+
+
+def test_skip_when_nfd_absent(tmp_path):
+    assert not write_readiness_label(TPU_READY_LABEL, root=str(tmp_path))
+    assert list(tmp_path.rglob("*")) == []
+
+
+def test_remove_idempotent(tmp_path):
+    d = tmp_path / "etc/kubernetes/node-feature-discovery/features.d"
+    d.mkdir(parents=True)
+    write_readiness_label(TPU_READY_LABEL, root=str(tmp_path))
+    remove_readiness_label(root=str(tmp_path))
+    assert not (d / "scale-out-readiness.txt").exists()
+    remove_readiness_label(root=str(tmp_path))  # second time: no error

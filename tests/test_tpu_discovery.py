@@ -1,0 +1,258 @@
+"""TPU backend tests: fake metadata server → topology → bootstrap.
+
+The TPU analog of the reference's fake-sysfs tier
+(ref ``cmd/discover/network_test.go:94-116`` SYSFS_ROOT rig): a real HTTP
+fake of the GCE metadata server, exercising the full discovery path the
+agent runs on a node.
+"""
+
+import json
+
+import pytest
+
+from tpu_network_operator.agent.tpu import bootstrap as bs
+from tpu_network_operator.agent.tpu import topology as topo
+from tpu_network_operator.agent.tpu.metadata import (
+    FakeMetadataServer,
+    MetadataClient,
+    MetadataError,
+)
+
+V5P_64_TPU_ENV = """\
+ACCELERATOR_TYPE: 'v5p-64'
+CHIPS_PER_HOST_BOUNDS: '2,2,1'
+HOST_BOUNDS: '1,2,4'
+TOPOLOGY: '2x4x4'
+WORKER_ID: '3'
+ZONE: 'us-east5-a'
+"""
+
+V5E_16_TPU_ENV = """\
+ACCELERATOR_TYPE: 'v5litepod-16'
+CHIPS_PER_HOST_BOUNDS: '2,4,1'
+HOST_BOUNDS: '2,1,1'
+TOPOLOGY: '4x4'
+WORKER_ID: '1'
+"""
+
+WORKER_NET = json.dumps(
+    [
+        {"workerId": 1, "ipAddress": "10.0.0.6"},
+        {"workerId": 0, "ipAddress": "10.0.0.5"},
+        {"workerId": 2, "ipAddress": "10.0.0.7"},
+        {"workerId": 3, "ipAddress": "10.0.0.8"},
+    ]
+)
+
+
+@pytest.fixture()
+def v5p_server():
+    attrs = {
+        "accelerator-type": "v5p-64",
+        "tpu-env": V5P_64_TPU_ENV,
+        "worker-network-config": WORKER_NET,
+        "agent-worker-number": "3",
+    }
+    with FakeMetadataServer(attrs) as srv:
+        yield srv
+
+
+class TestMetadataClient:
+    def test_attributes(self, v5p_server):
+        c = MetadataClient(v5p_server.url)
+        assert c.accelerator_type() == "v5p-64"
+        env = c.tpu_env()
+        assert env["ACCELERATOR_TYPE"] == "v5p-64"
+        assert env["TOPOLOGY"] == "2x4x4"
+        assert c.worker_number() == 3
+        workers = c.worker_network_config()
+        assert len(workers) == 4
+
+    def test_missing_attribute(self, v5p_server):
+        c = MetadataClient(v5p_server.url)
+        with pytest.raises(MetadataError, match="not found"):
+            c.attribute("nope")
+        assert c.attribute_or("nope", "dflt") == "dflt"
+
+    def test_env_var_selects_server(self, v5p_server, monkeypatch):
+        monkeypatch.setenv("TPUNET_METADATA_URL", v5p_server.url)
+        assert MetadataClient().accelerator_type() == "v5p-64"
+
+    def test_megascale_absent(self, v5p_server):
+        assert MetadataClient(v5p_server.url).megascale() == {}
+
+
+class TestAcceleratorParsing:
+    @pytest.mark.parametrize(
+        "accel,gen,chips",
+        [
+            ("v2-8", "v2", 4),
+            ("v3-32", "v3", 16),
+            ("v4-32", "v4", 16),
+            ("v5p-64", "v5p", 32),
+            ("v5litepod-16", "v5litepod", 16),
+            ("v6e-16", "v6e", 16),
+            ("v6e-256", "v6e", 256),
+        ],
+    )
+    def test_parse(self, accel, gen, chips):
+        assert topo.parse_accelerator_type(accel) == (gen, chips)
+
+    def test_parse_garbage(self):
+        with pytest.raises(topo.TopologyError):
+            topo.parse_accelerator_type("gaudi3-8")
+        with pytest.raises(topo.TopologyError):
+            topo.parse_accelerator_type("v5p")
+
+    @pytest.mark.parametrize(
+        "chips,ndims,grid",
+        [
+            (32, 3, (2, 4, 4)),   # v5p-64 documented topology
+            (16, 3, (2, 2, 4)),   # v4-32
+            (16, 2, (4, 4)),      # v5e-16
+            (256, 2, (16, 16)),
+            (4, 3, (1, 2, 2)),
+            (1, 3, (1,)),
+        ],
+    )
+    def test_default_grid(self, chips, ndims, grid):
+        assert topo.default_grid(chips, ndims) == grid
+
+
+class TestTopologyDiscovery:
+    def test_from_tpu_env_v5p(self, v5p_server):
+        t = topo.discover(MetadataClient(v5p_server.url))
+        assert t.source == "tpu-env"
+        assert t.ici_mesh == (2, 4, 4)
+        assert t.num_chips == 32
+        assert t.chips_per_host == 4
+        assert t.num_hosts == 8
+        assert t.worker_id == 3
+        assert t.num_slices == 1
+
+    def test_from_accelerator_type_only(self):
+        attrs = {"accelerator-type": "v5litepod-16", "agent-worker-number": "1"}
+        with FakeMetadataServer(attrs) as srv:
+            t = topo.discover(MetadataClient(srv.url))
+        assert t.source == "accelerator-type"
+        assert t.ici_mesh == (4, 4)
+        assert t.chips_per_host == 8
+        assert t.num_hosts == 2
+        assert t.worker_id == 1
+
+    def test_multislice(self):
+        attrs = {
+            "accelerator-type": "v5litepod-16",
+            "tpu-env": V5E_16_TPU_ENV,
+            "megascale-num-slices": "2",
+            "megascale-slice-id": "1",
+            "megascale-coordinator-address": "10.9.0.1:8080",
+        }
+        with FakeMetadataServer(attrs) as srv:
+            c = MetadataClient(srv.url)
+            t = topo.discover(c)
+            ms = c.megascale()
+        assert (t.num_slices, t.slice_id) == (2, 1)
+        assert ms["megascale-coordinator-address"] == "10.9.0.1:8080"
+
+    def test_accelerator_type_only_no_tpu_env(self):
+        # regression: worker_number() must not crash when tpu-env is absent
+        with FakeMetadataServer({"accelerator-type": "v4-8"}) as srv:
+            t = topo.discover(MetadataClient(srv.url))
+        assert t.num_chips == 4
+        assert t.worker_id == 0
+
+    def test_topology_only_tpu_env_uses_accel_attribute(self):
+        # regression: TOPOLOGY-only tpu-env must pull ACCELERATOR_TYPE from
+        # the separate attribute instead of failing
+        attrs = {
+            "accelerator-type": "v4-16",
+            "tpu-env": "TOPOLOGY: '2x2x2'\nWORKER_ID: '1'\n",
+        }
+        with FakeMetadataServer(attrs) as srv:
+            t = topo.discover(MetadataClient(srv.url))
+        assert t.ici_mesh == (2, 2, 2)
+        assert t.worker_id == 1
+        assert t.generation == "v4"
+
+    def test_tpu_env_without_worker_id_uses_agent_worker_number(self):
+        # regression: duplicate process_ids when WORKER_ID line is missing
+        attrs = {
+            "accelerator-type": "v5p-64",
+            "tpu-env": "ACCELERATOR_TYPE: 'v5p-64'\nTOPOLOGY: '2x4x4'\n",
+            "agent-worker-number": "6",
+        }
+        with FakeMetadataServer(attrs) as srv:
+            t = topo.discover(MetadataClient(srv.url))
+        assert t.worker_id == 6
+
+    def test_round_trip(self, v5p_server):
+        t = topo.discover(MetadataClient(v5p_server.url))
+        assert topo.TpuTopology.from_dict(t.to_dict()) == t
+
+
+class TestBootstrap:
+    def make(self, tmp_path, v5p_server):
+        c = MetadataClient(v5p_server.url)
+        t = topo.discover(c)
+        cfg = bs.build_bootstrap(t, c.worker_network_config(), 8476)
+        path = str(tmp_path / "jax-coordinator.json")
+        bs.write_bootstrap(cfg, path)
+        return cfg, path
+
+    def test_build_and_write(self, tmp_path, v5p_server):
+        cfg, path = self.make(tmp_path, v5p_server)
+        assert cfg.coordinator_address == "10.0.0.5:8476"  # worker 0, sorted
+        assert cfg.num_processes == 8
+        assert cfg.process_id == 3
+        on_disk = json.load(open(path))
+        assert on_disk["version"] == 1
+        assert on_disk["topology"]["ici_mesh"] == [2, 4, 4]
+        assert on_disk["workers"][0] == {"workerId": 0, "ipAddress": "10.0.0.5"}
+        import os
+        assert oct(os.stat(path).st_mode & 0o777) == "0o644"
+
+    def test_read_round_trip(self, tmp_path, v5p_server):
+        cfg, path = self.make(tmp_path, v5p_server)
+        back = bs.read_bootstrap(path)
+        assert back.coordinator_address == cfg.coordinator_address
+        assert back.topology.ici_mesh == (2, 4, 4)
+
+    def test_multislice_coordinator_wins(self, v5p_server):
+        c = MetadataClient(v5p_server.url)
+        t = topo.discover(c)
+        t.num_slices, t.slice_id = 2, 1
+        cfg = bs.build_bootstrap(
+            t, c.worker_network_config(), 8476,
+            megascale_coordinator="10.9.0.1",
+        )
+        assert cfg.coordinator_address == "10.9.0.1:8476"
+        assert cfg.num_processes == 16
+        assert cfg.process_id == 8 + 3
+
+    def test_refuses_partial(self, tmp_path):
+        t = topo.from_accelerator_type("v4-8")
+        with pytest.raises(bs.BootstrapError, match="no worker endpoints"):
+            bs.build_bootstrap(t, [], 8476)
+        cfg = bs.BootstrapConfig(coordinator_address="1.2.3.4:1", num_processes=0)
+        with pytest.raises(bs.BootstrapError, match="no processes"):
+            bs.write_bootstrap(cfg, str(tmp_path / "x.json"))
+
+    def test_worker_zero_required_for_coordinator(self):
+        # regression: a partial worker-network-config missing worker 0 must
+        # refuse rather than silently pick the lowest workerId present
+        t = topo.from_accelerator_type("v4-16")
+        partial = [
+            {"workerId": 1, "ipAddress": "10.0.0.6"},
+            {"workerId": 2, "ipAddress": "10.0.0.7"},
+        ]
+        with pytest.raises(bs.BootstrapError, match="worker 0 missing"):
+            bs.build_bootstrap(t, partial, 8476)
+
+    def test_version_gate(self, tmp_path, v5p_server):
+        _, path = self.make(tmp_path, v5p_server)
+        doc = json.load(open(path))
+        doc["version"] = 99
+        open(path, "w").write(json.dumps(doc))
+        with pytest.raises(bs.BootstrapError, match="version"):
+            bs.read_bootstrap(path)

@@ -1,0 +1,7 @@
+"""Node agent (L2): per-node data-plane configurator.
+
+The privileged DaemonSet payload (ref ``cmd/discover/``): discovers
+scale-out interconnects, configures host networking, writes the bootstrap
+artifact for the accelerator runtime, drops the NFD readiness label, idles
+until SIGTERM, then restores.
+"""

@@ -1,0 +1,8 @@
+"""TPU backend: ICI topology discovery + jax.distributed bootstrap emission.
+
+The TPU-native replacement for the reference's Gaudi discovery
+(ref ``cmd/discover/network.go:88-119`` sysfs globbing): ICI is pre-wired,
+so discovery means reading slice topology from the GCE metadata server (or
+libtpu), and the emitted artifact is a ``jax.distributed`` bootstrap config
+instead of ``gaudinet.json`` (SURVEY.md §5.8).
+"""

@@ -1,0 +1,157 @@
+"""jax.distributed bootstrap config — the ``gaudinet.json`` analog.
+
+Where the reference emits ``/etc/habanalabs/gaudinet.json`` for the Gaudi
+firmware (ref ``cmd/discover/gaudinet.go:28-89``), the TPU agent emits
+``jax-coordinator.json``: everything a JAX job needs to call
+``jax.distributed.initialize`` and build its device mesh — coordinator
+address, process count/id, and the slice's ICI topology.  The consuming side
+is :func:`tpu_network_operator.parallel.mesh.mesh_from_bootstrap`.
+
+Write semantics mirror the reference writer: refuse silently-partial
+output, 0644, parent dir must exist (ref ``WriteGaudiNet()``
+``gaudinet.go:78-89``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ...utils import write_atomic
+from .topology import TpuTopology
+
+SCHEMA_VERSION = 1
+
+
+class BootstrapError(Exception):
+    pass
+
+
+@dataclass
+class WorkerEndpoint:
+    worker_id: int
+    ip_address: str
+
+
+@dataclass
+class BootstrapConfig:
+    """The on-disk schema (stable, versioned)."""
+
+    coordinator_address: str = ""       # "10.0.0.5:8476"
+    num_processes: int = 0              # hosts × slices
+    process_id: int = 0                 # slice_id*hosts_per_slice + worker_id
+    topology: Optional[TpuTopology] = None
+    workers: List[WorkerEndpoint] = field(default_factory=list)
+    dcn_interfaces: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> Dict:
+        return {
+            "version": SCHEMA_VERSION,
+            "coordinator_address": self.coordinator_address,
+            "num_processes": self.num_processes,
+            "process_id": self.process_id,
+            "topology": self.topology.to_dict() if self.topology else {},
+            "workers": [
+                {"workerId": w.worker_id, "ipAddress": w.ip_address}
+                for w in self.workers
+            ],
+            "dcn_interfaces": list(self.dcn_interfaces),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "BootstrapConfig":
+        if d.get("version") != SCHEMA_VERSION:
+            raise BootstrapError(
+                f"unsupported bootstrap schema version {d.get('version')!r}"
+            )
+        return cls(
+            coordinator_address=d.get("coordinator_address", ""),
+            num_processes=d.get("num_processes", 0),
+            process_id=d.get("process_id", 0),
+            topology=TpuTopology.from_dict(d.get("topology", {})),
+            workers=[
+                WorkerEndpoint(w.get("workerId", 0), w.get("ipAddress", ""))
+                for w in d.get("workers", [])
+            ],
+            dcn_interfaces=list(d.get("dcn_interfaces", [])),
+        )
+
+
+def build_bootstrap(
+    topo: TpuTopology,
+    worker_net_config: List[Dict],
+    coordinator_port: int,
+    megascale_coordinator: str = "",
+    dcn_interfaces: Optional[List[str]] = None,
+) -> BootstrapConfig:
+    """Assemble the bootstrap from discovery results.
+
+    Coordinator selection: multislice uses the Megascale-provided address;
+    single-slice uses worker 0's IP from worker-network-config.  Process
+    numbering is global across slices: ``slice_id * hosts_per_slice +
+    worker_id`` with ``num_processes = num_hosts * num_slices``.
+    """
+    workers = sorted(
+        (
+            WorkerEndpoint(int(w.get("workerId", i)), w.get("ipAddress", ""))
+            for i, w in enumerate(worker_net_config)
+        ),
+        key=lambda w: w.worker_id,
+    )
+
+    if megascale_coordinator:
+        coord = megascale_coordinator
+        if ":" not in coord:
+            coord = f"{coord}:{coordinator_port}"
+    else:
+        if not workers:
+            raise BootstrapError(
+                "no worker endpoints: worker-network-config empty and no "
+                "megascale coordinator"
+            )
+        # explicitly workerId 0, not merely the lowest present:
+        # jax.distributed's coordinator must be where process 0 listens
+        worker0 = next((w for w in workers if w.worker_id == 0), None)
+        if worker0 is None or not worker0.ip_address:
+            raise BootstrapError(
+                "worker 0 missing from worker-network-config; refusing to "
+                "pick an arbitrary coordinator"
+            )
+        coord = f"{worker0.ip_address}:{coordinator_port}"
+
+    return BootstrapConfig(
+        coordinator_address=coord,
+        num_processes=topo.num_hosts * topo.num_slices,
+        process_id=topo.slice_id * topo.num_hosts + topo.worker_id,
+        topology=topo,
+        workers=workers,
+        dcn_interfaces=list(dcn_interfaces or []),
+    )
+
+
+def write_bootstrap(cfg: BootstrapConfig, path: str) -> None:
+    """ref ``WriteGaudiNet()`` gaudinet.go:78-89: validate, marshal, 0644."""
+    if not cfg.coordinator_address:
+        raise BootstrapError("refusing to write bootstrap without coordinator")
+    if cfg.num_processes < 1:
+        raise BootstrapError("refusing to write bootstrap with no processes")
+    if not (0 <= cfg.process_id < cfg.num_processes):
+        raise BootstrapError(
+            f"process_id {cfg.process_id} out of range 0..{cfg.num_processes - 1}"
+        )
+    write_atomic(path, json.dumps(cfg.to_dict(), indent=2) + "\n")
+
+
+def read_bootstrap(path: str) -> BootstrapConfig:
+    with open(path) as f:
+        return BootstrapConfig.from_dict(json.load(f))
+
+
+def delete_bootstrap(path: str) -> None:
+    """De-provision cleanup (ref postCleanups, cmd/discover/main.go:143-159)."""
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass

@@ -1,0 +1,185 @@
+"""GCE metadata client (+ fake server for tests).
+
+The TPU analog of the reference's sysfs seam: where the Gaudi agent globs
+``/sys/bus/pci/drivers/habanalabs`` overridable via ``SYSFS_ROOT``
+(ref ``cmd/discover/network.go:76-82``), the TPU agent reads the GCE
+metadata server, overridable via ``TPUNET_METADATA_URL`` so tests run
+against :class:`FakeMetadataServer` (SURVEY.md §4 blueprint take-away:
+"fake GCE metadata server ... from day one").
+
+TPU-VM metadata surface used (all public GCE/TPU attributes):
+
+* ``instance/attributes/accelerator-type`` — e.g. ``v5p-64``, ``v5litepod-16``
+* ``instance/attributes/tpu-env`` — newline-separated ``KEY: 'value'`` pairs
+  (ACCELERATOR_TYPE, TOPOLOGY, WORKER_ID, CHIPS_PER_HOST_BOUNDS,
+  HOST_BOUNDS, ...)
+* ``instance/attributes/worker-network-config`` — JSON list of slice worker
+  endpoints ``[{"workerId": 0, "ipAddress": "10.0.0.5"}, ...]``
+* ``instance/attributes/agent-worker-number`` — this host's worker index
+* multislice (Megascale) attributes: ``megascale-num-slices``,
+  ``megascale-slice-id``, ``megascale-coordinator-address``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+DEFAULT_METADATA_URL = "http://metadata.google.internal"
+METADATA_URL_ENV = "TPUNET_METADATA_URL"
+ATTR_BASE = "/computeMetadata/v1/instance/attributes/"
+
+# required on every request; the server rejects its absence (SSRF guard)
+FLAVOR_HEADER = ("Metadata-Flavor", "Google")
+
+
+class MetadataError(Exception):
+    pass
+
+
+class MetadataClient:
+    """Small blocking client for the instance-attributes surface."""
+
+    def __init__(self, base_url: Optional[str] = None, timeout: float = 5.0):
+        self.base_url = (
+            base_url
+            or os.environ.get(METADATA_URL_ENV)
+            or DEFAULT_METADATA_URL
+        ).rstrip("/")
+        self.timeout = timeout
+
+    def attribute(self, name: str) -> str:
+        url = self.base_url + ATTR_BASE + name
+        req = urlrequest.Request(url)
+        req.add_header(*FLAVOR_HEADER)
+        try:
+            with urlrequest.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read().decode()
+        except urlerror.HTTPError as e:
+            if e.code == 404:
+                raise MetadataError(f"metadata attribute {name!r} not found") from e
+            raise MetadataError(f"metadata attribute {name!r}: HTTP {e.code}") from e
+        except OSError as e:
+            raise MetadataError(f"metadata server unreachable: {e}") from e
+
+    def attribute_or(self, name: str, default: str = "") -> str:
+        try:
+            return self.attribute(name)
+        except MetadataError:
+            return default
+
+    # -- typed accessors -------------------------------------------------------
+
+    def accelerator_type(self) -> str:
+        return self.attribute("accelerator-type").strip()
+
+    def tpu_env(self) -> Dict[str, str]:
+        """Parse the ``KEY: 'value'`` lines of the tpu-env attribute."""
+        out: Dict[str, str] = {}
+        for line in self.attribute("tpu-env").splitlines():
+            line = line.strip()
+            if not line or ":" not in line:
+                continue
+            key, _, val = line.partition(":")
+            out[key.strip()] = val.strip().strip("'\"")
+        return out
+
+    def worker_network_config(self) -> list:
+        raw = self.attribute_or("worker-network-config", "[]")
+        try:
+            cfg = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise MetadataError(f"bad worker-network-config JSON: {e}") from e
+        if not isinstance(cfg, list):
+            raise MetadataError("worker-network-config is not a list")
+        return cfg
+
+    def worker_number(self) -> int:
+        raw = self.attribute_or("agent-worker-number", "")
+        if raw:
+            return int(raw.strip())
+        try:
+            env = self.tpu_env()
+        except MetadataError:
+            return 0   # single-host default when neither attribute exists
+        return int(env.get("WORKER_ID", "0"))
+
+    def megascale(self) -> Dict[str, str]:
+        """Multislice attributes; empty dict when single-slice."""
+        out = {}
+        for name in (
+            "megascale-num-slices",
+            "megascale-slice-id",
+            "megascale-coordinator-address",
+        ):
+            val = self.attribute_or(name, "")
+            if val:
+                out[name] = val.strip()
+        return out
+
+
+class FakeMetadataServer:
+    """In-process GCE metadata server for tests (and the agent's dry runs).
+
+    Serves ``instance/attributes/*`` from a dict; enforces the
+    ``Metadata-Flavor: Google`` header exactly as GCE does, so client bugs
+    around the header are caught in tests.
+    """
+
+    def __init__(self, attributes: Dict[str, str]):
+        self.attributes = dict(attributes)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.headers.get("Metadata-Flavor") != "Google":
+                    self.send_error(403, "Missing Metadata-Flavor header")
+                    return
+                if not self.path.startswith(ATTR_BASE):
+                    self.send_error(404)
+                    return
+                name = self.path[len(ATTR_BASE):]
+                if name not in outer.attributes:
+                    self.send_error(404)
+                    return
+                body = outer.attributes[name].encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/text")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FakeMetadataServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,278 @@
+"""ICI topology model: accelerator-type / tpu-env → mesh description.
+
+The TPU analog of the reference's device discovery
+(ref ``cmd/discover/network.go:88-119``): instead of globbing sysfs for
+NICs, the agent derives the slice's ICI mesh (chip grid, hosts, this host's
+place in it) from metadata.  This is the "hard part #1" called out in
+SURVEY.md §7 (ICI topology fidelity across v2..v6e variants).
+
+Two sources, in order of authority:
+
+1. ``tpu-env`` attributes ``TOPOLOGY`` / ``CHIPS_PER_HOST_BOUNDS`` /
+   ``HOST_BOUNDS`` / ``WORKER_ID`` — exact, preferred.
+2. The ``accelerator-type`` string alone (e.g. ``v5p-64``) — chip count is
+   derived from the generation's core-vs-chip naming rule and the grid from
+   a documented near-cubic factorization; used when tpu-env is absent.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+
+class TopologyError(Exception):
+    pass
+
+
+# Generation naming rule: v2/v3/v4/v5p suffixes count TensorCores (2 per
+# chip); v5e/v6e suffixes count chips.  Chips per host is the physical
+# machine layout default, overridden by CHIPS_PER_HOST_BOUNDS when known.
+_GENERATIONS = {
+    # name            cores_suffix  chips/host  ici dims
+    "v2":            (True,  4, 2),
+    "v3":            (True,  4, 2),
+    "v4":            (True,  4, 3),
+    "v5p":           (True,  4, 3),
+    "v5litepod":     (False, 8, 2),
+    "v5e":           (False, 8, 2),
+    "v6e":           (False, 4, 2),
+}
+
+_ACCEL_RE = re.compile(r"^(?P<gen>v[a-z0-9]+)-(?P<count>\d+)\Z")
+
+
+def parse_accelerator_type(accel: str) -> Tuple[str, int]:
+    """``v5p-64`` → (generation, num_chips)."""
+    m = _ACCEL_RE.match(accel.strip().lower())
+    if not m:
+        raise TopologyError(f"unparseable accelerator-type {accel!r}")
+    gen = m.group("gen")
+    if gen not in _GENERATIONS:
+        # normalize pod-suffix variants: v5lite ↔ v5litepod
+        for alt in (gen + "pod", gen[:-3] if gen.endswith("pod") else ""):
+            if alt in _GENERATIONS:
+                gen = alt
+                break
+    if gen not in _GENERATIONS:
+        raise TopologyError(f"unknown TPU generation {gen!r} in {accel!r}")
+    cores_suffix, _, _ = _GENERATIONS[gen]
+    count = int(m.group("count"))
+    chips = count // 2 if cores_suffix else count
+    if chips < 1:
+        raise TopologyError(f"accelerator-type {accel!r} has no chips")
+    return gen, chips
+
+
+def default_grid(chips: int, ndims: int) -> Tuple[int, ...]:
+    """Near-cubic factorization, dims sorted ascending (v5p-64 → 2x4x4)."""
+    if ndims == 1 or chips == 1:
+        return (chips,)
+    dims: List[int] = []
+    remaining = chips
+    for i in range(ndims - 1, 0, -1):
+        target = round(remaining ** (1 / (i + 1)))
+        d = max(1, target)
+        while remaining % d != 0:
+            d -= 1
+        dims.append(d)
+        remaining //= d
+    dims.append(remaining)
+    return tuple(sorted(dims))
+
+
+def _parse_bounds(s: str) -> Tuple[int, ...]:
+    return tuple(int(x) for x in s.replace("x", ",").split(",") if x.strip())
+
+
+@dataclass
+class TpuTopology:
+    """A slice's ICI mesh and this host's position in it."""
+
+    accelerator_type: str = ""
+    generation: str = ""
+    topology: str = ""                  # e.g. "2x4x4"
+    ici_mesh: Tuple[int, ...] = ()      # chip grid, e.g. (2, 4, 4)
+    chips_per_host_bounds: Tuple[int, ...] = ()
+    host_bounds: Tuple[int, ...] = ()
+    num_chips: int = 0
+    chips_per_host: int = 0
+    num_hosts: int = 0
+    worker_id: int = 0
+    # multislice (Megascale); single-slice => num_slices=1, slice_id=0
+    num_slices: int = 1
+    slice_id: int = 0
+    source: str = ""                    # "tpu-env" | "accelerator-type"
+
+    def to_dict(self) -> Dict:
+        return {
+            "accelerator_type": self.accelerator_type,
+            "generation": self.generation,
+            "topology": self.topology,
+            "ici_mesh": list(self.ici_mesh),
+            "chips_per_host_bounds": list(self.chips_per_host_bounds),
+            "host_bounds": list(self.host_bounds),
+            "num_chips": self.num_chips,
+            "chips_per_host": self.chips_per_host,
+            "num_hosts": self.num_hosts,
+            "worker_id": self.worker_id,
+            "num_slices": self.num_slices,
+            "slice_id": self.slice_id,
+            "source": self.source,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "TpuTopology":
+        return cls(
+            accelerator_type=d.get("accelerator_type", ""),
+            generation=d.get("generation", ""),
+            topology=d.get("topology", ""),
+            ici_mesh=tuple(d.get("ici_mesh", [])),
+            chips_per_host_bounds=tuple(d.get("chips_per_host_bounds", [])),
+            host_bounds=tuple(d.get("host_bounds", [])),
+            num_chips=d.get("num_chips", 0),
+            chips_per_host=d.get("chips_per_host", 0),
+            num_hosts=d.get("num_hosts", 0),
+            worker_id=d.get("worker_id", 0),
+            num_slices=d.get("num_slices", 1),
+            slice_id=d.get("slice_id", 0),
+            source=d.get("source", ""),
+        )
+
+
+def from_tpu_env(
+    env: Dict[str, str], accel_hint: str = "", worker_id_hint: Optional[int] = None
+) -> TpuTopology:
+    """Build from tpu-env attributes (authoritative path).  ``accel_hint`` /
+    ``worker_id_hint`` fill gaps from other metadata attributes when the
+    corresponding tpu-env lines are absent."""
+    accel = env.get("ACCELERATOR_TYPE", accel_hint)
+    if not accel:
+        raise TopologyError("tpu-env lacks ACCELERATOR_TYPE")
+    gen, chips_from_name = parse_accelerator_type(accel)
+
+    topo_str = env.get("TOPOLOGY", "")
+    if topo_str:
+        mesh = _parse_bounds(topo_str)
+        num_chips = math.prod(mesh)
+    else:
+        _, _, ndims = _GENERATIONS[gen]
+        mesh = default_grid(chips_from_name, ndims)
+        num_chips = chips_from_name
+
+    cphb = _parse_bounds(env.get("CHIPS_PER_HOST_BOUNDS", "")) or ()
+    hostb = _parse_bounds(env.get("HOST_BOUNDS", "")) or ()
+    chips_per_host = (
+        math.prod(cphb) if cphb else _GENERATIONS[gen][1]
+    )
+    chips_per_host = min(chips_per_host, num_chips)
+    num_hosts = (
+        math.prod(hostb) if hostb else max(1, num_chips // chips_per_host)
+    )
+
+    return TpuTopology(
+        accelerator_type=accel,
+        generation=gen,
+        topology=topo_str or "x".join(str(d) for d in mesh),
+        ici_mesh=mesh,
+        chips_per_host_bounds=cphb,
+        host_bounds=hostb,
+        num_chips=num_chips,
+        chips_per_host=chips_per_host,
+        num_hosts=num_hosts,
+        worker_id=(
+            int(env["WORKER_ID"])
+            if "WORKER_ID" in env
+            else (worker_id_hint or 0)
+        ),
+        source="tpu-env",
+    )
+
+
+def from_accelerator_type(accel: str, worker_id: int = 0) -> TpuTopology:
+    """Fallback when only the accelerator-type string is known."""
+    gen, chips = parse_accelerator_type(accel)
+    _, chips_per_host, ndims = _GENERATIONS[gen]
+    mesh = default_grid(chips, ndims)
+    chips_per_host = min(chips_per_host, chips)
+    return TpuTopology(
+        accelerator_type=accel,
+        generation=gen,
+        topology="x".join(str(d) for d in mesh),
+        ici_mesh=mesh,
+        num_chips=chips,
+        chips_per_host=chips_per_host,
+        num_hosts=max(1, chips // chips_per_host),
+        worker_id=worker_id,
+        source="accelerator-type",
+    )
+
+
+def discover(metadata_client, source: str = "auto") -> TpuTopology:
+    """Full discovery: tpu-env when available, else accelerator-type;
+    megascale attributes fold in multislice placement."""
+    topo: Optional[TpuTopology] = None
+    if source in ("auto", "metadata"):
+        try:
+            env = metadata_client.tpu_env()
+        except Exception:
+            env = {}
+        if env.get("ACCELERATOR_TYPE") or env.get("TOPOLOGY"):
+            topo = from_tpu_env(
+                env,
+                accel_hint=metadata_client.attribute_or("accelerator-type", ""),
+                worker_id_hint=metadata_client.worker_number(),
+            )
+        else:
+            accel = metadata_client.accelerator_type()
+            topo = from_accelerator_type(
+                accel, worker_id=metadata_client.worker_number()
+            )
+    elif source == "libtpu":
+        topo = _from_libtpu()
+    else:
+        raise TopologyError(f"unknown topology source {source!r}")
+
+    ms = metadata_client.megascale()
+    if ms:
+        topo.num_slices = int(ms.get("megascale-num-slices", "1"))
+        topo.slice_id = int(ms.get("megascale-slice-id", "0"))
+    return topo
+
+
+def _from_libtpu() -> TpuTopology:
+    """Probe the local runtime via jax/libtpu.  Only works on a TPU VM with
+    a quiescent runtime; the metadata path is preferred (and is the default
+    under --topology-source=auto)."""
+    try:
+        import jax
+
+        devices = jax.devices("tpu")
+    except Exception as e:  # pragma: no cover - needs hardware
+        raise TopologyError(f"libtpu probe failed: {e}") from e
+    if not devices:  # pragma: no cover
+        raise TopologyError("libtpu probe found no TPU devices")
+    coords = [getattr(d, "coords", None) for d in devices]
+    kind = devices[0].device_kind
+    mesh: Tuple[int, ...]
+    if all(c is not None for c in coords):
+        dims = len(coords[0])
+        mesh = tuple(
+            max(c[i] for c in coords) + 1 for i in range(dims)
+        )
+    else:  # pragma: no cover
+        mesh = (len(devices),)
+    local = [d for d in devices if d.process_index == jax.process_index()]
+    return TpuTopology(
+        accelerator_type=kind,
+        generation=kind,
+        topology="x".join(str(d) for d in mesh),
+        ici_mesh=mesh,
+        num_chips=len(devices),
+        chips_per_host=len(local),
+        num_hosts=max(1, len(devices) // max(1, len(local))),
+        worker_id=jax.process_index(),
+        source="libtpu",
+    )

@@ -1,0 +1,8 @@
+"""NFD (node-feature-discovery) integration."""
+
+from .labels import (  # noqa: F401
+    GAUDI_READY_LABEL,
+    TPU_READY_LABEL,
+    remove_readiness_label,
+    write_readiness_label,
+)

@@ -1,0 +1,44 @@
+"""NFD readiness label file management.
+
+The agent advertises "this node's scale-out fabric is configured" by
+dropping a feature file into NFD's ``features.d``; the NFD worker turns it
+into a node label that workload pods nodeSelector on.  This is the entire
+job-scheduling integration — labels, not a scheduler plugin
+(ref ``cmd/discover/main.go:43-46,240-246`` and SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..utils import write_atomic
+
+# ref cmd/discover/main.go:43-46
+NFD_FEATURES_DIR = "/etc/kubernetes/node-feature-discovery/features.d"
+NFD_FILE_NAME = "scale-out-readiness.txt"
+
+GAUDI_READY_LABEL = "tpunet.dev/gaudi-scale-out=true"
+TPU_READY_LABEL = "tpunet.dev/tpu-scale-out=true"
+
+
+def features_dir(root: str = "") -> str:
+    return os.path.join(root or "/", NFD_FEATURES_DIR.lstrip("/"))
+
+
+def write_readiness_label(label: str, root: str = "") -> bool:
+    """Write the label file if the features.d dir exists (NFD installed);
+    returns whether it was written (ref main.go:240-246 — the agent skips
+    silently when NFD is absent)."""
+    d = features_dir(root)
+    if not os.path.isdir(d):
+        return False
+    write_atomic(os.path.join(d, NFD_FILE_NAME), label + "\n")
+    return True
+
+
+def remove_readiness_label(root: str = "") -> None:
+    """Pre-clean + de-provision removal (ref main.go:124-141,143-149)."""
+    try:
+        os.unlink(os.path.join(features_dir(root), NFD_FILE_NAME))
+    except FileNotFoundError:
+        pass

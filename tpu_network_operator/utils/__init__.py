@@ -1,0 +1,3 @@
+"""Shared utilities."""
+
+from .fsutil import write_atomic  # noqa: F401

@@ -1,0 +1,23 @@
+"""Filesystem helpers."""
+
+from __future__ import annotations
+
+import os
+
+
+def write_atomic(path: str, data: str, mode: int = 0o644) -> None:
+    """Atomic publish: tmp-write, chmod, rename.  Consumers (the JAX job
+    reading the bootstrap, the NFD worker scanning features.d) never see a
+    torn file."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            f.write(data)
+        os.chmod(tmp, mode)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except FileNotFoundError:
+            pass
+        raise
