@@ -1,0 +1,100 @@
+// lldpcap — native LLDP capture core (AF_PACKET + classic BPF).
+//
+// The reference's only native dependency is libpcap, bound through CGO for
+// promiscuous capture with an in-kernel EtherType filter
+// (ref pkg/lldp/client.go:81-91, build/Dockerfile.linkdiscovery:24,32).
+// This is the from-scratch equivalent: a raw AF_PACKET socket bound to the
+// interface, a 4-instruction classic-BPF program filtering EtherType 0x88cc
+// in-kernel, promiscuous membership, and poll()-based timed reads.
+// Python binds it via ctypes (tpu_network_operator/lldp/client.py).
+//
+// API (C ABI):
+//   int lldpcap_open(const char *ifname);              // >=0 fd, <0 -errno
+//   int lldpcap_next(int fd, char *buf, int buflen,
+//                    int timeout_ms);                  // >0 len, 0 timeout, <0 -errno
+//   void lldpcap_close(int fd);
+
+#include <cerrno>
+#include <cstring>
+
+#include <arpa/inet.h>
+#include <linux/filter.h>
+#include <linux/if_packet.h>
+#include <net/ethernet.h>
+#include <net/if.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr unsigned short kLldpEthertype = 0x88cc;
+
+// tcpdump -dd 'ether proto 0x88cc'
+const sock_filter kLldpFilter[] = {
+    {0x28, 0, 0, 12},                 // ldh [12]        ; EtherType
+    {0x15, 0, 1, kLldpEthertype},     // jeq 0x88cc, A, B
+    {0x06, 0, 0, 0x00040000},         // ret 262144      ; accept
+    {0x06, 0, 0, 0x00000000},         // ret 0           ; drop
+};
+
+}  // namespace
+
+extern "C" {
+
+int lldpcap_open(const char *ifname) {
+  unsigned idx = if_nametoindex(ifname);
+  if (idx == 0) return -errno;
+
+  int fd = socket(AF_PACKET, SOCK_RAW | SOCK_CLOEXEC, htons(ETH_P_ALL));
+  if (fd < 0) return -errno;
+
+  // in-kernel EtherType filter BEFORE bind: no foreign frames are ever
+  // queued (the reference gets the same from pcap's BPF handle)
+  sock_fprog prog{};
+  prog.len = sizeof(kLldpFilter) / sizeof(kLldpFilter[0]);
+  prog.filter = const_cast<sock_filter *>(kLldpFilter);
+  if (setsockopt(fd, SOL_SOCKET, SO_ATTACH_FILTER, &prog, sizeof(prog)) < 0) {
+    int err = -errno;
+    close(fd);
+    return err;
+  }
+
+  sockaddr_ll addr{};
+  addr.sll_family = AF_PACKET;
+  addr.sll_protocol = htons(ETH_P_ALL);
+  addr.sll_ifindex = static_cast<int>(idx);
+  if (bind(fd, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)) < 0) {
+    int err = -errno;
+    close(fd);
+    return err;
+  }
+
+  // promiscuous: LLDP goes to 01:80:c2:00:00:0e, not our unicast MAC
+  packet_mreq mreq{};
+  mreq.mr_ifindex = static_cast<int>(idx);
+  mreq.mr_type = PACKET_MR_PROMISC;
+  if (setsockopt(fd, SOL_PACKET, PACKET_ADD_MEMBERSHIP, &mreq,
+                 sizeof(mreq)) < 0) {
+    int err = -errno;
+    close(fd);
+    return err;
+  }
+
+  return fd;
+}
+
+int lldpcap_next(int fd, char *buf, int buflen, int timeout_ms) {
+  pollfd pfd{fd, POLLIN, 0};
+  int rc = poll(&pfd, 1, timeout_ms);
+  if (rc < 0) return -errno;
+  if (rc == 0) return 0;   // timeout
+
+  ssize_t n = recv(fd, buf, static_cast<size_t>(buflen), 0);
+  if (n < 0) return -errno;
+  return static_cast<int>(n);
+}
+
+void lldpcap_close(int fd) { close(fd); }
+
+}  // extern "C"

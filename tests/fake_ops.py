@@ -1,0 +1,127 @@
+"""Fake LinkOps function table — the reference's fake-netlink test rig
+(ref ``cmd/discover/network_test.go:212-361``): in-memory links, recorded
+mutations, injectable errors."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu_network_operator.agent import netlink as nl
+
+
+class FakeSubscription:
+    def __init__(self, cluster: "FakeLinkOps"):
+        self.cluster = cluster
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+    def wait_for(self, names, predicate, timeout=3.0):
+        return {
+            n: predicate(self.cluster.links[n])
+            for n in names
+            if n in self.cluster.links
+        }
+
+
+@dataclass
+class FakeLinkOps:
+    """Drop-in for netlink.LinkOps backed by dicts."""
+
+    links: Dict[str, nl.Link] = field(default_factory=dict)
+    addrs: Dict[int, List[nl.Addr]] = field(default_factory=dict)
+    routes: List[nl.Route] = field(default_factory=list)
+    # error injection (ref fakeAddrsAdded/error injectors)
+    fail_link_set_up: Optional[str] = None
+    fail_addr_add: Optional[str] = None
+    # recordings
+    mtu_set: Dict[str, int] = field(default_factory=dict)
+    ups: List[str] = field(default_factory=list)
+    downs: List[str] = field(default_factory=list)
+
+    def add_fake_link(self, name: str, index: int, mac: str,
+                      up: bool = False, mtu: int = 1500) -> nl.Link:
+        link = nl.Link(
+            index=index, name=name,
+            flags=nl.IFF_UP if up else 0, mtu=mtu, mac=mac,
+            operstate=nl.OPER_UP if up else 0,
+        )
+        self.links[name] = link
+        self.addrs.setdefault(index, [])
+        return link
+
+    # -- LinkOps surface ------------------------------------------------------
+
+    def link_by_name(self, name: str) -> nl.Link:
+        if name not in self.links:
+            raise nl.NetlinkError(19, f"netlink: no such device: {name}")
+        return self.links[name]
+
+    def link_list(self):
+        return list(self.links.values())
+
+    def link_set_up(self, link) -> None:
+        link = self._resolve(link)
+        if self.fail_link_set_up == link.name:
+            raise nl.NetlinkError(1, "netlink: operation not permitted")
+        link.flags |= nl.IFF_UP
+        link.operstate = nl.OPER_UP
+        self.ups.append(link.name)
+
+    def link_set_down(self, link) -> None:
+        link = self._resolve(link)
+        link.flags &= ~nl.IFF_UP
+        link.operstate = 0
+        self.downs.append(link.name)
+
+    def link_set_mtu(self, link, mtu: int) -> None:
+        link = self._resolve(link)
+        link.mtu = mtu
+        self.mtu_set[link.name] = mtu
+
+    def addr_list(self, index=None):
+        if index is None:
+            return [a for lst in self.addrs.values() for a in lst]
+        return list(self.addrs.get(index, []))
+
+    def addr_add(self, link, cidr: str) -> None:
+        link = self._resolve(link)
+        if self.fail_addr_add == link.name:
+            raise nl.NetlinkError(13, "netlink: permission denied")
+        address, plen = cidr.split("/")
+        existing = self.addrs.setdefault(link.index, [])
+        if any(a.address == address for a in existing):
+            raise nl.NetlinkError(17, "netlink: file exists")
+        existing.append(nl.Addr(link.index, address, int(plen), link.name))
+
+    def addr_del(self, link, cidr: str) -> None:
+        link = self._resolve(link)
+        address, _ = cidr.split("/")
+        lst = self.addrs.get(link.index, [])
+        before = len(lst)
+        lst[:] = [a for a in lst if a.address != address]
+        if len(lst) == before:
+            raise nl.NetlinkError(99, "netlink: cannot assign")
+
+    def route_append(self, route: nl.Route) -> None:
+        if any(r.dst == route.dst and r.oif == route.oif for r in self.routes):
+            raise nl.NetlinkError(17, "netlink: file exists")
+        self.routes.append(route)
+
+    def route_list(self):
+        return [
+            {"dst": r.dst, "gateway": r.gateway, "oif": r.oif}
+            for r in self.routes
+        ]
+
+    def subscribe(self):
+        return FakeSubscription(self)
+
+    def _resolve(self, link):
+        if isinstance(link, nl.Link):
+            return self.links[link.name]
+        return self.link_by_name(link)

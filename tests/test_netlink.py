@@ -1,0 +1,135 @@
+"""Netlink tests.
+
+Two tiers, mirroring the reference's split (SURVEY.md §4): pure unit tests
+of the wire encoding (no kernel), and a root-gated integration tier that
+exercises the real kernel on the spare ``ifb1`` device (skipped without
+NET_ADMIN) — coverage the reference never had for its netlink layer.
+"""
+
+import os
+import socket
+import struct
+
+import pytest
+
+from tpu_network_operator.agent import netlink as nl
+
+
+class TestWireFormat:
+    def test_attr_padding(self):
+        a = nl._attr(nl.IFLA_IFNAME, b"eth0\x00")
+        # 4 hdr + 5 payload = 9 -> padded to 12
+        assert len(a) == 12
+        length, rtype = struct.unpack_from("=HH", a)
+        assert (length, rtype) == (9, nl.IFLA_IFNAME)
+
+    def test_attr_parse_round_trip(self):
+        blob = (
+            nl._attr_u32(nl.IFLA_MTU, 9000)
+            + nl._attr_str(nl.IFLA_IFNAME, "scaleout0")
+            + nl._attr(nl.IFLA_ADDRESS, bytes(range(6)))
+        )
+        attrs = nl.parse_attrs(blob)
+        assert struct.unpack("=I", attrs[nl.IFLA_MTU])[0] == 9000
+        assert attrs[nl.IFLA_IFNAME].rstrip(b"\x00") == b"scaleout0"
+        assert attrs[nl.IFLA_ADDRESS] == bytes(range(6))
+
+    def test_parse_attrs_truncated_garbage(self):
+        assert nl.parse_attrs(b"\x01") == {}
+        assert nl.parse_attrs(b"\x00\x00\x00\x00") == {}  # len<hdr stops
+
+    def test_link_parse(self):
+        body = nl._IFINFOMSG.pack(0, 1, 7, nl.IFF_UP | nl.IFF_RUNNING, 0)
+        body += nl._attr_str(nl.IFLA_IFNAME, "acc7")
+        body += nl._attr_u32(nl.IFLA_MTU, 8000)
+        body += nl._attr(nl.IFLA_ADDRESS, bytes.fromhex("aabbccddeeff"))
+        body += nl._attr(nl.IFLA_OPERSTATE, bytes([nl.OPER_UP]))
+        link = nl._parse_link(body)
+        assert link.index == 7
+        assert link.name == "acc7"
+        assert link.is_up and link.oper_up
+        assert link.mtu == 8000
+        assert link.mac == "aa:bb:cc:dd:ee:ff"
+
+    def test_addr_parse(self):
+        body = nl._IFADDRMSG.pack(socket.AF_INET.value
+                                  if hasattr(socket.AF_INET, "value")
+                                  else socket.AF_INET, 30, 0, 0, 3)
+        body += nl._attr(nl.IFA_LOCAL, socket.inet_aton("10.1.2.1"))
+        body += nl._attr_str(nl.IFA_LABEL, "acc3")
+        addr = nl._parse_addr(body)
+        assert addr.cidr() == "10.1.2.1/30"
+        assert addr.index == 3
+
+
+def _have_net_admin() -> bool:
+    try:
+        nl.link_by_name("ifb1")
+    except Exception:
+        return False
+    try:
+        nl.link_set_down("ifb1")
+        return True
+    except PermissionError:
+        return False
+    except nl.NetlinkError as e:
+        return e.errno != 1
+
+
+needs_root = pytest.mark.skipif(
+    not _have_net_admin(), reason="requires NET_ADMIN and ifb1"
+)
+
+
+@needs_root
+class TestKernelIntegration:
+    IFACE = "ifb1"
+
+    def teardown_method(self):
+        try:
+            link = nl.link_by_name(self.IFACE)
+            for a in nl.addr_list(link.index):
+                nl.addr_del(self.IFACE, a.cidr())
+            nl.link_set_mtu(self.IFACE, 1500)
+            nl.link_set_down(self.IFACE)
+        except Exception:
+            pass
+
+    def test_up_down_with_echo(self):
+        nl.link_set_up(self.IFACE)
+        with nl.LinkSubscription() as sub:
+            got = sub.wait_for([self.IFACE], lambda l: l.is_up, timeout=3.0)
+        assert got == {self.IFACE: True}
+        nl.link_set_down(self.IFACE)
+        assert not nl.link_by_name(self.IFACE).is_up
+
+    def test_mtu(self):
+        nl.link_set_mtu(self.IFACE, 8000)
+        assert nl.link_by_name(self.IFACE).mtu == 8000
+
+    def test_addr_lifecycle_and_kernel_l30_route(self):
+        nl.link_set_up(self.IFACE)
+        nl.addr_add(self.IFACE, "10.200.1.1/30")
+        link = nl.link_by_name(self.IFACE)
+        assert [a.cidr() for a in nl.addr_list(link.index)] == ["10.200.1.1/30"]
+        # duplicate add -> EEXIST surfaces as NetlinkError
+        with pytest.raises(nl.NetlinkError):
+            nl.addr_add(self.IFACE, "10.200.1.1/30")
+        nl.addr_del(self.IFACE, "10.200.1.1/30")
+        assert nl.addr_list(link.index) == []
+
+    def test_route_via_lldp_style_gateway(self):
+        """The reference's L3 scheme: /30 on-link + /16 via the switch
+        gateway (network.go:311-379)."""
+        nl.link_set_up(self.IFACE)
+        nl.addr_add(self.IFACE, "10.200.2.1/30")
+        link = nl.link_by_name(self.IFACE)
+        nl.route_append(
+            nl.Route(dst="10.202.0.0/16", gateway="10.200.2.2", oif=link.index)
+        )
+        routes = [r for r in nl.route_list() if r["dst"] == "10.202.0.0/16"]
+        assert routes and routes[0]["gateway"] == "10.200.2.2"
+
+    def test_missing_device_error(self):
+        with pytest.raises(nl.NetlinkError, match="no such device"):
+            nl.link_by_name("does-not-exist0")

@@ -14,7 +14,9 @@ def test_write_when_nfd_present(tmp_path):
     d.mkdir(parents=True)
     assert write_readiness_label(TPU_READY_LABEL, root=str(tmp_path))
     content = (d / "scale-out-readiness.txt").read_text()
-    assert content == "tpunet.dev/tpu-scale-out=true\n"
+    # must live under the feature.node.kubernetes.io vendor namespace or
+    # NFD's default deny-label-ns silently drops it
+    assert content == "tpunet.feature.node.kubernetes.io/tpu-scale-out=true\n"
 
 
 def test_skip_when_nfd_absent(tmp_path):

@@ -1,0 +1,99 @@
+"""NetworkManager opt-out tests: mock client against the seam (ref
+``internal/nm/networkmanager_test.go:25-175``) + D-Bus wire codec units."""
+
+import pytest
+
+from tpu_network_operator.nm import disable_network_manager_for_interfaces
+from tpu_network_operator.nm.dbus import (
+    DBusError,
+    build_method_call,
+    marshal_body,
+    parse_message,
+    unmarshal_body,
+)
+
+
+class MockNmClient:
+    """ref MockNetworkManager/MockDevice."""
+
+    def __init__(self, devices, fail_set=()):
+        self.devices = devices          # ifname -> (path, managed)
+        self.fail_set = set(fail_set)
+        self.set_calls = []
+
+    def get_device_by_ip_iface(self, ifname):
+        if ifname not in self.devices:
+            raise DBusError("org.freedesktop.NetworkManager.UnknownDevice")
+        return self.devices[ifname][0]
+
+    def get_managed(self, path):
+        for p, managed in self.devices.values():
+            if p == path:
+                return managed
+        raise DBusError("unknown path")
+
+    def set_managed(self, path, managed):
+        if path in self.fail_set:
+            raise DBusError("org.freedesktop.DBus.Error.AccessDenied")
+        self.set_calls.append((path, managed))
+
+
+class TestDisable:
+    def test_disables_managed_devices(self):
+        client = MockNmClient(
+            {"acc0": ("/dev/0", True), "acc1": ("/dev/1", False)}
+        )
+        done = disable_network_manager_for_interfaces(
+            ["acc0", "acc1"], client
+        )
+        assert done == ["acc0", "acc1"]
+        # acc1 already unmanaged: no Set call (ref :92-101 behavior)
+        assert client.set_calls == [("/dev/0", False)]
+
+    def test_unknown_device_tolerated(self):
+        client = MockNmClient({"acc0": ("/dev/0", True)})
+        done = disable_network_manager_for_interfaces(
+            ["acc0", "ghost"], client
+        )
+        assert done == ["acc0"]
+
+    def test_set_failure_tolerated(self):
+        client = MockNmClient(
+            {"acc0": ("/dev/0", True), "acc1": ("/dev/1", True)},
+            fail_set={"/dev/0"},
+        )
+        done = disable_network_manager_for_interfaces(
+            ["acc0", "acc1"], client
+        )
+        assert done == ["acc1"]
+
+    def test_nm_absent_tolerated(self, monkeypatch):
+        """ref :79-110: node without NetworkManager -> no-op, no crash."""
+        monkeypatch.setenv("TPUNET_DBUS_SOCKET", "/nonexistent/socket")
+        assert disable_network_manager_for_interfaces(["acc0"]) == []
+
+
+class TestDbusWire:
+    def test_body_round_trip(self):
+        body = marshal_body("ssv", ["iface.Dev", "Managed", ("b", False)])
+        out = unmarshal_body("ssv", body)
+        assert out == ["iface.Dev", "Managed", ("b", False)]
+
+    def test_method_call_parses_back(self):
+        msg = build_method_call(
+            7, "org.freedesktop.NetworkManager",
+            "/org/freedesktop/NetworkManager",
+            "org.freedesktop.NetworkManager", "GetDeviceByIpIface",
+            signature="s", args=["acc0"],
+        )
+        msg_type, fields, body, total = parse_message(msg)
+        assert msg_type == 1
+        assert total == len(msg)
+        assert fields[1] == "/org/freedesktop/NetworkManager"
+        assert fields[3] == "GetDeviceByIpIface"
+        assert fields[8] == "s"
+        assert unmarshal_body("s", body) == ["acc0"]
+
+    def test_unsupported_signature_raises(self):
+        with pytest.raises(DBusError):
+            marshal_body("x", [1])

@@ -186,6 +186,13 @@ class TestTopologyDiscovery:
             t = topo.discover(MetadataClient(srv.url))
         assert t.worker_id == 6
 
+    def test_multi_host_without_worker_id_refused(self):
+        # every host defaulting to worker 0 would deadlock
+        # jax.distributed.initialize with colliding process ids
+        with FakeMetadataServer({"accelerator-type": "v4-16"}) as srv:
+            with pytest.raises(topo.TopologyError, match="no worker-id"):
+                topo.discover(MetadataClient(srv.url))
+
     def test_round_trip(self, v5p_server):
         t = topo.discover(MetadataClient(v5p_server.url))
         assert topo.TpuTopology.from_dict(t.to_dict()) == t

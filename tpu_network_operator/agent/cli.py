@@ -1,0 +1,311 @@
+"""The ``discover`` node-agent CLI — the DaemonSet payload.
+
+Rebuild of ref ``cmd/discover/main.go``: sanitize → pre-clean → enumerate →
+(optional) NetworkManager opt-out → links up (echo-wait) → MTU → strip IPs →
+(L3) LLDP detect → /30 + routes → write artifacts → NFD label → idle until
+SIGTERM → restore.  The ``tpu`` backend replaces device enumeration with
+ICI topology discovery, targets DCN host NICs, and emits the
+``jax.distributed`` bootstrap instead of ``gaudinet.json``.
+
+Flag surface mirrors the reference's cobra flags (main.go:281-298) plus the
+TPU additions the operator projects (controller/reconciler.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .. import nfd
+from ..lldp import detect_lldp
+from . import netlink as nl
+from . import network as net
+from .gaudinet import write_gaudinet
+from .systemd_networkd import delete_systemd_networkd, write_systemd_networkd
+from .tpu import bootstrap as tpu_bootstrap
+from .tpu import topology as tpu_topology
+from .tpu.metadata import MetadataClient, MetadataError
+
+log = logging.getLogger("tpunet.agent")
+
+L2, L3 = "L2", "L3"
+
+
+@dataclass
+class CmdConfig:
+    """ref ``cmdConfig`` main.go:48-60 + tpu fields."""
+
+    backend: str = "gaudi"
+    configure: bool = False
+    keep_running: bool = False
+    mode: str = L3
+    mtu: int = 1500
+    wait: float = 30.0
+    gaudinet: str = ""
+    networkd: str = ""
+    interfaces: str = ""
+    disable_nm: bool = False
+    verbosity: int = 0
+    # tpu backend
+    topology_source: str = "auto"
+    coordinator_port: int = 8476
+    bootstrap: str = ""
+    # seams
+    ops: nl.LinkOps = field(default_factory=nl.LinkOps)
+    nfd_root: str = ""
+    lldp_backend: str = "auto"
+
+
+def sanitize_input(config: CmdConfig) -> None:
+    """ref ``sanitizeInput()`` main.go:61-82: clamp MTU, normalize mode —
+    the agent never trusts operator input (defense in depth)."""
+    if config.mtu < 1500:
+        log.info("forcing MTU value 1500 (old %d)", config.mtu)
+        config.mtu = 1500
+    elif config.mtu > 9000:
+        log.info("limiting MTU value 9000 (old %d)", config.mtu)
+        config.mtu = 9000
+    mode = config.mode.upper()
+    if mode not in (L2, L3):
+        raise ValueError(f"invalid mode '{config.mode}'")
+    config.mode = mode
+    if config.backend not in ("gaudi", "tpu"):
+        raise ValueError(f"invalid backend '{config.backend}'")
+
+
+def pre_cleanups(config: CmdConfig) -> None:
+    """ref ``preCleanups()`` main.go:124-141."""
+    nfd.remove_readiness_label(root=config.nfd_root)
+    if config.networkd:
+        os.makedirs(config.networkd, exist_ok=True)
+        log.info("created systemd-networkd directory %s", config.networkd)
+
+
+def post_cleanups(
+    config: CmdConfig, configs: Dict[str, net.NetworkConfiguration]
+) -> None:
+    """ref ``postCleanups()`` main.go:143-159: label off, IPs off, links
+    restored; bootstrap removed for the tpu backend."""
+    log.info("clean up before exiting...")
+    nfd.remove_readiness_label(root=config.nfd_root)
+    if config.backend == "tpu" and config.bootstrap:
+        tpu_bootstrap.delete_bootstrap(config.bootstrap)
+    try:
+        net.remove_existing_ips(configs, config.ops)
+    except nl.NetlinkError as e:
+        log.warning("failed to remove existing IPs: %s", e)
+    net.interfaces_restore_down(configs, config.ops)
+
+
+def _detect_and_apply_lldp(
+    config: CmdConfig, configs: Dict[str, net.NetworkConfiguration]
+) -> None:
+    """ref detectLLDP + lldpResults wiring (main.go:199-217)."""
+    up_ifaces = {
+        name: cfg.link.mac
+        for name, cfg in configs.items()
+        if cfg.link.is_up
+    }
+    for name, cfg in configs.items():
+        if not cfg.link.is_up:
+            log.info("link %r down, cannot start LLDP", name)
+    results = detect_lldp(
+        up_ifaces, config.wait, backend=config.lldp_backend
+    )
+    for result in results:
+        if result.interface_name in configs:
+            cfg = configs[result.interface_name]
+            cfg.port_description = result.port_description
+            cfg.peer_hw_addr = result.peer_mac
+    net.lldp_results(configs)
+
+
+def _resolve_interfaces(config: CmdConfig) -> List[str]:
+    names = net.get_networks() if config.backend == "gaudi" else []
+    extra = [i for i in config.interfaces.split(",") if i]
+    return names + [e for e in extra if e not in names]
+
+
+def _configure_network(
+    config: CmdConfig, names: List[str]
+) -> Dict[str, net.NetworkConfiguration]:
+    """The shared L2/L3 data-plane pass (both backends)."""
+    configs = net.get_network_configs(names, config.ops)
+    missing = [n for n in names if n not in configs]
+    if missing:
+        raise RuntimeError(f"interfaces not found: {missing}")
+
+    if config.disable_nm and configs:
+        from ..nm import disable_network_manager_for_interfaces
+
+        disable_network_manager_for_interfaces(list(configs))
+
+    net.interfaces_up(configs, config.ops)
+    net.interfaces_set_mtu(configs, config.ops, config.mtu)
+    net.remove_existing_ips(configs, config.ops)
+
+    if config.mode == L3 and configs:
+        _detect_and_apply_lldp(config, configs)
+        configured, total = net.configure_interfaces(configs, config.ops)
+        if configured < total:
+            log.warning(
+                "configured %d/%d interfaces", configured, total
+            )
+        if config.gaudinet and config.backend == "gaudi":
+            write_gaudinet(config.gaudinet, configs)
+        if config.networkd:
+            write_systemd_networkd(config.networkd, configs)
+    net.log_results(configs, config.ops, config.mode == L3)
+    return configs
+
+
+def _tpu_discovery(config: CmdConfig) -> None:
+    """TPU backend: topology probe + jax.distributed bootstrap emission."""
+    client = MetadataClient()
+    topo = tpu_topology.discover(client, source=config.topology_source)
+    log.info(
+        "discovered %s: %s chips, hosts %d, worker %d, slices %d",
+        topo.accelerator_type, topo.num_chips, topo.num_hosts,
+        topo.worker_id, topo.num_slices,
+    )
+    cfg = tpu_bootstrap.build_bootstrap(
+        topo,
+        client.worker_network_config(),
+        config.coordinator_port,
+        megascale_coordinator=topo.megascale_coordinator,
+        dcn_interfaces=[i for i in config.interfaces.split(",") if i],
+    )
+    if config.bootstrap:
+        tpu_bootstrap.write_bootstrap(cfg, config.bootstrap)
+        log.info("wrote bootstrap to %s", config.bootstrap)
+
+
+def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
+    """ref ``cmdRun()`` main.go:161-259."""
+    sanitize_input(config)
+    pre_cleanups(config)
+
+    configs: Dict[str, net.NetworkConfiguration] = {}
+    ready_label = (
+        nfd.TPU_READY_LABEL if config.backend == "tpu" else nfd.GAUDI_READY_LABEL
+    )
+
+    try:
+        if config.backend == "tpu":
+            _tpu_discovery(config)
+
+        names = _resolve_interfaces(config)
+        if names:
+            configs = _configure_network(config, names)
+        elif config.backend == "gaudi":
+            raise RuntimeError("no accelerator network interfaces found")
+
+        if not config.configure:
+            # dry-run: observe, then put links back (ref main.go:235-237)
+            net.interfaces_restore_down(configs, config.ops)
+            return 0
+
+        if config.keep_running:
+            if nfd.write_readiness_label(ready_label, root=config.nfd_root):
+                log.info("wrote NFD readiness label")
+            if wait_signal:
+                _block_until_signal()
+            post_cleanups(config, configs)
+        return 0
+    except (
+        MetadataError,
+        tpu_topology.TopologyError,
+        tpu_bootstrap.BootstrapError,
+        RuntimeError,
+    ) as e:
+        log.error("%s", e)
+        return 1
+
+
+def _block_until_signal() -> None:
+    """ref main.go:252-255 (idle steady state)."""
+    ev = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: ev.set())
+    ev.wait()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """Flag surface (ref main.go:281-298 + tpu)."""
+    p = argparse.ArgumentParser(
+        prog="discover",
+        description="accelerator scale-out network configurator",
+    )
+    p.add_argument("--backend", default="gaudi", choices=["gaudi", "tpu"])
+    p.add_argument("--configure", default=False,
+                   type=lambda s: s.lower() in ("1", "true", "yes"),
+                   help="actually configure (else dry-run)")
+    p.add_argument("--keep-running", action="store_true")
+    p.add_argument("--mode", default=L3, help="L2 or L3")
+    p.add_argument("--mtu", type=int, default=1500)
+    p.add_argument("--wait", default="30s",
+                   help="LLDP wait budget (e.g. 90s)")
+    p.add_argument("--gaudinet", default="")
+    p.add_argument("--systemd-networkd", dest="networkd", default="")
+    p.add_argument("--interfaces", default="",
+                   help="comma-separated extra interfaces")
+    p.add_argument("--disable-networkmanager", dest="disable_nm",
+                   action="store_true")
+    p.add_argument("--v", dest="verbosity", type=int, default=0)
+    p.add_argument("--topology-source", default="auto")
+    p.add_argument("--coordinator-port", type=int, default=8476)
+    p.add_argument("--bootstrap", default="")
+    return p
+
+
+def parse_wait(s: str) -> float:
+    if s.endswith("ms"):
+        return float(s[:-2]) / 1000.0
+    if s.endswith("s"):
+        return float(s[:-1])
+    if s.endswith("m"):
+        return float(s[:-1]) * 60.0
+    return float(s)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    level = logging.DEBUG if args.verbosity >= 3 else (
+        logging.INFO if args.verbosity >= 1 else logging.WARNING
+    )
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        stream=sys.stderr,
+    )
+    config = CmdConfig(
+        backend=args.backend,
+        configure=args.configure,
+        keep_running=args.keep_running,
+        mode=args.mode,
+        mtu=args.mtu,
+        wait=parse_wait(args.wait),
+        gaudinet=args.gaudinet,
+        networkd=args.networkd,
+        interfaces=args.interfaces,
+        disable_nm=args.disable_nm,
+        verbosity=args.verbosity,
+        topology_source=args.topology_source,
+        coordinator_port=args.coordinator_port,
+        bootstrap=args.bootstrap,
+    )
+    try:
+        return cmd_run(config)
+    except ValueError as e:
+        log.error("%s", e)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+"""gaudinet.json writer — the artifact Gaudi FW/HCCL consumes.
+
+Rebuild of ref ``cmd/discover/gaudinet.go:28-89``: per-NIC
+``{NIC_MAC, NIC_IP, SUBNET_MASK, GATEWAY_MAC}`` entries; interfaces lacking
+an LLDP-derived address or peer MAC are skipped with a warning (partial
+tolerance), matching the reference byte-for-byte in schema.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Dict
+
+from ..utils import write_atomic
+from .network import NetworkConfiguration
+
+log = logging.getLogger("tpunet.agent")
+
+SUBNET_MASK_30 = "255.255.255.252"
+
+
+def generate_gaudinet(configs: Dict[str, NetworkConfiguration]) -> dict:
+    """ref ``GenerateGaudiNet()`` gaudinet.go:46-76."""
+    entries = []
+    for ifname, cfg in sorted(configs.items()):
+        if cfg.local_addr is None:
+            log.warning(
+                "interface %r has no LLDP address when creating gaudinet "
+                "file, skipping...", ifname,
+            )
+            continue
+        if cfg.peer_hw_addr is None:
+            log.warning(
+                "interface %r has no peer MAC address when creating gaudinet "
+                "file, skipping...", ifname,
+            )
+            continue
+        entries.append(
+            {
+                "NIC_MAC": cfg.link.mac,
+                "NIC_IP": cfg.local_addr,
+                "SUBNET_MASK": SUBNET_MASK_30,
+                "GATEWAY_MAC": cfg.peer_hw_addr,
+            }
+        )
+    return {"NIC_NET_CONFIG": entries}
+
+
+def write_gaudinet(
+    filename: str, configs: Dict[str, NetworkConfiguration]
+) -> None:
+    """ref ``WriteGaudiNet()`` gaudinet.go:78-89 (0644)."""
+    if not filename:
+        raise ValueError("no file name when saving gaudinet.json")
+    write_atomic(filename, json.dumps(generate_gaudinet(configs)))

@@ -1,0 +1,471 @@
+"""Pure-Python rtnetlink: the L1 kernel seam for link/addr/route config.
+
+The reference drives the kernel through the pure-Go ``vishvananda/netlink``
+package (ref ``cmd/discover/network.go:28,41-63``) — netlink is a syscall
+ABI, not a C library, so a from-scratch implementation in Python raw
+sockets is the faithful analog (SURVEY.md §2 native table).
+
+Implements exactly the surface the agent needs (mirroring the reference's
+``networkLinkFn`` function table, ``network.go:41-63``):
+
+* link lookup by name (RTM_GETLINK dump), up/down (RTM_NEWLINK IFF_UP),
+  set MTU (IFLA_MTU);
+* address list/add/del (RTM_GETADDR/NEWADDR/DELADDR);
+* route list/append (RTM_GETROUTE/NEWROUTE) for the /30 + /16 scheme;
+* link-event subscribe (RTMGRP_LINK) for the operstate echo wait
+  (ref ``network.go:242-257``).
+
+All functions raise :class:`NetlinkError` with the kernel's errno.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# -- constants (uapi/linux/netlink.h, rtnetlink.h, if.h) ----------------------
+
+NETLINK_ROUTE = 0
+
+NLM_F_REQUEST = 0x01
+NLM_F_ACK = 0x04
+NLM_F_DUMP = 0x300
+NLM_F_CREATE = 0x400
+NLM_F_EXCL = 0x200
+NLM_F_APPEND = 0x800
+NLM_F_REPLACE = 0x100
+
+NLMSG_ERROR = 0x2
+NLMSG_DONE = 0x3
+
+RTM_NEWLINK = 16
+RTM_DELLINK = 17
+RTM_GETLINK = 18
+RTM_NEWADDR = 20
+RTM_DELADDR = 21
+RTM_GETADDR = 22
+RTM_NEWROUTE = 24
+RTM_DELROUTE = 25
+RTM_GETROUTE = 26
+
+IFF_UP = 0x1
+IFF_RUNNING = 0x40
+IFF_LOWER_UP = 0x10000
+
+# ifinfomsg attributes
+IFLA_ADDRESS = 1
+IFLA_IFNAME = 3
+IFLA_MTU = 4
+IFLA_OPERSTATE = 16
+IFLA_LINKINFO = 18
+IFLA_INFO_KIND = 1
+
+# ifaddrmsg attributes
+IFA_ADDRESS = 1
+IFA_LOCAL = 2
+IFA_LABEL = 3
+
+# rtmsg attributes
+RTA_DST = 1
+RTA_OIF = 4
+RTA_GATEWAY = 5
+RTA_PREFSRC = 7
+
+RT_TABLE_MAIN = 254
+RT_SCOPE_UNIVERSE = 0
+RT_SCOPE_LINK = 253
+RTPROT_BOOT = 3
+RTPROT_STATIC = 4
+RTN_UNICAST = 1
+
+RTMGRP_LINK = 0x1
+RTMGRP_IPV4_IFADDR = 0x10
+
+OPER_UP = 6
+
+AF_UNSPEC = 0
+AF_INET = socket.AF_INET
+
+_NLMSGHDR = struct.Struct("=IHHII")
+_IFINFOMSG = struct.Struct("=BxHiII")
+_IFADDRMSG = struct.Struct("=BBBBi")
+_RTMSG = struct.Struct("=BBBBBBBBI")
+_RTA = struct.Struct("=HH")
+
+
+class NetlinkError(OSError):
+    pass
+
+
+def _align4(n: int) -> int:
+    return (n + 3) & ~3
+
+
+def _attr(rta_type: int, payload: bytes) -> bytes:
+    length = _RTA.size + len(payload)
+    return _RTA.pack(length, rta_type) + payload + b"\x00" * (
+        _align4(length) - length
+    )
+
+
+def _attr_u32(rta_type: int, val: int) -> bytes:
+    return _attr(rta_type, struct.pack("=I", val))
+
+
+def _attr_str(rta_type: int, s: str) -> bytes:
+    return _attr(rta_type, s.encode() + b"\x00")
+
+
+def parse_attrs(data: bytes) -> Dict[int, bytes]:
+    """Flat attribute parse (no nesting needed for our surface)."""
+    out: Dict[int, bytes] = {}
+    off = 0
+    while off + _RTA.size <= len(data):
+        length, rta_type = _RTA.unpack_from(data, off)
+        if length < _RTA.size:
+            break
+        out[rta_type] = data[off + _RTA.size : off + length]
+        off += _align4(length)
+    return out
+
+
+# -- data types ---------------------------------------------------------------
+
+
+@dataclass
+class Link:
+    index: int
+    name: str
+    flags: int
+    mtu: int
+    mac: str
+    operstate: int = 0
+
+    @property
+    def is_up(self) -> bool:
+        return bool(self.flags & IFF_UP)
+
+    @property
+    def oper_up(self) -> bool:
+        return self.operstate == OPER_UP
+
+
+@dataclass
+class Addr:
+    index: int
+    address: str
+    prefixlen: int
+    label: str = ""
+
+    def cidr(self) -> str:
+        return f"{self.address}/{self.prefixlen}"
+
+
+@dataclass
+class Route:
+    dst: str              # "10.1.2.0/30"; "" = default
+    gateway: str = ""
+    oif: int = 0
+    scope: int = RT_SCOPE_UNIVERSE
+
+
+# -- socket -------------------------------------------------------------------
+
+
+class NetlinkSocket:
+    """One rtnetlink request/response socket."""
+
+    def __init__(self, groups: int = 0):
+        self.sock = socket.socket(
+            socket.AF_NETLINK, socket.SOCK_RAW, NETLINK_ROUTE
+        )
+        self.sock.bind((0, groups))
+        self.seq = 0
+
+    def close(self) -> None:
+        self.sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _send(self, msg_type: int, flags: int, payload: bytes) -> int:
+        self.seq += 1
+        hdr = _NLMSGHDR.pack(
+            _NLMSGHDR.size + len(payload), msg_type, flags, self.seq, 0
+        )
+        self.sock.send(hdr + payload)
+        return self.seq
+
+    def _recv_msgs(self) -> Iterator[Tuple[int, bytes]]:
+        data = self.sock.recv(65536)
+        off = 0
+        while off + _NLMSGHDR.size <= len(data):
+            length, msg_type, _flags, _seq, _pid = _NLMSGHDR.unpack_from(
+                data, off
+            )
+            if length < _NLMSGHDR.size:
+                break
+            yield msg_type, data[off + _NLMSGHDR.size : off + length]
+            off += _align4(length)
+
+    def transact(
+        self, msg_type: int, flags: int, payload: bytes
+    ) -> List[Tuple[int, bytes]]:
+        """Send and collect until ACK/DONE/ERROR; raises on kernel error."""
+        self._send(msg_type, flags | NLM_F_REQUEST | NLM_F_ACK, payload)
+        out: List[Tuple[int, bytes]] = []
+        while True:
+            for mtype, body in self._recv_msgs():
+                if mtype == NLMSG_ERROR:
+                    (errno_neg,) = struct.unpack_from("=i", body)
+                    if errno_neg != 0:
+                        err = -errno_neg
+                        raise NetlinkError(
+                            err, f"netlink: {os.strerror(err)}"
+                        )
+                    return out
+                if mtype == NLMSG_DONE:
+                    return out
+                out.append((mtype, body))
+
+
+# -- parsing helpers ----------------------------------------------------------
+
+
+def _parse_link(body: bytes) -> Link:
+    _fam, _type, index, flags, _change = _IFINFOMSG.unpack_from(body)
+    attrs = parse_attrs(body[_IFINFOMSG.size:])
+    name = attrs.get(IFLA_IFNAME, b"\x00").split(b"\x00")[0].decode()
+    mtu = struct.unpack("=I", attrs[IFLA_MTU])[0] if IFLA_MTU in attrs else 0
+    mac = (
+        ":".join(f"{b:02x}" for b in attrs[IFLA_ADDRESS])
+        if IFLA_ADDRESS in attrs and len(attrs[IFLA_ADDRESS]) == 6
+        else ""
+    )
+    oper = attrs.get(IFLA_OPERSTATE, b"\x00")[0]
+    return Link(index, name, flags, mtu, mac, oper)
+
+
+def _parse_addr(body: bytes) -> Addr:
+    _fam, prefixlen, _flags, _scope, index = _IFADDRMSG.unpack_from(body)
+    attrs = parse_attrs(body[_IFADDRMSG.size:])
+    raw = attrs.get(IFA_LOCAL) or attrs.get(IFA_ADDRESS) or b""
+    address = socket.inet_ntoa(raw) if len(raw) == 4 else ""
+    label = attrs.get(IFA_LABEL, b"\x00").split(b"\x00")[0].decode()
+    return Addr(index, address, prefixlen, label)
+
+
+# -- public API (the networkLinkFn surface) -----------------------------------
+
+
+def link_list() -> List[Link]:
+    with NetlinkSocket() as nl:
+        msgs = nl.transact(
+            RTM_GETLINK, NLM_F_DUMP, _IFINFOMSG.pack(AF_UNSPEC, 0, 0, 0, 0)
+        )
+    return [_parse_link(b) for t, b in msgs if t == RTM_NEWLINK]
+
+
+def link_by_name(name: str) -> Link:
+    """ref LinkByName (network.go seam)."""
+    for link in link_list():
+        if link.name == name:
+            return link
+    raise NetlinkError(19, f"netlink: no such device: {name}")
+
+
+def _link_change(index: int, flags: int, change: int, attrs: bytes = b"") -> None:
+    with NetlinkSocket() as nl:
+        nl.transact(
+            RTM_NEWLINK,
+            0,
+            _IFINFOMSG.pack(AF_UNSPEC, 0, index, flags, change) + attrs,
+        )
+
+
+def link_set_up(name_or_link) -> None:
+    """ref LinkSetUp."""
+    link = _resolve(name_or_link)
+    _link_change(link.index, IFF_UP, IFF_UP)
+
+
+def link_set_down(name_or_link) -> None:
+    """ref LinkSetDown (restore path, network.go:285-309)."""
+    link = _resolve(name_or_link)
+    _link_change(link.index, 0, IFF_UP)
+
+
+def link_set_mtu(name_or_link, mtu: int) -> None:
+    """ref LinkSetMTU (network.go:381-388)."""
+    link = _resolve(name_or_link)
+    _link_change(link.index, 0, 0, _attr_u32(IFLA_MTU, mtu))
+
+
+def _resolve(name_or_link) -> Link:
+    if isinstance(name_or_link, Link):
+        return name_or_link
+    return link_by_name(name_or_link)
+
+
+def addr_list(index: Optional[int] = None) -> List[Addr]:
+    """ref AddrList."""
+    with NetlinkSocket() as nl:
+        msgs = nl.transact(
+            RTM_GETADDR, NLM_F_DUMP, _IFADDRMSG.pack(AF_INET, 0, 0, 0, 0)
+        )
+    addrs = [_parse_addr(b) for t, b in msgs if t == RTM_NEWADDR]
+    if index is not None:
+        addrs = [a for a in addrs if a.index == index]
+    return addrs
+
+
+def _addr_payload(link: Link, address: str, prefixlen: int) -> bytes:
+    raw = socket.inet_aton(address)
+    scope = RT_SCOPE_UNIVERSE
+    body = _IFADDRMSG.pack(AF_INET, prefixlen, 0, scope, link.index)
+    return (
+        body
+        + _attr(IFA_LOCAL, raw)
+        + _attr(IFA_ADDRESS, raw)
+        + _attr_str(IFA_LABEL, link.name[:15])
+    )
+
+
+def addr_add(name_or_link, cidr: str) -> None:
+    """ref AddrAdd (network.go:407-469 configure path); '10.0.0.1/30'."""
+    link = _resolve(name_or_link)
+    address, prefixlen = cidr.split("/")
+    with NetlinkSocket() as nl:
+        nl.transact(
+            RTM_NEWADDR,
+            NLM_F_CREATE | NLM_F_EXCL,
+            _addr_payload(link, address, int(prefixlen)),
+        )
+
+
+def addr_del(name_or_link, cidr: str) -> None:
+    """ref AddrDel (removeExistingIPs, network.go:390-405)."""
+    link = _resolve(name_or_link)
+    address, prefixlen = cidr.split("/")
+    with NetlinkSocket() as nl:
+        nl.transact(
+            RTM_DELADDR, 0, _addr_payload(link, address, int(prefixlen))
+        )
+
+
+def route_append(route: Route) -> None:
+    """ref RouteAppend: the /30 link route + /16 gateway route
+    (network.go:311-379)."""
+    dst, prefixlen = (route.dst.split("/") + ["32"])[:2]
+    payload = _RTMSG.pack(
+        AF_INET, int(prefixlen), 0, 0, RT_TABLE_MAIN,
+        RTPROT_STATIC, route.scope, RTN_UNICAST, 0,
+    )
+    payload += _attr(RTA_DST, socket.inet_aton(dst))
+    if route.gateway:
+        payload += _attr(RTA_GATEWAY, socket.inet_aton(route.gateway))
+    if route.oif:
+        payload += _attr_u32(RTA_OIF, route.oif)
+    with NetlinkSocket() as nl:
+        nl.transact(RTM_NEWROUTE, NLM_F_CREATE | NLM_F_APPEND, payload)
+
+
+def route_list() -> List[Dict]:
+    """Installed IPv4 unicast routes (verification/debug surface)."""
+    with NetlinkSocket() as nl:
+        msgs = nl.transact(
+            RTM_GETROUTE, NLM_F_DUMP, _RTMSG.pack(AF_INET, 0, 0, 0, 0, 0, 0, 0, 0)
+        )
+    out = []
+    for t, b in msgs:
+        if t != RTM_NEWROUTE:
+            continue
+        fam, dst_len, _src_len, _tos, table, _proto, scope, rtype, _fl = (
+            _RTMSG.unpack_from(b)
+        )
+        attrs = parse_attrs(b[_RTMSG.size:])
+        dst = (
+            socket.inet_ntoa(attrs[RTA_DST]) if RTA_DST in attrs else "0.0.0.0"
+        )
+        gw = socket.inet_ntoa(attrs[RTA_GATEWAY]) if RTA_GATEWAY in attrs else ""
+        oif = struct.unpack("=I", attrs[RTA_OIF])[0] if RTA_OIF in attrs else 0
+        out.append(
+            {"dst": f"{dst}/{dst_len}", "gateway": gw, "oif": oif,
+             "table": table, "scope": scope, "type": rtype}
+        )
+    return out
+
+
+# -- link event subscription (echo wait) --------------------------------------
+
+
+class LinkSubscription:
+    """RTMGRP_LINK multicast listener — the reference's LinkSubscribe echo
+    wait (network.go:242-257): after LinkSetUp, wait for the kernel to echo
+    the operational state instead of sleeping."""
+
+    def __init__(self):
+        self.nl = NetlinkSocket(groups=RTMGRP_LINK)
+
+    def close(self) -> None:
+        self.nl.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def wait_for(
+        self, names, predicate, timeout: float = 3.0
+    ) -> Dict[str, bool]:
+        """Wait until ``predicate(link)`` holds for every name (or timeout,
+        ref 3s budget network.go:251).  Returns name -> satisfied."""
+        import time as _time
+
+        pending = {n: False for n in names}
+        # seed with current state (event may have fired before subscribe)
+        for link in link_list():
+            if link.name in pending and predicate(link):
+                pending[link.name] = True
+        deadline = _time.monotonic() + timeout
+        self.nl.sock.settimeout(0.2)
+        while not all(pending.values()) and _time.monotonic() < deadline:
+            try:
+                for mtype, body in self.nl._recv_msgs():
+                    if mtype != RTM_NEWLINK:
+                        continue
+                    link = _parse_link(body)
+                    if link.name in pending and predicate(link):
+                        pending[link.name] = True
+            except (TimeoutError, socket.timeout):
+                continue
+        return pending
+
+
+# -- seam struct (test injection point) ---------------------------------------
+
+
+@dataclass
+class LinkOps:
+    """Function table mirroring the reference's ``networkLinkFn`` seam
+    (network.go:41-63): production uses the real netlink functions; tests
+    swap in fakes per-field."""
+
+    link_by_name: callable = link_by_name
+    link_list: callable = link_list
+    link_set_up: callable = link_set_up
+    link_set_down: callable = link_set_down
+    link_set_mtu: callable = link_set_mtu
+    addr_list: callable = addr_list
+    addr_add: callable = addr_add
+    addr_del: callable = addr_del
+    route_append: callable = route_append
+    route_list: callable = route_list
+    subscribe: callable = LinkSubscription

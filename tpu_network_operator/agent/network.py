@@ -1,0 +1,291 @@
+"""Device discovery + interface configuration (the agent's data plane).
+
+Rebuild of ref ``cmd/discover/network.go``: sysfs discovery of accelerator
+NICs, link bring-up with event-echo wait, MTU, fresh-slate address removal,
+LLDP-derived /30 local addressing (switch-port trick: local = peer ^ 0x3),
+/30 point-to-point + /16 routed-network routes, idempotent re-entry.
+
+Every kernel touch goes through a :class:`~..netlink.LinkOps` function
+table (the reference's ``networkLinkFn`` seam, network.go:41-63) so tests
+inject fakes; sysfs paths honor ``SYSFS_ROOT`` (network.go:76-82).
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+import socket
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from . import netlink as nl
+
+log = logging.getLogger("tpunet.agent")
+
+# ref network.go driverPath/pciDevicePattern/netDevicePattern
+DRIVER_PATH = "bus/pci/drivers/habanalabs"
+PCI_DEVICE_PATTERN = "????:??:??.?"
+NET_DEVICE_PATTERN = "net/*"
+
+ROUTE_MASK_ROUTED_NETWORK = 16   # ref RouteMaskRoutedNetwork
+ROUTE_MASK_POINT_TO_POINT = 30   # ref RouteMaskPointToPoint
+
+
+def sysfs_root() -> str:
+    return os.environ.get("SYSFS_ROOT", "/sys/")
+
+
+def get_networks() -> List[str]:
+    """Accelerator NIC names by sysfs glob (ref ``getNetworks()``
+    network.go:88-119): driver dir → PCI device symlinks → net/ children."""
+    names: List[str] = []
+    pattern = os.path.join(sysfs_root(), DRIVER_PATH, PCI_DEVICE_PATTERN)
+    for p in glob.glob(pattern):
+        try:
+            target = os.path.realpath(p)
+        except OSError:
+            log.warning("expected %s to be a symlink", p)
+            continue
+        for n in glob.glob(os.path.join(target, NET_DEVICE_PATTERN)):
+            names.append(os.path.basename(n))
+    return sorted(names)
+
+
+@dataclass
+class NetworkConfiguration:
+    """Per-interface working state (ref ``networkConfiguration``
+    network.go:65-74)."""
+
+    link: nl.Link
+    orig_flags: int = 0
+    port_description: str = ""
+    peer_hw_addr: Optional[str] = None
+    lldp_peer: Optional[str] = None      # switch /30 address
+    local_addr: Optional[str] = None     # ours: peer ^ 0x3
+    expect_response: bool = False
+
+
+def get_network_configs(
+    names: List[str], ops: nl.LinkOps
+) -> Dict[str, NetworkConfiguration]:
+    """ref ``getNetworkConfigs()``: resolve links, remember original state."""
+    configs: Dict[str, NetworkConfiguration] = {}
+    for name in names:
+        try:
+            link = ops.link_by_name(name)
+        except nl.NetlinkError as e:
+            log.warning("link %r not found: %s", name, e)
+            continue
+        configs[name] = NetworkConfiguration(link=link, orig_flags=link.flags)
+    return configs
+
+
+def select_mask30_l3_address(
+    cfg: NetworkConfiguration,
+) -> Tuple[str, str]:
+    """ref ``selectMask30L3Address()`` network.go:141-173.
+
+    The switch's port description carries ``<something> <ip>/30``; the
+    node takes the peer address with the low two bits toggled.
+    Raises ValueError on any deviation (wrong field count, bad CIDR,
+    mask != 30)."""
+    name = cfg.link.name
+    parts = cfg.port_description.split(" ")
+    if len(parts) < 2:
+        raise ValueError(
+            f"interface '{name}' could not split string '{cfg.port_description}'"
+        )
+    cidr = parts[1]
+    try:
+        addr_s, mask_s = cidr.split("/")
+        peer_packed = socket.inet_aton(addr_s)
+        mask = int(mask_s)
+    except (ValueError, OSError) as e:
+        raise ValueError(
+            f"interface '{name}' could not parse '{cfg.port_description}': {e}"
+        ) from e
+    if mask != 30:
+        raise ValueError(
+            f"interface '{name}' mask is {mask}, not the expected 30"
+        )
+    (peer_int,) = struct.unpack("!I", peer_packed)
+    local = socket.inet_ntoa(struct.pack("!I", (peer_int & ~0x3) | ((peer_int & 0x3) ^ 0x3)))
+    return addr_s, local
+
+
+def lldp_results(configs: Dict[str, NetworkConfiguration]) -> bool:
+    """ref ``lldpResults()``: derive local /30 addrs; tolerate partial."""
+    found = False
+    for cfg in configs.values():
+        try:
+            peer, local = select_mask30_l3_address(cfg)
+        except ValueError as e:
+            log.warning("%s", e)
+            continue
+        cfg.lldp_peer = peer
+        cfg.local_addr = local
+        found = True
+    return found
+
+
+def interfaces_up(
+    configs: Dict[str, NetworkConfiguration], ops: nl.LinkOps,
+    timeout: float = 3.0,
+) -> None:
+    """ref ``interfacesUp()`` network.go:259-283: LinkSetUp + wait for the
+    kernel's link-update echo (3s budget)."""
+    to_wait = []
+    for cfg in configs.values():
+        if not cfg.link.is_up:
+            try:
+                ops.link_set_up(cfg.link)
+                cfg.expect_response = True
+                to_wait.append(cfg.link.name)
+            except nl.NetlinkError as e:
+                log.warning("cannot set link %r up: %s", cfg.link.name, e)
+    if to_wait:
+        with ops.subscribe() as sub:
+            sub.wait_for(to_wait, lambda link: link.is_up, timeout=timeout)
+    # refresh link state
+    for cfg in configs.values():
+        try:
+            cfg.link = ops.link_by_name(cfg.link.name)
+            cfg.expect_response = False
+        except nl.NetlinkError:
+            pass
+
+
+def interfaces_restore_down(
+    configs: Dict[str, NetworkConfiguration], ops: nl.LinkOps
+) -> None:
+    """ref ``interfacesRestoreDown()``: only downs links the agent
+    brought up (original state preserved)."""
+    for cfg in configs.values():
+        if not (cfg.orig_flags & nl.IFF_UP) and cfg.link.is_up:
+            try:
+                ops.link_set_down(cfg.link)
+                log.info("setting link %r back down", cfg.link.name)
+            except nl.NetlinkError as e:
+                log.warning(
+                    "cannot set link %r back down: %s", cfg.link.name, e
+                )
+
+
+def interfaces_set_mtu(
+    configs: Dict[str, NetworkConfiguration], ops: nl.LinkOps, mtu: int
+) -> None:
+    """ref ``interfacesSetMTU()`` network.go:381-388."""
+    for cfg in configs.values():
+        try:
+            ops.link_set_mtu(cfg.link, mtu)
+        except nl.NetlinkError as e:
+            log.warning(
+                "could not set MTU %d for %r: %s", mtu, cfg.link.name, e
+            )
+
+
+def remove_existing_ips(
+    configs: Dict[str, NetworkConfiguration], ops: nl.LinkOps
+) -> None:
+    """ref ``removeExistingIPs()``: fresh slate before (re)configuring."""
+    for cfg in configs.values():
+        for addr in ops.addr_list(cfg.link.index):
+            ops.addr_del(cfg.link, addr.cidr())
+
+
+def _network_addr(local: str, mask: int) -> str:
+    (i,) = struct.unpack("!I", socket.inet_aton(local))
+    i &= ~((1 << (32 - mask)) - 1)
+    return socket.inet_ntoa(struct.pack("!I", i))
+
+
+def add_route(
+    cfg: NetworkConfiguration, ops: nl.LinkOps, mask: int
+) -> None:
+    """ref ``addRoute()`` network.go:311-379: /30 on-link (kernel-style) or
+    /16 via the LLDP peer as gateway.  EEXIST tolerated."""
+    if cfg.local_addr is None:
+        raise ValueError(f"interface '{cfg.link.name}' has no local address")
+    dst = f"{_network_addr(cfg.local_addr, mask)}/{mask}"
+    route = nl.Route(dst=dst, oif=cfg.link.index)
+    if mask == ROUTE_MASK_ROUTED_NETWORK:
+        route.gateway = cfg.lldp_peer or ""
+    else:
+        route.scope = nl.RT_SCOPE_LINK
+    try:
+        ops.route_append(route)
+        log.info("configured route %s for %r", dst, cfg.link.name)
+    except nl.NetlinkError as e:
+        if e.errno == 17:   # EEXIST
+            log.info("route %s already exists for %r", dst, cfg.link.name)
+            return
+        log.warning("could not add route %s for %r: %s", dst, cfg.link.name, e)
+        raise
+
+
+def configure_interfaces(
+    configs: Dict[str, NetworkConfiguration], ops: nl.LinkOps
+) -> Tuple[int, int]:
+    """ref ``configureInterfaces()`` network.go:407-469: add the /30 (or
+    keep an existing correct one and re-ensure its route) + the /16; count
+    successes.  Partial LLDP responses are tolerated — unanswered ifaces are
+    skipped, the caller compares counts."""
+    configured = 0
+    log.info("configuring interfaces...")
+    for cfg in configs.values():
+        if cfg.local_addr is None:
+            continue
+        name = cfg.link.name
+        try:
+            addrs = ops.addr_list(cfg.link.index)
+        except nl.NetlinkError as e:
+            log.warning("could not get addresses for %r: %s", name, e)
+            continue
+
+        existing = any(a.address == cfg.local_addr for a in addrs)
+        if not existing:
+            try:
+                ops.addr_add(cfg.link, f"{cfg.local_addr}/30")
+                log.info(
+                    "configured address %s/30 for %r", cfg.local_addr, name
+                )
+            except nl.NetlinkError as e:
+                log.warning(
+                    "could not configure address %s for %r: %s",
+                    cfg.local_addr, name, e,
+                )
+                continue
+        else:
+            log.info("interface %r already configured, ensuring /30 route", name)
+            try:
+                add_route(cfg, ops, ROUTE_MASK_POINT_TO_POINT)
+            except (nl.NetlinkError, ValueError):
+                continue
+        try:
+            add_route(cfg, ops, ROUTE_MASK_ROUTED_NETWORK)
+        except (nl.NetlinkError, ValueError):
+            continue
+        configured += 1
+    return configured, len(configs)
+
+
+def log_results(
+    configs: Dict[str, NetworkConfiguration], ops: nl.LinkOps, l3: bool
+) -> None:
+    """ref ``logResults()`` network.go:175-213 (V(3) dump)."""
+    for cfg in configs.values():
+        addrs = " ".join(
+            a.cidr()
+            + ("(matches lldp)" if a.address == cfg.local_addr else "")
+            for a in ops.addr_list(cfg.link.index)
+        ) or "no addresses"
+        log.debug("interface %r: addresses: %s", cfg.link.name, addrs)
+        if l3:
+            log.debug(
+                "  peer MAC: %s  peer LLDP: %s  local /30: %s",
+                cfg.peer_hw_addr or "<none>",
+                cfg.lldp_peer or "<none>",
+                cfg.local_addr or "<none>",
+            )

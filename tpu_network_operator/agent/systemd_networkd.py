@@ -1,0 +1,89 @@
+"""systemd-networkd unit writer — config persistence across restarts.
+
+Rebuild of ref ``cmd/discover/systemd-networkd.go``: one ``.network`` unit
+per interface ([Match] MAC, [Network] /30 address, [Route] /16 network),
+all-or-nothing with rollback delete on partial failure.  This is the
+framework's "checkpoint" analog (SURVEY.md §5.4): addressing survives agent
+death and node reboots.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+from ..utils import write_atomic
+from .network import (
+    ROUTE_MASK_POINT_TO_POINT,
+    ROUTE_MASK_ROUTED_NETWORK,
+    NetworkConfiguration,
+    _network_addr,
+)
+
+SYSTEMD_NETWORKD_PATH = "/etc/systemd/network"
+
+
+def networkd_filename(networkd_path: str, ifname: str) -> str:
+    return os.path.join(networkd_path, ifname + ".network")
+
+
+def check_network_config(ifname: str, cfg: NetworkConfiguration) -> None:
+    """ref ``checkNetworkConfig()`` :34-47 — refuse partial state up front."""
+    if cfg.link is None:
+        raise ValueError(f"no link information for {ifname}")
+    if cfg.local_addr is None:
+        raise ValueError(f"no local address for {ifname}")
+    if not cfg.link.mac:
+        raise ValueError(f"no local hw address for {ifname}")
+
+
+def render_network(ifname: str, cfg: NetworkConfiguration) -> str:
+    """ref ``writeNetwork()`` :49-74 (format preserved)."""
+    network_addr = _network_addr(cfg.local_addr, ROUTE_MASK_ROUTED_NETWORK)
+    return (
+        "[Match]\n"
+        f"MACAddress={cfg.link.mac}\n"
+        "\n"
+        "[Network]\n"
+        f"Description=Networkd configuration for {ifname} created by "
+        "network-operator\n"
+        f"Address={cfg.local_addr}/{ROUTE_MASK_POINT_TO_POINT}\n"
+        "\n"
+        "[Route]\n"
+        f"Destination={network_addr}/{ROUTE_MASK_ROUTED_NETWORK}\n"
+    )
+
+
+def write_systemd_networkd(
+    networkd_path: str, configs: Dict[str, NetworkConfiguration]
+) -> List[str]:
+    """ref ``WriteSystemdNetworkd()`` :76-94: validate all, then write all;
+    any write failure rolls back the units already written."""
+    for ifname, cfg in configs.items():
+        check_network_config(ifname, cfg)
+
+    written: List[str] = []
+    for ifname, cfg in sorted(configs.items()):
+        try:
+            write_atomic(
+                networkd_filename(networkd_path, ifname),
+                render_network(ifname, cfg),
+            )
+        except OSError as e:
+            delete_systemd_networkd(networkd_path, written)
+            raise OSError(
+                f"could not write networkd config file for '{ifname}': {e}"
+            ) from e
+        written.append(ifname)
+    return written
+
+
+def delete_systemd_networkd(
+    networkd_path: str, interfaces: List[str]
+) -> None:
+    """ref ``DeleteSystemdNetworkd()`` :96-101."""
+    for ifname in interfaces:
+        try:
+            os.remove(networkd_filename(networkd_path, ifname))
+        except FileNotFoundError:
+            pass

@@ -104,6 +104,9 @@ class TpuTopology:
     # multislice (Megascale); single-slice => num_slices=1, slice_id=0
     num_slices: int = 1
     slice_id: int = 0
+    # multislice coordinator hint from the megascale attributes (threaded
+    # through so the bootstrap builder needn't re-query metadata)
+    megascale_coordinator: str = ""
     source: str = ""                    # "tpu-env" | "accelerator-type"
 
     def to_dict(self) -> Dict:
@@ -120,6 +123,7 @@ class TpuTopology:
             "worker_id": self.worker_id,
             "num_slices": self.num_slices,
             "slice_id": self.slice_id,
+            "megascale_coordinator": self.megascale_coordinator,
             "source": self.source,
         }
 
@@ -138,6 +142,7 @@ class TpuTopology:
             worker_id=d.get("worker_id", 0),
             num_slices=d.get("num_slices", 1),
             slice_id=d.get("slice_id", 0),
+            megascale_coordinator=d.get("megascale_coordinator", ""),
             source=d.get("source", ""),
         )
 
@@ -212,24 +217,37 @@ def from_accelerator_type(accel: str, worker_id: int = 0) -> TpuTopology:
 
 def discover(metadata_client, source: str = "auto") -> TpuTopology:
     """Full discovery: tpu-env when available, else accelerator-type;
-    megascale attributes fold in multislice placement."""
+    megascale attributes fold in multislice placement.
+
+    Each metadata attribute is fetched at most once per pass.  A multi-host
+    slice with no authoritative worker-id source is refused: silently
+    defaulting every host to worker 0 would give jax.distributed colliding
+    process ids (deadlock at initialize)."""
     topo: Optional[TpuTopology] = None
+    worker_id_authoritative = True
     if source in ("auto", "metadata"):
         try:
             env = metadata_client.tpu_env()
         except Exception:
             env = {}
+        awn = metadata_client.attribute_or("agent-worker-number", "").strip()
+        worker_hint = int(awn) if awn else None
         if env.get("ACCELERATOR_TYPE") or env.get("TOPOLOGY"):
+            accel_hint = env.get(
+                "ACCELERATOR_TYPE"
+            ) or metadata_client.attribute_or("accelerator-type", "")
             topo = from_tpu_env(
-                env,
-                accel_hint=metadata_client.attribute_or("accelerator-type", ""),
-                worker_id_hint=metadata_client.worker_number(),
+                env, accel_hint=accel_hint, worker_id_hint=worker_hint
+            )
+            worker_id_authoritative = (
+                "WORKER_ID" in env or worker_hint is not None
             )
         else:
-            accel = metadata_client.accelerator_type()
             topo = from_accelerator_type(
-                accel, worker_id=metadata_client.worker_number()
+                metadata_client.accelerator_type(),
+                worker_id=worker_hint or 0,
             )
+            worker_id_authoritative = worker_hint is not None
     elif source == "libtpu":
         topo = _from_libtpu()
     else:
@@ -239,6 +257,19 @@ def discover(metadata_client, source: str = "auto") -> TpuTopology:
     if ms:
         topo.num_slices = int(ms.get("megascale-num-slices", "1"))
         topo.slice_id = int(ms.get("megascale-slice-id", "0"))
+        topo.megascale_coordinator = ms.get(
+            "megascale-coordinator-address", ""
+        )
+
+    if (
+        topo.num_hosts * topo.num_slices > 1
+        and not worker_id_authoritative
+    ):
+        raise TopologyError(
+            f"{topo.accelerator_type}: multi-host slice but no worker-id "
+            "source (agent-worker-number / tpu-env WORKER_ID); refusing to "
+            "default every host to worker 0"
+        )
     return topo
 
 

@@ -1,0 +1,13 @@
+"""LLDP client: switch-cooperative L3 auto-addressing (L1, wire boundary).
+
+Rebuild of ref ``pkg/lldp/client.go`` (gopacket+libpcap via CGO): capture
+LLDP frames (EtherType 0x88cc) on scale-out interfaces, parse the TLVs, and
+hand the switch's port description to the /30 derivation.  Two capture
+backends: the C++ AF_PACKET+BPF core in ``native/`` (the reference's
+native-capture analog) via ctypes, and a pure-Python AF_PACKET fallback.
+The TLV parser and the frame *fabricator* (closing the reference's
+zero-test gap on this package, SURVEY.md §4 notes) are pure Python.
+"""
+
+from .frame import LldpFrame, build_lldp_frame, parse_lldp_frame  # noqa: F401
+from .client import DiscoveryResult, LldpClient, detect_lldp  # noqa: F401

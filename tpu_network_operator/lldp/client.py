@@ -1,0 +1,200 @@
+"""LLDP capture client.
+
+Rebuild of ref ``pkg/lldp/client.go:45-150``: per-interface capture with a
+BPF-style EtherType filter, ignore our own frames, return the first peer
+announcement or time out.  Capture backends:
+
+* ``native`` — the C++ AF_PACKET + classic-BPF core (``native/lldpcap``)
+  through ctypes: the analog of the reference's libpcap/CGO dependency.
+* ``python`` — pure-Python AF_PACKET raw socket (Linux ``socket`` module),
+  always available; used when the native lib is absent.
+
+``detect_lldp`` mirrors ``detectLLDP`` (ref ``cmd/discover/main.go:84-122``):
+one worker per interface, shared wait budget, partial results tolerated.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from .frame import LLDP_ETHERTYPE, LldpFrame, LldpParseError, parse_lldp_frame
+
+log = logging.getLogger("tpunet.lldp")
+
+ETH_P_ALL = 0x0003
+
+# packet(7) promiscuous membership
+SOL_PACKET = 263
+PACKET_ADD_MEMBERSHIP = 1
+PACKET_MR_PROMISC = 1
+
+
+@dataclass
+class DiscoveryResult:
+    """ref ``DiscoveryResult`` client.go:52-60."""
+
+    interface_name: str
+    peer_mac: str = ""
+    port_description: str = ""
+    sys_name: str = ""
+    sys_description: str = ""
+
+
+def _native_lib_path() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+    return os.environ.get(
+        "TPUNET_LLDPCAP_LIB", os.path.join(here, "native", "liblldpcap.so")
+    )
+
+
+class _NativeCapture:
+    """ctypes binding to native/lldpcap.cpp (AF_PACKET + classic BPF)."""
+
+    def __init__(self, ifname: str):
+        self.lib = ctypes.CDLL(_native_lib_path())
+        self.lib.lldpcap_open.restype = ctypes.c_int
+        self.lib.lldpcap_open.argtypes = [ctypes.c_char_p]
+        self.lib.lldpcap_next.restype = ctypes.c_int
+        self.lib.lldpcap_next.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ]
+        self.lib.lldpcap_close.argtypes = [ctypes.c_int]
+        self.fd = self.lib.lldpcap_open(ifname.encode())
+        if self.fd < 0:
+            raise OSError(f"lldpcap_open({ifname}) failed: {-self.fd}")
+
+    def next_frame(self, timeout_ms: int) -> Optional[bytes]:
+        buf = ctypes.create_string_buffer(4096)
+        n = self.lib.lldpcap_next(self.fd, buf, len(buf), timeout_ms)
+        if n < 0:
+            raise OSError(f"lldpcap_next failed: {-n}")
+        return buf.raw[:n] if n else None
+
+    def close(self) -> None:
+        self.lib.lldpcap_close(self.fd)
+
+
+class _PythonCapture:
+    """AF_PACKET raw socket, EtherType-filtered in userspace."""
+
+    def __init__(self, ifname: str):
+        self.sock = socket.socket(
+            socket.AF_PACKET, socket.SOCK_RAW, socket.htons(ETH_P_ALL)
+        )
+        self.sock.bind((ifname, 0))
+        idx = socket.if_nametoindex(ifname)
+        mreq = struct.pack("@iHH8s", idx, PACKET_MR_PROMISC, 0, b"")
+        self.sock.setsockopt(SOL_PACKET, PACKET_ADD_MEMBERSHIP, mreq)
+
+    def next_frame(self, timeout_ms: int) -> Optional[bytes]:
+        self.sock.settimeout(timeout_ms / 1000.0)
+        try:
+            data = self.sock.recv(4096)
+        except (TimeoutError, socket.timeout):
+            return None
+        if len(data) >= 14 and struct.unpack_from("!H", data, 12)[0] == LLDP_ETHERTYPE:
+            return data
+        return b""   # non-LLDP frame: caller keeps polling
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+def _make_capture(ifname: str, backend: str):
+    if backend == "native":
+        return _NativeCapture(ifname)
+    if backend == "python":
+        return _PythonCapture(ifname)
+    # auto: native when built, else python
+    try:
+        return _NativeCapture(ifname)
+    except OSError:
+        return _PythonCapture(ifname)
+
+
+class LldpClient:
+    """ref ``Client``/``Start()`` client.go:45-150: capture until the first
+    foreign LLDP frame on the interface or deadline."""
+
+    def __init__(
+        self, ifname: str, own_mac: str, backend: str = "auto",
+    ):
+        self.ifname = ifname
+        self.own_mac = own_mac.lower()
+        self.backend = backend
+
+    def capture_one(self, deadline: float) -> Optional[LldpFrame]:
+        cap = _make_capture(self.ifname, self.backend)
+        try:
+            while time.monotonic() < deadline:
+                budget_ms = max(
+                    1, int((deadline - time.monotonic()) * 1000)
+                )
+                raw = cap.next_frame(min(budget_ms, 250))
+                if not raw:
+                    continue
+                try:
+                    frame = parse_lldp_frame(raw)
+                except LldpParseError:
+                    continue
+                if frame.source_mac.lower() == self.own_mac:
+                    continue   # ignore our own announcements (client.go:118)
+                return frame
+            return None
+        finally:
+            cap.close()
+
+
+def detect_lldp(
+    interfaces: Dict[str, str],
+    wait_seconds: float,
+    backend: str = "auto",
+    client_factory: Optional[Callable[..., LldpClient]] = None,
+) -> List[DiscoveryResult]:
+    """Per-interface worker threads with one shared deadline
+    (ref ``detectLLDP`` main.go:84-122).  ``interfaces`` maps name → own MAC.
+    Partial results are returned; missing interfaces simply have none."""
+    client_factory = client_factory or LldpClient
+    deadline = time.monotonic() + wait_seconds
+    results: List[DiscoveryResult] = []
+    lock = threading.Lock()
+
+    def worker(name: str, mac: str) -> None:
+        try:
+            frame = client_factory(name, mac, backend=backend).capture_one(
+                deadline
+            )
+        except OSError as e:
+            log.info("cannot start LLDP client on %r: %s", name, e)
+            return
+        if frame is None:
+            log.info("no LLDP frame on %r within budget", name)
+            return
+        with lock:
+            results.append(
+                DiscoveryResult(
+                    interface_name=name,
+                    peer_mac=frame.port_mac or frame.source_mac,
+                    port_description=frame.port_description,
+                    sys_name=frame.sys_name,
+                    sys_description=frame.sys_description,
+                )
+            )
+
+    threads = []
+    for n, m in interfaces.items():
+        t = threading.Thread(target=worker, args=(n, m), daemon=True)
+        t.start()
+        threads.append(t)
+        log.info("started LLDP discovery for %r...", n)
+    for t in threads:
+        t.join(timeout=wait_seconds + 1)
+    return results

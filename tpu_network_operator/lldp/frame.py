@@ -1,0 +1,137 @@
+"""LLDP frame encode/decode (IEEE 802.1AB TLVs).
+
+The parse side mirrors what the reference extracts with gopacket
+(ref ``pkg/lldp/client.go:99-144``): ChassisID/PortID MAC subtypes,
+SysName, SysDescription, PortDescription.  The build side is the frame
+fabricator the reference never had — tests synthesize switch announcements
+byte-for-byte instead of needing a ToR switch.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Optional
+
+LLDP_ETHERTYPE = 0x88CC
+LLDP_MCAST = "01:80:c2:00:00:0e"
+
+# TLV types (802.1AB §8.4)
+TLV_END = 0
+TLV_CHASSIS_ID = 1
+TLV_PORT_ID = 2
+TLV_TTL = 3
+TLV_PORT_DESCRIPTION = 4
+TLV_SYS_NAME = 5
+TLV_SYS_DESCRIPTION = 6
+
+CHASSIS_SUBTYPE_MAC = 4
+PORT_SUBTYPE_MAC = 3
+
+
+def _mac_str(raw: bytes) -> str:
+    return ":".join(f"{b:02x}" for b in raw)
+
+
+def _mac_bytes(mac: str) -> bytes:
+    return bytes(int(x, 16) for x in mac.split(":"))
+
+
+@dataclass
+class LldpFrame:
+    """Parsed announcement (ref ``DiscoveryResult`` fields client.go:52-60)."""
+
+    source_mac: str = ""
+    chassis_mac: str = ""
+    port_mac: str = ""
+    ttl: int = 0
+    port_description: str = ""
+    sys_name: str = ""
+    sys_description: str = ""
+
+
+class LldpParseError(Exception):
+    pass
+
+
+def parse_lldp_frame(data: bytes) -> LldpFrame:
+    """Parse an Ethernet frame carrying LLDP; raises on non-LLDP."""
+    if len(data) < 14:
+        raise LldpParseError("frame too short")
+    ethertype = struct.unpack_from("!H", data, 12)[0]
+    off = 14
+    if ethertype == 0x8100:   # single VLAN tag
+        if len(data) < 18:
+            raise LldpParseError("frame too short (vlan)")
+        ethertype = struct.unpack_from("!H", data, 16)[0]
+        off = 18
+    if ethertype != LLDP_ETHERTYPE:
+        raise LldpParseError(f"not LLDP (ethertype 0x{ethertype:04x})")
+
+    frame = LldpFrame(source_mac=_mac_str(data[6:12]))
+    while off + 2 <= len(data):
+        hdr = struct.unpack_from("!H", data, off)[0]
+        tlv_type = hdr >> 9
+        tlv_len = hdr & 0x1FF
+        off += 2
+        payload = data[off : off + tlv_len]
+        if len(payload) < tlv_len:
+            raise LldpParseError("truncated TLV")
+        off += tlv_len
+
+        if tlv_type == TLV_END:
+            break
+        if tlv_type == TLV_CHASSIS_ID and payload[:1] == bytes(
+            [CHASSIS_SUBTYPE_MAC]
+        ):
+            frame.chassis_mac = _mac_str(payload[1:7])
+        elif tlv_type == TLV_PORT_ID and payload[:1] == bytes(
+            [PORT_SUBTYPE_MAC]
+        ):
+            frame.port_mac = _mac_str(payload[1:7])
+        elif tlv_type == TLV_TTL and tlv_len >= 2:
+            frame.ttl = struct.unpack("!H", payload[:2])[0]
+        elif tlv_type == TLV_PORT_DESCRIPTION:
+            frame.port_description = payload.decode(errors="replace")
+        elif tlv_type == TLV_SYS_NAME:
+            frame.sys_name = payload.decode(errors="replace")
+        elif tlv_type == TLV_SYS_DESCRIPTION:
+            frame.sys_description = payload.decode(errors="replace")
+    return frame
+
+
+def _tlv(tlv_type: int, payload: bytes) -> bytes:
+    if len(payload) > 0x1FF:
+        raise ValueError("TLV payload too long")
+    return struct.pack("!H", (tlv_type << 9) | len(payload)) + payload
+
+
+def build_lldp_frame(
+    source_mac: str,
+    port_description: str,
+    *,
+    dest_mac: str = LLDP_MCAST,
+    chassis_mac: Optional[str] = None,
+    port_mac: Optional[str] = None,
+    sys_name: str = "fab-switch",
+    sys_description: str = "test fabric switch",
+    ttl: int = 120,
+) -> bytes:
+    """Fabricate a switch announcement (test rig; no reference analog)."""
+    chassis = chassis_mac or source_mac
+    port = port_mac or source_mac
+    body = (
+        _tlv(TLV_CHASSIS_ID, bytes([CHASSIS_SUBTYPE_MAC]) + _mac_bytes(chassis))
+        + _tlv(TLV_PORT_ID, bytes([PORT_SUBTYPE_MAC]) + _mac_bytes(port))
+        + _tlv(TLV_TTL, struct.pack("!H", ttl))
+        + _tlv(TLV_PORT_DESCRIPTION, port_description.encode())
+        + _tlv(TLV_SYS_NAME, sys_name.encode())
+        + _tlv(TLV_SYS_DESCRIPTION, sys_description.encode())
+        + _tlv(TLV_END, b"")
+    )
+    return (
+        _mac_bytes(dest_mac)
+        + _mac_bytes(source_mac)
+        + struct.pack("!H", LLDP_ETHERTYPE)
+        + body
+    )

@@ -1,0 +1,10 @@
+"""Model zoo for the validation workload (flagship: Llama-3 family)."""
+
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_train_step,
+    param_shardings,
+)

@@ -1,0 +1,279 @@
+"""Llama-3-family transformer, pure-JAX, TPU-first.
+
+The flagship validation workload: the model the benchmark harness runs on
+operator-provisioned slices (BASELINE.md metric "Llama-3-8B tokens/sec/chip").
+
+TPU-first choices:
+
+* layers are *stacked* (one leading layer axis per parameter) and executed
+  with ``lax.scan`` — one compiled layer body regardless of depth;
+* bf16 activations/params, f32 softmax and norm accumulations (MXU-friendly);
+* sharding is declarative: :func:`param_shardings` maps every parameter to a
+  ``PartitionSpec`` over the (data, fsdp, seq, tensor) mesh axes —
+  Megatron-style tensor splits on head/ffn dims, fsdp on the complementary
+  dim; XLA inserts the ICI collectives;
+* ``jax.checkpoint`` on the layer body trades FLOPs for HBM (remat).
+
+No torch, no reference code: this is the JAX answer to the workload the
+reference's network exists to serve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import causal_attention
+from ..ops.norms import rms_norm
+from ..ops.rope import apply_rope, rope_angles
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    hidden: int = 4096
+    layers: int = 32
+    heads: int = 32
+    kv_heads: int = 8
+    ffn: int = 14_336
+    max_seq: int = 8192
+    rope_theta: float = 500_000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    # long-context: shard activations along seq mesh axis + ring attention
+    seq_parallel: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+    def num_params(self) -> int:
+        """Exact parameter count (embeddings + untied head included)."""
+        per_layer = (
+            self.hidden * (self.heads + 2 * self.kv_heads) * self.head_dim
+            + self.heads * self.head_dim * self.hidden
+            + 3 * self.hidden * self.ffn
+            + 2 * self.hidden
+        )
+        return (
+            2 * self.vocab_size * self.hidden
+            + self.layers * per_layer
+            + self.hidden
+        )
+
+    # -- presets ------------------------------------------------------------
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def llama3_1b() -> "LlamaConfig":
+        # Llama-3.2-1B geometry
+        return LlamaConfig(
+            hidden=2048, layers=16, heads=32, kv_heads=8, ffn=8192
+        )
+
+    @staticmethod
+    def llama3_3b() -> "LlamaConfig":
+        # Llama-3.2-3B geometry
+        return LlamaConfig(
+            hidden=3072, layers=28, heads=24, kv_heads=8, ffn=8192
+        )
+
+    @staticmethod
+    def tiny(vocab: int = 256) -> "LlamaConfig":
+        """Test/dryrun config: small but structurally identical."""
+        return LlamaConfig(
+            vocab_size=vocab, hidden=64, layers=2, heads=4, kv_heads=2,
+            ffn=128, max_seq=128, remat=False,
+        )
+
+
+# -- parameters ---------------------------------------------------------------
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
+    """Stacked-layer parameter pytree, truncated-normal init."""
+    keys = jax.random.split(key, 10)
+    h, hd, ffn, L = cfg.hidden, cfg.head_dim, cfg.ffn, cfg.layers
+    dt = cfg.dtype
+
+    def init(k, shape, fan_in):
+        return (
+            jax.random.truncated_normal(k, -3, 3, shape, jnp.float32)
+            * (1.0 / math.sqrt(fan_in))
+        ).astype(dt)
+
+    return {
+        "embed": init(keys[0], (cfg.vocab_size, h), h),
+        "layers": {
+            "wq": init(keys[1], (L, h, cfg.heads * hd), h),
+            "wk": init(keys[2], (L, h, cfg.kv_heads * hd), h),
+            "wv": init(keys[3], (L, h, cfg.kv_heads * hd), h),
+            "wo": init(keys[4], (L, cfg.heads * hd, h), cfg.heads * hd),
+            "w_gate": init(keys[5], (L, h, ffn), h),
+            "w_up": init(keys[6], (L, h, ffn), h),
+            "w_down": init(keys[7], (L, ffn, h), ffn),
+            "ln_attn": jnp.ones((L, h), dt),
+            "ln_mlp": jnp.ones((L, h), dt),
+        },
+        "ln_final": jnp.ones((h,), dt),
+        "lm_head": init(keys[8], (h, cfg.vocab_size), h),
+    }
+
+
+def param_specs(cfg: LlamaConfig) -> Params:
+    """PartitionSpecs, same tree shape as params.
+
+    Tensor parallelism on the head/ffn dims, fsdp on the complementary dim;
+    the leading stacked-layer axis is never sharded (scan carries it).
+    """
+    return {
+        "embed": P("fsdp", "tensor"),
+        "layers": {
+            "wq": P(None, "fsdp", "tensor"),
+            "wk": P(None, "fsdp", "tensor"),
+            "wv": P(None, "fsdp", "tensor"),
+            "wo": P(None, "tensor", "fsdp"),
+            "w_gate": P(None, "fsdp", "tensor"),
+            "w_up": P(None, "fsdp", "tensor"),
+            "w_down": P(None, "tensor", "fsdp"),
+            "ln_attn": P(None, None),
+            "ln_mlp": P(None, None),
+        },
+        "ln_final": P(None),
+        "lm_head": P("fsdp", "tensor"),
+    }
+
+
+def param_shardings(cfg: LlamaConfig, mesh: Mesh) -> Params:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def _activation_spec(cfg: LlamaConfig) -> P:
+    """[batch, seq, hidden]: batch over data(+fsdp), seq over seq axis when
+    sequence parallelism is on."""
+    return P(("data", "fsdp"), "seq" if cfg.seq_parallel else None, None)
+
+
+# -- forward ------------------------------------------------------------------
+
+
+def _layer(cfg: LlamaConfig, cos, sin, x, lp, attn_fn):
+    """One transformer block.  x: [B, S, H]; lp: this layer's params."""
+    # attention
+    y = rms_norm(x, lp["ln_attn"], cfg.rms_eps)
+    b, s, _ = y.shape
+    q = (y @ lp["wq"]).reshape(b, s, cfg.heads, cfg.head_dim)
+    k = (y @ lp["wk"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+    v = (y @ lp["wv"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    a = attn_fn(q, k, v)
+    x = x + a.reshape(b, s, -1) @ lp["wo"]
+
+    # mlp (SwiGLU)
+    y = rms_norm(x, lp["ln_mlp"], cfg.rms_eps)
+    gated = jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"])
+    return x + gated @ lp["w_down"]
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,              # [B, S] int32
+    cfg: LlamaConfig,
+    attn_fn: Optional[Callable] = None,
+) -> jnp.ndarray:
+    """Logits [B, S, vocab].  ``attn_fn`` defaults to the fused causal
+    attention; the ring-attention path passes its own (see parallel/ring)."""
+    attn_fn = attn_fn or causal_attention
+    x = params["embed"][tokens].astype(cfg.dtype)
+    # activation layout (batch over data+fsdp, optional seq sharding) is
+    # pinned by the jit in/out shardings; XLA propagates it through the scan
+
+    cos, sin = rope_angles(tokens.shape[1], cfg.head_dim, cfg.rope_theta)
+
+    def block(x, lp):
+        return _layer(cfg, cos, sin, x, lp, attn_fn)
+
+    if cfg.remat:
+        # full remat of the layer body: recompute in backward, keep HBM flat
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.nothing_saveable
+        )
+
+    x, _ = jax.lax.scan(lambda x, lp: (block(x, lp), None), x, params["layers"])
+    x = rms_norm(x, params["ln_final"], cfg.rms_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(
+    params: Params,
+    tokens: jnp.ndarray,               # [B, S+1]
+    cfg: LlamaConfig,
+    attn_fn: Optional[Callable] = None,
+) -> jnp.ndarray:
+    """Next-token cross entropy over [B, S]."""
+    logits = forward(params, tokens[:, :-1], cfg, attn_fn)
+    targets = tokens[:, 1:]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1
+    )[..., 0]
+    return jnp.mean(logz - gold)
+
+
+# -- training -----------------------------------------------------------------
+
+
+def make_train_step(
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    optimizer=None,
+    attn_fn: Optional[Callable] = None,
+):
+    """Jitted (params, opt_state, tokens) -> (params, opt_state, loss) with
+    full sharding annotations over the mesh."""
+    import optax
+
+    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.1)
+    p_shard = param_shardings(cfg, mesh)
+    tok_shard = NamedSharding(mesh, P(("data", "fsdp"), None))
+    repl = NamedSharding(mesh, P())
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, attn_fn)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    step_jit = jax.jit(
+        step,
+        in_shardings=(p_shard, None, tok_shard),
+        out_shardings=(p_shard, None, repl),
+        donate_argnums=(0, 1),
+    )
+
+    def init_all(key):
+        params = jax.jit(
+            partial(init_params, cfg=cfg), out_shardings=p_shard
+        )(key)
+        opt_state = optimizer.init(params)
+        return params, opt_state
+
+    return step_jit, init_all, optimizer

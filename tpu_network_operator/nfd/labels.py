@@ -17,8 +17,11 @@ from ..utils import write_atomic
 NFD_FEATURES_DIR = "/etc/kubernetes/node-feature-discovery/features.d"
 NFD_FILE_NAME = "scale-out-readiness.txt"
 
-GAUDI_READY_LABEL = "tpunet.dev/gaudi-scale-out=true"
-TPU_READY_LABEL = "tpunet.dev/tpu-scale-out=true"
+# Vendor subdomain of feature.node.kubernetes.io: NFD's default deny-label-ns
+# drops any other namespace silently (the reference uses
+# intel.feature.node.kubernetes.io for the same reason, main.go:45).
+GAUDI_READY_LABEL = "tpunet.feature.node.kubernetes.io/gaudi-scale-out=true"
+TPU_READY_LABEL = "tpunet.feature.node.kubernetes.io/tpu-scale-out=true"
 
 
 def features_dir(root: str = "") -> str:

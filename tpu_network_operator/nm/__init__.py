@@ -1,0 +1,6 @@
+"""NetworkManager opt-out (ref ``internal/nm/networkmanager.go``)."""
+
+from .networkmanager import (  # noqa: F401
+    NetworkManagerClient,
+    disable_network_manager_for_interfaces,
+)

@@ -1,0 +1,317 @@
+"""Minimal D-Bus wire client (system bus), from scratch.
+
+The reference opts interfaces out of NetworkManager over D-Bus via the
+``gonetworkmanager`` library (ref ``internal/nm/networkmanager.go:22``);
+no D-Bus binding exists in this environment, so this module implements the
+small wire-protocol subset the agent needs: EXTERNAL auth, Hello, method
+calls with (s)/(ssv) signatures, and replies carrying object paths,
+booleans and variants.
+
+Marshaling follows the D-Bus specification (little-endian, natural
+alignment; arrays = u32 byte-length + aligned elements; variants =
+signature + value).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+SYSTEM_BUS_PATH = "/var/run/dbus/system_bus_socket"
+
+MSG_METHOD_CALL = 1
+MSG_METHOD_RETURN = 2
+MSG_ERROR = 3
+
+FIELD_PATH = 1
+FIELD_INTERFACE = 2
+FIELD_MEMBER = 3
+FIELD_ERROR_NAME = 4
+FIELD_REPLY_SERIAL = 5
+FIELD_DESTINATION = 6
+FIELD_SENDER = 7
+FIELD_SIGNATURE = 8
+
+
+class DBusError(Exception):
+    pass
+
+
+def _pad(buf: bytearray, align: int) -> None:
+    while len(buf) % align:
+        buf.append(0)
+
+
+class Marshaller:
+    def __init__(self):
+        self.buf = bytearray()
+
+    def u32(self, v: int) -> "Marshaller":
+        _pad(self.buf, 4)
+        self.buf += struct.pack("<I", v)
+        return self
+
+    def boolean(self, v: bool) -> "Marshaller":
+        return self.u32(1 if v else 0)
+
+    def string(self, s: str) -> "Marshaller":
+        raw = s.encode()
+        self.u32(len(raw))
+        self.buf += raw + b"\x00"
+        return self
+
+    def object_path(self, s: str) -> "Marshaller":
+        return self.string(s)
+
+    def signature(self, s: str) -> "Marshaller":
+        raw = s.encode()
+        self.buf.append(len(raw))
+        self.buf += raw + b"\x00"
+        return self
+
+    def variant(self, sig: str, value: Any) -> "Marshaller":
+        self.signature(sig)
+        if sig == "b":
+            self.boolean(value)
+        elif sig == "s":
+            self.string(value)
+        elif sig == "o":
+            self.object_path(value)
+        elif sig == "u":
+            self.u32(value)
+        else:
+            raise DBusError(f"unsupported variant signature {sig!r}")
+        return self
+
+
+class Unmarshaller:
+    def __init__(self, data: bytes, offset: int = 0):
+        self.data = data
+        self.off = offset
+
+    def _align(self, n: int) -> None:
+        self.off = (self.off + n - 1) & ~(n - 1)
+
+    def byte(self) -> int:
+        v = self.data[self.off]
+        self.off += 1
+        return v
+
+    def u32(self) -> int:
+        self._align(4)
+        (v,) = struct.unpack_from("<I", self.data, self.off)
+        self.off += 4
+        return v
+
+    def boolean(self) -> bool:
+        return self.u32() != 0
+
+    def string(self) -> str:
+        n = self.u32()
+        v = self.data[self.off : self.off + n].decode()
+        self.off += n + 1
+        return v
+
+    def signature(self) -> str:
+        n = self.byte()
+        v = self.data[self.off : self.off + n].decode()
+        self.off += n + 1
+        return v
+
+    def variant(self) -> Tuple[str, Any]:
+        sig = self.signature()
+        if sig == "b":
+            return sig, self.boolean()
+        if sig in ("s", "o"):
+            return sig, self.string()
+        if sig == "u":
+            return sig, self.u32()
+        if sig == "g":
+            return sig, self.signature()
+        raise DBusError(f"unsupported variant signature {sig!r}")
+
+
+def marshal_body(signature: str, args: List[Any]) -> bytes:
+    m = Marshaller()
+    i = 0
+    for ch in signature:
+        if ch == "s":
+            m.string(args[i])
+        elif ch == "o":
+            m.object_path(args[i])
+        elif ch == "b":
+            m.boolean(args[i])
+        elif ch == "v":
+            sig, val = args[i]
+            m.variant(sig, val)
+        else:
+            raise DBusError(f"unsupported arg signature {ch!r}")
+        i += 1
+    return bytes(m.buf)
+
+
+def unmarshal_body(signature: str, data: bytes) -> List[Any]:
+    u = Unmarshaller(data)
+    out: List[Any] = []
+    for ch in signature:
+        if ch in ("s", "o"):
+            out.append(u.string())
+        elif ch == "b":
+            out.append(u.boolean())
+        elif ch == "u":
+            out.append(u.u32())
+        elif ch == "v":
+            out.append(u.variant())
+        else:
+            raise DBusError(f"unsupported reply signature {ch!r}")
+    return out
+
+
+def build_method_call(
+    serial: int,
+    destination: str,
+    path: str,
+    interface: str,
+    member: str,
+    signature: str = "",
+    args: Optional[List[Any]] = None,
+) -> bytes:
+    body = marshal_body(signature, args or []) if signature else b""
+
+    # All header fields are marshalled into ONE buffer: the fields array
+    # begins at absolute offset 16 (≡ 0 mod 8), so padding computed against
+    # this buffer equals absolute alignment — padding a variant in its own
+    # sub-buffer would misalign it inside the message.
+    fields = bytearray()
+
+    def field(code: int, sig: str, value: Any) -> None:
+        _pad(fields, 8)   # array elements are (yv) structs, 8-aligned
+        fields.append(code)
+        # inline variant: signature then value, aligned in-place
+        fields.append(len(sig))
+        fields.extend(sig.encode() + b"\x00")
+        if sig in ("s", "o"):
+            _pad(fields, 4)
+            raw = value.encode()
+            fields.extend(struct.pack("<I", len(raw)) + raw + b"\x00")
+        elif sig == "g":
+            fields.append(len(value))
+            fields.extend(value.encode() + b"\x00")
+        else:
+            raise DBusError(f"unsupported header field signature {sig!r}")
+
+    field(FIELD_PATH, "o", path)
+    field(FIELD_INTERFACE, "s", interface)
+    field(FIELD_MEMBER, "s", member)
+    field(FIELD_DESTINATION, "s", destination)
+    if signature:
+        field(FIELD_SIGNATURE, "g", signature)
+
+    hdr = bytearray()
+    hdr += b"l"                                   # little endian
+    hdr.append(MSG_METHOD_CALL)
+    hdr.append(0)                                 # flags
+    hdr.append(1)                                 # protocol version
+    hdr += struct.pack("<I", len(body))
+    hdr += struct.pack("<I", serial)
+    hdr += struct.pack("<I", len(fields))
+    hdr += fields
+    _pad(hdr, 8)
+    return bytes(hdr) + body
+
+
+def parse_message(data: bytes) -> Tuple[int, dict, bytes, int]:
+    """Returns (msg_type, fields, body, total_length)."""
+    if len(data) < 16:
+        raise DBusError("short header")
+    if data[0:1] != b"l":
+        raise DBusError("big-endian peer not supported")
+    msg_type = data[1]
+    (body_len,) = struct.unpack_from("<I", data, 4)
+    (fields_len,) = struct.unpack_from("<I", data, 12)
+    fields_end = 16 + fields_len
+    header_end = (fields_end + 7) & ~7
+    total = header_end + body_len
+    if len(data) < total:
+        raise DBusError("incomplete message")
+
+    fields = {}
+    u = Unmarshaller(data, 16)
+    while u.off < fields_end:
+        u._align(8)
+        if u.off >= fields_end:
+            break
+        code = u.byte()
+        _, value = u.variant()
+        fields[code] = value
+    return msg_type, fields, data[header_end:total], total
+
+
+class DBusConnection:
+    """System-bus connection: EXTERNAL auth + Hello + blocking calls."""
+
+    def __init__(self, bus_path: str = ""):
+        path = bus_path or os.environ.get(
+            "TPUNET_DBUS_SOCKET", SYSTEM_BUS_PATH
+        )
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.settimeout(5.0)
+        self.sock.connect(path)
+        self._serial = 0
+        self._auth()
+        self.unique_name = self.call(
+            "org.freedesktop.DBus", "/org/freedesktop/DBus",
+            "org.freedesktop.DBus", "Hello", reply_signature="s",
+        )[0]
+
+    def _auth(self) -> None:
+        uid_hex = str(os.getuid()).encode().hex().encode()
+        self.sock.sendall(b"\x00AUTH EXTERNAL " + uid_hex + b"\r\n")
+        resp = self.sock.recv(512)
+        if not resp.startswith(b"OK"):
+            raise DBusError(f"auth failed: {resp!r}")
+        self.sock.sendall(b"BEGIN\r\n")
+
+    def close(self) -> None:
+        self.sock.close()
+
+    def call(
+        self,
+        destination: str,
+        path: str,
+        interface: str,
+        member: str,
+        signature: str = "",
+        args: Optional[List[Any]] = None,
+        reply_signature: str = "",
+    ) -> List[Any]:
+        self._serial += 1
+        self.sock.sendall(
+            build_method_call(
+                self._serial, destination, path, interface, member,
+                signature, args,
+            )
+        )
+        buf = b""
+        while True:
+            buf += self.sock.recv(65536)
+            try:
+                while buf:
+                    msg_type, fields, body, total = parse_message(buf)
+                    buf = buf[total:]
+                    if fields.get(FIELD_REPLY_SERIAL) != self._serial:
+                        continue   # signals / unrelated replies
+                    if msg_type == MSG_ERROR:
+                        raise DBusError(
+                            fields.get(FIELD_ERROR_NAME, "unknown dbus error")
+                        )
+                    if msg_type == MSG_METHOD_RETURN:
+                        sig = fields.get(FIELD_SIGNATURE, reply_signature)
+                        return unmarshal_body(sig, body) if sig else []
+            except DBusError as e:
+                if "incomplete" in str(e) or "short" in str(e):
+                    continue
+                raise

@@ -1,0 +1,86 @@
+"""NetworkManager opt-out over D-Bus.
+
+Rebuild of ref ``internal/nm/networkmanager.go:79-110``: for each scale-out
+interface, resolve the NM device object and set ``Managed=false`` so host
+NetworkManager stops fighting the agent's addressing.  NM absence is
+tolerated (a node may not run NM at all) — mirrored by returning quietly
+when the bus or the NM name is unreachable.
+
+Seams mirror the reference's ``NetworkManagerIf``/``DeviceWrapperIf``
+interfaces (:26-34): tests inject a fake client.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from .dbus import DBusConnection, DBusError
+
+log = logging.getLogger("tpunet.nm")
+
+NM_NAME = "org.freedesktop.NetworkManager"
+NM_PATH = "/org/freedesktop/NetworkManager"
+NM_IFACE = "org.freedesktop.NetworkManager"
+NM_DEVICE_IFACE = "org.freedesktop.NetworkManager.Device"
+PROPS_IFACE = "org.freedesktop.DBus.Properties"
+
+
+class NetworkManagerClient:
+    """Typed wrapper over the raw bus (ref ``NetworkManagerIf`` seam)."""
+
+    def __init__(self, conn: Optional[DBusConnection] = None):
+        self.conn = conn or DBusConnection()
+
+    def get_device_by_ip_iface(self, ifname: str) -> str:
+        out = self.conn.call(
+            NM_NAME, NM_PATH, NM_IFACE, "GetDeviceByIpIface",
+            signature="s", args=[ifname], reply_signature="o",
+        )
+        return out[0]
+
+    def get_managed(self, device_path: str) -> bool:
+        out = self.conn.call(
+            NM_NAME, device_path, PROPS_IFACE, "Get",
+            signature="ss", args=[NM_DEVICE_IFACE, "Managed"],
+            reply_signature="v",
+        )
+        return bool(out[0][1])
+
+    def set_managed(self, device_path: str, managed: bool) -> None:
+        self.conn.call(
+            NM_NAME, device_path, PROPS_IFACE, "Set",
+            signature="ssv",
+            args=[NM_DEVICE_IFACE, "Managed", ("b", managed)],
+        )
+
+    def close(self) -> None:
+        self.conn.close()
+
+
+def disable_network_manager_for_interfaces(
+    interfaces: List[str], client: Optional[NetworkManagerClient] = None
+) -> List[str]:
+    """ref ``DisableNetworkManagerForInterfaces()`` :79-110.
+
+    Returns the interfaces actually detached.  NM absence (no bus socket,
+    name not activatable) is tolerated; per-device failures are logged and
+    skipped, the rest proceed."""
+    if client is None:
+        try:
+            client = NetworkManagerClient()
+        except (OSError, DBusError) as e:
+            log.info("NetworkManager not reachable (%s); nothing to disable", e)
+            return []
+
+    disabled: List[str] = []
+    for ifname in interfaces:
+        try:
+            dev = client.get_device_by_ip_iface(ifname)
+            if client.get_managed(dev):
+                client.set_managed(dev, False)
+                log.info("disabled NetworkManager for %r", ifname)
+            disabled.append(ifname)
+        except DBusError as e:
+            log.warning("could not disable NM for %r: %s", ifname, e)
+    return disabled

@@ -1,0 +1,10 @@
+"""TPU-first neural net ops for the validation workload.
+
+Shapes stay static, control flow stays structural (scan/cond), elementwise
+work is left for XLA to fuse into the surrounding matmuls — the MXU/HBM
+rules of the TPU playbook.
+"""
+
+from .norms import rms_norm  # noqa: F401
+from .rope import apply_rope, rope_angles  # noqa: F401
+from .attention import causal_attention  # noqa: F401

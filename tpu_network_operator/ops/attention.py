@@ -1,0 +1,65 @@
+"""Attention ops.
+
+``causal_attention`` is the plain XLA path: one fused softmax(QKᵀ)V with a
+causal mask, GQA-aware.  XLA tiles the two matmuls onto the MXU; for the
+long-context path see :mod:`tpu_network_operator.parallel.ring` (ring
+attention over the ``seq`` mesh axis) and the pallas flash kernel in
+:mod:`tpu_network_operator.ops.pallas_attention` (when available).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """GQA: expand kv heads to match query heads.
+    [B, S, kvH, D] -> [B, S, kvH*n_rep, D]."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(
+        k[:, :, :, None, :], (b, s, h, n_rep, d)
+    ).reshape(b, s, h * n_rep, d)
+
+
+def causal_attention(
+    q: jnp.ndarray,                    # [B, Sq, H, D]
+    k: jnp.ndarray,                    # [B, Sk, Hkv, D]
+    v: jnp.ndarray,                    # [B, Sk, Hkv, D]
+    *,
+    q_offset: int = 0,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Causal (optionally masked) attention; f32 softmax accumulation.
+
+    ``q_offset`` positions the query block within the key timeline (for
+    decode or sequence-chunked execution): query i attends keys
+    ``<= q_offset + i``.
+    """
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    k = repeat_kv(k, h // hkv)
+    v = repeat_kv(v, h // hkv)
+
+    scale = d ** -0.5
+    # [B, H, Sq, Sk]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+
+    sk = k.shape[1]
+    causal = (
+        jnp.arange(sq)[:, None] + q_offset >= jnp.arange(sk)[None, :]
+    )
+    if mask is not None:
+        causal = jnp.logical_and(causal, mask)
+    logits = jnp.where(causal[None, None, :, :], logits, -1e30)
+
+    probs = jnp.exp(
+        logits - jnp.max(logits, axis=-1, keepdims=True)
+    )
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(v.dtype), v
+    )

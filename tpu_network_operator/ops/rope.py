@@ -1,0 +1,35 @@
+"""Rotary position embeddings (RoPE), Llama-3 convention."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def rope_angles(
+    seq_len: int, head_dim: int, theta: float = 500_000.0, dtype=jnp.float32
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Precompute (cos, sin) tables of shape [seq_len, head_dim//2]."""
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    angles = jnp.outer(t, freqs)
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rope(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, offset: int = 0
+) -> jnp.ndarray:
+    """Rotate pairs.  x: [..., seq, heads, head_dim]; tables indexed at
+    [offset : offset+seq].  Interleaved-pair convention (x0,x1),(x2,x3)...
+    matching Llama reference weights."""
+    seq = x.shape[-3]
+    c = cos[offset : offset + seq][:, None, :]   # [seq, 1, hd/2]
+    s = sin[offset : offset + seq][:, None, :]
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    y1 = x1 * c - x2 * s
+    y2 = x1 * s + x2 * c
+    return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)

@@ -1,0 +1,20 @@
+"""Parallelism layer: device meshes, collectives, sequence parallelism.
+
+This is the consuming side of the operator's work: JAX jobs that read the
+emitted ``jax-coordinator.json`` and run XLA collectives over the ICI/DCN
+fabric the agent provisioned — the framework's validation workload and
+benchmark payload (SURVEY.md §7 stage 6), playing the role the reference
+delegates to HCCL's E2E tests (ref README.md:25-27).
+
+Design follows the scaling-book recipe: pick a mesh, annotate shardings,
+let XLA insert the collectives; ICI carries intra-slice axes, DCN carries
+the (outermost) inter-slice axis.
+"""
+
+from .mesh import (  # noqa: F401
+    MeshPlan,
+    distributed_init_from_bootstrap,
+    make_mesh,
+    mesh_from_bootstrap,
+    plan_axes,
+)

@@ -1,0 +1,151 @@
+"""Collective benchmarks and helpers over the provisioned fabric.
+
+The framework's measurable contract (BASELINE.md): "JAX all-reduce GB/s
+over ICI".  Where the reference points at HCCL E2E docs for validating the
+network it provisioned (ref README.md:25-27), this module *is* that
+validation: psum / all-gather / reduce-scatter / ppermute sweeps over a
+named mesh axis, timed on-device, reporting algorithmic and bus bandwidth.
+
+Everything is shard_map + lax collectives — XLA emits the ICI/DCN rings;
+nothing here hand-schedules communication.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+@dataclass
+class CollectiveResult:
+    op: str
+    axis: str
+    size_bytes: int          # global payload per device-visible array
+    seconds: float           # best-of-iters wall time
+    algbw_gbps: float        # size / time
+    busbw_gbps: float        # hardware-normalized (ring-model) bandwidth
+
+    def to_dict(self) -> Dict:
+        return self.__dict__.copy()
+
+
+def _bus_factor(op: str, n: int) -> float:
+    """Ring-model bus/algorithmic bandwidth ratio (nccl-tests convention)."""
+    if n <= 1:
+        return 1.0
+    if op == "all_reduce":
+        return 2.0 * (n - 1) / n
+    if op in ("all_gather", "reduce_scatter"):
+        return (n - 1) / n
+    return 1.0   # ppermute / p2p
+
+
+def _sync(out) -> None:
+    # host transfer of one element: forces completion even on platforms
+    # whose ready-flag does not block (experimental axon relay)
+    jax.device_get(jax.tree.leaves(out)[0].ravel()[:1])
+
+
+def _timed(fn: Callable, arg, iters: int, warmup: int = 2) -> float:
+    for _ in range(warmup):
+        out = fn(arg)
+    _sync(out)
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(arg)
+        _sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _collective_fn(op: str, axis: str, mesh: Mesh):
+    n = mesh.shape[axis]
+
+    if op == "all_reduce":
+        def body(x):
+            return jax.lax.psum(x, axis)
+        in_spec, out_spec = P(axis), P(axis)
+    elif op == "all_gather":
+        def body(x):
+            return jax.lax.all_gather(x, axis, tiled=True)
+        in_spec, out_spec = P(axis), P()
+    elif op == "reduce_scatter":
+        def body(x):
+            return jax.lax.psum_scatter(x, axis, tiled=True)
+        in_spec, out_spec = P(), P(axis)
+    elif op == "ppermute":
+        def body(x):
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            return jax.lax.ppermute(x, axis, perm)
+        in_spec, out_spec = P(axis), P(axis)
+    else:
+        raise ValueError(f"unknown collective {op!r}")
+
+    return jax.jit(
+        shard_map(
+            body, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+            check_rep=False,
+        )
+    )
+
+
+def run_collective(
+    mesh: Mesh,
+    op: str = "all_reduce",
+    axis: str = "data",
+    size_mb: float = 64.0,
+    iters: int = 10,
+    dtype=jnp.bfloat16,
+) -> CollectiveResult:
+    """Benchmark one collective at one size over one mesh axis."""
+    n = mesh.shape[axis]
+    itemsize = jnp.dtype(dtype).itemsize
+    n_elems = max(n, int(size_mb * 1e6) // itemsize)
+    n_elems -= n_elems % n   # divisible by axis size
+    x = jnp.arange(n_elems, dtype=jnp.float32).astype(dtype)
+    sharding = NamedSharding(mesh, P(axis) if op != "reduce_scatter" else P())
+    x = jax.device_put(x, sharding)
+
+    fn = _collective_fn(op, axis, mesh)
+    secs = _timed(fn, x, iters)
+    size_bytes = n_elems * itemsize
+    algbw = size_bytes / secs / 1e9
+    return CollectiveResult(
+        op=op,
+        axis=axis,
+        size_bytes=size_bytes,
+        seconds=secs,
+        algbw_gbps=algbw,
+        busbw_gbps=algbw * _bus_factor(op, n),
+    )
+
+
+def sweep(
+    mesh: Mesh,
+    axis: str = "data",
+    ops: Optional[List[str]] = None,
+    sizes_mb: Optional[List[float]] = None,
+    iters: int = 10,
+) -> List[CollectiveResult]:
+    """The all-reduce sweep of BASELINE configs 2/5: sizes × ops over an
+    axis; returns per-point results (peak busbw is the headline number)."""
+    ops = ops or ["all_reduce"]
+    sizes_mb = sizes_mb or [1.0, 8.0, 64.0, 256.0]
+    out = []
+    for op in ops:
+        for size in sizes_mb:
+            out.append(run_collective(mesh, op, axis, size, iters))
+    return out
+
+
+def peak_busbw(results: List[CollectiveResult]) -> float:
+    return max((r.busbw_gbps for r in results), default=0.0)

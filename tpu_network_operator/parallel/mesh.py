@@ -1,0 +1,143 @@
+"""Device mesh construction from operator-provisioned topology.
+
+Maps the agent's discovered ICI mesh (``TpuTopology``) and multislice
+placement onto a ``jax.sharding.Mesh`` whose axis order keeps
+bandwidth-hungry collectives on ICI and only the outermost (data/slice)
+axis on DCN — the scaling-book layout rule.  The DCN axis, when present,
+is always the *first* (slowest-varying) mesh axis, so XLA's collectives
+along inner axes never cross slices.
+
+Axis vocabulary (used by models/ and ops/):
+
+* ``data``   — pure data parallelism (gradient psum only; DCN-tolerant)
+* ``fsdp``   — parameter/optimizer sharding (all-gather + reduce-scatter)
+* ``tensor`` — Megatron-style tensor parallelism (activation collectives;
+  must ride fastest ICI)
+* ``seq``    — sequence/context parallelism for long-context (ring
+  attention's ppermute axis)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..agent.tpu.bootstrap import BootstrapConfig
+
+AXES = ("data", "fsdp", "seq", "tensor")
+
+
+@dataclass
+class MeshPlan:
+    """A named axis → size assignment totaling the device count."""
+
+    axis_sizes: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(self.axis_sizes.keys())
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.axis_sizes.values())
+
+    def size(self) -> int:
+        return math.prod(self.shape) if self.shape else 1
+
+
+def plan_axes(
+    n_devices: int,
+    *,
+    tensor: int = 1,
+    seq: int = 1,
+    fsdp: Optional[int] = None,
+    data: Optional[int] = None,
+    dcn_slices: int = 1,
+) -> MeshPlan:
+    """Fill unset axes so the product covers all devices.
+
+    Precedence: ``tensor`` and ``seq`` are taken as given (model-imposed);
+    ``fsdp`` defaults to the remaining intra-slice factor; ``data`` absorbs
+    whatever is left (including the DCN slice axis).
+    """
+    if n_devices % (tensor * seq) != 0:
+        raise ValueError(
+            f"tensor*seq={tensor * seq} does not divide device count {n_devices}"
+        )
+    rest = n_devices // (tensor * seq)
+    if data is None and fsdp is None and dcn_slices > 1:
+        # the DCN slice factor rides the (outermost) data axis
+        if rest % dcn_slices != 0:
+            raise ValueError(
+                f"dcn_slices={dcn_slices} does not divide remainder {rest}"
+            )
+        data = dcn_slices
+    if fsdp is None:
+        fsdp = rest if data is None else rest // data
+    if fsdp == 0 or rest % fsdp != 0:
+        raise ValueError(f"fsdp={fsdp} does not divide remainder {rest}")
+    if data is None:
+        data = rest // fsdp
+    if data * fsdp * seq * tensor != n_devices:
+        raise ValueError(
+            f"axis product {data}*{fsdp}*{seq}*{tensor} != {n_devices}"
+        )
+    if dcn_slices > 1 and data % dcn_slices != 0:
+        raise ValueError(
+            f"data axis {data} not divisible by dcn_slices {dcn_slices}"
+        )
+    return MeshPlan({"data": data, "fsdp": fsdp, "seq": seq, "tensor": tensor})
+
+
+def make_mesh(
+    plan: MeshPlan, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Mesh over the given (or all) devices in plan order.
+
+    Device order: ``jax.devices()`` enumerates process-major then
+    ICI-topology-major; reshaping that order into (data, fsdp, seq, tensor)
+    puts ``tensor`` on adjacent chips (fastest ICI neighbours) and ``data``
+    across processes/slices (DCN), which is exactly the bandwidth hierarchy
+    the axes demand.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if len(devs) != plan.size():
+        raise ValueError(
+            f"plan covers {plan.size()} devices but {len(devs)} available"
+        )
+    arr = np.array(devs, dtype=object).reshape(plan.shape)
+    return Mesh(arr, plan.names)
+
+
+def mesh_from_bootstrap(
+    cfg: BootstrapConfig,
+    *,
+    tensor: int = 1,
+    seq: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build the job mesh from the operator-emitted bootstrap config.
+
+    Multislice: the DCN (slice) factor folds into the leading ``data`` axis,
+    keeping every inner axis intra-slice (pure ICI).
+    """
+    topo = cfg.topology
+    n = (topo.num_chips * topo.num_slices) if topo else len(jax.devices())
+    plan = plan_axes(n, tensor=tensor, seq=seq,
+                     dcn_slices=topo.num_slices if topo else 1)
+    return make_mesh(plan, devices)
+
+
+def distributed_init_from_bootstrap(cfg: BootstrapConfig) -> None:
+    """``jax.distributed.initialize`` from the operator-emitted file — the
+    consuming end of the contract (SURVEY.md §5.8 item iii)."""
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )

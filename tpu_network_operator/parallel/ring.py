@@ -1,0 +1,132 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+Long-context is first-class (SURVEY.md §5.7 TPU-equivalent): sequences too
+large for one chip's HBM are sharded along the ``seq`` mesh axis; each
+device holds a [B, S/n, H, D] chunk of Q/K/V and K/V chunks rotate around
+the ring with ``lax.ppermute`` (neighbour hops = pure ICI traffic) while a
+running online-softmax accumulator keeps the computation exact (the
+RingAttention construction, Liu et al. 2023 — see PAPERS.md).
+
+Causality by construction: chunks are laid out in ring order, so the chunk
+arriving at step j originated at device (i - j) mod n and is
+
+* j == 0   — the diagonal block: locally causal;
+* src < i  — strictly past: fully attended;
+* src > i  — strictly future: skipped (masked to zero contribution).
+
+Compute/communication overlap is left to XLA's latency-hiding scheduler —
+the ppermute of step j+1 is independent of step j's matmuls, which is
+exactly the pattern it overlaps.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..ops.attention import repeat_kv
+
+_NEG = -1e30
+
+
+def _chunk_scores(q, k, scale):
+    """[B,Sq,H,D] x [B,Sk,H,D] -> f32 logits [B,H,Sq,Sk]."""
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+
+
+def _ring_body(axis_name: str, n: int, scale: float, j, carry):
+    """One ring step: accumulate this K/V chunk, rotate K/V backwards."""
+    k, v, m, l, o, q, my = carry
+
+    src = (my - j) % n
+    logits = _chunk_scores(q, k, scale)          # [B,H,Sq,Sk]
+    sq, sk = logits.shape[-2], logits.shape[-1]
+
+    diag_mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+    keep = jnp.where(
+        src == my, diag_mask[None, None],
+        jnp.where(src < my, True, False),
+    )
+    logits = jnp.where(keep, logits, _NEG)
+
+    m_c = jnp.max(logits, axis=-1)               # [B,H,Sq]
+    m_new = jnp.maximum(m, m_c)
+    p = jnp.exp(logits - m_new[..., None])       # [B,H,Sq,Sk]
+    l_c = jnp.sum(p, axis=-1)
+    alpha = jnp.exp(m - m_new)
+    l = l * alpha + l_c
+    o = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32)
+    )
+    m = m_new
+
+    # rotate K/V to the next device (ring hop on ICI)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k = jax.lax.ppermute(k, axis_name, perm)
+    v = jax.lax.ppermute(v, axis_name, perm)
+    return (k, v, m, l, o, q, my)
+
+
+def _ring_kernel(axis_name: str, scale: float, q, k, v):
+    """Per-device kernel under shard_map.  q,k,v: [B, S_local, H, D]."""
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+
+    b, sq, h, d = q.shape
+    m = jnp.full((b, h, sq), _NEG, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    o = jnp.zeros((b, h, sq, d), jnp.float32)
+
+    carry = (k, v, m, l, o, q, my)
+    carry = jax.lax.fori_loop(
+        0, n, partial(_ring_body, axis_name, n, scale), carry
+    )
+    _, _, m, l, o, _, _ = carry
+    out = o / jnp.maximum(l, 1e-30)[..., None]   # [B,H,Sq,D]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,                    # [B, S, H, D], S sharded on `axis`
+    k: jnp.ndarray,                    # [B, S, Hkv, D]
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "seq",
+    batch_axes=("data", "fsdp"),
+    head_axis: Optional[str] = "tensor",
+) -> jnp.ndarray:
+    """Global-view ring attention (callable inside jit).
+
+    Sequence is sharded along ``axis``; batch along ``batch_axes``; heads
+    along ``head_axis``.  Exact match to full causal attention.
+    """
+    h, hkv = q.shape[2], k.shape[2]
+    if hkv != h:
+        k = repeat_kv(k, h // hkv)
+        v = repeat_kv(v, h // hkv)
+
+    spec = P(batch_axes, axis, head_axis, None)
+    scale = q.shape[-1] ** -0.5
+
+    kernel = partial(_ring_kernel, axis, scale)
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )(q, k, v)
+
+
+def make_ring_attn_fn(mesh: Mesh, axis: str = "seq"):
+    """Adapter matching the model's ``attn_fn`` signature."""
+
+    def attn_fn(q, k, v):
+        return ring_attention(q, k, v, mesh, axis=axis)
+
+    return attn_fn
