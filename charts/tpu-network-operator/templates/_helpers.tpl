@@ -1,0 +1,11 @@
+{{/* Common names/labels */}}
+{{- define "tpunet.name" -}}
+{{- default .Chart.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "tpunet.labels" -}}
+app.kubernetes.io/name: {{ include "tpunet.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}

@@ -1,0 +1,282 @@
+"""End-to-end: the full operator lifecycle, with real transports.
+
+Goes beyond the reference's e2e (which only waits for the manager pod and
+never applies a CR, ref ``test/e2e/e2e_test.go:32-122`` / SURVEY.md §4 gap
+list):
+
+1. admission goes through the REAL webhook server over TLS — the fake
+   apiserver's admission hook POSTs AdmissionReview to it, exactly as a
+   kube-apiserver would;
+2. the manager runs its REAL background threads (watch fan-in, workqueue);
+3. a sample CR from deploy/samples is applied, the DaemonSet materializes,
+   node simulation drives status No targets → All good;
+4. the projected agent args are executed as a REAL subprocess against a
+   fake GCE metadata server, asserting the jax.distributed bootstrap and
+   NFD readiness label appear on "the host", then SIGTERM de-provisions.
+"""
+
+import base64
+import json
+import os
+import signal
+import ssl
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+import yaml
+
+from tpu_network_operator.agent.tpu.metadata import FakeMetadataServer
+from tpu_network_operator.api.v1alpha1.types import API_VERSION
+from tpu_network_operator.controller.manager import Manager
+from tpu_network_operator.controller.webhook_server import (
+    MUTATE_PATH,
+    VALIDATE_PATH,
+    WebhookServer,
+)
+from tpu_network_operator.kube import AdmissionDeniedError
+from tpu_network_operator.kube.fake import FakeCluster
+
+ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+NAMESPACE = "tpunet-system"
+
+
+# -- TLS webhook plumbing (cert fixture mirrors cert-manager's mount) ---------
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+    import datetime
+
+    d = tmp_path_factory.mktemp("certs")
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name).issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName("localhost")]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    (d / "tls.key").write_bytes(key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption(),
+    ))
+    (d / "tls.crt").write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    return str(d)
+
+
+def wire_admission_over_tls(fake: FakeCluster, port: int) -> None:
+    """Make the fake apiserver call the real webhook server, as a
+    kube-apiserver calls the webhook Service."""
+    ctx = ssl._create_unverified_context()
+
+    def post(path, review):
+        req = urllib.request.Request(
+            f"https://127.0.0.1:{port}{path}",
+            data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, context=ctx, timeout=5) as r:
+            return json.loads(r.read())
+
+    def mutate(obj):
+        review = {"request": {"uid": "e2e", "object": obj,
+                              "operation": "CREATE"}}
+        resp = post(MUTATE_PATH, review)["response"]
+        if not resp["allowed"]:
+            raise AdmissionDeniedError(
+                resp.get("status", {}).get("message", "denied")
+            )
+        if "patch" in resp:
+            patch = json.loads(base64.b64decode(resp["patch"]))
+            for op in patch:
+                assert op["op"] == "replace" and op["path"] == "/spec"
+                obj = dict(obj, spec=op["value"])
+        return obj
+
+    def validate(obj, old):
+        review = {"request": {
+            "uid": "e2e", "object": obj, "oldObject": old,
+            "operation": "UPDATE" if old else "CREATE",
+        }}
+        resp = post(VALIDATE_PATH, review)["response"]
+        if not resp["allowed"]:
+            raise AdmissionDeniedError(
+                resp.get("status", {}).get("message", "denied")
+            )
+
+    fake.register_admission(
+        API_VERSION, "NetworkClusterPolicy", mutate=mutate, validate=validate
+    )
+
+
+def wait_for(cond, timeout=10.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def load_sample(name):
+    with open(os.path.join(ROOT, "deploy", "samples", name)) as f:
+        return yaml.safe_load(f)
+
+
+# -- the lifecycle ------------------------------------------------------------
+
+
+def test_full_lifecycle_tpu(certs, tmp_path):
+    fake = FakeCluster()
+    webhook_srv = WebhookServer(port=0, cert_dir=certs, bind="127.0.0.1")
+    webhook_srv.start()
+    mgr = Manager(fake, namespace=NAMESPACE)
+    try:
+        wire_admission_over_tls(fake, webhook_srv.port)
+        mgr.start()
+
+        # a bad CR is rejected through the real TLS webhook
+        bad = load_sample("tpu-l2.yaml")
+        bad["spec"]["configurationType"] = "bogus"
+        bad["metadata"]["name"] = "bad"
+        with pytest.raises(AdmissionDeniedError):
+            fake.create(bad)
+
+        # the good sample is admitted, defaulted, reconciled
+        cr = load_sample("tpu-l2.yaml")
+        created = fake.create(cr)
+        assert created["spec"]["tpuScaleOut"]["image"], "defaulting ran"
+
+        name = cr["metadata"]["name"]
+        wait_for(
+            lambda: fake.list("apps/v1", "DaemonSet", namespace=NAMESPACE),
+            what="DaemonSet creation",
+        )
+        ds = fake.list("apps/v1", "DaemonSet", namespace=NAMESPACE)[0]
+        args = ds["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--backend=tpu" in args
+
+        # nodes join; the DaemonSet controller schedules agent pods
+        for i in range(2):
+            fake.add_node(
+                f"tpu-worker-{i}",
+                labels={"tpunet.feature.node.kubernetes.io/tpu": "true"},
+            )
+        fake.simulate_daemonset_controller()
+
+        def state():
+            obj = fake.get(API_VERSION, "NetworkClusterPolicy", name)
+            return obj.get("status", {}).get("state", "")
+
+        wait_for(lambda: state() == "All good", what="status All good")
+        obj = fake.get(API_VERSION, "NetworkClusterPolicy", name)
+        assert obj["status"]["targets"] == 2
+        assert obj["status"]["ready"] == 2
+
+        # spec update flows through webhook + reconcile to the DaemonSet
+        obj["spec"]["logLevel"] = 4
+        fake.update(obj)
+        def v4():
+            d = fake.list("apps/v1", "DaemonSet", namespace=NAMESPACE)[0]
+            return "--v=4" in d["spec"]["template"]["spec"]["containers"][0]["args"]
+        wait_for(v4, what="DaemonSet arg update")
+
+        # CR delete garbage-collects the DaemonSet
+        fake.delete(API_VERSION, "NetworkClusterPolicy", name)
+        wait_for(
+            lambda: not fake.list("apps/v1", "DaemonSet", namespace=NAMESPACE),
+            what="DaemonSet GC",
+        )
+    finally:
+        mgr.stop()
+        webhook_srv.stop()
+
+
+def test_agent_subprocess_runs_projected_args(tmp_path):
+    """The DaemonSet's projected args drive the real agent process: fake
+    metadata in, bootstrap + readiness label out, SIGTERM de-provisions."""
+    nfd_dir = tmp_path / "etc/kubernetes/node-feature-discovery/features.d"
+    nfd_dir.mkdir(parents=True)
+    bootstrap = tmp_path / "jax-coordinator.json"
+
+    attrs = {
+        "accelerator-type": "v5litepod-16",
+        "tpu-env": (
+            "ACCELERATOR_TYPE: 'v5litepod-16'\nTOPOLOGY: '4x4'\n"
+            "WORKER_ID: '0'\n"
+        ),
+        "worker-network-config": json.dumps(
+            [{"workerId": 0, "ipAddress": "10.0.0.5"},
+             {"workerId": 1, "ipAddress": "10.0.0.6"}]
+        ),
+    }
+    with FakeMetadataServer(attrs) as srv:
+        env = dict(
+            os.environ,
+            TPUNET_METADATA_URL=srv.url,
+            TPUNET_NFD_ROOT=str(tmp_path),
+            PYTHONPATH=ROOT,
+        )
+        # exactly what the reconciler projects for tpu-so L2 (minus
+        # interfaces: none on this host — the agent must tolerate that)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_network_operator.agent.cli",
+             "--configure=true", "--keep-running", "--backend=tpu",
+             "--mode=L2", "--v=3",
+             "--topology-source=metadata",
+             "--coordinator-port=8476",
+             f"--bootstrap={bootstrap}"],
+            env=env, cwd=ROOT,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        try:
+            deadline = time.time() + 15
+            label = nfd_dir / "scale-out-readiness.txt"
+            while time.time() < deadline:
+                if bootstrap.exists() and label.exists():
+                    break
+                assert proc.poll() is None, (
+                    f"agent died: {proc.stderr.read().decode()[-2000:]}"
+                )
+                time.sleep(0.1)
+            else:
+                proc.kill()
+                raise AssertionError(
+                    f"no bootstrap/label: {proc.stderr.read().decode()[-2000:]}"
+                )
+
+            cfg = json.loads(bootstrap.read_text())
+            assert cfg["coordinator_address"] == "10.0.0.5:8476"
+            assert cfg["num_processes"] == 2
+            assert cfg["process_id"] == 0
+            assert label.read_text().strip() == (
+                "tpunet.feature.node.kubernetes.io/tpu-scale-out=true"
+            )
+
+            # graceful de-provision (ref main.go:143-159,250-255)
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=10) == 0
+            assert not bootstrap.exists()
+            assert not label.exists()
+        finally:
+            if proc.poll() is None:
+                proc.kill()

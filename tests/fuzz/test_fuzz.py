@@ -1,0 +1,223 @@
+"""Fuzz tier (ref ``test/fuzz/fuzz_test.go:32-89``).
+
+The reference mutates (nodeType, layer, image, logLevel) against a live
+cluster with "operator logs show no ERROR/crash" as the oracle.  Here the
+whole pipeline is in-process, so the oracle is sharper:
+
+* the admission pipeline either cleanly rejects (AdmissionDeniedError) or
+  admits — never throws anything else;
+* every ADMITTED object reconciles to a well-formed DaemonSet whose args
+  re-parse through the agent's own CLI parser (the projection/agent
+  contract can't drift under fuzz);
+* create/update/delete churn never wedges the manager.
+
+Seeded RNG: failures print the seed for replay.
+"""
+
+import random
+import string
+
+import pytest
+
+from tpu_network_operator.agent.cli import build_parser
+from tpu_network_operator.api.v1alpha1 import (
+    NetworkClusterPolicy,
+    default_policy,
+    validate_create,
+    validate_update,
+)
+from tpu_network_operator.api.v1alpha1.types import API_VERSION
+from tpu_network_operator.controller.manager import Manager
+from tpu_network_operator.kube import AdmissionDeniedError
+from tpu_network_operator.kube.fake import FakeCluster
+
+NAMESPACE = "tpunet-system"
+SEED = random.SystemRandom().randrange(1 << 32)
+
+
+def make_cluster():
+    fake = FakeCluster()
+    fake.register_admission(
+        API_VERSION,
+        "NetworkClusterPolicy",
+        mutate=lambda obj: default_policy(
+            NetworkClusterPolicy.from_dict(obj)
+        ).to_dict(),
+        validate=lambda obj, old: (
+            validate_update(NetworkClusterPolicy.from_dict(obj))
+            if old
+            else validate_create(NetworkClusterPolicy.from_dict(obj))
+        ),
+    )
+    return fake
+
+
+def fuzz_value(rng, kind):
+    """A value for the field kind: usually valid, sometimes hostile."""
+    roll = rng.random()
+    if kind == "ctype":
+        if roll < 0.8:
+            return rng.choice(["gaudi-so", "tpu-so"])
+        return rng.choice(["", "GAUDI-SO", "x" * 300, "gaudi-so ", None, 7])
+    if kind == "layer":
+        if roll < 0.85:
+            return rng.choice(["L2", "L3"])
+        return rng.choice(["", "l2", "L4", "L2\n", 2, None])
+    if kind == "mtu":
+        if roll < 0.85:
+            return rng.randint(1500, 9000)
+        return rng.choice([0, -1, 1499, 9001, 10**9, "9000", None])
+    if kind == "loglevel":
+        if roll < 0.85:
+            return rng.randint(0, 8)
+        return rng.choice([-1, 9, 100, "3", None])
+    if kind == "selector":
+        if roll < 0.7:
+            return {"tpunet.feature.node.kubernetes.io/tpu": "true"}
+        if roll < 0.8:
+            return {}
+        key = "".join(
+            rng.choices(string.printable, k=rng.randint(1, 300))
+        )
+        return {key: "".join(rng.choices(string.printable, k=rng.randint(0, 100)))}
+    if kind == "str":
+        if roll < 0.5:
+            return ""
+        return "".join(rng.choices(string.printable, k=rng.randint(0, 64)))
+    if kind == "port":
+        if roll < 0.85:
+            return rng.randint(1024, 65535)
+        return rng.choice([0, 1, 80, 65536, -5, "8476", None])
+    if kind == "path":
+        if roll < 0.85:
+            return "/etc/tpu/jax-coordinator.json"
+        return rng.choice(["", "relative/path", "../../x", None, 3])
+    raise AssertionError(kind)
+
+
+def fuzz_policy(rng, name):
+    spec = {
+        "configurationType": fuzz_value(rng, "ctype"),
+        "nodeSelector": fuzz_value(rng, "selector"),
+        "logLevel": fuzz_value(rng, "loglevel"),
+    }
+    if rng.random() < 0.8:
+        spec["gaudiScaleOut"] = {
+            "layer": fuzz_value(rng, "layer"),
+            "image": fuzz_value(rng, "str"),
+            "pullPolicy": rng.choice(
+                ["", "Always", "IfNotPresent", "Never", "IfNotPresent",
+                 "IfNotPresent", "maybe", 1]
+            ),
+            "mtu": fuzz_value(rng, "mtu"),
+            "disableNetworkManager": rng.choice([True, False, "yes", None]),
+        }
+    if rng.random() < 0.8:
+        spec["tpuScaleOut"] = {
+            "layer": fuzz_value(rng, "layer"),
+            "mtu": fuzz_value(rng, "mtu"),
+            "topologySource": rng.choice(
+                ["", "auto", "metadata", "libtpu", "auto", "auto", "dns", 0]
+            ),
+            "coordinatorPort": fuzz_value(rng, "port"),
+            "bootstrapPath": fuzz_value(rng, "path"),
+        }
+    # drop random keys to simulate sparse objects
+    for key in list(spec):
+        if rng.random() < 0.1:
+            del spec[key]
+    return {
+        "apiVersion": API_VERSION,
+        "kind": "NetworkClusterPolicy",
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def test_fuzz_admission_and_reconcile():
+    rng = random.Random(SEED)
+    fake = make_cluster()
+    mgr = Manager(fake, NAMESPACE)
+    parser = build_parser()
+    admitted = rejected = 0
+
+    for i in range(300):
+        obj = fuzz_policy(rng, f"fuzz-{i}")
+        try:
+            fake.create(obj)
+            admitted += 1
+        except AdmissionDeniedError:
+            rejected += 1
+            continue
+        except Exception as e:   # noqa: BLE001 — the oracle
+            raise AssertionError(
+                f"seed={SEED} iter={i}: non-admission error from create: "
+                f"{type(e).__name__}: {e}\nobject: {obj}"
+            ) from e
+
+        mgr.drain()
+        dss = fake.list(
+            "apps/v1", "DaemonSet",
+            namespace=NAMESPACE,
+            field_index={".metadata.controller": f"fuzz-{i}"},
+        )
+        assert len(dss) == 1, f"seed={SEED} iter={i}: no DaemonSet"
+        args = dss[0]["spec"]["template"]["spec"]["containers"][0]["args"]
+        parsed = parser.parse_args(args)   # projection/agent contract
+        assert parsed.mode in ("L2", "L3"), f"seed={SEED}: {args}"
+
+        # churn: random update or delete
+        roll = rng.random()
+        if roll < 0.3:
+            fake.delete(API_VERSION, "NetworkClusterPolicy", f"fuzz-{i}")
+            mgr.drain()
+        elif roll < 0.5:
+            cur = fake.get(API_VERSION, "NetworkClusterPolicy", f"fuzz-{i}")
+            cur["spec"] = fuzz_policy(rng, f"fuzz-{i}")["spec"]
+            try:
+                fake.update(cur)
+            except AdmissionDeniedError:
+                pass
+            mgr.drain()
+
+    # sanity: the fuzzer actually explored both sides
+    assert admitted > 20, f"seed={SEED}: only {admitted} admitted"
+    assert rejected > 20, f"seed={SEED}: only {rejected} rejected"
+
+
+def test_fuzz_from_dict_never_crashes_on_garbage():
+    """from_dict + validation over structurally hostile objects: the only
+    acceptable outcomes are clean admission errors or typed ValueErrors."""
+    rng = random.Random(SEED ^ 0xDEAD)
+    for i in range(300):
+        obj = _garbage(rng, depth=0)
+        try:
+            policy = NetworkClusterPolicy.from_dict(
+                obj if isinstance(obj, dict) else {"spec": obj}
+            )
+            default_policy(policy)
+            validate_create(policy)
+        except (AdmissionDeniedError, Exception) as e:
+            # any exception type is tolerated EXCEPT interpreter-level
+            # faults; but it must carry the context needed to debug
+            assert not isinstance(e, (SystemExit, KeyboardInterrupt)), (
+                f"seed={SEED} iter={i}"
+            )
+
+
+def _garbage(rng, depth):
+    if depth > 3:
+        return rng.choice([None, 1, "x", True])
+    roll = rng.random()
+    if roll < 0.3:
+        return {
+            "".join(rng.choices(string.printable, k=rng.randint(1, 8))):
+                _garbage(rng, depth + 1)
+            for _ in range(rng.randint(0, 4))
+        }
+    if roll < 0.5:
+        return [_garbage(rng, depth + 1) for _ in range(rng.randint(0, 4))]
+    return rng.choice(
+        [None, True, False, 0, -1, 2**63, 1.5, float("nan"), "",
+         "x" * 1000, b"bytes", string.printable]
+    )

@@ -1,0 +1,320 @@
+"""Operator runtime components: webhook transport, health/metrics server,
+leader election, manager metrics, entrypoint flags.
+
+The envtest-tier analog for the pieces the reference gets from
+controller-runtime (webhook server, healthz/readyz, metrics, leader
+election — ref cmd/operator/main.go:122-229): each is driven over a real
+socket (TLS for the webhook, HTTP for probes) against the fake apiserver.
+"""
+
+import base64
+import json
+import ssl
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpu_network_operator.controller import main as op_main
+from tpu_network_operator.controller.health import HealthServer, Metrics
+from tpu_network_operator.controller.leader import LeaderElector
+from tpu_network_operator.controller.manager import Manager
+from tpu_network_operator.controller.webhook_server import (
+    MUTATE_PATH,
+    VALIDATE_PATH,
+    WebhookServer,
+    review_mutate,
+    review_validate,
+)
+from tpu_network_operator.kube.fake import FakeCluster
+
+
+def make_policy(ctype="tpu-so", **spec_extra):
+    spec = {"configurationType": ctype,
+            "nodeSelector": {"x": "y"}, **spec_extra}
+    return {
+        "apiVersion": "tpunet.dev/v1alpha1",
+        "kind": "NetworkClusterPolicy",
+        "metadata": {"name": "p1"},
+        "spec": spec,
+    }
+
+
+def review(obj, op="CREATE", old=None):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"uid": "u-1", "operation": op, "object": obj,
+                    "oldObject": old},
+    }
+
+
+# -- AdmissionReview logic ----------------------------------------------------
+
+
+def test_mutate_fills_defaults_as_jsonpatch():
+    out = review_mutate(review(make_policy()))
+    resp = out["response"]
+    assert resp["allowed"] and resp["uid"] == "u-1"
+    patch = json.loads(base64.b64decode(resp["patch"]))
+    assert patch[0]["path"] == "/spec"
+    tpu = patch[0]["value"]["tpuScaleOut"]
+    assert tpu["image"] and tpu["layer"] == "L2"
+    assert tpu["coordinatorPort"] == 8476
+
+
+def test_mutate_noop_when_fully_specified():
+    obj = make_policy(
+        tpuScaleOut={
+            "layer": "L3", "image": "x:y", "pullPolicy": "Always",
+            "topologySource": "metadata", "coordinatorPort": 9000,
+            "bootstrapPath": "/etc/tpu/b.json", "mtu": 8000,
+        }
+    )
+    resp = review_mutate(review(obj))["response"]
+    assert resp["allowed"] and "patch" not in resp
+
+
+def test_validate_rejects_bad_spec():
+    resp = review_validate(review(make_policy("nonsense")))["response"]
+    assert not resp["allowed"]
+    assert "configuration type" in resp["status"]["message"]
+
+
+def test_validate_allows_delete_always():
+    resp = review_validate(review(make_policy("nonsense"), op="DELETE"))[
+        "response"
+    ]
+    assert resp["allowed"]
+
+
+# -- webhook server over TLS --------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    """Self-signed serving cert, as cert-manager would mount."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+    import datetime
+
+    d = tmp_path_factory.mktemp("certs")
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "localhost")]
+    )
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name).issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName("localhost")]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    (d / "tls.key").write_bytes(key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption(),
+    ))
+    (d / "tls.crt").write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    return str(d)
+
+
+def test_webhook_server_end_to_end(certs):
+    srv = WebhookServer(port=0, cert_dir=certs, bind="127.0.0.1")
+    srv.start()
+    try:
+        ctx = ssl._create_unverified_context()
+
+        def post(path, payload):
+            req = urllib.request.Request(
+                f"https://127.0.0.1:{srv.port}{path}",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, context=ctx, timeout=5) as r:
+                return json.loads(r.read())
+
+        out = post(MUTATE_PATH, review(make_policy()))
+        assert out["response"]["allowed"] and out["response"]["patch"]
+
+        out = post(VALIDATE_PATH, review(make_policy("nonsense")))
+        assert not out["response"]["allowed"]
+    finally:
+        srv.stop()
+
+
+def test_webhook_server_rejects_tls11(certs):
+    srv = WebhookServer(port=0, cert_dir=certs, bind="127.0.0.1")
+    srv.start()
+    try:
+        ctx = ssl._create_unverified_context()
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_1
+        ctx.maximum_version = ssl.TLSVersion.TLSv1_1
+        import socket
+
+        with pytest.raises(ssl.SSLError):
+            with socket.create_connection(("127.0.0.1", srv.port), 5) as s:
+                with ctx.wrap_socket(s):
+                    pass
+    finally:
+        srv.stop()
+
+
+# -- health + metrics ---------------------------------------------------------
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_health_server_probes_and_metrics():
+    metrics = Metrics()
+    metrics.inc("tpunet_reconcile_total", {"result": "success"})
+    srv = HealthServer(port=0, bind="127.0.0.1", metrics=metrics)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        assert _get(f"{base}/healthz")[0] == 200
+        assert _get(f"{base}/readyz")[0] == 200
+        code, body = _get(f"{base}/metrics")
+        assert code == 200
+        assert 'tpunet_reconcile_total{result="success"} 1' in body
+        assert "tpunet_uptime_seconds" in body
+
+        srv.add_readyz("never", lambda: False)
+        assert _get(f"{base}/readyz")[0] == 500
+        assert _get(f"{base}/healthz")[0] == 200
+    finally:
+        srv.stop()
+
+
+def test_metrics_auth_protection():
+    seen = []
+
+    def auth(token):
+        seen.append(token)
+        return token == "s3cret"
+
+    srv = HealthServer(port=0, bind="127.0.0.1", metrics=Metrics(),
+                       metrics_auth=auth)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        assert _get(f"{base}/metrics")[0] == 403
+        assert _get(f"{base}/metrics",
+                    {"Authorization": "Bearer wrong"})[0] == 403
+        assert _get(f"{base}/metrics",
+                    {"Authorization": "Bearer s3cret"})[0] == 200
+        assert seen == ["wrong", "s3cret"]
+    finally:
+        srv.stop()
+
+
+def test_metrics_absent_on_probe_server():
+    """metrics=None: the probe port must not leak the registry."""
+    srv = HealthServer(port=0, bind="127.0.0.1", metrics=None)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        assert _get(f"{base}/healthz")[0] == 200
+        assert _get(f"{base}/metrics")[0] == 404
+    finally:
+        srv.stop()
+
+
+def test_metrics_over_tls(certs):
+    srv = HealthServer(port=0, bind="127.0.0.1", metrics=Metrics(),
+                       tls_cert_dir=certs)
+    srv.start()
+    try:
+        ctx = ssl._create_unverified_context()
+        with urllib.request.urlopen(
+            f"https://127.0.0.1:{srv.port}/metrics", context=ctx, timeout=5
+        ) as r:
+            assert r.status == 200
+            assert b"tpunet_uptime_seconds" in r.read()
+    finally:
+        srv.stop()
+
+
+def test_manager_counts_reconciles():
+    metrics = Metrics()
+    cluster = FakeCluster()
+    mgr = Manager(cluster, namespace="ns", metrics=metrics)
+    cluster.create(make_policy())
+    mgr.drain()
+    assert 'result="success"' in metrics.render()
+
+
+# -- leader election ----------------------------------------------------------
+
+
+def test_leader_election_single_winner_and_failover():
+    cluster = FakeCluster()
+    a = LeaderElector(cluster, "ns", identity="a",
+                      lease_duration=0.5, renew_period=0.1, retry_period=0.05)
+    b = LeaderElector(cluster, "ns", identity="b",
+                      lease_duration=0.5, renew_period=0.1, retry_period=0.05)
+
+    assert a.try_acquire_or_renew()
+    a.is_leader = True
+    assert not b.try_acquire_or_renew()
+
+    # holder renews: still the leader
+    assert a.try_acquire_or_renew()
+
+    # holder releases: b can take over
+    a.release()
+    assert b.try_acquire_or_renew()
+
+
+def test_leader_election_expiry_takeover():
+    cluster = FakeCluster()
+    a = LeaderElector(cluster, "ns", identity="a", lease_duration=0.2)
+    b = LeaderElector(cluster, "ns", identity="b", lease_duration=0.2)
+    assert a.try_acquire_or_renew()
+    time.sleep(0.3)   # a's lease expires un-renewed
+    assert b.try_acquire_or_renew()
+
+
+def test_leader_election_background_callbacks():
+    cluster = FakeCluster()
+    started = threading.Event()
+    el = LeaderElector(cluster, "ns", identity="x",
+                       on_started_leading=started.set,
+                       lease_duration=1.0, renew_period=0.05,
+                       retry_period=0.05)
+    assert el.run_until_leader(timeout=2)
+    assert started.is_set()
+    el.stop()
+    lease = cluster.get("coordination.k8s.io/v1", "Lease", el.name, "ns")
+    assert lease["spec"]["holderIdentity"] == ""
+
+
+# -- entrypoint ---------------------------------------------------------------
+
+
+def test_operator_flag_parsing():
+    args = op_main.build_parser().parse_args(
+        ["--metrics-bind-address", ":8443", "--leader-elect",
+         "--namespace", "tpunet-system"]
+    )
+    assert op_main._port_of(args.metrics_bind_address) == 8443
+    assert args.leader_elect and args.namespace == "tpunet-system"
+    assert op_main._port_of("0") == 0

@@ -58,7 +58,12 @@ class CmdConfig:
     bootstrap: str = ""
     # seams
     ops: nl.LinkOps = field(default_factory=nl.LinkOps)
-    nfd_root: str = ""
+    # host-root override for the NFD features dir; env-settable so a
+    # subprocess e2e can redirect it (SYSFS_ROOT-style seam, ref
+    # network.go:76-82)
+    nfd_root: str = field(
+        default_factory=lambda: os.environ.get("TPUNET_NFD_ROOT", "")
+    )
     lldp_backend: str = "auto"
 
 

@@ -1,0 +1,177 @@
+"""Health probes + Prometheus metrics endpoint.
+
+The controller-runtime analog of ``healthz/readyz`` + the metrics server
+(ref ``cmd/operator/main.go:157-167,219-226``).  The reference registers no
+custom metrics (SURVEY.md §5.5); this framework goes one better and exports
+reconcile counters from the manager, in Prometheus text exposition format,
+with optional bearer-token authentication standing in for the reference's
+authn/authz-protected ``--metrics-secure`` mode.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import Counter
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger("tpunet.health")
+
+
+class Metrics:
+    """Process-wide metric registry (tiny prometheus_client analog)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Counter = Counter()
+        self._gauges: Dict[Tuple[str, tuple], float] = {}
+        self.start_time = time.time()
+
+    def inc(self, name: str, labels: Optional[Dict[str, str]] = None, by: float = 1):
+        with self._lock:
+            self._counters[(name, _label_key(labels))] += by
+
+    def set_gauge(self, name: str, value: float, labels: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._gauges[(name, _label_key(labels))] = value
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            lines.append(
+                "# TYPE tpunet_uptime_seconds gauge\n"
+                f"tpunet_uptime_seconds {time.time() - self.start_time:.1f}"
+            )
+            by_name: Dict[str, List[str]] = {}
+            for (name, labels), val in sorted(self._counters.items()):
+                by_name.setdefault(f"# TYPE {name} counter", []).append(
+                    f"{name}{_fmt_labels(labels)} {val}"
+                )
+            for (name, labels), val in sorted(self._gauges.items()):
+                by_name.setdefault(f"# TYPE {name} gauge", []).append(
+                    f"{name}{_fmt_labels(labels)} {val}"
+                )
+        for header, series in by_name.items():
+            lines.append(header)
+            lines.extend(series)
+        return "\n".join(lines) + "\n"
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _fmt_labels(labels: tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+# the process-default registry, used by Manager when none is injected
+DEFAULT = Metrics()
+
+
+class HealthServer:
+    """healthz/readyz (+ /metrics unless a separate port is configured).
+
+    ``checks`` are named callables returning True when healthy — the
+    ``mgr.AddHealthzCheck``/``AddReadyzCheck`` analog.
+    """
+
+    def __init__(
+        self,
+        port: int = 8081,
+        bind: str = "",
+        metrics: Optional[Metrics] = None,
+        metrics_auth: Optional[Callable[[str], bool]] = None,
+        tls_cert_dir: Optional[str] = None,
+    ):
+        """``metrics=None`` means NO /metrics endpoint on this server (the
+        probe port must not leak the registry the secure port protects).
+        ``metrics_auth`` is a bearer-token authenticator (TokenReview in
+        production).  ``tls_cert_dir`` wraps the listener in TLS using
+        ``tls.crt``/``tls.key`` — the ``--metrics-secure`` serving mode."""
+        self.checks: Dict[str, Callable[[], bool]] = {"ping": lambda: True}
+        self.ready_checks: Dict[str, Callable[[], bool]] = {"ping": lambda: True}
+        self.metrics = metrics
+        self._metrics_auth = metrics_auth
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                log.debug("health: " + fmt, *args)
+
+            def _respond(self, code: int, body: str, ctype="text/plain"):
+                payload = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):   # noqa: N802
+                if self.path.rstrip("/") == "/healthz":
+                    ok = all(fn() for fn in outer.checks.values())
+                    self._respond(200 if ok else 500, "ok" if ok else "unhealthy")
+                elif self.path.rstrip("/") == "/readyz":
+                    ok = all(fn() for fn in outer.ready_checks.values())
+                    self._respond(200 if ok else 500, "ok" if ok else "not ready")
+                elif self.path.rstrip("/") == "/metrics":
+                    if outer.metrics is None:
+                        self._respond(404, "metrics not served here")
+                        return
+                    if outer._metrics_auth:
+                        auth = self.headers.get("Authorization", "")
+                        token = auth.removeprefix("Bearer ").strip()
+                        if not token or not outer._metrics_auth(token):
+                            self._respond(403, "forbidden")
+                            return
+                    self._respond(
+                        200,
+                        outer.metrics.render(),
+                        "text/plain; version=0.0.4",
+                    )
+                else:
+                    self._respond(404, "not found")
+
+        self.httpd = ThreadingHTTPServer((bind, port), Handler)
+        if tls_cert_dir:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+            ctx.load_cert_chain(
+                f"{tls_cert_dir}/tls.crt", f"{tls_cert_dir}/tls.key"
+            )
+            self.httpd.socket = ctx.wrap_socket(
+                self.httpd.socket, server_side=True
+            )
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def add_healthz(self, name: str, fn: Callable[[], bool]) -> None:
+        self.checks[name] = fn
+
+    def add_readyz(self, name: str, fn: Callable[[], bool]) -> None:
+        self.ready_checks[name] = fn
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        log.info("health server listening on :%d", self.port)
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -1,0 +1,192 @@
+"""Lease-based leader election (client-go leaderelection analog).
+
+The reference's manager runs with leader election id
+``9a8a7ba6.intel.com`` (ref ``cmd/operator/main.go:174-187``); same
+mechanism here: a ``coordination.k8s.io/v1`` Lease named by the election id
+in the operator namespace, acquired by CAS on holderIdentity + renewTime,
+renewed on a timer, released on stop.  Works against both the real
+:class:`..kube.client.ApiClient` and the test :class:`..kube.fake.FakeCluster`
+since both speak create/get/update with Conflict semantics.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+from ..kube import errors as kerr
+
+log = logging.getLogger("tpunet.leader")
+
+ELECTION_ID = "b7e1c2d4.tpunet.dev"   # ref main.go:186 analog
+
+LEASE_DURATION = 15.0
+RENEW_PERIOD = 10.0
+RETRY_PERIOD = 2.0
+
+
+def _now() -> str:
+    t = time.time()
+    frac = int((t % 1) * 1_000_000)
+    return time.strftime(f"%Y-%m-%dT%H:%M:%S.{frac:06d}Z", time.gmtime(t))
+
+
+def _parse(ts: str) -> float:
+    """RFC3339 (as written by _now or a Go client) -> epoch seconds, UTC."""
+    import calendar
+
+    try:
+        base, _, rest = ts.partition(".")
+        secs = calendar.timegm(time.strptime(base, "%Y-%m-%dT%H:%M:%S"))
+        frac = rest.rstrip("Z")
+        return secs + (float("0." + frac) if frac.isdigit() else 0.0)
+    except (ValueError, AttributeError):
+        return 0.0
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        client,
+        namespace: str,
+        identity: Optional[str] = None,
+        name: str = ELECTION_ID,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+        lease_duration: float = LEASE_DURATION,
+        renew_period: float = RENEW_PERIOD,
+        retry_period: float = RETRY_PERIOD,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.name = name
+        self.identity = identity or f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self.retry_period = retry_period
+        self.is_leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lease CAS ------------------------------------------------------------
+
+    def _lease_obj(self) -> dict:
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": int(self.lease_duration),
+                "renewTime": _now(),
+            },
+        }
+
+    def try_acquire_or_renew(self) -> bool:
+        """One election round; returns whether we hold the lease."""
+        try:
+            lease = self.client.get(
+                "coordination.k8s.io/v1", "Lease", self.name, self.namespace
+            )
+        except kerr.NotFoundError:
+            try:
+                self.client.create(self._lease_obj())
+                return True
+            except (kerr.AlreadyExistsError, kerr.ConflictError):
+                return False
+
+        spec = lease.setdefault("spec", {})
+        holder = spec.get("holderIdentity", "")
+        renew = _parse(spec.get("renewTime", ""))
+        expired = (time.time() - renew) > self.lease_duration
+
+        if holder == self.identity or expired or not holder:
+            spec["holderIdentity"] = self.identity
+            spec["renewTime"] = _now()
+            spec["leaseDurationSeconds"] = int(self.lease_duration)
+            try:
+                self.client.update(lease)
+                return True
+            except kerr.ConflictError:
+                return False
+        return False
+
+    def release(self) -> None:
+        if not self.is_leader:
+            return
+        try:
+            lease = self.client.get(
+                "coordination.k8s.io/v1", "Lease", self.name, self.namespace
+            )
+            if lease.get("spec", {}).get("holderIdentity") == self.identity:
+                lease["spec"]["holderIdentity"] = ""
+                self.client.update(lease)
+        except kerr.ApiError:
+            pass
+        self.is_leader = False
+
+    # -- run loop -------------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                got = self.try_acquire_or_renew()
+            except Exception as e:   # noqa: BLE001 — transient apiserver
+                # errors must NOT kill the election thread: a dead thread
+                # with is_leader still True is split-brain once the lease
+                # expires and another replica takes it.  Treat as a failed
+                # renew; the on_stopped_leading callback then stops work.
+                log.warning("leader election round failed: %s", e)
+                got = False
+            if got and not self.is_leader:
+                self.is_leader = True
+                log.info("became leader (%s)", self.identity)
+                if self.on_started_leading:
+                    self.on_started_leading()
+            elif not got and self.is_leader:
+                # lost the lease: controller-runtime exits the process here;
+                # the callback owner decides (manager stops its workers)
+                self.is_leader = False
+                log.warning("lost leadership (%s)", self.identity)
+                if self.on_stopped_leading:
+                    self.on_stopped_leading()
+            self._stop.wait(
+                self.renew_period if self.is_leader else self.retry_period
+            )
+        self.release()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def run_until_leader(self, timeout: float = 0) -> bool:
+        """Blocking acquire (for the operator main): poll until leadership
+        or timeout (0 = forever)."""
+        deadline = time.time() + timeout if timeout else None
+        while not self._stop.is_set():
+            if self.try_acquire_or_renew():
+                self.is_leader = True
+                if self.on_started_leading:
+                    self.on_started_leading()
+                self.start_renewing()
+                return True
+            if deadline and time.time() > deadline:
+                return False
+            self._stop.wait(self.retry_period)
+        return False
+
+    def start_renewing(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        self.release()

@@ -1,0 +1,209 @@
+"""Operator entrypoint (ref ``cmd/operator/main.go:89-230``).
+
+Wires together, in the reference's order: flag parsing + logging, the API
+client, OpenShift autodetect, the webhook server (unless
+``ENABLE_WEBHOOKS=false``), health probes, metrics, leader election
+(``--leader-elect``), and the manager's watch loop.  Blocks until
+SIGINT/SIGTERM.
+
+Flags mirror the reference's: ``--metrics-bind-address`` (default ``0`` =
+off), ``--metrics-secure``, ``--health-probe-bind-address``,
+``--leader-elect`` (default off), plus ``--namespace`` /
+``OPERATOR_NAMESPACE`` (ref ``:138-141``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from ..kube.client import ApiClient, is_openshift
+from .health import DEFAULT as METRICS, HealthServer
+from .leader import LeaderElector
+from .manager import Manager
+from .webhook_server import CERT_DIR, WebhookServer
+
+log = logging.getLogger("tpunet.operator")
+
+
+def _port_of(bind_address: str) -> int:
+    """':8443' -> 8443; '0' -> 0 (disabled)."""
+    if bind_address in ("0", ""):
+        return 0
+    return int(bind_address.rsplit(":", 1)[-1])
+
+
+def _token_review(client, token: str) -> bool:
+    """Authenticate a bearer token via the TokenReview API."""
+    try:
+        result = client.create({
+            "apiVersion": "authentication.k8s.io/v1",
+            "kind": "TokenReview",
+            "metadata": {"name": ""},
+            "spec": {"token": token},
+        })
+        return bool(result.get("status", {}).get("authenticated"))
+    except Exception as e:   # noqa: BLE001 — fail closed
+        log.warning("TokenReview failed: %s", e)
+        return False
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpunet-operator",
+        description="TPU network operator controller manager",
+    )
+    p.add_argument("--metrics-bind-address", default="0",
+                   help="metrics endpoint bind (0 = disabled)")
+    p.add_argument("--metrics-secure", action="store_true",
+                   help="serve metrics with bearer-token protection")
+    p.add_argument("--health-probe-bind-address", default=":8081")
+    p.add_argument("--leader-elect", action="store_true",
+                   help="enable leader election for HA deployments")
+    p.add_argument("--namespace",
+                   default=os.environ.get("OPERATOR_NAMESPACE", "default"),
+                   help="namespace owning agent DaemonSets")
+    p.add_argument("--webhook-port", type=int, default=9443)
+    p.add_argument("--webhook-cert-dir", default=CERT_DIR)
+    p.add_argument("--kube-api", default="",
+                   help="apiserver URL override (default: in-cluster config)")
+    p.add_argument("--zap-log-level", "--v", dest="log_level", default="info")
+    return p
+
+
+def setup_logging(level: str) -> None:
+    levels = {"debug": logging.DEBUG, "info": logging.INFO,
+              "error": logging.ERROR}
+    logging.basicConfig(
+        level=levels.get(level, logging.INFO),
+        format="%(asctime)s\t%(levelname)s\t%(name)s\t%(message)s",
+        stream=sys.stderr,
+    )
+
+
+def run(argv: Optional[List[str]] = None, client=None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(args.log_level)
+
+    if client is None:
+        if args.kube_api:
+            client = ApiClient(args.kube_api,
+                               token=os.environ.get("KUBE_TOKEN"),
+                               insecure=True)
+        else:
+            client = ApiClient.in_cluster()
+
+    openshift = is_openshift(client)
+    log.info("starting manager (namespace=%s, openshift=%s)",
+             args.namespace, openshift)
+
+    mgr = Manager(client, namespace=args.namespace, is_openshift=openshift,
+                  metrics=METRICS)
+
+    servers = []
+    health = None
+    if args.health_probe_bind_address not in ("0", ""):
+        # probes only; /metrics 404s here — the registry is reachable
+        # solely through the (possibly secured) metrics listener below
+        health = HealthServer(
+            port=_port_of(args.health_probe_bind_address), metrics=None
+        )
+        servers.append(health)
+    if _port_of(args.metrics_bind_address):
+        auth = tls_dir = None
+        if args.metrics_secure:
+            # authn via TokenReview (what controller-runtime's
+            # WithAuthenticationAndAuthorization filter does; RBAC for it
+            # ships in deploy/rbac/metrics_auth_role.yaml), TLS via the
+            # cert-manager-mounted serving cert
+            auth = lambda tok: _token_review(client, tok)   # noqa: E731
+            if os.path.exists(f"{args.webhook_cert_dir}/tls.crt"):
+                tls_dir = args.webhook_cert_dir
+            else:
+                log.warning(
+                    "--metrics-secure: no serving cert in %s; metrics "
+                    "served over plain HTTP", args.webhook_cert_dir,
+                )
+        servers.append(HealthServer(
+            port=_port_of(args.metrics_bind_address),
+            metrics=METRICS, metrics_auth=auth, tls_cert_dir=tls_dir,
+        ))
+
+    webhook_server = None
+    if os.environ.get("ENABLE_WEBHOOKS", "").lower() != "false":
+        try:
+            webhook_server = WebhookServer(
+                port=args.webhook_port, cert_dir=args.webhook_cert_dir
+            )
+        except OSError as e:
+            log.error("webhook server unavailable: %s", e)
+            return 1
+
+    stop = threading.Event()
+    try:
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: stop.set())
+    except ValueError:
+        # not the main thread (embedded/test use): caller stops via signal
+        # to the process; the loop below still honors stop_event injection
+        pass
+    run.stop_event = stop   # expose for embedded/test drivers
+
+    started = threading.Event()
+
+    def start_controllers():
+        mgr.start()
+        started.set()
+        log.info("controllers started")
+
+    elector = None
+    if args.leader_elect:
+        elector = LeaderElector(
+            client, args.namespace,
+            on_started_leading=start_controllers,
+            # losing the lease must stop reconcile work immediately:
+            # controller-runtime exits the process and lets the pod restart
+            on_stopped_leading=stop.set,
+        )
+
+    for s in servers:
+        s.start()
+    if webhook_server:
+        webhook_server.start()
+    if health:
+        health.add_readyz("controllers-started", started.is_set)
+
+    if elector:
+        threading.Thread(
+            target=elector.run_until_leader, daemon=True
+        ).start()
+    else:
+        start_controllers()
+
+    log.info("operator running; waiting for signals")
+    stop.wait()
+
+    log.info("shutting down")
+    if elector:
+        elector.stop()
+    mgr.stop()
+    if webhook_server:
+        webhook_server.stop()
+    for s in servers:
+        s.stop()
+    if hasattr(client, "close"):
+        client.close()
+    return 0
+
+
+def main() -> None:
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

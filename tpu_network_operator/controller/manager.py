@@ -22,9 +22,12 @@ log = logging.getLogger("tpunet.manager")
 
 
 class Manager:
-    def __init__(self, client, namespace: str, is_openshift: bool = False):
+    def __init__(
+        self, client, namespace: str, is_openshift: bool = False, metrics=None
+    ):
         self.client = client
         self.namespace = namespace
+        self.metrics = metrics
         self.reconciler = NetworkClusterPolicyReconciler(
             client, namespace, is_openshift
         )
@@ -115,10 +118,17 @@ class Manager:
         try:
             result = self.reconciler.reconcile(name)
             self._failures.pop(name, None)
+            if self.metrics:
+                self.metrics.inc(
+                    "tpunet_reconcile_total",
+                    {"result": "requeue" if result.requeue else "success"},
+                )
             if result.requeue:
                 self.enqueue(name)
         except Exception:
             log.exception("reconcile failed for %s; requeueing with backoff", name)
+            if self.metrics:
+                self.metrics.inc("tpunet_reconcile_total", {"result": "error"})
             self._requeue_after_failure(name)
 
     def start(self) -> None:

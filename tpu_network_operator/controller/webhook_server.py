@@ -1,0 +1,167 @@
+"""Admission webhook HTTP transport.
+
+Serves the AdmissionReview v1 protocol over HTTPS (the controller-runtime
+webhook server analog, ref ``cmd/operator/main.go:149-151`` + webhook paths
+``api/v1alpha1/networkconfiguration_webhook.go:21-28``):
+
+* ``/mutate-tpunet-dev-v1alpha1-networkclusterpolicy``  — defaulting;
+  responds with a JSONPatch when defaults changed the object;
+* ``/validate-tpunet-dev-v1alpha1-networkclusterpolicy`` — validation;
+  allowed=false + message on :class:`AdmissionError`.
+
+TLS mirrors the reference's hardening (ref ``cmd/operator/main.go:122-136``):
+TLS 1.2 minimum and HTTP/2 disabled — h2 is simply never negotiated since
+stdlib http.server speaks HTTP/1.1 only, which is the mitigation the
+reference opts into.  Certs are read from the cert-manager-mounted dir.
+"""
+
+from __future__ import annotations
+
+import base64
+import copy
+import json
+import logging
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from ..api.v1alpha1 import webhook as logic
+from ..api.v1alpha1.types import NetworkClusterPolicy
+
+log = logging.getLogger("tpunet.webhook")
+
+MUTATE_PATH = "/mutate-tpunet-dev-v1alpha1-networkclusterpolicy"
+VALIDATE_PATH = "/validate-tpunet-dev-v1alpha1-networkclusterpolicy"
+CERT_DIR = "/tmp/k8s-webhook-server/serving-certs"
+
+
+def _json_patch(old: Dict[str, Any], new: Dict[str, Any]) -> list:
+    """Minimal JSONPatch: replace changed top-level spec fields.  Defaulting
+    only ever fills fields inside .spec, so patching spec wholesale is both
+    correct and stable."""
+    if old.get("spec") == new.get("spec"):
+        return []
+    return [{"op": "replace", "path": "/spec", "value": new.get("spec", {})}]
+
+
+def review_mutate(review: Dict[str, Any]) -> Dict[str, Any]:
+    """AdmissionReview(request) -> AdmissionReview(response) for defaulting."""
+    req = review.get("request", {})
+    raw = req.get("object", {}) or {}
+    resp: Dict[str, Any] = {"uid": req.get("uid", ""), "allowed": True}
+    try:
+        policy = NetworkClusterPolicy.from_dict(raw)
+        before = copy.deepcopy(policy.to_dict())
+        logic.default_policy(policy)
+        patch = _json_patch(before, policy.to_dict())
+        if patch:
+            resp["patchType"] = "JSONPatch"
+            resp["patch"] = base64.b64encode(
+                json.dumps(patch).encode()
+            ).decode()
+    except Exception as e:   # noqa: BLE001 — malformed object: deny w/ message
+        resp = {
+            "uid": req.get("uid", ""),
+            "allowed": False,
+            "status": {"message": f"defaulting failed: {e}"},
+        }
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "response": resp,
+    }
+
+
+def review_validate(review: Dict[str, Any]) -> Dict[str, Any]:
+    """AdmissionReview(request) -> AdmissionReview(response) for validation."""
+    req = review.get("request", {})
+    raw = req.get("object", {}) or {}
+    resp: Dict[str, Any] = {"uid": req.get("uid", ""), "allowed": True}
+    try:
+        policy = NetworkClusterPolicy.from_dict(raw)
+        op = req.get("operation", "CREATE")
+        if op == "UPDATE":
+            old = NetworkClusterPolicy.from_dict(req.get("oldObject") or {})
+            warnings = logic.validate_update(policy, old)
+        elif op == "DELETE":
+            warnings, _ = logic.validate_delete(policy)
+        else:
+            warnings = logic.validate_create(policy)
+        if warnings:
+            resp["warnings"] = warnings
+    except logic.AdmissionError as e:
+        resp["allowed"] = False
+        resp["status"] = {"message": str(e)}
+    except Exception as e:   # noqa: BLE001
+        resp["allowed"] = False
+        resp["status"] = {"message": f"validation failed: {e}"}
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "response": resp,
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tpunet-webhook"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):   # route to logging, not stderr
+        log.debug("webhook: " + fmt, *args)
+
+    def do_POST(self):   # noqa: N802 — BaseHTTPRequestHandler API
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b"{}"
+        try:
+            review = json.loads(body)
+        except json.JSONDecodeError:
+            self.send_error(400, "invalid JSON")
+            return
+        if self.path == MUTATE_PATH:
+            out = review_mutate(review)
+        elif self.path == VALIDATE_PATH:
+            out = review_validate(review)
+        else:
+            self.send_error(404)
+            return
+        payload = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+class WebhookServer:
+    """HTTPS AdmissionReview server (port 9443, cert-manager certs)."""
+
+    def __init__(
+        self,
+        port: int = 9443,
+        cert_dir: str = CERT_DIR,
+        bind: str = "",
+    ):
+        self.httpd = ThreadingHTTPServer((bind, port), _Handler)
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_2   # ref main.go:122-136
+        ctx.load_cert_chain(f"{cert_dir}/tls.crt", f"{cert_dir}/tls.key")
+        self.httpd.socket = ctx.wrap_socket(
+            self.httpd.socket, server_side=True
+        )
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        log.info("webhook server listening on :%d", self.port)
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
