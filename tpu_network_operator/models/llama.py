@@ -174,6 +174,50 @@ def _activation_spec(cfg: LlamaConfig) -> P:
 # -- forward ------------------------------------------------------------------
 
 
+def _backend() -> str:
+    """Seam for tests: the dispatch's view of the platform (the kernel's
+    interpret-mode switch keeps its own, unpatched view)."""
+    return jax.default_backend()
+
+
+def auto_attention(cfg: LlamaConfig, mesh: Optional[Mesh] = None) -> Callable:
+    """Pick the fastest attention the context allows, at trace time.
+
+    Flash (Pallas) on TPU when the shape gate passes; on a multi-device
+    mesh the kernel must go through ``shard_map`` (a ``pallas_call`` is
+    opaque to the GSPMD partitioner — jit-propagated shardings would
+    replicate it), so it is only used when batch/heads divide the mesh and
+    the ``seq`` axis is trivial (sequence sharding is the ring path's job,
+    :mod:`..parallel.ring`). Everything else falls back to the plain fused
+    XLA attention. All checks are on static shapes, so the choice bakes
+    into the compiled program — no runtime dispatch.
+    """
+    from ..ops import pallas_attention as pa
+
+    def attn(q, k, v):
+        b, sq = q.shape[0], q.shape[1]
+        sk = k.shape[1]
+        if _backend() != "tpu" or not pa.supports(
+            sq, sk, cfg.head_dim
+        ):
+            return causal_attention(q, k, v)
+        if mesh is None or mesh.size == 1:
+            return pa.flash_attention(q, k, v)
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        batch_shards = sizes.get("data", 1) * sizes.get("fsdp", 1)
+        t = sizes.get("tensor", 1)
+        if (
+            sizes.get("seq", 1) > 1
+            or b % batch_shards
+            or cfg.heads % t
+            or cfg.kv_heads % t
+        ):
+            return causal_attention(q, k, v)
+        return pa.sharded_flash_attention(mesh)(q, k, v)
+
+    return attn
+
+
 def _layer(cfg: LlamaConfig, cos, sin, x, lp, attn_fn):
     """One transformer block.  x: [B, S, H]; lp: this layer's params."""
     # attention
@@ -199,9 +243,11 @@ def forward(
     cfg: LlamaConfig,
     attn_fn: Optional[Callable] = None,
 ) -> jnp.ndarray:
-    """Logits [B, S, vocab].  ``attn_fn`` defaults to the fused causal
-    attention; the ring-attention path passes its own (see parallel/ring)."""
-    attn_fn = attn_fn or causal_attention
+    """Logits [B, S, vocab].  ``attn_fn`` defaults to :func:`auto_attention`
+    without mesh context (Pallas flash on single-device TPU, plain fused XLA
+    attention elsewhere); sharded callers get their attn_fn from
+    ``make_train_step``, and the ring path passes its own (parallel/ring)."""
+    attn_fn = attn_fn or auto_attention(cfg)
     x = params["embed"][tokens].astype(cfg.dtype)
     # activation layout (batch over data+fsdp, optional seq sharding) is
     # pinned by the jit in/out shardings; XLA propagates it through the scan
@@ -252,6 +298,7 @@ def make_train_step(
     import optax
 
     optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.1)
+    attn_fn = attn_fn or auto_attention(cfg, mesh)
     p_shard = param_shardings(cfg, mesh)
     tok_shard = NamedSharding(mesh, P(("data", "fsdp"), None))
     repl = NamedSharding(mesh, P())

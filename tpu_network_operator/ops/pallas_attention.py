@@ -1,0 +1,424 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The HBM-bandwidth answer to the plain XLA path in
+:mod:`tpu_network_operator.ops.attention`: the ``[S, S]`` score matrix never
+leaves VMEM.  Forward runs an online-softmax over key blocks; backward is a
+custom VJP with two Pallas kernels (dQ, and per-query-head dK/dV partials
+that are group-summed for GQA outside the kernel).
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+
+* grid is ``(batch, q_heads, num_q_blocks)``; each program holds one query
+  block plus the full K/V for its kv-head in VMEM (fine for the local-chunk
+  lengths this framework runs: long-context beyond VMEM belongs to the ring
+  path in :mod:`tpu_network_operator.parallel.ring`, which shards sequence
+  across devices — its per-chunk math is currently plain XLA);
+* multi-device meshes must NOT call this through jit-propagated shardings
+  (a ``pallas_call`` is opaque to the GSPMD partitioner and would be
+  replicated); use :func:`sharded_flash_attention`, which wraps it in
+  ``shard_map`` over the batch/head axes;
+* GQA without materializing repeated K/V: the K/V BlockSpec index map sends
+  query head ``h`` to kv head ``h // n_rep``;
+* causal blocks that are fully masked are skipped with ``lax.cond`` inside
+  the key-block loop — ~2x fewer MXU FLOPs than mask-after-matmul;
+* f32 softmax state and accumulators, bf16 MXU operands,
+  ``preferred_element_type=f32`` on every dot.
+
+On non-TPU backends the kernels run in interpreter mode, so the same code
+path is exercised by the CPU test suite and the multi-chip dry run.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# TPU blocks need their last dim divisible by 128 (pallas_guide.md tiling
+# table), so per-row softmax state (lse, delta) is carried 128-lanes wide —
+# same layout as jax.experimental.pallas.ops.tpu.flash_attention
+LANES = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_sizes(seq_q: int, seq_k: int, block_q: int, block_k: int) -> Tuple[int, int]:
+    bq = min(block_q, seq_q)
+    bk = min(block_k, seq_k)
+    if seq_q % bq or seq_k % bk:
+        raise ValueError(
+            f"flash attention needs seq_q ({seq_q}) divisible by block_q "
+            f"({bq}) and seq_k ({seq_k}) by block_k ({bk}); pad or use "
+            "ops.attention.causal_attention"
+        )
+    return bq, bk
+
+
+# -- forward ------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale, causal):
+    i = pl.program_id(2)
+    nk = k_ref.shape[2] // bk
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale          # [bq, d]
+    d = q.shape[-1]
+
+    def body(j, carry):
+        m, l, acc = carry
+
+        def compute(carry):
+            m, l, acc = carry
+            k = k_ref[0, 0, pl.ds(j * bk, bk), :]        # [bk, d]
+            v = v_ref[0, 0, pl.ds(j * bk, bk), :]
+            s = jax.lax.dot_general(
+                q.astype(jnp.bfloat16), k,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                            # [bq, bk]
+            if causal:
+                rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+                mask = (i * bq + rows) >= (j * bk + cols)
+                s = jnp.where(mask, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)                       # [bq, bk]
+            alpha = jnp.exp(m - m_new)
+            l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p.astype(jnp.bfloat16), v,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                            # [bq, d]
+            return m_new, l_new, acc * alpha + pv
+
+        if causal:
+            # block j is live iff its first key column <= last query row
+            live = (j * bk) <= (i * bq + bq - 1)
+            return jax.lax.cond(live, compute, lambda c: c, carry)
+        return compute(carry)
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(l), (bq, LANES))
+
+
+def _fwd(q, k, v, *, block_q, block_k, causal):
+    """q: [B, H, S, D]; k, v: [B, Hkv, S, D] -> (out [B,H,S,D], lse [B,H,S])"""
+    b, h, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    n_rep = h // hkv
+    bq, bk = _block_sizes(sq, sk, block_q, block_k)
+    scale = d ** -0.5
+
+    grid = (b, h, sq // bq)
+    kernel = functools.partial(
+        _fwd_kernel, bq=bq, bk=bk, scale=scale, causal=causal
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_ // n_rep, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_ // n_rep, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, LANES), lambda b_, h_, i: (b_, h_, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# -- backward -----------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               *, bq, bk, scale, causal):
+    i = pl.program_id(2)
+    nk = k_ref.shape[2] // bk
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0:1]                           # [bq, 1]
+    delta = delta_ref[0, 0, :, 0:1]                       # [bq, 1]
+    d = q.shape[-1]
+
+    def body(j, dq):
+        def compute(dq):
+            k = k_ref[0, 0, pl.ds(j * bk, bk), :]
+            v = v_ref[0, 0, pl.ds(j * bk, bk), :]
+            s = jax.lax.dot_general(
+                q.astype(jnp.bfloat16), k,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if causal:
+                rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+                s = jnp.where((i * bq + rows) >= (j * bk + cols), s, NEG_INF)
+            p = jnp.exp(s - lse)                          # [bq, bk]
+            dp = jax.lax.dot_general(
+                do.astype(jnp.bfloat16), v,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta)                         # [bq, bk]
+            return dq + jax.lax.dot_general(
+                ds.astype(jnp.bfloat16), k,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        if causal:
+            live = (j * bk) <= (i * bq + bq - 1)
+            return jax.lax.cond(live, compute, lambda x: x, dq)
+        return compute(dq)
+
+    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, bq, bk, scale, causal):
+    j = pl.program_id(2)
+    nq = q_ref.shape[2] // bq
+
+    k = k_ref[0, 0].astype(jnp.float32)                   # [bk, d]
+    v = v_ref[0, 0]                                       # [bk, d]
+    d = k.shape[-1]
+
+    def body(i, carry):
+        dk, dv = carry
+
+        def compute(carry):
+            dk, dv = carry
+            q = q_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32) * scale
+            do = do_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+            lse = lse_ref[0, 0, pl.ds(i * bq, bq), 0:1]
+            delta = delta_ref[0, 0, pl.ds(i * bq, bq), 0:1]
+            s = jax.lax.dot_general(
+                q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                             # [bq, bk]
+            if causal:
+                rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+                s = jnp.where((i * bq + rows) >= (j * bk + cols), s, NEG_INF)
+            p = jnp.exp(s - lse)
+            dv_new = dv + jax.lax.dot_general(
+                p.astype(jnp.bfloat16), do.astype(jnp.bfloat16),
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                             # [bk, d]
+            dp = jax.lax.dot_general(
+                do.astype(jnp.bfloat16), v,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta)
+            dk_new = dk + jax.lax.dot_general(
+                ds.astype(jnp.bfloat16), q.astype(jnp.bfloat16),
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                             # [bk, d]
+            return dk_new, dv_new
+
+        if causal:
+            # query block i sees key block j iff its last row >= first col
+            live = (i * bq + bq - 1) >= (j * bk)
+            return jax.lax.cond(live, compute, lambda c: c, carry)
+        return compute(carry)
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, nq, body, (dk0, dv0))
+    # q was pre-scaled inside body, so dk = Σ dsᵀ·(scale·q) is already the
+    # full ∂L/∂k — no extra scale here
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, out, lse, do, *, block_q, block_k, causal):
+    b, h, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    n_rep = h // hkv
+    bq, bk = _block_sizes(sq, sk, block_q, block_k)
+    scale = d ** -0.5
+
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1, keepdims=True),
+        (b, h, sq, LANES),
+    )                                                     # [B, H, S, LANES]
+
+    kv_spec = pl.BlockSpec(
+        (1, 1, sk, d), lambda b_, h_, i: (b_, h_ // n_rep, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    q_blk = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0),
+                         memory_space=pltpu.VMEM)
+    s_blk = pl.BlockSpec((1, 1, bq, LANES), lambda b_, h_, i: (b_, h_, i, 0),
+                         memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, bq=bq, bk=bk, scale=scale,
+                          causal=causal),
+        grid=(b, h, sq // bq),
+        in_specs=[q_blk, kv_spec, kv_spec, q_blk, s_blk, s_blk],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    # per-query-head dk/dv partials; GQA group-sum happens below in XLA
+    full_spec = pl.BlockSpec(
+        (1, 1, sq, d), lambda b_, h_, j: (b_, h_, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    full_s = pl.BlockSpec((1, 1, sq, LANES), lambda b_, h_, j: (b_, h_, 0, 0),
+                          memory_space=pltpu.VMEM)
+    kv_blk = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j: (b_, h_ // n_rep, j, 0),
+                          memory_space=pltpu.VMEM)
+    dkv_out = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j: (b_, h_, j, 0),
+                           memory_space=pltpu.VMEM)
+    dk_p, dv_p = pl.pallas_call(
+        functools.partial(_dkv_kernel, bq=bq, bk=bk, scale=scale,
+                          causal=causal),
+        grid=(b, h, sk // bk),
+        in_specs=[full_spec, kv_blk, kv_blk, full_spec, full_s, full_s],
+        out_specs=[dkv_out, dkv_out],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    if n_rep > 1:
+        dk_p = dk_p.reshape(b, hkv, n_rep, sk, d).sum(axis=2)
+        dv_p = dv_p.reshape(b, hkv, n_rep, sk, d).sum(axis=2)
+    return dq, dk_p.astype(k.dtype), dv_p.astype(v.dtype)
+
+
+# -- public api (matches ops.attention.causal_attention layout) ---------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhsd(q, k, v, block_q, block_k, causal):
+    out, _ = _fwd(q, k, v, block_q=block_q, block_k=block_k, causal=causal)
+    return out
+
+
+def _flash_bhsd_fwd(q, k, v, block_q, block_k, causal):
+    out, lse = _fwd(q, k, v, block_q=block_q, block_k=block_k, causal=causal)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bhsd_bwd(block_q, block_k, causal, res, do):
+    q, k, v, out, lse = res
+    return _bwd(q, k, v, out, lse, do,
+                block_q=block_q, block_k=block_k, causal=causal)
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,                    # [B, S, H, D]
+    k: jnp.ndarray,                    # [B, S, Hkv, D]
+    v: jnp.ndarray,                    # [B, S, Hkv, D]
+    *,
+    block_q: int = 512,
+    block_k: int = 512,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Drop-in for :func:`...ops.attention.causal_attention` (same layout)."""
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash_bhsd(qt, kt, vt, block_q, block_k, causal)
+    return out.transpose(0, 2, 1, 3)
+
+
+def sharded_flash_attention(mesh, *, block_q: int = 512, block_k: int = 512,
+                            causal: bool = True):
+    """Flash attention for a multi-device mesh.
+
+    A ``pallas_call`` is an opaque custom call: the GSPMD partitioner cannot
+    split it, so calling :func:`flash_attention` under jit with sharded
+    operands would replicate q/k/v onto every device. This wraps the kernel
+    in ``shard_map`` over the model's activation layout — batch over
+    ``(data, fsdp)``, heads over ``tensor`` — so each device runs the kernel
+    on its local shard (attention is independent per batch element and per
+    head; GQA groups stay intact because q- and kv-heads shard by the same
+    ``tensor`` factor). The ``seq`` axis must be unsharded here — sequence
+    sharding is the ring path's job.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    import inspect
+
+    try:
+        from jax import shard_map as _shard_map
+    except ImportError:                                   # jax < 0.8
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+    qspec = P(("data", "fsdp"), None, "tensor", None)
+    # replication checking can't see through a pallas custom call; the
+    # flag was renamed check_rep -> check_vma across jax versions
+    flag = (
+        "check_vma"
+        if "check_vma" in inspect.signature(_shard_map).parameters
+        else "check_rep"
+    )
+
+    @functools.partial(
+        _shard_map, mesh=mesh, in_specs=(qspec, qspec, qspec),
+        out_specs=qspec, **{flag: False},
+    )
+    def attn(q, k, v):
+        return flash_attention(
+            q, k, v, block_q=block_q, block_k=block_k, causal=causal
+        )
+
+    return attn
+
+
+def supports(seq_q: int, seq_k: int, head_dim: int,
+             block_q: int = 512, block_k: int = 512) -> bool:
+    """Shape gate the model uses to decide flash vs plain XLA attention."""
+    bq = min(block_q, seq_q)
+    bk = min(block_k, seq_k)
+    return (
+        seq_q % bq == 0
+        and seq_k % bk == 0
+        and bq % 128 == 0          # keep MXU-tile-aligned blocks
+        and bk % 128 == 0
+        and head_dim % 64 == 0
+    )
