@@ -94,6 +94,32 @@ deployments: ## Render all deployment YAML (for scanning, ref Makefile:142-147)
 helm-package: manifests ## Package the Helm chart
 	helm package charts/tpu-network-operator -d dist/
 
+# OLM bundle/catalog (ref Makefile:281-335, operator-sdk/opm analog)
+BUNDLE_IMG ?= $(IMG_REGISTRY)/tpu-network-operator-bundle:$(VERSION)
+CATALOG_IMG ?= $(IMG_REGISTRY)/tpu-network-operator-catalog:$(VERSION)
+BUNDLE_IMGS ?= $(BUNDLE_IMG)
+
+.PHONY: bundle
+bundle: manifests ## Generate OLM bundle manifests + metadata
+	VERSION=$(VERSION) OPERATOR_IMG=$(OPERATOR_IMG) $(PYTHON) tools/gen_bundle.py
+
+.PHONY: bundle-build
+bundle-build: bundle ## Build the OLM bundle image
+	docker build -f bundle.Dockerfile -t $(BUNDLE_IMG) .
+
+.PHONY: bundle-push
+bundle-push: ## Push the OLM bundle image
+	docker push $(BUNDLE_IMG)
+
+.PHONY: catalog-build
+catalog-build: ## Build a catalog image from bundle images (opm analog)
+	opm index add --container-tool docker --mode semver \
+	  --tag $(CATALOG_IMG) --bundles $(BUNDLE_IMGS)
+
+.PHONY: catalog-push
+catalog-push: ## Push the catalog image
+	docker push $(CATALOG_IMG)
+
 .PHONY: clean
 clean: ## Remove build artifacts
 	rm -rf dist rendered build/__pycache__
