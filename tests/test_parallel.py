@@ -17,11 +17,11 @@ from tpu_network_operator.parallel.ring import ring_attention
 class TestMeshPlanning:
     def test_defaults_fill_fsdp(self):
         plan = plan_axes(8)
-        assert plan.axis_sizes == {"data": 1, "fsdp": 8, "seq": 1, "tensor": 1}
+        assert plan.axis_sizes == {"data": 1, "fsdp": 8, "pipe": 1, "expert": 1, "seq": 1, "tensor": 1}
 
     def test_tensor_and_seq_respected(self):
         plan = plan_axes(8, tensor=2, seq=2)
-        assert plan.axis_sizes == {"data": 1, "fsdp": 2, "seq": 2, "tensor": 2}
+        assert plan.axis_sizes == {"data": 1, "fsdp": 2, "pipe": 1, "expert": 1, "seq": 2, "tensor": 2}
         assert plan.size() == 8
 
     def test_invalid_products_raise(self):
@@ -32,7 +32,7 @@ class TestMeshPlanning:
 
     def test_make_mesh(self):
         mesh = make_mesh(plan_axes(8, tensor=2))
-        assert mesh.shape == {"data": 1, "fsdp": 4, "seq": 1, "tensor": 2}
+        assert mesh.shape == {"data": 1, "fsdp": 4, "pipe": 1, "expert": 1, "seq": 1, "tensor": 2}
 
     def test_mesh_from_bootstrap_multislice(self):
         topo = TpuTopology(

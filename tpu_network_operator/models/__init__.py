@@ -1,4 +1,8 @@
-"""Model zoo for the validation workload (flagship: Llama-3 family)."""
+"""Model zoo for the validation workload.
+
+Families: Llama-3 (dense flagship, :mod:`.llama`) and Mixtral-style
+sparse MoE (expert-parallel, :mod:`.moe`).
+"""
 
 from .llama import (  # noqa: F401
     LlamaConfig,
@@ -8,3 +12,4 @@ from .llama import (  # noqa: F401
     make_train_step,
     param_shardings,
 )
+from .moe import MoEConfig  # noqa: F401

@@ -275,13 +275,10 @@ def loss_fn(
     attn_fn: Optional[Callable] = None,
 ) -> jnp.ndarray:
     """Next-token cross entropy over [B, S]."""
+    from .training import next_token_xent
+
     logits = forward(params, tokens[:, :-1], cfg, attn_fn)
-    targets = tokens[:, 1:]
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(
-        logits, targets[..., None], axis=-1
-    )[..., 0]
-    return jnp.mean(logz - gold)
+    return next_token_xent(logits, tokens)
 
 
 # -- training -----------------------------------------------------------------
@@ -295,32 +292,14 @@ def make_train_step(
 ):
     """Jitted (params, opt_state, tokens) -> (params, opt_state, loss) with
     full sharding annotations over the mesh."""
-    import optax
+    from .training import make_sharded_train_step
 
-    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.1)
     attn_fn = attn_fn or auto_attention(cfg, mesh)
-    p_shard = param_shardings(cfg, mesh)
-    tok_shard = NamedSharding(mesh, P(("data", "fsdp"), None))
-    repl = NamedSharding(mesh, P())
-
-    def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, attn_fn)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
-    step_jit = jax.jit(
-        step,
-        in_shardings=(p_shard, None, tok_shard),
-        out_shardings=(p_shard, None, repl),
-        donate_argnums=(0, 1),
+    return make_sharded_train_step(
+        lambda params, tokens: loss_fn(params, tokens, cfg, attn_fn),
+        partial(init_params, cfg=cfg),
+        param_shardings(cfg, mesh),
+        NamedSharding(mesh, P(("data", "fsdp"), None)),
+        NamedSharding(mesh, P()),
+        optimizer,
     )
-
-    def init_all(key):
-        params = jax.jit(
-            partial(init_params, cfg=cfg), out_shardings=p_shard
-        )(key)
-        opt_state = optimizer.init(params)
-        return params, opt_state
-
-    return step_jit, init_all, optimizer

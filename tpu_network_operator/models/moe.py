@@ -1,0 +1,342 @@
+"""Mixtral-family sparse Mixture-of-Experts transformer, TPU-first.
+
+Second model family of the validation workload (SURVEY.md §7 stage 6):
+exercises *expert parallelism* over the ``expert`` mesh axis — the
+all-to-all token dispatch pattern that stresses ICI differently from the
+dense model's all-reduces, and therefore a distinct probe of the fabric
+the operator provisioned.
+
+TPU-first choices, beyond those shared with :mod:`.llama`:
+
+* GShard-style dense dispatch: top-k routing is materialized as
+  dispatch/combine one-hot tensors and applied with einsums — everything
+  is a static-shape batched matmul on the MXU, no gather/scatter, no
+  dynamic shapes;
+* capacity-based token dropping (``capacity_factor``) keeps per-expert
+  work static; dropped tokens pass through the residual stream untouched
+  (exactly the Switch/GShard semantics);
+* expert weights carry a leading ``experts`` dim sharded on the
+  ``expert`` mesh axis; a sharding constraint on the dispatched
+  activations makes XLA insert the all-to-all (scaling-book recipe — no
+  manual collective);
+* router math in f32 (softmax/top-k are precision-sensitive), expert
+  matmuls in bf16;
+* Switch-style load-balancing auxiliary loss keeps routing trainable.
+
+Reference parity note: the reference has no model code at all (SURVEY.md
+§2 parallelism checklist — ABSENT); this is a framework workload, like the
+HCCL E2E tests the reference leans on (ref README.md:25-27).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import causal_attention
+from ..ops.norms import rms_norm
+from ..ops.rope import apply_rope, rope_angles
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32_000
+    hidden: int = 4096
+    layers: int = 32
+    heads: int = 32
+    kv_heads: int = 8
+    ffn: int = 14_336            # per-expert FFN width
+    experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+    max_seq: int = 32_768
+    rope_theta: float = 1_000_000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+    def capacity(self, tokens_per_group: int) -> int:
+        """Static per-expert capacity for a routing group of that size."""
+        cap = math.ceil(
+            self.experts_per_token * tokens_per_group / self.experts
+            * self.capacity_factor
+        )
+        return max(cap, 1)
+
+    def num_params(self) -> int:
+        """Exact parameter count (all experts; router included)."""
+        per_layer = (
+            self.hidden * (self.heads + 2 * self.kv_heads) * self.head_dim
+            + self.heads * self.head_dim * self.hidden
+            + self.experts * 3 * self.hidden * self.ffn
+            + self.hidden * self.experts
+            + 2 * self.hidden
+        )
+        return (
+            2 * self.vocab_size * self.hidden
+            + self.layers * per_layer
+            + self.hidden
+        )
+
+    # -- presets ------------------------------------------------------------
+
+    @staticmethod
+    def mixtral_8x7b() -> "MoEConfig":
+        return MoEConfig()
+
+    @staticmethod
+    def small() -> "MoEConfig":
+        """~1B-active bench preset."""
+        return MoEConfig(
+            hidden=2048, layers=16, heads=16, kv_heads=8, ffn=5632,
+            experts=8,
+        )
+
+    @staticmethod
+    def tiny(vocab: int = 256) -> "MoEConfig":
+        """Test/dryrun config: small but structurally identical."""
+        return MoEConfig(
+            vocab_size=vocab, hidden=64, layers=2, heads=4, kv_heads=2,
+            ffn=128, experts=4, experts_per_token=2, max_seq=128,
+            remat=False,
+        )
+
+
+# -- parameters ---------------------------------------------------------------
+
+
+def init_params(key: jax.Array, cfg: MoEConfig) -> Params:
+    """Stacked-layer parameter pytree; expert weights carry [L, E, ...]."""
+    keys = jax.random.split(key, 12)
+    h, hd, f, L, E = cfg.hidden, cfg.head_dim, cfg.ffn, cfg.layers, cfg.experts
+    dt = cfg.dtype
+
+    def init(k, shape, fan_in, dtype=dt):
+        return (
+            jax.random.truncated_normal(k, -3, 3, shape, jnp.float32)
+            * (1.0 / math.sqrt(fan_in))
+        ).astype(dtype)
+
+    return {
+        "embed": init(keys[0], (cfg.vocab_size, h), h),
+        "layers": {
+            "wq": init(keys[1], (L, h, cfg.heads * hd), h),
+            "wk": init(keys[2], (L, h, cfg.kv_heads * hd), h),
+            "wv": init(keys[3], (L, h, cfg.kv_heads * hd), h),
+            "wo": init(keys[4], (L, cfg.heads * hd, h), cfg.heads * hd),
+            # router in f32: tiny, precision-sensitive
+            "router": init(keys[5], (L, h, E), h, dtype=jnp.float32),
+            "w_gate": init(keys[6], (L, E, h, f), h),
+            "w_up": init(keys[7], (L, E, h, f), h),
+            "w_down": init(keys[8], (L, E, f, h), f),
+            "ln_attn": jnp.ones((L, h), dt),
+            "ln_mlp": jnp.ones((L, h), dt),
+        },
+        "ln_final": jnp.ones((h,), dt),
+        "lm_head": init(keys[9], (h, cfg.vocab_size), h),
+    }
+
+
+def param_specs(cfg: MoEConfig) -> Params:
+    """PartitionSpecs, same tree shape as params.
+
+    Expert weights shard their experts dim on ``expert`` and follow the
+    dense convention (fsdp on one matmul dim, tensor on the other) within
+    each expert; attention matches :func:`..models.llama.param_specs`.
+    """
+    return {
+        "embed": P("fsdp", "tensor"),
+        "layers": {
+            "wq": P(None, "fsdp", "tensor"),
+            "wk": P(None, "fsdp", "tensor"),
+            "wv": P(None, "fsdp", "tensor"),
+            "wo": P(None, "tensor", "fsdp"),
+            "router": P(None, None, None),
+            "w_gate": P(None, "expert", "fsdp", "tensor"),
+            "w_up": P(None, "expert", "fsdp", "tensor"),
+            "w_down": P(None, "expert", "tensor", "fsdp"),
+            "ln_attn": P(None, None),
+            "ln_mlp": P(None, None),
+        },
+        "ln_final": P(None),
+        "lm_head": P("fsdp", "tensor"),
+    }
+
+
+def param_shardings(cfg: MoEConfig, mesh: Mesh) -> Params:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+# -- routing + expert layer ---------------------------------------------------
+
+
+def route(
+    probs: jnp.ndarray,           # [B, S, E] f32 router softmax
+    k: int,
+    capacity: int,
+):
+    """Top-k capacity routing → (dispatch [B,S,E,C] bool, combine [B,S,E,C] f32).
+
+    Each batch row is a routing group (its tokens compete for the same
+    per-expert capacity slots).  Earlier sequence positions and earlier
+    top-k slots win ties, the GShard priority order.  All shapes static.
+    """
+    e = probs.shape[-1]
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)           # [B,S,k]
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, -1, keepdims=True), 1e-9
+    )
+
+    dispatch = None
+    combine = None
+    counts = jnp.zeros(probs.shape[:1] + (e,), jnp.int32)   # [B,E]
+    for slot in range(k):
+        m = jax.nn.one_hot(gate_idx[..., slot], e, dtype=jnp.int32)  # [B,S,E]
+        # position of each token within its expert's capacity buffer
+        pos_e = jnp.cumsum(m, axis=1) - m + counts[:, None, :]       # [B,S,E]
+        pos = jnp.sum(pos_e * m, axis=-1)                            # [B,S]
+        keep = (pos < capacity)[..., None] * m                       # [B,S,E]
+        pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.int32)      # [B,S,C]
+        d = keep[..., None] * pos_oh[:, :, None, :]                  # [B,S,E,C]
+        w = gate_vals[..., slot][..., None, None].astype(jnp.float32) * d
+        dispatch = d if dispatch is None else dispatch + d
+        combine = w if combine is None else combine + w
+        counts = counts + jnp.sum(m, axis=1)
+    return dispatch.astype(jnp.bool_), combine
+
+
+def _moe_ffn(cfg: MoEConfig, lp: Params, y: jnp.ndarray,
+             mesh: Optional[Mesh] = None):
+    """Sparse expert FFN.  y: [B, S, h] → ([B, S, h], aux_loss scalar)."""
+    b, s, h = y.shape
+    probs = jax.nn.softmax(
+        (y.astype(jnp.float32) @ lp["router"]), axis=-1
+    )                                                      # [B,S,E]
+    cap = cfg.capacity(s)
+    dispatch, combine = route(probs, cfg.experts_per_token, cap)
+
+    # Switch aux loss: experts balanced when dispatch fraction tracks 1/E
+    frac = jnp.mean(
+        jnp.any(dispatch, axis=-1).astype(jnp.float32), axis=(0, 1)
+    )                                                      # [E]
+    aux = cfg.experts * jnp.sum(frac * jnp.mean(probs, axis=(0, 1)))
+
+    # dispatch: [B,S,E,C] x [B,S,h] -> [E,B,C,h]; the sharding constraint
+    # (experts on `expert`, batch staying on data/fsdp) makes XLA lower
+    # this as the expert-parallel all-to-all
+    xin = jnp.einsum(
+        "bsec,bsh->ebch", dispatch.astype(cfg.dtype), y
+    )
+    if mesh is not None:
+        xin = jax.lax.with_sharding_constraint(
+            xin, NamedSharding(mesh, P("expert", ("data", "fsdp"), None, None))
+        )
+    gated = jax.nn.silu(
+        jnp.einsum("ebch,ehf->ebcf", xin, lp["w_gate"])
+    ) * jnp.einsum("ebch,ehf->ebcf", xin, lp["w_up"])
+    out = jnp.einsum("ebcf,efh->ebch", gated, lp["w_down"])
+    # combine: weighted un-dispatch back to [B,S,h] (reverse all-to-all)
+    y_out = jnp.einsum(
+        "ebch,bsec->bsh", out, combine.astype(cfg.dtype)
+    )
+    return y_out, aux
+
+
+def _layer(cfg: MoEConfig, cos, sin, x, lp, attn_fn,
+           mesh: Optional[Mesh] = None):
+    """One MoE transformer block.  x: [B,S,H] → (x', aux)."""
+    y = rms_norm(x, lp["ln_attn"], cfg.rms_eps)
+    b, s, _ = y.shape
+    q = (y @ lp["wq"]).reshape(b, s, cfg.heads, cfg.head_dim)
+    k = (y @ lp["wk"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+    v = (y @ lp["wv"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    a = attn_fn(q, k, v)
+    x = x + a.reshape(b, s, -1) @ lp["wo"]
+
+    y = rms_norm(x, lp["ln_mlp"], cfg.rms_eps)
+    ff, aux = _moe_ffn(cfg, lp, y, mesh)
+    return x + ff, aux
+
+
+# -- forward / loss / training ------------------------------------------------
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,               # [B, S] int32
+    cfg: MoEConfig,
+    attn_fn: Optional[Callable] = None,
+    mesh: Optional[Mesh] = None,
+):
+    """(logits [B,S,vocab] f32, mean router aux loss)."""
+    attn_fn = attn_fn or causal_attention
+    x = params["embed"][tokens].astype(cfg.dtype)
+    cos, sin = rope_angles(tokens.shape[1], cfg.head_dim, cfg.rope_theta)
+
+    def block(x, lp):
+        return _layer(cfg, cos, sin, x, lp, attn_fn, mesh)
+
+    if cfg.remat:
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.nothing_saveable
+        )
+
+    x, auxes = jax.lax.scan(
+        lambda x, lp: block(x, lp), x, params["layers"]
+    )
+    x = rms_norm(x, params["ln_final"], cfg.rms_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, jnp.mean(auxes)
+
+
+def loss_fn(
+    params: Params,
+    tokens: jnp.ndarray,               # [B, S+1]
+    cfg: MoEConfig,
+    attn_fn: Optional[Callable] = None,
+    mesh: Optional[Mesh] = None,
+) -> jnp.ndarray:
+    """Next-token CE + router load-balancing aux."""
+    from .training import next_token_xent
+
+    logits, aux = forward(params, tokens[:, :-1], cfg, attn_fn, mesh)
+    return next_token_xent(logits, tokens) + cfg.router_aux_weight * aux
+
+
+def make_train_step(
+    cfg: MoEConfig,
+    mesh: Mesh,
+    optimizer=None,
+    attn_fn: Optional[Callable] = None,
+):
+    """Jitted (params, opt_state, tokens) -> (params, opt_state, loss),
+    expert-parallel over the mesh's ``expert`` axis."""
+    from .training import make_sharded_train_step
+
+    return make_sharded_train_step(
+        lambda params, tokens: loss_fn(params, tokens, cfg, attn_fn, mesh),
+        partial(init_params, cfg=cfg),
+        param_shardings(cfg, mesh),
+        NamedSharding(mesh, P(("data", "fsdp"), None)),
+        NamedSharding(mesh, P()),
+        optimizer,
+    )

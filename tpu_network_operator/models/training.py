@@ -1,0 +1,59 @@
+"""Shared sharded-training harness for the model zoo.
+
+One implementation of the train-step glue — loss math, adamw default,
+value_and_grad step, jit in/out shardings with donation, sharded init —
+consumed by the dense model (:mod:`.llama`), the MoE model (:mod:`.moe`)
+and the pipeline schedule (:mod:`..parallel.pipeline`), so loss/optimizer
+fixes land in all of them at once.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def next_token_xent(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Mean next-token cross entropy.  logits [B,S,V] f32, tokens [B,S+1]."""
+    targets = tokens[:, 1:]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def make_sharded_train_step(
+    loss_fn: Callable,             # (params, tokens) -> scalar loss
+    init_fn: Callable,             # key -> params
+    p_shard,                       # params sharding pytree
+    tok_shard,                     # tokens sharding
+    repl,                          # replicated sharding (for the loss)
+    optimizer=None,
+):
+    """(step_jit, init_all, optimizer) with the standard contract:
+    step(params, opt_state, tokens) -> (params, opt_state, loss), params
+    and opt_state donated; init_all(key) -> (params, opt_state) sharded."""
+    import optax
+
+    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.1)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    step_jit = jax.jit(
+        step,
+        in_shardings=(p_shard, None, tok_shard),
+        out_shardings=(p_shard, None, repl),
+        donate_argnums=(0, 1),
+    )
+
+    def init_all(key):
+        params = jax.jit(init_fn, out_shardings=p_shard)(key)
+        opt_state = optimizer.init(params)
+        return params, opt_state
+
+    return step_jit, init_all, optimizer

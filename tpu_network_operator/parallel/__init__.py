@@ -18,3 +18,7 @@ from .mesh import (  # noqa: F401
     mesh_from_bootstrap,
     plan_axes,
 )
+from .pipeline import (  # noqa: F401
+    make_pipeline_train_step,
+    pipeline_apply,
+)

@@ -11,10 +11,19 @@ Axis vocabulary (used by models/ and ops/):
 
 * ``data``   — pure data parallelism (gradient psum only; DCN-tolerant)
 * ``fsdp``   — parameter/optimizer sharding (all-gather + reduce-scatter)
+* ``pipe``   — pipeline parallelism over the stacked-layer axis
+  (:mod:`.pipeline`; ppermute neighbour hops between stages)
+* ``expert`` — expert parallelism for MoE models (all-to-all token
+  dispatch, :mod:`..models.moe`)
 * ``tensor`` — Megatron-style tensor parallelism (activation collectives;
   must ride fastest ICI)
 * ``seq``    — sequence/context parallelism for long-context (ring
   attention's ppermute axis)
+
+Order = bandwidth hierarchy: ``data`` is outermost (slowest-varying, the
+only axis that may cross DCN), ``tensor`` innermost (adjacent chips,
+fastest ICI); ``pipe`` stages and ``expert`` groups sit between so their
+ppermute/all-to-all hops stay on ICI.
 """
 
 from __future__ import annotations
@@ -29,7 +38,7 @@ from jax.sharding import Mesh
 
 from ..agent.tpu.bootstrap import BootstrapConfig
 
-AXES = ("data", "fsdp", "seq", "tensor")
+AXES = ("data", "fsdp", "pipe", "expert", "seq", "tensor")
 
 
 @dataclass
@@ -55,21 +64,26 @@ def plan_axes(
     *,
     tensor: int = 1,
     seq: int = 1,
+    expert: int = 1,
+    pipe: int = 1,
     fsdp: Optional[int] = None,
     data: Optional[int] = None,
     dcn_slices: int = 1,
 ) -> MeshPlan:
     """Fill unset axes so the product covers all devices.
 
-    Precedence: ``tensor`` and ``seq`` are taken as given (model-imposed);
-    ``fsdp`` defaults to the remaining intra-slice factor; ``data`` absorbs
-    whatever is left (including the DCN slice axis).
+    Precedence: ``tensor``, ``seq``, ``expert`` and ``pipe`` are taken as
+    given (model-imposed); ``fsdp`` defaults to the remaining intra-slice
+    factor; ``data`` absorbs whatever is left (including the DCN slice
+    axis).
     """
-    if n_devices % (tensor * seq) != 0:
+    fixed = tensor * seq * expert * pipe
+    if n_devices % fixed != 0:
         raise ValueError(
-            f"tensor*seq={tensor * seq} does not divide device count {n_devices}"
+            f"tensor*seq*expert*pipe={fixed} does not divide "
+            f"device count {n_devices}"
         )
-    rest = n_devices // (tensor * seq)
+    rest = n_devices // fixed
     if data is None and fsdp is None and dcn_slices > 1:
         # the DCN slice factor rides the (outermost) data axis
         if rest % dcn_slices != 0:
@@ -83,15 +97,18 @@ def plan_axes(
         raise ValueError(f"fsdp={fsdp} does not divide remainder {rest}")
     if data is None:
         data = rest // fsdp
-    if data * fsdp * seq * tensor != n_devices:
+    if data * fsdp * fixed != n_devices:
         raise ValueError(
-            f"axis product {data}*{fsdp}*{seq}*{tensor} != {n_devices}"
+            f"axis product {data}*{fsdp}*{fixed} != {n_devices}"
         )
     if dcn_slices > 1 and data % dcn_slices != 0:
         raise ValueError(
             f"data axis {data} not divisible by dcn_slices {dcn_slices}"
         )
-    return MeshPlan({"data": data, "fsdp": fsdp, "seq": seq, "tensor": tensor})
+    return MeshPlan({
+        "data": data, "fsdp": fsdp, "pipe": pipe, "expert": expert,
+        "seq": seq, "tensor": tensor,
+    })
 
 
 def make_mesh(
@@ -100,10 +117,11 @@ def make_mesh(
     """Mesh over the given (or all) devices in plan order.
 
     Device order: ``jax.devices()`` enumerates process-major then
-    ICI-topology-major; reshaping that order into (data, fsdp, seq, tensor)
-    puts ``tensor`` on adjacent chips (fastest ICI neighbours) and ``data``
-    across processes/slices (DCN), which is exactly the bandwidth hierarchy
-    the axes demand.
+    ICI-topology-major; reshaping that order into
+    (data, fsdp, pipe, expert, seq, tensor) puts ``tensor`` on adjacent
+    chips (fastest ICI neighbours), pipeline stages and expert groups on
+    near neighbours, and ``data`` across processes/slices (DCN) — the
+    bandwidth hierarchy the axes demand (see module docstring).
     """
     devs = list(devices if devices is not None else jax.devices())
     if len(devs) != plan.size():
@@ -119,6 +137,8 @@ def mesh_from_bootstrap(
     *,
     tensor: int = 1,
     seq: int = 1,
+    expert: int = 1,
+    pipe: int = 1,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
     """Build the job mesh from the operator-emitted bootstrap config.
@@ -128,7 +148,7 @@ def mesh_from_bootstrap(
     """
     topo = cfg.topology
     n = (topo.num_chips * topo.num_slices) if topo else len(jax.devices())
-    plan = plan_axes(n, tensor=tensor, seq=seq,
+    plan = plan_axes(n, tensor=tensor, seq=seq, expert=expert, pipe=pipe,
                      dcn_slices=topo.num_slices if topo else 1)
     return make_mesh(plan, devices)
 
