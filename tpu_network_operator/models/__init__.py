@@ -12,4 +12,15 @@ from .llama import (  # noqa: F401
     make_train_step,
     param_shardings,
 )
+from .generate import generate, make_generate_fn  # noqa: F401
 from .moe import MoEConfig  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy: checkpoint pulls in orbax, which training/dryrun paths that
+    # never checkpoint shouldn't have to have installed
+    if name == "TrainCheckpointer":
+        from .checkpoint import TrainCheckpointer
+
+        return TrainCheckpointer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

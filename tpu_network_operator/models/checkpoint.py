@@ -1,0 +1,133 @@
+"""Sharding-aware checkpoint/resume for the training workloads (orbax).
+
+SURVEY.md §5.4: the reference has no model checkpointing (its analog is
+network-config persistence via systemd-networkd units); the TPU framework
+needs the real thing for its validation workloads.  This wraps orbax's
+``CheckpointManager`` with the conventions the model zoo uses:
+
+* saves the full train state (params + opt_state + step) with each
+  array's ``NamedSharding`` recorded, so restore re-shards onto whatever
+  mesh the resuming job built (elastic resume across mesh shapes of the
+  same device count, or a different sharding plan entirely);
+* async save by default — the train loop keeps stepping while the
+  previous state serializes (HBM→host→disk off the critical path);
+* retention (``max_to_keep``) and step bookkeeping delegated to orbax.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+
+import orbax.checkpoint as ocp
+
+
+class TrainCheckpointer:
+    """Checkpoint manager for (params, opt_state) train state.
+
+    Usage::
+
+        ckpt = TrainCheckpointer(path, max_to_keep=3)
+        ckpt.save(step, params, opt_state)          # async by default
+        step, params, opt_state = ckpt.restore(
+            (params_like, opt_state_like))           # latest step
+        ckpt.close()                                 # drain pending saves
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_to_keep: int = 3,
+        async_save: bool = True,
+        save_interval_steps: int = 1,
+    ):
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=async_save,
+                save_interval_steps=save_interval_steps,
+            ),
+        )
+
+    def save(self, step: int, params: Any, opt_state: Any) -> bool:
+        """Queue a save; returns False when the interval policy skips it."""
+        return self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardSave(params),
+                opt_state=ocp.args.StandardSave(opt_state),
+            ),
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return self._mgr.all_steps()
+
+    def restore(
+        self,
+        templates: Tuple[Any, Any],
+        step: Optional[int] = None,
+    ) -> Tuple[int, Any, Any]:
+        """(step, params, opt_state) restored onto the templates' shardings.
+
+        ``templates`` is a (params, opt_state) pair of arrays OR
+        ``jax.ShapeDtypeStruct``s carrying the target shardings — build it
+        with :func:`abstract_state` to restore without materializing a
+        throwaway init.
+        """
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint found")
+        params_t, opt_t = templates
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(_abstractify(params_t)),
+                opt_state=ocp.args.StandardRestore(_abstractify(opt_t)),
+            ),
+        )
+        return step, restored["params"], restored["opt_state"]
+
+    def wait(self) -> None:
+        """Block until queued async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _abstractify(tree: Any) -> Any:
+    """Arrays → ShapeDtypeStructs keeping shardings (already-abstract
+    leaves pass through)."""
+    def leaf(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        return jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+        )
+    return jax.tree.map(leaf, tree)
+
+
+def abstract_state(init_all, key=None):
+    """Shape/sharding templates for restore without a real init.
+
+    ``init_all`` is the closure returned by the model's
+    ``make_*_train_step``; this evaluates it with ``jax.eval_shape`` so no
+    device memory is allocated.
+    """
+    key = key if key is not None else jax.random.key(0)
+    return jax.eval_shape(init_all, key)

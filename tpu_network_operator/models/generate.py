@@ -1,0 +1,194 @@
+"""Autoregressive generation with a static-shape KV cache, TPU-first.
+
+The inference half of the validation workload: prefill + decode on the
+dense (Llama) family, jit-compiled end to end.
+
+TPU-first choices:
+
+* the KV cache is one static-shape buffer pair ``[L, B, max_len, Hkv, D]``
+  — decode steps write with ``dynamic_update_slice`` and attend over the
+  full buffer under a position mask, so every step is the same compiled
+  program (no growing shapes, no recompiles);
+* the whole decode loop is a single ``lax.scan`` inside one jit — the
+  host never sees intermediate tokens;
+* layer iteration is the same stacked-params ``lax.scan`` as training,
+  with the per-layer cache slices carried as scan xs/ys;
+* cache shardings mirror the training head layout (kv heads on
+  ``tensor``, batch on ``data``/``fsdp``), so a trained sharded
+  checkpoint serves without resharding.
+
+Reference parity note: the reference has no model/inference code
+(SURVEY.md §2) — this is framework workload surface, with no counterpart
+to cite.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import causal_attention
+from ..ops.norms import rms_norm
+from ..ops.rope import apply_rope_at, rope_angles
+from .llama import LlamaConfig, Params
+
+
+def init_cache(
+    cfg: LlamaConfig, batch: int, max_len: int
+) -> Dict[str, jnp.ndarray]:
+    """Zeroed KV cache: k/v of [L, B, max_len, Hkv, D]."""
+    shape = (cfg.layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def cache_specs() -> Dict[str, P]:
+    """PartitionSpecs matching the training head layout."""
+    spec = P(None, ("data", "fsdp"), None, "tensor", None)
+    return {"k": spec, "v": spec}
+
+
+def cache_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, s) for k, s in cache_specs().items()}
+
+
+def _block_with_cache(cfg, cos, sin, pos, x, lp, ck, cv):
+    """One block over cached keys/values.
+
+    x: [B, s, H] new tokens at absolute positions [pos, pos+s);
+    ck/cv: [B, max_len, Hkv, D] this layer's cache.
+    Returns (x', ck', cv').
+    """
+    b, s, _ = x.shape
+    y = rms_norm(x, lp["ln_attn"], cfg.rms_eps)
+    q = (y @ lp["wq"]).reshape(b, s, cfg.heads, cfg.head_dim)
+    k = (y @ lp["wk"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+    v = (y @ lp["wv"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+    positions = pos + jnp.arange(s)
+    q = apply_rope_at(q, cos, sin, positions)
+    k = apply_rope_at(k, cos, sin, positions)
+
+    ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+
+    # q_offset=pos makes query i attend cache slots <= pos+i; unwritten
+    # future slots are masked out by exactly that
+    a = causal_attention(q, ck, cv, q_offset=pos)
+    x = x + a.reshape(b, s, -1) @ lp["wo"]
+
+    y = rms_norm(x, lp["ln_mlp"], cfg.rms_eps)
+    gated = jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"])
+    return x + gated @ lp["w_down"], ck, cv
+
+
+def forward_with_cache(
+    params: Params,
+    tokens: jnp.ndarray,               # [B, s] int32
+    cache: Dict[str, jnp.ndarray],
+    pos,                               # scalar (may be traced)
+    cfg: LlamaConfig,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """(logits [B, s, vocab] f32, updated cache).  Serves both prefill
+    (s = prompt length, pos = 0) and decode (s = 1, pos = current)."""
+    max_len = cache["k"].shape[2]
+    x = params["embed"][tokens].astype(cfg.dtype)
+    cos, sin = rope_angles(max_len, cfg.head_dim, cfg.rope_theta)
+
+    def body(x, layer_in):
+        lp, ck, cv = layer_in
+        x, ck, cv = _block_with_cache(cfg, cos, sin, pos, x, lp, ck, cv)
+        return x, (ck, cv)
+
+    x, (ck, cv) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["ln_final"], cfg.rms_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": ck, "v": cv}
+
+
+def _sample(logits: jnp.ndarray, temperature: float, key) -> jnp.ndarray:
+    """logits [B, V] -> tokens [B].  Greedy at temperature 0."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, logits / temperature, axis=-1
+    ).astype(jnp.int32)
+
+
+def generate(
+    params: Params,
+    prompt: jnp.ndarray,               # [B, S] int32
+    cfg: LlamaConfig,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+) -> jnp.ndarray:
+    """Prompt + sampled continuation, [B, S + max_new_tokens].
+
+    Jit-safe (shapes static in prompt length and budget); greedy when
+    ``temperature == 0`` (then ``key`` is unused).
+    """
+    b, s = prompt.shape
+    max_len = max_len or s + max_new_tokens
+    if max_len < s + max_new_tokens:
+        raise ValueError(
+            f"max_len {max_len} < prompt {s} + new {max_new_tokens}"
+        )
+    if key is None:
+        key = jax.random.key(0)
+
+    cache = init_cache(cfg, b, max_len)
+    logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
+    key, sub = jax.random.split(key)
+    tok = _sample(logits[:, -1], temperature, sub)
+
+    def body(carry, _):
+        tok, pos, cache, key = carry
+        logits, cache = forward_with_cache(
+            params, tok[:, None], cache, pos, cfg
+        )
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits[:, -1], temperature, sub)
+        return (nxt, pos + 1, cache, key), tok
+
+    (tok, _, _, _), toks = jax.lax.scan(
+        body, (tok, jnp.int32(s), cache, key), None,
+        length=max_new_tokens - 1,
+    )
+    return jnp.concatenate([prompt, toks.T, tok[:, None]], axis=1)
+
+
+def make_generate_fn(
+    cfg: LlamaConfig,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    mesh: Optional[Mesh] = None,
+):
+    """Jitted generate with params/prompt shardings pinned when a mesh is
+    given (batch on data/fsdp; params as trained)."""
+    from .llama import param_shardings
+
+    gen = partial(
+        generate, cfg=cfg, max_new_tokens=max_new_tokens,
+        temperature=temperature,
+    )
+    if mesh is None:
+        return jax.jit(gen)
+    return jax.jit(
+        gen,
+        in_shardings=(
+            param_shardings(cfg, mesh),
+            NamedSharding(mesh, P(("data", "fsdp"), None)),
+        ),
+        out_shardings=NamedSharding(mesh, P(("data", "fsdp"), None)),
+    )

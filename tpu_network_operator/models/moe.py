@@ -330,8 +330,12 @@ def make_train_step(
 ):
     """Jitted (params, opt_state, tokens) -> (params, opt_state, loss),
     expert-parallel over the mesh's ``expert`` axis."""
+    from .llama import auto_attention
     from .training import make_sharded_train_step
 
+    # same flash-kernel dispatch as the dense model (auto_attention only
+    # reads heads/kv_heads/head_dim, which MoEConfig shares)
+    attn_fn = attn_fn or auto_attention(cfg, mesh)
     return make_sharded_train_step(
         lambda params, tokens: loss_fn(params, tokens, cfg, attn_fn, mesh),
         partial(init_params, cfg=cfg),

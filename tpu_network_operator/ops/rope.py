@@ -19,17 +19,33 @@ def rope_angles(
     return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
 
 
-def apply_rope(
-    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, offset: int = 0
-) -> jnp.ndarray:
-    """Rotate pairs.  x: [..., seq, heads, head_dim]; tables indexed at
-    [offset : offset+seq].  Interleaved-pair convention (x0,x1),(x2,x3)...
-    matching Llama reference weights."""
-    seq = x.shape[-3]
-    c = cos[offset : offset + seq][:, None, :]   # [seq, 1, hd/2]
-    s = sin[offset : offset + seq][:, None, :]
+def _rotate(x, c, s):
+    """Interleaved-pair rotation (x0,x1),(x2,x3)... matching Llama
+    reference weights.  c/s: [seq, 1, hd/2] broadcast over heads."""
     x1 = x[..., 0::2]
     x2 = x[..., 1::2]
     y1 = x1 * c - x2 * s
     y2 = x1 * s + x2 * c
     return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def apply_rope(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, offset: int = 0
+) -> jnp.ndarray:
+    """Rotate pairs.  x: [..., seq, heads, head_dim]; tables indexed at
+    [offset : offset+seq] (static offset)."""
+    seq = x.shape[-3]
+    c = cos[offset : offset + seq][:, None, :]   # [seq, 1, hd/2]
+    s = sin[offset : offset + seq][:, None, :]
+    return _rotate(x, c, s)
+
+
+def apply_rope_at(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+    positions: jnp.ndarray,
+) -> jnp.ndarray:
+    """Like :func:`apply_rope` but gathering table rows at ``positions``
+    [seq] — which may be traced (decode-time cache offsets)."""
+    c = jnp.take(cos, positions, axis=0)[:, None, :]
+    s = jnp.take(sin, positions, axis=0)[:, None, :]
+    return _rotate(x, c, s)
