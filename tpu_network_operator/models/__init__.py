@@ -12,7 +12,9 @@ from .llama import (  # noqa: F401
     make_train_step,
     param_shardings,
 )
-from .generate import generate, make_generate_fn  # noqa: F401
+# NOTE: only the factory is re-exported — re-exporting the `generate`
+# function would shadow the `models.generate` submodule attribute
+from .generate import make_generate_fn  # noqa: F401
 from .moe import MoEConfig  # noqa: F401
 
 

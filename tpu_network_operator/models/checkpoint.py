@@ -126,8 +126,10 @@ def abstract_state(init_all, key=None):
     """Shape/sharding templates for restore without a real init.
 
     ``init_all`` is the closure returned by the model's
-    ``make_*_train_step``; this evaluates it with ``jax.eval_shape`` so no
-    device memory is allocated.
+    ``make_*_train_step``; its ``abstract=True`` mode compiles (but never
+    executes) the init, so restore targets the right shardings with no
+    throwaway allocation — resuming llama-8B never holds two copies of
+    the train state.
     """
     key = key if key is not None else jax.random.key(0)
-    return jax.eval_shape(init_all, key)
+    return init_all(key, abstract=True)

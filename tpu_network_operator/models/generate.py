@@ -131,14 +131,16 @@ def generate(
     temperature: float = 0.0,
     key: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
+    mesh: Optional[Mesh] = None,
 ) -> jnp.ndarray:
     """Prompt + sampled continuation, [B, S + max_new_tokens].
 
     Jit-safe (shapes static in prompt length and budget); greedy when
-    ``temperature == 0`` (then ``key`` is unused).
+    ``temperature == 0`` (then ``key`` is unused).  With a ``mesh``, the
+    KV cache is pinned to the training head layout (:func:`cache_specs`).
     """
     b, s = prompt.shape
-    max_len = max_len or s + max_new_tokens
+    max_len = max_len if max_len is not None else s + max_new_tokens
     if max_len < s + max_new_tokens:
         raise ValueError(
             f"max_len {max_len} < prompt {s} + new {max_new_tokens}"
@@ -147,6 +149,13 @@ def generate(
         key = jax.random.key(0)
 
     cache = init_cache(cfg, b, max_len)
+    if mesh is not None:
+        cache = {
+            name: jax.lax.with_sharding_constraint(
+                arr, NamedSharding(mesh, cache_specs()[name])
+            )
+            for name, arr in cache.items()
+        }
     logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
     key, sub = jax.random.split(key)
     tok = _sample(logits[:, -1], temperature, sub)
@@ -180,7 +189,7 @@ def make_generate_fn(
 
     gen = partial(
         generate, cfg=cfg, max_new_tokens=max_new_tokens,
-        temperature=temperature,
+        temperature=temperature, mesh=mesh,
     )
     if mesh is None:
         return jax.jit(gen)

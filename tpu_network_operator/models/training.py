@@ -51,9 +51,27 @@ def make_sharded_train_step(
         donate_argnums=(0, 1),
     )
 
-    def init_all(key):
-        params = jax.jit(init_fn, out_shardings=p_shard)(key)
-        opt_state = optimizer.init(params)
-        return params, opt_state
+    def init_all(key, abstract=False):
+        # optimizer.init inside the same jit: its state leaves then carry
+        # mesh-wide shardings (scalars replicated, moments like params) —
+        # required for checkpoint restore to re-commit onto the mesh
+        # instead of a single device
+        def both(key):
+            params = init_fn(key)
+            return params, optimizer.init(params)
+
+        both_jit = jax.jit(both, out_shardings=(p_shard, None))
+        if abstract:
+            # shape/sharding templates without allocating the state —
+            # compile (not execute) to learn the output shardings
+            shardings = both_jit.lower(key).compile().output_shardings
+            shapes = jax.eval_shape(both, key)
+            return jax.tree.map(
+                lambda st, sh: jax.ShapeDtypeStruct(
+                    st.shape, st.dtype, sharding=sh
+                ),
+                shapes, shardings,
+            )
+        return both_jit(key)
 
     return step_jit, init_all, optimizer

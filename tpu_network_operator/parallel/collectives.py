@@ -138,7 +138,7 @@ def sweep(
 ) -> List[CollectiveResult]:
     """The all-reduce sweep of BASELINE configs 2/5: sizes × ops over an
     axis; returns per-point results (peak busbw is the headline number)."""
-    ops = ops or ["all_reduce"]
+    ops = ops or ["all_reduce", "all_gather", "reduce_scatter", "ppermute"]
     sizes_mb = sizes_mb or [1.0, 8.0, 64.0, 256.0]
     out = []
     for op in ops:

@@ -147,9 +147,10 @@ def mesh_from_bootstrap(
     keeping every inner axis intra-slice (pure ICI).
     """
     topo = cfg.topology
-    n = (topo.num_chips * topo.num_slices) if topo else len(jax.devices())
+    have_topo = topo is not None and topo.num_chips > 0
+    n = (topo.num_chips * topo.num_slices) if have_topo else len(jax.devices())
     plan = plan_axes(n, tensor=tensor, seq=seq, expert=expert, pipe=pipe,
-                     dcn_slices=topo.num_slices if topo else 1)
+                     dcn_slices=topo.num_slices if have_topo else 1)
     return make_mesh(plan, devices)
 
 

@@ -1,0 +1,351 @@
+"""Validation-workload runner: the consuming end of the operator contract.
+
+``python -m tpu_network_operator.workload <subcommand>`` is what a user
+(or the e2e harness) schedules onto operator-labeled nodes
+(``tpu-scale-out=true``).  It closes the provisioning loop the reference
+delegates to Habana's HCCL E2E docs (ref README.md:25-27): read the
+bootstrap file the node agent emitted, ``jax.distributed.initialize``
+from it, build the mesh, and run the workload (SURVEY.md §7 stage 6,
+BASELINE.md configs 2-5).
+
+Subcommands:
+
+* ``collectives`` — psum/all-gather/reduce-scatter/ppermute bandwidth
+  sweep over a mesh axis (the BASELINE "JAX all-reduce GB/s over ICI"
+  contract metric);
+* ``train`` — N steps of the dense or MoE model with any mix of
+  dp/fsdp/tp/sp/ep/pp, reporting tokens/sec/chip; optional orbax
+  checkpointing (resumes from the latest step when the directory holds
+  one);
+* ``generate`` — jitted KV-cache decode throughput (tokens/sec).
+
+Every subcommand takes ``--bootstrap <path>``; without it the job runs
+single-process on the locally visible devices (the dev loop).  Passing
+``--profile <dir>`` wraps the timed region in ``jax.profiler.trace`` —
+the captured trace (TensorBoard/XProf format) shows MXU utilization, HBM
+traffic and the ICI collectives the mesh layout produced, which is how
+sharding layouts get validated on hardware (SURVEY.md §5.1: the
+reference has no tracing; this framework treats it as a first-class
+workload flag).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Optional
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _init_distributed(bootstrap_path: Optional[str]):
+    """Returns (bootstrap_cfg | None).  Initializes jax.distributed when a
+    bootstrap file is given — the operator-provisioned path."""
+    if not bootstrap_path:
+        return None
+    from .agent.tpu.bootstrap import read_bootstrap
+    from .parallel import distributed_init_from_bootstrap
+
+    cfg = read_bootstrap(bootstrap_path)
+    distributed_init_from_bootstrap(cfg)
+    log(
+        f"jax.distributed initialized: process {cfg.process_id}/"
+        f"{cfg.num_processes} coordinator {cfg.coordinator_address}"
+    )
+    return cfg
+
+
+def _build_mesh(args, bootstrap):
+    import jax
+
+    from .parallel import make_mesh, mesh_from_bootstrap, plan_axes
+
+    kw = dict(tensor=args.tensor, seq=args.seq,
+              expert=getattr(args, "expert", 1),
+              pipe=getattr(args, "pipe", 1))
+    if bootstrap is not None:
+        return mesh_from_bootstrap(bootstrap, **kw)
+    return make_mesh(plan_axes(len(jax.devices()), **kw))
+
+
+def _emit(payload: dict) -> None:
+    print(json.dumps(payload))
+
+
+class _maybe_profile:
+    """jax.profiler.trace(dir) when --profile was given, else no-op."""
+
+    def __init__(self, directory: Optional[str]):
+        self._dir = directory
+
+    def __enter__(self):
+        if self._dir:
+            import jax
+
+            jax.profiler.start_trace(self._dir)
+            log(f"profiling to {self._dir}")
+        return self
+
+    def __exit__(self, *exc):
+        if self._dir:
+            import jax
+
+            jax.profiler.stop_trace()
+        return False
+
+
+# -- subcommands --------------------------------------------------------------
+
+
+def cmd_collectives(args) -> int:
+    bootstrap = _init_distributed(args.bootstrap)
+    import jax
+
+    from .parallel.collectives import peak_busbw, sweep
+
+    mesh = _build_mesh(args, bootstrap)
+    axis = args.axis or max(mesh.shape, key=lambda a: mesh.shape[a])
+    if mesh.shape[axis] < 2:
+        log(f"axis {axis!r} has size {mesh.shape[axis]}; nothing to sweep")
+        _emit({"metric": "collective busbw", "value": 0.0, "unit": "GB/s",
+               "axis": axis, "devices": len(jax.devices())})
+        return 0
+    with _maybe_profile(args.profile):
+        results = sweep(
+            mesh, axis=axis, sizes_mb=args.sizes_mb, iters=args.iters
+        )
+    for r in results:
+        log(f"{r.op:15s} {r.size_bytes >> 20:5d}MB "
+            f"alg {r.algbw_gbps:8.2f} GB/s bus {r.busbw_gbps:8.2f} GB/s")
+    _emit({
+        "metric": "collective busbw",
+        "value": round(peak_busbw(results), 2),
+        "unit": "GB/s",
+        "axis": axis,
+        "axis_size": mesh.shape[axis],
+        "results": [r.to_dict() for r in results],
+    })
+    return 0
+
+
+def cmd_train(args) -> int:
+    bootstrap = _init_distributed(args.bootstrap)
+    import jax
+    import jax.numpy as jnp
+
+    mesh = _build_mesh(args, bootstrap)
+    n = mesh.size
+
+    if args.model == "moe":
+        from .models.moe import MoEConfig, make_train_step
+
+        cfg = {
+            "tiny": MoEConfig.tiny,
+            "small": MoEConfig.small,
+            "mixtral-8x7b": MoEConfig.mixtral_8x7b,
+        }[args.preset]()
+        step, init_all, _ = make_train_step(cfg, mesh)
+    else:
+        from .models import LlamaConfig
+        from .models.llama import make_train_step
+
+        cfg = {
+            "tiny": LlamaConfig.tiny,
+            "llama3-1b": LlamaConfig.llama3_1b,
+            "llama3-3b": LlamaConfig.llama3_3b,
+            "llama3-8b": LlamaConfig.llama3_8b,
+        }[args.preset]()
+        if args.pipe > 1:
+            from .parallel import make_pipeline_train_step
+
+            step, init_all, _ = make_pipeline_train_step(
+                cfg, mesh, n_microbatches=args.microbatches
+            )
+        else:
+            attn_fn = None
+            if args.seq > 1:
+                from .parallel.ring import make_ring_attn_fn
+
+                attn_fn = make_ring_attn_fn(mesh)
+            step, init_all, _ = make_train_step(cfg, mesh, attn_fn=attn_fn)
+
+    start_step = 0
+    ckpt = None
+    if args.checkpoint_dir:
+        from .models.checkpoint import TrainCheckpointer, abstract_state
+
+        ckpt = TrainCheckpointer(
+            args.checkpoint_dir, max_to_keep=args.keep_checkpoints
+        )
+        if ckpt.latest_step() is not None:
+            # restore onto abstract templates: never materialize a
+            # throwaway init alongside the restored state
+            start_step, params, opt_state = ckpt.restore(
+                abstract_state(init_all)
+            )
+            log(f"resumed from checkpoint step {start_step}")
+        else:
+            params, opt_state = init_all(jax.random.key(0))
+    else:
+        params, opt_state = init_all(jax.random.key(0))
+
+    tokens = jax.random.randint(
+        jax.random.key(1), (args.batch, args.seq_len + 1), 0,
+        cfg.vocab_size, jnp.int32,
+    )
+
+    def maybe_save(i: int, last: int):
+        if ckpt is not None and (
+            i == last
+            or (args.checkpoint_every and i % args.checkpoint_every == 0)
+        ):
+            ckpt.save(i, params, opt_state)
+
+    # the compile step is optimizer update #start_step+1 — counted, so
+    # checkpoint step labels always equal real update counts
+    last = start_step + args.steps
+    t0 = time.perf_counter()
+    params, opt_state, loss = step(params, opt_state, tokens)
+    loss_val = float(jax.device_get(loss))
+    compile_dt = time.perf_counter() - t0
+    log(f"first step (incl. compile) {compile_dt:.1f}s loss {loss_val:.4f}")
+    maybe_save(start_step + 1, last)
+
+    timed_steps = args.steps - 1
+    t0 = time.perf_counter()
+    with _maybe_profile(args.profile):
+        for i in range(start_step + 2, last + 1):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            maybe_save(i, last)
+        loss_val = float(jax.device_get(loss))
+    dt = time.perf_counter() - t0
+    if ckpt is not None:
+        ckpt.close()
+
+    if timed_steps == 0:
+        log("steps=1: throughput includes compile time")
+        timed_steps, dt = 1, compile_dt
+    tps_chip = args.batch * args.seq_len * timed_steps / dt / n
+    _emit({
+        "metric": f"{args.model}:{args.preset} train throughput",
+        "value": round(tps_chip, 1),
+        "unit": "tokens/sec/chip",
+        "steps": args.steps,
+        "final_loss": round(loss_val, 4),
+        "mesh": dict(mesh.shape),
+        "resumed_from": start_step,
+    })
+    return 0
+
+
+def cmd_generate(args) -> int:
+    bootstrap = _init_distributed(args.bootstrap)
+    import jax
+    import jax.numpy as jnp
+
+    from .models import LlamaConfig
+    from .models.generate import make_generate_fn
+    from .models.llama import init_params, param_shardings
+
+    mesh = _build_mesh(args, bootstrap)
+    cfg = {
+        "tiny": LlamaConfig.tiny,
+        "llama3-1b": LlamaConfig.llama3_1b,
+        "llama3-3b": LlamaConfig.llama3_3b,
+        "llama3-8b": LlamaConfig.llama3_8b,
+    }[args.preset]()
+
+    params = jax.jit(
+        lambda k: init_params(k, cfg),
+        out_shardings=param_shardings(cfg, mesh),
+    )(jax.random.key(0))
+    prompt = jnp.ones((args.batch, args.prompt_len), jnp.int32)
+    gen = make_generate_fn(
+        cfg, args.max_new_tokens, temperature=args.temperature, mesh=mesh
+    )
+
+    t0 = time.perf_counter()
+    out = jax.device_get(gen(params, prompt))
+    log(f"first call (incl. compile) {time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    with _maybe_profile(args.profile):
+        out = jax.device_get(gen(params, prompt))
+    dt = time.perf_counter() - t0
+
+    _emit({
+        "metric": f"{args.preset} decode throughput",
+        "value": round(args.batch * args.max_new_tokens / dt, 1),
+        "unit": "tokens/sec",
+        "batch": args.batch,
+        "new_tokens": args.max_new_tokens,
+        "out_shape": list(out.shape),
+        "mesh": dict(mesh.shape),
+    })
+    return 0
+
+
+# -- cli ----------------------------------------------------------------------
+
+
+def _mesh_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--bootstrap", default=None,
+                   help="operator-emitted jax-coordinator.json path")
+    p.add_argument("--tensor", type=int, default=1)
+    p.add_argument("--seq", type=int, default=1)
+    p.add_argument("--expert", type=int, default=1)
+    p.add_argument("--pipe", type=int, default=1)
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the timed region")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-network-operator-workload",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("collectives", help="ICI/DCN bandwidth sweep")
+    _mesh_flags(c)
+    c.add_argument("--axis", default=None, help="mesh axis (default: largest)")
+    c.add_argument("--sizes-mb", type=float, nargs="+",
+                   default=[16.0, 64.0, 256.0])
+    c.add_argument("--iters", type=int, default=5)
+    c.set_defaults(fn=cmd_collectives)
+
+    t = sub.add_parser("train", help="training throughput")
+    _mesh_flags(t)
+    t.add_argument("--model", choices=["llama", "moe"], default="llama")
+    t.add_argument("--preset", default="tiny")
+    t.add_argument("--steps", type=int, default=10)
+    t.add_argument("--batch", type=int, default=8)
+    t.add_argument("--seq-len", type=int, default=128)
+    t.add_argument("--microbatches", type=int, default=4)
+    t.add_argument("--checkpoint-dir", default=None)
+    t.add_argument("--checkpoint-every", type=int, default=0)
+    t.add_argument("--keep-checkpoints", type=int, default=3)
+    t.set_defaults(fn=cmd_train)
+
+    g = sub.add_parser("generate", help="decode throughput")
+    _mesh_flags(g)
+    g.add_argument("--preset", default="tiny")
+    g.add_argument("--batch", type=int, default=4)
+    g.add_argument("--prompt-len", type=int, default=16)
+    g.add_argument("--max-new-tokens", type=int, default=32)
+    g.add_argument("--temperature", type=float, default=0.0)
+    g.set_defaults(fn=cmd_generate)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
