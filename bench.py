@@ -148,8 +148,10 @@ def main() -> None:
         )
 
         axis = max(plan.axis_sizes, key=lambda a: plan.axis_sizes[a])
-        results = sweep(mesh, axis=axis, sizes_mb=[16.0, 64.0, 256.0],
-                        iters=5)
+        # all_reduce only: the headline metric is the BASELINE all-reduce
+        # busbw; sweep() defaults to all four ops for the workload CLI
+        results = sweep(mesh, axis=axis, ops=["all_reduce"],
+                        sizes_mb=[16.0, 64.0, 256.0], iters=5)
         extras["ici_allreduce_busbw_gbps"] = round(peak_busbw(results), 2)
 
     target = TARGETS.get((kind, name))

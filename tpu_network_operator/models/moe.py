@@ -63,6 +63,7 @@ class MoEConfig:
     rms_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    remat_policy: str = "dots"          # see LlamaConfig.remat_policy
 
     @property
     def head_dim(self) -> int:
@@ -296,9 +297,9 @@ def forward(
         return _layer(cfg, cos, sin, x, lp, attn_fn, mesh)
 
     if cfg.remat:
-        block = jax.checkpoint(
-            block, policy=jax.checkpoint_policies.nothing_saveable
-        )
+        from .training import remat_policy
+
+        block = jax.checkpoint(block, policy=remat_policy(cfg))
 
     x, auxes = jax.lax.scan(
         lambda x, lp: block(x, lp), x, params["layers"]

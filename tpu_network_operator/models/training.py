@@ -15,6 +15,16 @@ import jax
 import jax.numpy as jnp
 
 
+def remat_policy(cfg):
+    """Checkpoint policy from a model config's ``remat_policy`` field:
+    "dots" saves matmul outputs (faster), "full" saves nothing (min HBM)."""
+    return (
+        jax.checkpoint_policies.dots_saveable
+        if getattr(cfg, "remat_policy", "dots") == "dots"
+        else jax.checkpoint_policies.nothing_saveable
+    )
+
+
 def next_token_xent(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     """Mean next-token cross entropy.  logits [B,S,V] f32, tokens [B,S+1]."""
     targets = tokens[:, 1:]

@@ -178,9 +178,9 @@ def make_pipeline_train_step(
             return llama._layer(cfg, cos, sin, x, lp, attn_fn)
 
         if cfg.remat:
-            block = jax.checkpoint(
-                block, policy=jax.checkpoint_policies.nothing_saveable
-            )
+            from ..models.training import remat_policy
+
+            block = jax.checkpoint(block, policy=remat_policy(cfg))
 
         x = pipeline_apply(
             block, params["layers"], x, mesh, n_microbatches

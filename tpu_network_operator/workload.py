@@ -76,6 +76,40 @@ def _emit(payload: dict) -> None:
     print(json.dumps(payload))
 
 
+def _llama_presets():
+    from .models import LlamaConfig
+
+    return {
+        "tiny": LlamaConfig.tiny,
+        "llama3-1b": LlamaConfig.llama3_1b,
+        "llama3-3b": LlamaConfig.llama3_3b,
+        "llama3-8b": LlamaConfig.llama3_8b,
+    }
+
+
+def _moe_presets():
+    from .models.moe import MoEConfig
+
+    return {
+        "tiny": MoEConfig.tiny,
+        "small": MoEConfig.small,
+        "mixtral-8x7b": MoEConfig.mixtral_8x7b,
+    }
+
+
+LLAMA_PRESET_NAMES = ("tiny", "llama3-1b", "llama3-3b", "llama3-8b")
+MOE_PRESET_NAMES = ("tiny", "small", "mixtral-8x7b")
+
+
+def _pick_preset(presets: dict, name: str, model: str):
+    if name not in presets:
+        raise SystemExit(
+            f"unknown preset {name!r} for model {model!r}; "
+            f"choose from {sorted(presets)}"
+        )
+    return presets[name]()
+
+
 class _maybe_profile:
     """jax.profiler.trace(dir) when --profile was given, else no-op."""
 
@@ -109,6 +143,10 @@ def cmd_collectives(args) -> int:
 
     mesh = _build_mesh(args, bootstrap)
     axis = args.axis or max(mesh.shape, key=lambda a: mesh.shape[a])
+    if axis not in mesh.shape:
+        raise SystemExit(
+            f"unknown mesh axis {axis!r}; choose from {list(mesh.shape)}"
+        )
     if mesh.shape[axis] < 2:
         log(f"axis {axis!r} has size {mesh.shape[axis]}; nothing to sweep")
         _emit({"metric": "collective busbw", "value": 0.0, "unit": "GB/s",
@@ -137,28 +175,31 @@ def cmd_train(args) -> int:
     import jax
     import jax.numpy as jnp
 
+    # reject axis requests the selected model path won't use — the mesh
+    # would carve devices onto a dead axis and silently replicate compute
+    if args.model == "moe":
+        if args.pipe > 1 or args.seq > 1:
+            raise SystemExit(
+                "--pipe/--seq are not supported with --model moe "
+                "(no pipeline or ring-attention path for MoE yet)"
+            )
+    elif args.expert > 1:
+        raise SystemExit("--expert requires --model moe")
+    if args.model != "moe" and args.pipe > 1 and args.seq > 1:
+        raise SystemExit("--pipe and --seq cannot be combined yet")
+
     mesh = _build_mesh(args, bootstrap)
     n = mesh.size
 
     if args.model == "moe":
-        from .models.moe import MoEConfig, make_train_step
+        from .models.moe import make_train_step
 
-        cfg = {
-            "tiny": MoEConfig.tiny,
-            "small": MoEConfig.small,
-            "mixtral-8x7b": MoEConfig.mixtral_8x7b,
-        }[args.preset]()
+        cfg = _pick_preset(_moe_presets(), args.preset, "moe")
         step, init_all, _ = make_train_step(cfg, mesh)
     else:
-        from .models import LlamaConfig
         from .models.llama import make_train_step
 
-        cfg = {
-            "tiny": LlamaConfig.tiny,
-            "llama3-1b": LlamaConfig.llama3_1b,
-            "llama3-3b": LlamaConfig.llama3_3b,
-            "llama3-8b": LlamaConfig.llama3_8b,
-        }[args.preset]()
+        cfg = _pick_preset(_llama_presets(), args.preset, "llama")
         if args.pipe > 1:
             from .parallel import make_pipeline_train_step
 
@@ -247,17 +288,11 @@ def cmd_generate(args) -> int:
     import jax
     import jax.numpy as jnp
 
-    from .models import LlamaConfig
     from .models.generate import make_generate_fn
     from .models.llama import init_params, param_shardings
 
     mesh = _build_mesh(args, bootstrap)
-    cfg = {
-        "tiny": LlamaConfig.tiny,
-        "llama3-1b": LlamaConfig.llama3_1b,
-        "llama3-3b": LlamaConfig.llama3_3b,
-        "llama3-8b": LlamaConfig.llama3_8b,
-    }[args.preset]()
+    cfg = _pick_preset(_llama_presets(), args.preset, "llama")
 
     params = jax.jit(
         lambda k: init_params(k, cfg),
@@ -333,7 +368,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     g = sub.add_parser("generate", help="decode throughput")
     _mesh_flags(g)
-    g.add_argument("--preset", default="tiny")
+    g.add_argument("--preset", default="tiny", choices=LLAMA_PRESET_NAMES)
     g.add_argument("--batch", type=int, default=4)
     g.add_argument("--prompt-len", type=int, default=16)
     g.add_argument("--max-new-tokens", type=int, default=32)
