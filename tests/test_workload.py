@@ -127,3 +127,17 @@ def test_train_rejects_unknown_preset():
 def test_collectives_rejects_unknown_axis():
     with pytest.raises(SystemExit, match="unknown mesh axis"):
         main(["collectives", "--axis", "bogus", "--sizes-mb", "1"])
+
+
+def test_train_from_token_file(capsys, tmp_path):
+    import numpy as np
+
+    path = tmp_path / "tokens.bin"
+    (np.arange(50_000, dtype=np.uint16) % 250).tofile(path)
+    r = run(capsys, [
+        "train", "--preset", "tiny", "--steps", "2", "--batch", "8",
+        "--seq-len", "32", "--data", str(path),
+    ])
+    assert r["value"] > 0
+    # structured data (repeating ramp) is learnable: loss must be sane
+    assert 0 < r["final_loss"] < 8

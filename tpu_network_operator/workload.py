@@ -234,10 +234,23 @@ def cmd_train(args) -> int:
     else:
         params, opt_state = init_all(jax.random.key(0))
 
-    tokens = jax.random.randint(
-        jax.random.key(1), (args.batch, args.seq_len + 1), 0,
-        cfg.vocab_size, jnp.int32,
-    )
+    if args.data:
+        from .data import DataConfig, MemmapTokens, sharded_batches
+
+        # resumable by construction: the iterator starts at the restored
+        # step, reproducing exactly the batches an uninterrupted run sees
+        data_it = sharded_batches(
+            MemmapTokens(args.data, vocab_size=cfg.vocab_size),
+            DataConfig(batch=args.batch, seq_len=args.seq_len),
+            mesh, start_step=start_step,
+        )
+        next_batch = lambda: next(data_it)   # noqa: E731
+    else:
+        tokens = jax.random.randint(
+            jax.random.key(1), (args.batch, args.seq_len + 1), 0,
+            cfg.vocab_size, jnp.int32,
+        )
+        next_batch = lambda: tokens          # noqa: E731
 
     def maybe_save(i: int, last: int):
         if ckpt is not None and (
@@ -250,7 +263,7 @@ def cmd_train(args) -> int:
     # checkpoint step labels always equal real update counts
     last = start_step + args.steps
     t0 = time.perf_counter()
-    params, opt_state, loss = step(params, opt_state, tokens)
+    params, opt_state, loss = step(params, opt_state, next_batch())
     loss_val = float(jax.device_get(loss))
     compile_dt = time.perf_counter() - t0
     log(f"first step (incl. compile) {compile_dt:.1f}s loss {loss_val:.4f}")
@@ -260,7 +273,7 @@ def cmd_train(args) -> int:
     t0 = time.perf_counter()
     with _maybe_profile(args.profile):
         for i in range(start_step + 2, last + 1):
-            params, opt_state, loss = step(params, opt_state, tokens)
+            params, opt_state, loss = step(params, opt_state, next_batch())
             maybe_save(i, last)
         loss_val = float(jax.device_get(loss))
     dt = time.perf_counter() - t0
@@ -360,6 +373,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--steps", type=int, default=10)
     t.add_argument("--batch", type=int, default=8)
     t.add_argument("--seq-len", type=int, default=128)
+    t.add_argument("--data", default=None, metavar="TOKENS.bin",
+                   help="memmapped token file (uint16/uint32); default: "
+                        "synthetic fixed batch")
     t.add_argument("--microbatches", type=int, default=4)
     t.add_argument("--checkpoint-dir", default=None)
     t.add_argument("--checkpoint-every", type=int, default=0)
