@@ -1,0 +1,122 @@
+"""Fuzz tier for the workload layer: routing, data pipeline, and mesh
+planning invariants under randomized configurations.
+
+Same philosophy as test_fuzz.py (ref ``test/fuzz/fuzz_test.go``): seeded
+RNG, many random draws per run, oracles that are *invariants* rather than
+golden values.  Failures print the seed for replay.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+SEED = random.SystemRandom().randrange(1 << 32)
+
+
+@pytest.fixture(scope="module")
+def rng():
+    print(f"\nfuzz seed: {SEED}")
+    return random.Random(SEED)
+
+
+class TestRoutingInvariants:
+    """GShard routing must hold its invariants for ANY router output."""
+
+    def test_route_invariants(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_network_operator.models.moe import route
+
+        for trial in range(25):
+            b = rng.choice([1, 2, 4])
+            s = rng.choice([4, 16, 64])
+            e = rng.choice([2, 4, 8])
+            k = rng.randint(1, min(e, 3))
+            cap = rng.randint(1, s)
+            key = jax.random.key(rng.randrange(1 << 30))
+            probs = jax.nn.softmax(
+                jax.random.normal(key, (b, s, e)) * rng.uniform(0.1, 8.0),
+                axis=-1,
+            )
+            dispatch, combine = route(probs, k, cap)
+            d = np.asarray(dispatch)
+            c = np.asarray(combine)
+            ctx = f"seed={SEED} trial={trial} b={b} s={s} e={e} k={k} cap={cap}"
+
+            # capacity never exceeded
+            assert (d.sum(axis=(1, 3)) <= cap).all(), ctx
+            # each capacity slot holds at most one token
+            assert (d.sum(axis=1) <= 1).all(), ctx
+            # each token dispatched at most k times
+            assert (d.sum(axis=(2, 3)) <= k).all(), ctx
+            # combine weights only where dispatched, in [0, 1], sum <= 1
+            assert (c[~d.astype(bool)] == 0).all(), ctx
+            assert (c >= 0).all() and (c <= 1.0 + 1e-5).all(), ctx
+            assert (c.sum(axis=(2, 3)) <= 1.0 + 1e-5).all(), ctx
+            # ample capacity => nothing dropped
+            if cap >= s * k:
+                assert (d.sum(axis=(2, 3)) == k).all(), ctx
+
+
+class TestDataPipelineInvariants:
+    def test_windows_in_bounds_and_partition(self, rng):
+        from tpu_network_operator.data import (
+            DataConfig,
+            SyntheticTokens,
+            local_batches,
+        )
+
+        for trial in range(25):
+            total = rng.randint(100, 5_000)
+            seq = rng.choice([8, 16, 32])
+            if total < seq + 1:
+                continue
+            procs = rng.choice([1, 2, 4])
+            batch = procs * rng.randint(1, 4)
+            vocab = rng.randint(2, 1000)
+            cfg = DataConfig(
+                batch=batch, seq_len=seq, seed=rng.randrange(1 << 20)
+            )
+            src = SyntheticTokens(vocab, total=total, seed=trial)
+            ctx = f"seed={SEED} trial={trial} cfg={cfg} total={total}"
+
+            shards = [
+                next(local_batches(
+                    src, cfg, process_index=i, process_count=procs,
+                    start_step=rng.randrange(100),
+                ))
+                for i in range(procs)
+            ]
+            allb = np.concatenate(shards)
+            assert allb.shape == (batch, seq + 1), ctx
+            assert allb.min() >= 0 and allb.max() < vocab, ctx
+
+
+class TestMeshPlanningInvariants:
+    def test_plan_axes_covers_or_raises(self, rng):
+        from tpu_network_operator.parallel import plan_axes
+
+        for trial in range(200):
+            n = rng.choice([1, 2, 4, 6, 8, 12, 16, 32, 64, 256])
+            kw = {}
+            for axis in ("tensor", "seq", "expert", "pipe"):
+                if rng.random() < 0.5:
+                    kw[axis] = rng.choice([1, 2, 3, 4, 8])
+            if rng.random() < 0.3:
+                kw["dcn_slices"] = rng.choice([1, 2, 4])
+            ctx = f"seed={SEED} trial={trial} n={n} kw={kw}"
+            try:
+                plan = plan_axes(n, **kw)
+            except ValueError:
+                continue                      # rejection is a valid outcome
+            # on success the plan must exactly cover the devices and honor
+            # every requested axis
+            assert plan.size() == n, ctx
+            for axis, size in kw.items():
+                if axis != "dcn_slices":
+                    assert plan.axis_sizes[axis] == size, ctx
+                else:
+                    assert plan.axis_sizes["data"] % size == 0, ctx
+            assert all(v >= 1 for v in plan.axis_sizes.values()), ctx

@@ -37,6 +37,15 @@ class DataConfig:
     seed: int = 0
 
 
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """64-bit avalanche mix (splitmix64 finalizer) over a uint64 array."""
+    x = x * np.uint64(6364136223846793005) + np.uint64(1442695040888963407)
+    x ^= x >> np.uint64(29)
+    x *= np.uint64(0xBF58476D1CE4E5B9)
+    x ^= x >> np.uint64(32)
+    return x
+
+
 class TokenSource:
     """A flat stream of token ids addressable by (index) -> window."""
 
@@ -95,12 +104,10 @@ class SyntheticTokens(TokenSource):
 
     def window(self, start: int, length: int) -> np.ndarray:
         # stateless: value at position i depends only on (seed, i)
-        idx = (start + np.arange(length, dtype=np.uint64))
-        x = idx * np.uint64(6364136223846793005) + np.uint64(self._seed)
-        x ^= x >> np.uint64(33)
-        x *= np.uint64(0xFF51AFD7ED558CCD)
-        x ^= x >> np.uint64(33)
-        return (x % np.uint64(self._vocab)).astype(np.int32)
+        idx = (start + np.arange(length, dtype=np.uint64)) + (
+            np.uint64(self._seed) << np.uint64(32)
+        )
+        return (_mix64(idx) % np.uint64(self._vocab)).astype(np.int32)
 
 
 def _batch_positions(
@@ -119,11 +126,7 @@ def _batch_positions(
     mask = (1 << 64) - 1
     base = ((cfg.seed * 0x100000001B3 + step) * rng_mix) & mask
     idx = np.arange(cfg.batch, dtype=np.uint64) + np.uint64(base)
-    x = idx * np.uint64(6364136223846793005) + np.uint64(1442695040888963407)
-    x ^= x >> np.uint64(29)
-    x *= np.uint64(0xBF58476D1CE4E5B9)
-    x ^= x >> np.uint64(32)
-    return (x % np.uint64(max_start + 1)).astype(np.int64)
+    return (_mix64(idx) % np.uint64(max_start + 1)).astype(np.int64)
 
 
 def local_batches(
@@ -141,19 +144,29 @@ def local_batches(
     offsets and slices its own contiguous row range, so shards are
     disjoint and the union is the global batch.
     """
+    # validate at construction, not first next(): config errors should
+    # point at the call site, before model init has run
     if cfg.batch % process_count:
         raise ValueError(
             f"global batch {cfg.batch} not divisible by "
             f"process_count {process_count}"
         )
+    span = cfg.seq_len + 1
+    if len(source) - span < 0:
+        raise ValueError(
+            f"dataset of {len(source)} tokens shorter than seq_len+1={span}"
+        )
     per = cfg.batch // process_count
     lo = process_index * per
-    step = start_step
-    span = cfg.seq_len + 1
-    while True:
-        starts = _batch_positions(len(source), cfg, step)[lo:lo + per]
-        yield np.stack([source.window(int(s), span) for s in starts])
-        step += 1
+
+    def gen():
+        step = start_step
+        while True:
+            starts = _batch_positions(len(source), cfg, step)[lo:lo + per]
+            yield np.stack([source.window(int(s), span) for s in starts])
+            step += 1
+
+    return gen()
 
 
 def sharded_batches(
@@ -173,7 +186,7 @@ def sharded_batches(
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     sharding = NamedSharding(mesh, P(("data", "fsdp"), None))
-    it = local_batches(
+    it = local_batches(                 # validates cfg eagerly
         source, cfg,
         start_step=start_step,
         process_index=jax.process_index(),
@@ -183,9 +196,12 @@ def sharded_batches(
     def put(local):
         return jax.make_array_from_process_local_data(sharding, local)
 
-    buf = collections.deque()
-    for _ in range(max(prefetch, 0)):
-        buf.append(put(next(it)))
-    while True:
-        buf.append(put(next(it)))
-        yield buf.popleft()
+    def gen():
+        buf = collections.deque()
+        for _ in range(max(prefetch, 0)):
+            buf.append(put(next(it)))
+        while True:
+            buf.append(put(next(it)))
+            yield buf.popleft()
+
+    return gen()
