@@ -1,0 +1,98 @@
+"""File-backed LinkOps provider for subprocess e2e runs.
+
+The agent process is launched with ``TPUNET_LINKOPS=tests.linkops_file:FileLinkOps``
+(the provider seam in ``agent/cli.py main()``) and
+``TPUNET_LINKOPS_STATE=<path>``: initial link state is loaded from the JSON
+file and every data-plane mutation is persisted back, so the test asserts
+the exact bring-up / MTU / addressing / route sequence from outside the
+process — the reference's fake-netlink table
+(ref ``cmd/discover/network_test.go:212-361``) promoted to a process
+boundary.
+
+State schema::
+
+    {"links": [{"name": "ens1", "index": 2, "mac": "...", "up": false,
+                "mtu": 1500, "addrs": ["10.0.0.2/24"]}],
+     "routes": [...], "ups": [...], "downs": [...], "mtu_set": {...}}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import tpu_network_operator.agent.netlink as nl
+from tests.fake_ops import FakeLinkOps
+
+
+class FileLinkOps(FakeLinkOps):
+    def __init__(self) -> None:
+        super().__init__()
+        self.path = os.environ["TPUNET_LINKOPS_STATE"]
+        with open(self.path) as f:
+            state = json.load(f)
+        for i, spec in enumerate(state.get("links", [])):
+            link = self.add_fake_link(
+                spec["name"],
+                spec.get("index", i + 2),
+                spec["mac"],
+                up=spec.get("up", False),
+                mtu=spec.get("mtu", 1500),
+            )
+            for cidr in spec.get("addrs", []):
+                address, plen = cidr.split("/")
+                self.addrs[link.index].append(
+                    nl.Addr(link.index, address, int(plen), link.name)
+                )
+        self._dump()
+
+    # -- persistence ----------------------------------------------------------
+
+    def _dump(self) -> None:
+        state = {
+            "links": [
+                {
+                    "name": l.name,
+                    "index": l.index,
+                    "mac": l.mac,
+                    "up": bool(l.is_up),
+                    "mtu": l.mtu,
+                    "addrs": [a.cidr() for a in self.addrs.get(l.index, [])],
+                }
+                for l in self.links.values()
+            ],
+            "routes": self.route_list(),
+            "ups": list(self.ups),
+            "downs": list(self.downs),
+            "mtu_set": dict(self.mtu_set),
+        }
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=1)
+        os.replace(tmp, self.path)
+
+    # -- mutators persist after applying --------------------------------------
+
+    def link_set_up(self, link) -> None:
+        super().link_set_up(link)
+        self._dump()
+
+    def link_set_down(self, link) -> None:
+        super().link_set_down(link)
+        self._dump()
+
+    def link_set_mtu(self, link, mtu: int) -> None:
+        super().link_set_mtu(link, mtu)
+        self._dump()
+
+    def addr_add(self, link, cidr: str) -> None:
+        super().addr_add(link, cidr)
+        self._dump()
+
+    def addr_del(self, link, cidr: str) -> None:
+        super().addr_del(link, cidr)
+        self._dump()
+
+    def route_append(self, route) -> None:
+        super().route_append(route)
+        self._dump()

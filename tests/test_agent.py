@@ -18,7 +18,11 @@ from tpu_network_operator.agent.systemd_networkd import (
     render_network,
     write_systemd_networkd,
 )
-from tpu_network_operator.agent.tpu.metadata import FakeMetadataServer
+from tpu_network_operator.agent.tpu import dcn as tpu_dcn
+from tpu_network_operator.agent.tpu.metadata import (
+    FakeMetadataServer,
+    MetadataClient,
+)
 
 
 # -- fake sysfs rig (ref network_test.go:94-116,226-252) ----------------------
@@ -49,6 +53,126 @@ def test_get_networks_fake_sysfs(tmp_path, monkeypatch):
 def test_get_networks_empty(tmp_path, monkeypatch):
     monkeypatch.setenv("SYSFS_ROOT", str(tmp_path))
     assert net.get_networks() == []
+
+
+def make_fake_class_net(tmp_path, nics):
+    """class/net tree: (name, mac, physical) triples; physical NICs get a
+    ``device`` backing dir, virtual ones don't (how the kernel lays it out)."""
+    base = tmp_path / "class/net"
+    for name, mac, physical in nics:
+        d = base / name
+        d.mkdir(parents=True)
+        (d / "address").write_text(mac + "\n")
+        if physical:
+            (d / "device").mkdir()
+    return str(tmp_path)
+
+
+class TestDcnDiscovery:
+    """Secondary-gVNIC auto-discovery (agent/tpu/dcn.py): GCE metadata NIC
+    enumeration ∩ sysfs physical NICs, primary NIC never selected."""
+
+    NICS = [
+        ("lo", "00:00:00:00:00:00", False),
+        ("ens8", "42:01:0a:00:00:05", True),    # primary (metadata index 0)
+        ("ens9", "42:01:0a:00:01:05", True),    # secondary -> DCN
+        ("ens10", "42:01:0a:00:02:05", True),   # secondary -> DCN
+        ("veth12", "aa:bb:cc:dd:ee:ff", False), # virtual, never eligible
+    ]
+
+    def test_physical_interfaces(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "SYSFS_ROOT", make_fake_class_net(tmp_path, self.NICS)
+        )
+        assert tpu_dcn.physical_interfaces() == {
+            "ens8": "42:01:0a:00:00:05",
+            "ens9": "42:01:0a:00:01:05",
+            "ens10": "42:01:0a:00:02:05",
+        }
+
+    def test_discover_excludes_primary(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "SYSFS_ROOT", make_fake_class_net(tmp_path, self.NICS)
+        )
+        with FakeMetadataServer(
+            {},
+            network_interfaces=[
+                {"mac": "42:01:0a:00:00:05"},
+                {"mac": "42:01:0a:00:01:05"},
+                {"mac": "42:01:0a:00:02:05"},
+            ],
+        ) as srv:
+            client = MetadataClient(srv.url)
+            assert client.network_interfaces() == [
+                {"index": 0, "mac": "42:01:0a:00:00:05"},
+                {"index": 1, "mac": "42:01:0a:00:01:05"},
+                {"index": 2, "mac": "42:01:0a:00:02:05"},
+            ]
+            assert tpu_dcn.discover_dcn_interfaces(client) == ["ens10", "ens9"]
+
+    def test_single_nic_vm_yields_nothing(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "SYSFS_ROOT", make_fake_class_net(tmp_path, self.NICS)
+        )
+        with FakeMetadataServer(
+            {}, network_interfaces=[{"mac": "42:01:0a:00:00:05"}]
+        ) as srv:
+            assert tpu_dcn.discover_dcn_interfaces(
+                MetadataClient(srv.url)
+            ) == []
+
+    def test_no_metadata_enumeration_yields_nothing(self, tmp_path, monkeypatch):
+        """No NIC listing (non-GCE host) => no guessing, nothing provisioned."""
+        monkeypatch.setenv(
+            "SYSFS_ROOT", make_fake_class_net(tmp_path, self.NICS)
+        )
+        with FakeMetadataServer({}) as srv:
+            assert tpu_dcn.discover_dcn_interfaces(
+                MetadataClient(srv.url)
+            ) == []
+
+    def test_unmatched_mac_skipped(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "SYSFS_ROOT", make_fake_class_net(tmp_path, self.NICS)
+        )
+        with FakeMetadataServer(
+            {},
+            network_interfaces=[
+                {"mac": "42:01:0a:00:00:05"},
+                {"mac": "de:ad:be:ef:00:00"},   # no local iface
+                {"mac": "42:01:0a:00:01:05"},
+            ],
+        ) as srv:
+            assert tpu_dcn.discover_dcn_interfaces(
+                MetadataClient(srv.url)
+            ) == ["ens9"]
+
+    def test_unreadable_mac_raises_not_shrinks(self, tmp_path, monkeypatch):
+        """A listed NIC whose mac can't be read is an error (agent exits,
+        DaemonSet retries) — silently skipping would shrink the DCN set."""
+        from tpu_network_operator.agent.tpu.metadata import MetadataError
+
+        monkeypatch.setenv(
+            "SYSFS_ROOT", make_fake_class_net(tmp_path, self.NICS)
+        )
+        with FakeMetadataServer(
+            {},
+            network_interfaces=[
+                {"mac": "42:01:0a:00:00:05"},
+                {},   # listed, but mac attribute 404s
+            ],
+        ) as srv:
+            with pytest.raises(MetadataError):
+                MetadataClient(srv.url).network_interfaces()
+
+    def test_resolve_interfaces_explicit_override_wins(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv(
+            "SYSFS_ROOT", make_fake_class_net(tmp_path, self.NICS)
+        )
+        cfg = agent_cli.CmdConfig(backend="tpu", interfaces="ens99")
+        assert agent_cli._resolve_interfaces(cfg, None) == ["ens99"]
 
 
 # -- /30 derivation (ref selectMask30L3Address + getFakeNetworkData) ----------
@@ -319,6 +443,79 @@ class TestCliLifecycle:
         assert ops.mtu_set == {"ens9": 8896}
         assert not os.path.exists(bootstrap_path)
         assert not (nfd_dir / "scale-out-readiness.txt").exists()
+
+    def test_tpu_l3_auto_discovery_full_pass(self, tmp_path, monkeypatch):
+        """BASELINE config 3 in miniature: secondary-gVNIC auto-discovery →
+        bring-up + MTU → LLDP /30 + /16 routes → bootstrap listing the
+        provisioned DCN NICs (the VERDICT r1 #1 path, in-process)."""
+        monkeypatch.setenv(
+            "SYSFS_ROOT",
+            make_fake_class_net(
+                tmp_path / "sys",
+                [
+                    ("ens8", "42:01:0a:00:00:05", True),
+                    ("ens9", "42:01:0a:00:01:05", True),
+                    ("ens10", "42:01:0a:00:02:05", True),
+                ],
+            ),
+        )
+        from tpu_network_operator.lldp.frame import build_lldp_frame
+
+        frames = {
+            "ens9": build_lldp_frame(
+                "aa:bb:cc:00:00:09", "Ethernet9 10.1.0.2/30"
+            ).hex(),
+            "ens10": build_lldp_frame(
+                "aa:bb:cc:00:00:0a", "Ethernet10 10.1.1.2/30"
+            ).hex(),
+        }
+        frames_file = tmp_path / "lldp.json"
+        frames_file.write_text(json.dumps(frames))
+        monkeypatch.setenv("TPUNET_LLDP_FRAMES", str(frames_file))
+
+        ops = FakeLinkOps()
+        ops.add_fake_link("ens9", 3, "42:01:0a:00:01:05")
+        ops.add_fake_link("ens10", 4, "42:01:0a:00:02:05")
+        attrs = {
+            "accelerator-type": "v5litepod-16",
+            "tpu-env": (
+                "ACCELERATOR_TYPE: 'v5litepod-16'\nTOPOLOGY: '4x4'\n"
+                "WORKER_ID: '0'\n"
+            ),
+            "worker-network-config": json.dumps(
+                [{"workerId": 0, "ipAddress": "10.0.0.5"},
+                 {"workerId": 1, "ipAddress": "10.0.0.6"}]
+            ),
+        }
+        bootstrap_path = tmp_path / "jax-coordinator.json"
+        with FakeMetadataServer(
+            attrs,
+            network_interfaces=[
+                {"mac": "42:01:0a:00:00:05"},
+                {"mac": "42:01:0a:00:01:05"},
+                {"mac": "42:01:0a:00:02:05"},
+            ],
+        ) as srv:
+            monkeypatch.setenv("TPUNET_METADATA_URL", srv.url)
+            cfg = agent_cli.CmdConfig(
+                backend="tpu", mode="L3", mtu=8896, wait=1.0,
+                configure=True, keep_running=False,
+                bootstrap=str(bootstrap_path),
+                ops=ops, nfd_root=str(tmp_path), lldp_backend="file",
+            )
+            assert agent_cli.cmd_run(cfg, wait_signal=False) == 0
+
+        assert sorted(ops.ups) == ["ens10", "ens9"]
+        assert ops.mtu_set == {"ens9": 8896, "ens10": 8896}
+        # LLDP-derived /30 local addrs: peer ^ 0x3
+        assert [a.address for a in ops.addrs[3]] == ["10.1.0.1"]
+        assert [a.address for a in ops.addrs[4]] == ["10.1.1.1"]
+        routes = ops.route_list()
+        assert {"dst": "10.1.0.0/16", "gateway": "10.1.0.2", "oif": 3} in routes
+        assert {"dst": "10.1.0.0/16", "gateway": "10.1.1.2", "oif": 4} in routes
+        cfg_json = json.loads(bootstrap_path.read_text())
+        assert cfg_json["dcn_interfaces"] == ["ens10", "ens9"]
+        assert cfg_json["coordinator_address"] == "10.0.0.5:8476"
 
     def test_tpu_metadata_unreachable_fails_cleanly(self, tmp_path, monkeypatch):
         monkeypatch.setenv("TPUNET_METADATA_URL", "http://127.0.0.1:1")

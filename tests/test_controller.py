@@ -202,6 +202,24 @@ class TestTpuProjection:
         assert "--wait=90s" not in args
         assert "--bootstrap=/host/etc/tpu/jax-coordinator.json" in args
 
+    def test_dcn_interfaces_projected(self, env):
+        """Explicit dcnInterfaces reach the agent as --interfaces (the
+        reference's arg-projection analog, controller :176-203)."""
+        fake, mgr = env
+        fake.create(
+            tpu_cr(name="tpu-dcn", dcn_interfaces=["ens9", "ens10"]).to_dict()
+        )
+        reconcile(fake, mgr, "tpu-dcn")
+        args = get_ds(fake, "tpu-dcn")["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--interfaces=ens9,ens10" in args
+
+    def test_no_dcn_interfaces_means_auto_discovery(self, env):
+        fake, mgr = env
+        fake.create(tpu_cr(name="tpu-auto").to_dict())
+        reconcile(fake, mgr, "tpu-auto")
+        args = get_ds(fake, "tpu-auto")["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert not any(a.startswith("--interfaces=") for a in args)
+
 
 class TestStatusMachine:
     # ref controller_test.go:95-100 — envtest can only see zero

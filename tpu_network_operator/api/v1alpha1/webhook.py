@@ -38,6 +38,10 @@ LABEL_VALUE_RE = re.compile(r"^(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?\Z")
 PULL_POLICIES = ("", "Never", "Always", "IfNotPresent")
 TOPOLOGY_SOURCES = ("", "auto", "metadata", "libtpu")
 
+# Linux interface names: IFNAMSIZ-1 = 15 chars, no '/', no whitespace,
+# must not be "." / ".." (kernel dev_valid_name()); conservative charset.
+IFACE_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.-]{0,14}\Z")
+
 
 def default_policy(policy: NetworkClusterPolicy) -> NetworkClusterPolicy:
     """Mutating admission: fill defaults in place, return the policy.
@@ -116,6 +120,17 @@ def validate_tpu_so_spec(s: t.TpuScaleOutSpec) -> None:
         raise AdmissionError("tpuScaleOut: coordinatorPort must be 1024-65535")
     if s.bootstrap_path and not s.bootstrap_path.startswith("/"):
         raise AdmissionError("tpuScaleOut: bootstrapPath must be absolute")
+    seen = set()
+    for name in s.dcn_interfaces:
+        if not IFACE_NAME_RE.match(name):
+            raise AdmissionError(
+                f"tpuScaleOut: invalid dcnInterfaces name {name!r}"
+            )
+        if name in seen:
+            raise AdmissionError(
+                f"tpuScaleOut: duplicate dcnInterfaces name {name!r}"
+            )
+        seen.add(name)
 
 
 def validate_spec(spec: NetworkClusterPolicySpec) -> List[str]:

@@ -182,6 +182,10 @@ def update_tpu_scale_out_daemonset(
         f"--coordinator-port={so.coordinator_port or t.DEFAULT_COORDINATOR_PORT}",
         f"--bootstrap={bootstrap_container}",
     ]
+    if so.dcn_interfaces:
+        # explicit DCN NIC override; absent = agent auto-discovery
+        # (ref --interfaces projection analog, controller :176-203)
+        args.append("--interfaces=" + ",".join(so.dcn_interfaces))
     if so.layer == t.LAYER_L3:
         args.append("--wait=90s")
     add_host_volume(

@@ -16,6 +16,7 @@ one worker per interface, shared wait budget, partial results tolerated.
 from __future__ import annotations
 
 import ctypes
+import json
 import logging
 import os
 import socket
@@ -108,7 +109,42 @@ class _PythonCapture:
         self.sock.close()
 
 
+class _FileCapture:
+    """Frame-injection backend: replays fabricated frames from the JSON file
+    named by ``TPUNET_LLDP_FRAMES`` (``{iface: "<hex frame>"}``, built with
+    :func:`..frame.build_lldp_frame`).  The subprocess-e2e analog of the
+    wire: the real TLV parser and own-MAC filtering still run, closing the
+    reference's pkg/lldp zero-coverage gap (ref Makefile:121) at the
+    process level too.
+    """
+
+    def __init__(self, ifname: str, path: str):
+        with open(path) as f:
+            frames = json.load(f)
+        hexframe = frames.get(ifname)
+        self._frame: Optional[bytes] = (
+            bytes.fromhex(hexframe) if hexframe else None
+        )
+
+    def next_frame(self, timeout_ms: int) -> Optional[bytes]:
+        frame, self._frame = self._frame, None
+        if frame is None:
+            time.sleep(timeout_ms / 1000.0)
+        return frame
+
+    def close(self) -> None:
+        pass
+
+
 def _make_capture(ifname: str, backend: str):
+    frames_file = os.environ.get("TPUNET_LLDP_FRAMES", "")
+    if backend == "file" or (frames_file and backend == "auto"):
+        # never silent: a leaked test env must be visible in agent logs
+        log.warning(
+            "LLDP capture on %r REPLACED by frame-injection file %s "
+            "(TPUNET_LLDP_FRAMES test seam)", ifname, frames_file,
+        )
+        return _FileCapture(ifname, frames_file)
     if backend == "native":
         return _NativeCapture(ifname)
     if backend == "python":
