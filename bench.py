@@ -3,17 +3,24 @@
 
 Measures the framework's headline numbers (BASELINE.md):
 
-* Llama-3-family training throughput, tokens/sec/chip, on the largest
-  preset that fits the local HBM (8B → 3B → 1B ladder; single v5e chip
-  lands on 1B);
-* when >1 device is visible, the ICI all-reduce sweep (GB/s bus bandwidth)
-  over the provisioned mesh — the operator's own contract metric.
+* Llama-3-family training throughput, tokens/sec/chip *and model FLOPs
+  utilization (MFU)*, on the largest preset that fits the local HBM
+  (8B → 3B → 1B ladder; a 16 GiB v5e chip lands on 1B thanks to the
+  chunked cross-entropy path — models/training.py);
+* a 150M-parameter continuity row so rounds stay comparable;
+* when >1 device is visible, the ICI all-reduce sweep (GB/s bus
+  bandwidth) over the provisioned mesh — the operator's own contract
+  metric.
 
 The reference publishes no numbers (BASELINE.md); `TARGETS` records this
-framework's own round-1 measurements so later rounds report a ratio.
+framework's own prior-round measurements so later rounds report a ratio.
+
+Env knobs: BENCH_CONFIG=llama3-1b forces a ladder rung; BENCH_ITERS=N.
 """
 
+import dataclasses
 import json
+import os
 import sys
 import time
 
@@ -22,13 +29,16 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-# round-1 measured baselines: (device_kind, config) -> tokens/sec/chip.
-# Frozen at the plain-XLA-attention number so the ratio tracks kernel-level
-# wins: the Pallas flash path (ops/pallas_attention.py) measured 69827
-# tokens/sec/chip on the same chip/config (1.74x) on 2026-07-29.
+# Prior-round measured baselines: (device_kind, config) -> tokens/sec/chip.
+# 150m frozen at the round-1 plain-XLA-attention number so the ratio tracks
+# kernel-level wins (the Pallas flash path measured 1.74x on 2026-07-29).
+# 1b recorded when first measured (round 3) — later rounds compare to it.
 TARGETS = {
     # measured 2026-07-29, single v5e chip, batch 8 x seq 2048, remat on
     ("TPU v5 lite", "llama3-150m"): 40122.9,
+    # measured 2026-07-29 (round 3), single v5e chip, batch 4 x seq 2048,
+    # chunked xent 512 + full remat — see docs/perf.md for the MFU analysis
+    ("TPU v5 lite", "llama3-1b"): 11314.3,
 }
 
 HBM_BYTES_BY_KIND = {
@@ -42,6 +52,18 @@ HBM_BYTES_BY_KIND = {
     "TPU v6 lite": 32 << 30,
     "TPU v6e": 32 << 30,
     "cpu": 8 << 30,
+}
+
+# bf16 peak FLOP/s per jax device (v2/v3 devices are cores, v4+ are chips)
+PEAK_FLOPS_BY_KIND = {
+    "TPU v2": 22.5e12,
+    "TPU v3": 61.5e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
 }
 
 
@@ -59,55 +81,44 @@ def hbm_bytes(dev) -> int:
     return 8 << 30
 
 
+def peak_flops(kind: str) -> float:
+    for prefix, f in PEAK_FLOPS_BY_KIND.items():
+        if kind.startswith(prefix):
+            return f
+    return 0.0
+
+
 def train_mem_estimate(cfg, batch: int, seq: int) -> int:
-    """bf16 params+grads + bf16 adam moments + logits f32 + remat residuals."""
+    """bf16 params+grads+adam moments, logits (chunked when cfg.xent_chunk),
+    remat residuals (policy-aware: "dots" keeps per-layer matmul outputs,
+    "full" keeps only the layer carry)."""
     p = cfg.num_params()
-    logits = batch * seq * cfg.vocab_size * 4 * 2   # fwd + bwd copies
-    resid = batch * seq * cfg.hidden * cfg.layers * 2
+    logit_seq = cfg.xent_chunk if cfg.xent_chunk else seq
+    logits = batch * logit_seq * cfg.vocab_size * 4 * 2   # fwd + bwd copies
+    if getattr(cfg, "remat_policy", "dots") == "dots":
+        per_tok = (
+            (cfg.heads + 2 * cfg.kv_heads) * cfg.head_dim  # qkv
+            + 2 * cfg.hidden                               # attn out, mlp down
+            + 2 * cfg.ffn                                  # gate, up
+        )
+        resid = batch * seq * per_tok * cfg.layers * 2
+    else:
+        resid = batch * seq * cfg.hidden * cfg.layers * 2
     return p * 8 + logits + resid
 
 
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
+def train_flops_per_token(cfg, seq: int) -> float:
+    """Model FLOPs per trained token: 6x matmul params (fwd 2 + bwd 4;
+    the embedding gather is not a matmul) + causal attention scores
+    (QK^T and AV, fwd+bwd, average context seq/2)."""
+    n_matmul = cfg.num_params() - cfg.vocab_size * cfg.hidden
+    attn = 6 * cfg.layers * cfg.hidden * seq
+    return 6 * n_matmul + attn
 
-    from tpu_network_operator.models import LlamaConfig, make_train_step
-    from tpu_network_operator.parallel import make_mesh, plan_axes
 
-    devices = jax.devices()
-    n = len(devices)
-    kind = getattr(devices[0], "device_kind", "cpu")
-    hbm = hbm_bytes(devices[0])
-    log(f"devices: {n} x {kind}, HBM {hbm / 2**30:.0f} GiB")
-
-    ladder = [
-        ("llama3-8b", LlamaConfig.llama3_8b(), 4, 2048),
-        ("llama3-3b", LlamaConfig.llama3_3b(), 4, 2048),
-        ("llama3-1b", LlamaConfig.llama3_1b(), 4, 2048),
-        ("llama3-150m",
-         LlamaConfig(vocab_size=32_000, hidden=1024, layers=8, heads=16,
-                     kv_heads=8, ffn=4096, max_seq=2048),
-         8, 2048),
-    ]
-    total_hbm = hbm * n
-    name, cfg, batch, seq = ladder[-1]
-    for cand_name, cand, b, s in ladder:
-        if train_mem_estimate(cand, b * max(1, n), s) <= 0.75 * total_hbm:
-            name, cfg, batch, seq = cand_name, cand, b, s
-            break
-    batch *= max(1, n)   # scale batch with the data axis
-    log(f"selected {name}: {cfg.num_params() / 1e9:.2f}B params, "
-        f"batch {batch} x seq {seq}")
-
-    # mesh: tensor parallelism on ICI when >1 chip, else trivial
-    tensor = 1
-    if n >= 4:
-        tensor = 4
-    elif n >= 2:
-        tensor = 2
-    plan = plan_axes(n, tensor=tensor)
-    mesh = make_mesh(plan)
-    log(f"mesh: {plan.axis_sizes}")
+def measure(name, cfg, batch, seq, n, kind, make_train_step, mesh, jax, jnp):
+    """One ladder rung: returns the result row dict."""
+    import gc
 
     step, init_all, _ = make_train_step(cfg, mesh)
     params, opt_state = init_all(jax.random.key(0))
@@ -125,20 +136,120 @@ def main() -> None:
     t0 = time.perf_counter()
     params, opt_state, loss = step(params, opt_state, tokens)
     sync(loss)
-    log(f"first step (incl. compile): {time.perf_counter() - t0:.1f}s")
+    log(f"[{name}] first step (incl. compile): {time.perf_counter() - t0:.1f}s")
 
-    # warmup + timed
     for _ in range(2):
         params, opt_state, loss = step(params, opt_state, tokens)
     sync(loss)
-    iters = 10
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
     t0 = time.perf_counter()
     for _ in range(iters):
         params, opt_state, loss = step(params, opt_state, tokens)
     loss_val = sync(loss)
     dt = time.perf_counter() - t0
     tok_per_sec_chip = batch * seq * iters / dt / n
-    log(f"{iters} steps in {dt:.2f}s, loss {loss_val:.3f}")
+
+    pk = peak_flops(kind)
+    mfu = tok_per_sec_chip * train_flops_per_token(cfg, seq) / pk if pk else 0.0
+    log(f"[{name}] {iters} steps in {dt:.2f}s, loss {loss_val:.3f}, "
+        f"{tok_per_sec_chip:.0f} tok/s/chip, MFU {mfu:.1%}")
+
+    target = TARGETS.get((kind, name))
+    row = {
+        "config": name,
+        "tokens_per_sec_per_chip": round(tok_per_sec_chip, 1),
+        "mfu": round(mfu, 4),
+        "batch": batch,
+        "seq": seq,
+        "loss": round(loss_val, 4),
+        "vs_baseline": round(tok_per_sec_chip / target, 4) if target else 1.0,
+    }
+    del params, opt_state, step, init_all
+    gc.collect()
+    return row
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_network_operator.models import LlamaConfig, make_train_step
+    from tpu_network_operator.parallel import make_mesh, plan_axes
+
+    devices = jax.devices()
+    n = len(devices)
+    kind = getattr(devices[0], "device_kind", "cpu")
+    hbm = hbm_bytes(devices[0])
+    log(f"devices: {n} x {kind}, HBM {hbm / 2**30:.0f} GiB")
+
+    # big rungs: chunked cross-entropy (never materialize [B,S,V] logits)
+    # and full remat (residuals = layer carry only) to fit HBM
+    big = dict(xent_chunk=512, remat_policy="full")
+    ladder = [
+        ("llama3-8b", dataclasses.replace(LlamaConfig.llama3_8b(), **big),
+         4, 2048),
+        ("llama3-3b", dataclasses.replace(LlamaConfig.llama3_3b(), **big),
+         4, 2048),
+        ("llama3-1b", dataclasses.replace(LlamaConfig.llama3_1b(), **big),
+         4, 2048),
+        ("llama3-150m",
+         LlamaConfig(vocab_size=32_000, hidden=1024, layers=8, heads=16,
+                     kv_heads=8, ffn=4096, max_seq=2048),
+         8, 2048),
+    ]
+    total_hbm = hbm * n
+    forced = os.environ.get("BENCH_CONFIG", "")
+    # 95%: the estimate is the steady-state live set; measured fit on a
+    # 16 GiB v5e confirms llama3-1b (est 15.2 GB) runs — OOM at runtime
+    # falls through to the next rung below
+    candidates = [
+        (cand_name, cand, b, s) for cand_name, cand, b, s in ladder
+        if (cand_name == forced if forced else
+            train_mem_estimate(cand, b * max(1, n), s) <= 0.95 * total_hbm)
+    ]
+    if forced and not candidates:
+        raise SystemExit(
+            f"BENCH_CONFIG={forced!r} matches no ladder rung "
+            f"(have: {[r[0] for r in ladder]})"
+        )
+    candidates = candidates or [ladder[-1]]
+
+    # mesh: tensor parallelism on ICI when >1 chip, else trivial
+    tensor = 1
+    if n >= 4:
+        tensor = 4
+    elif n >= 2:
+        tensor = 2
+    plan = plan_axes(n, tensor=tensor)
+    mesh = make_mesh(plan)
+    log(f"mesh: {plan.axis_sizes}")
+
+    rows = []
+    for cand_name, cand, b, s in candidates:
+        batch = b * max(1, n)   # scale batch with the data axis
+        log(f"attempting {cand_name}: {cand.num_params() / 1e9:.2f}B params, "
+            f"batch {batch} x seq {s}")
+        try:
+            rows.append(measure(cand_name, cand, batch, s, n, kind,
+                                make_train_step, mesh, jax, jnp))
+            break
+        except Exception as e:   # OOM / compile failure: next rung down
+            log(f"[{cand_name}] failed ({type(e).__name__}: {str(e)[:120]}); "
+                "trying next rung")
+    if not rows:
+        raise SystemExit("no ladder rung ran to completion")
+    name = rows[0]["config"]
+    if name != "llama3-150m" and not forced:
+        # continuity row: every round also reports the 150m proxy so the
+        # cross-round series stays unbroken; best-effort — its failure
+        # must not discard the headline measurement above
+        sm_name, sm_cfg, sm_b, sm_s = ladder[-1]
+        try:
+            rows.append(measure(sm_name, sm_cfg, sm_b * max(1, n), sm_s, n,
+                                kind, make_train_step, mesh, jax, jnp))
+        except Exception as e:
+            log(f"[{sm_name}] continuity row failed "
+                f"({type(e).__name__}: {str(e)[:120]}); keeping headline row")
 
     extras = {}
     if n > 1:
@@ -154,18 +265,17 @@ def main() -> None:
                         sizes_mb=[16.0, 64.0, 256.0], iters=5)
         extras["ici_allreduce_busbw_gbps"] = round(peak_busbw(results), 2)
 
-    target = TARGETS.get((kind, name))
-    vs_baseline = round(tok_per_sec_chip / target, 4) if target else 1.0
-
+    head = rows[0]
     print(json.dumps({
-        "metric": f"{name} train throughput",
-        "value": round(tok_per_sec_chip, 1),
+        "metric": f"{head['config']} train throughput",
+        "value": head["tokens_per_sec_per_chip"],
         "unit": "tokens/sec/chip",
-        "vs_baseline": vs_baseline,
+        "vs_baseline": head["vs_baseline"],
+        "mfu": head["mfu"],
         "device_kind": kind,
         "num_devices": n,
         "mesh": plan.axis_sizes,
-        "loss": round(loss_val, 4),
+        "rows": rows,
         **extras,
     }))
 

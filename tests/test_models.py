@@ -53,6 +53,40 @@ class TestForward:
     def test_param_count_llama3_8b(self):
         assert abs(LlamaConfig.llama3_8b().num_params() - 8.03e9) < 0.05e9
 
+    def test_chunked_xent_matches_full(self, tiny, tiny_params):
+        """cfg.xent_chunk must change memory, not math: same loss and same
+        gradients as the full-logits path."""
+        import dataclasses
+
+        chunked = dataclasses.replace(tiny, xent_chunk=8)
+        toks = jax.random.randint(jax.random.key(3), (2, 33), 0, 256, jnp.int32)
+        full_loss, full_grads = jax.jit(
+            jax.value_and_grad(lambda p, t: loss_fn(p, t, tiny))
+        )(tiny_params, toks)
+        ck_loss, ck_grads = jax.jit(
+            jax.value_and_grad(lambda p, t: loss_fn(p, t, chunked))
+        )(tiny_params, toks)
+        # chunked accumulates the vocab matmul in f32 (preferred_element_type)
+        # where the full path casts a bf16 matmul, hence the loose rtol
+        np.testing.assert_allclose(
+            float(full_loss), float(ck_loss), rtol=1e-3
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=5e-2, atol=2e-3,   # grads are bf16: ~1e-3 grain
+            ),
+            full_grads, ck_grads,
+        )
+
+    def test_chunked_xent_rejects_indivisible(self, tiny, tiny_params):
+        import dataclasses
+
+        chunked = dataclasses.replace(tiny, xent_chunk=7)
+        toks = jnp.ones((2, 33), jnp.int32)
+        with pytest.raises(ValueError, match="not divisible"):
+            jax.jit(lambda p, t: loss_fn(p, t, chunked))(tiny_params, toks)
+
 
 class TestTraining:
     def test_loss_decreases_sharded(self, tiny):

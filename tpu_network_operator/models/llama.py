@@ -56,6 +56,11 @@ class LlamaConfig:
     remat_policy: str = "dots"
     # long-context: shard activations along seq mesh axis + ring attention
     seq_parallel: bool = False
+    # >0: compute training cross-entropy in sequence chunks of this size so
+    # the [B,S,vocab] logits tensor is never materialized (see
+    # training.chunked_next_token_xent) — required to fit the 1B+ presets
+    # in 16 GiB HBM.  0 keeps the plain full-logits path.
+    xent_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -223,7 +228,13 @@ def auto_attention(cfg: LlamaConfig, mesh: Optional[Mesh] = None) -> Callable:
 
 
 def _layer(cfg: LlamaConfig, cos, sin, x, lp, attn_fn):
-    """One transformer block.  x: [B, S, H]; lp: this layer's params."""
+    """One transformer block.  x: [B, S, H]; lp: this layer's params.
+
+    Intermediates are tagged with ``checkpoint_name`` so the selective
+    remat policies (:func:`.training.remat_policy`) can keep exactly the
+    activations that buy the most backward-recompute for their bytes."""
+    from jax.ad_checkpoint import checkpoint_name
+
     # attention
     y = rms_norm(x, lp["ln_attn"], cfg.rms_eps)
     b, s, _ = y.shape
@@ -233,24 +244,25 @@ def _layer(cfg: LlamaConfig, cos, sin, x, lp, attn_fn):
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     a = attn_fn(q, k, v)
-    x = x + a.reshape(b, s, -1) @ lp["wo"]
+    x = checkpoint_name(x + a.reshape(b, s, -1) @ lp["wo"], "resid_mid")
 
     # mlp (SwiGLU)
     y = rms_norm(x, lp["ln_mlp"], cfg.rms_eps)
-    gated = jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"])
+    gate = checkpoint_name(y @ lp["w_gate"], "ffn_gate")
+    up = checkpoint_name(y @ lp["w_up"], "ffn_up")
+    gated = jax.nn.silu(gate) * up
     return x + gated @ lp["w_down"]
 
 
-def forward(
+def forward_hidden(
     params: Params,
     tokens: jnp.ndarray,              # [B, S] int32
     cfg: LlamaConfig,
     attn_fn: Optional[Callable] = None,
 ) -> jnp.ndarray:
-    """Logits [B, S, vocab].  ``attn_fn`` defaults to :func:`auto_attention`
-    without mesh context (Pallas flash on single-device TPU, plain fused XLA
-    attention elsewhere); sharded callers get their attn_fn from
-    ``make_train_step``, and the ring path passes its own (parallel/ring)."""
+    """Final-norm hidden states [B, S, hidden] — everything before the
+    vocab projection.  Split out so the training loss can chunk the
+    projection (``cfg.xent_chunk``) without touching the transformer."""
     attn_fn = attn_fn or auto_attention(cfg)
     x = params["embed"][tokens].astype(cfg.dtype)
     # activation layout (batch over data+fsdp, optional seq sharding) is
@@ -268,7 +280,20 @@ def forward(
         block = jax.checkpoint(block, policy=remat_policy(cfg))
 
     x, _ = jax.lax.scan(lambda x, lp: (block(x, lp), None), x, params["layers"])
-    x = rms_norm(x, params["ln_final"], cfg.rms_eps)
+    return rms_norm(x, params["ln_final"], cfg.rms_eps)
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,              # [B, S] int32
+    cfg: LlamaConfig,
+    attn_fn: Optional[Callable] = None,
+) -> jnp.ndarray:
+    """Logits [B, S, vocab].  ``attn_fn`` defaults to :func:`auto_attention`
+    without mesh context (Pallas flash on single-device TPU, plain fused XLA
+    attention elsewhere); sharded callers get their attn_fn from
+    ``make_train_step``, and the ring path passes its own (parallel/ring)."""
+    x = forward_hidden(params, tokens, cfg, attn_fn)
     return (x @ params["lm_head"]).astype(jnp.float32)
 
 
@@ -279,8 +304,13 @@ def loss_fn(
     attn_fn: Optional[Callable] = None,
 ) -> jnp.ndarray:
     """Next-token cross entropy over [B, S]."""
-    from .training import next_token_xent
+    from .training import chunked_next_token_xent, next_token_xent
 
+    if cfg.xent_chunk > 0:
+        x = forward_hidden(params, tokens[:, :-1], cfg, attn_fn)
+        return chunked_next_token_xent(
+            x, params["lm_head"], tokens, cfg.xent_chunk
+        )
     logits = forward(params, tokens[:, :-1], cfg, attn_fn)
     return next_token_xent(logits, tokens)
 
