@@ -244,6 +244,68 @@ def test_config3_v5e16_dcn_l3_auto_discovery(tmp_path):
             terminate_and_assert_deprovision(proc, host)
 
 
+def test_dry_run_adds_no_addresses_or_routes(tmp_path):
+    """VERDICT r3 #2 'done when' (a): the same config-3 CR run with
+    --configure=false observes LLDP but leaves node addressing alone —
+    zero addresses, zero routes, links restored, no readiness artifacts
+    (ref main.go:211-212,235-237)."""
+    args = projected_agent_args(tpu_cr("v5e-dry", "L3"))
+    args = [
+        "--configure=false" if a == "--configure=true" else a
+        for a in args
+        if a != "--keep-running"   # one observational pass, then exit
+    ]
+    host = AgentHost(tmp_path, HOST_NICS, LLDP_DESCS)
+    with FakeMetadataServer(
+        V5E_16_ATTRS, network_interfaces=TWO_NIC_METADATA
+    ) as srv:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_network_operator.agent.cli",
+             *host_args(args, host)],
+            env=host.env(srv.url), cwd=ROOT, capture_output=True, timeout=60,
+        )
+    assert proc.returncode == 0, proc.stderr.decode()[-3000:]
+    state = host.state()
+    for link in state["links"]:
+        assert link["addrs"] == [], link
+    assert state["routes"] == []
+    assert set(state.get("downs", [])) == set(state.get("ups", []))
+    assert not host.bootstrap_path().exists()
+    assert not host.label_path().exists()
+
+
+def test_partial_lldp_exits_nonzero_no_label_no_bootstrap(tmp_path):
+    """VERDICT r3 #2 'done when' (b): one of two DCN NICs never receives
+    an LLDP answer → the agent hard-fails (ref main.go:213-216), rolls
+    back the half-configured addressing, and leaves neither the NFD label
+    nor the bootstrap behind."""
+    args = [
+        # shrink the operator's 90s LLDP budget: the missing frame never
+        # arrives, the subject here is the failure semantics
+        "--wait=2s" if a == "--wait=90s" else a
+        for a in projected_agent_args(tpu_cr("v5e-partial", "L3"))
+    ]
+    host = AgentHost(
+        tmp_path, HOST_NICS,
+        {"ens9": "Ethernet9 10.1.0.2/30"},   # ens10 never answers
+    )
+    with FakeMetadataServer(
+        V5E_16_ATTRS, network_interfaces=TWO_NIC_METADATA
+    ) as srv:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_network_operator.agent.cli",
+             *host_args(args, host)],
+            env=host.env(srv.url), cwd=ROOT, capture_output=True, timeout=120,
+        )
+    assert proc.returncode == 1, proc.stderr.decode()[-3000:]
+    state = host.state()
+    for link in state["links"]:
+        assert link["addrs"] == [], link   # partial /30 rolled back
+    assert set(state.get("downs", [])) == set(state.get("ups", []))
+    assert not host.bootstrap_path().exists()
+    assert not host.label_path().exists()
+
+
 def test_config4_v5p64_l3_lldp_eight_hosts(tmp_path):
     """BASELINE config 4 (north-star scale): v5p-64 pod slice, 8 hosts,
     L3 LLDP-aided DCN provisioning with an explicit dcnInterfaces override

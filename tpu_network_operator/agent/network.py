@@ -230,8 +230,9 @@ def configure_interfaces(
 ) -> Tuple[int, int]:
     """ref ``configureInterfaces()`` network.go:407-469: add the /30 (or
     keep an existing correct one and re-ensure its route) + the /16; count
-    successes.  Partial LLDP responses are tolerated — unanswered ifaces are
-    skipped, the caller compares counts."""
+    successes.  Unanswered interfaces are skipped here and reflected in the
+    returned ``(configured, total)``; the caller treats configured < total
+    as a hard failure (ref main.go:213-216 — see cli.py)."""
     configured = 0
     log.info("configuring interfaces...")
     for cfg in configs.values():
