@@ -27,7 +27,11 @@ lint: ## Byte-compile + pytest collection as the minimum static gate
 	$(PYTHON) -m pytest tests/ -q --collect-only >/dev/null
 
 .PHONY: test
-test: ## Unit + integration tests on the virtual 8-device CPU mesh
+test: ## Fast tier (<3 min): everything except the heavy JAX model tests
+	$(PYTHON) -m pytest tests/ -x -q -m "not slow"
+
+.PHONY: test-all
+test-all: ## Full matrix incl. heavy JAX model/training tests
 	$(PYTHON) -m pytest tests/ -x -q
 
 .PHONY: test-e2e

@@ -9,3 +9,19 @@ app.kubernetes.io/instance: {{ .Release.Name }}
 app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
 app.kubernetes.io/managed-by: {{ .Release.Service }}
 {{- end -}}
+
+{{/*
+Fail-fast validation of the shared scale-out fields, used by both policy
+templates (gaudi.yaml, tpu.yaml).  Scope (.) is one backend's values
+block.  Bounds track api/v1alpha1/types.py (MTU_MIN=1500, MTU_MAX=9000,
+layers "L2" "L3") so a bad value fails `helm template` instead of the
+admission webhook.
+*/}}
+{{- define "tpunet.validateScaleOut" -}}
+{{- if not (has .mode (list "L2" "L3")) -}}
+{{- fail (printf "config: invalid layer mode %q (want L2 or L3)" .mode) -}}
+{{- end -}}
+{{- if or (lt (int .mtu) 1500) (gt (int .mtu) 9000) -}}
+{{- fail (printf "config: mtu %d outside 1500-9000" (int .mtu)) -}}
+{{- end -}}
+{{- end -}}

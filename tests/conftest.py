@@ -28,4 +28,23 @@ def pytest_configure(config):
     except Exception:
         pass
 
+
+# Modules whose tests compile/train real (tiny) models on the virtual
+# mesh — minutes of XLA compile time.  They are auto-marked `slow` so the
+# default `make test` tier stays under a few minutes; `make test-all`
+# (and the driver's plain `pytest tests/`) still runs everything.
+SLOW_MODULES = {
+    "test_models", "test_moe", "test_pipeline", "test_parallel",
+    "test_generate", "test_workload", "test_runtime",
+    "test_pallas_attention", "test_data",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    for item in items:
+        if item.module.__name__.rsplit(".", 1)[-1] in SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
