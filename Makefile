@@ -22,8 +22,9 @@ native: ## Build the native LLDP capture library (C++)
 	$(MAKE) -C native
 
 .PHONY: lint
-lint: ## Byte-compile + pytest collection as the minimum static gate
+lint: ## Static gate: byte-compile + AST checker (tools/lint.py) + collection
 	$(PYTHON) -m compileall -q tpu_network_operator tests tools bench.py __graft_entry__.py
+	$(PYTHON) tools/lint.py
 	$(PYTHON) -m pytest tests/ -q --collect-only >/dev/null
 
 .PHONY: test

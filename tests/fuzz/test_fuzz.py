@@ -17,7 +17,6 @@ Seeded RNG: failures print the seed for replay.
 import random
 import string
 
-import pytest
 
 from tpu_network_operator.agent.cli import build_parser
 from tpu_network_operator.api.v1alpha1 import (

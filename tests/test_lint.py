@@ -1,0 +1,158 @@
+"""Tests for the from-scratch AST static checker (tools/lint.py) — the
+stand-in for the reference's 19-linter golangci gate
+(ref .golangci.yml:24-44) in an environment without ruff/mypy."""
+
+import ast
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools"))
+
+import lint   # noqa: E402
+
+
+def findings_of(src: str):
+    tree = ast.parse(src)
+    return [
+        (f.code, f.message)
+        for f in lint.Checker("<test>", tree, src).run()
+    ]
+
+
+def codes_of(src: str):
+    return {c for c, _ in findings_of(src)}
+
+
+class TestUndefinedNames:
+    def test_typo_flagged(self):
+        assert ("F821", "undefined name 'pritn'") in findings_of(
+            "def f():\n    pritn('x')\n"
+        )
+
+    def test_missing_import_flagged(self):
+        assert "F821" in codes_of("def f():\n    return json.dumps({})\n")
+
+    def test_defined_everywhere_ok(self):
+        src = (
+            "import json\n"
+            "X = 1\n"
+            "def f(a, *args, **kw):\n"
+            "    y = a + X\n"
+            "    return json.dumps([y, args, kw])\n"
+        )
+        assert codes_of(src) == set()
+
+    def test_forward_reference_ok(self):
+        # order-blind by design: helpers defined later are fine
+        src = "def f():\n    return g()\n\ndef g():\n    return 1\n"
+        assert codes_of(src) == set()
+
+    def test_comprehension_scope(self):
+        assert codes_of("xs = [1]\nys = [x * 2 for x in xs]\n") == set()
+        assert "F821" in codes_of("ys = [zz * 2 for x in [1]]\n")
+
+    def test_lambda_args(self):
+        assert codes_of("f = lambda a, b=2: a + b\n") == set()
+        assert "F821" in codes_of("f = lambda a: a + qq\n")
+
+    def test_class_attrs_not_visible_in_methods(self):
+        # runtime rule: class-body names don't leak into method bodies
+        src = (
+            "class C:\n"
+            "    x = 1\n"
+            "    def m(self):\n"
+            "        return x\n"
+        )
+        assert "F821" in codes_of(src)
+
+    def test_global_and_walrus(self):
+        src = (
+            "total = 0\n"
+            "def add(n):\n"
+            "    global total\n"
+            "    total += n\n"
+            "if (m := 10) > 5:\n"
+            "    print(m)\n"
+        )
+        assert codes_of(src) == set()
+
+    def test_star_import_poisons_scope(self):
+        assert codes_of("from os.path import *\nprint(join('a'))\n") == set()
+
+    def test_nested_function_sees_enclosing(self):
+        src = (
+            "def outer():\n"
+            "    x = 1\n"
+            "    def inner():\n"
+            "        return x\n"
+            "    return inner\n"
+        )
+        assert codes_of(src) == set()
+
+    def test_except_name_and_with(self):
+        src = (
+            "try:\n    pass\n"
+            "except ValueError as e:\n    print(e)\n"
+            "with open('f') as fh:\n    print(fh)\n"
+        )
+        assert codes_of(src) == set()
+
+
+class TestUnusedImports:
+    def test_flagged(self):
+        assert ("F401", "'os' imported but unused") in findings_of(
+            "import os\nprint('hi')\n"
+        )
+
+    def test_used_via_attribute(self):
+        assert codes_of("import os\nprint(os.path.sep)\n") == set()
+
+    def test_all_reexport_counts(self):
+        src = "from x import thing\n__all__ = ['thing']\n"
+        assert codes_of(src) == set()
+
+    def test_future_import_exempt(self):
+        assert codes_of("from __future__ import annotations\nx = 1\n") == set()
+
+
+class TestMisc:
+    def test_bare_except(self):
+        assert "E722" in codes_of("try:\n    pass\nexcept:\n    pass\n")
+
+    def test_fstring_no_placeholder(self):
+        assert "F541" in codes_of("x = f'static'\n")
+
+    def test_fstring_format_spec_not_flagged(self):
+        assert "F541" not in codes_of("v = 1.5\nx = f'{v:.1f}'\n")
+
+    def test_mutable_default(self):
+        assert "B006" in codes_of("def f(a=[]):\n    return a\n")
+
+    def test_none_comparison(self):
+        assert "E711" in codes_of("def f(x):\n    return x == None\n")
+
+    def test_assert_tuple(self):
+        assert "B011" in codes_of("assert (1, 'msg')\n")
+
+    def test_syntax_error_reported(self):
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False
+        ) as f:
+            f.write("def broken(:\n")
+        try:
+            fs = lint.lint_file(f.name)
+            assert fs and fs[0].code == "E999"
+        finally:
+            os.unlink(f.name)
+
+
+def test_repo_is_lint_clean():
+    """The gate itself: the whole repo must stay at zero findings."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = []
+    for target in lint.DEFAULT_TARGETS:
+        for path in lint.iter_py_files([os.path.join(root, target)]):
+            findings.extend(lint.lint_file(path))
+    assert findings == [], "\n".join(str(f) for f in findings)

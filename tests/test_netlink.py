@@ -6,7 +6,6 @@ exercises the real kernel on the spare ``ifb1`` device (skipped without
 NET_ADMIN) — coverage the reference never had for its netlink layer.
 """
 
-import os
 import socket
 import struct
 

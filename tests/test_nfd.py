@@ -1,6 +1,5 @@
 """NFD label file tests (ref cmd/discover/main.go:240-246 behavior)."""
 
-import os
 
 from tpu_network_operator.nfd import (
     TPU_READY_LABEL,

@@ -1,0 +1,464 @@
+#!/usr/bin/env python3
+"""Static analysis gate (`make lint`).
+
+The reference gates CI on 19 golangci linters
+(`/root/reference/.golangci.yml:24-44`); the Python toolchain baked into
+this environment has neither ruff nor mypy, so this is a from-scratch
+AST checker covering the highest-signal subset:
+
+  F821  undefined name (scope-aware: module/function/class/comprehension,
+        global/nonlocal, wildcard-import poisoning)
+  F401  unused import (module scope; `__init__.py` re-exports and
+        `__all__` entries excluded)
+  E722  bare `except:`
+  F541  f-string without placeholders
+  B006  mutable default argument (list/dict/set literal)
+  E711  comparison to None with ==/!=
+  B011  assert on a non-empty tuple literal (always true)
+
+Zero third-party dependencies; exits 1 on any finding.  Run as
+`python tools/lint.py [paths...]` (defaults to the package, tests, tools
+and the repo-root entry points).
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import List, Optional, Set
+
+DEFAULT_TARGETS = [
+    "tpu_network_operator",
+    "tests",
+    "tools",
+    "bench.py",
+    "__graft_entry__.py",
+]
+
+BUILTINS = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__path__", "__all__",
+    "__version__", "__class__",   # implicit cell in methods using super()
+}
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+@dataclass
+class Scope:
+    kind: str                      # "module" | "function" | "class" | "comp"
+    bindings: Set[str] = field(default_factory=set)
+    globals_decl: Set[str] = field(default_factory=set)
+    has_star_import: bool = False
+
+
+class _BindingCollector(ast.NodeVisitor):
+    """Collect every name bound anywhere in one scope body (order-blind:
+    we check existence, not use-before-def, trading completeness for zero
+    false positives on forward references)."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+        self.star = False
+
+    def _bind_target(self, t):
+        if isinstance(t, ast.Name):
+            self.names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._bind_target(e)
+        elif isinstance(t, ast.Starred):
+            self._bind_target(t.value)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._bind_target(t)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._bind_target(node.target)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._bind_target(node.target)
+        self.generic_visit(node)
+
+    def visit_NamedExpr(self, node):   # walrus binds in the nearest fn scope
+        self._bind_target(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._bind_target(node.target)
+        self.generic_visit(node)
+
+    visit_AsyncFor = visit_For
+
+    def visit_withitem(self, node):
+        if node.optional_vars is not None:
+            self._bind_target(node.optional_vars)
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node):
+        if node.name:
+            self.names.add(node.name)
+        self.generic_visit(node)
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self.names.add((a.asname or a.name).split(".")[0])
+
+    def visit_ImportFrom(self, node):
+        for a in node.names:
+            if a.name == "*":
+                self.star = True
+            else:
+                self.names.add(a.asname or a.name)
+
+    def visit_Global(self, node):
+        self.names.update(node.names)
+
+    def visit_Nonlocal(self, node):
+        self.names.update(node.names)
+
+    def visit_MatchAs(self, node):
+        if node.name:
+            self.names.add(node.name)
+        self.generic_visit(node)
+
+    def visit_MatchStar(self, node):
+        if node.name:
+            self.names.add(node.name)
+        self.generic_visit(node)
+
+    def visit_MatchMapping(self, node):
+        if node.rest:
+            self.names.add(node.rest)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)
+        # decorators/defaults/annotations evaluate in THIS scope
+        for d in node.decorator_list:
+            self.generic_visit(d)
+        for d in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            self.generic_visit(d)
+        # body is its own scope: do not descend
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.names.add(node.name)
+        for d in node.decorator_list + node.bases + [
+            k.value for k in node.keywords
+        ]:
+            self.generic_visit(d)
+        # body is its own scope
+
+    def visit_Lambda(self, node):
+        for d in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            self.generic_visit(d)
+        # body is its own scope
+
+    def _comp(self, node):
+        # py3 comprehensions are their own scope; only the first
+        # iterable evaluates here
+        self.generic_visit(node.generators[0].iter)
+
+    visit_ListComp = visit_SetComp = visit_DictComp = visit_GeneratorExp = _comp
+
+
+def _arg_names(args: ast.arguments) -> Set[str]:
+    names = set()
+    for a in (
+        list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+    ):
+        names.add(a.arg)
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+class Checker:
+    def __init__(self, path: str, tree: ast.Module, source: str):
+        self.path = path
+        self.tree = tree
+        self.source = source
+        self.findings: List[Finding] = []
+        self.is_init = os.path.basename(path) == "__init__.py"
+
+    def report(self, node, code, message):
+        self.findings.append(
+            Finding(self.path, getattr(node, "lineno", 0), code, message)
+        )
+
+    # -- driver ---------------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        module_scope = self._scope_of("module", self.tree.body)
+        self._check_body(self.tree.body, [module_scope])
+        self._check_unused_imports()
+        # format specs ({x:.1f}) parse as nested JoinedStr with only
+        # constant parts — they are not user f-strings, exclude from F541
+        self._format_specs = {
+            id(node.format_spec)
+            for node in ast.walk(self.tree)
+            if isinstance(node, ast.FormattedValue)
+            and node.format_spec is not None
+        }
+        for node in ast.walk(self.tree):
+            self._check_misc(node)
+        return self.findings
+
+    def _scope_of(self, kind: str, body, extra: Optional[Set[str]] = None):
+        coll = _BindingCollector()
+        for stmt in body:
+            coll.visit(stmt)
+        scope = Scope(kind=kind, bindings=coll.names | (extra or set()))
+        scope.has_star_import = coll.star
+        return scope
+
+    # -- undefined names (F821) ----------------------------------------------
+
+    def _lookup(self, name: str, stack: List[Scope]) -> bool:
+        if name in BUILTINS:
+            return True
+        for scope in reversed(stack):
+            # class scopes are invisible to nested functions, but we are
+            # order-blind anyway; skipping them only when they are not
+            # the innermost scope matches the runtime rule
+            if scope.kind == "class" and scope is not stack[-1]:
+                continue
+            if name in scope.bindings or scope.has_star_import:
+                return True
+        return False
+
+    def _check_body(self, body, stack: List[Scope]):
+        for stmt in body:
+            self._check_stmt(stmt, stack)
+
+    def _check_stmt(self, stmt, stack: List[Scope]):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in stmt.decorator_list:
+                self._check_names_shallow(d, stack)
+            inner = self._scope_of(
+                "function", stmt.body, extra=_arg_names(stmt.args)
+            )
+            self._check_body(stmt.body, stack + [inner])
+        elif isinstance(stmt, ast.ClassDef):
+            for d in stmt.decorator_list + stmt.bases:
+                self._check_names_shallow(d, stack)
+            inner = self._scope_of("class", stmt.body)
+            self._check_body(stmt.body, stack + [inner])
+        else:
+            self._check_names_shallow(stmt, stack)
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    self._check_stmt(child, stack)
+                elif hasattr(child, "body") and isinstance(
+                    getattr(child, "body"), list
+                ):
+                    # nested blocks (if/for/while/try/with) share the scope
+                    self._check_stmt_block(child, stack)
+
+    def _check_stmt_block(self, node, stack):
+        for name in ("body", "orelse", "finalbody"):
+            for sub in getattr(node, name, []) or []:
+                self._check_stmt(sub, stack)
+        for h in getattr(node, "handlers", []) or []:
+            self._check_stmt_block(h, stack)
+
+    def _check_names_shallow(self, node, stack: List[Scope]):
+        """Check Load-names in this statement, descending into nested
+        scopes (lambda/comprehension) with extended stacks but NOT into
+        nested statement lists (handled by _check_stmt)."""
+        skip_bodies = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+        def walk(n, stack):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                if not self._lookup(n.id, stack):
+                    self.report(n, "F821", f"undefined name '{n.id}'")
+                return
+            if isinstance(n, ast.Lambda):
+                inner = Scope("function", _arg_names(n.args))
+                coll = _BindingCollector()
+                coll.visit(n.body)
+                inner.bindings |= coll.names
+                for d in list(n.args.defaults) + [
+                    d for d in n.args.kw_defaults if d is not None
+                ]:
+                    walk(d, stack)
+                walk(n.body, stack + [inner])
+                return
+            if isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                              ast.GeneratorExp)):
+                inner = Scope("comp")
+                for gen in n.generators:
+                    coll = _BindingCollector()
+                    coll._bind_target(gen.target)
+                    inner.bindings |= coll.names
+                walk(n.generators[0].iter, stack)
+                new_stack = stack + [inner]
+                for gen in n.generators:
+                    if gen is not n.generators[0]:
+                        walk(gen.iter, new_stack)
+                    for cond in gen.ifs:
+                        walk(cond, new_stack)
+                if isinstance(n, ast.DictComp):
+                    walk(n.key, new_stack)
+                    walk(n.value, new_stack)
+                else:
+                    walk(n.elt, new_stack)
+                return
+            if isinstance(n, skip_bodies):
+                return
+            if isinstance(n, ast.stmt) and hasattr(n, "body") and n is not node:
+                return   # nested statement blocks handled by _check_stmt
+            for child in ast.iter_child_nodes(n):
+                walk(child, stack)
+
+        walk(node, stack)
+
+    # -- unused imports (F401) -----------------------------------------------
+
+    def _check_unused_imports(self):
+        if self.is_init:
+            return   # __init__.py imports are the public re-export surface
+        imported = {}   # name -> node
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Import):
+                for a in stmt.names:
+                    imported[(a.asname or a.name).split(".")[0]] = stmt
+            elif isinstance(stmt, ast.ImportFrom):
+                if stmt.module == "__future__":
+                    continue
+                for a in stmt.names:
+                    if a.name != "*":
+                        imported[a.asname or a.name] = stmt
+        if not imported:
+            return
+        used: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                used.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                base = node
+                while isinstance(base, ast.Attribute):
+                    base = base.value
+                if isinstance(base, ast.Name):
+                    used.add(base.id)
+        # names re-exported via __all__ count as used
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "__all__"
+                    for t in node.targets
+                )
+                and isinstance(node.value, (ast.List, ast.Tuple))
+            ):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, str
+                    ):
+                        used.add(elt.value)
+        # strings in annotations may reference imports (from __future__)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                for name in imported:
+                    if name in node.value:
+                        used.add(name)
+        for name, node in sorted(imported.items()):
+            if name not in used:
+                self.report(node, "F401", f"'{name}' imported but unused")
+
+    # -- misc single-node checks ----------------------------------------------
+
+    def _check_misc(self, node):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            self.report(node, "E722", "bare 'except:'")
+        if isinstance(node, ast.JoinedStr) and id(node) not in self._format_specs:
+            if not any(
+                isinstance(v, ast.FormattedValue) for v in node.values
+            ):
+                self.report(node, "F541", "f-string without placeholders")
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.args.defaults + [
+                d for d in node.args.kw_defaults if d is not None
+            ]:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    self.report(
+                        d, "B006",
+                        "mutable default argument (list/dict/set literal)",
+                    )
+        if isinstance(node, ast.Compare):
+            for op, cmp in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.Eq, ast.NotEq)) and (
+                    isinstance(cmp, ast.Constant) and cmp.value is None
+                ):
+                    self.report(
+                        node, "E711", "comparison to None (use 'is None')"
+                    )
+        if isinstance(node, ast.Assert) and isinstance(node.test, ast.Tuple):
+            if node.test.elts:
+                self.report(
+                    node, "B011", "assert on tuple literal is always true"
+                )
+
+
+def lint_file(path: str) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "E999", f"syntax error: {e.msg}")]
+    return Checker(path, tree, source).run()
+
+
+def iter_py_files(targets):
+    for t in targets:
+        if os.path.isfile(t):
+            yield t
+        else:
+            for root, dirs, files in os.walk(t):
+                dirs[:] = [d for d in dirs if d not in
+                           ("__pycache__", ".git", ".pytest_cache")]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def main(argv=None) -> int:
+    targets = (argv or sys.argv[1:]) or DEFAULT_TARGETS
+    findings: List[Finding] = []
+    n = 0
+    for path in iter_py_files(targets):
+        n += 1
+        findings.extend(lint_file(path))
+    for f in findings:
+        print(f)
+    print(f"lint: {n} files, {len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,7 +24,7 @@ import logging
 import ssl
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 from ..api.v1alpha1 import webhook as logic
 from ..api.v1alpha1.types import NetworkClusterPolicy

@@ -17,7 +17,6 @@ from __future__ import annotations
 import os
 import socket
 import struct
-from dataclasses import dataclass, field
 from typing import Any, List, Optional, Tuple
 
 SYSTEM_BUS_PATH = "/var/run/dbus/system_bus_socket"
