@@ -35,8 +35,7 @@ def pytest_configure(config):
 # (and the driver's plain `pytest tests/`) still runs everything.
 SLOW_MODULES = {
     "test_models", "test_moe", "test_pipeline", "test_parallel",
-    "test_generate", "test_workload", "test_runtime",
-    "test_pallas_attention", "test_data",
+    "test_generate", "test_workload", "test_pallas_attention", "test_data",
 }
 
 

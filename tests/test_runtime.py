@@ -307,6 +307,49 @@ def test_leader_election_background_callbacks():
     assert lease["spec"]["holderIdentity"] == ""
 
 
+def test_token_review_cache_one_review_per_ttl_window():
+    """VERDICT r3 #9: one TokenReview per token per TTL window — a
+    scraping Prometheus must not hammer the apiserver."""
+    from tpu_network_operator.controller.health import CachedTokenAuthenticator
+
+    calls = []
+    clock = [0.0]
+    auth = CachedTokenAuthenticator(
+        lambda tok: calls.append(tok) or tok == "good",
+        ttl=60.0, failure_ttl=10.0, clock=lambda: clock[0],
+    )
+    # 30 scrapes inside one window: exactly one backend review
+    for _ in range(30):
+        assert auth("good")
+    assert calls == ["good"]
+    # next window: exactly one more
+    clock[0] = 61.0
+    for _ in range(30):
+        assert auth("good")
+    assert calls == ["good", "good"]
+    # failures cache too, but for the shorter failure_ttl
+    for _ in range(5):
+        assert not auth("bad")
+    assert calls.count("bad") == 1
+    clock[0] = 72.0   # 11s later: failure entry expired, success still live
+    assert not auth("bad")
+    assert calls.count("bad") == 2
+    assert auth("good")
+    assert calls.count("good") == 2
+
+
+def test_token_review_cache_bounded():
+    """A token-spraying client cannot grow the cache without bound."""
+    from tpu_network_operator.controller.health import CachedTokenAuthenticator
+
+    auth = CachedTokenAuthenticator(
+        lambda tok: False, max_entries=8, clock=lambda: 0.0,
+    )
+    for i in range(100):
+        auth(f"tok-{i}")
+    assert len(auth._cache) <= 8
+
+
 # -- entrypoint ---------------------------------------------------------------
 
 

@@ -23,7 +23,7 @@ import threading
 from typing import List, Optional
 
 from ..kube.client import ApiClient, is_openshift
-from .health import DEFAULT as METRICS, HealthServer
+from .health import DEFAULT as METRICS, CachedTokenAuthenticator, HealthServer
 from .leader import LeaderElector
 from .manager import Manager
 from .webhook_server import CERT_DIR, WebhookServer
@@ -120,8 +120,11 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
             # authn via TokenReview (what controller-runtime's
             # WithAuthenticationAndAuthorization filter does; RBAC for it
             # ships in deploy/rbac/metrics_auth_role.yaml), TLS via the
-            # cert-manager-mounted serving cert
-            auth = lambda tok: _token_review(client, tok)   # noqa: E731
+            # cert-manager-mounted serving cert.  TTL-cached: one
+            # TokenReview per token per window, not per scrape
+            auth = CachedTokenAuthenticator(
+                lambda tok: _token_review(client, tok)
+            )
             if os.path.exists(f"{args.webhook_cert_dir}/tls.crt"):
                 tls_dir = args.webhook_cert_dir
             else:
