@@ -27,7 +27,6 @@ import subprocess
 import sys
 import time
 
-import pytest
 
 from tpu_network_operator.agent.tpu.metadata import FakeMetadataServer
 from tpu_network_operator.api.v1alpha1 import webhook as wh

@@ -12,10 +12,9 @@ import pytest
 from tests.fake_ops import FakeLinkOps
 from tpu_network_operator.agent import cli as agent_cli
 from tpu_network_operator.agent import network as net
-from tpu_network_operator.agent.gaudinet import generate_gaudinet, write_gaudinet
+from tpu_network_operator.agent.gaudinet import write_gaudinet
 from tpu_network_operator.agent.systemd_networkd import (
     delete_systemd_networkd,
-    render_network,
     write_systemd_networkd,
 )
 from tpu_network_operator.agent.tpu import dcn as tpu_dcn
@@ -600,6 +599,53 @@ class TestCliLifecycle:
         assert agent_cli.cmd_run(cfg, wait_signal=False) == 1
         assert ops.addr_list() == []
         assert not (nfd_dir / "scale-out-readiness.txt").exists()
+
+    def test_hard_failure_publishes_not_ok_report(self, tmp_path, monkeypatch):
+        """A hard provisioning failure leaves an ok=False report Lease so
+        the CR's status.errors names the node and the cause (instead of an
+        opaque 'Working on it..')."""
+        from tpu_network_operator.agent import report as rpt
+        from tpu_network_operator.kube.client import ApiClient
+        from tpu_network_operator.kube.wire import WireApiServer
+        from tpu_network_operator.lldp.frame import build_lldp_frame
+
+        root = make_fake_sysfs(
+            tmp_path / "sys",
+            [("0000:19:00.0", "acc0"), ("0000:1a:00.0", "acc1")],
+        )
+        monkeypatch.setenv("SYSFS_ROOT", root)
+        frames_file = tmp_path / "lldp.json"
+        frames_file.write_text(json.dumps({
+            "acc0": build_lldp_frame(
+                "aa:bb:cc:00:00:01", "Ethernet1 10.1.0.2/30"
+            ).hex(),
+        }))
+        monkeypatch.setenv("TPUNET_LLDP_FRAMES", str(frames_file))
+        monkeypatch.setenv("NODE_NAME", "node-x")
+        ops = FakeLinkOps()
+        ops.add_fake_link("acc0", 2, "00:11:22:33:44:00")
+        ops.add_fake_link("acc1", 3, "00:11:22:33:44:01")
+        with WireApiServer() as srv:
+            monkeypatch.setenv("TPUNET_KUBE_URL", srv.url)
+            cfg = agent_cli.CmdConfig(
+                backend="gaudi", mode="L3", configure=True,
+                keep_running=True, wait=0.5, ops=ops,
+                nfd_root=str(tmp_path), lldp_backend="file",
+                report_namespace="tpunet-system", policy_name="pol",
+            )
+            assert agent_cli.cmd_run(cfg, wait_signal=False) == 1
+            client = ApiClient(srv.url)
+            leases = client.list(
+                rpt.LEASE_API, "Lease", namespace="tpunet-system",
+                label_selector={rpt.AGENT_LABEL: "true"},
+            )
+            assert len(leases) == 1
+            rep = rpt.ProvisioningReport.from_json(
+                leases[0]["metadata"]["annotations"][rpt.REPORT_ANNOTATION]
+            )
+            assert rep.ok is False
+            assert rep.node == "node-x"
+            assert "not all interfaces were configured" in rep.error
 
     def test_tpu_l3_zero_dcn_nics_fails(self, tmp_path, monkeypatch):
         """BASELINE config 3's silent failure mode (VERDICT r2 weak #3):

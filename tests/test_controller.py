@@ -79,6 +79,17 @@ def get_ds(fake, name):
     return fake.get("apps/v1", "DaemonSet", name, NAMESPACE)
 
 
+def _agent_report(fake, node, policy="gaudi-l3", ok=True, error=""):
+    """Simulate a node agent's provisioning-report Lease
+    (agent/report.py write_report path)."""
+    from tpu_network_operator.agent import report as rpt
+
+    rep = rpt.ProvisioningReport(
+        node=node, policy=policy, ok=ok, error=error,
+    )
+    fake.apply(rpt.lease_for(rep, NAMESPACE))
+
+
 class TestGaudiProjection:
     # ref controller_test.go:106-134
     def test_l3_daemonset_args_and_volumes(self, env):
@@ -92,6 +103,8 @@ class TestGaudiProjection:
             "--configure=true",
             "--keep-running",
             "--mode=L3",
+            "--report-namespace=tpunet-system",
+            "--policy-name=gaudi-l3",
             "--mtu=8000",
             "--wait=90s",
             "--gaudinet=/host/etc/habanalabs/gaudinet.json",
@@ -128,6 +141,8 @@ class TestGaudiProjection:
             "--configure=true",
             "--keep-running",
             "--mode=L2",
+            "--report-namespace=tpunet-system",
+            "--policy-name=gaudi-l3",
         ]
 
     # ref controller_test.go:153-180
@@ -180,6 +195,8 @@ class TestTpuProjection:
             "--keep-running",
             "--backend=tpu",
             "--mode=L3",
+            "--report-namespace=tpunet-system",
+            "--policy-name=tpu-slice",
             "--mtu=8896",
             "--topology-source=auto",
             "--coordinator-port=8476",
@@ -233,6 +250,9 @@ class TestStatusMachine:
 
     # beyond the reference: node simulation drives the full state machine
     def test_working_then_all_good(self, env):
+        """"All good" requires BOTH pod-readiness and a successful
+        provisioning report from every target node's agent (VERDICT r3
+        #3) — pod counts alone never flip the state anymore."""
         fake, mgr = env
         for i in range(3):
             fake.add_node(
@@ -244,6 +264,7 @@ class TestStatusMachine:
         reconcile(fake, mgr, "gaudi-l3")
 
         fake.simulate_daemonset_controller(ready_nodes=["node-0"])
+        _agent_report(fake, "node-0")
         reconcile(fake, mgr, "gaudi-l3")
         cr = fake.get(API_VERSION, "NetworkClusterPolicy", "gaudi-l3")
         assert cr["status"] == {
@@ -253,13 +274,73 @@ class TestStatusMachine:
             "errors": [],
         }
 
-        fake.simulate_daemonset_controller()  # all ready
+        # every pod ready, but two agents have not reported success:
+        # the reference would say "All good" here — we must not
+        fake.simulate_daemonset_controller()
+        reconcile(fake, mgr, "gaudi-l3")
+        cr = fake.get(API_VERSION, "NetworkClusterPolicy", "gaudi-l3")
+        assert cr["status"]["state"] == "Working on it.."
+        assert cr["status"]["ready"] == 1
+
+        _agent_report(fake, "node-1")
+        _agent_report(fake, "node-2")
         reconcile(fake, mgr, "gaudi-l3")
         cr = fake.get(API_VERSION, "NetworkClusterPolicy", "gaudi-l3")
         assert cr["status"]["state"] == "All good"
         assert cr["status"]["ready"] == 3
         # agent pods materialized under the DS (feeds the pod indexer)
         assert len(fake.list("v1", "Pod", namespace=NAMESPACE)) == 3
+
+    def test_stale_report_from_departed_node_ignored(self, env):
+        """A Lease left behind by a crashed/replaced node (retraction is
+        best-effort) must not stand in for a live node's missing report."""
+        fake, mgr = env
+        fake.add_node(
+            "node-new", {"intel.feature.node.kubernetes.io/gaudi": "true"}
+        )
+        fake.create(gaudi_cr().to_dict())
+        reconcile(fake, mgr, "gaudi-l3")
+        fake.simulate_daemonset_controller()
+        # ok report from a node that no longer runs an agent pod
+        _agent_report(fake, "node-departed")
+        reconcile(fake, mgr, "gaudi-l3")
+        cr = fake.get(API_VERSION, "NetworkClusterPolicy", "gaudi-l3")
+        assert cr["status"]["state"] == "Working on it.."
+        assert cr["status"]["ready"] == 0
+        # the live node's report counts
+        _agent_report(fake, "node-new")
+        reconcile(fake, mgr, "gaudi-l3")
+        cr = fake.get(API_VERSION, "NetworkClusterPolicy", "gaudi-l3")
+        assert cr["status"]["state"] == "All good"
+        assert cr["status"]["ready"] == 1
+
+    def test_failure_report_flips_all_good_back(self, env):
+        """An induced per-node failure (e.g. a NIC lost its LLDP peer on
+        re-provision) demotes the CR from "All good" and surfaces the
+        node's error in status.errors."""
+        fake, mgr = env
+        fake.add_node(
+            "node-0", {"intel.feature.node.kubernetes.io/gaudi": "true"}
+        )
+        fake.create(gaudi_cr().to_dict())
+        reconcile(fake, mgr, "gaudi-l3")
+        fake.simulate_daemonset_controller()
+        _agent_report(fake, "node-0")
+        reconcile(fake, mgr, "gaudi-l3")
+        assert fake.get(API_VERSION, "NetworkClusterPolicy", "gaudi-l3")[
+            "status"]["state"] == "All good"
+
+        _agent_report(
+            fake, "node-0", ok=False,
+            error="not all interfaces were configured (1/2)",
+        )
+        reconcile(fake, mgr, "gaudi-l3")
+        cr = fake.get(API_VERSION, "NetworkClusterPolicy", "gaudi-l3")
+        assert cr["status"]["state"] == "Working on it.."
+        assert cr["status"]["ready"] == 0
+        assert cr["status"]["errors"] == [
+            "node-0: not all interfaces were configured (1/2)"
+        ]
 
     def test_admission_rejects_bad_cr(self, env):
         fake, _ = env

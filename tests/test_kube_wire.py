@@ -1,0 +1,248 @@
+"""ApiClient conformance against a real HTTP wire (VERDICT r3 #4).
+
+The reference exercises its client against envtest's real apiserver
+(ref internal/controller/suite_test.go:61-102); no apiserver binary
+exists here, so kube/wire.py serves the REST API over actual HTTP(S) on
+localhost and the real ApiClient talks to it — TLS handshake, chunked
+watch decode, reconnect-after-drop, 410 Gone relist, 409 mapping,
+server-side apply.  A client-side wire bug now fails these tests instead
+of shipping.
+"""
+
+import json
+import os
+import subprocess
+import time
+
+import pytest
+
+from tpu_network_operator.kube import errors as kerr
+from tpu_network_operator.kube.client import ApiClient, is_openshift
+from tpu_network_operator.kube.wire import WireApiServer
+
+
+def make_policy(name, layer="L2"):
+    return {
+        "apiVersion": "tpunet.dev/v1alpha1",
+        "kind": "NetworkClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {
+            "configurationType": "tpu-so",
+            "nodeSelector": {"x": "y"},
+            "tpuScaleOut": {"layer": layer},
+        },
+    }
+
+
+@pytest.fixture()
+def srv():
+    with WireApiServer() as s:
+        yield s
+
+
+@pytest.fixture()
+def client(srv):
+    return ApiClient(srv.url)
+
+
+class TestCrudOverWire:
+    def test_create_get_update_delete(self, client):
+        client.create(make_policy("p1"))
+        got = client.get("tpunet.dev/v1alpha1", "NetworkClusterPolicy", "p1")
+        assert got["spec"]["tpuScaleOut"]["layer"] == "L2"
+        got["spec"]["tpuScaleOut"]["layer"] = "L3"
+        client.update(got)
+        got = client.get("tpunet.dev/v1alpha1", "NetworkClusterPolicy", "p1")
+        assert got["spec"]["tpuScaleOut"]["layer"] == "L3"
+        client.delete("tpunet.dev/v1alpha1", "NetworkClusterPolicy", "p1")
+        with pytest.raises(kerr.NotFoundError):
+            client.get("tpunet.dev/v1alpha1", "NetworkClusterPolicy", "p1")
+
+    def test_already_exists_maps_to_409_reason(self, client):
+        client.create(make_policy("dup"))
+        with pytest.raises(kerr.AlreadyExistsError):
+            client.create(make_policy("dup"))
+
+    def test_conflict_maps_to_conflict_error(self, client):
+        client.create(make_policy("c1"))
+        got = client.get("tpunet.dev/v1alpha1", "NetworkClusterPolicy", "c1")
+        stale = json.loads(json.dumps(got))
+        got["spec"]["logLevel"] = 3
+        client.update(got)
+        stale["spec"]["logLevel"] = 5
+        with pytest.raises(kerr.ConflictError):
+            client.update(stale)   # resourceVersion behind
+
+    def test_list_with_label_selector(self, client):
+        lease = {
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {"name": "l1", "namespace": "ns1",
+                         "labels": {"app": "tpunet-agent"}},
+            "spec": {"holderIdentity": "node-1"},
+        }
+        client.create(lease)
+        other = json.loads(json.dumps(lease))
+        other["metadata"] = {"name": "l2", "namespace": "ns1",
+                             "labels": {"app": "other"}}
+        client.create(other)
+        items = client.list(
+            "coordination.k8s.io/v1", "Lease", namespace="ns1",
+            label_selector={"app": "tpunet-agent"},
+        )
+        assert [o["metadata"]["name"] for o in items] == ["l1"]
+
+    def test_update_status_subresource(self, client):
+        client.create(make_policy("st"))
+        obj = client.get("tpunet.dev/v1alpha1", "NetworkClusterPolicy", "st")
+        obj["status"] = {"state": "All good"}
+        client.update_status(obj)
+        got = client.get("tpunet.dev/v1alpha1", "NetworkClusterPolicy", "st")
+        assert got["status"]["state"] == "All good"
+
+    def test_server_side_apply_create_then_merge(self, client):
+        lease = {
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {"name": "ap", "namespace": "ns1",
+                         "annotations": {"a": "1"}},
+            "spec": {"holderIdentity": "n1"},
+        }
+        created = client.apply(lease)
+        assert created["spec"]["holderIdentity"] == "n1"
+        patch = {
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {"name": "ap", "namespace": "ns1",
+                         "annotations": {"b": "2"}},
+        }
+        merged = client.apply(patch)
+        assert merged["metadata"]["annotations"] == {"a": "1", "b": "2"}
+        assert merged["spec"]["holderIdentity"] == "n1"   # untouched
+
+    def test_is_openshift_detection(self):
+        with WireApiServer(openshift=True) as s:
+            assert is_openshift(ApiClient(s.url)) is True
+        with WireApiServer(openshift=False) as s:
+            assert is_openshift(ApiClient(s.url)) is False
+
+
+class TestWatchOverWire:
+    def _collect(self, watch, n, timeout=10.0, until_name=None):
+        """Collect up to ``n`` events, returning early when ``until_name``
+        is seen (drop/reconnect tests race benign extra events)."""
+        out = []
+        deadline = time.time() + timeout
+        while len(out) < n and time.time() < deadline:
+            ev = watch.next(timeout=0.2)
+            if ev:
+                out.append(ev)
+                if until_name and ev[1]["metadata"]["name"] == until_name:
+                    break
+        return out
+
+    def test_chunked_watch_stream(self, srv, client):
+        w = client.watch("tpunet.dev/v1alpha1", "NetworkClusterPolicy")
+        time.sleep(0.3)   # let the stream connect
+        srv.cluster.create(make_policy("w1"))
+        srv.cluster.create(make_policy("w2"))
+        evs = self._collect(w, 2)
+        assert [(t, o["metadata"]["name"]) for t, o in evs] == [
+            ("ADDED", "w1"), ("ADDED", "w2"),
+        ]
+        w.stop()
+
+    def test_watch_survives_connection_drop(self, srv, client):
+        w = client.watch("tpunet.dev/v1alpha1", "NetworkClusterPolicy")
+        time.sleep(0.3)
+        srv.cluster.create(make_policy("d1"))
+        assert self._collect(w, 1)
+        srv.drop_watch_once()
+        srv.cluster.create(make_policy("d2"))   # may race the drop
+        time.sleep(1.5)                          # reconnect backoff is 1s
+        srv.cluster.create(make_policy("d3"))
+        # d3 postdates the reconnect: seeing it proves the stream revived
+        evs = self._collect(w, 2, timeout=10, until_name="d3")
+        assert any(o["metadata"]["name"] == "d3" for _, o in evs)
+        w.stop()
+
+    def test_watch_410_gone_triggers_relist(self, srv, client):
+        w = client.watch("tpunet.dev/v1alpha1", "NetworkClusterPolicy")
+        time.sleep(0.3)
+        srv.cluster.create(make_policy("g1"))
+        assert self._collect(w, 1)   # client now has a resourceVersion
+        srv.inject_gone_once()       # next reconnect with rv gets ERROR 410
+        srv.drop_watch_once()        # force that reconnect
+        time.sleep(1.5)
+        srv.cluster.create(make_policy("g2"))
+        evs = self._collect(w, 2, timeout=10, until_name="g2")
+        assert any(o["metadata"]["name"] == "g2" for _, o in evs)
+        w.stop()
+
+
+class TestAuthAndTls:
+    def test_bearer_token_required(self):
+        with WireApiServer(require_token=True) as s:
+            s.valid_tokens.add("sekret")
+            ok = ApiClient(s.url, token="sekret")
+            ok.create(make_policy("t1"))
+            bad = ApiClient(s.url, token="wrong")
+            with pytest.raises(kerr.ApiError):
+                bad.create(make_policy("t2"))
+
+    def test_token_review_endpoint(self):
+        with WireApiServer() as s:
+            s.valid_tokens.add("good-token")
+            c = ApiClient(s.url)
+            r = c.create({
+                "apiVersion": "authentication.k8s.io/v1",
+                "kind": "TokenReview",
+                "metadata": {"name": ""},
+                "spec": {"token": "good-token"},
+            })
+            assert r["status"]["authenticated"] is True
+            r = c.create({
+                "apiVersion": "authentication.k8s.io/v1",
+                "kind": "TokenReview",
+                "metadata": {"name": ""},
+                "spec": {"token": "nope"},
+            })
+            assert r["status"]["authenticated"] is False
+
+    def test_tls_handshake(self, tmp_path):
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(tmp_path / "tls.key"),
+             "-out", str(tmp_path / "tls.crt"),
+             "-days", "1", "-subj", "/CN=127.0.0.1",
+             "-addext", "subjectAltName=IP:127.0.0.1"],
+            check=True, capture_output=True,
+        )
+        with WireApiServer(tls_cert_dir=str(tmp_path)) as s:
+            assert s.url.startswith("https://")
+            c = ApiClient(s.url, ca_file=str(tmp_path / "tls.crt"))
+            c.create(make_policy("tls1"))
+            assert c.get(
+                "tpunet.dev/v1alpha1", "NetworkClusterPolicy", "tls1"
+            )["metadata"]["name"] == "tls1"
+
+
+class TestReconcilerOverWire:
+    def test_full_reconcile_through_real_http(self, srv):
+        """The envtest-shaped test: real reconciler + real client + real
+        HTTP apiserver — CR in, DaemonSet projected, status written."""
+        from tpu_network_operator.controller.reconciler import (
+            NetworkClusterPolicyReconciler,
+        )
+
+        client = ApiClient(srv.url)
+        rec = NetworkClusterPolicyReconciler(client, namespace="tpunet-system")
+        rec.setup()
+        client.create(make_policy("wire-policy", layer="L3"))
+        rec.reconcile("wire-policy")
+        ds = client.list("apps/v1", "DaemonSet", namespace="tpunet-system")
+        assert len(ds) == 1
+        args = ds[0]["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--backend=tpu" in args and "--wait=90s" in args
+        rec.reconcile("wire-policy")
+        got = client.get(
+            "tpunet.dev/v1alpha1", "NetworkClusterPolicy", "wire-policy"
+        )
+        assert got["status"]["state"] == "No targets"

@@ -27,7 +27,7 @@ from ..lldp import detect_lldp
 from . import netlink as nl
 from . import network as net
 from .gaudinet import write_gaudinet
-from .systemd_networkd import delete_systemd_networkd, write_systemd_networkd
+from .systemd_networkd import write_systemd_networkd
 from .tpu import bootstrap as tpu_bootstrap
 from .tpu import dcn as tpu_dcn
 from .tpu import topology as tpu_topology
@@ -57,6 +57,9 @@ class CmdConfig:
     topology_source: str = "auto"
     coordinator_port: int = 8476
     bootstrap: str = ""
+    # readiness reporting (Lease in the operator namespace; empty = off)
+    report_namespace: str = ""
+    policy_name: str = ""
     # seams
     ops: nl.LinkOps = field(default_factory=nl.LinkOps)
     # host-root override for the NFD features dir; env-settable so a
@@ -97,8 +100,12 @@ def post_cleanups(
     config: CmdConfig, configs: Dict[str, net.NetworkConfiguration]
 ) -> None:
     """ref ``postCleanups()`` main.go:143-159: label off, IPs off, links
-    restored; bootstrap removed for the tpu backend."""
+    restored; bootstrap removed for the tpu backend.  Ordering is the
+    drain contract (SURVEY.md §7 hard part 5): readiness signals retract
+    FIRST (cluster report, then NFD label, then bootstrap) so schedulers
+    stop placing work before any route is withdrawn."""
     log.info("clean up before exiting...")
+    _retract_report(config)
     nfd.remove_readiness_label(root=config.nfd_root)
     if config.backend == "tpu" and config.bootstrap:
         tpu_bootstrap.delete_bootstrap(config.bootstrap)
@@ -107,6 +114,92 @@ def post_cleanups(
     except nl.NetlinkError as e:
         log.warning("failed to remove existing IPs: %s", e)
     net.interfaces_restore_down(configs, config.ops)
+
+
+def _kube_client():
+    """Cluster client for readiness reporting: explicit URL (test seam /
+    non-standard deployments) or in-cluster SA config; None when neither
+    is available (reporting silently off — the NFD label remains the
+    node-local signal)."""
+    from ..kube.client import ApiClient
+
+    url = os.environ.get("TPUNET_KUBE_URL", "")
+    if url:
+        return ApiClient(
+            url, token=os.environ.get("TPUNET_KUBE_TOKEN") or None
+        )
+    try:
+        return ApiClient.in_cluster()
+    except Exception:   # noqa: BLE001 — not in a cluster
+        return None
+
+
+def _publish_report(
+    config: CmdConfig,
+    configs: Dict[str, net.NetworkConfiguration],
+    coordinator: str,
+) -> None:
+    """Write the per-node provisioning report Lease (VERDICT r3 #3)."""
+    if not config.report_namespace:
+        return
+    node = os.environ.get("NODE_NAME", "")
+    if not node:
+        log.warning("NODE_NAME unset; cannot write provisioning report")
+        return
+    client = _kube_client()
+    if client is None:
+        log.warning("no cluster access; provisioning report skipped")
+        return
+    from . import report as rpt
+
+    rep = rpt.report_from_result(
+        node=node,
+        policy=config.policy_name,
+        backend=config.backend,
+        mode=config.mode,
+        configs=configs,
+        bootstrap_path=config.bootstrap,
+        coordinator=coordinator,
+    )
+    rpt.write_report(client, config.report_namespace, rep)
+
+
+def _publish_failure_report(config: CmdConfig, error: str) -> None:
+    """ok=False report on a hard provisioning failure: the reconciler
+    shows the node's error in status.errors instead of an opaque
+    'Working on it..' while the DaemonSet restarts the pod."""
+    if not config.report_namespace:
+        return
+    node = os.environ.get("NODE_NAME", "")
+    client = _kube_client() if node else None
+    if client is None:
+        return
+    from . import report as rpt
+
+    rpt.write_report(
+        client,
+        config.report_namespace,
+        rpt.ProvisioningReport(
+            node=node,
+            policy=config.policy_name,
+            ok=False,
+            backend=config.backend,
+            mode=config.mode,
+            error=error,
+        ),
+    )
+
+
+def _retract_report(config: CmdConfig) -> None:
+    if not config.report_namespace:
+        return
+    node = os.environ.get("NODE_NAME", "")
+    client = _kube_client() if node else None
+    if client is None:
+        return
+    from . import report as rpt
+
+    rpt.delete_report(client, config.report_namespace, node)
 
 
 def _detect_and_apply_lldp(
@@ -235,25 +328,23 @@ def _tpu_emit_bootstrap(
     worker_net_config: List[Dict],
     topo: tpu_topology.TpuTopology,
     configs: Dict[str, net.NetworkConfiguration],
-) -> None:
+) -> str:
     """Assemble + write the jax.distributed bootstrap (the gaudinet.json
     analog).  ``dcn_interfaces`` lists the DCN NICs traffic can actually
     ride: up, and in L3 mode also LLDP-addressed — an unaddressed link is
-    not a usable inter-slice path."""
-    usable = [
-        n for n, c in configs.items()
-        if c.link.is_up and (config.mode != L3 or c.local_addr is not None)
-    ]
+    not a usable inter-slice path.  Returns the coordinator address for
+    the readiness report."""
     cfg = tpu_bootstrap.build_bootstrap(
         topo,
         worker_net_config,
         config.coordinator_port,
         megascale_coordinator=topo.megascale_coordinator,
-        dcn_interfaces=sorted(usable),
+        dcn_interfaces=net.usable_interfaces(configs, config.mode == L3),
     )
     if config.bootstrap:
         tpu_bootstrap.write_bootstrap(cfg, config.bootstrap)
         log.info("wrote bootstrap to %s", config.bootstrap)
+    return cfg.coordinator_address
 
 
 def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
@@ -277,6 +368,7 @@ def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
             topo = _tpu_discovery(config, metadata_client)
             worker_net_config = metadata_client.worker_network_config()
 
+        coordinator = ""
         names = _resolve_interfaces(config, metadata_client)
         try:
             if names:
@@ -300,7 +392,9 @@ def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
                 # dry-run must not leave a readiness artifact behind
                 # (unlike gaudinet.json, which the reference writes even
                 # in dry-run — the bootstrap is a signal, not a dump)
-                _tpu_emit_bootstrap(config, worker_net_config, topo, configs)
+                coordinator = _tpu_emit_bootstrap(
+                    config, worker_net_config, topo, configs
+                )
         except Exception:
             # a failure after link mutation must not leave the node in a
             # half-provisioned state the next pod can't reason about
@@ -314,6 +408,9 @@ def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
             return 0
 
         if config.keep_running:
+            # report first, then label: the cluster-visible record of WHAT
+            # was provisioned precedes the schedulability signal
+            _publish_report(config, configs, coordinator)
             if nfd.write_readiness_label(ready_label, root=config.nfd_root):
                 log.info("wrote NFD readiness label")
             if wait_signal:
@@ -327,6 +424,10 @@ def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
         RuntimeError,
     ) as e:
         log.error("%s", e)
+        if config.configure:
+            # surface the failure in the CR: a not-ok report feeds
+            # status.errors (cleanup above retracted any stale ok one)
+            _publish_failure_report(config, str(e))
         return 1
 
 
@@ -363,6 +464,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--topology-source", default="auto")
     p.add_argument("--coordinator-port", type=int, default=8476)
     p.add_argument("--bootstrap", default="")
+    p.add_argument("--report-namespace", default="",
+                   help="namespace for the provisioning-report Lease "
+                        "(empty = no cluster reporting)")
+    p.add_argument("--policy-name", default="",
+                   help="owning NetworkClusterPolicy, labeled on the report")
     return p
 
 
@@ -420,6 +526,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         topology_source=args.topology_source,
         coordinator_port=args.coordinator_port,
         bootstrap=args.bootstrap,
+        report_namespace=args.report_namespace,
+        policy_name=args.policy_name,
     )
     try:
         return cmd_run(config)

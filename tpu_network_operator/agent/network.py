@@ -272,6 +272,20 @@ def configure_interfaces(
     return configured, len(configs)
 
 
+def usable_interfaces(
+    configs: Dict[str, NetworkConfiguration], l3: bool
+) -> List[str]:
+    """Interfaces traffic can actually ride: link up, and in L3 mode also
+    LLDP-addressed (an unaddressed link is not a usable path).  The single
+    definition consumed by the bootstrap's ``dcn_interfaces`` and the
+    provisioning report."""
+    return sorted(
+        name
+        for name, cfg in configs.items()
+        if cfg.link.is_up and (not l3 or cfg.local_addr is not None)
+    )
+
+
 def log_results(
     configs: Dict[str, NetworkConfiguration], ops: nl.LinkOps, l3: bool
 ) -> None:

@@ -1,0 +1,165 @@
+"""Per-node provisioning report (the readiness back-channel).
+
+The reference's operator infers readiness purely from DaemonSet
+scheduling counts (ref networkconfiguration_controller.go:282-295) — a
+pod can be Running with zero usable interfaces behind it.  Here the
+agent reports what it actually accomplished by server-side-applying a
+``coordination.k8s.io/v1`` Lease named after the node into the operator
+namespace (the kubelet-heartbeat pattern), carrying a JSON report in an
+annotation.  The reconciler aggregates these so the CR's "All good"
+means "a JAX job will start on every target node" (SURVEY.md §7 hard
+part 3), not "the pods scheduled".
+
+The report includes a coordinator reachability probe: a TCP connect to
+the jax.distributed coordinator address.  Nothing listens on the port
+until the job starts, so ECONNREFUSED counts as REACHABLE (the host
+routes and answers); only timeout / no-route / name-failure count as
+unreachable — exactly the failure the DCN provisioning exists to
+prevent.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import logging
+import socket
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+log = logging.getLogger("tpunet.agent")
+
+LEASE_API = "coordination.k8s.io/v1"
+REPORT_ANNOTATION = "tpunet.dev/provisioning-report"
+AGENT_LABEL = "tpunet.dev/agent"
+POLICY_LABEL = "tpunet.dev/policy"
+
+
+@dataclass
+class ProvisioningReport:
+    """What this node's agent actually provisioned."""
+
+    node: str
+    policy: str = ""
+    ok: bool = False
+    backend: str = ""
+    mode: str = ""
+    interfaces_configured: int = 0
+    interfaces_total: int = 0
+    bootstrap_written: bool = False
+    coordinator: str = ""
+    coordinator_reachable: Optional[bool] = None
+    dcn_interfaces: List[str] = field(default_factory=list)
+    error: str = ""
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), sort_keys=True)
+
+    @staticmethod
+    def from_json(raw: str) -> "ProvisioningReport":
+        return ProvisioningReport(**json.loads(raw))
+
+
+def coordinator_reachable(address: str, timeout: float = 3.0) -> bool:
+    """TCP probe of ``host:port``.  Pre-job there is no listener, so a
+    fast RST (ECONNREFUSED) proves reachability; only can't-get-there
+    failures (timeout, unreachable, resolution) return False."""
+    host, _, port_s = address.rpartition(":")
+    try:
+        port = int(port_s)
+    except ValueError:
+        return False
+    try:
+        with socket.create_connection((host, port), timeout=timeout):
+            return True
+    except ConnectionRefusedError:
+        return True
+    except OSError as e:
+        if e.errno == errno.ECONNREFUSED:
+            return True
+        log.warning("coordinator %s unreachable: %s", address, e)
+        return False
+
+
+def lease_name(node: str) -> str:
+    return f"tpunet-agent-{node}"
+
+
+def lease_for(report: ProvisioningReport, namespace: str) -> Dict:
+    return {
+        "apiVersion": LEASE_API,
+        "kind": "Lease",
+        "metadata": {
+            "name": lease_name(report.node),
+            "namespace": namespace,
+            "labels": {
+                AGENT_LABEL: "true",
+                POLICY_LABEL: report.policy or "unowned",
+            },
+            "annotations": {REPORT_ANNOTATION: report.to_json()},
+        },
+        "spec": {"holderIdentity": report.node},
+    }
+
+
+def write_report(client, namespace: str, report: ProvisioningReport) -> bool:
+    """Server-side apply the report Lease.  Best-effort: the label file
+    remains the node-local signal; a cluster API hiccup must not fail the
+    provisioning pass.  Returns True when the report landed."""
+    try:
+        client.apply(lease_for(report, namespace), field_manager="tpunet-agent")
+        log.info("provisioning report written (ok=%s)", report.ok)
+        return True
+    except Exception as e:   # noqa: BLE001 — report is advisory
+        log.warning("could not write provisioning report: %s", e)
+        return False
+
+
+def delete_report(client, namespace: str, node: str) -> None:
+    """Remove the node's report — the FIRST step of teardown, so the
+    operator marks the node not-ready before any route is withdrawn
+    (drain ordering, SURVEY.md §7 hard part 5)."""
+    try:
+        client.delete(LEASE_API, "Lease", lease_name(node), namespace)
+    except Exception as e:   # noqa: BLE001 — already gone is fine
+        log.debug("report delete: %s", e)
+
+
+def report_from_result(
+    node: str,
+    policy: str,
+    backend: str,
+    mode: str,
+    configs,
+    bootstrap_path: str,
+    coordinator: str = "",
+    probe=coordinator_reachable,
+) -> ProvisioningReport:
+    """Assemble the report from the agent's post-pass state."""
+    import os
+
+    from .network import usable_interfaces
+
+    usable = usable_interfaces(configs, mode == "L3")
+    bootstrap_written = bool(bootstrap_path) and os.path.exists(bootstrap_path)
+    reachable = None
+    if coordinator:
+        reachable = probe(coordinator)
+    ok = (
+        len(usable) == len(configs)
+        and (not bootstrap_path or bootstrap_written)
+        and (reachable is not False)
+    )
+    return ProvisioningReport(
+        node=node,
+        policy=policy,
+        ok=ok,
+        backend=backend,
+        mode=mode,
+        interfaces_configured=len(usable),
+        interfaces_total=len(configs),
+        bootstrap_written=bootstrap_written,
+        coordinator=coordinator,
+        coordinator_reachable=reachable,
+        dcn_interfaces=usable,
+    )

@@ -37,6 +37,10 @@ STATE_NO_TARGETS = "No targets"      # ref controller :290
 STATE_WORKING = "Working on it.."    # ref controller :292
 STATE_ALL_GOOD = "All good"          # ref controller :294
 
+# shared agent ServiceAccount (deploy/rbac/agent_service_account.yaml):
+# grants the provisioning-report Lease writes (agent/report.py)
+AGENT_SERVICE_ACCOUNT = "tpunet-agent"
+
 
 @dataclass
 class Result:
@@ -103,6 +107,10 @@ def update_gaudi_scale_out_daemonset(
         container["imagePullPolicy"] = so.pull_policy
 
     args = ["--configure=true", "--keep-running", f"--mode={so.layer}"]
+    args += [
+        f"--report-namespace={namespace}",
+        f"--policy-name={policy.metadata.name}",
+    ]
     if spec.log_level > 0:
         args.append(f"--v={spec.log_level}")
     if so.mtu > 0:
@@ -160,6 +168,10 @@ def update_tpu_scale_out_daemonset(
         "--keep-running",
         "--backend=tpu",
         f"--mode={so.layer or t.LAYER_L2}",
+    ]
+    args += [
+        f"--report-namespace={namespace}",
+        f"--policy-name={policy.metadata.name}",
     ]
     if spec.log_level > 0:
         args.append(f"--v={spec.log_level}")
@@ -265,6 +277,36 @@ class NetworkClusterPolicyReconciler:
         except kerr.AlreadyExistsError:
             pass
 
+        # the per-policy SA also needs the provisioning-report Lease
+        # grant the shared tpunet-agent SA gets from
+        # deploy/rbac/agent_report_role_binding.yaml — without it the
+        # OpenShift agents' reports 403 and the CR can never go ready
+        report_rb = {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": {
+                "name": sa_name + "-report-rb",
+                "namespace": self.namespace,
+            },
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "Role",
+                "name": "agent-report-role",
+            },
+            "subjects": [
+                {
+                    "kind": "ServiceAccount",
+                    "name": sa_name,
+                    "namespace": self.namespace,
+                }
+            ],
+        }
+        self._own(policy, report_rb)
+        try:
+            self.client.create(report_rb)
+        except kerr.AlreadyExistsError:
+            pass
+
     def _own(self, policy: NetworkClusterPolicy, obj: Dict[str, Any]) -> None:
         meta = am.ObjectMeta()
         am.set_controller_reference(policy, meta)
@@ -286,7 +328,13 @@ class NetworkClusterPolicyReconciler:
             log.error("unknown configuration type %r, this shouldn't happen", ctype)
             raise kerr.ApiError(f"unknown configuration type {ctype!r}")
 
-        sa_name = policy.metadata.name + "-sa" if self.is_openshift else ""
+        # non-OpenShift: the shared agent SA (deploy/rbac/agent_*.yaml)
+        # whose Role allows the provisioning-report Lease writes;
+        # OpenShift: per-policy SA for the SCC RoleBinding (ref :109-162)
+        sa_name = (
+            policy.metadata.name + "-sa" if self.is_openshift
+            else AGENT_SERVICE_ACCOUNT
+        )
         ds["spec"]["template"]["spec"]["serviceAccountName"] = sa_name
 
         project(ds, policy, self.namespace)
@@ -294,7 +342,7 @@ class NetworkClusterPolicyReconciler:
         self.client.create(ds)
         log.info("scale-out daemonset created: %s", ds["metadata"]["name"])
 
-        if sa_name:
+        if self.is_openshift:
             self._create_openshift_collateral(policy, sa_name)
         return Result()
 
@@ -314,29 +362,105 @@ class NetworkClusterPolicyReconciler:
 
     # -- status ---------------------------------------------------------------
 
+    def _agent_reports(self, policy_name: str) -> List[Any]:
+        """Per-node provisioning reports (Leases the agents apply,
+        agent/report.py).  Parse failures count as not-ready reports."""
+        from ..agent import report as rpt
+
+        try:
+            leases = self.client.list(
+                rpt.LEASE_API,
+                "Lease",
+                namespace=self.namespace,
+                label_selector={
+                    rpt.AGENT_LABEL: "true",
+                    rpt.POLICY_LABEL: policy_name,
+                },
+            )
+        except Exception as e:   # noqa: BLE001 — absence = no reports yet
+            log.debug("agent report list failed: %s", e)
+            return []
+        out = []
+        for lease in leases:
+            raw = (
+                lease.get("metadata", {}).get("annotations", {}) or {}
+            ).get(rpt.REPORT_ANNOTATION, "")
+            try:
+                out.append(rpt.ProvisioningReport.from_json(raw))
+            except Exception:   # noqa: BLE001 — malformed = not ready
+                node = lease.get("spec", {}).get("holderIdentity", "?")
+                out.append(rpt.ProvisioningReport(
+                    node=node, ok=False, error="unparseable report"
+                ))
+        return out
+
+    def _target_nodes(self, ds: Dict[str, Any]) -> set:
+        """Nodes the DaemonSet's pods currently sit on (via the owned-pod
+        field index, ref ``indexPods`` :385-404).  Empty when no pods have
+        materialized (e.g. envtest-style runs), in which case report
+        filtering degrades to trusting the Lease set."""
+        try:
+            pods = self.client.list(
+                "v1",
+                "Pod",
+                namespace=self.namespace,
+                field_index={OWNER_KEY: ds["metadata"]["name"]},
+            )
+        except Exception as e:   # noqa: BLE001 — index absence = no info
+            log.debug("pod list for node correlation failed: %s", e)
+            return set()
+        return {
+            p.get("spec", {}).get("nodeName", "")
+            for p in pods
+        } - {""}
+
     def _update_status(
         self, policy: NetworkClusterPolicy, ds: Dict[str, Any]
     ) -> Result:
-        """ref ``updateStatus()`` :267-307: status from DaemonSet counts;
-        conflict → requeue."""
+        """Status from DaemonSet counts AND per-node agent reports.
+
+        Stronger than ref ``updateStatus()`` :267-307 (pure pod
+        arithmetic): "All good" here requires every target node's agent
+        to have reported a successful provisioning pass — bootstrap
+        written, all interfaces configured, coordinator reachable — i.e.
+        "a JAX job will start" (SURVEY.md §7 hard part 3).  Conflict →
+        requeue, as in the reference."""
         ds_status = ds.get("status", {}) or {}
         targets = int(ds_status.get("desiredNumberScheduled", 0))
-        ready = int(ds_status.get("numberReady", 0))
+        pods_ready = int(ds_status.get("numberReady", 0))
+
+        reports = self._agent_reports(policy.metadata.name)
+        # correlate with the nodes the DaemonSet actually targets: a
+        # stale Lease from a departed node (crash without retraction)
+        # must not stand in for a live node's missing report
+        target_nodes = self._target_nodes(ds)
+        if target_nodes:
+            reports = [r for r in reports if r.node in target_nodes]
+        ok_nodes = sorted(r.node for r in reports if r.ok)
+        errors = sorted(
+            f"{r.node}: {r.error or 'provisioning incomplete'}"
+            for r in reports
+            if not r.ok
+        )
+        ready = len(ok_nodes)
+
+        if targets == 0:
+            state = STATE_NO_TARGETS
+        elif pods_ready < targets or ready < targets:
+            state = STATE_WORKING
+        else:
+            state = STATE_ALL_GOOD
 
         updated = (
             policy.status.targets != targets
             or policy.status.ready_nodes != ready
-            or not policy.status.state
+            or policy.status.state != state
+            or policy.status.errors != errors
         )
         policy.status.targets = targets
         policy.status.ready_nodes = ready
-        policy.status.errors = []
-        if targets == 0:
-            policy.status.state = STATE_NO_TARGETS
-        elif ready < targets:
-            policy.status.state = STATE_WORKING
-        else:
-            policy.status.state = STATE_ALL_GOOD
+        policy.status.errors = errors
+        policy.status.state = state
 
         if updated:
             try:

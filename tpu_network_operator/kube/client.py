@@ -209,6 +209,35 @@ class ApiClient:
             obj,
         )
 
+    def apply(
+        self, obj: Dict[str, Any], field_manager: str = "tpunet"
+    ) -> Dict[str, Any]:
+        """Server-side apply: PATCH with apply semantics — create the
+        object if absent, merge the given fields if present.  The agent's
+        readiness report uses this (one idempotent call instead of a
+        create/conflict/update dance)."""
+        av, kind = obj["apiVersion"], obj["kind"]
+        m = obj.get("metadata", {})
+        url = self._url(av, kind, m.get("namespace", ""), m["name"])
+        url += f"?fieldManager={field_manager}&force=true"
+        data = json.dumps(obj).encode()
+        req = urllib.request.Request(url, data=data, method="PATCH")
+        req.add_header("Accept", "application/json")
+        req.add_header("Content-Type", "application/apply-patch+yaml")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout, context=self._ctx
+            ) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else {}
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:512]
+            if e.code == 409:
+                raise kerr.ConflictError(detail) from None
+            raise kerr.ApiError(f"{e.code}: {detail}") from None
+
     def delete(self, api_version: str, kind: str, name: str, namespace: str = ""):
         return self._request(
             "DELETE", self._url(api_version, kind, namespace, name)

@@ -214,6 +214,32 @@ class FakeCluster:
     def update_status(self, obj: Dict[str, Any]) -> Dict[str, Any]:
         return self.update(obj, subresource="status")
 
+    def apply(
+        self, obj: Dict[str, Any], field_manager: str = "tpunet"
+    ) -> Dict[str, Any]:
+        """Server-side apply analog (mirrors ApiClient.apply and the wire
+        server's PATCH handler): create if absent, deep-merge if present
+        (dicts merge recursively, lists/scalars replace)."""
+        m = obj.get("metadata", {})
+        try:
+            current = self.get(
+                obj["apiVersion"], obj["kind"], m.get("name", ""),
+                m.get("namespace", ""),
+            )
+        except NotFoundError:
+            return self.create(obj)
+
+        def merge(base, patch):
+            out = dict(base)
+            for k, v in patch.items():
+                if isinstance(v, dict) and isinstance(out.get(k), dict):
+                    out[k] = merge(out[k], v)
+                else:
+                    out[k] = v
+            return out
+
+        return self.update(merge(current, obj))
+
     def delete(
         self, api_version: str, kind: str, name: str, namespace: str = ""
     ) -> None:

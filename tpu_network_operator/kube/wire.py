@@ -1,0 +1,380 @@
+"""Wire-level apiserver frontend over :class:`.fake.FakeCluster`.
+
+The envtest analog (ref ``internal/controller/suite_test.go:61-102`` boots
+a real kube-apiserver): no real apiserver binary exists in this
+environment, so this serves the Kubernetes REST API over actual HTTP —
+chunked watch streams, 409 AlreadyExists/Conflict status bodies, 410
+Gone watch expiry, server-side apply, optional TLS and bearer-token
+authentication — backed by the in-process fake's store and admission
+seams.  :class:`..kube.client.ApiClient` pointed at this server
+exercises its real wire paths (TLS handshake, chunked decode, watch
+reconnect, conflict mapping) instead of the in-process shortcut, and
+agent subprocesses in e2e tests get a cluster to report to.
+
+Deliberately NOT implemented: apiserver features the framework does not
+consume (field selectors server-side, OpenAPI discovery beyond /apis,
+resourceVersion semantical list pagination).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlparse
+
+from . import errors as kerr
+from .fake import FakeCluster
+
+log = logging.getLogger("tpunet.kube.wire")
+
+# plural -> Kind (reverse of client.plural())
+KINDS = {
+    "networkclusterpolicies": "NetworkClusterPolicy",
+    "daemonsets": "DaemonSet",
+    "pods": "Pod",
+    "nodes": "Node",
+    "leases": "Lease",
+    "serviceaccounts": "ServiceAccount",
+    "rolebindings": "RoleBinding",
+    "tokenreviews": "TokenReview",
+    "events": "Event",
+    "configmaps": "ConfigMap",
+}
+
+
+def _status_body(code: int, reason: str, message: str) -> bytes:
+    # compact separators: the real apiserver emits compact JSON, and the
+    # client's AlreadyExists/Conflict discrimination matches on the
+    # compact '"reason":"AlreadyExists"' form
+    return json.dumps({
+        "kind": "Status", "apiVersion": "v1", "status": "Failure",
+        "message": message, "reason": reason, "code": code,
+    }, separators=(",", ":")).encode()
+
+
+class WireApiServer:
+    """HTTP(S) facade over a FakeCluster.
+
+    Fault injection for client-conformance tests:
+
+    * ``inject_gone_once()`` — the next watch request with a
+      resourceVersion gets a 410 Gone ERROR event, forcing the client's
+      relist path;
+    * ``drop_watch_once()`` — the next watch stream closes mid-flight
+      (connection error path / reconnect);
+    * ``valid_tokens`` — bearer tokens accepted when ``require_token``;
+      TokenReview POSTs authenticate against the same set.
+    """
+
+    def __init__(
+        self,
+        cluster: Optional[FakeCluster] = None,
+        tls_cert_dir: Optional[str] = None,
+        require_token: bool = False,
+        openshift: bool = False,
+    ):
+        self.cluster = cluster or FakeCluster()
+        self.valid_tokens: set = set()
+        self.require_token = require_token
+        self.openshift = openshift
+        self._gone_once = threading.Event()
+        self._drop_once = threading.Event()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                log.debug("wire: " + fmt, *args)
+
+            # -- plumbing ----------------------------------------------------
+
+            def _reply(self, code: int, body: bytes,
+                       ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_obj(self, obj: Dict[str, Any], code: int = 200):
+                self._reply(code, json.dumps(obj).encode())
+
+            def _reply_err(self, e: Exception):
+                if isinstance(e, kerr.NotFoundError):
+                    self._reply(404, _status_body(404, "NotFound", str(e)))
+                elif isinstance(e, kerr.AlreadyExistsError):
+                    self._reply(
+                        409, _status_body(409, "AlreadyExists", str(e))
+                    )
+                elif isinstance(e, kerr.ConflictError):
+                    self._reply(409, _status_body(409, "Conflict", str(e)))
+                else:
+                    self._reply(400, _status_body(400, "BadRequest", str(e)))
+
+            def _authorized(self) -> bool:
+                if not outer.require_token:
+                    return True
+                tok = self.headers.get("Authorization", "").removeprefix(
+                    "Bearer "
+                ).strip()
+                return tok in outer.valid_tokens
+
+            def _route(self) -> Optional[Tuple[str, str, str, str, str]]:
+                """path -> (api_version, kind, namespace, name, subresource)"""
+                u = urlparse(self.path)
+                parts = [p for p in unquote(u.path).split("/") if p]
+                if not parts:
+                    return None
+                if parts[0] == "api":
+                    parts = parts[1:]
+                    if not parts:
+                        return None
+                    api_version, parts = parts[0], parts[1:]
+                elif parts[0] == "apis":
+                    parts = parts[1:]
+                    if len(parts) < 2:
+                        return None
+                    api_version, parts = f"{parts[0]}/{parts[1]}", parts[2:]
+                else:
+                    return None
+                namespace = ""
+                if len(parts) >= 2 and parts[0] == "namespaces":
+                    namespace, parts = parts[1], parts[2:]
+                if not parts:
+                    return None
+                plural_name, parts = parts[0], parts[1:]
+                kind = KINDS.get(plural_name)
+                if kind is None:
+                    kind = plural_name[:-1].capitalize()
+                name = parts[0] if parts else ""
+                sub = parts[1] if len(parts) > 1 else ""
+                return api_version, kind, namespace, name, sub
+
+            def _read_body(self) -> Dict[str, Any]:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            # -- verbs -------------------------------------------------------
+
+            def do_GET(self):   # noqa: N802
+                if not self._authorized():
+                    self._reply(401, _status_body(401, "Unauthorized", ""))
+                    return
+                u = urlparse(self.path)
+                if u.path == "/apis":
+                    groups = [{"name": "apps"}, {"name": "tpunet.dev"}]
+                    if outer.openshift:
+                        groups.append({"name": "config.openshift.io"})
+                    self._reply_obj({"kind": "APIGroupList", "groups": groups})
+                    return
+                route = self._route()
+                if route is None:
+                    self._reply(404, _status_body(404, "NotFound", self.path))
+                    return
+                av, kind, ns, name, _sub = route
+                q = parse_qs(u.query)
+                try:
+                    if name:
+                        self._reply_obj(
+                            outer.cluster.get(av, kind, name, ns)
+                        )
+                    elif q.get("watch", ["false"])[0] == "true":
+                        self._serve_watch(av, kind, ns, q)
+                    else:
+                        sel = None
+                        if "labelSelector" in q:
+                            sel = dict(
+                                kv.split("=", 1)
+                                for kv in q["labelSelector"][0].split(",")
+                            )
+                        items = outer.cluster.list(
+                            av, kind, namespace=ns or None,
+                            label_selector=sel,
+                        )
+                        self._reply_obj({
+                            "kind": f"{kind}List", "apiVersion": av,
+                            "items": items,
+                        })
+                except Exception as e:   # noqa: BLE001 — wire error mapping
+                    self._reply_err(e)
+
+            def _serve_watch(self, av, kind, ns, q):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data: bytes):
+                    self.wfile.write(f"{len(data):x}\r\n".encode())
+                    self.wfile.write(data + b"\r\n")
+                    self.wfile.flush()
+
+                # a watch response never completes normally; without this
+                # the keep-alive socket stays open after we return and the
+                # client never observes drops
+                self.close_connection = True
+
+                if q.get("resourceVersion") and outer._gone_once.is_set():
+                    outer._gone_once.clear()
+                    chunk(json.dumps({
+                        "type": "ERROR",
+                        "object": {
+                            "kind": "Status", "code": 410, "reason": "Expired",
+                            "message": "too old resource version",
+                        },
+                    }).encode() + b"\n")
+                    chunk(b"")   # terminal chunk
+                    return
+
+                w = outer.cluster.watch(av, kind)
+                try:
+                    while True:
+                        if outer._drop_once.is_set():
+                            outer._drop_once.clear()
+                            return   # close mid-stream, no terminal chunk
+                        ev = w.next(timeout=0.2)
+                        if ev is None:
+                            continue
+                        ev_type, obj = ev
+                        if ns and obj.get("metadata", {}).get(
+                            "namespace", ""
+                        ) != ns:
+                            continue
+                        chunk(json.dumps(
+                            {"type": ev_type, "object": obj}
+                        ).encode() + b"\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    w.stop()
+
+            def do_POST(self):   # noqa: N802
+                route = self._route()
+                if route is None:
+                    self._reply(404, _status_body(404, "NotFound", self.path))
+                    return
+                av, kind, _ns, _name, _sub = route
+                body = self._read_body()
+                if kind == "TokenReview":
+                    tok = body.get("spec", {}).get("token", "")
+                    self._reply_obj({
+                        "kind": "TokenReview", "apiVersion": av,
+                        "status": {
+                            "authenticated": tok in outer.valid_tokens
+                        },
+                    }, 201)
+                    return
+                if not self._authorized():
+                    self._reply(401, _status_body(401, "Unauthorized", ""))
+                    return
+                try:
+                    self._reply_obj(outer.cluster.create(body), 201)
+                except Exception as e:   # noqa: BLE001
+                    self._reply_err(e)
+
+            def do_PUT(self):   # noqa: N802
+                if not self._authorized():
+                    self._reply(401, _status_body(401, "Unauthorized", ""))
+                    return
+                route = self._route()
+                if route is None:
+                    self._reply(404, _status_body(404, "NotFound", self.path))
+                    return
+                _av, _kind, _ns, _name, sub = route
+                body = self._read_body()
+                try:
+                    if sub == "status":
+                        self._reply_obj(outer.cluster.update_status(body))
+                    else:
+                        self._reply_obj(outer.cluster.update(body))
+                except Exception as e:   # noqa: BLE001
+                    self._reply_err(e)
+
+            def do_PATCH(self):   # noqa: N802
+                """Server-side apply (application/apply-patch+yaml): upsert
+                with a deep merge of the applied fields."""
+                if not self._authorized():
+                    self._reply(401, _status_body(401, "Unauthorized", ""))
+                    return
+                route = self._route()
+                if route is None:
+                    self._reply(404, _status_body(404, "NotFound", self.path))
+                    return
+                av, kind, ns, name, _sub = route
+                patch = self._read_body()
+                patch.setdefault("apiVersion", av)
+                patch.setdefault("kind", kind)
+                patch.setdefault("metadata", {})["name"] = name
+                if ns:
+                    patch["metadata"]["namespace"] = ns
+                try:
+                    self._reply_obj(outer.cluster.apply(patch))
+                except Exception as e:   # noqa: BLE001
+                    self._reply_err(e)
+
+            def do_DELETE(self):   # noqa: N802
+                if not self._authorized():
+                    self._reply(401, _status_body(401, "Unauthorized", ""))
+                    return
+                route = self._route()
+                if route is None:
+                    self._reply(404, _status_body(404, "NotFound", self.path))
+                    return
+                av, kind, ns, name, _sub = route
+                try:
+                    outer.cluster.delete(av, kind, name, ns)
+                    self._reply_obj({"kind": "Status", "status": "Success"})
+                except Exception as e:   # noqa: BLE001
+                    self._reply_err(e)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.scheme = "http"
+        if tls_cert_dir:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+            ctx.load_cert_chain(
+                f"{tls_cert_dir}/tls.crt", f"{tls_cert_dir}/tls.key"
+            )
+            self.httpd.socket = ctx.wrap_socket(
+                self.httpd.socket, server_side=True
+            )
+            self.scheme = "https"
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle + fault injection ------------------------------------------
+
+    @property
+    def url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"{self.scheme}://{host}:{port}"
+
+    def inject_gone_once(self) -> None:
+        self._gone_once.set()
+
+    def drop_watch_once(self) -> None:
+        self._drop_once.set()
+
+    def start(self) -> "WireApiServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def __enter__(self) -> "WireApiServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
