@@ -1,0 +1,138 @@
+"""De-provision drain e2e (VERDICT r3 #7; SURVEY §7 hard part 5).
+
+Asserts the teardown ORDERING on SIGTERM with a live job: readiness
+signals retract first (report Lease, NFD label) while the data plane
+stays intact; the agent then blocks on the bootstrap job lock; only
+after the job releases it do the bootstrap, addresses and links go away.
+A wedged job is bounded by --drain-timeout.
+"""
+
+import json
+import os
+import signal
+import time
+
+from tpu_network_operator.agent.tpu import bootstrap as tb
+from tpu_network_operator.agent.tpu.metadata import FakeMetadataServer
+
+from tests.e2e.test_dcn_e2e import (
+    HOST_NICS,
+    LLDP_DESCS,
+    TWO_NIC_METADATA,
+    V5E_16_ATTRS,
+    AgentHost,
+    projected_agent_args,
+    run_agent_until_ready,
+    tpu_cr,
+)
+
+
+def wait_for(cond, timeout=30.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def links_up(host):
+    return {l["name"] for l in host.state()["links"] if l["up"]}
+
+
+def addrs_present(host):
+    return any(l["addrs"] for l in host.state()["links"])
+
+
+def test_sigterm_drain_waits_for_job(tmp_path):
+    args = projected_agent_args(tpu_cr("v5e-drain", "L3"))
+    host = AgentHost(tmp_path, HOST_NICS, LLDP_DESCS)
+    with FakeMetadataServer(
+        V5E_16_ATTRS, network_interfaces=TWO_NIC_METADATA
+    ) as srv:
+        proc = run_agent_until_ready(args, host, srv.url)
+        try:
+            bootstrap = str(host.bootstrap_path())
+            # a "job" (this test) holds the bootstrap lock (heartbeating)
+            lock = tb.acquire_job_lock(bootstrap)
+
+            proc.send_signal(signal.SIGTERM)
+
+            # phase 1: readiness retracts while the data plane survives
+            wait_for(lambda: not host.label_path().exists(),
+                     what="label removal")
+            assert proc.poll() is None, "agent exited before drain"
+            time.sleep(0.5)   # drain window: nothing else may change
+            assert os.path.exists(bootstrap), "bootstrap gone during drain"
+            assert addrs_present(host), "addresses withdrawn during drain"
+            assert links_up(host) == {"ens9", "ens10"}, (
+                "links downed during drain"
+            )
+
+            # phase 2: job finishes -> teardown completes
+            lock.release()
+            assert proc.wait(timeout=15) == 0
+            assert not os.path.exists(bootstrap)
+            assert not addrs_present(host)
+            state = host.state()
+            assert set(state["downs"]) == set(state["ups"])
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+
+def test_sigterm_drain_timeout_bounds_wedged_job(tmp_path):
+    """A job that never releases the lock cannot pin the node past the
+    drain budget."""
+    args = [
+        "--drain-timeout=2s" if a.startswith("--drain-timeout") else a
+        for a in projected_agent_args(tpu_cr("v5e-wedge", "L3"))
+    ]
+    if not any(a.startswith("--drain-timeout") for a in args):
+        args.append("--drain-timeout=2s")
+    host = AgentHost(tmp_path, HOST_NICS, LLDP_DESCS)
+    with FakeMetadataServer(
+        V5E_16_ATTRS, network_interfaces=TWO_NIC_METADATA
+    ) as srv:
+        proc = run_agent_until_ready(args, host, srv.url)
+        try:
+            bootstrap = str(host.bootstrap_path())
+            # a heartbeating lock that is never released (wedged job)
+            lock = tb.acquire_job_lock(bootstrap)
+            t0 = time.time()
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=20) == 0
+            elapsed = time.time() - t0
+            assert elapsed >= 1.8, f"drain budget not honored ({elapsed:.1f}s)"
+            assert not os.path.exists(bootstrap)
+            assert not addrs_present(host)
+        finally:
+            lock.release()
+            if proc.poll() is None:
+                proc.kill()
+
+
+def test_crashed_job_lock_does_not_block(tmp_path):
+    """A lock whose heartbeat went stale (crashed job: nothing refreshes
+    the mtime) is not an active job: teardown proceeds immediately."""
+    args = projected_agent_args(tpu_cr("v5e-crash", "L3"))
+    host = AgentHost(tmp_path, HOST_NICS, LLDP_DESCS)
+    with FakeMetadataServer(
+        V5E_16_ATTRS, network_interfaces=TWO_NIC_METADATA
+    ) as srv:
+        proc = run_agent_until_ready(args, host, srv.url)
+        try:
+            bootstrap = str(host.bootstrap_path())
+            # fabricate a crashed job: a lock whose heartbeat stopped
+            # long ago (back-dated mtime, nothing refreshing it)
+            with open(tb.lock_path(bootstrap), "w") as f:
+                json.dump({"token": "crashed"}, f)
+            stale = time.time() - tb.LOCK_STALE_AFTER - 5
+            os.utime(tb.lock_path(bootstrap), (stale, stale))
+            t0 = time.time()
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=15) == 0
+            assert time.time() - t0 < 5, "dead-pid lock blocked teardown"
+        finally:
+            if proc.poll() is None:
+                proc.kill()

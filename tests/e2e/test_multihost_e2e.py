@@ -1,0 +1,219 @@
+"""Concurrent multi-host e2e (VERDICT r3 #6): N real agent subprocesses,
+each against its own fake metadata server (distinct WORKER_ID, shared
+worker-network-config) and fake host, running simultaneously.  Asserts
+the cross-host invariants a single-agent test cannot: process_ids form
+exactly {0..N-1} with no duplicates, every bootstrap names the same
+coordinator and num_processes, and the shared cluster ends with one ok
+report per node.  A regression in build_bootstrap's process numbering
+(e.g. deriving process_id from list order instead of WORKER_ID, or
+per-slice instead of global numbering) fails these tests.
+"""
+
+import json
+import signal
+import subprocess
+import sys
+import time
+
+from tpu_network_operator.agent import report as rpt
+from tpu_network_operator.agent.tpu.metadata import FakeMetadataServer
+from tpu_network_operator.kube.client import ApiClient
+from tpu_network_operator.kube.wire import WireApiServer
+
+from tests.e2e.test_dcn_e2e import (
+    HOST_NICS,
+    LLDP_DESCS,
+    TWO_NIC_METADATA,
+    AgentHost,
+    host_args,
+    projected_agent_args,
+    tpu_cr,
+)
+
+NAMESPACE = "tpunet-system"
+N_HOSTS = 4
+
+WORKER_NET = json.dumps(
+    [{"workerId": 0, "ipAddress": "127.0.0.1"}]
+    + [{"workerId": i, "ipAddress": f"127.0.0.{i + 1}"}
+       for i in range(1, N_HOSTS)]
+)
+
+
+def v5e_attrs(worker_id):
+    return {
+        "accelerator-type": "v5litepod-16",
+        "tpu-env": (
+            "ACCELERATOR_TYPE: 'v5litepod-16'\nTOPOLOGY: '4x4'\n"
+            "CHIPS_PER_HOST_BOUNDS: '2x2'\nHOST_BOUNDS: '2x2'\n"
+            f"WORKER_ID: '{worker_id}'\n"
+        ),
+        "worker-network-config": WORKER_NET,
+    }
+
+
+def multislice_attrs(slice_id, worker_id, hosts_per_slice=2):
+    return {
+        "accelerator-type": "v5litepod-8",
+        "tpu-env": (
+            "ACCELERATOR_TYPE: 'v5litepod-8'\nTOPOLOGY: '2x4'\n"
+            "CHIPS_PER_HOST_BOUNDS: '2x2'\nHOST_BOUNDS: '1x2'\n"
+            f"WORKER_ID: '{worker_id}'\n"
+        ),
+        "worker-network-config": json.dumps(
+            [{"workerId": i, "ipAddress": f"127.0.1.{i + 1}"}
+             for i in range(hosts_per_slice)]
+        ),
+        "megascale-num-slices": "2",
+        "megascale-slice-id": str(slice_id),
+        "megascale-coordinator-address": "127.0.0.1",
+    }
+
+
+class Fleet:
+    """N concurrent (metadata server, host, agent subprocess) triples."""
+
+    def __init__(self, tmp_path, attrs_list, args, kube_url=None):
+        self.hosts = []
+        self.metas = []
+        self.procs = []
+        for i, attrs in enumerate(attrs_list):
+            host = AgentHost(tmp_path / f"host{i}", HOST_NICS, LLDP_DESCS)
+            meta = FakeMetadataServer(
+                attrs, network_interfaces=TWO_NIC_METADATA
+            ).__enter__()
+            env = host.env(meta.url)
+            env["NODE_NAME"] = f"tpu-worker-{i}"
+            if kube_url:
+                env["TPUNET_KUBE_URL"] = kube_url
+            self.hosts.append(host)
+            self.metas.append(meta)
+            self.procs.append(subprocess.Popen(
+                [sys.executable, "-m", "tpu_network_operator.agent.cli",
+                 *host_args(args, host)],
+                env=env, cwd=env["PYTHONPATH"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            ))
+
+    def wait_all_ready(self, timeout=60):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(
+                h.bootstrap_path().exists() and h.label_path().exists()
+                for h in self.hosts
+            ):
+                return
+            for i, p in enumerate(self.procs):
+                if p.poll() is not None:
+                    raise AssertionError(
+                        f"agent {i} died: "
+                        f"{p.stderr.read().decode()[-2000:]}"
+                    )
+            time.sleep(0.1)
+        raise AssertionError(
+            "fleet never became ready: " + ", ".join(
+                f"host{i} bootstrap={h.bootstrap_path().exists()} "
+                f"label={h.label_path().exists()}"
+                for i, h in enumerate(self.hosts)
+            )
+        )
+
+    def bootstraps(self):
+        return [
+            json.loads(h.bootstrap_path().read_text()) for h in self.hosts
+        ]
+
+    def teardown(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in self.procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for m in self.metas:
+            m.__exit__(None, None, None)
+
+
+def test_concurrent_single_slice_fleet(tmp_path):
+    """BASELINE config 3 at fleet scale: 4 hosts of one v5e-16 slice
+    provision concurrently; global process numbering must be exactly the
+    metadata WORKER_IDs, not arrival order."""
+    args = projected_agent_args(tpu_cr("v5e-fleet", "L3"))
+    fleet = Fleet(
+        tmp_path, [v5e_attrs(i) for i in range(N_HOSTS)], args,
+    )
+    try:
+        fleet.wait_all_ready()
+        boots = fleet.bootstraps()
+        assert [b["process_id"] for b in boots] == [0, 1, 2, 3]
+        assert {b["num_processes"] for b in boots} == {4}
+        # one coordinator for the whole fleet: worker 0's address
+        assert {b["coordinator_address"] for b in boots} == {
+            "127.0.0.1:8476"
+        }
+        assert {b["topology"]["topology"] for b in boots} == {"4x4"}
+        for b in boots:
+            assert b["dcn_interfaces"] == ["ens10", "ens9"]
+    finally:
+        fleet.teardown()
+
+
+def test_concurrent_fleet_reports_aggregate(tmp_path):
+    """The fleet's reports land as N distinct Leases in one shared
+    cluster; every node appears exactly once with ok=True."""
+    args = projected_agent_args(tpu_cr("v5e-fleet-rep", "L3"))
+    with WireApiServer() as srv:
+        fleet = Fleet(
+            tmp_path, [v5e_attrs(i) for i in range(N_HOSTS)], args,
+            kube_url=srv.url,
+        )
+        try:
+            fleet.wait_all_ready()
+            client = ApiClient(srv.url)
+            leases = client.list(
+                rpt.LEASE_API, "Lease", namespace=NAMESPACE,
+                label_selector={
+                    rpt.AGENT_LABEL: "true",
+                    rpt.POLICY_LABEL: "v5e-fleet-rep",
+                },
+            )
+            reports = [
+                rpt.ProvisioningReport.from_json(
+                    ls["metadata"]["annotations"][rpt.REPORT_ANNOTATION]
+                )
+                for ls in leases
+            ]
+            assert sorted(r.node for r in reports) == [
+                f"tpu-worker-{i}" for i in range(N_HOSTS)
+            ]
+            assert all(r.ok for r in reports)
+        finally:
+            fleet.teardown()
+
+
+def test_concurrent_two_slice_multislice(tmp_path):
+    """BASELINE config 5 at fleet scale: 2 slices x 2 hosts concurrently.
+    Global process ids must interleave slices correctly
+    (slice_id * hosts_per_slice + worker_id) and every host must agree on
+    the megascale coordinator."""
+    args = projected_agent_args(tpu_cr("v5e-ms-fleet", "L3"))
+    attrs = [
+        multislice_attrs(slice_id, worker_id)
+        for slice_id in (0, 1)
+        for worker_id in (0, 1)
+    ]
+    fleet = Fleet(tmp_path, attrs, args)
+    try:
+        fleet.wait_all_ready()
+        boots = fleet.bootstraps()
+        assert [b["process_id"] for b in boots] == [0, 1, 2, 3]
+        assert {b["num_processes"] for b in boots} == {4}
+        assert {b["coordinator_address"] for b in boots} == {
+            "127.0.0.1:8476"
+        }
+        assert [b["topology"]["slice_id"] for b in boots] == [0, 0, 1, 1]
+        assert {b["topology"]["num_slices"] for b in boots} == {2}
+    finally:
+        fleet.teardown()

@@ -60,6 +60,9 @@ class CmdConfig:
     # readiness reporting (Lease in the operator namespace; empty = off)
     report_namespace: str = ""
     policy_name: str = ""
+    # de-provision drain: how long to wait for an active job to release
+    # the bootstrap lock before withdrawing routes/links
+    drain_timeout: float = 30.0
     # seams
     ops: nl.LinkOps = field(default_factory=nl.LinkOps)
     # host-root override for the NFD features dir; env-settable so a
@@ -108,12 +111,41 @@ def post_cleanups(
     _retract_report(config)
     nfd.remove_readiness_label(root=config.nfd_root)
     if config.backend == "tpu" and config.bootstrap:
+        # readiness is now retracted; wait for a running job to let go of
+        # the bootstrap before touching the data plane.  Whatever the
+        # outcome, clear the lock so a timed-out drain cannot poison the
+        # next provision/teardown cycle
+        _wait_for_drain(config)
+        tpu_bootstrap.release_job_lock(config.bootstrap)
         tpu_bootstrap.delete_bootstrap(config.bootstrap)
     try:
         net.remove_existing_ips(configs, config.ops)
     except nl.NetlinkError as e:
         log.warning("failed to remove existing IPs: %s", e)
     net.interfaces_restore_down(configs, config.ops)
+
+
+def _wait_for_drain(config: CmdConfig) -> None:
+    """Poll the bootstrap job lock until released or the drain budget is
+    spent (then proceed anyway — a wedged job must not pin the node)."""
+    import time
+
+    if not tpu_bootstrap.job_active(config.bootstrap):
+        return
+    log.info(
+        "active job holds %s; draining up to %.0fs",
+        tpu_bootstrap.lock_path(config.bootstrap), config.drain_timeout,
+    )
+    deadline = time.monotonic() + config.drain_timeout
+    while time.monotonic() < deadline:
+        if not tpu_bootstrap.job_active(config.bootstrap):
+            log.info("job released the bootstrap; continuing teardown")
+            return
+        time.sleep(0.25)
+    log.warning(
+        "drain timeout (%.0fs) expired with the job lock still held; "
+        "tearing down anyway", config.drain_timeout,
+    )
 
 
 def _kube_client():
@@ -469,6 +501,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(empty = no cluster reporting)")
     p.add_argument("--policy-name", default="",
                    help="owning NetworkClusterPolicy, labeled on the report")
+    p.add_argument("--drain-timeout", default="30s",
+                   help="max wait for an active job to release the "
+                        "bootstrap lock before teardown (e.g. 45s)")
     return p
 
 
@@ -528,6 +563,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         bootstrap=args.bootstrap,
         report_namespace=args.report_namespace,
         policy_name=args.policy_name,
+        drain_timeout=parse_wait(args.drain_timeout),
     )
     try:
         return cmd_run(config)

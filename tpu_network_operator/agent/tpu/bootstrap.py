@@ -155,3 +155,101 @@ def delete_bootstrap(path: str) -> None:
         os.unlink(path)
     except FileNotFoundError:
         pass
+
+
+# -- job lock (the drain signal) ----------------------------------------------
+#
+# The drain contract (SURVEY.md §7 hard part 5): a JAX job that consumed
+# the bootstrap holds ``<bootstrap>.lock`` while running.  On SIGTERM the
+# agent retracts readiness first, then waits for the lock to clear
+# (bounded by --drain-timeout) before withdrawing routes/links, so a
+# live job's collectives are not cut mid-step.
+#
+# Liveness is an mtime HEARTBEAT, not a pid: the agent and the workload
+# run in different pods (different PID namespaces), so a recorded pid is
+# meaningless across the shared hostPath — the holder refreshes the
+# file's mtime every LOCK_HEARTBEAT seconds instead, and a lock whose
+# mtime is older than LOCK_STALE_AFTER counts as a crashed job.
+
+LOCK_HEARTBEAT = 3.0
+LOCK_STALE_AFTER = 15.0
+
+
+def lock_path(bootstrap_path: str) -> str:
+    return bootstrap_path + ".lock"
+
+
+class JobLock:
+    """Held by the workload while it runs; background thread heartbeats
+    the mtime.  ``release()`` only unlinks the holder's own lock (token
+    check), so a second consumer clobbering the file cannot have its
+    lock deleted out from under it by the first's exit."""
+
+    def __init__(self, bootstrap_path: str):
+        import binascii
+        import threading
+
+        self.path = lock_path(bootstrap_path)
+        self.token = binascii.hexlify(os.urandom(8)).decode()
+        if job_active(bootstrap_path):
+            import logging
+
+            logging.getLogger("tpunet.agent").warning(
+                "job lock %s already held by a live job; taking it over "
+                "(two consumers of one bootstrap?)", self.path,
+            )
+        write_atomic(
+            self.path,
+            json.dumps({"token": self.token, "pid": os.getpid()}) + "\n",
+        )
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+        self._thread.start()
+
+    def _beat(self) -> None:
+        while not self._stop.wait(LOCK_HEARTBEAT):
+            try:
+                os.utime(self.path)
+            except OSError:
+                return   # lock removed (agent timed out) — stop beating
+
+    def release(self) -> None:
+        self._stop.set()
+        try:
+            with open(self.path) as f:
+                if json.load(f).get("token") != self.token:
+                    return   # someone else's lock now — leave it
+        except (OSError, ValueError):
+            return
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+
+def acquire_job_lock(bootstrap_path: str) -> JobLock:
+    """Workload-side: mark the bootstrap in use (heartbeating)."""
+    return JobLock(bootstrap_path)
+
+
+def release_job_lock(bootstrap_path: str) -> None:
+    """Unconditional unlink — the AGENT's post-drain cleanup (a stale
+    lock left by a timed-out drain must not poison the next cycle).
+    Workloads release through their own :meth:`JobLock.release`."""
+    try:
+        os.unlink(lock_path(bootstrap_path))
+    except FileNotFoundError:
+        pass
+
+
+def job_active(bootstrap_path: str) -> bool:
+    """Agent-side drain predicate: lock present with a fresh heartbeat.
+    Pure ``stat`` — no content parsing, so a malformed lock can never
+    abort the teardown path that calls this."""
+    import time
+
+    try:
+        age = time.time() - os.stat(lock_path(bootstrap_path)).st_mtime
+    except OSError:
+        return False
+    return age < LOCK_STALE_AFTER

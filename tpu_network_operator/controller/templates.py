@@ -136,7 +136,8 @@ spec:
             add:
             - NET_ADMIN
             - NET_RAW
-      terminationGracePeriodSeconds: 10
+      # covers the 30s bootstrap-lock drain (agent --drain-timeout) + teardown
+      terminationGracePeriodSeconds: 45
 """
 
 SERVICEACCOUNT_YAML = """

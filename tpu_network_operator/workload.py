@@ -44,13 +44,19 @@ def log(msg: str) -> None:
 
 def _init_distributed(bootstrap_path: Optional[str]):
     """Returns (bootstrap_cfg | None).  Initializes jax.distributed when a
-    bootstrap file is given — the operator-provisioned path."""
+    bootstrap file is given — the operator-provisioned path.  Holds the
+    bootstrap job lock for the life of the process: the agent's SIGTERM
+    drain waits for it before withdrawing routes (bootstrap.py)."""
     if not bootstrap_path:
         return None
-    from .agent.tpu.bootstrap import read_bootstrap
+    import atexit
+
+    from .agent.tpu.bootstrap import acquire_job_lock, read_bootstrap
     from .parallel import distributed_init_from_bootstrap
 
     cfg = read_bootstrap(bootstrap_path)
+    lock = acquire_job_lock(bootstrap_path)
+    atexit.register(lock.release)
     distributed_init_from_bootstrap(cfg)
     log(
         f"jax.distributed initialized: process {cfg.process_id}/"
