@@ -88,23 +88,25 @@ def peak_flops(kind: str) -> float:
     return 0.0
 
 
-def train_mem_estimate(cfg, batch: int, seq: int) -> int:
-    """bf16 params+grads+adam moments, logits (chunked when cfg.xent_chunk),
-    remat residuals (policy-aware: "dots" keeps per-layer matmul outputs,
-    "full" keeps only the layer carry)."""
+def train_mem_estimate(cfg, batch: int, seq: int, opt8: bool = False) -> int:
+    """bf16 params+grads + adam moments (bf16, or int8/f8 when ``opt8``),
+    logits (chunked when cfg.xent_chunk), remat residuals (policy-aware:
+    see models/training.py remat_policy)."""
     p = cfg.num_params()
     logit_seq = cfg.xent_chunk if cfg.xent_chunk else seq
     logits = batch * logit_seq * cfg.vocab_size * 4 * 2   # fwd + bwd copies
-    if getattr(cfg, "remat_policy", "dots") == "dots":
-        per_tok = (
-            (cfg.heads + 2 * cfg.kv_heads) * cfg.head_dim  # qkv
-            + 2 * cfg.hidden                               # attn out, mlp down
-            + 2 * cfg.ffn                                  # gate, up
-        )
-        resid = batch * seq * per_tok * cfg.layers * 2
-    else:
-        resid = batch * seq * cfg.hidden * cfg.layers * 2
-    return p * 8 + logits + resid
+    policy = getattr(cfg, "remat_policy", "dots")
+    per_tok = {
+        # bytes/2 per token of saved activations per layer
+        "dots": (cfg.heads + 2 * cfg.kv_heads) * cfg.head_dim
+                + 2 * cfg.hidden + 2 * cfg.ffn,
+        "ffn": cfg.hidden + 2 * cfg.ffn,        # resid_mid + gate + up
+        "ffn_lite": cfg.hidden + cfg.ffn,       # resid_mid + gate
+        "full": cfg.hidden,                     # scan carry only
+    }.get(policy, cfg.hidden)
+    resid = batch * seq * per_tok * cfg.layers * 2
+    param_bytes = p * (6 if opt8 else 8)   # 2+2+1+1 vs 2+2+2+2
+    return param_bytes + logits + resid
 
 
 def train_flops_per_token(cfg, seq: int) -> float:
@@ -116,11 +118,18 @@ def train_flops_per_token(cfg, seq: int) -> float:
     return 6 * n_matmul + attn
 
 
-def measure(name, cfg, batch, seq, n, kind, make_train_step, mesh, jax, jnp):
-    """One ladder rung: returns the result row dict."""
+def measure(name, cfg, batch, seq, n, kind, make_train_step, mesh, jax, jnp,
+            opt=None):
+    """One ladder rung: returns the result row dict.  ``opt``: None for
+    optax.adamw, "adam8" for the int8/f8-moment AdamW (optim8bit)."""
     import gc
 
-    step, init_all, _ = make_train_step(cfg, mesh)
+    optimizer = None
+    if opt == "adam8":
+        from tpu_network_operator.models.optim8bit import adamw8bit
+
+        optimizer = adamw8bit(3e-4, weight_decay=0.1)
+    step, init_all, _ = make_train_step(cfg, mesh, optimizer=optimizer)
     params, opt_state = init_all(jax.random.key(0))
     # realistic token stream (constant tokens collapse the loss in a few
     # steps and make the workload unrepresentative)
@@ -154,7 +163,12 @@ def measure(name, cfg, batch, seq, n, kind, make_train_step, mesh, jax, jnp):
     log(f"[{name}] {iters} steps in {dt:.2f}s, loss {loss_val:.3f}, "
         f"{tok_per_sec_chip:.0f} tok/s/chip, MFU {mfu:.1%}")
 
-    target = TARGETS.get((kind, name))
+    # "+adam8"-style variant rungs compare against the base config's
+    # recorded target: the cross-round series must show the win or
+    # regression the variant exists to measure, not a fake 1.0
+    target = TARGETS.get((kind, name)) or TARGETS.get(
+        (kind, name.split("+")[0])
+    )
     row = {
         "config": name,
         "tokens_per_sec_per_chip": round(tok_per_sec_chip, 1),
@@ -183,19 +197,29 @@ def main() -> None:
     log(f"devices: {n} x {kind}, HBM {hbm / 2**30:.0f} GiB")
 
     # big rungs: chunked cross-entropy (never materialize [B,S,V] logits)
-    # and full remat (residuals = layer carry only) to fit HBM
+    # and full remat (residuals = layer carry only) to fit HBM.  The 1B
+    # "+adam8" rungs trade bf16 adam moments for int8/f8 ones
+    # (models/optim8bit.py) to buy back saved FFN activations — less
+    # backward recompute, the docs/perf.md lever for >50% MFU; plain 1b
+    # remains the fallback if they OOM in practice.
     big = dict(xent_chunk=512, remat_policy="full")
+    one_b = LlamaConfig.llama3_1b()
     ladder = [
         ("llama3-8b", dataclasses.replace(LlamaConfig.llama3_8b(), **big),
-         4, 2048),
+         4, 2048, None),
         ("llama3-3b", dataclasses.replace(LlamaConfig.llama3_3b(), **big),
-         4, 2048),
-        ("llama3-1b", dataclasses.replace(LlamaConfig.llama3_1b(), **big),
-         4, 2048),
+         4, 2048, None),
+        ("llama3-1b+ffn+adam8",
+         dataclasses.replace(one_b, xent_chunk=512, remat_policy="ffn"),
+         4, 2048, "adam8"),
+        ("llama3-1b+adam8",
+         dataclasses.replace(one_b, xent_chunk=512, remat_policy="ffn_lite"),
+         4, 2048, "adam8"),
+        ("llama3-1b", dataclasses.replace(one_b, **big), 4, 2048, None),
         ("llama3-150m",
          LlamaConfig(vocab_size=32_000, hidden=1024, layers=8, heads=16,
                      kv_heads=8, ffn=4096, max_seq=2048),
-         8, 2048),
+         8, 2048, None),
     ]
     total_hbm = hbm * n
     forced = os.environ.get("BENCH_CONFIG", "")
@@ -203,9 +227,11 @@ def main() -> None:
     # 16 GiB v5e confirms llama3-1b (est 15.2 GB) runs — OOM at runtime
     # falls through to the next rung below
     candidates = [
-        (cand_name, cand, b, s) for cand_name, cand, b, s in ladder
+        (cand_name, cand, b, s, opt)
+        for cand_name, cand, b, s, opt in ladder
         if (cand_name == forced if forced else
-            train_mem_estimate(cand, b * max(1, n), s) <= 0.95 * total_hbm)
+            train_mem_estimate(cand, b * max(1, n), s, opt8=opt == "adam8")
+            <= 0.95 * total_hbm)
     ]
     if forced and not candidates:
         raise SystemExit(
@@ -225,13 +251,13 @@ def main() -> None:
     log(f"mesh: {plan.axis_sizes}")
 
     rows = []
-    for cand_name, cand, b, s in candidates:
+    for cand_name, cand, b, s, opt in candidates:
         batch = b * max(1, n)   # scale batch with the data axis
         log(f"attempting {cand_name}: {cand.num_params() / 1e9:.2f}B params, "
             f"batch {batch} x seq {s}")
         try:
             rows.append(measure(cand_name, cand, batch, s, n, kind,
-                                make_train_step, mesh, jax, jnp))
+                                make_train_step, mesh, jax, jnp, opt=opt))
             break
         except Exception as e:   # OOM / compile failure: next rung down
             log(f"[{cand_name}] failed ({type(e).__name__}: {str(e)[:120]}); "
@@ -243,7 +269,7 @@ def main() -> None:
         # continuity row: every round also reports the 150m proxy so the
         # cross-round series stays unbroken; best-effort — its failure
         # must not discard the headline measurement above
-        sm_name, sm_cfg, sm_b, sm_s = ladder[-1]
+        sm_name, sm_cfg, sm_b, sm_s, _ = ladder[-1]
         try:
             rows.append(measure(sm_name, sm_cfg, sm_b * max(1, n), sm_s, n,
                                 kind, make_train_step, mesh, jax, jnp))

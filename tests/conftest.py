@@ -36,6 +36,7 @@ def pytest_configure(config):
 SLOW_MODULES = {
     "test_models", "test_moe", "test_pipeline", "test_parallel",
     "test_generate", "test_workload", "test_pallas_attention", "test_data",
+    "test_optim8bit",
 }
 
 

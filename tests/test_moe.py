@@ -111,6 +111,15 @@ class TestForward:
         # Mixtral-8x7B ≈ 46.7B total parameters
         assert abs(MoEConfig.mixtral_8x7b().num_params() - 46.7e9) < 1.0e9
 
+    def test_chunked_xent_matches_full(self, tiny, tiny_params):
+        """cfg.xent_chunk changes memory, not math (same contract as the
+        dense model, test_models.py)."""
+        chunked = MoEConfig(**{**tiny.__dict__, "xent_chunk": 8})
+        toks = jax.random.randint(jax.random.key(3), (2, 33), 0, 256, jnp.int32)
+        full = jax.jit(lambda p, t: loss_fn(p, t, tiny))(tiny_params, toks)
+        ck = jax.jit(lambda p, t: loss_fn(p, t, chunked))(tiny_params, toks)
+        np.testing.assert_allclose(float(full), float(ck), rtol=1e-3)
+
 
 class TestExpertParallelTraining:
     def test_loss_decreases_ep4_dp2(self, tiny):

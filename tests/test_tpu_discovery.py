@@ -107,16 +107,36 @@ class TestAcceleratorParsing:
     @pytest.mark.parametrize(
         "chips,ndims,grid",
         [
-            (32, 3, (2, 4, 4)),   # v5p-64 documented topology
-            (16, 3, (2, 2, 4)),   # v4-32
-            (16, 2, (4, 4)),      # v5e-16
+            # canonical platform defaults (Cloud TPU config tables)
+            (32, 3, (2, 4, 4)),    # v5p-64
+            (16, 3, (2, 2, 4)),    # v4-32
+            (128, 3, (4, 4, 8)),   # v4-256 / v5p-256
+            (256, 3, (4, 8, 8)),   # v4-512
+            (512, 3, (8, 8, 8)),   # v4-1024
+            (4, 3, (2, 2, 1)),     # v4-8: one host's 2x2x1, not 1x2x2
+            (8, 2, (2, 4)),        # v5e-8
+            (16, 2, (4, 4)),       # v5e-16
+            (32, 2, (4, 8)),       # v5e-32: the asymmetric default
+            (128, 2, (8, 16)),     # v5e-128
             (256, 2, (16, 16)),
-            (4, 3, (1, 2, 2)),
             (1, 3, (1,)),
+            # off-table size: near-cubic factorization fallback
+            (24, 2, (4, 6)),
         ],
     )
     def test_default_grid(self, chips, ndims, grid):
         assert topo.default_grid(chips, ndims) == grid
+
+    def test_explicit_topology_beats_canonical(self):
+        """A non-default reservation (v5e-32 as 2x16) announces itself via
+        the tpu-env TOPOLOGY attribute, which must win over the table."""
+        t = topo.from_tpu_env({
+            "ACCELERATOR_TYPE": "v5litepod-32",
+            "TOPOLOGY": "2x16",
+            "WORKER_ID": "0",
+        })
+        assert t.ici_mesh == (2, 16)
+        assert t.num_chips == 32
 
 
 class TestTopologyDiscovery:

@@ -17,10 +17,13 @@ Two sources, in order of authority:
 
 from __future__ import annotations
 
+import logging
 import math
 import re
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger("tpunet.agent")
 
 
 class TopologyError(Exception):
@@ -66,10 +69,33 @@ def parse_accelerator_type(accel: str) -> Tuple[str, int]:
     return gen, chips
 
 
+# Canonical default topologies per (generation-dims, chips), from the
+# public Cloud TPU configuration tables — what a reservation gets when no
+# explicit topology flag was passed.  Pinned explicitly (rather than
+# derived) so the guess the agent makes when the metadata ``TOPOLOGY``
+# attribute is absent is verifiably the platform default, not a
+# factorization artifact.  A non-default reservation (e.g. v5e-32 as
+# 2x16) always announces itself through TOPOLOGY, which wins.
+_CANONICAL_2D = {
+    4: (2, 2), 8: (2, 4), 16: (4, 4), 32: (4, 8),
+    64: (8, 8), 128: (8, 16), 256: (16, 16),
+}
+_CANONICAL_3D = {
+    4: (2, 2, 1), 8: (2, 2, 2), 16: (2, 2, 4), 32: (2, 4, 4),
+    64: (4, 4, 4), 128: (4, 4, 8), 256: (4, 8, 8), 512: (8, 8, 8),
+    1024: (8, 8, 16), 2048: (8, 16, 16), 4096: (16, 16, 16),
+}
+
+
 def default_grid(chips: int, ndims: int) -> Tuple[int, ...]:
-    """Near-cubic factorization, dims sorted ascending (v5p-64 → 2x4x4)."""
+    """Default chip grid when metadata reports no ``TOPOLOGY``: the
+    platform's canonical topology for the size, else a near-cubic
+    factorization (dims ascending).  Callers log that this is a guess."""
     if ndims == 1 or chips == 1:
         return (chips,)
+    canonical = (_CANONICAL_2D if ndims == 2 else _CANONICAL_3D).get(chips)
+    if canonical:
+        return canonical
     dims: List[int] = []
     remaining = chips
     for i in range(ndims - 1, 0, -1):
@@ -166,6 +192,11 @@ def from_tpu_env(
         _, _, ndims = _GENERATIONS[gen]
         mesh = default_grid(chips_from_name, ndims)
         num_chips = chips_from_name
+        log.warning(
+            "tpu-env lacks TOPOLOGY; assuming the canonical %s grid %s — "
+            "a non-default reservation must export TOPOLOGY",
+            accel, "x".join(str(d) for d in mesh),
+        )
 
     cphb = _parse_bounds(env.get("CHIPS_PER_HOST_BOUNDS", "")) or ()
     hostb = _parse_bounds(env.get("HOST_BOUNDS", "")) or ()
@@ -201,6 +232,10 @@ def from_accelerator_type(accel: str, worker_id: int = 0) -> TpuTopology:
     gen, chips = parse_accelerator_type(accel)
     _, chips_per_host, ndims = _GENERATIONS[gen]
     mesh = default_grid(chips, ndims)
+    log.warning(
+        "topology derived from accelerator-type %s alone: assuming the "
+        "canonical grid %s", accel, "x".join(str(d) for d in mesh),
+    )
     chips_per_host = min(chips_per_host, chips)
     return TpuTopology(
         accelerator_type=accel,

@@ -64,6 +64,9 @@ class MoEConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     remat_policy: str = "dots"          # see LlamaConfig.remat_policy
+    # >0: chunked cross-entropy — never materialize [B,S,vocab] logits
+    # (see LlamaConfig.xent_chunk / training.chunked_next_token_xent)
+    xent_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -281,14 +284,15 @@ def _layer(cfg: MoEConfig, cos, sin, x, lp, attn_fn,
 # -- forward / loss / training ------------------------------------------------
 
 
-def forward(
+def forward_hidden(
     params: Params,
     tokens: jnp.ndarray,               # [B, S] int32
     cfg: MoEConfig,
     attn_fn: Optional[Callable] = None,
     mesh: Optional[Mesh] = None,
 ):
-    """(logits [B,S,vocab] f32, mean router aux loss)."""
+    """(final hidden [B,S,H], mean router aux loss) — pre vocab
+    projection, so the training loss can chunk it (cfg.xent_chunk)."""
     attn_fn = attn_fn or causal_attention
     x = params["embed"][tokens].astype(cfg.dtype)
     cos, sin = rope_angles(tokens.shape[1], cfg.head_dim, cfg.rope_theta)
@@ -304,9 +308,19 @@ def forward(
     x, auxes = jax.lax.scan(
         lambda x, lp: block(x, lp), x, params["layers"]
     )
-    x = rms_norm(x, params["ln_final"], cfg.rms_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
-    return logits, jnp.mean(auxes)
+    return rms_norm(x, params["ln_final"], cfg.rms_eps), jnp.mean(auxes)
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,               # [B, S] int32
+    cfg: MoEConfig,
+    attn_fn: Optional[Callable] = None,
+    mesh: Optional[Mesh] = None,
+):
+    """(logits [B,S,vocab] f32, mean router aux loss)."""
+    x, aux = forward_hidden(params, tokens, cfg, attn_fn, mesh)
+    return (x @ params["lm_head"]).astype(jnp.float32), aux
 
 
 def loss_fn(
@@ -317,8 +331,14 @@ def loss_fn(
     mesh: Optional[Mesh] = None,
 ) -> jnp.ndarray:
     """Next-token CE + router load-balancing aux."""
-    from .training import next_token_xent
+    from .training import chunked_next_token_xent, next_token_xent
 
+    if cfg.xent_chunk > 0:
+        x, aux = forward_hidden(params, tokens[:, :-1], cfg, attn_fn, mesh)
+        ce = chunked_next_token_xent(
+            x, params["lm_head"], tokens, cfg.xent_chunk
+        )
+        return ce + cfg.router_aux_weight * aux
     logits, aux = forward(params, tokens[:, :-1], cfg, attn_fn, mesh)
     return next_token_xent(logits, tokens) + cfg.router_aux_weight * aux
 
