@@ -291,6 +291,19 @@ class TestStatusMachine:
         # agent pods materialized under the DS (feeds the pod indexer)
         assert len(fake.list("v1", "Pod", namespace=NAMESPACE)) == 3
 
+    def test_drain_timeout_projection(self, env):
+        """drainTimeoutSeconds projects the agent flag AND scales the pod
+        grace period to cover it (kubelet must not SIGKILL mid-drain)."""
+        fake, mgr = env
+        cr = tpu_cr()
+        cr.spec.tpu_scale_out.drain_timeout_seconds = 120
+        fake.create(cr.to_dict())
+        reconcile(fake, mgr, "tpu-slice")
+        ds = get_ds(fake, "tpu-slice")
+        pod_spec = ds["spec"]["template"]["spec"]
+        assert "--drain-timeout=120s" in pod_spec["containers"][0]["args"]
+        assert pod_spec["terminationGracePeriodSeconds"] == 135
+
     def test_stale_report_from_departed_node_ignored(self, env):
         """A Lease left behind by a crashed/replaced node (retraction is
         best-effort) must not stand in for a live node's missing report."""

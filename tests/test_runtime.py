@@ -259,7 +259,16 @@ def test_manager_counts_reconciles():
     mgr = Manager(cluster, namespace="ns", metrics=metrics)
     cluster.create(make_policy())
     mgr.drain()
-    assert 'result="success"' in metrics.render()
+    rendered = metrics.render()
+    assert 'result="success"' in rendered
+    # per-policy readiness gauges (SURVEY §5.5 — beyond the reference,
+    # which registers no custom metric at all)
+    assert 'tpunet_policy_targets{policy="p1"} 0' in rendered
+    assert 'tpunet_policy_all_good{policy="p1"} 0.0' in rendered
+    # deleting the CR retracts its series (no phantom export)
+    cluster.delete("tpunet.dev/v1alpha1", "NetworkClusterPolicy", "p1")
+    mgr.drain()
+    assert 'policy="p1"' not in metrics.render()
 
 
 # -- leader election ----------------------------------------------------------

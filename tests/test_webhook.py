@@ -160,6 +160,11 @@ class TestValidation:
             validate_create(p)
         p.spec.tpu_scale_out.topology_source = "metadata"
         assert validate_create(p) == []
+        p.spec.tpu_scale_out.drain_timeout_seconds = 601
+        with pytest.raises(AdmissionError, match="drainTimeoutSeconds"):
+            validate_create(p)
+        p.spec.tpu_scale_out.drain_timeout_seconds = 120
+        assert validate_create(p) == []
 
     def test_tpu_dcn_interfaces_validation(self):
         p = tpu_policy()

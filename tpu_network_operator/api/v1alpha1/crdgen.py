@@ -118,6 +118,17 @@ def openapi_schema() -> Dict[str, Any]:
                                     "GCE metadata."
                                 ),
                             },
+                            "drainTimeoutSeconds": {
+                                "type": "integer",
+                                "minimum": 0,
+                                "maximum": 600,
+                                "description": (
+                                    "SIGTERM drain: max seconds the agent "
+                                    "waits for a running JAX job to release "
+                                    "the bootstrap lock before withdrawing "
+                                    "routes (0 = agent default, 30s)."
+                                ),
+                            },
                         },
                     },
                 },

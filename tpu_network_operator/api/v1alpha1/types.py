@@ -91,6 +91,11 @@ class TpuScaleOutSpec:
     # ``--interfaces`` (ref main.go:171-184 extras).  Empty = the agent
     # auto-discovers the secondary gVNICs from GCE metadata (agent/tpu/dcn).
     dcn_interfaces: List[str] = j("dcnInterfaces", factory=list)
+    # De-provision drain: how long the agent waits on SIGTERM for a
+    # running JAX job to release the bootstrap lock before withdrawing
+    # routes/links (agent --drain-timeout; 0 = agent default 30s).  The
+    # projected DaemonSet grace period scales to cover it.
+    drain_timeout_seconds: int = j("drainTimeoutSeconds", 0)
 
 
 @dataclass

@@ -131,6 +131,10 @@ def validate_tpu_so_spec(s: t.TpuScaleOutSpec) -> None:
                 f"tpuScaleOut: duplicate dcnInterfaces name {name!r}"
             )
         seen.add(name)
+    if not (0 <= s.drain_timeout_seconds <= 600):
+        raise AdmissionError(
+            "tpuScaleOut: drainTimeoutSeconds must be 0-600"
+        )
 
 
 def validate_spec(spec: NetworkClusterPolicySpec) -> List[str]:

@@ -37,6 +37,12 @@ class Metrics:
         with self._lock:
             self._gauges[(name, _label_key(labels))] = value
 
+    def remove_gauge(self, name: str, labels: Optional[Dict[str, str]] = None):
+        """Drop one series — e.g. a deleted policy's gauges must not be
+        exported as healthy phantoms until restart."""
+        with self._lock:
+            self._gauges.pop((name, _label_key(labels)), None)
+
     def render(self) -> str:
         """Prometheus text exposition format."""
         lines: List[str] = []

@@ -29,7 +29,7 @@ class Manager:
         self.namespace = namespace
         self.metrics = metrics
         self.reconciler = NetworkClusterPolicyReconciler(
-            client, namespace, is_openshift
+            client, namespace, is_openshift, metrics=metrics
         )
         self._queue: "queue.Queue[str]" = queue.Queue()
         self._pending = set()

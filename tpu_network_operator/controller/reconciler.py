@@ -198,6 +198,12 @@ def update_tpu_scale_out_daemonset(
         # explicit DCN NIC override; absent = agent auto-discovery
         # (ref --interfaces projection analog, controller :176-203)
         args.append("--interfaces=" + ",".join(so.dcn_interfaces))
+    if so.drain_timeout_seconds > 0:
+        args.append(f"--drain-timeout={so.drain_timeout_seconds}s")
+        # grace must cover drain + teardown or kubelet SIGKILLs mid-drain
+        pod_spec["terminationGracePeriodSeconds"] = (
+            so.drain_timeout_seconds + 15
+        )
     if so.layer == t.LAYER_L3:
         args.append("--wait=90s")
     add_host_volume(
@@ -213,10 +219,13 @@ def update_tpu_scale_out_daemonset(
 class NetworkClusterPolicyReconciler:
     """ref ``NetworkClusterPolicyReconciler`` controller :50-55."""
 
-    def __init__(self, client, namespace: str, is_openshift: bool = False):
+    def __init__(
+        self, client, namespace: str, is_openshift: bool = False, metrics=None
+    ):
         self.client = client
         self.namespace = namespace
         self.is_openshift = is_openshift
+        self.metrics = metrics
 
     # -- setup ----------------------------------------------------------------
 
@@ -451,6 +460,16 @@ class NetworkClusterPolicyReconciler:
         else:
             state = STATE_ALL_GOOD
 
+        if self.metrics:
+            labels = {"policy": policy.metadata.name}
+            self.metrics.set_gauge("tpunet_policy_targets", targets, labels)
+            self.metrics.set_gauge("tpunet_policy_ready_nodes", ready, labels)
+            self.metrics.set_gauge(
+                "tpunet_policy_all_good",
+                1.0 if state == STATE_ALL_GOOD else 0.0,
+                labels,
+            )
+
         updated = (
             policy.status.targets != targets
             or policy.status.ready_nodes != ready
@@ -476,7 +495,14 @@ class NetworkClusterPolicyReconciler:
         try:
             raw = self.client.get(t.API_VERSION, NetworkClusterPolicy.KIND, name)
         except kerr.NotFoundError:
-            return Result()   # IgnoreNotFound (ref :320-326)
+            # IgnoreNotFound (ref :320-326) — but retract the deleted
+            # policy's gauge series so /metrics stops exporting phantoms
+            if self.metrics:
+                for gauge in ("tpunet_policy_targets",
+                              "tpunet_policy_ready_nodes",
+                              "tpunet_policy_all_good"):
+                    self.metrics.remove_gauge(gauge, {"policy": name})
+            return Result()
         policy = NetworkClusterPolicy.from_dict(raw)
 
         owned = self.client.list(
