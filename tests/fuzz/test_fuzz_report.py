@@ -1,0 +1,136 @@
+"""Fuzz the readiness back-channel (round 3 additions).
+
+Oracles:
+
+* the reconciler's report aggregation never raises on arbitrary Lease
+  content — malformed annotations degrade to not-ready reports, never to
+  a crashed reconcile;
+* the wire apiserver answers arbitrary request paths/bodies with an HTTP
+  status, never a hung or reset connection;
+* ProvisioningReport JSON round-trips losslessly for arbitrary field
+  values.
+
+Seeded RNG: failures print the seed for replay.
+"""
+
+import json
+import random
+import string
+import urllib.error
+import urllib.request
+
+from tpu_network_operator.agent import report as rpt
+from tpu_network_operator.controller.reconciler import (
+    NetworkClusterPolicyReconciler,
+)
+from tpu_network_operator.kube.fake import FakeCluster
+from tpu_network_operator.kube.wire import WireApiServer
+
+NAMESPACE = "tpunet-system"
+SEED = random.SystemRandom().randrange(1 << 32)
+
+
+def junk(rng, n=40):
+    return "".join(
+        rng.choice(string.printable) for _ in range(rng.randrange(n))
+    )
+
+
+def test_report_aggregation_never_crashes():
+    rng = random.Random(SEED)
+    print(f"seed={SEED}")
+    fake = FakeCluster()
+    rec = NetworkClusterPolicyReconciler(fake, namespace=NAMESPACE)
+
+    for i in range(200):
+        roll = rng.random()
+        if roll < 0.3:
+            annotation = junk(rng, 120)                  # garbage
+        elif roll < 0.5:
+            annotation = json.dumps(rng.choice(
+                [[], 42, None, "str", {"unexpected": junk(rng)}]
+            ))                                           # wrong shape
+        elif roll < 0.7:
+            # right shape, fuzzed values
+            annotation = json.dumps({
+                "node": junk(rng), "policy": junk(rng),
+                "ok": rng.choice([True, False, None, "yes", 1]),
+                "error": junk(rng),
+            })
+        else:
+            annotation = rpt.ProvisioningReport(
+                node=f"n{i}", policy="p", ok=rng.random() < 0.5
+            ).to_json()
+        fake.create({
+            "apiVersion": rpt.LEASE_API,
+            "kind": "Lease",
+            "metadata": {
+                "name": f"lease-{i}",
+                "namespace": NAMESPACE,
+                "labels": {rpt.AGENT_LABEL: "true", rpt.POLICY_LABEL: "p"},
+                "annotations": {rpt.REPORT_ANNOTATION: annotation},
+            },
+            "spec": {"holderIdentity": f"n{i}"},
+        })
+        # the oracle: aggregation returns a list, never raises
+        reports = rec._agent_reports("p")
+        assert isinstance(reports, list)
+
+
+def test_wire_server_survives_arbitrary_requests():
+    rng = random.Random(SEED + 1)
+    print(f"seed={SEED + 1}")
+    url_chars = string.ascii_letters + string.digits + "-._~%!$&'()*+,;=:@"
+    with WireApiServer() as srv:
+        for _ in range(150):
+            path = "/" + "/".join(
+                "".join(rng.choice(url_chars)
+                        for _ in range(rng.randrange(1, 12)))
+                for _ in range(rng.randrange(1, 6))
+            )
+            method = rng.choice(["GET", "POST", "PUT", "DELETE", "PATCH"])
+            body = None
+            if method in ("POST", "PUT", "PATCH"):
+                body = (
+                    junk(rng, 60).encode()
+                    if rng.random() < 0.5
+                    else json.dumps({"metadata": {"name": junk(rng, 10)}}).encode()
+                )
+            req = urllib.request.Request(
+                srv.url + path, data=body, method=method
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    assert resp.status < 600
+            except urllib.error.HTTPError as e:
+                assert 400 <= e.code < 600   # clean HTTP error, not a hang
+        # after the storm the server still works
+        import tpu_network_operator.kube.client as kc
+
+        c = kc.ApiClient(srv.url)
+        c.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "post-fuzz", "namespace": "ns"},
+        })
+        assert c.get("v1", "ConfigMap", "post-fuzz", "ns")
+
+
+def test_provisioning_report_round_trip():
+    rng = random.Random(SEED + 2)
+    print(f"seed={SEED + 2}")
+    for _ in range(100):
+        rep = rpt.ProvisioningReport(
+            node=junk(rng, 30),
+            policy=junk(rng, 30),
+            ok=rng.random() < 0.5,
+            backend=rng.choice(["gaudi", "tpu", junk(rng, 8)]),
+            mode=rng.choice(["L2", "L3"]),
+            interfaces_configured=rng.randrange(-5, 50),
+            interfaces_total=rng.randrange(0, 50),
+            bootstrap_written=rng.random() < 0.5,
+            coordinator=junk(rng, 24),
+            coordinator_reachable=rng.choice([True, False, None]),
+            dcn_interfaces=[junk(rng, 12) for _ in range(rng.randrange(4))],
+            error=junk(rng, 60),
+        )
+        assert rpt.ProvisioningReport.from_json(rep.to_json()) == rep

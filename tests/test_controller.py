@@ -304,6 +304,19 @@ class TestStatusMachine:
         assert "--drain-timeout=120s" in pod_spec["containers"][0]["args"]
         assert pod_spec["terminationGracePeriodSeconds"] == 135
 
+        # lowering back to 0 must RESET the live DS to the template
+        # default, not leave the scaled grace behind (idempotence)
+        cr2 = fake.get(API_VERSION, "NetworkClusterPolicy", "tpu-slice")
+        cr2["spec"]["tpuScaleOut"]["drainTimeoutSeconds"] = 0
+        fake.update(cr2)
+        reconcile(fake, mgr, "tpu-slice")
+        pod_spec = get_ds(fake, "tpu-slice")["spec"]["template"]["spec"]
+        assert not any(
+            a.startswith("--drain-timeout")
+            for a in pod_spec["containers"][0]["args"]
+        )
+        assert pod_spec["terminationGracePeriodSeconds"] == 45
+
     def test_stale_report_from_departed_node_ignored(self, env):
         """A Lease left behind by a crashed/replaced node (retraction is
         best-effort) must not stand in for a live node's missing report."""

@@ -41,6 +41,15 @@ STATE_ALL_GOOD = "All good"          # ref controller :294
 # grants the provisioning-report Lease writes (agent/report.py)
 AGENT_SERVICE_ACCOUNT = "tpunet-agent"
 
+# every per-policy gauge the reconciler exports; ONE list for both the
+# set site (_update_status) and the retract-on-delete site (reconcile)
+# so no series can become a phantom after CR deletion
+POLICY_GAUGES = (
+    "tpunet_policy_targets",
+    "tpunet_policy_ready_nodes",
+    "tpunet_policy_all_good",
+)
+
 
 @dataclass
 class Result:
@@ -198,12 +207,17 @@ def update_tpu_scale_out_daemonset(
         # explicit DCN NIC override; absent = agent auto-discovery
         # (ref --interfaces projection analog, controller :176-203)
         args.append("--interfaces=" + ",".join(so.dcn_interfaces))
+    # grace must cover drain + teardown or kubelet SIGKILLs mid-drain;
+    # written in BOTH branches so lowering the CR value back to 0 resets
+    # a live DaemonSet to the template default (45 = 30s agent default
+    # + 15 teardown) instead of leaving the scaled value behind
     if so.drain_timeout_seconds > 0:
         args.append(f"--drain-timeout={so.drain_timeout_seconds}s")
-        # grace must cover drain + teardown or kubelet SIGKILLs mid-drain
         pod_spec["terminationGracePeriodSeconds"] = (
             so.drain_timeout_seconds + 15
         )
+    else:
+        pod_spec["terminationGracePeriodSeconds"] = 45
     if so.layer == t.LAYER_L3:
         args.append("--wait=90s")
     add_host_volume(
@@ -462,13 +476,15 @@ class NetworkClusterPolicyReconciler:
 
         if self.metrics:
             labels = {"policy": policy.metadata.name}
-            self.metrics.set_gauge("tpunet_policy_targets", targets, labels)
-            self.metrics.set_gauge("tpunet_policy_ready_nodes", ready, labels)
-            self.metrics.set_gauge(
-                "tpunet_policy_all_good",
-                1.0 if state == STATE_ALL_GOOD else 0.0,
-                labels,
-            )
+            values = {
+                "tpunet_policy_targets": targets,
+                "tpunet_policy_ready_nodes": ready,
+                "tpunet_policy_all_good":
+                    1.0 if state == STATE_ALL_GOOD else 0.0,
+            }
+            assert set(values) == set(POLICY_GAUGES)
+            for gauge in POLICY_GAUGES:
+                self.metrics.set_gauge(gauge, values[gauge], labels)
 
         updated = (
             policy.status.targets != targets
@@ -498,9 +514,7 @@ class NetworkClusterPolicyReconciler:
             # IgnoreNotFound (ref :320-326) — but retract the deleted
             # policy's gauge series so /metrics stops exporting phantoms
             if self.metrics:
-                for gauge in ("tpunet_policy_targets",
-                              "tpunet_policy_ready_nodes",
-                              "tpunet_policy_all_good"):
+                for gauge in POLICY_GAUGES:
                     self.metrics.remove_gauge(gauge, {"policy": name})
             return Result()
         policy = NetworkClusterPolicy.from_dict(raw)
