@@ -1,0 +1,99 @@
+"""Crash-recovery e2e: idempotent re-entry (SURVEY §5.4's "network
+config persistence" analog, ref network.go:424-459).
+
+A SIGKILLed agent leaves the node half-provisioned (addresses installed,
+bootstrap written, label present, nothing cleaned).  The DaemonSet's
+replacement pod must converge the node to exactly the same state a fresh
+pod would produce: fresh-slate address strip, re-derived /30s (no
+duplicates), one bootstrap, label restored — and a normal SIGTERM of the
+second pod still de-provisions fully.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from tpu_network_operator.agent.tpu.metadata import FakeMetadataServer
+
+from tests.e2e.test_dcn_e2e import (
+    HOST_NICS,
+    LLDP_DESCS,
+    TWO_NIC_METADATA,
+    V5E_16_ATTRS,
+    AgentHost,
+    projected_agent_args,
+    run_agent_until_ready,
+    terminate_and_assert_deprovision,
+    tpu_cr,
+)
+
+
+def test_sigkill_then_restart_converges(tmp_path):
+    args = projected_agent_args(tpu_cr("v5e-crash-recover", "L3"))
+    host = AgentHost(tmp_path, HOST_NICS, LLDP_DESCS)
+    with FakeMetadataServer(
+        V5E_16_ATTRS, network_interfaces=TWO_NIC_METADATA
+    ) as srv:
+        # first pod: provision, then die without any cleanup
+        proc = run_agent_until_ready(args, host, srv.url)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        state = host.state()
+        assert any(l["addrs"] for l in state["links"]), "precondition"
+        assert host.bootstrap_path().exists()
+        assert host.label_path().exists()
+
+        # replacement pod over the dirty node.  The STALE label/bootstrap
+        # from the crash would satisfy a naive readiness poll before the
+        # new agent has done anything, so wait for the bootstrap to be
+        # REWRITTEN (write_atomic = new inode) and the label re-written.
+        stat_before = os.stat(host.bootstrap_path())
+        from tests.e2e.test_dcn_e2e import ROOT, host_args
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_network_operator.agent.cli",
+             *host_args(args, host)],
+            env=host.env(srv.url), cwd=ROOT,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"agent died: {proc.stderr.read().decode()[-3000:]}"
+                )
+            try:
+                cur = os.stat(host.bootstrap_path())
+            except FileNotFoundError:
+                cur = None
+            if (
+                cur is not None
+                and cur.st_ino != stat_before.st_ino
+                and host.label_path().exists()
+            ):
+                break
+            time.sleep(0.1)
+        else:
+            proc.kill()
+            raise AssertionError("second agent never re-provisioned")
+        time.sleep(0.3)   # let it reach the signal-wait steady state
+        try:
+            state = host.state()
+            links = {l["name"]: l for l in state["links"]}
+            # exactly one /30 per DCN NIC — no accumulation across runs
+            assert links["ens9"]["addrs"] == ["10.1.0.1/30"]
+            assert links["ens10"]["addrs"] == ["10.1.1.1/30"]
+            assert not links["ens8"]["addrs"]   # primary still untouched
+            # no duplicate routes either
+            routes = [
+                (r["dst"], r["oif"]) for r in state["routes"]
+            ]
+            assert len(routes) == len(set(routes)), routes
+            cfg = json.loads(host.bootstrap_path().read_text())
+            assert cfg["dcn_interfaces"] == ["ens10", "ens9"]
+        finally:
+            # second pod's graceful exit fully de-provisions
+            terminate_and_assert_deprovision(proc, host)

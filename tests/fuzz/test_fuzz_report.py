@@ -57,6 +57,15 @@ def test_report_aggregation_never_crashes():
                 "ok": rng.choice([True, False, None, "yes", 1]),
                 "error": junk(rng),
             })
+        elif roll < 0.85:
+            # right shape, wrong TYPES (the crash class: a non-string
+            # node would break sorted() in status aggregation)
+            annotation = json.dumps({
+                "node": rng.choice([1, None, ["a"], {"x": 1}, "n"]),
+                "policy": rng.choice([2.5, "p", None]),
+                "ok": True,
+                "dcn_interfaces": rng.choice([[1, 2], "notalist", ["ok"]]),
+            })
         else:
             annotation = rpt.ProvisioningReport(
                 node=f"n{i}", policy="p", ok=rng.random() < 0.5
@@ -72,30 +81,57 @@ def test_report_aggregation_never_crashes():
             },
             "spec": {"holderIdentity": f"n{i}"},
         })
-        # the oracle: aggregation returns a list, never raises
+        # the oracle: aggregation returns a list whose fields are usable
+        # by status aggregation (sortable nodes), never raises
         reports = rec._agent_reports("p")
         assert isinstance(reports, list)
+        sorted(r.node for r in reports if r.ok)
+        sorted(f"{r.node}: {r.error}" for r in reports if not r.ok)
 
 
 def test_wire_server_survives_arbitrary_requests():
     rng = random.Random(SEED + 1)
     print(f"seed={SEED + 1}")
     url_chars = string.ascii_letters + string.digits + "-._~%!$&'()*+,;=:@"
+
+    def segment():
+        return "".join(
+            rng.choice(url_chars) for _ in range(rng.randrange(1, 12))
+        )
+
     with WireApiServer() as srv:
-        for _ in range(150):
-            path = "/" + "/".join(
-                "".join(rng.choice(url_chars)
-                        for _ in range(rng.randrange(1, 12)))
-                for _ in range(rng.randrange(1, 6))
-            )
+        for _ in range(200):
+            roll = rng.random()
+            if roll < 0.4:
+                # VALID route prefixes so body handling/dispatch is
+                # actually reached (pure-random segments ~never hit
+                # /api|/apis and would only exercise the 404 path)
+                path = rng.choice([
+                    "/api/v1/configmaps",
+                    "/api/v1/namespaces/ns1/configmaps",
+                    f"/api/v1/namespaces/{segment()}/leases/{segment()}",
+                    "/apis/apps/v1/daemonsets",
+                    f"/apis/tpunet.dev/v1alpha1/networkclusterpolicies/{segment()}",
+                    f"/apis/{segment()}/{segment()}/{segment()}",
+                ])
+            else:
+                path = "/" + "/".join(
+                    segment() for _ in range(rng.randrange(1, 6))
+                )
             method = rng.choice(["GET", "POST", "PUT", "DELETE", "PATCH"])
             body = None
             if method in ("POST", "PUT", "PATCH"):
-                body = (
-                    junk(rng, 60).encode()
-                    if rng.random() < 0.5
-                    else json.dumps({"metadata": {"name": junk(rng, 10)}}).encode()
-                )
+                body = rng.choice([
+                    junk(rng, 60).encode(),                      # not JSON
+                    json.dumps(rng.choice([[], 7, "s"])).encode(),  # non-dict
+                    json.dumps(
+                        {"metadata": {"name": junk(rng, 10)}}
+                    ).encode(),
+                    json.dumps({
+                        "apiVersion": "v1", "kind": "ConfigMap",
+                        "metadata": {"name": segment(), "namespace": "ns1"},
+                    }).encode(),
+                ])
             req = urllib.request.Request(
                 srv.url + path, data=body, method=method
             )

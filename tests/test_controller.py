@@ -317,6 +317,20 @@ class TestStatusMachine:
         )
         assert pod_spec["terminationGracePeriodSeconds"] == 45
 
+    def test_grace_default_matches_template(self):
+        """Drift gate: the reconciler's reset value must be the embedded
+        template's baked-in grace, or 'reset to default' is a lie."""
+        from tpu_network_operator.controller import templates
+        from tpu_network_operator.controller.reconciler import (
+            TPU_GRACE_PERIOD_DEFAULT,
+        )
+
+        ds = templates.tpu_discovery_daemonset()
+        assert (
+            ds["spec"]["template"]["spec"]["terminationGracePeriodSeconds"]
+            == TPU_GRACE_PERIOD_DEFAULT
+        )
+
     def test_stale_report_from_departed_node_ignored(self, env):
         """A Lease left behind by a crashed/replaced node (retraction is
         best-effort) must not stand in for a live node's missing report."""

@@ -57,7 +57,35 @@ class ProvisioningReport:
 
     @staticmethod
     def from_json(raw: str) -> "ProvisioningReport":
-        return ProvisioningReport(**json.loads(raw))
+        """Parse with type validation: annotations come from the cluster
+        (any agent, any version, possibly mangled) and the reconciler
+        sorts/compares these fields — a non-string ``node`` must be a
+        parse failure the caller degrades on, not a latent TypeError in
+        status aggregation."""
+        d = json.loads(raw)
+        if not isinstance(d, dict):
+            raise ValueError("report must be a JSON object")
+        rep = ProvisioningReport(**d)
+        for field_name in ("node", "policy", "backend", "mode",
+                           "coordinator", "error"):
+            if not isinstance(getattr(rep, field_name), str):
+                raise ValueError(f"report field {field_name!r} not a string")
+        for field_name in ("interfaces_configured", "interfaces_total"):
+            if not isinstance(getattr(rep, field_name), int):
+                raise ValueError(f"report field {field_name!r} not an int")
+        if not isinstance(rep.dcn_interfaces, list) or not all(
+            isinstance(i, str) for i in rep.dcn_interfaces
+        ):
+            raise ValueError("report field 'dcn_interfaces' not a str list")
+        return ProvisioningReport(**{
+            **asdict(rep),
+            "ok": rep.ok is True,
+            "bootstrap_written": rep.bootstrap_written is True,
+            "coordinator_reachable": (
+                None if rep.coordinator_reachable is None
+                else rep.coordinator_reachable is True
+            ),
+        })
 
 
 def coordinator_reachable(address: str, timeout: float = 3.0) -> bool:

@@ -41,6 +41,11 @@ STATE_ALL_GOOD = "All good"          # ref controller :294
 # grants the provisioning-report Lease writes (agent/report.py)
 AGENT_SERVICE_ACCOUNT = "tpunet-agent"
 
+# tpu DaemonSet default grace period: agent default drain (30s) + 15s
+# teardown.  templates.py bakes the same value into the embedded YAML;
+# a drift gate in tests/test_controller.py pins them together
+TPU_GRACE_PERIOD_DEFAULT = 45
+
 # every per-policy gauge the reconciler exports; ONE list for both the
 # set site (_update_status) and the retract-on-delete site (reconcile)
 # so no series can become a phantom after CR deletion
@@ -209,15 +214,15 @@ def update_tpu_scale_out_daemonset(
         args.append("--interfaces=" + ",".join(so.dcn_interfaces))
     # grace must cover drain + teardown or kubelet SIGKILLs mid-drain;
     # written in BOTH branches so lowering the CR value back to 0 resets
-    # a live DaemonSet to the template default (45 = 30s agent default
-    # + 15 teardown) instead of leaving the scaled value behind
+    # a live DaemonSet to the template default instead of leaving the
+    # scaled value behind
     if so.drain_timeout_seconds > 0:
         args.append(f"--drain-timeout={so.drain_timeout_seconds}s")
         pod_spec["terminationGracePeriodSeconds"] = (
             so.drain_timeout_seconds + 15
         )
     else:
-        pod_spec["terminationGracePeriodSeconds"] = 45
+        pod_spec["terminationGracePeriodSeconds"] = TPU_GRACE_PERIOD_DEFAULT
     if so.layer == t.LAYER_L3:
         args.append("--wait=90s")
     add_host_volume(

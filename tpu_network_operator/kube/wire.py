@@ -154,9 +154,15 @@ class WireApiServer:
                 sub = parts[1] if len(parts) > 1 else ""
                 return api_version, kind, namespace, name, sub
 
-            def _read_body(self) -> Dict[str, Any]:
+            def _read_body(self) -> Optional[Dict[str, Any]]:
+                """None on malformed/non-object JSON — callers must 400,
+                not let the handler thread die with a reset connection."""
                 n = int(self.headers.get("Content-Length", 0))
-                return json.loads(self.rfile.read(n) or b"{}")
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    return None
+                return body if isinstance(body, dict) else None
 
             # -- verbs -------------------------------------------------------
 
@@ -259,6 +265,10 @@ class WireApiServer:
                     return
                 av, kind, _ns, _name, _sub = route
                 body = self._read_body()
+                if body is None:
+                    self._reply(400, _status_body(400, "BadRequest",
+                                                  "malformed JSON body"))
+                    return
                 if kind == "TokenReview":
                     tok = body.get("spec", {}).get("token", "")
                     self._reply_obj({
@@ -286,6 +296,10 @@ class WireApiServer:
                     return
                 _av, _kind, _ns, _name, sub = route
                 body = self._read_body()
+                if body is None:
+                    self._reply(400, _status_body(400, "BadRequest",
+                                                  "malformed JSON body"))
+                    return
                 try:
                     if sub == "status":
                         self._reply_obj(outer.cluster.update_status(body))
@@ -306,6 +320,10 @@ class WireApiServer:
                     return
                 av, kind, ns, name, _sub = route
                 patch = self._read_body()
+                if patch is None:
+                    self._reply(400, _status_body(400, "BadRequest",
+                                                  "malformed JSON body"))
+                    return
                 patch.setdefault("apiVersion", av)
                 patch.setdefault("kind", kind)
                 patch.setdefault("metadata", {})["name"] = name
