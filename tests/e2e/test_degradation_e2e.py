@@ -1,0 +1,106 @@
+"""Continuous-readiness e2e: a provisioned, idling agent detects a link
+that degrades underneath it (the kernel flipping state is simulated by
+editing the FileLinkOps state file externally), retracts the NFD label
+and publishes an ok=False report; when the link recovers, readiness is
+restored.  The reference has nothing like this — its agent idles blind
+(ref cmd/discover/main.go:252-255).
+"""
+
+import json
+import signal
+import subprocess
+import sys
+import time
+
+from tpu_network_operator.agent import report as rpt
+from tpu_network_operator.agent.tpu.metadata import FakeMetadataServer
+from tpu_network_operator.kube.client import ApiClient
+from tpu_network_operator.kube.wire import WireApiServer
+
+from tests.e2e.test_dcn_e2e import (
+    HOST_NICS,
+    LLDP_DESCS,
+    ROOT,
+    TWO_NIC_METADATA,
+    V5E_16_ATTRS,
+    AgentHost,
+    host_args,
+    projected_agent_args,
+    tpu_cr,
+)
+
+NAMESPACE = "tpunet-system"
+
+
+def wait_for(cond, timeout=30.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def flip_link(host, name, up):
+    state = host.state()
+    for link in state["links"]:
+        if link["name"] == name:
+            link["up"] = up
+    host.state_file.write_text(json.dumps(state))
+
+
+def get_report(client):
+    leases = client.list(
+        rpt.LEASE_API, "Lease", namespace=NAMESPACE,
+        label_selector={rpt.AGENT_LABEL: "true"},
+    )
+    if not leases:
+        return None
+    return rpt.ProvisioningReport.from_json(
+        leases[0]["metadata"]["annotations"][rpt.REPORT_ANNOTATION]
+    )
+
+
+def test_link_degradation_retracts_and_recovery_restores(tmp_path):
+    args = projected_agent_args(tpu_cr("v5e-degrade", "L3"))
+    args.append("--recheck-interval=300ms")
+    host = AgentHost(tmp_path, HOST_NICS, LLDP_DESCS)
+    with WireApiServer() as srv, FakeMetadataServer(
+        V5E_16_ATTRS, network_interfaces=TWO_NIC_METADATA
+    ) as meta:
+        env = host.env(meta.url)
+        env["TPUNET_KUBE_URL"] = srv.url
+        env["NODE_NAME"] = "tpu-worker-0"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_network_operator.agent.cli",
+             *host_args(args, host)],
+            env=env, cwd=ROOT,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        client = ApiClient(srv.url)
+        try:
+            wait_for(lambda: host.label_path().exists(), what="ready")
+            rep = get_report(client)
+            assert rep and rep.ok
+
+            # the kernel "loses" ens9 under the idling agent
+            flip_link(host, "ens9", up=False)
+            wait_for(lambda: not host.label_path().exists(),
+                     what="label retraction on degradation")
+            wait_for(lambda: get_report(client).ok is False,
+                     what="ok=False report")
+            assert "ens9" in get_report(client).error
+            assert proc.poll() is None   # agent keeps running (no crash)
+
+            # link comes back: readiness restored
+            flip_link(host, "ens9", up=True)
+            wait_for(lambda: host.label_path().exists(),
+                     what="label restoration on recovery")
+            wait_for(lambda: get_report(client).ok is True,
+                     what="ok report restored")
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()

@@ -29,8 +29,15 @@ class FileLinkOps(FakeLinkOps):
     def __init__(self) -> None:
         super().__init__()
         self.path = os.environ["TPUNET_LINKOPS_STATE"]
+        self._mtime = -1
         with open(self.path) as f:
             state = json.load(f)
+        self._load_links(state)
+        self._dump()
+
+    def _load_links(self, state) -> None:
+        self.links.clear()
+        self.addrs.clear()
         for i, spec in enumerate(state.get("links", [])):
             link = self.add_fake_link(
                 spec["name"],
@@ -44,7 +51,33 @@ class FileLinkOps(FakeLinkOps):
                 self.addrs[link.index].append(
                     nl.Addr(link.index, address, int(plen), link.name)
                 )
-        self._dump()
+
+    def _maybe_reload(self) -> None:
+        """Pick up EXTERNAL edits to the state file (a test flipping a
+        link down plays the role of the kernel changing link state under
+        a live agent).  Journals (ups/downs/routes/mtu_set) stay ours."""
+        try:
+            m = os.stat(self.path).st_mtime_ns
+        except OSError:
+            return
+        if m == self._mtime:
+            return
+        with open(self.path) as f:
+            state = json.load(f)
+        self._load_links(state)
+        self._mtime = m
+
+    def link_by_name(self, name):
+        self._maybe_reload()
+        return super().link_by_name(name)
+
+    def link_list(self):
+        self._maybe_reload()
+        return super().link_list()
+
+    def addr_list(self, index=None):
+        self._maybe_reload()
+        return super().addr_list(index)
 
     # -- persistence ----------------------------------------------------------
 
@@ -70,6 +103,10 @@ class FileLinkOps(FakeLinkOps):
         with open(tmp, "w") as f:
             json.dump(state, f, indent=1)
         os.replace(tmp, self.path)
+        try:
+            self._mtime = os.stat(self.path).st_mtime_ns
+        except OSError:
+            pass
 
     # -- mutators persist after applying --------------------------------------
 

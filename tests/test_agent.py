@@ -287,6 +287,47 @@ class TestConfigureFlow:
 # -- gaudinet (ref gaudinet_test.go golden) -----------------------------------
 
 
+class TestVerifyConfigured:
+    """Idle-time degradation detection (continuous readiness)."""
+
+    def _configs(self, ops):
+        cfgs = {}
+        for name in ops.links:
+            c = net.NetworkConfiguration(link=ops.links[name])
+            cfgs[name] = c
+        return cfgs
+
+    def test_healthy_pass(self):
+        ops = FakeLinkOps()
+        ops.add_fake_link("ens9", 2, "aa:00:00:00:00:01", up=True)
+        cfgs = self._configs(ops)
+        assert net.verify_configured(cfgs, ops, l3=False) == []
+
+    def test_down_link_detected(self):
+        ops = FakeLinkOps()
+        ops.add_fake_link("ens9", 2, "aa:00:00:00:00:01", up=True)
+        ops.add_fake_link("ens10", 3, "aa:00:00:00:00:02", up=True)
+        cfgs = self._configs(ops)
+        ops.links["ens10"].flags &= ~1   # IFF_UP off behind the agent's back
+        assert net.verify_configured(cfgs, ops, l3=False) == ["ens10"]
+
+    def test_l3_missing_address_detected(self):
+        ops = FakeLinkOps()
+        link = ops.add_fake_link("ens9", 2, "aa:00:00:00:00:01", up=True)
+        cfgs = self._configs(ops)
+        cfgs["ens9"].local_addr = "10.1.0.1"
+        assert net.verify_configured(cfgs, ops, l3=True) == ["ens9"]
+        ops.addr_add(link, "10.1.0.1/30")
+        assert net.verify_configured(cfgs, ops, l3=True) == []
+
+    def test_vanished_link_detected(self):
+        ops = FakeLinkOps()
+        ops.add_fake_link("ens9", 2, "aa:00:00:00:00:01", up=True)
+        cfgs = self._configs(ops)
+        del ops.links["ens9"]
+        assert net.verify_configured(cfgs, ops, l3=False) == ["ens9"]
+
+
 class TestGaudinet:
     def make_configs(self):
         ops = FakeLinkOps()

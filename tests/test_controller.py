@@ -354,6 +354,35 @@ class TestStatusMachine:
         assert cr["status"]["state"] == "All good"
         assert cr["status"]["ready"] == 1
 
+    def test_stale_heartbeat_ages_out_ok_report(self, env):
+        """An ok report whose Lease renewTime is older than the TTL means
+        the agent wedged — the node must age out of All good."""
+        fake, mgr = env
+        fake.add_node(
+            "node-0", {"intel.feature.node.kubernetes.io/gaudi": "true"}
+        )
+        fake.create(gaudi_cr().to_dict())
+        reconcile(fake, mgr, "gaudi-l3")
+        fake.simulate_daemonset_controller()
+        _agent_report(fake, "node-0")
+        reconcile(fake, mgr, "gaudi-l3")
+        assert fake.get(API_VERSION, "NetworkClusterPolicy", "gaudi-l3")[
+            "status"]["state"] == "All good"
+
+        # age the heartbeat past the TTL
+        lease = fake.get(
+            "coordination.k8s.io/v1", "Lease",
+            "tpunet-agent-node-0", NAMESPACE,
+        )
+        lease["spec"]["renewTime"] = "2020-01-01T00:00:00.000000Z"
+        fake.update(lease)
+        reconcile(fake, mgr, "gaudi-l3")
+        cr = fake.get(API_VERSION, "NetworkClusterPolicy", "gaudi-l3")
+        assert cr["status"]["state"] == "Working on it.."
+        assert cr["status"]["errors"] == [
+            "node-0: report stale (agent heartbeat lost)"
+        ]
+
     def test_failure_report_flips_all_good_back(self, env):
         """An induced per-node failure (e.g. a NIC lost its LLDP peer on
         re-provision) demotes the CR from "All good" and surfaces the

@@ -271,6 +271,43 @@ def test_manager_counts_reconciles():
     assert 'policy="p1"' not in metrics.render()
 
 
+def test_manager_periodic_resync_requeues():
+    """Time-based staleness (report heartbeats) produces no watch event;
+    the resync loop must re-enqueue every policy on its own."""
+    import time
+
+    cluster = FakeCluster()
+    cluster.create(make_policy())
+    mgr = Manager(cluster, namespace="ns", resync_interval=0.1)
+    mgr.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            ds = cluster.list("apps/v1", "DaemonSet", namespace="ns")
+            if ds:
+                break
+            time.sleep(0.05)
+        assert cluster.list("apps/v1", "DaemonSet", namespace="ns")
+        # the DS exists; now delete it behind the manager's back — only
+        # the resync (no CR watch event fires) can recreate it... but DS
+        # deletion DOES fire the owned-DaemonSet watch; so instead prove
+        # resync by counting repeated reconciles of an unchanged CR
+        before = time.time()
+        seen = []
+        orig = mgr.reconciler.reconcile
+
+        def spy(name):
+            seen.append(time.time())
+            return orig(name)
+
+        mgr.reconciler.reconcile = spy
+        time.sleep(0.5)
+        assert len(seen) >= 2, "resync did not re-enqueue an unchanged CR"
+        assert seen[-1] > before
+    finally:
+        mgr.stop()
+
+
 # -- leader election ----------------------------------------------------------
 
 

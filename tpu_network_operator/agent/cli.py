@@ -63,6 +63,9 @@ class CmdConfig:
     # de-provision drain: how long to wait for an active job to release
     # the bootstrap lock before withdrawing routes/links
     drain_timeout: float = 30.0
+    # idle-time data-plane recheck cadence (continuous readiness):
+    # degraded links retract the label/report, recovery restores them
+    recheck_interval: float = 60.0
     # seams
     ops: nl.LinkOps = field(default_factory=nl.LinkOps)
     # host-root override for the NFD features dir; env-settable so a
@@ -148,22 +151,48 @@ def _wait_for_drain(config: CmdConfig) -> None:
     )
 
 
+_CLIENT_CACHE: Dict[str, object] = {}
+
+
 def _kube_client():
     """Cluster client for readiness reporting: explicit URL (test seam /
     non-standard deployments) or in-cluster SA config; None when neither
     is available (reporting silently off — the NFD label remains the
-    node-local signal)."""
+    node-local signal).  Cached per target so the 60s heartbeat does not
+    rebuild TLS contexts / re-read SA tokens every tick."""
     from ..kube.client import ApiClient
 
     url = os.environ.get("TPUNET_KUBE_URL", "")
+    key = url or os.environ.get("KUBERNETES_SERVICE_HOST", "")
+    if key in _CLIENT_CACHE:
+        return _CLIENT_CACHE[key]
     if url:
-        return ApiClient(
+        client = ApiClient(
             url, token=os.environ.get("TPUNET_KUBE_TOKEN") or None
         )
-    try:
-        return ApiClient.in_cluster()
-    except Exception:   # noqa: BLE001 — not in a cluster
+    else:
+        try:
+            client = ApiClient.in_cluster()
+        except Exception:   # noqa: BLE001 — not in a cluster
+            client = None
+    _CLIENT_CACHE[key] = client
+    return client
+
+
+def _report_ctx(config: CmdConfig):
+    """(node, client) when readiness reporting is configured and a
+    cluster is reachable; None otherwise.  The single preamble for
+    publish/renew/retract."""
+    if not config.report_namespace:
         return None
+    node = os.environ.get("NODE_NAME", "")
+    if not node:
+        log.debug("NODE_NAME unset; cluster reporting off")
+        return None
+    client = _kube_client()
+    if client is None:
+        return None
+    return node, client
 
 
 def _publish_report(
@@ -172,16 +201,10 @@ def _publish_report(
     coordinator: str,
 ) -> None:
     """Write the per-node provisioning report Lease (VERDICT r3 #3)."""
-    if not config.report_namespace:
+    ctx = _report_ctx(config)
+    if ctx is None:
         return
-    node = os.environ.get("NODE_NAME", "")
-    if not node:
-        log.warning("NODE_NAME unset; cannot write provisioning report")
-        return
-    client = _kube_client()
-    if client is None:
-        log.warning("no cluster access; provisioning report skipped")
-        return
+    node, client = ctx
     from . import report as rpt
 
     rep = rpt.report_from_result(
@@ -200,12 +223,10 @@ def _publish_failure_report(config: CmdConfig, error: str) -> None:
     """ok=False report on a hard provisioning failure: the reconciler
     shows the node's error in status.errors instead of an opaque
     'Working on it..' while the DaemonSet restarts the pod."""
-    if not config.report_namespace:
+    ctx = _report_ctx(config)
+    if ctx is None:
         return
-    node = os.environ.get("NODE_NAME", "")
-    client = _kube_client() if node else None
-    if client is None:
-        return
+    node, client = ctx
     from . import report as rpt
 
     rpt.write_report(
@@ -222,13 +243,22 @@ def _publish_failure_report(config: CmdConfig, error: str) -> None:
     )
 
 
+def _renew_report(config: CmdConfig) -> None:
+    """Heartbeat the report Lease's renewTime (healthy idle pass)."""
+    ctx = _report_ctx(config)
+    if ctx is None:
+        return
+    node, client = ctx
+    from . import report as rpt
+
+    rpt.renew_report(client, config.report_namespace, node)
+
+
 def _retract_report(config: CmdConfig) -> None:
-    if not config.report_namespace:
+    ctx = _report_ctx(config)
+    if ctx is None:
         return
-    node = os.environ.get("NODE_NAME", "")
-    client = _kube_client() if node else None
-    if client is None:
-        return
+    node, client = ctx
     from . import report as rpt
 
     rpt.delete_report(client, config.report_namespace, node)
@@ -446,7 +476,7 @@ def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
             if nfd.write_readiness_label(ready_label, root=config.nfd_root):
                 log.info("wrote NFD readiness label")
             if wait_signal:
-                _block_until_signal()
+                _idle_monitor(config, configs, coordinator, ready_label)
             post_cleanups(config, configs)
         return 0
     except (
@@ -463,12 +493,45 @@ def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
         return 1
 
 
-def _block_until_signal() -> None:
-    """ref main.go:252-255 (idle steady state)."""
+def _idle_monitor(
+    config: CmdConfig,
+    configs: Dict[str, net.NetworkConfiguration],
+    coordinator: str,
+    ready_label: str,
+) -> None:
+    """The idle steady state (ref main.go:252-255) upgraded to continuous
+    readiness: every ``recheck_interval`` the agent re-verifies the data
+    plane.  Degradation (link down / L3 address gone) retracts the NFD
+    label and publishes an ok=False report — a broken node must stop
+    advertising readiness long before its pod dies; recovery restores
+    both.  Healthy passes refresh the report Lease's renewTime so the
+    reconciler can age out reports from wedged agents."""
     ev = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: ev.set())
-    ev.wait()
+
+    last_bad: List[str] = []
+    while not ev.wait(config.recheck_interval):
+        bad = net.verify_configured(configs, config.ops, config.mode == L3)
+        if bad != last_bad:
+            # degradation set CHANGED (including nonempty → different
+            # nonempty: the report must name the currently-broken
+            # interfaces, not the first ones that broke)
+            if bad:
+                log.warning(
+                    "data plane degraded: %s — retracting readiness", bad
+                )
+                nfd.remove_readiness_label(root=config.nfd_root)
+                _publish_failure_report(
+                    config, "interfaces degraded: " + ",".join(bad)
+                )
+            else:
+                log.info("data plane recovered — restoring readiness")
+                _publish_report(config, configs, coordinator)
+                nfd.write_readiness_label(ready_label, root=config.nfd_root)
+        elif not bad:
+            _renew_report(config)
+        last_bad = bad
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -504,6 +567,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--drain-timeout", default="30s",
                    help="max wait for an active job to release the "
                         "bootstrap lock before teardown (e.g. 45s)")
+    p.add_argument("--recheck-interval", default="60s",
+                   help="idle data-plane health recheck cadence")
     return p
 
 
@@ -564,6 +629,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         report_namespace=args.report_namespace,
         policy_name=args.policy_name,
         drain_timeout=parse_wait(args.drain_timeout),
+        recheck_interval=parse_wait(args.recheck_interval),
     )
     try:
         return cmd_run(config)

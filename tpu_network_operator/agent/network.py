@@ -272,6 +272,33 @@ def configure_interfaces(
     return configured, len(configs)
 
 
+def verify_configured(
+    configs: Dict[str, NetworkConfiguration], ops: nl.LinkOps, l3: bool
+) -> List[str]:
+    """Idle-time health check: which provisioned interfaces have silently
+    degraded (link gone/down, or an L3 node's /30 disappeared)?  Refreshes
+    each config's link view so callers see current state."""
+    bad: List[str] = []
+    for name, cfg in configs.items():
+        try:
+            cfg.link = ops.link_by_name(name)
+        except nl.NetlinkError:
+            bad.append(name)
+            continue
+        if not cfg.link.is_up:
+            bad.append(name)
+            continue
+        if l3 and cfg.local_addr is not None:
+            try:
+                addrs = ops.addr_list(cfg.link.index)
+            except nl.NetlinkError:
+                bad.append(name)
+                continue
+            if not any(a.address == cfg.local_addr for a in addrs):
+                bad.append(name)
+    return sorted(bad)
+
+
 def usable_interfaces(
     configs: Dict[str, NetworkConfiguration], l3: bool
 ) -> List[str]:

@@ -113,6 +113,32 @@ def lease_name(node: str) -> str:
     return f"tpunet-agent-{node}"
 
 
+def _now_micro() -> str:
+    """Kubernetes MicroTime format (Lease spec.renewTime)."""
+    import time
+
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000000Z", time.gmtime())
+
+
+def parse_micro_time(s: str) -> Optional[float]:
+    """MicroTime/RFC3339 → epoch seconds; None when absent/unparseable
+    (a report without a heartbeat is accepted — age cannot be judged).
+    Handles both '…T00:00:00.000000Z' (MicroTime) and '…T00:00:00Z'
+    (plain RFC3339, e.g. written by Go clients or kubectl edit)."""
+    import calendar
+    import time
+
+    if not s:
+        return None
+    try:
+        base = s.split(".")[0].split("+")[0].rstrip("Zz")
+        return float(calendar.timegm(
+            time.strptime(base, "%Y-%m-%dT%H:%M:%S")
+        ))
+    except (ValueError, OverflowError):
+        return None
+
+
 def lease_for(report: ProvisioningReport, namespace: str) -> Dict:
     return {
         "apiVersion": LEASE_API,
@@ -126,8 +152,32 @@ def lease_for(report: ProvisioningReport, namespace: str) -> Dict:
             },
             "annotations": {REPORT_ANNOTATION: report.to_json()},
         },
-        "spec": {"holderIdentity": report.node},
+        "spec": {
+            "holderIdentity": report.node,
+            "renewTime": _now_micro(),
+        },
     }
+
+
+def renew_report(client, namespace: str, node: str) -> None:
+    """Heartbeat: bump the report Lease's renewTime without touching the
+    report body (the agent's healthy idle pass).
+
+    DISTINCT field manager from :func:`write_report`: under real
+    server-side-apply semantics, re-applying with the same manager but
+    without the labels/annotation would transfer ownership and DELETE
+    them — the reconciler's label-selector listing would lose the Lease
+    one heartbeat after provisioning.  A separate manager owns only
+    ``spec.renewTime``."""
+    try:
+        client.apply({
+            "apiVersion": LEASE_API,
+            "kind": "Lease",
+            "metadata": {"name": lease_name(node), "namespace": namespace},
+            "spec": {"renewTime": _now_micro()},
+        }, field_manager="tpunet-agent-heartbeat")
+    except Exception as e:   # noqa: BLE001 — heartbeat is advisory
+        log.debug("report renew failed: %s", e)
 
 
 def write_report(client, namespace: str, report: ProvisioningReport) -> bool:

@@ -23,11 +23,13 @@ log = logging.getLogger("tpunet.manager")
 
 class Manager:
     def __init__(
-        self, client, namespace: str, is_openshift: bool = False, metrics=None
+        self, client, namespace: str, is_openshift: bool = False,
+        metrics=None, resync_interval: float = 60.0,
     ):
         self.client = client
         self.namespace = namespace
         self.metrics = metrics
+        self.resync_interval = resync_interval
         self.reconciler = NetworkClusterPolicyReconciler(
             client, namespace, is_openshift, metrics=metrics
         )
@@ -137,10 +139,26 @@ class Manager:
         # seed: reconcile everything that already exists (informer initial list)
         for obj in self.client.list(API_VERSION, NetworkClusterPolicy.KIND):
             self.enqueue(obj["metadata"]["name"])
-        for fn in (self._watch_policies, self._watch_daemonsets, self._worker):
+        for fn in (self._watch_policies, self._watch_daemonsets,
+                   self._worker, self._resync_loop):
             th = threading.Thread(target=fn, daemon=True)
             th.start()
             self._threads.append(th)
+
+    def _resync_loop(self) -> None:
+        """Periodic full resync (controller-runtime SyncPeriod analog).
+        Time-based state changes — an agent report Lease whose heartbeat
+        went stale — produce no watch event, so without this the
+        reconciler's REPORT_TTL_SECONDS aging would never fire and a
+        wedged agent's node would stay "All good" forever."""
+        while not self._stop.wait(self.resync_interval):
+            try:
+                for obj in self.client.list(
+                    API_VERSION, NetworkClusterPolicy.KIND
+                ):
+                    self.enqueue(obj["metadata"]["name"])
+            except Exception as e:   # noqa: BLE001 — next tick retries
+                log.debug("resync list failed: %s", e)
 
     def stop(self) -> None:
         self._stop.set()

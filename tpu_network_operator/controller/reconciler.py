@@ -390,9 +390,18 @@ class NetworkClusterPolicyReconciler:
 
     # -- status ---------------------------------------------------------------
 
+    # reports older than this many seconds (by Lease renewTime — the
+    # agent heartbeats healthy passes) count as not-ready: a wedged or
+    # partitioned agent must age out of "All good" even while its stale
+    # ok report lingers.  3x the agent's default 60s recheck cadence.
+    REPORT_TTL_SECONDS = 180.0
+
     def _agent_reports(self, policy_name: str) -> List[Any]:
         """Per-node provisioning reports (Leases the agents apply,
-        agent/report.py).  Parse failures count as not-ready reports."""
+        agent/report.py).  Parse failures and stale heartbeats count as
+        not-ready reports."""
+        import time as time_mod
+
         from ..agent import report as rpt
 
         try:
@@ -410,16 +419,31 @@ class NetworkClusterPolicyReconciler:
             return []
         out = []
         for lease in leases:
+            node = lease.get("spec", {}).get("holderIdentity", "?")
             raw = (
                 lease.get("metadata", {}).get("annotations", {}) or {}
             ).get(rpt.REPORT_ANNOTATION, "")
             try:
-                out.append(rpt.ProvisioningReport.from_json(raw))
+                rep = rpt.ProvisioningReport.from_json(raw)
             except Exception:   # noqa: BLE001 — malformed = not ready
-                node = lease.get("spec", {}).get("holderIdentity", "?")
                 out.append(rpt.ProvisioningReport(
                     node=node, ok=False, error="unparseable report"
                 ))
+                continue
+            renewed = rpt.parse_micro_time(
+                str(lease.get("spec", {}).get("renewTime", "") or "")
+            )
+            if (
+                rep.ok
+                and renewed is not None
+                and time_mod.time() - renewed > self.REPORT_TTL_SECONDS
+            ):
+                out.append(rpt.ProvisioningReport(
+                    node=rep.node, policy=rep.policy, ok=False,
+                    error="report stale (agent heartbeat lost)",
+                ))
+                continue
+            out.append(rep)
         return out
 
     def _target_nodes(self, ds: Dict[str, Any]) -> set:
