@@ -46,7 +46,10 @@ def flip_link(host, name, up):
     for link in state["links"]:
         if link["name"] == name:
             link["up"] = up
-    host.state_file.write_text(json.dumps(state))
+    # atomic: the agent's FileLinkOps may read concurrently
+    tmp = host.state_file.with_suffix(".flip-tmp")
+    tmp.write_text(json.dumps(state))
+    tmp.replace(host.state_file)
 
 
 def get_report(client):

@@ -62,8 +62,11 @@ class FileLinkOps(FakeLinkOps):
             return
         if m == self._mtime:
             return
-        with open(self.path) as f:
-            state = json.load(f)
+        try:
+            with open(self.path) as f:
+                state = json.load(f)
+        except (OSError, ValueError):
+            return   # writer mid-flight: keep current view, retry next call
         self._load_links(state)
         self._mtime = m
 

@@ -79,6 +79,33 @@ class TestForward:
             full_grads, ck_grads,
         )
 
+    def test_chunked_xent_with_sequence_parallelism(self, tiny):
+        """Long-context combination: ring attention over the seq axis AND
+        chunked cross-entropy — the chunk reshape crosses the sharded seq
+        dim, so pin that GSPMD handles it and the loss matches the
+        full-logits seq-parallel path."""
+        import dataclasses
+
+        from tpu_network_operator.parallel.ring import make_ring_attn_fn
+
+        plan = plan_axes(8, seq=4)
+        mesh = make_mesh(plan)
+        toks = jax.random.randint(
+            jax.random.key(5), (4, 65), 0, tiny.vocab_size, jnp.int32
+        )
+        losses = {}
+        for chunk in (16, 0):
+            cfg = dataclasses.replace(
+                tiny, seq_parallel=True, xent_chunk=chunk
+            )
+            step, init_all, _ = make_train_step(
+                cfg, mesh, attn_fn=make_ring_attn_fn(mesh)
+            )
+            params, opt = init_all(jax.random.key(0))
+            _, _, loss = step(params, opt, toks)
+            losses[chunk] = float(loss)
+        np.testing.assert_allclose(losses[16], losses[0], rtol=1e-3)
+
     def test_chunked_xent_rejects_indivisible(self, tiny, tiny_params):
         import dataclasses
 

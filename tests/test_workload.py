@@ -36,6 +36,15 @@ def test_train_llama_tiny(capsys):
     assert 0 < r["final_loss"] < 8
 
 
+def test_train_llama_adam8bit(capsys):
+    r = run(capsys, [
+        "train", "--preset", "tiny", "--steps", "2", "--batch", "8",
+        "--seq-len", "32", "--optimizer", "adam8bit",
+    ])
+    assert r["value"] > 0
+    assert 0 < r["final_loss"] < 8
+
+
 def test_train_pipeline(capsys):
     r = run(capsys, [
         "train", "--preset", "tiny", "--steps", "2", "--batch", "8",

@@ -173,8 +173,11 @@ def _kube_client():
     else:
         try:
             client = ApiClient.in_cluster()
-        except Exception:   # noqa: BLE001 — not in a cluster
-            client = None
+        except Exception as e:   # noqa: BLE001 — not in a cluster (yet)
+            # do NOT negatively cache: the SA token may simply not be
+            # mounted yet; the next publish/heartbeat retries
+            log.warning("no cluster access for reporting (will retry): %s", e)
+            return None
     _CLIENT_CACHE[key] = client
     return client
 
@@ -199,11 +202,12 @@ def _publish_report(
     config: CmdConfig,
     configs: Dict[str, net.NetworkConfiguration],
     coordinator: str,
-) -> None:
-    """Write the per-node provisioning report Lease (VERDICT r3 #3)."""
+) -> bool:
+    """Write the per-node provisioning report Lease (VERDICT r3 #3).
+    True when it landed (or reporting is off: nothing to sync)."""
     ctx = _report_ctx(config)
     if ctx is None:
-        return
+        return not config.report_namespace
     node, client = ctx
     from . import report as rpt
 
@@ -216,20 +220,20 @@ def _publish_report(
         bootstrap_path=config.bootstrap,
         coordinator=coordinator,
     )
-    rpt.write_report(client, config.report_namespace, rep)
+    return rpt.write_report(client, config.report_namespace, rep)
 
 
-def _publish_failure_report(config: CmdConfig, error: str) -> None:
+def _publish_failure_report(config: CmdConfig, error: str) -> bool:
     """ok=False report on a hard provisioning failure: the reconciler
     shows the node's error in status.errors instead of an opaque
     'Working on it..' while the DaemonSet restarts the pod."""
     ctx = _report_ctx(config)
     if ctx is None:
-        return
+        return not config.report_namespace
     node, client = ctx
     from . import report as rpt
 
-    rpt.write_report(
+    return rpt.write_report(
         client,
         config.report_namespace,
         rpt.ProvisioningReport(
@@ -511,27 +515,52 @@ def _idle_monitor(
         signal.signal(sig, lambda *_: ev.set())
 
     last_bad: List[str] = []
+    report_synced = True   # the provisioning pass just published
     while not ev.wait(config.recheck_interval):
-        bad = net.verify_configured(configs, config.ops, config.mode == L3)
-        if bad != last_bad:
-            # degradation set CHANGED (including nonempty → different
-            # nonempty: the report must name the currently-broken
-            # interfaces, not the first ones that broke)
-            if bad:
-                log.warning(
-                    "data plane degraded: %s — retracting readiness", bad
+        # one transient error (netlink hiccup, API blip) must not kill
+        # the agent: a crashed monitor skips post_cleanups and leaves the
+        # node advertising readiness with nobody watching it
+        try:
+            bad = net.verify_configured(
+                configs, config.ops, config.mode == L3
+            )
+            if bad != last_bad:
+                # degradation set CHANGED (including nonempty →
+                # different nonempty: the report must name the
+                # currently-broken interfaces, not the first that broke)
+                if bad:
+                    log.warning(
+                        "data plane degraded: %s — retracting readiness",
+                        bad,
+                    )
+                    nfd.remove_readiness_label(root=config.nfd_root)
+                    report_synced = _publish_failure_report(
+                        config, "interfaces degraded: " + ",".join(bad)
+                    )
+                else:
+                    log.info("data plane recovered — restoring readiness")
+                    report_synced = _publish_report(
+                        config, configs, coordinator
+                    )
+                    nfd.write_readiness_label(
+                        ready_label, root=config.nfd_root
+                    )
+            elif not report_synced:
+                # the last transition's publish failed: retry until the
+                # cluster-visible report matches reality (renewing a
+                # stale body would keep the WRONG report fresh forever)
+                report_synced = (
+                    _publish_report(config, configs, coordinator)
+                    if not bad
+                    else _publish_failure_report(
+                        config, "interfaces degraded: " + ",".join(bad)
+                    )
                 )
-                nfd.remove_readiness_label(root=config.nfd_root)
-                _publish_failure_report(
-                    config, "interfaces degraded: " + ",".join(bad)
-                )
-            else:
-                log.info("data plane recovered — restoring readiness")
-                _publish_report(config, configs, coordinator)
-                nfd.write_readiness_label(ready_label, root=config.nfd_root)
-        elif not bad:
-            _renew_report(config)
-        last_bad = bad
+            elif not bad:
+                _renew_report(config)
+            last_bad = bad
+        except Exception as e:   # noqa: BLE001 — stay alive, retry next tick
+            log.warning("idle recheck failed (will retry): %s", e)
 
 
 def build_parser() -> argparse.ArgumentParser:

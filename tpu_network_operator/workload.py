@@ -197,16 +197,27 @@ def cmd_train(args) -> int:
     mesh = _build_mesh(args, bootstrap)
     n = mesh.size
 
+    optimizer = None
+    if args.optimizer == "adam8bit":
+        # int8/f8-moment AdamW: halves optimizer HBM (models/optim8bit)
+        from .models.optim8bit import adamw8bit
+
+        optimizer = adamw8bit(3e-4, weight_decay=0.1)
+
     if args.model == "moe":
         from .models.moe import make_train_step
 
         cfg = _pick_preset(_moe_presets(), args.preset, "moe")
-        step, init_all, _ = make_train_step(cfg, mesh)
+        step, init_all, _ = make_train_step(cfg, mesh, optimizer=optimizer)
     else:
         from .models.llama import make_train_step
 
         cfg = _pick_preset(_llama_presets(), args.preset, "llama")
         if args.pipe > 1:
+            if optimizer is not None:
+                raise SystemExit(
+                    "--optimizer adam8bit is not supported with --pipe yet"
+                )
             from .parallel import make_pipeline_train_step
 
             step, init_all, _ = make_pipeline_train_step(
@@ -218,7 +229,9 @@ def cmd_train(args) -> int:
                 from .parallel.ring import make_ring_attn_fn
 
                 attn_fn = make_ring_attn_fn(mesh)
-            step, init_all, _ = make_train_step(cfg, mesh, attn_fn=attn_fn)
+            step, init_all, _ = make_train_step(
+                cfg, mesh, optimizer=optimizer, attn_fn=attn_fn
+            )
 
     start_step = 0
     ckpt = None
@@ -383,6 +396,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="memmapped token file (uint16/uint32); default: "
                         "synthetic fixed batch")
     t.add_argument("--microbatches", type=int, default=4)
+    t.add_argument("--optimizer", choices=["adamw", "adam8bit"],
+                   default="adamw",
+                   help="adam8bit: int8/f8 moment storage, half the "
+                        "optimizer HBM (models/optim8bit)")
     t.add_argument("--checkpoint-dir", default=None)
     t.add_argument("--checkpoint-every", type=int, default=0)
     t.add_argument("--keep-checkpoints", type=int, default=3)
