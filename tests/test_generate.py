@@ -139,6 +139,29 @@ class TestCheckpoint:
             _, _, lb = step(p2, o2, toks)
             assert abs(float(la) - float(lb)) < 1e-6
 
+    def test_save_restore_adam8bit_state(self, tiny, tmp_path):
+        """The quantized optimizer's _QTensor pytrees (int8 + float8
+        leaves) must round-trip through orbax and resume identically."""
+        from tpu_network_operator.models.optim8bit import adamw8bit
+
+        mesh = make_mesh(plan_axes(8, tensor=2))
+        step, init_all, _ = make_train_step(
+            tiny, mesh, optimizer=adamw8bit(3e-3, weight_decay=0.1)
+        )
+        params, opt = init_all(jax.random.key(0))
+        toks = jax.random.randint(
+            jax.random.key(1), (8, 33), 0, tiny.vocab_size
+        )
+        params, opt, _ = step(params, opt, toks)
+        with TrainCheckpointer(str(tmp_path), async_save=True) as ck:
+            assert ck.save(1, params, opt)
+            ck.wait()
+            s, p2, o2 = ck.restore((params, opt))
+            assert s == 1
+            _, _, la = step(params, opt, toks)
+            _, _, lb = step(p2, o2, toks)
+            assert abs(float(la) - float(lb)) < 1e-6
+
     def test_restore_missing_raises(self, tmp_path):
         with TrainCheckpointer(str(tmp_path)) as ck:
             with pytest.raises(FileNotFoundError):

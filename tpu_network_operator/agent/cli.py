@@ -476,11 +476,14 @@ def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
         if config.keep_running:
             # report first, then label: the cluster-visible record of WHAT
             # was provisioned precedes the schedulability signal
-            _publish_report(config, configs, coordinator)
+            synced = _publish_report(config, configs, coordinator)
             if nfd.write_readiness_label(ready_label, root=config.nfd_root):
                 log.info("wrote NFD readiness label")
             if wait_signal:
-                _idle_monitor(config, configs, coordinator, ready_label)
+                _idle_monitor(
+                    config, configs, coordinator, ready_label,
+                    initial_synced=synced,
+                )
             post_cleanups(config, configs)
         return 0
     except (
@@ -502,6 +505,7 @@ def _idle_monitor(
     configs: Dict[str, net.NetworkConfiguration],
     coordinator: str,
     ready_label: str,
+    initial_synced: bool = True,
 ) -> None:
     """The idle steady state (ref main.go:252-255) upgraded to continuous
     readiness: every ``recheck_interval`` the agent re-verifies the data
@@ -515,7 +519,10 @@ def _idle_monitor(
         signal.signal(sig, lambda *_: ev.set())
 
     last_bad: List[str] = []
-    report_synced = True   # the provisioning pass just published
+    # whether the provisioning pass's publish landed — a failed initial
+    # publish must be retried here, not heartbeat-renewed into a bare
+    # Lease the reconciler can never see
+    report_synced = initial_synced
     while not ev.wait(config.recheck_interval):
         # one transient error (netlink hiccup, API blip) must not kill
         # the agent: a crashed monitor skips post_cleanups and leaves the

@@ -202,7 +202,7 @@ def cmd_train(args) -> int:
         # int8/f8-moment AdamW: halves optimizer HBM (models/optim8bit)
         from .models.optim8bit import adamw8bit
 
-        optimizer = adamw8bit(3e-4, weight_decay=0.1)
+        optimizer = adamw8bit()   # library defaults mirror adamw's
 
     if args.model == "moe":
         from .models.moe import make_train_step
