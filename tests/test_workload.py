@@ -124,8 +124,20 @@ def test_train_from_bootstrap_file(capsys, tmp_path):
 def test_train_rejects_dead_axes():
     with pytest.raises(SystemExit, match="expert requires"):
         main(["train", "--preset", "tiny", "--expert", "2"])
+    # --pipe composes with moe now (make_moe_pipeline_train_step); only
+    # the ring-attention path remains llama-only
     with pytest.raises(SystemExit, match="not supported with --model moe"):
-        main(["train", "--model", "moe", "--preset", "tiny", "--pipe", "2"])
+        main(["train", "--model", "moe", "--preset", "tiny", "--seq", "2"])
+
+
+def test_train_moe_pipeline(capsys):
+    r = run(capsys, [
+        "train", "--model", "moe", "--preset", "tiny", "--steps", "2",
+        "--batch", "8", "--seq-len", "32", "--pipe", "2", "--expert", "2",
+    ])
+    assert r["value"] > 0
+    assert r["mesh"]["pipe"] == 2 and r["mesh"]["expert"] == 2
+    assert 0 < r["final_loss"] < 8
 
 
 def test_train_rejects_unknown_preset():

@@ -380,27 +380,17 @@ def sharded_flash_attention(mesh, *, block_q: int = 512, block_k: int = 512,
     ``tensor`` factor). The ``seq`` axis must be unsharded here — sequence
     sharding is the ring path's job.
     """
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    import inspect
-
-    try:
-        from jax import shard_map as _shard_map
-    except ImportError:                                   # jax < 0.8
-        from jax.experimental.shard_map import shard_map as _shard_map
-
     qspec = P(("data", "fsdp"), None, "tensor", None)
-    # replication checking can't see through a pallas custom call; the
-    # flag was renamed check_rep -> check_vma across jax versions
-    flag = (
-        "check_vma"
-        if "check_vma" in inspect.signature(_shard_map).parameters
-        else "check_rep"
-    )
 
+    # check_vma=False: replication checking can't see through a pallas
+    # custom call.  jax>=0.8 API (pyproject pins it — same floor as
+    # parallel/collectives and parallel/ring)
     @functools.partial(
-        _shard_map, mesh=mesh, in_specs=(qspec, qspec, qspec),
-        out_specs=qspec, **{flag: False},
+        shard_map, mesh=mesh, in_specs=(qspec, qspec, qspec),
+        out_specs=qspec, check_vma=False,
     )
     def attn(q, k, v):
         return flash_attention(

@@ -19,6 +19,7 @@ from .mesh import (  # noqa: F401
     plan_axes,
 )
 from .pipeline import (  # noqa: F401
+    make_moe_pipeline_train_step,
     make_pipeline_train_step,
     pipeline_apply,
 )

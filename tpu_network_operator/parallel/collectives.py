@@ -19,7 +19,7 @@ from typing import Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 
 @dataclass
@@ -91,7 +91,7 @@ def _collective_fn(op: str, axis: str, mesh: Mesh):
     return jax.jit(
         shard_map(
             body, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
-            check_rep=False,
+            check_vma=False,
         )
     )
 

@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def _stage_kernel(
-    layer_fn: Callable,            # (x [b,s,h], lp_local) -> x'
+    layer_fn: Callable,            # (x [b,s,h], lp_local) -> (x', aux)
     n_micro: int,
     layers_local,                  # pytree, leaves [L/S, ...]
     xmb,                           # [M, b, s, h] microbatched activations
@@ -42,6 +42,12 @@ def _stage_kernel(
     interior stages transform what arrives from the left, the last stage
     banks results.  The final psum-mask broadcast makes the output
     genuinely pipe-replicated, which is what ``out_specs=P()`` asserts.
+
+    ``layer_fn`` returns (x', aux_scalar); per-layer aux is accumulated
+    only for VALID ticks (during fill/drain a stage chews zero-state
+    garbage whose aux must not contaminate the loss) and psum-reduced
+    over stages at the end.  Dense models wrap their layer with a zero
+    aux (see pipeline_apply).
     """
     rank = jax.lax.axis_index("pipe")
     n = jax.lax.axis_size("pipe")
@@ -51,18 +57,29 @@ def _stage_kernel(
     xmb = xmb.astype(jax.tree.leaves(layers_local)[0].dtype)
 
     def local_stack(x):
-        def body(x, lp):
-            return layer_fn(x, lp), None
-        x, _ = jax.lax.scan(body, x, layers_local)
-        return x
+        def body(carry, lp):
+            x, aux = carry
+            x, a = layer_fn(x, lp)
+            return (x, aux + a.astype(jnp.float32)), None
+        (x, aux), _ = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), layers_local
+        )
+        return x, aux
 
     outputs = jnp.zeros_like(xmb)
     state = jnp.zeros_like(xmb[0])
+    aux_total = jnp.zeros((), jnp.float32)
 
     def tick(carry, t):
-        state, outputs = carry
+        state, outputs, aux_total = carry
         inp = jnp.where(rank == 0, xmb[jnp.minimum(t, n_micro - 1)], state)
-        out = local_stack(inp)
+        out, aux = local_stack(inp)
+        # this stage processed microbatch (t - rank); outside [0, M) the
+        # input was fill/drain garbage
+        mb = t - rank
+        aux_total = aux_total + jnp.where(
+            (mb >= 0) & (mb < n_micro), aux, 0.0
+        )
         idx = t - (n - 1)
         banked = jax.lax.dynamic_update_slice(
             outputs, out[None].astype(outputs.dtype),
@@ -72,16 +89,21 @@ def _stage_kernel(
         state = jax.lax.ppermute(
             out, "pipe", [(i, (i + 1) % n) for i in range(n)]
         )
-        return (state, outputs), None
+        return (state, outputs, aux_total), None
 
-    (state, outputs), _ = jax.lax.scan(
-        tick, (state, outputs), jnp.arange(ticks)
+    (state, outputs, aux_total), _ = jax.lax.scan(
+        tick, (state, outputs, aux_total), jnp.arange(ticks)
     )
     # broadcast from the last stage; psum in f32 — XLA's CPU backend
     # aborts on sub-byte/bf16 all-reduce in manual-subset shard_map, and
     # on TPU the f32 upcast of one activation tensor is noise
     banked = jnp.where(rank == n - 1, outputs, 0).astype(jnp.float32)
-    return jax.lax.psum(banked, "pipe").astype(outputs.dtype)
+    out = jax.lax.psum(banked, "pipe").astype(outputs.dtype)
+    # mean over (layers x microbatches): every stage contributed its
+    # local-layer sums for its M valid ticks
+    L_total = jax.tree.leaves(layers_local)[0].shape[0] * n
+    aux_mean = jax.lax.psum(aux_total, "pipe") / (L_total * n_micro)
+    return out, aux_mean
 
 
 def pipeline_apply(
@@ -90,12 +112,14 @@ def pipeline_apply(
     x: jnp.ndarray,                # [B, s, h]
     mesh: Mesh,
     n_microbatches: int,
+    with_aux: bool = False,
 ):
     """Run x through the layer stack pipelined over ``mesh``'s pipe axis.
 
     Callable inside jit.  ``layers_params`` leaves must be sharded
     ``P("pipe", ...)`` on the leading (layer) axis; batch B must divide by
-    ``n_microbatches``.
+    ``n_microbatches``.  With ``with_aux`` the layer returns (x, aux) and
+    the call returns (out, aux_mean) — the MoE router-loss path.
     """
     n_stages = mesh.shape["pipe"]
     b = x.shape[0]
@@ -107,6 +131,12 @@ def pipeline_apply(
     if L % n_stages:
         raise ValueError(f"layers {L} not divisible by stages {n_stages}")
 
+    if with_aux:
+        aux_fn = layer_fn
+    else:
+        def aux_fn(x, lp):
+            return layer_fn(x, lp), jnp.zeros((), jnp.float32)
+
     # the boundary crossing is f32: xmb enters pipe-replicated (in_spec
     # P()), so its transpose under AD is a psum over `pipe` — which XLA's
     # CPU backend aborts on for bf16 (same bug as the output broadcast);
@@ -115,15 +145,86 @@ def pipeline_apply(
     xmb = x.reshape(
         (n_microbatches, b // n_microbatches) + x.shape[1:]
     ).astype(jnp.float32)
-    out = jax.shard_map(
-        partial(_stage_kernel, layer_fn, n_microbatches),
+    out, aux = jax.shard_map(
+        partial(_stage_kernel, aux_fn, n_microbatches),
         mesh=mesh,
         axis_names={"pipe"},
         in_specs=(P("pipe"), P()),
-        out_specs=P(),
+        out_specs=(P(), P()),
         check_vma=False,
     )(layers_params, xmb)
-    return out.reshape(x.shape)
+    out = out.reshape(x.shape)
+    return (out, aux) if with_aux else out
+
+
+def _make_pipelined_step(
+    cfg,
+    mesh: Mesh,
+    n_microbatches: int,
+    optimizer,
+    attn_fn: Optional[Callable],
+    param_specs_fn: Callable,      # cfg -> PartitionSpec pytree
+    init_fn: Callable,             # key -> params
+    make_block: Callable,          # (cos, sin, attn_fn) -> (x, lp) -> out
+    with_aux: bool,
+    aux_weight: float,
+):
+    """Shared pipeline train-step builder: ONE copy of the policy both
+    model families must agree on — the pipe-remap of the stacked-layer
+    specs, the token/replicated shardings, the f32 boundary rule (inside
+    pipeline_apply), remat wiring, and the loss assembly."""
+    from ..models.training import make_sharded_train_step, next_token_xent
+    from ..ops.attention import causal_attention
+    from ..ops.norms import rms_norm
+    from ..ops.rope import rope_angles
+
+    # plain fused XLA attention by default: the block runs inside a
+    # manual-over-pipe shard_map region, where the mesh-aware flash paths
+    # (auto_attention with a mesh → sharded_flash_attention's own
+    # shard_map; without one → an unsharded pallas_call GSPMD would
+    # replicate) are both wrong.  GSPMD partitions the fused attention
+    # over the auto batch/tensor axes correctly.
+    attn_fn = attn_fn or causal_attention
+
+    # model specs, with the stacked-layer axis pipe-sharded
+    specs = param_specs_fn(cfg)
+    specs["layers"] = jax.tree.map(
+        lambda s: P(*(("pipe",) + tuple(s)[1:])),
+        specs["layers"],
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    p_shard = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    tok_shard = NamedSharding(mesh, P(("data", "fsdp"), None))
+    repl = NamedSharding(mesh, P())
+
+    def fwd(params, tokens):
+        x = params["embed"][tokens].astype(cfg.dtype)
+        cos, sin = rope_angles(
+            tokens.shape[1], cfg.head_dim, cfg.rope_theta
+        )
+        block = make_block(cos, sin, attn_fn)
+        if cfg.remat:
+            from ..models.training import remat_policy
+
+            block = jax.checkpoint(block, policy=remat_policy(cfg))
+        out = pipeline_apply(
+            block, params["layers"], x, mesh, n_microbatches,
+            with_aux=with_aux,
+        )
+        x, aux = out if with_aux else (out, 0.0)
+        x = rms_norm(x, params["ln_final"], cfg.rms_eps)
+        return (x @ params["lm_head"]).astype(jnp.float32), aux
+
+    def loss_fn(params, tokens):
+        logits, aux = fwd(params, tokens[:, :-1])
+        return next_token_xent(logits, tokens) + aux_weight * aux
+
+    return make_sharded_train_step(
+        loss_fn, init_fn, p_shard, tok_shard, repl, optimizer,
+    )
 
 
 def make_pipeline_train_step(
@@ -142,61 +243,44 @@ def make_pipeline_train_step(
     (batch) and tensor (head/ffn) axes, which remain auto-partitioned.
     """
     from ..models import llama
-    from ..models.training import make_sharded_train_step, next_token_xent
-    from ..ops.attention import causal_attention
-    from ..ops.rope import rope_angles
 
-    # plain fused XLA attention by default: the block runs inside a
-    # manual-over-pipe shard_map region, where the mesh-aware flash paths
-    # (auto_attention with a mesh → sharded_flash_attention's own
-    # shard_map; without one → an unsharded pallas_call GSPMD would
-    # replicate) are both wrong.  GSPMD partitions the fused attention
-    # over the auto batch/tensor axes correctly.
-    attn_fn = attn_fn or causal_attention
-
-    # llama specs, with the stacked-layer axis pipe-sharded
-    specs = llama.param_specs(cfg)
-    specs["layers"] = jax.tree.map(
-        lambda s: P(*(("pipe",) + tuple(s)[1:])),
-        specs["layers"],
-        is_leaf=lambda x: isinstance(x, P),
-    )
-    p_shard = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), specs,
-        is_leaf=lambda x: isinstance(x, P),
-    )
-    tok_shard = NamedSharding(mesh, P(("data", "fsdp"), None))
-    repl = NamedSharding(mesh, P())
-
-    def fwd(params, tokens):
-        x = params["embed"][tokens].astype(cfg.dtype)
-        cos, sin = rope_angles(
-            tokens.shape[1], cfg.head_dim, cfg.rope_theta
-        )
-
+    def make_block(cos, sin, attn):
         def block(x, lp):
-            return llama._layer(cfg, cos, sin, x, lp, attn_fn)
+            return llama._layer(cfg, cos, sin, x, lp, attn)
+        return block
 
-        if cfg.remat:
-            from ..models.training import remat_policy
+    return _make_pipelined_step(
+        cfg, mesh, n_microbatches, optimizer, attn_fn,
+        llama.param_specs, partial(llama.init_params, cfg=cfg),
+        make_block, with_aux=False, aux_weight=0.0,
+    )
 
-            block = jax.checkpoint(block, policy=remat_policy(cfg))
 
-        x = pipeline_apply(
-            block, params["layers"], x, mesh, n_microbatches
-        )
-        from ..ops.norms import rms_norm
-        x = rms_norm(x, params["ln_final"], cfg.rms_eps)
-        return (x @ params["lm_head"]).astype(jnp.float32)
+def make_moe_pipeline_train_step(
+    cfg,
+    mesh: Mesh,
+    n_microbatches: int = 4,
+    optimizer=None,
+    attn_fn: Optional[Callable] = None,
+):
+    """Pipeline-parallel MoE training step: stages over ``pipe``, experts
+    over ``expert`` (the MoE all-to-all stays auto-partitioned inside the
+    manual-over-pipe region), batch over data/fsdp.  The router aux loss
+    accumulates per valid (layer, microbatch) tick inside the pipeline —
+    see ``_stage_kernel`` — giving the microbatched estimator of
+    ``moe.loss_fn``'s batch-mean aux."""
+    from ..models import moe
 
-    def loss_fn(params, tokens):
-        return next_token_xent(fwd(params, tokens[:, :-1]), tokens)
+    def make_block(cos, sin, attn):
+        def block(x, lp):
+            # mesh=None: inside the manual-over-pipe region the expert
+            # all-to-all is left to GSPMD via the einsum structure; the
+            # with_sharding_constraint hint needs the full auto mesh
+            return moe._layer(cfg, cos, sin, x, lp, attn, mesh=None)
+        return block
 
-    return make_sharded_train_step(
-        loss_fn,
-        partial(llama.init_params, cfg=cfg),
-        p_shard,
-        tok_shard,
-        repl,
-        optimizer,
+    return _make_pipelined_step(
+        cfg, mesh, n_microbatches, optimizer, attn_fn,
+        moe.param_specs, partial(moe.init_params, cfg=cfg),
+        make_block, with_aux=True, aux_weight=cfg.router_aux_weight,
     )

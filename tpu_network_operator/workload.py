@@ -184,10 +184,10 @@ def cmd_train(args) -> int:
     # reject axis requests the selected model path won't use — the mesh
     # would carve devices onto a dead axis and silently replicate compute
     if args.model == "moe":
-        if args.pipe > 1 or args.seq > 1:
+        if args.seq > 1:
             raise SystemExit(
-                "--pipe/--seq are not supported with --model moe "
-                "(no pipeline or ring-attention path for MoE yet)"
+                "--seq is not supported with --model moe "
+                "(no ring-attention path for MoE yet)"
             )
     elif args.expert > 1:
         raise SystemExit("--expert requires --model moe")
@@ -205,23 +205,30 @@ def cmd_train(args) -> int:
         optimizer = adamw8bit()   # library defaults mirror adamw's
 
     if args.model == "moe":
-        from .models.moe import make_train_step
-
         cfg = _pick_preset(_moe_presets(), args.preset, "moe")
-        step, init_all, _ = make_train_step(cfg, mesh, optimizer=optimizer)
+        if args.pipe > 1:
+            from .parallel import make_moe_pipeline_train_step
+
+            step, init_all, _ = make_moe_pipeline_train_step(
+                cfg, mesh, n_microbatches=args.microbatches,
+                optimizer=optimizer,
+            )
+        else:
+            from .models.moe import make_train_step
+
+            step, init_all, _ = make_train_step(
+                cfg, mesh, optimizer=optimizer
+            )
     else:
         from .models.llama import make_train_step
 
         cfg = _pick_preset(_llama_presets(), args.preset, "llama")
         if args.pipe > 1:
-            if optimizer is not None:
-                raise SystemExit(
-                    "--optimizer adam8bit is not supported with --pipe yet"
-                )
             from .parallel import make_pipeline_train_step
 
             step, init_all, _ = make_pipeline_train_step(
-                cfg, mesh, n_microbatches=args.microbatches
+                cfg, mesh, n_microbatches=args.microbatches,
+                optimizer=optimizer,
             )
         else:
             attn_fn = None
