@@ -250,20 +250,30 @@ def main() -> None:
     mesh = make_mesh(plan)
     log(f"mesh: {plan.axis_sizes}")
 
+    # measure EVERY fitting rung of the largest family that runs (e.g. all
+    # llama3-1b variants) and let the fastest one be the headline — a
+    # variant rung that regresses in practice (measured 2026-07-30: the
+    # jnp-path adam8 rungs cost more than their remat win) must not hide
+    # the base config's number
     rows = []
+    headline_base = None
     for cand_name, cand, b, s, opt in candidates:
+        base = cand_name.split("+")[0]
+        if headline_base is not None and base != headline_base:
+            break   # done with the headline family; smaller rungs skipped
         batch = b * max(1, n)   # scale batch with the data axis
         log(f"attempting {cand_name}: {cand.num_params() / 1e9:.2f}B params, "
             f"batch {batch} x seq {s}")
         try:
             rows.append(measure(cand_name, cand, batch, s, n, kind,
                                 make_train_step, mesh, jax, jnp, opt=opt))
-            break
+            headline_base = base
         except Exception as e:   # OOM / compile failure: next rung down
             log(f"[{cand_name}] failed ({type(e).__name__}: {str(e)[:120]}); "
                 "trying next rung")
     if not rows:
         raise SystemExit("no ladder rung ran to completion")
+    rows.sort(key=lambda r: -r["tokens_per_sec_per_chip"])
     name = rows[0]["config"]
     if name != "llama3-150m" and not forced:
         # continuity row: every round also reports the 150m proxy so the

@@ -103,3 +103,46 @@ class TestAdam8bit:
         state = opt.init({"w": jnp.zeros((4,))})
         with pytest.raises(ValueError, match="requires params"):
             opt.update({"w": jnp.ones((4,))}, state, None)
+
+
+class TestFusedKernel:
+    """The Pallas single-pass update must match the composable jnp path
+    bit-for-bit-ish: same quantized moments, same updates (both compute
+    identical f32 math; only op order inside a block differs)."""
+
+    def _one_update(self, monkeypatch, fused: bool):
+        monkeypatch.setenv("TPUNET_ADAM8_FUSED", "1" if fused else "0")
+        opt = adamw8bit(3e-3, weight_decay=0.1)
+        key = jax.random.key(7)
+        # BLOCK-divisible leaf (fused-eligible) + odd leaf (always jnp)
+        params = {
+            "w": jax.random.normal(key, (4, 512), jnp.bfloat16),
+            "odd": jax.random.normal(key, (77,), jnp.bfloat16),
+        }
+        grads = jax.tree.map(
+            lambda p: jnp.full(p.shape, 0.01, p.dtype), params
+        )
+        state = opt.init(params)
+        upd1, state = opt.update(grads, state, params)
+        upd2, state = opt.update(grads, state, params)   # non-zero moments
+        return upd2, state
+
+    def test_fused_matches_jnp_path(self, monkeypatch):
+        uf, sf = self._one_update(monkeypatch, fused=True)
+        uj, sj = self._one_update(monkeypatch, fused=False)
+        for leaf in ("w", "odd"):
+            np.testing.assert_allclose(
+                np.asarray(uf[leaf], np.float32),
+                np.asarray(uj[leaf], np.float32),
+                rtol=1e-2, atol=1e-6,
+            )
+        mf, mj = sf.m["w"], sj.m["w"]
+        np.testing.assert_array_equal(np.asarray(mf.q), np.asarray(mj.q))
+        np.testing.assert_allclose(
+            np.asarray(mf.scale), np.asarray(mj.scale), rtol=1e-6
+        )
+        vf, vj = sf.v["w"], sj.v["w"]
+        np.testing.assert_allclose(
+            np.asarray(vf.q, np.float32), np.asarray(vj.q, np.float32),
+            rtol=0.07,   # one f8 ulp
+        )
