@@ -216,10 +216,7 @@ def main() -> None:
          dataclasses.replace(one_b, xent_chunk=512, remat_policy="ffn_lite"),
          4, 2048, "adam8"),
         ("llama3-1b", dataclasses.replace(one_b, **big), 4, 2048, None),
-        ("llama3-150m",
-         LlamaConfig(vocab_size=32_000, hidden=1024, layers=8, heads=16,
-                     kv_heads=8, ffn=4096, max_seq=2048),
-         8, 2048, None),
+        ("llama3-150m", LlamaConfig.llama3_150m(), 8, 2048, None),
     ]
     total_hbm = hbm * n
     forced = os.environ.get("BENCH_CONFIG", "")

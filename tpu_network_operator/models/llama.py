@@ -100,6 +100,14 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def llama3_150m() -> "LlamaConfig":
+        # the benchmark's continuity proxy (BASELINE.md measured series)
+        return LlamaConfig(
+            vocab_size=32_000, hidden=1024, layers=8, heads=16,
+            kv_heads=8, ffn=4096, max_seq=2048,
+        )
+
+    @staticmethod
     def tiny(vocab: int = 256) -> "LlamaConfig":
         """Test/dryrun config: small but structurally identical."""
         return LlamaConfig(

@@ -200,7 +200,13 @@ def adamw8bit(
     block: int = BLOCK,
 ):
     """Drop-in for ``optax.adamw`` with int8 moment storage.  Returns an
-    optax ``GradientTransformation``-shaped (init, update) pair."""
+    optax ``GradientTransformation``-shaped (init, update) pair.
+
+    Call ``update`` under jit (as ``make_sharded_train_step`` does): on
+    the fused single-TPU path the previous state's moment buffers are
+    donated in place (``input_output_aliases``), so an *eager* update
+    invalidates the old ``Adam8State``'s arrays — reading them afterwards
+    raises "Array has been deleted"."""
     import optax
 
     def init(params):

@@ -263,18 +263,41 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
+def attention_delta(out: jnp.ndarray, do: jnp.ndarray) -> jnp.ndarray:
+    """delta = rowsum(dO ⊙ O), broadcast LANES-wide for the backward
+    kernels.  Split out so the ring path (parallel/ring) can compute it
+    once from the *global* output and reuse it for every K/V chunk."""
+    b, h, sq, _ = out.shape
+    return jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1, keepdims=True),
+        (b, h, sq, LANES),
+    )
+
+
 def _bwd(q, k, v, out, lse, do, *, block_q, block_k, causal):
+    # dq emitted directly in q.dtype — the dense path needs no f32
+    # accumulation (single chunk), so skip the wider HBM write
+    dq, dk, dv = _bwd_core(
+        q, k, v, do, lse, attention_delta(out, do),
+        block_q=block_q, block_k=block_k, causal=causal, dq_dtype=q.dtype,
+    )
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _bwd_core(q, k, v, do, lse, delta, *, block_q, block_k, causal,
+              dq_dtype=None):
+    """dq/dk/dv (dk/dv f32 GQA-group-summed to kv heads; dq in
+    ``dq_dtype``, default f32) from the given lse/delta — which may be
+    the GLOBAL softmax statistics when the caller is accumulating over
+    ring chunks (the per-key-block backward formulas only ever reference
+    lse/delta, so chunk contributions with global statistics sum to the
+    exact full-attention gradient)."""
     b, h, sq, d = q.shape
     hkv, sk = k.shape[1], k.shape[2]
     n_rep = h // hkv
     bq, bk = _block_sizes(sq, sk, block_q, block_k)
     scale = d ** -0.5
-
-    delta = jnp.broadcast_to(
-        jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                axis=-1, keepdims=True),
-        (b, h, sq, LANES),
-    )                                                     # [B, H, S, LANES]
 
     kv_spec = pl.BlockSpec(
         (1, 1, sk, d), lambda b_, h_, i: (b_, h_ // n_rep, 0, 0),
@@ -292,7 +315,7 @@ def _bwd(q, k, v, out, lse, do, *, block_q, block_k, causal):
         in_specs=[q_blk, kv_spec, kv_spec, q_blk, s_blk, s_blk],
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(q.shape, dq_dtype or jnp.float32),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
@@ -323,7 +346,7 @@ def _bwd(q, k, v, out, lse, do, *, block_q, block_k, causal):
     if n_rep > 1:
         dk_p = dk_p.reshape(b, hkv, n_rep, sk, d).sum(axis=2)
         dv_p = dv_p.reshape(b, hkv, n_rep, sk, d).sum(axis=2)
-    return dq, dk_p.astype(k.dtype), dv_p.astype(v.dtype)
+    return dq, dk_p, dv_p
 
 
 # -- public api (matches ops.attention.causal_attention layout) ---------------
@@ -398,6 +421,30 @@ def sharded_flash_attention(mesh, *, block_q: int = 512, block_k: int = 512,
         )
 
     return attn
+
+
+# -- chunk-level seams for the ring path (parallel/ring) ----------------------
+#
+# Ring attention runs these kernels once per visiting K/V chunk and owns
+# the cross-chunk combination itself (LSE merge forward, global-lse/delta
+# accumulation backward), so both seams are raw — NOT differentiable.
+
+
+def chunk_fwd(q, k, v, *, causal: bool,
+              block_q: int = 512, block_k: int = 512):
+    """One K/V chunk forward: (out [B,H,Sq,D] in q.dtype, lse
+    [B,H,Sq,LANES] f32).  ``causal=True`` for the diagonal chunk (locally
+    causal), ``False`` for strictly-past chunks."""
+    return _fwd(q, k, v, block_q=block_q, block_k=block_k, causal=causal)
+
+
+def chunk_bwd(q, k, v, do, lse, delta, *, causal: bool,
+              block_q: int = 512, block_k: int = 512):
+    """One K/V chunk backward with GLOBAL lse/delta: f32 (dq, dk, dv),
+    dk/dv group-summed to kv heads — summing these over all chunks gives
+    the exact full-attention gradient (see :func:`_bwd_core`)."""
+    return _bwd_core(q, k, v, do, lse, delta,
+                     block_q=block_q, block_k=block_k, causal=causal)
 
 
 def supports(seq_q: int, seq_k: int, head_dim: int,

@@ -14,6 +14,22 @@ arriving at step j originated at device (i - j) mod n and is
 * src < i  — strictly past: fully attended;
 * src > i  — strictly future: skipped (masked to zero contribution).
 
+Two per-chunk implementations, chosen statically by shape:
+
+* **flash** (default on TPU when :func:`..ops.pallas_attention.supports`
+  passes):
+  the Pallas flash kernels per chunk — the [Sq, Sk] score matrix never
+  leaves VMEM, K/V rotate *unrepeated* (GQA handled inside the kernel, so
+  ring traffic shrinks by heads/kv_heads).  Forward merges chunk outputs
+  with their LSEs (exact log-sum-exp combination); backward is hand-written
+  (``jax.custom_vjp``): the flash backward formulas only reference the
+  softmax statistics lse/delta, so with the GLOBAL lse (from the forward
+  merge) and global delta = rowsum(dO ⊙ O), per-chunk kernel contributions
+  sum to the exact full-attention gradient while dK/dV accumulators rotate
+  home with their chunks.
+* **xla** fallback: plain einsum online-softmax (small head_dim / odd
+  chunk sizes / non-TPU-non-interpret contexts).
+
 Compute/communication overlap is left to XLA's latency-hiding scheduler —
 the ppermute of step j+1 is independent of step j's matmuls, which is
 exactly the pattern it overlaps.
@@ -91,6 +107,141 @@ def _ring_kernel(axis_name: str, scale: float, q, k, v):
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
+# -- flash (Pallas-per-chunk) path --------------------------------------------
+
+
+def _rot(axis_name: str, n: int, *xs):
+    """One backwards ring hop for each operand (chunk i -> device i+1)."""
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return tuple(jax.lax.ppermute(x, axis_name, perm) for x in xs)
+
+
+def _flash_fwd_loop(axis_name, n, bq, bk, q, k, v):
+    """Per-device forward: q [B,H,Sq,D]; k,v [B,Hkv,Sk,D] (unrepeated).
+    Returns (out, lse, k, v) — k/v have made n hops, i.e. are home again.
+    """
+    from ..ops import pallas_attention as pa
+
+    my = jax.lax.axis_index(axis_name)
+
+    # diagonal chunk peeled: it is the only causal one, and `causal` must
+    # be static for the kernel
+    out0, lse = pa.chunk_fwd(q, k, v, causal=True, block_q=bq, block_k=bk)
+    o = out0.astype(jnp.float32)
+    k, v = _rot(axis_name, n, k, v)
+
+    def body(j, carry):
+        k, v, o, lse = carry
+        src = (my - j) % n
+
+        def visit(o, lse, k, v):
+            out_c, lse_c = pa.chunk_fwd(
+                q, k, v, causal=False, block_q=bq, block_k=bk
+            )
+            new = jnp.logaddexp(lse, lse_c)
+            o2 = (
+                o * jnp.exp((lse - new)[..., 0:1])
+                + out_c.astype(jnp.float32) * jnp.exp((lse_c - new)[..., 0:1])
+            )
+            return o2, new
+
+        # strictly-future chunks contribute nothing (causal skip)
+        o, lse = jax.lax.cond(
+            src < my, visit, lambda o, lse, k, v: (o, lse), o, lse, k, v
+        )
+        k, v = _rot(axis_name, n, k, v)
+        return (k, v, o, lse)
+
+    k, v, o, lse = jax.lax.fori_loop(1, n, body, (k, v, o, lse))
+    return o.astype(q.dtype), lse, k, v
+
+
+def _flash_bwd_loop(axis_name, n, bq, bk, q, k, v, do, lse, delta):
+    """Per-device backward.  dK/dV accumulators travel WITH their chunk
+    (n hops total = home); dQ accumulates locally."""
+    from ..ops import pallas_attention as pa
+
+    my = jax.lax.axis_index(axis_name)
+
+    dq, dk, dv = pa.chunk_bwd(
+        q, k, v, do, lse, delta, causal=True, block_q=bq, block_k=bk
+    )
+    k, v, dk, dv = _rot(axis_name, n, k, v, dk, dv)
+
+    def body(j, carry):
+        k, v, dk, dv, dq = carry
+        src = (my - j) % n
+
+        def visit(dq, dk, dv, k, v):
+            dq_c, dk_c, dv_c = pa.chunk_bwd(
+                q, k, v, do, lse, delta, causal=False,
+                block_q=bq, block_k=bk,
+            )
+            return dq + dq_c, dk + dk_c, dv + dv_c
+
+        dq, dk, dv = jax.lax.cond(
+            src < my, visit, lambda dq, dk, dv, k, v: (dq, dk, dv),
+            dq, dk, dv, k, v,
+        )
+        k, v, dk, dv = _rot(axis_name, n, k, v, dk, dv)
+        return (k, v, dk, dv, dq)
+
+    _, _, dk, dv, dq = jax.lax.fori_loop(
+        1, n, body, (k, v, dk, dv, dq)
+    )
+    return dq, dk, dv
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _flash_ring(axis_name, n, bq, bk, q, k, v):
+    out, _, _, _ = _flash_fwd_loop(axis_name, n, bq, bk, q, k, v)
+    return out
+
+
+def _flash_ring_fwd(axis_name, n, bq, bk, q, k, v):
+    out, lse, k_home, v_home = _flash_fwd_loop(axis_name, n, bq, bk, q, k, v)
+    return out, (q, k_home, v_home, out, lse)
+
+
+def _flash_ring_bwd(axis_name, n, bq, bk, res, do):
+    from ..ops import pallas_attention as pa
+
+    q, k, v, out, lse = res
+    delta = pa.attention_delta(out, do)
+    dq, dk, dv = _flash_bwd_loop(
+        axis_name, n, bq, bk, q, k, v, do, lse, delta
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_ring.defvjp(_flash_ring_fwd, _flash_ring_bwd)
+
+
+def _use_flash(sq_local, head_dim, h, hkv, mesh, head_axis) -> bool:
+    """Static gate for ``impl="auto"``: TPU backend only (the kernels
+    would run in slow interpret mode anywhere else — same policy as
+    ``llama.auto_attention`` and ``optim8bit._use_fused``; tests force
+    the path with ``impl="flash"`` or TPUNET_RING_FLASH=1), plus
+    flash-compatible local shapes and GQA groups intact per head shard."""
+    import os
+
+    from ..ops import pallas_attention as pa
+
+    flag = os.environ.get("TPUNET_RING_FLASH", "")
+    if flag == "0":
+        return False
+    if flag != "1" and jax.default_backend() != "tpu":
+        return False
+    t = dict(zip(mesh.axis_names, mesh.devices.shape)).get(head_axis, 1) \
+        if head_axis else 1
+    return (
+        pa.supports(sq_local, sq_local, head_dim)
+        and h % max(t, 1) == 0
+        and hkv % max(t, 1) == 0
+        and (h // max(t, 1)) % (hkv // max(t, 1) or 1) == 0
+    )
+
+
 def ring_attention(
     q: jnp.ndarray,                    # [B, S, H, D], S sharded on `axis`
     k: jnp.ndarray,                    # [B, S, Hkv, D]
@@ -99,19 +250,52 @@ def ring_attention(
     axis: str = "seq",
     batch_axes=("data", "fsdp"),
     head_axis: Optional[str] = "tensor",
+    impl: str = "auto",                # "auto" | "flash" | "xla"
 ) -> jnp.ndarray:
     """Global-view ring attention (callable inside jit).
 
     Sequence is sharded along ``axis``; batch along ``batch_axes``; heads
-    along ``head_axis``.  Exact match to full causal attention.
+    along ``head_axis``.  Exact match to full causal attention.  ``impl``
+    picks the per-chunk math: flash (Pallas kernels, K/V rotate
+    unrepeated) when the static shape gate passes, else plain XLA.
     """
     h, hkv = q.shape[2], k.shape[2]
+    n = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+    sq_local = q.shape[1] // max(n, 1)
+    scale = q.shape[-1] ** -0.5
+
+    flash = impl == "flash" or (
+        impl == "auto" and _use_flash(sq_local, q.shape[-1], h, hkv,
+                                      mesh, head_axis)
+    )
+    if flash:
+        qspec = P(batch_axes, axis, head_axis, None)
+        bq = min(512, sq_local)
+        bk = min(512, sq_local)
+
+        def kernel(q, k, v):
+            # kernels run in BHSD layout
+            out = _flash_ring(
+                axis, n, bq, bk,
+                q.transpose(0, 2, 1, 3),
+                k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3),
+            )
+            return out.transpose(0, 2, 1, 3)
+
+        return shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(qspec, qspec, qspec),
+            out_specs=qspec,
+            check_vma=False,
+        )(q, k, v)
+
     if hkv != h:
         k = repeat_kv(k, h // hkv)
         v = repeat_kv(v, h // hkv)
 
     spec = P(batch_axes, axis, head_axis, None)
-    scale = q.shape[-1] ** -0.5
 
     kernel = partial(_ring_kernel, axis, scale)
     return shard_map(

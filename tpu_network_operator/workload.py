@@ -87,6 +87,7 @@ def _llama_presets():
 
     return {
         "tiny": LlamaConfig.tiny,
+        "llama3-150m": LlamaConfig.llama3_150m,
         "llama3-1b": LlamaConfig.llama3_1b,
         "llama3-3b": LlamaConfig.llama3_3b,
         "llama3-8b": LlamaConfig.llama3_8b,
