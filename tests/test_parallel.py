@@ -1,6 +1,8 @@
 """Mesh planning, collectives, and ring attention on the 8-device CPU mesh
 (the framework's multi-chip intent-level test tier, SURVEY.md §4.2 analog)."""
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -171,8 +173,79 @@ class TestFlashRing:
             assert bool(jnp.isfinite(a).all()), f"d{name} not finite"
             assert self._max_rel(b, a) < 0.05, f"d{name} diverges"
 
-    def test_small_head_dim_falls_back(self):
+    def test_small_head_dim_falls_back(self, monkeypatch):
         from tpu_network_operator.parallel.ring import _use_flash
 
+        # force past the backend gate so the SHAPE gate is what's tested
+        monkeypatch.setenv("TPUNET_RING_FLASH", "1")
         mesh = make_mesh(plan_axes(8, seq=8, tensor=1, fsdp=1, data=1))
-        assert not _use_flash(32, 8, 2, 2, mesh, "tensor")
+        assert not _use_flash(32, 8, 2, 2, mesh, "tensor")       # d < 64
+        assert not _use_flash(100, 64, 2, 2, mesh, "tensor")     # seq % block
+        assert _use_flash(128, 64, 2, 2, mesh, "tensor")
+
+
+class TestUlyssesAttention:
+    """All-to-all (Ulysses) sequence parallelism: exact vs dense causal
+    attention, gradient parity with the ring scheme, GQA head repetition
+    only up to divisibility."""
+
+    def _qkv(self, B=2, S=64, H=8, KV=4, D=16):
+        ks = jax.random.split(jax.random.key(5), 3)
+        return (
+            jax.random.normal(ks[0], (B, S, H, D), jnp.float32),
+            jax.random.normal(ks[1], (B, S, KV, D), jnp.float32),
+            jax.random.normal(ks[2], (B, S, KV, D), jnp.float32),
+        )
+
+    def test_matches_causal_attention(self):
+        from tpu_network_operator.parallel.ulysses import ulysses_attention
+
+        mesh = make_mesh(plan_axes(8, seq=4, tensor=2, fsdp=1, data=1))
+        q, k, v = self._qkv()
+        ref = causal_attention(q, k, v)
+        out = jax.jit(
+            lambda q, k, v: ulysses_attention(q, k, v, mesh)
+        )(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(ref), np.asarray(out), atol=2e-5
+        )
+
+    def test_gqa_repeats_only_to_divisibility(self):
+        from tpu_network_operator.parallel.ulysses import _heads_for
+
+        # kv=4 over 8 head-splits: repeat x2, NOT full expansion x4
+        assert _heads_for(8, 16, 4) == 2
+        assert _heads_for(4, 16, 4) == 1
+        # impossible small kv bounded by full GQA expansion
+        assert _heads_for(8, 8, 2) == 4
+
+    def test_grads_match_ring(self):
+        from tpu_network_operator.parallel.ring import ring_attention as ra
+        from tpu_network_operator.parallel.ulysses import ulysses_attention
+
+        mesh = make_mesh(plan_axes(8, seq=4, tensor=1, fsdp=2, data=1))
+        q, k, v = self._qkv(B=2, S=64, H=4, KV=2, D=16)
+
+        def grads(fn):
+            def f(q, k, v):
+                out = fn(q, k, v, mesh)
+                return jnp.sum(out * jnp.sin(out))
+            return jax.jit(jax.grad(f, argnums=(0, 1, 2)))(q, k, v)
+
+        gu = grads(ulysses_attention)
+        gr = grads(partial(ra, impl="xla"))
+        for a, b, name in zip(gu, gr, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-4,
+                err_msg=f"d{name} ulysses vs ring",
+            )
+
+    def test_indivisible_heads_raise(self):
+        from tpu_network_operator.parallel.ulysses import ulysses_attention
+
+        mesh = make_mesh(plan_axes(8, seq=8, tensor=1, fsdp=1, data=1))
+        q, k, v = self._qkv(B=1, S=64, H=4, KV=4)   # 4 heads, 8 shards
+        with pytest.raises(ValueError, match="divisible"):
+            jax.jit(
+                lambda q, k, v: ulysses_attention(q, k, v, mesh)
+            )(q, k, v)

@@ -232,8 +232,7 @@ def _use_flash(sq_local, head_dim, h, hkv, mesh, head_axis) -> bool:
         return False
     if flag != "1" and jax.default_backend() != "tpu":
         return False
-    t = dict(zip(mesh.axis_names, mesh.devices.shape)).get(head_axis, 1) \
-        if head_axis else 1
+    t = mesh.shape.get(head_axis, 1) if head_axis else 1
     return (
         pa.supports(sq_local, sq_local, head_dim)
         and h % max(t, 1) == 0
@@ -260,7 +259,7 @@ def ring_attention(
     unrepeated) when the static shape gate passes, else plain XLA.
     """
     h, hkv = q.shape[2], k.shape[2]
-    n = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+    n = mesh.shape.get(axis, 1)
     sq_local = q.shape[1] // max(n, 1)
     scale = q.shape[-1] ** -0.5
 

@@ -1,0 +1,136 @@
+"""Ulysses-style all-to-all sequence parallelism for attention.
+
+The second long-context scheme (SURVEY.md §5.7 TPU-equivalent; the
+DeepSpeed-Ulysses construction — see PAPERS.md): activations arrive
+sequence-sharded on the ``seq`` mesh axis, and attention needs the full
+sequence per query — but it is *embarrassingly parallel over heads*.  So
+instead of rotating K/V chunks around a ring, each device trades its
+sequence shard of ALL heads for the full sequence of H/n heads:
+
+    [B, S/n, H, D]  --all_to_all-->  [B, S, H/n, D]
+    local attention (full causal, flash kernel when shapes allow)
+    [B, S, H/n, D]  --all_to_all-->  [B, S/n, H, D]
+
+Four ``all_to_all`` collectives per attention call (q/k/v scatters +
+the output gather; q and out move O(B·S·H·D/n) bytes, k/v
+O(B·S·Hkv·rep·D/n)) versus ring's n ``ppermute`` hops of the K/V chunk.
+Trade-off vs :mod:`.ring` (both exact):
+
+* **ulysses** — less latency-sensitive (4 collectives regardless of n,
+  and XLA can overlap them with the QKV/out projections), but every
+  device holds K/V for the FULL sequence of its head group: HBM per
+  device scales O(S·Hkv/n).  Needs heads % n == 0 (and kv_heads % n
+  == 0, else K/V heads are repeated up to the GQA group that divides).
+* **ring** — K/V stay chunked (HBM O(S/n)), the right choice when S is
+  the thing that doesn't fit; n neighbour hops instead of 2 all-to-alls.
+
+The model picks via ``attn_fn`` injection exactly like ring
+(:func:`make_ulysses_attn_fn` mirrors ``make_ring_attn_fn``); the
+workload CLI exposes ``--sp-impl {ring,ulysses}``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ..ops.attention import causal_attention, repeat_kv
+
+
+def _heads_for(axis_n: int, h: int, hkv: int) -> int:
+    """Smallest K/V head replication factor making the kv-head count
+    divide the combined head split (tensor shards × seq shards); always
+    exists (rep = axis_n works), capped by full GQA expansion h/hkv."""
+    rep = 1
+    while (hkv * rep) % axis_n:
+        rep += 1
+    return min(rep, h // hkv)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,                    # [B, S, H, D], S sharded on `axis`
+    k: jnp.ndarray,                    # [B, S, Hkv, D]
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "seq",
+    batch_axes=("data", "fsdp"),
+    head_axis: Optional[str] = "tensor",
+) -> jnp.ndarray:
+    """Global-view Ulysses attention (callable inside jit).  Exact match
+    to full causal attention; sequence sharded on ``axis``.
+
+    Requires ``heads`` divisible by (head_axis shards × seq shards) —
+    the head dimension is consumed by both tensor parallelism and the
+    all-to-all scatter.  K/V heads are GQA-repeated only up to the
+    factor needed for divisibility.
+    """
+    n = mesh.shape.get(axis, 1)
+    h, hkv = q.shape[2], k.shape[2]
+    t = mesh.shape.get(head_axis, 1) if head_axis else 1
+    if h % max(t, 1) or (h // max(t, 1)) % max(n, 1):
+        raise ValueError(
+            f"ulysses needs heads {h} divisible by tensor shards {t} and "
+            f"local heads {h}/{t} divisible by seq shards {n}"
+        )
+    rep = _heads_for(n * max(t, 1), h, hkv)
+    if rep > 1:
+        k = repeat_kv(k, rep)
+        v = repeat_kv(v, rep)
+
+    spec_q = P(batch_axes, axis, head_axis, None)
+
+    def kernel(q, k, v):
+        # local: q [B, S/n, H_l, D]; all_to_all trades seq shard for a
+        # head group (tiled=True splits axis 2 n-ways, concatenates the
+        # gathered seq chunks on axis 1)
+        qg = jax.lax.all_to_all(q, axis, split_axis=2, concat_axis=1,
+                                tiled=True)
+        kg = jax.lax.all_to_all(k, axis, split_axis=2, concat_axis=1,
+                                tiled=True)
+        vg = jax.lax.all_to_all(v, axis, split_axis=2, concat_axis=1,
+                                tiled=True)
+        out = _local_attention(qg, kg, vg)
+        return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(spec_q, spec_q, spec_q),
+        out_specs=spec_q,
+        check_vma=False,
+    )(q, k, v)
+
+
+def _local_attention(q, k, v):
+    """Full-sequence causal attention on the local head group: the flash
+    kernel when the static shape gate passes on TPU (or under the test
+    override), else the fused XLA path."""
+    import os
+
+    from ..ops import pallas_attention as pa
+
+    s, d = q.shape[1], q.shape[-1]
+    hkv = k.shape[2]
+    flag = os.environ.get("TPUNET_RING_FLASH", "")   # shared SP override
+    on_tpu = jax.default_backend() == "tpu" or flag == "1"
+    if (
+        flag != "0" and on_tpu and pa.supports(s, s, d)
+        and q.shape[2] % hkv == 0
+    ):
+        return pa.flash_attention(q, k, v)
+    return causal_attention(q, k, v)
+
+
+def make_ulysses_attn_fn(mesh: Mesh, axis: str = "seq"):
+    """Adapter matching the model's ``attn_fn`` signature (mirrors
+    ``ring.make_ring_attn_fn``)."""
+
+    def attn_fn(q, k, v):
+        return ulysses_attention(q, k, v, mesh, axis=axis)
+
+    return attn_fn

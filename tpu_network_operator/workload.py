@@ -234,9 +234,14 @@ def cmd_train(args) -> int:
         else:
             attn_fn = None
             if args.seq > 1:
-                from .parallel.ring import make_ring_attn_fn
+                if getattr(args, "sp_impl", "ring") == "ulysses":
+                    from .parallel.ulysses import make_ulysses_attn_fn
 
-                attn_fn = make_ring_attn_fn(mesh)
+                    attn_fn = make_ulysses_attn_fn(mesh)
+                else:
+                    from .parallel.ring import make_ring_attn_fn
+
+                    attn_fn = make_ring_attn_fn(mesh)
             step, init_all, _ = make_train_step(
                 cfg, mesh, optimizer=optimizer, attn_fn=attn_fn
             )
@@ -400,6 +405,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--steps", type=int, default=10)
     t.add_argument("--batch", type=int, default=8)
     t.add_argument("--seq-len", type=int, default=128)
+    t.add_argument("--sp-impl", choices=["ring", "ulysses"], default="ring",
+                   help="sequence-parallel attention scheme when --seq>1: "
+                        "ring (K/V chunks rotate, HBM O(S/n)) or ulysses "
+                        "(head-scatter all-to-alls, 4 collectives/call "
+                        "regardless of shard count)")
     t.add_argument("--data", default=None, metavar="TOKENS.bin",
                    help="memmapped token file (uint16/uint32); default: "
                         "synthetic fixed batch")
