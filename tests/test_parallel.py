@@ -210,6 +210,20 @@ class TestUlyssesAttention:
             np.asarray(ref), np.asarray(out), atol=2e-5
         )
 
+    def test_flash_local_attention_matches_dense(self, monkeypatch):
+        """The TPU production branch of _local_attention (flash kernel on
+        the gathered full sequence), forced via the shared SP override."""
+        from tpu_network_operator.parallel.ulysses import ulysses_attention
+
+        monkeypatch.setenv("TPUNET_RING_FLASH", "1")
+        mesh = make_mesh(plan_axes(8, seq=4, tensor=2, fsdp=1, data=1))
+        q, k, v = self._qkv(B=1, S=512, H=8, KV=4, D=64)
+        ref = causal_attention(q, k, v)
+        out = jax.jit(
+            lambda q, k, v: ulysses_attention(q, k, v, mesh)
+        )(q, k, v)
+        assert TestFlashRing._max_rel(ref, out) < 0.03
+
     def test_gqa_repeats_only_to_divisibility(self):
         from tpu_network_operator.parallel.ulysses import _heads_for
 

@@ -124,10 +124,13 @@ def test_train_from_bootstrap_file(capsys, tmp_path):
 def test_train_rejects_dead_axes():
     with pytest.raises(SystemExit, match="expert requires"):
         main(["train", "--preset", "tiny", "--expert", "2"])
-    # --pipe composes with moe now (make_moe_pipeline_train_step); only
-    # the ring-attention path remains llama-only
-    with pytest.raises(SystemExit, match="not supported with --model moe"):
-        main(["train", "--model", "moe", "--preset", "tiny", "--seq", "2"])
+    # --seq composes with moe now (ring/ulysses attn_fn); the remaining
+    # unsupported combination is pipelining x sequence parallelism
+    with pytest.raises(SystemExit, match="cannot be combined"):
+        main(["train", "--model", "moe", "--preset", "tiny",
+              "--seq", "2", "--pipe", "2"])
+    with pytest.raises(SystemExit, match="cannot be combined"):
+        main(["train", "--preset", "tiny", "--seq", "2", "--pipe", "2"])
 
 
 def test_train_moe_pipeline(capsys):

@@ -19,10 +19,11 @@ Trade-off vs :mod:`.ring` (both exact):
 * **ulysses** — less latency-sensitive (4 collectives regardless of n,
   and XLA can overlap them with the QKV/out projections), but every
   device holds K/V for the FULL sequence of its head group: HBM per
-  device scales O(S·Hkv/n).  Needs heads % n == 0 (and kv_heads % n
-  == 0, else K/V heads are repeated up to the GQA group that divides).
+  device scales O(S·Hkv/n).  Needs heads divisible by tensor_shards ×
+  seq_shards (the head dim is consumed by both splits; K/V heads are
+  repeated only up to the factor that makes them divide).
 * **ring** — K/V stay chunked (HBM O(S/n)), the right choice when S is
-  the thing that doesn't fit; n neighbour hops instead of 2 all-to-alls.
+  the thing that doesn't fit; n neighbour hops instead of 4 all-to-alls.
 
 The model picks via ``attn_fn`` injection exactly like ring
 (:func:`make_ulysses_attn_fn` mirrors ``make_ring_attn_fn``); the

@@ -184,19 +184,26 @@ def cmd_train(args) -> int:
 
     # reject axis requests the selected model path won't use — the mesh
     # would carve devices onto a dead axis and silently replicate compute
-    if args.model == "moe":
-        if args.seq > 1:
-            raise SystemExit(
-                "--seq is not supported with --model moe "
-                "(no ring-attention path for MoE yet)"
-            )
-    elif args.expert > 1:
+    if args.model != "moe" and args.expert > 1:
         raise SystemExit("--expert requires --model moe")
-    if args.model != "moe" and args.pipe > 1 and args.seq > 1:
+    if args.pipe > 1 and args.seq > 1:
         raise SystemExit("--pipe and --seq cannot be combined yet")
 
     mesh = _build_mesh(args, bootstrap)
     n = mesh.size
+
+    def _sp_attn_fn():
+        """Sequence-parallel attention for --seq>1 (both model families;
+        the fns are global-view, so jit reshards q/k/v around them)."""
+        if args.seq <= 1:
+            return None
+        if getattr(args, "sp_impl", "ring") == "ulysses":
+            from .parallel.ulysses import make_ulysses_attn_fn
+
+            return make_ulysses_attn_fn(mesh)
+        from .parallel.ring import make_ring_attn_fn
+
+        return make_ring_attn_fn(mesh)
 
     optimizer = None
     if args.optimizer == "adam8bit":
@@ -218,7 +225,7 @@ def cmd_train(args) -> int:
             from .models.moe import make_train_step
 
             step, init_all, _ = make_train_step(
-                cfg, mesh, optimizer=optimizer
+                cfg, mesh, optimizer=optimizer, attn_fn=_sp_attn_fn()
             )
     else:
         from .models.llama import make_train_step
@@ -232,18 +239,8 @@ def cmd_train(args) -> int:
                 optimizer=optimizer,
             )
         else:
-            attn_fn = None
-            if args.seq > 1:
-                if getattr(args, "sp_impl", "ring") == "ulysses":
-                    from .parallel.ulysses import make_ulysses_attn_fn
-
-                    attn_fn = make_ulysses_attn_fn(mesh)
-                else:
-                    from .parallel.ring import make_ring_attn_fn
-
-                    attn_fn = make_ring_attn_fn(mesh)
             step, init_all, _ = make_train_step(
-                cfg, mesh, optimizer=optimizer, attn_fn=attn_fn
+                cfg, mesh, optimizer=optimizer, attn_fn=_sp_attn_fn()
             )
 
     start_step = 0
