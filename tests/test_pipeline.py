@@ -136,6 +136,36 @@ class TestPipelineTrainStep:
 
         np.testing.assert_allclose(pp_losses, ref_losses, atol=2e-2)
 
+    def test_composes_with_seq_parallel(self):
+        """pp x sp: the ring runs INSIDE the stage's manual region (the
+        region extends to {pipe, seq}; rope angles sliced per shard) and
+        must not change the math — per-step losses track the plain
+        unsharded step."""
+        cfg = LlamaConfig.tiny()
+        toks = jax.random.randint(
+            jax.random.key(6), (8, 65), 0, cfg.vocab_size, jnp.int32
+        )
+
+        mesh = make_mesh(plan_axes(8, pipe=2, seq=2, fsdp=2, data=1))
+        step, init_all, _ = make_pipeline_train_step(
+            cfg, mesh, n_microbatches=4, seq_axis="seq",
+        )
+        p, o = init_all(jax.random.key(0))
+        sp_losses = []
+        for _ in range(2):
+            p, o, loss = step(p, o, toks)
+            sp_losses.append(float(loss))
+
+        mesh_ref = make_mesh(plan_axes(8))
+        step_ref, init_ref, _ = make_train_step(cfg, mesh_ref)
+        p, o = init_ref(jax.random.key(0))
+        ref_losses = []
+        for _ in range(2):
+            p, o, loss = step_ref(p, o, toks)
+            ref_losses.append(float(loss))
+
+        np.testing.assert_allclose(sp_losses, ref_losses, atol=2e-2)
+
 
 class TestMoePipeline:
     def test_loss_decreases_pp2_ep2_fsdp2(self):

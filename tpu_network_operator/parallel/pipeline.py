@@ -33,10 +33,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 def _stage_kernel(
     layer_fn: Callable,            # (x [b,s,h], lp_local) -> (x', aux)
     n_micro: int,
+    compute_dtype,
+    param_dtypes,                  # pytree of the ORIGINAL leaf dtypes
     layers_local,                  # pytree, leaves [L/S, ...]
     xmb,                           # [M, b, s, h] microbatched activations
 ):
-    """Per-stage body, manual only over ``pipe``.
+    """Per-stage body, manual over ``pipe`` (plus the seq axis when
+    composing with sequence parallelism — ``pipeline_apply(seq_axis=)``).
 
     Runs M + S - 1 ticks: stage 0 feeds a fresh microbatch each tick,
     interior stages transform what arrives from the left, the last stage
@@ -52,9 +55,14 @@ def _stage_kernel(
     rank = jax.lax.axis_index("pipe")
     n = jax.lax.axis_size("pipe")
     ticks = n_micro + n - 1
-    # xmb crosses the boundary in f32 (see pipeline_apply) — back to the
-    # compute dtype here
-    xmb = xmb.astype(jax.tree.leaves(layers_local)[0].dtype)
+    # xmb (and, in the CPU seq-parallel case, the layer params — see
+    # pipeline_apply) cross the boundary in f32 — back to each leaf's
+    # ORIGINAL dtype here (a single target dtype would silently downcast
+    # deliberately-f32 leaves like the MoE router)
+    xmb = xmb.astype(compute_dtype)
+    layers_local = jax.tree.map(
+        lambda a, dt: a.astype(dt), layers_local, param_dtypes
+    )
 
     def local_stack(x):
         def body(carry, lp):
@@ -113,6 +121,7 @@ def pipeline_apply(
     mesh: Mesh,
     n_microbatches: int,
     with_aux: bool = False,
+    seq_axis: Optional[str] = None,
 ):
     """Run x through the layer stack pipelined over ``mesh``'s pipe axis.
 
@@ -120,6 +129,12 @@ def pipeline_apply(
     ``P("pipe", ...)`` on the leading (layer) axis; batch B must divide by
     ``n_microbatches``.  With ``with_aux`` the layer returns (x, aux) and
     the call returns (out, aux_mean) — the MoE router-loss path.
+
+    ``seq_axis``: compose with sequence parallelism — the manual region
+    extends to {pipe, seq_axis}, activations are sequence-sharded along
+    it, and ``layer_fn`` is responsible for seq-aware attention
+    (``ring.ring_attn_in_manual``) and absolute rope positions (the
+    stage body sees only its local sequence chunk).
     """
     n_stages = mesh.shape["pipe"]
     b = x.shape[0]
@@ -145,12 +160,27 @@ def pipeline_apply(
     xmb = x.reshape(
         (n_microbatches, b // n_microbatches) + x.shape[1:]
     ).astype(jnp.float32)
+    compute_dtype = jax.tree.leaves(layers_params)[0].dtype
+    param_dtypes = jax.tree.map(lambda a: a.dtype, layers_params)
+    if seq_axis and jax.default_backend() == "cpu":
+        # with a seq axis the params are REPLICATED over it, so their AD
+        # transpose is a psum over `seq` — which XLA's CPU backend aborts
+        # on for bf16 (the same bug as the activation boundary above);
+        # cross in f32 there.  TPU keeps the params bf16 on the wire.
+        layers_params = jax.tree.map(
+            lambda a: a.astype(jnp.float32)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a,
+            layers_params,
+        )
+    # [M, b_micro, s, h]: sequence dim sharded when composing with SP
+    x_spec = P(None, None, seq_axis, None) if seq_axis else P()
     out, aux = jax.shard_map(
-        partial(_stage_kernel, aux_fn, n_microbatches),
+        partial(_stage_kernel, aux_fn, n_microbatches, compute_dtype,
+                param_dtypes),
         mesh=mesh,
-        axis_names={"pipe"},
-        in_specs=(P("pipe"), P()),
-        out_specs=(P(), P()),
+        axis_names={"pipe", seq_axis} if seq_axis else {"pipe"},
+        in_specs=(P("pipe"), x_spec),
+        out_specs=(x_spec, P()),
         check_vma=False,
     )(layers_params, xmb)
     out = out.reshape(x.shape)
@@ -168,11 +198,18 @@ def _make_pipelined_step(
     make_block: Callable,          # (cos, sin, attn_fn) -> (x, lp) -> out
     with_aux: bool,
     aux_weight: float,
+    seq_axis: Optional[str] = None,
 ):
     """Shared pipeline train-step builder: ONE copy of the policy both
     model families must agree on — the pipe-remap of the stacked-layer
     specs, the token/replicated shardings, the f32 boundary rule (inside
-    pipeline_apply), remat wiring, and the loss assembly."""
+    pipeline_apply), remat wiring, and the loss assembly.
+
+    ``seq_axis``: compose with ring sequence parallelism — the stage
+    region goes manual over {pipe, seq_axis}, attention becomes the raw
+    in-manual ring body, and rope angles are sliced to each shard's
+    absolute positions (a nested shard_map would try to rebind ``pipe``
+    and is rejected by the partitioner, so SP lives inside the stage)."""
     from ..models.training import make_sharded_train_step, next_token_xent
     from ..ops.attention import causal_attention
     from ..ops.norms import rms_norm
@@ -184,7 +221,12 @@ def _make_pipelined_step(
     # shard_map; without one → an unsharded pallas_call GSPMD would
     # replicate) are both wrong.  GSPMD partitions the fused attention
     # over the auto batch/tensor axes correctly.
-    attn_fn = attn_fn or causal_attention
+    if seq_axis:
+        from .ring import ring_attn_in_manual
+
+        attn_fn = partial(ring_attn_in_manual, axis=seq_axis)
+    else:
+        attn_fn = attn_fn or causal_attention
 
     # model specs, with the stacked-layer axis pipe-sharded
     specs = param_specs_fn(cfg)
@@ -205,14 +247,25 @@ def _make_pipelined_step(
         cos, sin = rope_angles(
             tokens.shape[1], cfg.head_dim, cfg.rope_theta
         )
-        block = make_block(cos, sin, attn_fn)
+        if seq_axis:
+            # the stage body sees only its local sequence chunk: slice
+            # the (closed-over, replicated) angle tables to the shard's
+            # absolute positions before handing them to the layer
+            def block(x, lp):
+                i = jax.lax.axis_index(seq_axis)
+                sl = x.shape[1]
+                cos_l = jax.lax.dynamic_slice_in_dim(cos, i * sl, sl, 0)
+                sin_l = jax.lax.dynamic_slice_in_dim(sin, i * sl, sl, 0)
+                return make_block(cos_l, sin_l, attn_fn)(x, lp)
+        else:
+            block = make_block(cos, sin, attn_fn)
         if cfg.remat:
             from ..models.training import remat_policy
 
             block = jax.checkpoint(block, policy=remat_policy(cfg))
         out = pipeline_apply(
             block, params["layers"], x, mesh, n_microbatches,
-            with_aux=with_aux,
+            with_aux=with_aux, seq_axis=seq_axis,
         )
         x, aux = out if with_aux else (out, 0.0)
         x = rms_norm(x, params["ln_final"], cfg.rms_eps)
@@ -233,6 +286,7 @@ def make_pipeline_train_step(
     n_microbatches: int = 4,
     optimizer=None,
     attn_fn: Optional[Callable] = None,
+    seq_axis: Optional[str] = None,
 ):
     """Pipeline-parallel Llama training step over the mesh's ``pipe`` axis.
 
@@ -240,7 +294,9 @@ def make_pipeline_train_step(
     (params, opt_state, tokens) → (params, opt_state, loss) — but the
     stacked layers are stage-sharded (leading axis on ``pipe``) and the
     batch streams through in microbatches.  Composes with data/fsdp
-    (batch) and tensor (head/ffn) axes, which remain auto-partitioned.
+    (batch) and tensor (head/ffn) axes, which remain auto-partitioned,
+    and — via ``seq_axis="seq"`` — with ring sequence parallelism
+    (activations sequence-sharded through the stages).
     """
     from ..models import llama
 
@@ -252,7 +308,7 @@ def make_pipeline_train_step(
     return _make_pipelined_step(
         cfg, mesh, n_microbatches, optimizer, attn_fn,
         llama.param_specs, partial(llama.init_params, cfg=cfg),
-        make_block, with_aux=False, aux_weight=0.0,
+        make_block, with_aux=False, aux_weight=0.0, seq_axis=seq_axis,
     )
 
 

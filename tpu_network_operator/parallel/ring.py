@@ -306,6 +306,32 @@ def ring_attention(
     )(q, k, v)
 
 
+def ring_attn_in_manual(q, k, v, axis: str = "seq") -> jnp.ndarray:
+    """Per-device ring attention for callers ALREADY inside a manual
+    region over ``axis`` — the pipeline's stage kernel extends its manual
+    set to {pipe, seq} and calls this raw body (a nested ``shard_map``
+    would try to rebind ``pipe`` and is rejected by the partitioner).
+
+    q: [B, s_local, H, D]; k/v: [B, s_local, Hkv, D] — the local chunk of
+    a sequence laid out in ring order along ``axis``.  Pure lax + axis
+    collectives, XLA per-chunk math (a ``pallas_call`` under the auto
+    batch/tensor axes would be replicated by the partitioner).
+    """
+    h, hkv = q.shape[2], k.shape[2]
+    if hkv != h:
+        k = repeat_kv(k, h // hkv)
+        v = repeat_kv(v, h // hkv)
+    if jax.default_backend() == "cpu":
+        # XLA's CPU backend aborts on bf16 collectives inside a
+        # manual-SUBSET region (same bug the pipeline's f32 boundary
+        # works around); upcast the ring hops there — the TPU path keeps
+        # bf16 K/V on the wire
+        k = k.astype(jnp.float32)
+        v = v.astype(jnp.float32)
+    scale = q.shape[-1] ** -0.5
+    return _ring_kernel(axis, scale, q, k, v)
+
+
 def make_ring_attn_fn(mesh: Mesh, axis: str = "seq"):
     """Adapter matching the model's ``attn_fn`` signature."""
 

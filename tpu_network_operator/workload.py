@@ -186,18 +186,32 @@ def cmd_train(args) -> int:
     # would carve devices onto a dead axis and silently replicate compute
     if args.model != "moe" and args.expert > 1:
         raise SystemExit("--expert requires --model moe")
-    if args.pipe > 1 and args.seq > 1:
-        raise SystemExit("--pipe and --seq cannot be combined yet")
+    sp_impl = getattr(args, "sp_impl", "ring")
+    # moe check first: its pp x seq is rejected for BOTH sp schemes, so
+    # the ulysses message's "use ring" advice must not fire for moe
+    if args.model == "moe" and args.pipe > 1 and args.seq > 1:
+        raise SystemExit(
+            "--pipe with --seq is not supported for --model moe yet "
+            "(the router aux is not seq-replicated inside the stage)"
+        )
+    if args.pipe > 1 and args.seq > 1 and sp_impl == "ulysses":
+        raise SystemExit(
+            "--sp-impl ulysses cannot nest inside the pipeline region; "
+            "use --sp-impl ring with --pipe"
+        )
 
     mesh = _build_mesh(args, bootstrap)
     n = mesh.size
 
     def _sp_attn_fn():
         """Sequence-parallel attention for --seq>1 (both model families;
-        the fns are global-view, so jit reshards q/k/v around them)."""
-        if args.seq <= 1:
+        the fns are global-view, so jit reshards q/k/v around them).
+        The pipeline composes with SP differently — via its own
+        seq_axis mechanism, not an attn_fn (see make_pipeline_train_step)
+        — so this returns None when pipelining."""
+        if args.seq <= 1 or args.pipe > 1:
             return None
-        if getattr(args, "sp_impl", "ring") == "ulysses":
+        if sp_impl == "ulysses":
             from .parallel.ulysses import make_ulysses_attn_fn
 
             return make_ulysses_attn_fn(mesh)
@@ -237,6 +251,7 @@ def cmd_train(args) -> int:
             step, init_all, _ = make_pipeline_train_step(
                 cfg, mesh, n_microbatches=args.microbatches,
                 optimizer=optimizer,
+                seq_axis="seq" if args.seq > 1 else None,
             )
         else:
             step, init_all, _ = make_train_step(
