@@ -91,6 +91,44 @@ class TestGenerate:
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         assert not np.array_equal(np.asarray(a), np.asarray(c))
 
+    def test_top_k_one_is_greedy(self, tiny, tiny_params):
+        prompt = jnp.ones((2, 4), jnp.int32)
+        greedy = generate(tiny_params, prompt, tiny, 5, temperature=0.0)
+        k1 = generate(
+            tiny_params, prompt, tiny, 5, temperature=0.7, top_k=1,
+            key=jax.random.key(9),
+        )
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+    def test_tiny_top_p_is_greedy(self, tiny, tiny_params):
+        # top_p below the argmax's probability keeps exactly one id
+        prompt = jnp.ones((2, 4), jnp.int32)
+        greedy = generate(tiny_params, prompt, tiny, 5, temperature=0.0)
+        p0 = generate(
+            tiny_params, prompt, tiny, 5, temperature=0.7, top_p=1e-6,
+            key=jax.random.key(9),
+        )
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(p0))
+
+    def test_top_k_masks_tail(self):
+        from tpu_network_operator.models.generate import _sample
+
+        logits = jnp.asarray([[3.0, 2.0, 1.0, 0.0, -1.0]] * 4)
+        toks = jax.vmap(
+            lambda k: _sample(logits, 1.0, k, top_k=2)
+        )(jax.random.split(jax.random.key(0), 64))
+        assert set(np.asarray(toks).ravel().tolist()) <= {0, 1}
+
+    def test_top_p_masks_tail(self):
+        from tpu_network_operator.models.generate import _sample
+
+        # probs ~ [0.64, 0.24, 0.09, 0.02, 0.01]: top_p=0.7 keeps {0, 1}
+        logits = jnp.asarray([[4.0, 3.0, 2.0, 0.5, -0.5]] * 4)
+        toks = jax.vmap(
+            lambda k: _sample(logits, 1.0, k, top_p=0.7)
+        )(jax.random.split(jax.random.key(1), 64))
+        assert set(np.asarray(toks).ravel().tolist()) <= {0, 1}
+
     def test_rejects_short_max_len(self, tiny, tiny_params):
         with pytest.raises(ValueError, match="max_len"):
             generate(

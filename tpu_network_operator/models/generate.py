@@ -113,13 +113,40 @@ def forward_with_cache(
     return logits, {"k": ck, "v": cv}
 
 
-def _sample(logits: jnp.ndarray, temperature: float, key) -> jnp.ndarray:
-    """logits [B, V] -> tokens [B].  Greedy at temperature 0."""
+def _sample(
+    logits: jnp.ndarray,
+    temperature: float,
+    key,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jnp.ndarray:
+    """logits [B, V] -> tokens [B].  Greedy at temperature 0; otherwise
+    categorical over temperature-scaled logits, optionally truncated to
+    the top-k ids and/or the top-p (nucleus) probability mass.  All
+    branches are static in the config, so the decode loop stays one
+    compiled program."""
+    if top_p <= 0.0:
+        raise ValueError(
+            f"top_p must be in (0, 1] (got {top_p}); use top_k=1 or "
+            "temperature=0 for greedy decoding"
+        )
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(
-        key, logits / temperature, axis=-1
-    ).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]      # [B, 1]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if 0.0 < top_p < 1.0:
+        # keep the smallest prefix of descending-prob ids whose mass
+        # reaches top_p (the id crossing the threshold stays included)
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1)
+        keep = csum - probs < top_p                          # [B, V] sorted
+        count = jnp.sum(keep, axis=-1, keepdims=True)        # [B, 1]
+        cutoff = jnp.take_along_axis(sorted_logits, count - 1, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
 def generate(
@@ -129,6 +156,8 @@ def generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     key: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
     mesh: Optional[Mesh] = None,
@@ -136,8 +165,9 @@ def generate(
     """Prompt + sampled continuation, [B, S + max_new_tokens].
 
     Jit-safe (shapes static in prompt length and budget); greedy when
-    ``temperature == 0`` (then ``key`` is unused).  With a ``mesh``, the
-    KV cache is pinned to the training head layout (:func:`cache_specs`).
+    ``temperature == 0`` (then ``key``/``top_k``/``top_p`` are unused).
+    With a ``mesh``, the KV cache is pinned to the training head layout
+    (:func:`cache_specs`).
     """
     b, s = prompt.shape
     max_len = max_len if max_len is not None else s + max_new_tokens
@@ -158,7 +188,7 @@ def generate(
         }
     logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
     key, sub = jax.random.split(key)
-    tok = _sample(logits[:, -1], temperature, sub)
+    tok = _sample(logits[:, -1], temperature, sub, top_k, top_p)
 
     def body(carry, _):
         tok, pos, cache, key = carry
@@ -166,7 +196,7 @@ def generate(
             params, tok[:, None], cache, pos, cfg
         )
         key, sub = jax.random.split(key)
-        nxt = _sample(logits[:, -1], temperature, sub)
+        nxt = _sample(logits[:, -1], temperature, sub, top_k, top_p)
         return (nxt, pos + 1, cache, key), tok
 
     (tok, _, _, _), toks = jax.lax.scan(
@@ -181,6 +211,8 @@ def make_generate_fn(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     mesh: Optional[Mesh] = None,
 ):
     """Jitted generate with params/prompt shardings pinned when a mesh is
@@ -189,7 +221,7 @@ def make_generate_fn(
 
     gen = partial(
         generate, cfg=cfg, max_new_tokens=max_new_tokens,
-        temperature=temperature, mesh=mesh,
+        temperature=temperature, top_k=top_k, top_p=top_p, mesh=mesh,
     )
     if mesh is None:
         return jax.jit(gen)

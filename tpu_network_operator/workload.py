@@ -104,7 +104,9 @@ def _moe_presets():
     }
 
 
-LLAMA_PRESET_NAMES = ("tiny", "llama3-1b", "llama3-3b", "llama3-8b")
+LLAMA_PRESET_NAMES = (
+    "tiny", "llama3-150m", "llama3-1b", "llama3-3b", "llama3-8b"
+)
 MOE_PRESET_NAMES = ("tiny", "small", "mixtral-8x7b")
 
 
@@ -206,10 +208,10 @@ def cmd_train(args) -> int:
     def _sp_attn_fn():
         """Sequence-parallel attention for --seq>1 (both model families;
         the fns are global-view, so jit reshards q/k/v around them).
-        The pipeline composes with SP differently — via its own
-        seq_axis mechanism, not an attn_fn (see make_pipeline_train_step)
-        — so this returns None when pipelining."""
-        if args.seq <= 1 or args.pipe > 1:
+        Only the non-pipeline branches call this — the pipeline composes
+        with SP via its own seq_axis mechanism instead (see
+        make_pipeline_train_step)."""
+        if args.seq <= 1:
             return None
         if sp_impl == "ulysses":
             from .parallel.ulysses import make_ulysses_attn_fn
@@ -357,7 +359,8 @@ def cmd_generate(args) -> int:
     )(jax.random.key(0))
     prompt = jnp.ones((args.batch, args.prompt_len), jnp.int32)
     gen = make_generate_fn(
-        cfg, args.max_new_tokens, temperature=args.temperature, mesh=mesh
+        cfg, args.max_new_tokens, temperature=args.temperature,
+        top_k=args.top_k, top_p=args.top_p, mesh=mesh,
     )
 
     t0 = time.perf_counter()
@@ -442,6 +445,10 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--prompt-len", type=int, default=16)
     g.add_argument("--max-new-tokens", type=int, default=32)
     g.add_argument("--temperature", type=float, default=0.0)
+    g.add_argument("--top-k", type=int, default=0,
+                   help="truncate sampling to the k highest-prob ids")
+    g.add_argument("--top-p", type=float, default=1.0,
+                   help="nucleus sampling: smallest top-p probability mass")
     g.set_defaults(fn=cmd_generate)
     return p
 
