@@ -156,6 +156,11 @@ def measure(name, cfg, batch, seq, n, kind, make_train_step, mesh, jax, jnp,
         params, opt_state, loss = step(params, opt_state, tokens)
     loss_val = sync(loss)
     dt = time.perf_counter() - t0
+    # numerics guard: a rung whose training is broken (NaN/inf loss, or
+    # loss far above ln(vocab) ~ 11.8 after 13 steps from scratch) must
+    # not become the headline on speed alone — raise to fall through
+    if not (0.0 < loss_val < 20.0):
+        raise RuntimeError(f"implausible loss {loss_val} — rung rejected")
     tok_per_sec_chip = batch * seq * iters / dt / n
 
     pk = peak_flops(kind)
