@@ -190,6 +190,39 @@ class TestMoePipeline:
             losses.append(float(loss))
         assert losses[-1] < losses[0]
 
+    def test_composes_with_seq_parallel(self):
+        """MoE pp x ep x sp: routing groups become seq-shard-local and
+        the aux mean extends over seq shards; the model itself is
+        unchanged, so training must work and the first-step loss must
+        land near the plain step's (routing-group quantization differs,
+        hence the loose bound)."""
+        from tpu_network_operator.models.moe import MoEConfig
+        from tpu_network_operator.models.moe import (
+            make_train_step as make_moe_train_step,
+        )
+        from tpu_network_operator.parallel import make_moe_pipeline_train_step
+
+        cfg = MoEConfig.tiny()
+        toks = jax.random.randint(
+            jax.random.key(8), (8, 65), 0, cfg.vocab_size, jnp.int32
+        )
+        mesh = make_mesh(plan_axes(8, pipe=2, expert=2, seq=2, fsdp=1))
+        step, init_all, _ = make_moe_pipeline_train_step(
+            cfg, mesh, n_microbatches=4, seq_axis="seq"
+        )
+        p, o = init_all(jax.random.key(0))
+        losses = []
+        for _ in range(3):
+            p, o, loss = step(p, o, toks)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+        mesh_1 = make_mesh(plan_axes(8))
+        step_1, init_1, _ = make_moe_train_step(cfg, mesh_1)
+        p, o = init_1(jax.random.key(0))
+        _, _, loss_1 = step_1(p, o, toks)
+        assert abs(losses[0] - float(loss_1)) < 5e-2
+
     def test_tracks_plain_moe_step(self):
         """Pipelining MoE changes the routing-group size (per microbatch)
         and the aux estimator, not the model: first-step losses must be

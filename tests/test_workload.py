@@ -124,14 +124,11 @@ def test_train_from_bootstrap_file(capsys, tmp_path):
 def test_train_rejects_dead_axes():
     with pytest.raises(SystemExit, match="expert requires"):
         main(["train", "--preset", "tiny", "--expert", "2"])
-    # pp x sp is supported for llama (ring inside the stage region);
-    # still rejected: ulysses inside a pipeline, and moe pp x sp
+    # pp x sp is supported for both families (ring inside the stage
+    # region); the one remaining rejection is ulysses inside a pipeline
     with pytest.raises(SystemExit, match="cannot nest"):
         main(["train", "--preset", "tiny", "--seq", "2", "--pipe", "2",
               "--sp-impl", "ulysses"])
-    with pytest.raises(SystemExit, match="not supported for --model moe"):
-        main(["train", "--model", "moe", "--preset", "tiny",
-              "--seq", "2", "--pipe", "2"])
 
 
 def test_train_moe_pipeline(capsys):

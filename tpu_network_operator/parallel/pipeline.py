@@ -35,6 +35,7 @@ def _stage_kernel(
     n_micro: int,
     compute_dtype,
     param_dtypes,                  # pytree of the ORIGINAL leaf dtypes
+    seq_axis,                      # None, or the seq mesh axis (also manual)
     layers_local,                  # pytree, leaves [L/S, ...]
     xmb,                           # [M, b, s, h] microbatched activations
 ):
@@ -107,10 +108,16 @@ def _stage_kernel(
     # on TPU the f32 upcast of one activation tensor is noise
     banked = jnp.where(rank == n - 1, outputs, 0).astype(jnp.float32)
     out = jax.lax.psum(banked, "pipe").astype(outputs.dtype)
-    # mean over (layers x microbatches): every stage contributed its
-    # local-layer sums for its M valid ticks
+    # mean over (layers x microbatches x seq shards): every stage
+    # contributed its local-layer sums for its M valid ticks; when the
+    # region is also manual over `seq`, each seq shard contributed its
+    # local routing group's aux, so reduce over both and renormalize
     L_total = jax.tree.leaves(layers_local)[0].shape[0] * n
-    aux_mean = jax.lax.psum(aux_total, "pipe") / (L_total * n_micro)
+    aux_axes = ("pipe",) if seq_axis is None else ("pipe", seq_axis)
+    groups = n_micro * (
+        1 if seq_axis is None else jax.lax.axis_size(seq_axis)
+    )
+    aux_mean = jax.lax.psum(aux_total, aux_axes) / (L_total * groups)
     return out, aux_mean
 
 
@@ -176,7 +183,7 @@ def pipeline_apply(
     x_spec = P(None, None, seq_axis, None) if seq_axis else P()
     out, aux = jax.shard_map(
         partial(_stage_kernel, aux_fn, n_microbatches, compute_dtype,
-                param_dtypes),
+                param_dtypes, seq_axis),
         mesh=mesh,
         axis_names={"pipe", seq_axis} if seq_axis else {"pipe"},
         in_specs=(P("pipe"), x_spec),
@@ -318,13 +325,20 @@ def make_moe_pipeline_train_step(
     n_microbatches: int = 4,
     optimizer=None,
     attn_fn: Optional[Callable] = None,
+    seq_axis: Optional[str] = None,
 ):
     """Pipeline-parallel MoE training step: stages over ``pipe``, experts
     over ``expert`` (the MoE all-to-all stays auto-partitioned inside the
     manual-over-pipe region), batch over data/fsdp.  The router aux loss
     accumulates per valid (layer, microbatch) tick inside the pipeline —
     see ``_stage_kernel`` — giving the microbatched estimator of
-    ``moe.loss_fn``'s batch-mean aux."""
+    ``moe.loss_fn``'s batch-mean aux.
+
+    ``seq_axis``: compose with ring sequence parallelism.  Routing
+    groups become (batch row × seq shard)-local — per-expert capacity is
+    quantized per local group rather than over the full sequence, the
+    standard local-group MoE formulation — and the aux estimator extends
+    its mean over seq shards."""
     from ..models import moe
 
     def make_block(cos, sin, attn):
@@ -339,4 +353,5 @@ def make_moe_pipeline_train_step(
         cfg, mesh, n_microbatches, optimizer, attn_fn,
         moe.param_specs, partial(moe.init_params, cfg=cfg),
         make_block, with_aux=True, aux_weight=cfg.router_aux_weight,
+        seq_axis=seq_axis,
     )

@@ -189,13 +189,6 @@ def cmd_train(args) -> int:
     if args.model != "moe" and args.expert > 1:
         raise SystemExit("--expert requires --model moe")
     sp_impl = getattr(args, "sp_impl", "ring")
-    # moe check first: its pp x seq is rejected for BOTH sp schemes, so
-    # the ulysses message's "use ring" advice must not fire for moe
-    if args.model == "moe" and args.pipe > 1 and args.seq > 1:
-        raise SystemExit(
-            "--pipe with --seq is not supported for --model moe yet "
-            "(the router aux is not seq-replicated inside the stage)"
-        )
     if args.pipe > 1 and args.seq > 1 and sp_impl == "ulysses":
         raise SystemExit(
             "--sp-impl ulysses cannot nest inside the pipeline region; "
@@ -236,6 +229,7 @@ def cmd_train(args) -> int:
             step, init_all, _ = make_moe_pipeline_train_step(
                 cfg, mesh, n_microbatches=args.microbatches,
                 optimizer=optimizer,
+                seq_axis="seq" if args.seq > 1 else None,
             )
         else:
             from .models.moe import make_train_step
