@@ -101,6 +101,55 @@ class TestMemmap:
             MemmapTokens(str(path))
 
 
+class TestTokenizeCorpus:
+    """tools/tokenize_corpus.py closes the text -> .bin -> train loop."""
+
+    def _tool(self):
+        import importlib.util
+        import pathlib
+
+        path = (pathlib.Path(__file__).parent.parent
+                / "tools" / "tokenize_corpus.py")
+        spec = importlib.util.spec_from_file_location("tokenize_corpus",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_bytes_roundtrip_and_separator(self, tmp_path):
+        tool = self._tool()
+        a = tmp_path / "a.txt"
+        b = tmp_path / "b.txt"
+        a.write_text("hello")
+        b.write_text("wörld")        # multibyte utf-8
+        out = tmp_path / "tokens.bin"
+        assert tool.main([str(a), str(b), "-o", str(out)]) == 0
+        ids = np.fromfile(out, np.uint16)
+        # a + NUL separator + b (utf-8 byte counts)
+        assert ids.size == 5 + 1 + 6
+        assert ids[5] == tool.BYTE_SEP
+        assert bytes(ids[:5].astype(np.uint8)) == b"hello"
+        assert int(ids.max()) < tool.BYTE_VOCAB
+
+    def test_bin_feeds_memmap_pipeline(self, tmp_path):
+        tool = self._tool()
+        text = tmp_path / "c.txt"
+        text.write_text("the quick brown fox " * 20)
+        out = tmp_path / "tokens.bin"
+        tool.main([str(text), "-o", str(out)])
+        src = MemmapTokens(str(out), vocab_size=tool.BYTE_VOCAB)
+        batch = next(local_batches(src, DataConfig(batch=2, seq_len=16)))
+        assert batch.shape == (2, 17)
+        assert (batch >= 0).all() and (batch < tool.BYTE_VOCAB).all()
+
+    def test_empty_inputs_rejected(self, tmp_path):
+        tool = self._tool()
+        empty = tmp_path / "e.txt"
+        empty.write_text("")
+        with pytest.raises(SystemExit, match="no tokens"):
+            tool.main([str(empty), "-o", str(tmp_path / "t.bin")])
+
+
 class TestShardedBatches:
     def test_device_sharding_and_training(self):
         import jax

@@ -1,0 +1,100 @@
+#!/usr/bin/env python3
+"""Tokenize text files into the flat ``.bin`` format the data pipeline
+memmaps (``tpu_network_operator.data.MemmapTokens`` — little-endian
+uint16/uint32 token ids, the nanoGPT convention).
+
+Closes the text → tokens → train loop:
+
+    python tools/tokenize_corpus.py corpus/*.txt -o tokens.bin
+    python -m tpu_network_operator.workload train --data tokens.bin ...
+
+Tokenizers:
+
+* ``bytes`` (default) — hermetic byte-level ids (0-255; NUL, absent
+  from normal text, doubles as the document separator, so the vocab is
+  exactly 256 — matching the ``tiny`` model preset); no downloads,
+  works in air-gapped environments and tests;
+* any HuggingFace tokenizer name or local path via ``--tokenizer`` —
+  requires the ``transformers`` package and, for hub names, cached or
+  downloadable tokenizer files.
+
+ref: the reference repo has no data tooling (not an ML framework); this
+belongs to the validation-workload stack (SURVEY.md §7 stage 6).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+BYTE_SEP = 0            # NUL: absent from normal text, separates docs
+BYTE_VOCAB = 256
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def encode_bytes(texts) -> np.ndarray:
+    """Byte-level ids with a separator between documents."""
+    parts = []
+    for i, text in enumerate(texts):
+        if i:
+            parts.append(np.asarray([BYTE_SEP], np.uint16))
+        parts.append(np.frombuffer(text.encode("utf-8"), np.uint8)
+                     .astype(np.uint16))
+    return np.concatenate(parts) if parts else np.zeros(0, np.uint16)
+
+
+def encode_hf(texts, tokenizer_name: str) -> tuple:
+    """(ids array, vocab_size) via a HuggingFace tokenizer."""
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(tokenizer_name)
+    sep = tok.eos_token_id
+    parts = []
+    for i, text in enumerate(texts):
+        if i and sep is not None:
+            parts.append([sep])
+        parts.append(tok.encode(text, add_special_tokens=False))
+    flat = np.concatenate([np.asarray(p, np.int64) for p in parts]) \
+        if parts else np.zeros(0, np.int64)
+    # len(tok), not tok.vocab_size: added special tokens (eos included on
+    # many Llama-style tokenizers) live ABOVE vocab_size, and both the
+    # dtype choice and the reported vocab must cover them
+    vocab = len(tok)
+    dtype = np.uint16 if vocab <= (1 << 16) else np.uint32
+    return flat.astype(dtype), vocab
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    p.add_argument("inputs", nargs="+", metavar="TEXT_FILE")
+    p.add_argument("-o", "--output", required=True, metavar="TOKENS.bin")
+    p.add_argument("--tokenizer", default="bytes",
+                   help="'bytes' (hermetic, default) or a HuggingFace "
+                        "tokenizer name/path")
+    args = p.parse_args(argv)
+
+    texts = []
+    for path in args.inputs:
+        with open(path, encoding="utf-8") as f:
+            texts.append(f.read())
+
+    if args.tokenizer == "bytes":
+        ids, vocab = encode_bytes(texts), BYTE_VOCAB
+    else:
+        ids, vocab = encode_hf(texts, args.tokenizer)
+    if ids.size == 0:
+        raise SystemExit("no tokens produced (empty inputs?)")
+
+    ids.tofile(args.output)
+    log(f"{args.output}: {ids.size} tokens, dtype {ids.dtype.name}, "
+        f"vocab {vocab}, from {len(texts)} file(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
