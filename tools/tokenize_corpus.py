@@ -54,6 +54,9 @@ def encode_hf(texts, tokenizer_name: str) -> tuple:
 
     tok = AutoTokenizer.from_pretrained(tokenizer_name)
     sep = tok.eos_token_id
+    if sep is None and len(texts) > 1:
+        log(f"warning: tokenizer {tokenizer_name!r} has no eos token — "
+            "documents will be concatenated with NO separator")
     parts = []
     for i, text in enumerate(texts):
         if i and sep is not None:
@@ -83,6 +86,10 @@ def main(argv=None) -> int:
         with open(path, encoding="utf-8") as f:
             texts.append(f.read())
 
+    if not any(texts):
+        # checked on the TEXTS, not the id stream: multi-file empty input
+        # would still emit separator ids and slip past an ids.size check
+        raise SystemExit("no tokens produced (empty inputs?)")
     if args.tokenizer == "bytes":
         ids, vocab = encode_bytes(texts), BYTE_VOCAB
     else:
