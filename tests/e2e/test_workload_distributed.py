@@ -4,7 +4,7 @@ The agent e2e tier proves the operator writes correct bootstrap files;
 this tier proves a JAX job actually forms a global mesh from them — two
 real OS processes, each reading its own operator-shaped bootstrap
 (shared coordinator, distinct process_id), running
-``jax.distributed.initialize`` and a cross-process collective on the CPU
+``jax.distributed.initialize`` and cross-process collectives on the CPU
 backend (Gloo).  This is the step the reference leaves to Habana's HCCL
 E2E docs (ref README.md:25-27) and never tests.
 """
@@ -14,6 +14,8 @@ import os
 import socket
 import subprocess
 import sys
+
+import pytest
 
 from tpu_network_operator.agent.tpu.bootstrap import (
     BootstrapConfig,
@@ -42,43 +44,113 @@ def _child_env():
     return env
 
 
-def test_two_processes_form_mesh_and_allreduce(tmp_path):
+def _run_pair(tmp_path, tag, topos, cli_args):
+    """Write one bootstrap per topology (shared fresh coordinator), run
+    the workload CLI in one subprocess per rank, and return each rank's
+    (last-json-line, stderr).  Children are killed on ANY failure — a
+    rank stuck at the coordinator barrier must not outlive the test."""
     port = _free_port()
+    procs = []
+    try:
+        for pid, topo in enumerate(topos):
+            path = tmp_path / f"bootstrap-{tag}{pid}.json"
+            write_bootstrap(
+                BootstrapConfig(
+                    coordinator_address=f"127.0.0.1:{port}",
+                    num_processes=len(topos),
+                    process_id=pid,
+                    topology=topo,
+                ),
+                str(path),
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "tpu_network_operator.workload",
+                 *cli_args, "--bootstrap", str(path)],
+                cwd=REPO, env=_child_env(),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            ))
+        results = []
+        for pid, proc in enumerate(procs):
+            out, err = proc.communicate(timeout=150)
+            assert proc.returncode == 0, (
+                f"rank {pid} failed:\nstdout: {out}\nstderr: {err[-2000:]}"
+            )
+            results.append((json.loads(out.strip().splitlines()[-1]), err))
+        return results
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
+def test_two_processes_form_mesh_and_allreduce(tmp_path):
     topo = TpuTopology(
         accelerator_type="v5litepod-2", topology="1x2", ici_mesh=(1, 2),
         num_chips=2, chips_per_host=1, num_hosts=2, num_slices=1,
     )
-    procs = []
-    for pid in range(2):
-        path = tmp_path / f"bootstrap-{pid}.json"
-        write_bootstrap(
-            BootstrapConfig(
-                coordinator_address=f"127.0.0.1:{port}",
-                num_processes=2,
-                process_id=pid,
-                topology=topo,
-            ),
-            str(path),
-        )
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "tpu_network_operator.workload",
-             "collectives", "--bootstrap", str(path),
-             "--axis", "fsdp", "--sizes-mb", "0.25", "--iters", "1"],
-            cwd=REPO, env=_child_env(),
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        ))
-
-    results = []
-    for pid, proc in enumerate(procs):
-        out, err = proc.communicate(timeout=150)
-        assert proc.returncode == 0, (
-            f"process {pid} failed:\nstdout: {out}\nstderr: {err[-2000:]}"
-        )
+    results = _run_pair(
+        tmp_path, "ar", [topo, topo],
+        ["collectives", "--axis", "fsdp", "--sizes-mb", "0.25",
+         "--iters", "1"],
+    )
+    for pid, (r, err) in enumerate(results):
         assert f"process {pid}/2" in err, err[-500:]
-        results.append(json.loads(out.strip().splitlines()[-1]))
-
-    for r in results:
         assert r["metric"] == "collective busbw"
         assert r["axis"] == "fsdp"
         assert r["axis_size"] == 2          # the 2-process global mesh
         assert r["value"] > 0               # the all-reduce really ran
+
+
+def test_two_slices_form_dcn_data_axis(tmp_path):
+    """Multislice: two single-host slices → the slice factor must land on
+    the (DCN) data axis of the mesh each process builds, and the
+    cross-slice all-reduce must run — BASELINE config 5's workload leg."""
+    topos = [
+        TpuTopology(
+            accelerator_type="v5litepod-1", topology="1x1", ici_mesh=(1, 1),
+            num_chips=1, chips_per_host=1, num_hosts=1,
+            num_slices=2, slice_id=slice_id, worker_id=0,
+        )
+        for slice_id in range(2)
+    ]
+    results = _run_pair(
+        tmp_path, "sl", topos,
+        ["collectives", "--axis", "data", "--sizes-mb", "0.25",
+         "--iters", "1"],
+    )
+    for r, _ in results:
+        assert r["axis"] == "data" and r["axis_size"] == 2
+        assert r["value"] > 0
+
+
+@pytest.mark.slow
+def test_two_processes_train_with_sharded_data(tmp_path):
+    """2-process training: every contract layer at once — bootstrap →
+    jax.distributed → global mesh → process-sharded batches
+    (make_array_from_process_local_data) → fsdp-sharded train steps with
+    identical (psum-agreed) losses on both ranks."""
+    import numpy as np
+
+    tokens = np.random.default_rng(0).integers(
+        0, 256, size=20_000
+    ).astype("<u2")
+    bin_path = tmp_path / "tokens.bin"
+    tokens.tofile(bin_path)
+
+    topo = TpuTopology(
+        accelerator_type="v5litepod-2", topology="1x2", ici_mesh=(1, 2),
+        num_chips=2, chips_per_host=1, num_hosts=2, num_slices=1,
+    )
+    results = _run_pair(
+        tmp_path, "tr", [topo, topo],
+        ["train", "--preset", "tiny", "--steps", "2", "--batch", "4",
+         "--seq-len", "32", "--data", str(bin_path)],
+    )
+    losses = []
+    for r, _ in results:
+        assert r["mesh"]["fsdp"] == 2
+        assert 0 < r["final_loss"] < 8
+        losses.append(r["final_loss"])
+    # the loss is psum-reduced over the mesh: both ranks must agree
+    assert losses[0] == losses[1]

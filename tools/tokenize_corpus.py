@@ -42,10 +42,10 @@ def encode_bytes(texts) -> np.ndarray:
     parts = []
     for i, text in enumerate(texts):
         if i:
-            parts.append(np.asarray([BYTE_SEP], np.uint16))
+            parts.append(np.asarray([BYTE_SEP], "<u2"))
         parts.append(np.frombuffer(text.encode("utf-8"), np.uint8)
-                     .astype(np.uint16))
-    return np.concatenate(parts) if parts else np.zeros(0, np.uint16)
+                     .astype("<u2"))
+    return np.concatenate(parts) if parts else np.zeros(0, "<u2")
 
 
 def encode_hf(texts, tokenizer_name: str) -> tuple:
@@ -68,7 +68,8 @@ def encode_hf(texts, tokenizer_name: str) -> tuple:
     # many Llama-style tokenizers) live ABOVE vocab_size, and both the
     # dtype choice and the reported vocab must cover them
     vocab = len(tok)
-    dtype = np.uint16 if vocab <= (1 << 16) else np.uint32
+    # explicit little-endian: the .bin format is LE regardless of host
+    dtype = "<u2" if vocab <= (1 << 16) else "<u4"
     return flat.astype(dtype), vocab
 
 
