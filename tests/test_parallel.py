@@ -15,6 +15,8 @@ from tpu_network_operator.parallel import make_mesh, mesh_from_bootstrap, plan_a
 from tpu_network_operator.parallel.collectives import run_collective
 from tpu_network_operator.parallel.ring import ring_attention
 
+from test_pallas_attention import max_rel
+
 
 class TestMeshPlanning:
     def test_defaults_fill_fsdp(self):
@@ -130,18 +132,12 @@ class TestFlashRing:
             jax.random.normal(ks[2], (B, S, KV, D), dtype),
         )
 
-    @staticmethod
-    def _max_rel(a, b):
-        a = jnp.asarray(a, jnp.float32)
-        b = jnp.asarray(b, jnp.float32)
-        return float(jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(a)) + 1e-9))
-
     def test_auto_picks_flash_and_matches_dense(self, monkeypatch):
         from tpu_network_operator.parallel.ring import _use_flash
 
         # the auto gate is TPU-only (interpret mode is a test vehicle,
         # not a production path) — force it for the CPU mesh
-        monkeypatch.setenv("TPUNET_RING_FLASH", "1")
+        monkeypatch.setenv("TPUNET_SP_FLASH", "1")
         mesh = make_mesh(plan_axes(8, seq=4, tensor=2, fsdp=1, data=1))
         q, k, v = self._qkv()
         assert _use_flash(q.shape[1] // 4, 64, 4, 2, mesh, "tensor")
@@ -149,7 +145,7 @@ class TestFlashRing:
         out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(q, k, v)
         # same bound as the dense flash kernel vs the f32 reference —
         # the kernels run MXU dots in bf16
-        assert self._max_rel(ref, out) < 0.03
+        assert max_rel(ref, out) < 0.03
 
     def test_auto_stays_xla_off_tpu(self):
         from tpu_network_operator.parallel.ring import _use_flash
@@ -171,13 +167,13 @@ class TestFlashRing:
         gx = loss("xla")(q, k, v)
         for a, b, name in zip(gf, gx, "qkv"):
             assert bool(jnp.isfinite(a).all()), f"d{name} not finite"
-            assert self._max_rel(b, a) < 0.05, f"d{name} diverges"
+            assert max_rel(b, a) < 0.05, f"d{name} diverges"
 
     def test_small_head_dim_falls_back(self, monkeypatch):
         from tpu_network_operator.parallel.ring import _use_flash
 
         # force past the backend gate so the SHAPE gate is what's tested
-        monkeypatch.setenv("TPUNET_RING_FLASH", "1")
+        monkeypatch.setenv("TPUNET_SP_FLASH", "1")
         mesh = make_mesh(plan_axes(8, seq=8, tensor=1, fsdp=1, data=1))
         assert not _use_flash(32, 8, 2, 2, mesh, "tensor")       # d < 64
         assert not _use_flash(100, 64, 2, 2, mesh, "tensor")     # seq % block
@@ -215,14 +211,14 @@ class TestUlyssesAttention:
         the gathered full sequence), forced via the shared SP override."""
         from tpu_network_operator.parallel.ulysses import ulysses_attention
 
-        monkeypatch.setenv("TPUNET_RING_FLASH", "1")
+        monkeypatch.setenv("TPUNET_SP_FLASH", "1")
         mesh = make_mesh(plan_axes(8, seq=4, tensor=2, fsdp=1, data=1))
         q, k, v = self._qkv(B=1, S=512, H=8, KV=4, D=64)
         ref = causal_attention(q, k, v)
         out = jax.jit(
             lambda q, k, v: ulysses_attention(q, k, v, mesh)
         )(q, k, v)
-        assert TestFlashRing._max_rel(ref, out) < 0.03
+        assert max_rel(ref, out) < 0.03
 
     def test_gqa_repeats_only_to_divisibility(self):
         from tpu_network_operator.parallel.ulysses import _heads_for

@@ -217,20 +217,29 @@ def _flash_ring_bwd(axis_name, n, bq, bk, res, do):
 _flash_ring.defvjp(_flash_ring_fwd, _flash_ring_bwd)
 
 
+def sp_flash_override():
+    """TPUNET_SP_FLASH=0/1 forces the Pallas path off/on for BOTH
+    sequence-parallel schemes (ring and ulysses; tests use =1 to run the
+    kernels in interpret mode on the CPU mesh).  None when unset."""
+    import os
+
+    return {"0": False, "1": True}.get(
+        os.environ.get("TPUNET_SP_FLASH", "")
+    )
+
+
 def _use_flash(sq_local, head_dim, h, hkv, mesh, head_axis) -> bool:
     """Static gate for ``impl="auto"``: TPU backend only (the kernels
     would run in slow interpret mode anywhere else — same policy as
     ``llama.auto_attention`` and ``optim8bit._use_fused``; tests force
-    the path with ``impl="flash"`` or TPUNET_RING_FLASH=1), plus
+    the path with ``impl="flash"`` or TPUNET_SP_FLASH=1), plus
     flash-compatible local shapes and GQA groups intact per head shard."""
-    import os
-
     from ..ops import pallas_attention as pa
 
-    flag = os.environ.get("TPUNET_RING_FLASH", "")
-    if flag == "0":
+    forced = sp_flash_override()
+    if forced is False:
         return False
-    if flag != "1" and jax.default_backend() != "tpu":
+    if forced is not True and jax.default_backend() != "tpu":
         return False
     t = mesh.shape.get(head_axis, 1) if head_axis else 1
     return (
@@ -261,7 +270,6 @@ def ring_attention(
     h, hkv = q.shape[2], k.shape[2]
     n = mesh.shape.get(axis, 1)
     sq_local = q.shape[1] // max(n, 1)
-    scale = q.shape[-1] ** -0.5
 
     flash = impl == "flash" or (
         impl == "auto" and _use_flash(sq_local, q.shape[-1], h, hkv,
@@ -296,7 +304,7 @@ def ring_attention(
 
     spec = P(batch_axes, axis, head_axis, None)
 
-    kernel = partial(_ring_kernel, axis, scale)
+    kernel = partial(_ring_kernel, axis, q.shape[-1] ** -0.5)
     return shard_map(
         kernel,
         mesh=mesh,

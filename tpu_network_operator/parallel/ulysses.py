@@ -109,18 +109,19 @@ def ulysses_attention(
 
 def _local_attention(q, k, v):
     """Full-sequence causal attention on the local head group: the flash
-    kernel when the static shape gate passes on TPU (or under the test
-    override), else the fused XLA path."""
-    import os
-
+    kernel when the static shape gate passes on TPU (or under the shared
+    SP override — ``ring.sp_flash_override``), else the fused XLA path."""
     from ..ops import pallas_attention as pa
+    from .ring import sp_flash_override
 
     s, d = q.shape[1], q.shape[-1]
     hkv = k.shape[2]
-    flag = os.environ.get("TPUNET_RING_FLASH", "")   # shared SP override
-    on_tpu = jax.default_backend() == "tpu" or flag == "1"
+    forced = sp_flash_override()
+    on_tpu = forced is True or (
+        forced is not False and jax.default_backend() == "tpu"
+    )
     if (
-        flag != "0" and on_tpu and pa.supports(s, s, d)
+        forced is not False and on_tpu and pa.supports(s, s, d)
         and q.shape[2] % hkv == 0
     ):
         return pa.flash_attention(q, k, v)
