@@ -209,18 +209,28 @@ def main() -> None:
     # remains the fallback if they OOM in practice.
     big = dict(xent_chunk=512, remat_policy="full")
     one_b = LlamaConfig.llama3_1b()
+
+    def fam(name, cfg, batch):
+        """A family's rungs: fused-8-bit-adam + saved-FFN remat first
+        (fastest when it fits), then the plain bf16-adamw/full-remat
+        base.  The ladder measures every fitting rung of the headline
+        family and keeps the fastest, so ordering here is just
+        preference, not commitment."""
+        return [
+            (f"{name}+ffn+adam8",
+             dataclasses.replace(cfg, xent_chunk=512, remat_policy="ffn"),
+             batch, 2048, "adam8"),
+            (f"{name}+adam8",
+             dataclasses.replace(cfg, xent_chunk=512,
+                                 remat_policy="ffn_lite"),
+             batch, 2048, "adam8"),
+            (name, dataclasses.replace(cfg, **big), batch, 2048, None),
+        ]
+
     ladder = [
-        ("llama3-8b", dataclasses.replace(LlamaConfig.llama3_8b(), **big),
-         4, 2048, None),
-        ("llama3-3b", dataclasses.replace(LlamaConfig.llama3_3b(), **big),
-         4, 2048, None),
-        ("llama3-1b+ffn+adam8",
-         dataclasses.replace(one_b, xent_chunk=512, remat_policy="ffn"),
-         4, 2048, "adam8"),
-        ("llama3-1b+adam8",
-         dataclasses.replace(one_b, xent_chunk=512, remat_policy="ffn_lite"),
-         4, 2048, "adam8"),
-        ("llama3-1b", dataclasses.replace(one_b, **big), 4, 2048, None),
+        *fam("llama3-8b", LlamaConfig.llama3_8b(), 4),
+        *fam("llama3-3b", LlamaConfig.llama3_3b(), 4),
+        *fam("llama3-1b", one_b, 4),
         ("llama3-150m", LlamaConfig.llama3_150m(), 8, 2048, None),
     ]
     total_hbm = hbm * n

@@ -154,3 +154,24 @@ def test_two_processes_train_with_sharded_data(tmp_path):
         losses.append(r["final_loss"])
     # the loss is psum-reduced over the mesh: both ranks must agree
     assert losses[0] == losses[1]
+
+
+@pytest.mark.slow
+def test_two_processes_sharded_decode(tmp_path):
+    """2-process generation: the KV cache and prompt batch shard over the
+    global mesh (batch on data/fsdp per cache_specs) and the jitted
+    decode loop runs cross-process."""
+    topo = TpuTopology(
+        accelerator_type="v5litepod-2", topology="1x2", ici_mesh=(1, 2),
+        num_chips=2, chips_per_host=1, num_hosts=2, num_slices=1,
+    )
+    results = _run_pair(
+        tmp_path, "ge", [topo, topo],
+        ["generate", "--preset", "tiny", "--batch", "4",
+         "--prompt-len", "8", "--max-new-tokens", "8",
+         "--temperature", "0.7", "--top-k", "8"],
+    )
+    for r, _ in results:
+        assert r["metric"] == "tiny decode throughput"
+        assert r["value"] > 0
+        assert r["out_shape"] == [4, 16]

@@ -217,29 +217,26 @@ def _flash_ring_bwd(axis_name, n, bq, bk, res, do):
 _flash_ring.defvjp(_flash_ring_fwd, _flash_ring_bwd)
 
 
-def sp_flash_override():
-    """TPUNET_SP_FLASH=0/1 forces the Pallas path off/on for BOTH
-    sequence-parallel schemes (ring and ulysses; tests use =1 to run the
-    kernels in interpret mode on the CPU mesh).  None when unset."""
+def sp_flash_enabled() -> bool:
+    """Whether the sequence-parallel schemes may use the Pallas kernels:
+    TPU backend by default (interpret mode is a test vehicle, not a
+    production path — same policy as ``llama.auto_attention`` and
+    ``optim8bit._use_fused``); TPUNET_SP_FLASH=0/1 forces it off/on for
+    BOTH schemes (tests use =1 on the CPU mesh)."""
     import os
 
-    return {"0": False, "1": True}.get(
+    forced = {"0": False, "1": True}.get(
         os.environ.get("TPUNET_SP_FLASH", "")
     )
+    return forced if forced is not None else jax.default_backend() == "tpu"
 
 
 def _use_flash(sq_local, head_dim, h, hkv, mesh, head_axis) -> bool:
-    """Static gate for ``impl="auto"``: TPU backend only (the kernels
-    would run in slow interpret mode anywhere else — same policy as
-    ``llama.auto_attention`` and ``optim8bit._use_fused``; tests force
-    the path with ``impl="flash"`` or TPUNET_SP_FLASH=1), plus
+    """Static gate for ``impl="auto"``: :func:`sp_flash_enabled` plus
     flash-compatible local shapes and GQA groups intact per head shard."""
     from ..ops import pallas_attention as pa
 
-    forced = sp_flash_override()
-    if forced is False:
-        return False
-    if forced is not True and jax.default_backend() != "tpu":
+    if not sp_flash_enabled():
         return False
     t = mesh.shape.get(head_axis, 1) if head_axis else 1
     return (

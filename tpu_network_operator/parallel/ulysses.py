@@ -109,21 +109,14 @@ def ulysses_attention(
 
 def _local_attention(q, k, v):
     """Full-sequence causal attention on the local head group: the flash
-    kernel when the static shape gate passes on TPU (or under the shared
-    SP override — ``ring.sp_flash_override``), else the fused XLA path."""
+    kernel when ``ring.sp_flash_enabled`` and the static shape gate
+    pass, else the fused XLA path."""
     from ..ops import pallas_attention as pa
-    from .ring import sp_flash_override
+    from .ring import sp_flash_enabled
 
     s, d = q.shape[1], q.shape[-1]
     hkv = k.shape[2]
-    forced = sp_flash_override()
-    on_tpu = forced is True or (
-        forced is not False and jax.default_backend() == "tpu"
-    )
-    if (
-        forced is not False and on_tpu and pa.supports(s, s, d)
-        and q.shape[2] % hkv == 0
-    ):
+    if sp_flash_enabled() and pa.supports(s, s, d) and q.shape[2] % hkv == 0:
         return pa.flash_attention(q, k, v)
     return causal_attention(q, k, v)
 

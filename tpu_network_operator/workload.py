@@ -357,12 +357,20 @@ def cmd_generate(args) -> int:
         top_k=args.top_k, top_p=args.top_p, mesh=mesh,
     )
 
+    def run_once():
+        out = gen(params, prompt)
+        # fetch only the LOCAL shard: the output batch is sharded over
+        # the (possibly multi-process) mesh, and device_get on the
+        # global array is illegal when other processes own part of it
+        jax.device_get(out.addressable_shards[0].data)
+        return out
+
     t0 = time.perf_counter()
-    out = jax.device_get(gen(params, prompt))
+    out = run_once()
     log(f"first call (incl. compile) {time.perf_counter() - t0:.1f}s")
     t0 = time.perf_counter()
     with _maybe_profile(args.profile):
-        out = jax.device_get(gen(params, prompt))
+        out = run_once()
     dt = time.perf_counter() - t0
 
     _emit({
