@@ -202,11 +202,11 @@ def main() -> None:
     log(f"devices: {n} x {kind}, HBM {hbm / 2**30:.0f} GiB")
 
     # big rungs: chunked cross-entropy (never materialize [B,S,V] logits)
-    # and full remat (residuals = layer carry only) to fit HBM.  The 1B
-    # "+adam8" rungs trade bf16 adam moments for int8/f8 ones
-    # (models/optim8bit.py) to buy back saved FFN activations — less
-    # backward recompute, the docs/perf.md lever for >50% MFU; plain 1b
-    # remains the fallback if they OOM in practice.
+    # and full remat (residuals = layer carry only) to fit HBM.  Every
+    # family's "+adam8" rungs trade bf16 adam moments for int8/f8 ones
+    # (models/optim8bit.py, fused single-pass update) to buy back saved
+    # FFN activations — less backward recompute, the docs/perf.md lever;
+    # each family's plain base remains the fallback if they OOM.
     big = dict(xent_chunk=512, remat_policy="full")
     one_b = LlamaConfig.llama3_1b()
 

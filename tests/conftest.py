@@ -20,13 +20,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 
 def pytest_configure(config):
-    # belt and braces: pin the platform even if jax was imported elsewhere
-    try:
-        import jax
+    # belt and braces: pin the platform even if jax was imported
+    # elsewhere, and drop the axon PJRT factory whose backend init
+    # blocks on a down tunnel — one shared implementation with the
+    # driver's dry run (see __graft_entry__._pin_cpu_backend)
+    import __graft_entry__
 
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
+    __graft_entry__._pin_cpu_backend()
 
 
 # Modules whose tests compile/train real (tiny) models on the virtual

@@ -359,9 +359,13 @@ def cmd_generate(args) -> int:
 
     def run_once():
         out = gen(params, prompt)
-        # fetch only the LOCAL shard: the output batch is sharded over
-        # the (possibly multi-process) mesh, and device_get on the
-        # global array is illegal when other processes own part of it
+        # sync without fetching the global array (device_get on it is
+        # illegal when other processes own part of it): block on all
+        # local shards, then force one local shard to the host — the
+        # experimental axon platform's ready-flag has been observed not
+        # to block (same workaround as bench.py), and the transfer is
+        # the guarantee there
+        out.block_until_ready()
         jax.device_get(out.addressable_shards[0].data)
         return out
 
