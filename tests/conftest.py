@@ -8,6 +8,10 @@ SURVEY.md §4.2) — flags must be set before jax first imports.
 import os
 import sys
 
+# Repo root on sys.path first: a bare `pytest` from any directory must
+# still import __graft_entry__ (below) and root-level modules (bench).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 # Force, don't setdefault: the axon site package exports JAX_PLATFORMS=axon
 # (one real TPU via tunnel), which would defeat the virtual CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -46,5 +50,3 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if item.module.__name__.rsplit(".", 1)[-1] in SLOW_MODULES:
             item.add_marker(pytest.mark.slow)
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,96 @@
+"""bench.py resilience — the round-3 postmortem tier.
+
+BENCH_r03.json was rc=1 with a bare traceback: one un-retried
+``jax.devices()`` on a dropped TPU tunnel zeroed the round's numbers.
+These tests pin the two fixes: bounded retry with backoff around backend
+init, and a well-formed JSON failure line as the last stdout line on any
+fatal error (the driver parses exactly that).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import bench
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestInitDevices:
+    def test_first_try_success_no_sleep(self):
+        sleeps = []
+        out = bench.init_devices(lambda: ["dev0"], sleep=sleeps.append)
+        assert out == ["dev0"]
+        assert sleeps == []
+
+    def test_retries_with_backoff_then_succeeds(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("UNAVAILABLE: TPU backend setup error")
+            return ["dev0"]
+
+        sleeps = []
+        out = bench.init_devices(flaky, sleep=sleeps.append)
+        assert out == ["dev0"]
+        assert calls["n"] == 3
+        assert sleeps == [bench.INIT_BACKOFFS[0], bench.INIT_BACKOFFS[1]]
+
+    def test_exhausted_budget_raises_last_error(self):
+        sleeps = []
+
+        def dead():
+            raise RuntimeError("tunnel down")
+
+        with pytest.raises(RuntimeError, match="tunnel down"):
+            bench.init_devices(dead, sleep=sleeps.append)
+        # one sleep between each pair of attempts, none after the last
+        assert len(sleeps) == bench.INIT_ATTEMPTS - 1
+        # backoff grows, capped at the table's last entry
+        assert sleeps == sorted(sleeps)
+        assert sleeps[-1] == bench.INIT_BACKOFFS[-1]
+
+
+class TestFailureLine:
+    def test_emit_failure_is_one_json_line(self, capsys):
+        bench.emit_failure(RuntimeError("boom: " + "x" * 1000))
+        out = capsys.readouterr().out
+        lines = [ln for ln in out.splitlines() if ln.strip()]
+        assert len(lines) == 1
+        row = json.loads(lines[0])
+        # the driver's contract keys
+        assert set(row) >= {"metric", "value", "unit", "vs_baseline", "error"}
+        assert row["value"] == 0.0
+        assert row["error"].startswith("RuntimeError: boom")
+        assert len(row["error"]) < 600  # truncated, not a dumped traceback
+
+    def test_dead_backend_emits_json_not_traceback(self):
+        """End-to-end: a broken JAX platform must still produce a parseable
+        last stdout line (rc=1 signals failure to the driver)."""
+        env = dict(os.environ)
+        # drop the axon TPU plugin entirely (its sitecustomize register()
+        # dials the tunnel at interpreter start and blocks when it's down
+        # — the exact failure mode this test must not depend on)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "JAX_PLATFORM_NAME": "cpu",
+            "BENCH_INIT_ATTEMPTS": "2",
+            # unknown rung -> SystemExit path; exercises the __main__ guard
+            "BENCH_CONFIG": "no-such-rung",
+        })
+        proc = subprocess.run(
+            [sys.executable, "bench.py"], cwd=REPO_ROOT, env=env,
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode != 0
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        assert lines, f"no stdout JSON line; stderr tail: {proc.stderr[-500:]}"
+        row = json.loads(lines[-1])
+        assert row["value"] == 0.0
+        assert "no-such-rung" in row["error"]

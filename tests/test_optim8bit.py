@@ -10,6 +10,7 @@ import pytest
 
 from tpu_network_operator.models import LlamaConfig, make_train_step
 from tpu_network_operator.models.optim8bit import (
+    _tile_rows,
     adamw8bit,
     dequantize,
     moment_bytes,
@@ -114,9 +115,10 @@ class TestFusedKernel:
         monkeypatch.setenv("TPUNET_ADAM8_FUSED", "1" if fused else "0")
         opt = adamw8bit(3e-3, weight_decay=0.1)
         key = jax.random.key(7)
-        # BLOCK-divisible leaf (fused-eligible) + odd leaf (always jnp)
+        # fused-eligible leaf (8192 elems -> 32 blocked rows, the minimum
+        # sublane-aligned tiling _tile_rows accepts) + odd leaf (always jnp)
         params = {
-            "w": jax.random.normal(key, (4, 512), jnp.bfloat16),
+            "w": jax.random.normal(key, (16, 512), jnp.bfloat16),
             "odd": jax.random.normal(key, (77,), jnp.bfloat16),
         }
         grads = jax.tree.map(
@@ -146,3 +148,58 @@ class TestFusedKernel:
             np.asarray(vf.q, np.float32), np.asarray(vj.q, np.float32),
             rtol=0.07,   # one f8 ulp
         )
+
+    def test_fused_leaf_actually_fuses(self):
+        # the "w" leaf above must remain kernel-eligible: if _tile_rows
+        # rejects its row count, the parity test silently compares the
+        # jnp path against itself
+        assert _tile_rows(16 * 512 // 256) == 32
+
+    def test_tile_rows_sublane_aligned(self):
+        # every accepted tiling is a 32-multiple exact divisor
+        for nb in (32, 320, 16384, 1_000_000, 1_026_048):
+            rows = _tile_rows(nb)
+            assert rows > 0 and rows % 32 == 0 and nb % rows == 0
+            assert rows <= 512
+        # no aligned divisor -> 0 (caller takes the jnp path): small or
+        # odd row counts that previously produced unaligned tiles
+        for nb in (1, 8, 31, 977):
+            assert _tile_rows(nb) == 0
+
+    def test_eager_fused_update_copies_moment_buffers(self, monkeypatch):
+        """Eager (non-jit) updates must not invalidate the previous
+        Adam8State through the kernel's in-place buffer aliasing.
+
+        CPU/interpret dispatch does not honor donation, so 'old state
+        stays readable' would pass with or without the guard; instead,
+        pin the mechanism: the arrays handed to the kernel must be
+        copies eagerly, and the original tracers under jit."""
+        from tpu_network_operator.models import optim8bit
+
+        seen = []
+        real = optim8bit._fused_leaf_update
+
+        def spy(p2, g2, mq, ms, vq, vs, cc, **kw):
+            seen.append((mq, ms, vq, vs))
+            return real(p2, g2, mq, ms, vq, vs, cc, **kw)
+
+        monkeypatch.setattr(optim8bit, "_fused_leaf_update", spy)
+        monkeypatch.setenv("TPUNET_ADAM8_FUSED", "1")
+        opt = adamw8bit(3e-3, weight_decay=0.1)
+        params = {"w": jnp.ones((16, 512), jnp.bfloat16)}
+        grads = {"w": jnp.full((16, 512), 0.01, jnp.bfloat16)}
+        s0 = opt.init(params)
+
+        _, s1 = opt.update(grads, s0, params)   # eager
+        assert len(seen) == 1
+        originals = (s0.m["w"].q, s0.m["w"].scale,
+                     s0.v["w"].q, s0.v["w"].scale)
+        for passed, orig in zip(seen[0], originals):
+            assert passed is not orig   # copied -> donation hits the copy
+        assert np.asarray(s0.m["w"].q).shape == (32, 256)  # still alive
+
+        seen.clear()
+        jax.jit(lambda g, s, p: opt.update(g, s, p))(grads, s0, params)
+        assert len(seen) == 1
+        for passed in seen[0]:   # traced -> no copy inserted
+            assert isinstance(passed, jax.core.Tracer)

@@ -120,7 +120,8 @@ def _fused_kernel(cc_ref, p_ref, g_ref, mq_ref, ms_ref, vq_ref, vs_ref,
     """One VMEM tile of [rows, BLOCK] blocks: dequantize both moments,
     f32 adam math (identical to the jnp path), requantize, emit the
     parameter update.  Every row is an independent quantization block,
-    so partial edge tiles are safe (out-of-bounds rows are discarded)."""
+    so any divisor-based tiling is valid — :func:`_tile_rows` always
+    picks an exact divisor, the grid never has partial tiles."""
     c1, c2 = cc_ref[0], cc_ref[1]
     g = g_ref[...].astype(jnp.float32)
     m = mq_ref[...].astype(jnp.float32) * ms_ref[...]
@@ -136,11 +137,15 @@ def _fused_kernel(cc_ref, p_ref, g_ref, mq_ref, ms_ref, vq_ref, vs_ref,
 
 
 def _tile_rows(nb: int) -> int:
-    """Largest tile height <= _ROWS that divides the row count, so the
-    grid needs no partial tiles (interpret mode included)."""
+    """Largest tile height <= _ROWS that divides the row count AND is a
+    multiple of 32 — the int8/float8 sublane tile height, so compiled
+    Mosaic gets aligned VMEM blocks (interpret-mode CI would accept any
+    divisor; real TPU may not).  Returns 0 when no such divisor exists —
+    the caller must fall back to the jnp path for that leaf."""
     rows = min(_ROWS, nb)
-    while nb % rows:
-        rows -= 1
+    rows -= rows % 32
+    while rows and nb % rows:
+        rows -= 32
     return rows
 
 
@@ -202,11 +207,12 @@ def adamw8bit(
     """Drop-in for ``optax.adamw`` with int8 moment storage.  Returns an
     optax ``GradientTransformation``-shaped (init, update) pair.
 
-    Call ``update`` under jit (as ``make_sharded_train_step`` does): on
-    the fused single-TPU path the previous state's moment buffers are
-    donated in place (``input_output_aliases``), so an *eager* update
-    invalidates the old ``Adam8State``'s arrays — reading them afterwards
-    raises "Array has been deleted"."""
+    Under jit (as ``make_sharded_train_step`` runs it) the fused
+    single-TPU path donates the previous state's moment buffers in place
+    (``input_output_aliases``).  An *eager* call would silently
+    invalidate the old ``Adam8State``'s arrays through the same aliasing,
+    so eager updates copy the moment buffers first — slightly slower,
+    never surprising."""
     import optax
 
     def init(params):
@@ -228,6 +234,9 @@ def adamw8bit(
         c2 = 1.0 - b2 ** count.astype(jnp.float32)
         cc = jnp.stack([c1, c2])
         fused = _use_fused()
+        # eager (non-traced) fused calls must not invalidate the caller's
+        # old state through the in-place aliasing — copy the moments first
+        tracing = isinstance(count, jax.core.Tracer)
 
         flat_g, treedef = jax.tree.flatten(grads)
         flat_p = treedef.flatten_up_to(params)
@@ -236,12 +245,17 @@ def adamw8bit(
 
         new_m, new_v, updates = [], [], []
         for g, p, mq, vq in zip(flat_g, flat_p, flat_m, flat_v):
-            if fused and block == BLOCK and g.size and g.size % BLOCK == 0:
+            if (fused and block == BLOCK and g.size
+                    and g.size % BLOCK == 0
+                    and _tile_rows(g.size // BLOCK) > 0):
+                moments = (mq.q, mq.scale, vq.q, vq.scale)
+                if not tracing:
+                    moments = tuple(jnp.array(x) for x in moments)
                 # single HBM pass; reshape to the blocked view is a
                 # bitcast (flat row-major), not a copy
                 upd2, nmq, nvq = _fused_leaf_update(
                     p.reshape(-1, BLOCK), g.reshape(-1, BLOCK),
-                    mq.q, mq.scale, vq.q, vq.scale, cc,
+                    *moments, cc,
                     lr=learning_rate, b1=b1, b2=b2, eps=eps,
                     wd=weight_decay,
                 )
