@@ -1,0 +1,86 @@
+"""RoPE layout-equivalence proof and decode-path consistency.
+
+The hot path uses split-half rotation (contiguous lanes); Llama
+reference weights use interleaved pairs.  The conversion contract —
+permute wq/wk output columns by deinterleave_perm, get identical
+attention scores — is what lets checkpoints move between the two, so it
+is pinned here."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_network_operator.ops.rope import (
+    apply_rope,
+    apply_rope_at,
+    convert_interleaved_qk,
+    deinterleave_perm,
+    rope_angles,
+    rotate_interleaved,
+)
+
+
+def test_tables_shape_and_theta():
+    cos, sin = rope_angles(32, 64, theta=10_000.0)
+    assert cos.shape == sin.shape == (32, 32)
+    # position 0 is the identity rotation
+    np.testing.assert_allclose(np.asarray(cos[0]), 1.0, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(sin[0]), 0.0, atol=1e-7)
+
+
+def test_split_half_equals_interleaved_after_permutation():
+    """score(q, k) under interleaved rope == score(q[perm], k[perm])
+    under split-half rope — the checkpoint-conversion invariant."""
+    b, s, h, d = 2, 16, 4, 64
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.float32)
+    cos, sin = rope_angles(s, d)
+    c = cos[:, None, :]
+    sn = sin[:, None, :]
+
+    qi = rotate_interleaved(q, c, sn)
+    ki = rotate_interleaved(k, c, sn)
+    scores_ref = jnp.einsum("bqhd,bkhd->bhqk", qi, ki)
+
+    perm = deinterleave_perm(d)
+    qh = apply_rope(q[..., perm], cos, sin)
+    kh = apply_rope(k[..., perm], cos, sin)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh)
+
+    np.testing.assert_allclose(
+        np.asarray(scores_ref), np.asarray(scores), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_convert_interleaved_qk_matches_channel_permutation():
+    """Permuting the projection's output columns == permuting its output."""
+    in_dim, heads, d = 8, 2, 16
+    w = jax.random.normal(jax.random.key(2), (in_dim, heads * d))
+    x = jax.random.normal(jax.random.key(3), (5, in_dim))
+    perm = deinterleave_perm(d)
+    ref = (x @ w).reshape(5, heads, d)[:, :, perm]
+    out = (x @ convert_interleaved_qk(w, d)).reshape(5, heads, d)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-6)
+
+
+def test_apply_rope_at_matches_offset_slice():
+    """Decode (gather at traced positions) == training (static slice)."""
+    s, h, d = 12, 2, 32
+    x = jax.random.normal(jax.random.key(4), (1, s, h, d), jnp.bfloat16)
+    cos, sin = rope_angles(64, d)
+    ref = apply_rope(x, cos, sin, offset=5)
+    out = apply_rope_at(x, cos, sin, jnp.arange(5, 5 + s))
+    np.testing.assert_allclose(
+        np.asarray(ref, np.float32), np.asarray(out, np.float32)
+    )
+
+
+def test_rotation_preserves_norm():
+    x = jax.random.normal(jax.random.key(5), (1, 8, 2, 64), jnp.float32)
+    cos, sin = rope_angles(8, 64)
+    y = apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x), axis=-1),
+        np.linalg.norm(np.asarray(y), axis=-1),
+        rtol=1e-5,
+    )

@@ -46,6 +46,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..ops.pallas_utils import interpret as _pl_interpret
+from ..ops.pallas_utils import tile_rows
+
 BLOCK = 256
 RATIO_CLIP = 10.0
 _F8_MAX = 448.0   # float8_e4m3fn max finite
@@ -137,16 +140,11 @@ def _fused_kernel(cc_ref, p_ref, g_ref, mq_ref, ms_ref, vq_ref, vs_ref,
 
 
 def _tile_rows(nb: int) -> int:
-    """Largest tile height <= _ROWS that divides the row count AND is a
-    multiple of 32 — the int8/float8 sublane tile height, so compiled
-    Mosaic gets aligned VMEM blocks (interpret-mode CI would accept any
-    divisor; real TPU may not).  Returns 0 when no such divisor exists —
-    the caller must fall back to the jnp path for that leaf."""
-    rows = min(_ROWS, nb)
-    rows -= rows % 32
-    while rows and nb % rows:
-        rows -= 32
-    return rows
+    """32-aligned (int8/float8 sublane tile height) exact-divisor tiling
+    of the quantization-block rows; 0 = no aligned tiling exists and the
+    caller must fall back to the jnp path for that leaf (interpret-mode
+    CI would accept any divisor; real compiled Mosaic may not)."""
+    return tile_rows(nb, _ROWS, 32)
 
 
 def _fused_leaf_update(p2, g2, mq, ms, vq, vs, cc,
@@ -180,7 +178,7 @@ def _fused_leaf_update(p2, g2, mq, ms, vq, vs, cc,
         # operands: 0=cc 1=p 2=g 3=mq 4=ms 5=vq 6=vs — moments update
         # in place rather than allocating a second copy
         input_output_aliases={3: 1, 4: 2, 5: 3, 6: 4},
-        interpret=jax.default_backend() != "tpu",
+        interpret=_pl_interpret(),
     )(cc, p2, g2, mq, ms, vq, vs)
     return upd2, _QTensor(q=nmq, scale=nms), _QTensor(q=nvq, scale=nvs)
 

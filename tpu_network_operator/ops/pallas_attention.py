@@ -46,8 +46,7 @@ NEG_INF = -1e30
 LANES = 128
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+from .pallas_utils import interpret as _interpret  # noqa: E402
 
 
 def _block_sizes(seq_q: int, seq_k: int, block_q: int, block_k: int) -> Tuple[int, int]:

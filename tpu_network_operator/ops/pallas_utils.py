@@ -1,0 +1,25 @@
+"""Shared helpers for the Pallas TPU kernels (flash attention, fused
+RMSNorm, fused 8-bit Adam) — one copy of the interpret-mode predicate
+and the aligned-divisor row tiler, so the backend check and alignment
+rules cannot drift between kernels."""
+
+from __future__ import annotations
+
+import jax
+
+
+def interpret() -> bool:
+    """Run kernels in interpreter mode off-TPU (CPU CI, dry runs)."""
+    return jax.default_backend() != "tpu"
+
+
+def tile_rows(n: int, cap: int, align: int) -> int:
+    """Largest row-tile <= ``cap`` that divides ``n`` AND is a multiple
+    of ``align`` (the dtype's sublane tile height), so compiled Mosaic
+    gets aligned VMEM blocks.  Returns 0 when no such divisor exists —
+    callers fall back to their unfused path for that shape."""
+    rows = min(cap, n)
+    rows -= rows % align
+    while rows and n % rows:
+        rows -= align
+    return rows

@@ -1,4 +1,22 @@
-"""Rotary position embeddings (RoPE), Llama-3 convention."""
+"""Rotary position embeddings (RoPE).
+
+TPU-first layout choice: rotation pairs are the *split halves*
+``(x[:d/2], x[d/2:])`` (GPT-NeoX style), not Llama's interleaved pairs
+``(x0,x1),(x2,x3)…``.  Interleaved pairing lowers to stride-2 lane
+gathers plus a stack/reshape relayout on TPU — pure vector-shuffle
+traffic on the hot path, twice per layer.  Split halves are contiguous
+lane slices, which XLA fuses into the surrounding matmul/attention ops
+for free.
+
+The two conventions are exactly score-equivalent: attention only ever
+consumes q·kᵀ, which is invariant under any fixed channel permutation
+applied to BOTH q and k.  Permuting head channels by
+:func:`deinterleave_perm` turns interleaved pairs into split halves, so
+a checkpoint trained with the interleaved convention (e.g. Meta Llama
+weights) loads exactly by permuting the wq/wk *output* columns once at
+import time (:func:`convert_interleaved_qk`) — no runtime cost, no
+numerics drift (pinned by tests/test_rope.py).
+"""
 
 from __future__ import annotations
 
@@ -20,13 +38,48 @@ def rope_angles(
 
 
 def _rotate(x, c, s):
-    """Interleaved-pair rotation (x0,x1),(x2,x3)... matching Llama
-    reference weights.  c/s: [seq, 1, hd/2] broadcast over heads."""
+    """Split-half rotation: pair i is (x[i], x[i + d/2]).  c/s:
+    [seq, 1, d/2] broadcast over heads.  Contiguous slices — no lane
+    shuffles (see module docstring)."""
+    half = x.shape[-1] // 2
+    x1 = x[..., :half]
+    x2 = x[..., half:]
+    y1 = x1 * c - x2 * s
+    y2 = x1 * s + x2 * c
+    return jnp.concatenate([y1, y2], axis=-1).astype(x.dtype)
+
+
+def rotate_interleaved(x, c, s):
+    """Reference interleaved-pair rotation (x0,x1),(x2,x3)… matching the
+    original Llama formulation.  Kept for the checkpoint-conversion
+    equivalence proof (tests/test_rope.py) — not used on the hot path."""
     x1 = x[..., 0::2]
     x2 = x[..., 1::2]
     y1 = x1 * c - x2 * s
     y2 = x1 * s + x2 * c
     return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def deinterleave_perm(head_dim: int) -> jnp.ndarray:
+    """Channel permutation taking interleaved-pair layout to split-half
+    layout: [0, 2, 4, …, 1, 3, 5, …]."""
+    even = jnp.arange(0, head_dim, 2)
+    odd = jnp.arange(1, head_dim, 2)
+    return jnp.concatenate([even, odd])
+
+
+def convert_interleaved_qk(w: jnp.ndarray, head_dim: int) -> jnp.ndarray:
+    """Convert a wq/wk weight [in, heads*head_dim] trained with the
+    interleaved convention for use with this module's split-half
+    :func:`apply_rope`: permute each head's output columns by
+    :func:`deinterleave_perm`.  Attention scores are bit-equivalent
+    (module docstring)."""
+    in_dim, out = w.shape
+    heads = out // head_dim
+    perm = deinterleave_perm(head_dim)
+    return (
+        w.reshape(in_dim, heads, head_dim)[:, :, perm].reshape(in_dim, out)
+    )
 
 
 def apply_rope(
