@@ -77,6 +77,22 @@ class TestGenerate:
         ref = np.asarray(jnp.argmax(full, -1))[:, 7:]
         np.testing.assert_array_equal(ref, np.asarray(out)[:, 8:])
 
+    def test_segmented_decode_matches_full_buffer(self, tiny, tiny_params):
+        """Effective-length decode (tiny segments, several compiled
+        prefix lengths) must reproduce the single full-buffer scan
+        token-for-token — truncating the masked cache tail is a pure
+        work reduction."""
+        prompt = jax.random.randint(jax.random.key(11), (2, 5), 0, 256)
+        full = jax.jit(
+            lambda p, t: generate(p, t, tiny, 17, max_len=64,
+                                  decode_block=0)
+        )(tiny_params, prompt)
+        seg = jax.jit(
+            lambda p, t: generate(p, t, tiny, 17, max_len=64,
+                                  decode_block=4)
+        )(tiny_params, prompt)
+        np.testing.assert_array_equal(np.asarray(full), np.asarray(seg))
+
     def test_sampled_in_vocab_and_deterministic_per_key(self, tiny, tiny_params):
         prompt = jnp.ones((2, 4), jnp.int32)
         g = jax.jit(

@@ -58,11 +58,13 @@ def cache_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
     return {k: NamedSharding(mesh, s) for k, s in cache_specs().items()}
 
 
-def _block_with_cache(cfg, cos, sin, pos, x, lp, ck, cv):
+def _block_with_cache(cfg, cos, sin, pos, x, lp, ck, cv, attn_len=None):
     """One block over cached keys/values.
 
     x: [B, s, H] new tokens at absolute positions [pos, pos+s);
-    ck/cv: [B, max_len, Hkv, D] this layer's cache.
+    ck/cv: [B, max_len, Hkv, D] this layer's cache.  ``attn_len`` (static)
+    bounds the filled prefix: attention reads only cache[:, :attn_len],
+    so decode work scales with generated length, not the full buffer.
     Returns (x', ck', cv').
     """
     b, s, _ = x.shape
@@ -78,8 +80,13 @@ def _block_with_cache(cfg, cos, sin, pos, x, lp, ck, cv):
     cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
 
     # q_offset=pos makes query i attend cache slots <= pos+i; unwritten
-    # future slots are masked out by exactly that
-    a = causal_attention(q, ck, cv, q_offset=pos)
+    # future slots (within the view) are masked out by exactly that, so
+    # truncating to the static prefix is a pure work reduction — the
+    # masked tail's softmax weights were exactly zero
+    ckv, cvv = ck, cv
+    if attn_len is not None and attn_len < ck.shape[1]:
+        ckv, cvv = ck[:, :attn_len], cv[:, :attn_len]
+    a = causal_attention(q, ckv, cvv, q_offset=pos)
     x = x + a.reshape(b, s, -1) @ lp["wo"]
 
     y = rms_norm(x, lp["ln_mlp"], cfg.rms_eps)
@@ -93,16 +100,23 @@ def forward_with_cache(
     cache: Dict[str, jnp.ndarray],
     pos,                               # scalar (may be traced)
     cfg: LlamaConfig,
+    attn_len: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """(logits [B, s, vocab] f32, updated cache).  Serves both prefill
-    (s = prompt length, pos = 0) and decode (s = 1, pos = current)."""
+    (s = prompt length, pos = 0) and decode (s = 1, pos = current).
+
+    ``attn_len``: static upper bound on the filled cache prefix
+    (pos + s <= attn_len); attention reads only that prefix.  None =
+    the whole buffer (the pre-effective-length behavior)."""
     max_len = cache["k"].shape[2]
     x = params["embed"][tokens].astype(cfg.dtype)
     cos, sin = rope_angles(max_len, cfg.head_dim, cfg.rope_theta)
 
     def body(x, layer_in):
         lp, ck, cv = layer_in
-        x, ck, cv = _block_with_cache(cfg, cos, sin, pos, x, lp, ck, cv)
+        x, ck, cv = _block_with_cache(
+            cfg, cos, sin, pos, x, lp, ck, cv, attn_len
+        )
         return x, (ck, cv)
 
     x, (ck, cv) = jax.lax.scan(
@@ -161,6 +175,7 @@ def generate(
     key: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
     mesh: Optional[Mesh] = None,
+    decode_block: int = 256,
 ) -> jnp.ndarray:
     """Prompt + sampled continuation, [B, S + max_new_tokens].
 
@@ -168,6 +183,14 @@ def generate(
     ``temperature == 0`` (then ``key``/``top_k``/``top_p`` are unused).
     With a ``mesh``, the KV cache is pinned to the training head layout
     (:func:`cache_specs`).
+
+    ``decode_block``: effective-length decode granularity.  The decode
+    scan is split into segments; all steps in a segment attend over one
+    static cache prefix (the filled length rounded up to this block), so
+    per-token attention work tracks the generated length instead of
+    ``max_len``.  Each distinct prefix length is its own compiled scan
+    body — larger blocks compile fewer variants, smaller blocks skip
+    more work.  0 disables segmentation (single full-buffer scan).
     """
     b, s = prompt.shape
     max_len = max_len if max_len is not None else s + max_new_tokens
@@ -186,22 +209,42 @@ def generate(
             )
             for name, arr in cache.items()
         }
-    logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
+    # prefill attends over its own keys only, not the whole buffer
+    logits, cache = forward_with_cache(params, prompt, cache, 0, cfg,
+                                       attn_len=s)
     key, sub = jax.random.split(key)
     tok = _sample(logits[:, -1], temperature, sub, top_k, top_p)
 
-    def body(carry, _):
-        tok, pos, cache, key = carry
-        logits, cache = forward_with_cache(
-            params, tok[:, None], cache, pos, cfg
-        )
-        key, sub = jax.random.split(key)
-        nxt = _sample(logits[:, -1], temperature, sub, top_k, top_p)
-        return (nxt, pos + 1, cache, key), tok
+    def make_body(attn_len):
+        def body(carry, _):
+            tok, pos, cache, key = carry
+            logits, cache = forward_with_cache(
+                params, tok[:, None], cache, pos, cfg, attn_len=attn_len
+            )
+            key, sub = jax.random.split(key)
+            nxt = _sample(logits[:, -1], temperature, sub, top_k, top_p)
+            return (nxt, pos + 1, cache, key), tok
 
-    (tok, _, _, _), toks = jax.lax.scan(
-        body, (tok, jnp.int32(s), cache, key), None,
-        length=max_new_tokens - 1,
+        return body
+
+    steps_total = max_new_tokens - 1
+    blk = decode_block if decode_block > 0 else max_len
+    carry = (tok, jnp.int32(s), cache, key)
+    segments = []
+    done = 0
+    while done < steps_total:
+        # the segment's first step writes position s+done, so it needs
+        # attn_len >= s+done+1; round up to the block grid, cap at the
+        # buffer, and run until the prefix would overflow that bound
+        attn_len = min(-(-(s + done + 1) // blk) * blk, max_len)
+        n = min(steps_total - done, attn_len - (s + done))
+        carry, seg = jax.lax.scan(make_body(attn_len), carry, None, length=n)
+        segments.append(seg)
+        done += n
+    tok = carry[0]
+    toks = (
+        jnp.concatenate(segments, axis=0) if segments
+        else jnp.zeros((0, b), jnp.int32)
     )
     return jnp.concatenate([prompt, toks.T, tok[:, None]], axis=1)
 
@@ -214,6 +257,7 @@ def make_generate_fn(
     top_k: int = 0,
     top_p: float = 1.0,
     mesh: Optional[Mesh] = None,
+    decode_block: int = 256,
 ):
     """Jitted generate with params/prompt shardings pinned when a mesh is
     given (batch on data/fsdp; params as trained)."""
@@ -222,6 +266,7 @@ def make_generate_fn(
     gen = partial(
         generate, cfg=cfg, max_new_tokens=max_new_tokens,
         temperature=temperature, top_k=top_k, top_p=top_p, mesh=mesh,
+        decode_block=decode_block,
     )
     if mesh is None:
         return jax.jit(gen)
