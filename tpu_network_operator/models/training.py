@@ -9,7 +9,7 @@ fixes land in all of them at once.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -105,16 +105,25 @@ def make_sharded_train_step(
     tok_shard,                     # tokens sharding
     repl,                          # replicated sharding (for the loss)
     optimizer=None,
+    grads_fn: Optional[Callable] = None,
 ):
     """(step_jit, init_all, optimizer) with the standard contract:
     step(params, opt_state, tokens) -> (params, opt_state, loss), params
-    and opt_state donated; init_all(key) -> (params, opt_state) sharded."""
+    and opt_state donated; init_all(key) -> (params, opt_state) sharded.
+
+    ``grads_fn``: (params, tokens) -> (loss, grads) computed WITHOUT
+    autodiff through this builder — the hand-scheduled 1F1B pipeline
+    produces its gradients inside its own kernel (``loss_fn`` is then
+    unused and may be None)."""
     import optax
 
     optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.1)
 
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        if grads_fn is not None:
+            loss, grads = grads_fn(params, tokens)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

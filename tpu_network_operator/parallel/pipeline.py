@@ -121,6 +121,61 @@ def _stage_kernel(
     return out, aux_mean
 
 
+def _1f1b_tables(n_stages: int, n_micro: int):
+    """Host-side list-scheduled 1F1B (PipeDream-flush) tick tables.
+
+    Returns two ``[T, S]`` int32 arrays: ``fwd[t, r]`` / ``bwd[t, r]`` is
+    the microbatch stage ``r`` forwards / backwards at tick ``t`` (-1 =
+    idle in that direction).  One compute unit per stage per tick;
+    backward is preferred over forward once ready (drains saved
+    activations), and forwards are capped at ``S - r`` in flight — the
+    1F1B memory bound (stage 0 holds at most S live microbatch inputs
+    instead of GPipe's M).  For the canonical M >= S case the schedule
+    completes in 2(M + S - 1) ticks — the same bubble as GPipe, with
+    bounded memory.
+    """
+    import numpy as np
+
+    S, M = n_stages, n_micro
+    tf = [[-1] * M for _ in range(S)]     # tick stage r forwarded mb m
+    tb = [[-1] * M for _ in range(S)]
+    nf, nb = [0] * S, [0] * S             # next fwd/bwd mb per stage
+    rows_f, rows_b = [], []
+    t = 0
+    while any(x < M for x in nb):
+        if t > 4 * (M + S) + 8:           # pragma: no cover — safety net
+            raise RuntimeError("1f1b scheduler failed to converge")
+        row_f, row_b = [-1] * S, [-1] * S
+        for r in range(S):
+            g = nb[r]
+            b_ready = (
+                g < M
+                and 0 <= tf[r][g] < t     # own forward done, earlier tick
+                and (r == S - 1 or 0 <= tb[r + 1][g] < t)
+            )
+            if b_ready:
+                row_b[r] = g
+                tb[r][g] = t
+                nb[r] += 1
+            # a backward and a forward may share a tick (the kernel
+            # executes one masked unit of each every tick regardless);
+            # the in-flight cap is checked after the backward retires
+            f = nf[r]
+            f_ready = (
+                f < M
+                and (r == 0 or 0 <= tf[r - 1][f] < t)
+                and (f - nb[r]) < max(S - r, 1)
+            )
+            if f_ready:
+                row_f[r] = f
+                tf[r][f] = t
+                nf[r] += 1
+        rows_f.append(row_f)
+        rows_b.append(row_b)
+        t += 1
+    return np.asarray(rows_f, np.int32), np.asarray(rows_b, np.int32)
+
+
 def pipeline_apply(
     layer_fn: Callable,
     layers_params,                 # pytree, leaves [L, ...], L % S == 0
@@ -287,6 +342,277 @@ def _make_pipelined_step(
     )
 
 
+def _make_1f1b_step(
+    cfg,
+    mesh: Mesh,
+    n_microbatches: int,
+    optimizer,
+    attn_fn: Optional[Callable],
+):
+    """Hand-scheduled 1F1B training step for the dense (Llama) family.
+
+    Reverse-mode AD of the GPipe forward scan necessarily runs ALL
+    forward ticks before any backward tick, so every in-flight
+    microbatch's stage activations stay live — memory grows with M.
+    1F1B interleaves each microbatch's backward as soon as its forward
+    clears the last stage, bounding live stage inputs at S.  That
+    interleaving cannot be expressed through autodiff of a single
+    forward region, so this builder drives the whole loss+gradient
+    computation inside one manual-over-``pipe`` kernel:
+
+    * host-side static tick tables (:func:`_1f1b_tables`) say which
+      microbatch each stage forwards/backwards at each tick;
+    * wire arrivals (activations rightward, cotangents leftward) are
+      banked into depth-S ring buffers as they land — the ppermute wire
+      itself is one slot overwritten every tick, and a stage at its
+      in-flight cap consumes an arrival several ticks late;
+    * a forward unit runs the local layer stack from the banked input;
+      the backward unit recomputes the stack under ``jax.vjp`` from the
+      same banked input — activation memory is two [S, b_micro, s, h]
+      buffers per stage regardless of M (the recompute matches what
+      ``cfg.remat`` policies already pay);
+    * every stage executes the SAME program every tick — one masked
+      forward unit plus one masked backward vjp whose scalar objective
+      is ``is_last·loss(y) + <y, masked_grad_in>``.  Stage-dependent
+      ``lax.cond`` branches would deadlock here: the auto tensor/fsdp
+      axes put GSPMD collectives inside the branch bodies, and devices
+      on different pipe ranks would disagree about which collectives
+      run.  The masking makes the last stage's vjp seed the true loss
+      gradient (final-norm -> lm_head -> cross-entropy are folded into
+      the same vjp; the embedding lookup is folded in for stage 0)
+      while interior stages propagate the received cotangent;
+    * activations hop right and gradients hop left with one
+      ``ppermute`` pair per tick; parameter grads accumulate in f32.
+
+    Composes with the auto (data/fsdp/tensor) axes like the GPipe path;
+    ``seq_axis`` and the MoE family are not supported on this schedule.
+    """
+    from ..models import llama
+    from ..models.training import (
+        make_sharded_train_step,
+        next_token_xent,
+        remat_policy,
+    )
+    from ..ops.attention import causal_attention
+    from ..ops.norms import rms_norm
+    from ..ops.rope import rope_angles
+
+    attn_fn = attn_fn or causal_attention
+    n_stages = mesh.shape["pipe"]
+    M = n_microbatches
+    if cfg.layers % n_stages:
+        raise ValueError(
+            f"layers {cfg.layers} not divisible by stages {n_stages}"
+        )
+
+    specs = llama.param_specs(cfg)
+    specs["layers"] = jax.tree.map(
+        lambda s: P(*(("pipe",) + tuple(s)[1:])),
+        specs["layers"],
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    p_shard = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    tok_shard = NamedSharding(mesh, P(("data", "fsdp"), None))
+    repl = NamedSharding(mesh, P())
+    # manual-over-pipe view of the same layout
+    pipe_specs = {
+        "embed": P(), "layers": P("pipe"), "ln_final": P(), "lm_head": P(),
+    }
+
+    fwd_rows, bwd_rows = _1f1b_tables(n_stages, M)
+    # each tick banks the PREVIOUS tick's wire arrivals, identified by
+    # the sending neighbor's schedule row (see the kernel's tick())
+    import numpy as np
+
+    pad = np.full((1, n_stages), -1, np.int32)
+    prev_fwd = np.vstack([pad, fwd_rows[:-1]])
+    prev_bwd = np.vstack([pad, bwd_rows[:-1]])
+
+    def grads_fn(params, tokens):
+        b, s1 = tokens.shape
+        s = s1 - 1
+        if b % M:
+            raise ValueError(f"batch {b} not divisible by microbatches {M}")
+        xtok = tokens.reshape(M, b // M, s1)
+        cos, sin = rope_angles(s, cfg.head_dim, cfg.rope_theta)
+
+        def block(x, lp):
+            return llama._layer(cfg, cos, sin, x, lp, attn_fn)
+
+        if cfg.remat:
+            block = jax.checkpoint(block, policy=remat_policy(cfg))
+
+        # explicit ppermutes are never differentiated here (the kernel
+        # computes its own grads), but XLA's CPU backend still rejects
+        # bf16 collectives in manual regions — same rule as pipeline_apply
+        wire_dt = (
+            jnp.float32 if jax.default_backend() == "cpu" else cfg.dtype
+        )
+
+        def kernel(p, xtok, fwd_rows, bwd_rows, prev_fwd, prev_bwd):
+            rank = jax.lax.axis_index("pipe")
+            n = jax.lax.axis_size("pipe")
+            bm = xtok.shape[1]
+            h = cfg.hidden
+            D = n                               # ring-buffer depth = S
+
+            def stack_f(p_, x_in):
+                y, _ = jax.lax.scan(
+                    lambda x, lp: (block(x, lp), None), x_in, p_["layers"]
+                )
+                return y
+
+            is_last = (rank == n - 1).astype(jnp.float32)
+
+            def fwd_one(p_, x_recv, tok_mb):
+                # stage 0's input is the embedding, not the wire
+                emb = p_["embed"][tok_mb[:, :-1]].astype(cfg.dtype)
+                x_in = jnp.where(rank == 0, emb, x_recv)
+                return stack_f(p_, x_in)
+
+            def bwd_unit(p_, x_saved, tok_mb, grad_in, active):
+                """One masked backward: vjp of a scalar that is the true
+                loss on an active last stage and <y, grad_in> on an
+                active interior stage (zero when idle), so one uniform
+                linearization serves every stage — no collective-bearing
+                branches."""
+                seed_loss = active * is_last
+                gmask = (active * (1.0 - is_last)) * grad_in.astype(
+                    jnp.float32
+                )
+
+                def f(p__, x__):
+                    y = fwd_one(p__, x__, tok_mb)
+                    z = rms_norm(y, p__["ln_final"], cfg.rms_eps)
+                    logits = (z @ p__["lm_head"]).astype(jnp.float32)
+                    loss = next_token_xent(logits, tok_mb)
+                    scalar = seed_loss * loss + jnp.sum(
+                        y.astype(jnp.float32) * gmask
+                    )
+                    return scalar, loss
+
+                _, vjpf, loss = jax.vjp(f, p_, x_saved, has_aux=True)
+                dp, dx = vjpf(jnp.float32(1.0))
+                dp = jax.tree.map(lambda a: a.astype(jnp.float32), dp)
+                return dp, dx, loss * seed_loss
+
+            def _bank(buf, mb, valid, value):
+                """Write ``value`` into slot ``mb % D`` when valid; ring
+                slots never collide while an entry is live because live
+                microbatches are <= D consecutive integers (the in-flight
+                cap)."""
+                slot = jnp.clip(mb, 0, M - 1) % D
+                cur = jax.lax.dynamic_index_in_dim(
+                    buf, slot, axis=0, keepdims=False
+                )
+                banked = jnp.where(valid, value, cur)
+                return jax.lax.dynamic_update_slice_in_dim(
+                    buf, banked[None], slot, axis=0
+                )
+
+            def _slot(buf, mb):
+                return jax.lax.dynamic_index_in_dim(
+                    buf, jnp.clip(mb, 0, M - 1) % D, axis=0, keepdims=False
+                )
+
+            def tick(carry, rows):
+                act_recv, grad_recv, abuf, gbuf, dacc, lacc = carry
+                row_f, row_b, prev_f, prev_b = rows
+                f = jnp.take(row_f, rank)
+                g = jnp.take(row_b, rank)
+
+                # bank last tick's wire arrivals FIRST.  The ppermute
+                # wires are single slots overwritten every tick, but a
+                # capped stage may consume an activation (or a gradient)
+                # several ticks after its neighbor produced it — reading
+                # the wire directly silently trains on idle-tick garbage
+                # for 3+ stages.  The neighbor's schedule row says which
+                # microbatch (if any) is on the wire.
+                af = jnp.take(prev_f, (rank - 1) % n)
+                abuf = _bank(abuf, af, (rank > 0) & (af >= 0),
+                             act_recv.astype(cfg.dtype))
+                ag = jnp.take(prev_b, (rank + 1) % n)
+                gbuf = _bank(gbuf, ag, (rank < n - 1) & (ag >= 0),
+                             grad_recv.astype(cfg.dtype))
+
+                # backward unit (stage input + arrived cotangent from
+                # the ring buffers)
+                tok_b = jax.lax.dynamic_index_in_dim(
+                    xtok, jnp.clip(g, 0, M - 1), axis=0, keepdims=False
+                )
+                dp, dx, lmb = bwd_unit(
+                    p, _slot(abuf, g), tok_b, _slot(gbuf, g),
+                    (g >= 0).astype(jnp.float32),
+                )
+                dacc = jax.tree.map(jnp.add, dacc, dp)
+                lacc = lacc + lmb
+
+                # forward unit (masked: idle ticks chew zeros, like the
+                # GPipe kernel's fill/drain ticks)
+                tok_f = jax.lax.dynamic_index_in_dim(
+                    xtok, jnp.clip(f, 0, M - 1), axis=0, keepdims=False
+                )
+                y = fwd_one(p, _slot(abuf, f), tok_f)
+
+                right = [(i, (i + 1) % n) for i in range(n)]
+                left = [(i, (i - 1) % n) for i in range(n)]
+                act_next = jax.lax.ppermute(
+                    y.astype(wire_dt), "pipe", right
+                )
+                grad_next = jax.lax.ppermute(
+                    dx.astype(wire_dt), "pipe", left
+                )
+                return (act_next, grad_next, abuf, gbuf, dacc, lacc), None
+
+            carry0 = (
+                jnp.zeros((bm, s, h), wire_dt),
+                jnp.zeros((bm, s, h), wire_dt),
+                jnp.zeros((D, bm, s, h), cfg.dtype),
+                jnp.zeros((D, bm, s, h), cfg.dtype),
+                jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, jnp.float32), p
+                ),
+                jnp.float32(0.0),
+            )
+            (_, _, _, _, dacc, lacc), _ = jax.lax.scan(
+                tick, carry0, (fwd_rows, bwd_rows, prev_fwd, prev_bwd)
+            )
+            # layer grads live on their stage; the replicated leaves
+            # (embed on stage 0, head/final-norm on the last stage) are
+            # psum-combined so every stage returns the full gradient
+            grads = {
+                "embed": jax.lax.psum(dacc["embed"], "pipe"),
+                "layers": dacc["layers"],
+                "ln_final": jax.lax.psum(dacc["ln_final"], "pipe"),
+                "lm_head": jax.lax.psum(dacc["lm_head"], "pipe"),
+            }
+            grads = jax.tree.map(lambda a: a / M, grads)
+            loss = jax.lax.psum(lacc, "pipe") / M
+            return grads, loss
+
+        grads32, loss = jax.shard_map(
+            kernel,
+            mesh=mesh,
+            axis_names={"pipe"},
+            in_specs=(pipe_specs, P(), P(), P(), P(), P()),
+            out_specs=(pipe_specs, P()),
+            check_vma=False,
+        )(params, xtok, jnp.asarray(fwd_rows), jnp.asarray(bwd_rows),
+          jnp.asarray(prev_fwd), jnp.asarray(prev_bwd))
+        grads = jax.tree.map(
+            lambda g_, p_: g_.astype(p_.dtype), grads32, params
+        )
+        return loss, grads
+
+    return make_sharded_train_step(
+        None, partial(llama.init_params, cfg=cfg), p_shard, tok_shard,
+        repl, optimizer, grads_fn=grads_fn,
+    )
+
+
 def make_pipeline_train_step(
     cfg,
     mesh: Mesh,
@@ -294,6 +620,7 @@ def make_pipeline_train_step(
     optimizer=None,
     attn_fn: Optional[Callable] = None,
     seq_axis: Optional[str] = None,
+    schedule: str = "gpipe",
 ):
     """Pipeline-parallel Llama training step over the mesh's ``pipe`` axis.
 
@@ -304,8 +631,22 @@ def make_pipeline_train_step(
     (batch) and tensor (head/ffn) axes, which remain auto-partitioned,
     and — via ``seq_axis="seq"`` — with ring sequence parallelism
     (activations sequence-sharded through the stages).
+
+    ``schedule``: "gpipe" (autodiff through the fill-drain scan; live
+    activations grow with ``n_microbatches``) or "1f1b" (hand-scheduled
+    one-forward-one-backward; live stage inputs bounded at the stage
+    count — see :func:`_make_1f1b_step`; dense family only, no
+    ``seq_axis``).
     """
     from ..models import llama
+
+    if schedule == "1f1b":
+        if seq_axis is not None:
+            raise ValueError("schedule='1f1b' does not compose with "
+                             "seq_axis yet — use the gpipe schedule")
+        return _make_1f1b_step(cfg, mesh, n_microbatches, optimizer, attn_fn)
+    if schedule != "gpipe":
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
 
     def make_block(cos, sin, attn):
         def block(x, lp):

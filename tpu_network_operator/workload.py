@@ -248,6 +248,7 @@ def cmd_train(args) -> int:
                 cfg, mesh, n_microbatches=args.microbatches,
                 optimizer=optimizer,
                 seq_axis="seq" if args.seq > 1 else None,
+                schedule=args.pp_schedule,
             )
         else:
             step, init_all, _ = make_train_step(
@@ -355,6 +356,7 @@ def cmd_generate(args) -> int:
     gen = make_generate_fn(
         cfg, args.max_new_tokens, temperature=args.temperature,
         top_k=args.top_k, top_p=args.top_p, mesh=mesh,
+        decode_block=args.decode_block,
     )
 
     def run_once():
@@ -435,6 +437,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="memmapped token file (uint16/uint32); default: "
                         "synthetic fixed batch")
     t.add_argument("--microbatches", type=int, default=4)
+    t.add_argument("--pp-schedule", default="gpipe",
+                   choices=["gpipe", "1f1b"],
+                   help="pipeline schedule (dense family; 1f1b bounds "
+                        "live activations at the stage count)")
     t.add_argument("--optimizer", choices=["adamw", "adam8bit"],
                    default="adamw",
                    help="adam8bit: int8/f8 moment storage, half the "
@@ -455,6 +461,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="truncate sampling to the k highest-prob ids")
     g.add_argument("--top-p", type=float, default=1.0,
                    help="nucleus sampling: smallest top-p probability mass")
+    g.add_argument("--decode-block", type=int, default=256,
+                   help="effective-length decode granularity; 0 = attend "
+                        "over the full KV buffer every step")
     g.set_defaults(fn=cmd_generate)
     return p
 
