@@ -484,6 +484,54 @@ class TestCliLifecycle:
         assert not os.path.exists(bootstrap_path)
         assert not (nfd_dir / "scale-out-readiness.txt").exists()
 
+    def test_tpu_backend_libtpu_topology_source(self, tmp_path, monkeypatch):
+        """--topology-source=libtpu: the agent pass runs end-to-end with
+        topology from the (faked) local runtime instead of metadata —
+        the metadata server deliberately serves NO tpu-env/accelerator
+        attributes, so only the libtpu route can succeed."""
+        nfd_dir = (
+            tmp_path / "etc/kubernetes/node-feature-discovery/features.d"
+        )
+        nfd_dir.mkdir(parents=True)
+        devices = [
+            {"coords": [x, y], "device_kind": "TPU v5 lite",
+             "process_index": (y * 4 + x) // 8}
+            for y in range(4) for x in range(4)
+        ]
+        libtpu = tmp_path / "libtpu.json"
+        libtpu.write_text(json.dumps(
+            {"process_index": 1, "devices": devices}
+        ))
+        monkeypatch.setenv("TPUNET_FAKE_LIBTPU", str(libtpu))
+        attrs = {
+            "worker-network-config": json.dumps(
+                [{"workerId": 0, "ipAddress": "10.0.0.5"},
+                 {"workerId": 1, "ipAddress": "10.0.0.6"}]
+            ),
+        }
+        ops = FakeLinkOps()
+        ops.add_fake_link("ens9", 2, "42:01:0a:00:00:05")
+        bootstrap_path = str(tmp_path / "jax-coordinator.json")
+        with FakeMetadataServer(attrs) as srv:
+            monkeypatch.setenv("TPUNET_METADATA_URL", srv.url)
+            cfg = agent_cli.CmdConfig(
+                backend="tpu", mode="L2", mtu=8896,
+                configure=True, keep_running=True,
+                topology_source="libtpu",
+                interfaces="ens9", bootstrap=bootstrap_path,
+                ops=ops, nfd_root=str(tmp_path),
+            )
+            assert agent_cli.cmd_run(cfg, wait_signal=False) == 0
+            # and the metadata route alone would NOT have worked
+            cfg_auto = agent_cli.CmdConfig(
+                backend="tpu", mode="L2", mtu=8896, configure=True,
+                topology_source="metadata",
+                interfaces="ens9", ops=FakeLinkOps(),
+                nfd_root=str(tmp_path),
+            )
+            assert agent_cli.cmd_run(cfg_auto, wait_signal=False) == 1
+        assert ops.ups == ["ens9"]
+
     def test_tpu_l3_auto_discovery_full_pass(self, tmp_path, monkeypatch):
         """BASELINE config 3 in miniature: secondary-gVNIC auto-discovery →
         bring-up + MTU → LLDP /30 + /16 routes → bootstrap listing the

@@ -218,6 +218,104 @@ class TestTopologyDiscovery:
         assert topo.TpuTopology.from_dict(t.to_dict()) == t
 
 
+def _fake_libtpu_file(tmp_path, *, grid=(2, 2, 2), procs=2, pindex=1,
+                      kind="TPU v4", coords=True):
+    devices = []
+    n = 1
+    for d in grid:
+        n *= d
+    for i in range(n):
+        x, rest = i % grid[0], i // grid[0]
+        y, z = rest % grid[1], rest // grid[1]
+        devices.append({
+            "coords": [x, y, z] if coords else None,
+            "device_kind": kind,
+            "process_index": i * procs // n,
+        })
+    path = tmp_path / "libtpu.json"
+    path.write_text(json.dumps(
+        {"process_index": pindex, "devices": devices}
+    ))
+    return str(path)
+
+
+class TestLibtpuSource:
+    """--topology-source=libtpu via the TPUNET_FAKE_LIBTPU seam (no
+    hardware): the runtime-probe path must produce the same TpuTopology
+    shape the metadata path does."""
+
+    def test_from_fake_runtime(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "TPUNET_FAKE_LIBTPU", _fake_libtpu_file(tmp_path)
+        )
+        t = topo._from_libtpu()
+        assert t.source == "libtpu"
+        assert t.ici_mesh == (2, 2, 2)
+        assert t.num_chips == 8
+        assert t.chips_per_host == 4
+        assert t.num_hosts == 2
+        assert t.worker_id == 1
+        assert t.accelerator_type == "TPU v4"
+
+    def test_no_coords_falls_back_to_flat_mesh(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "TPUNET_FAKE_LIBTPU",
+            _fake_libtpu_file(tmp_path, coords=False, procs=1, pindex=0),
+        )
+        t = topo._from_libtpu()
+        assert t.ici_mesh == (8,)
+        assert t.num_hosts == 1
+
+    def test_empty_runtime_refused(self, tmp_path, monkeypatch):
+        path = tmp_path / "none.json"
+        path.write_text(json.dumps({"process_index": 0, "devices": []}))
+        monkeypatch.setenv("TPUNET_FAKE_LIBTPU", str(path))
+        with pytest.raises(topo.TopologyError, match="no TPU devices"):
+            topo._from_libtpu()
+
+    def test_probe_failure_wrapped(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "TPUNET_FAKE_LIBTPU", str(tmp_path / "missing.json")
+        )
+        with pytest.raises(topo.TopologyError, match="libtpu probe failed"):
+            topo._from_libtpu()
+
+    def test_discover_source_libtpu_with_dead_metadata(
+        self, tmp_path, monkeypatch
+    ):
+        """source=libtpu must not require a metadata service at all
+        (megascale lookup degrades to single-slice)."""
+        monkeypatch.setenv(
+            "TPUNET_FAKE_LIBTPU", _fake_libtpu_file(tmp_path)
+        )
+        t = topo.discover(
+            MetadataClient("http://127.0.0.1:1"), source="libtpu"
+        )
+        assert t.source == "libtpu"
+        assert (t.num_slices, t.slice_id) == (1, 0)
+
+    def test_auto_falls_back_to_libtpu(self, tmp_path, monkeypatch):
+        """auto ordering: metadata first; a dead metadata service falls
+        through to the runtime probe instead of failing discovery."""
+        monkeypatch.setenv(
+            "TPUNET_FAKE_LIBTPU", _fake_libtpu_file(tmp_path)
+        )
+        t = topo.discover(
+            MetadataClient("http://127.0.0.1:1"), source="auto"
+        )
+        assert t.source == "libtpu"
+        assert t.ici_mesh == (2, 2, 2)
+
+    def test_metadata_wins_over_libtpu_on_auto(
+        self, tmp_path, monkeypatch, v5p_server
+    ):
+        monkeypatch.setenv(
+            "TPUNET_FAKE_LIBTPU", _fake_libtpu_file(tmp_path)
+        )
+        t = topo.discover(MetadataClient(v5p_server.url), source="auto")
+        assert t.source == "tpu-env"
+
+
 class TestBootstrap:
     def make(self, tmp_path, v5p_server):
         c = MetadataClient(v5p_server.url)

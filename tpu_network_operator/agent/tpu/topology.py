@@ -17,10 +17,13 @@ Two sources, in order of authority:
 
 from __future__ import annotations
 
+import json
 import logging
 import math
+import os
 import re
 from dataclasses import dataclass
+from types import SimpleNamespace
 from typing import Dict, List, Optional, Tuple
 
 log = logging.getLogger("tpunet.agent")
@@ -262,33 +265,51 @@ def discover(metadata_client, source: str = "auto") -> TpuTopology:
     worker_id_authoritative = True
     if source in ("auto", "metadata"):
         try:
-            env = metadata_client.tpu_env()
-        except Exception:
-            env = {}
-        awn = metadata_client.attribute_or("agent-worker-number", "").strip()
-        worker_hint = int(awn) if awn else None
-        if env.get("ACCELERATOR_TYPE") or env.get("TOPOLOGY"):
-            accel_hint = env.get(
-                "ACCELERATOR_TYPE"
-            ) or metadata_client.attribute_or("accelerator-type", "")
-            topo = from_tpu_env(
-                env, accel_hint=accel_hint, worker_id_hint=worker_hint
+            try:
+                env = metadata_client.tpu_env()
+            except Exception:
+                env = {}
+            awn = metadata_client.attribute_or(
+                "agent-worker-number", ""
+            ).strip()
+            worker_hint = int(awn) if awn else None
+            if env.get("ACCELERATOR_TYPE") or env.get("TOPOLOGY"):
+                accel_hint = env.get(
+                    "ACCELERATOR_TYPE"
+                ) or metadata_client.attribute_or("accelerator-type", "")
+                topo = from_tpu_env(
+                    env, accel_hint=accel_hint, worker_id_hint=worker_hint
+                )
+                worker_id_authoritative = (
+                    "WORKER_ID" in env or worker_hint is not None
+                )
+            else:
+                topo = from_accelerator_type(
+                    metadata_client.accelerator_type(),
+                    worker_id=worker_hint or 0,
+                )
+                worker_id_authoritative = worker_hint is not None
+        except Exception as e:
+            if source == "metadata":
+                raise
+            # auto: fall through to the local runtime probe — a TPU VM
+            # with no/broken metadata service can still describe itself
+            log.warning(
+                "metadata topology discovery failed (%s); probing libtpu",
+                e,
             )
-            worker_id_authoritative = (
-                "WORKER_ID" in env or worker_hint is not None
-            )
-        else:
-            topo = from_accelerator_type(
-                metadata_client.accelerator_type(),
-                worker_id=worker_hint or 0,
-            )
-            worker_id_authoritative = worker_hint is not None
+            topo = _from_libtpu()
+            worker_id_authoritative = True   # process_index is exact
     elif source == "libtpu":
         topo = _from_libtpu()
     else:
         raise TopologyError(f"unknown topology source {source!r}")
 
-    ms = metadata_client.megascale()
+    try:
+        ms = metadata_client.megascale()
+    except Exception:
+        # metadata may be down on the libtpu path; single-slice default
+        ms = {}
     if ms:
         topo.num_slices = int(ms.get("megascale-num-slices", "1"))
         topo.slice_id = int(ms.get("megascale-slice-id", "0"))
@@ -308,17 +329,41 @@ def discover(metadata_client, source: str = "auto") -> TpuTopology:
     return topo
 
 
+def _probe_devices() -> Tuple[list, int]:
+    """(tpu devices, this process index) from the local runtime.
+
+    Seam: ``TPUNET_FAKE_LIBTPU=<path.json>`` substitutes a fake device
+    set — ``{"process_index": N, "devices": [{"coords": [x,y,z]|null,
+    "device_kind": "...", "process_index": p}, ...]}`` — so the libtpu
+    path is exercisable without hardware, including from agent-CLI
+    subprocess tests (the ``TPUNET_METADATA_URL`` pattern of
+    :mod:`.metadata`)."""
+    fake = os.environ.get("TPUNET_FAKE_LIBTPU")
+    if fake:
+        with open(fake) as f:
+            spec = json.load(f)
+        devices = []
+        for d in spec.get("devices", []):
+            dev = SimpleNamespace(**d)
+            dev.coords = (
+                tuple(dev.coords) if dev.coords is not None else None
+            )
+            devices.append(dev)
+        return devices, int(spec.get("process_index", 0))
+    import jax
+
+    return jax.devices("tpu"), jax.process_index()
+
+
 def _from_libtpu() -> TpuTopology:
     """Probe the local runtime via jax/libtpu.  Only works on a TPU VM with
-    a quiescent runtime; the metadata path is preferred (and is the default
-    under --topology-source=auto)."""
+    a quiescent runtime; the metadata path is preferred (and is tried
+    first under --topology-source=auto)."""
     try:
-        import jax
-
-        devices = jax.devices("tpu")
-    except Exception as e:  # pragma: no cover - needs hardware
+        devices, process_index = _probe_devices()
+    except Exception as e:
         raise TopologyError(f"libtpu probe failed: {e}") from e
-    if not devices:  # pragma: no cover
+    if not devices:
         raise TopologyError("libtpu probe found no TPU devices")
     coords = [getattr(d, "coords", None) for d in devices]
     kind = devices[0].device_kind
@@ -328,9 +373,9 @@ def _from_libtpu() -> TpuTopology:
         mesh = tuple(
             max(c[i] for c in coords) + 1 for i in range(dims)
         )
-    else:  # pragma: no cover
+    else:
         mesh = (len(devices),)
-    local = [d for d in devices if d.process_index == jax.process_index()]
+    local = [d for d in devices if d.process_index == process_index]
     return TpuTopology(
         accelerator_type=kind,
         generation=kind,
@@ -339,6 +384,6 @@ def _from_libtpu() -> TpuTopology:
         num_chips=len(devices),
         chips_per_host=len(local),
         num_hosts=max(1, len(devices) // max(1, len(local))),
-        worker_id=jax.process_index(),
+        worker_id=process_index,
         source="libtpu",
     )
