@@ -73,6 +73,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kube-api", default="",
                    help="apiserver URL override (default: in-cluster config)")
     p.add_argument("--zap-log-level", "--v", dest="log_level", default="info")
+    p.add_argument("--report-cache-seconds", type=float, default=2.0,
+                   help="agent-report Lease list cache window: one "
+                        "namespace-wide list serves all policies' status "
+                        "passes for this long (0 = refetch every pass)")
     return p
 
 
@@ -104,6 +108,7 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
 
     mgr = Manager(client, namespace=args.namespace, is_openshift=openshift,
                   metrics=METRICS)
+    mgr.reconciler.REPORT_CACHE_SECONDS = args.report_cache_seconds
 
     servers = []
     health = None

@@ -245,6 +245,8 @@ class NetworkClusterPolicyReconciler:
         self.namespace = namespace
         self.is_openshift = is_openshift
         self.metrics = metrics
+        self._reports_cache: Optional[Dict[str, List[Any]]] = None
+        self._reports_cached_at = 0.0
 
     # -- setup ----------------------------------------------------------------
 
@@ -395,30 +397,54 @@ class NetworkClusterPolicyReconciler:
     # partitioned agent must age out of "All good" even while its stale
     # ok report lingers.  3x the agent's default 60s recheck cadence.
     REPORT_TTL_SECONDS = 180.0
+    # one namespace-wide Lease list serves every policy's status pass
+    # within this window, bucketed by policy label — a status pass is
+    # O(its own targets), not O(policies x namespace Leases) per tick.
+    # 0 disables the window (every pass refetches — exact visibility,
+    # the default so tests and ad-hoc reconciles see writes instantly);
+    # the operator entrypoint turns it on (--report-cache-seconds, 2s
+    # default there), which bounds a large fleet's status-pass cost and
+    # delays report visibility by at most the window.  Always small vs
+    # REPORT_TTL_SECONDS, so staleness aging is unaffected.
+    REPORT_CACHE_SECONDS = 0.0
 
     def _agent_reports(self, policy_name: str) -> List[Any]:
         """Per-node provisioning reports (Leases the agents apply,
-        agent/report.py).  Parse failures and stale heartbeats count as
-        not-ready reports."""
+        agent/report.py) for one policy, from the shared bucket cache.
+        Parse failures and stale heartbeats count as not-ready reports."""
+        return list(self._report_buckets().get(policy_name, []))
+
+    def _report_buckets(self) -> Dict[str, List[Any]]:
+        """All agent-report Leases in the namespace, parsed once and
+        bucketed by policy label; cached REPORT_CACHE_SECONDS.  A list
+        failure returns (and does not cache) empty buckets — absence =
+        no reports yet."""
         import time as time_mod
 
         from ..agent import report as rpt
 
+        now = time_mod.time()
+        if (
+            self._reports_cache is not None
+            and now - self._reports_cached_at < self.REPORT_CACHE_SECONDS
+        ):
+            return self._reports_cache
         try:
             leases = self.client.list(
                 rpt.LEASE_API,
                 "Lease",
                 namespace=self.namespace,
-                label_selector={
-                    rpt.AGENT_LABEL: "true",
-                    rpt.POLICY_LABEL: policy_name,
-                },
+                label_selector={rpt.AGENT_LABEL: "true"},
             )
         except Exception as e:   # noqa: BLE001 — absence = no reports yet
             log.debug("agent report list failed: %s", e)
-            return []
-        out = []
+            return {}
+        buckets: Dict[str, List[Any]] = {}
         for lease in leases:
+            policy_name = (
+                lease.get("metadata", {}).get("labels", {}) or {}
+            ).get(rpt.POLICY_LABEL, "")
+            out = buckets.setdefault(policy_name, [])
             node = lease.get("spec", {}).get("holderIdentity", "?")
             raw = (
                 lease.get("metadata", {}).get("annotations", {}) or {}
@@ -444,7 +470,9 @@ class NetworkClusterPolicyReconciler:
                 ))
                 continue
             out.append(rep)
-        return out
+        self._reports_cache = buckets
+        self._reports_cached_at = now
+        return buckets
 
     def _target_nodes(self, ds: Dict[str, Any]) -> set:
         """Nodes the DaemonSet's pods currently sit on (via the owned-pod
