@@ -93,6 +93,13 @@ deployments: ## Render all deployment YAML (for scanning, ref Makefile:142-147)
 	kubectl kustomize deploy/default > rendered/operator.yaml || true
 	helm template charts/tpu-network-operator > rendered/helm.yaml || true
 
+.PHONY: deployments-strict
+deployments-strict: ## Render deployment YAML, failing on render errors (CI scan input)
+	mkdir -p rendered
+	kubectl kustomize deploy/default > rendered/operator.yaml
+	helm template charts/tpu-network-operator > rendered/helm.yaml
+	test -s rendered/operator.yaml && test -s rendered/helm.yaml
+
 ##@ Packaging
 
 .PHONY: helm-package

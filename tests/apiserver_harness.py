@@ -1,0 +1,219 @@
+"""Apiserver endpoints for the conformance tier (tests/test_apiserver_conformance.py).
+
+Two implementations of one tiny interface — ``url``, ``request()``,
+``close()``:
+
+* :func:`wire_endpoint` — the framework's own :class:`WireApiServer`
+  (always available);
+* :func:`real_endpoint` — a real ``kube-apiserver`` + ``etcd`` booted
+  from envtest-style binaries (ref ``internal/controller/suite_test.go:61-102``
+  boots exactly this pair via controller-runtime's envtest).  Gated on
+  the binaries being present: set ``KUBEBUILDER_ASSETS`` (the envtest
+  layout, e.g. from ``setup-envtest use -p path``) or
+  ``TPUNET_ENVTEST_BIN_DIR`` to a directory containing both binaries.
+
+The conformance tests speak raw HTTP through ``request()`` so they pin
+SERVER semantics (status codes, Status bodies, watch event sequences),
+not this repo's client behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+import ssl
+import subprocess
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional, Tuple
+
+
+def envtest_bin_dir() -> str:
+    """Directory holding kube-apiserver + etcd, or ""."""
+    for var in ("KUBEBUILDER_ASSETS", "TPUNET_ENVTEST_BIN_DIR"):
+        d = os.environ.get(var, "")
+        if (
+            d
+            and os.path.exists(os.path.join(d, "kube-apiserver"))
+            and os.path.exists(os.path.join(d, "etcd"))
+        ):
+            return d
+    return ""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class Endpoint:
+    """One running apiserver: ``request()`` returns (code, parsed-body or
+    raw bytes for streams)."""
+
+    def __init__(self, url: str, ctx: Optional[ssl.SSLContext] = None,
+                 procs=(), workdir: Optional[str] = None):
+        self.url = url
+        self._ctx = ctx
+        self._procs = list(procs)
+        self._workdir = workdir
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Dict[str, Any]] = None,
+        content_type: str = "application/json",
+        timeout: float = 10.0,
+    ) -> Tuple[int, Any]:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.url + path, data=data, method=method
+        )
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout, context=self._ctx
+            ) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            raw = e.read() or b"{}"
+            try:
+                return e.code, json.loads(raw)
+            except ValueError:
+                return e.code, raw
+
+    def stream(self, path: str, timeout: float = 10.0):
+        """Open a watch stream; yields decoded event dicts."""
+        req = urllib.request.Request(self.url + path)
+        resp = urllib.request.urlopen(
+            req, timeout=timeout, context=self._ctx
+        )
+
+        def events():
+            with resp:
+                for line in resp:
+                    line = line.strip()
+                    if line:
+                        yield json.loads(line)
+
+        return events()
+
+    def close(self) -> None:
+        for p in self._procs:
+            p.terminate()
+        for p in self._procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if self._workdir:
+            shutil.rmtree(self._workdir, ignore_errors=True)
+
+
+def wire_endpoint() -> Tuple[Endpoint, Any]:
+    """(endpoint, wire-server handle) over a fresh FakeCluster."""
+    from tpu_network_operator.kube.wire import WireApiServer
+
+    srv = WireApiServer().start()
+    return Endpoint(srv.url), srv
+
+
+def real_endpoint(workdir: str) -> Endpoint:
+    """Boot etcd + kube-apiserver (anonymous auth, AlwaysAllow authz —
+    the envtest defaults) and install the framework CRD.  Caller must
+    have checked :func:`envtest_bin_dir`."""
+    bin_dir = envtest_bin_dir()
+    assert bin_dir, "real_endpoint called without envtest binaries"
+    os.makedirs(workdir, exist_ok=True)
+
+    etcd_client = _free_port()
+    etcd_peer = _free_port()
+    etcd = subprocess.Popen(
+        [
+            os.path.join(bin_dir, "etcd"),
+            "--data-dir", os.path.join(workdir, "etcd"),
+            "--listen-client-urls", f"http://127.0.0.1:{etcd_client}",
+            "--advertise-client-urls", f"http://127.0.0.1:{etcd_client}",
+            "--listen-peer-urls", f"http://127.0.0.1:{etcd_peer}",
+            "--unsafe-no-fsync",
+        ],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+    # the apiserver refuses to start without a service-account signing
+    # key since 1.20; a throwaway RSA key is fine for conformance
+    sa_key = os.path.join(workdir, "sa.key")
+    subprocess.run(
+        ["openssl", "genrsa", "-out", sa_key, "2048"],
+        check=True, capture_output=True,
+    )
+    secure_port = _free_port()
+    cert_dir = os.path.join(workdir, "apiserver-certs")
+    apiserver = subprocess.Popen(
+        [
+            os.path.join(bin_dir, "kube-apiserver"),
+            "--etcd-servers", f"http://127.0.0.1:{etcd_client}",
+            "--secure-port", str(secure_port),
+            "--cert-dir", cert_dir,
+            "--authorization-mode", "AlwaysAllow",
+            "--anonymous-auth=true",
+            "--service-account-issuer", "https://kubernetes.default.svc",
+            "--service-account-key-file", sa_key,
+            "--service-account-signing-key-file", sa_key,
+            "--disable-admission-plugins",
+            "ServiceAccount",
+            "--allow-privileged=true",
+        ],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    ep = Endpoint(
+        f"https://127.0.0.1:{secure_port}", ctx=ctx,
+        procs=(apiserver, etcd), workdir=workdir,
+    )
+
+    deadline = time.time() + 60
+    while True:
+        try:
+            code, _ = ep.request("GET", "/readyz")
+            if code == 200:
+                break
+        except Exception:
+            pass
+        if time.time() > deadline:
+            ep.close()
+            raise RuntimeError("kube-apiserver did not become ready")
+        time.sleep(0.5)
+
+    _install_crd(ep)
+    return ep
+
+
+def _install_crd(ep: Endpoint) -> None:
+    """POST the generated CRD and wait until the CR endpoint serves."""
+    from tpu_network_operator.api.v1alpha1 import crdgen
+
+    crd = crdgen.crd()
+    code, body = ep.request(
+        "POST",
+        "/apis/apiextensions.k8s.io/v1/customresourcedefinitions",
+        crd,
+    )
+    assert code in (200, 201, 409), body
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        code, _ = ep.request(
+            "GET", "/apis/tpunet.dev/v1alpha1/networkclusterpolicies"
+        )
+        if code == 200:
+            return
+        time.sleep(0.5)
+    raise RuntimeError("CRD endpoint never became ready")

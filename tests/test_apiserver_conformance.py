@@ -1,0 +1,247 @@
+"""Apiserver conformance tier (VERDICT r3 #5).
+
+Pins the exact status codes, Status bodies, and watch-event sequences
+kube-apiserver produces for the operations this framework performs —
+create, duplicate create, stale-resourceVersion update, status
+subresource conflict, server-side apply on Leases, watch add/modify/
+delete, 410 resume — and runs the same assertions against:
+
+* the in-repo wire server (always) — this is what keeps
+  ``kube/wire.py`` honest instead of self-certified;
+* a REAL ``kube-apiserver`` + ``etcd`` when envtest-style binaries are
+  available (``KUBEBUILDER_ASSETS`` or ``TPUNET_ENVTEST_BIN_DIR``) —
+  the envtest analog of ref ``internal/controller/suite_test.go:61-102``.
+
+Every assertion here is written to hold on a real apiserver; anything
+wire-specific (fault injection) asserts only the event SHAPE the real
+server also uses.
+"""
+
+import pytest
+
+from tests.apiserver_harness import (
+    envtest_bin_dir,
+    real_endpoint,
+    wire_endpoint,
+)
+
+NS = "default"
+LEASES = f"/apis/coordination.k8s.io/v1/namespaces/{NS}/leases"
+POLICIES = "/apis/tpunet.dev/v1alpha1/networkclusterpolicies"
+
+_PARAMS = ["wire"] + (["real"] if envtest_bin_dir() else [])
+
+
+@pytest.fixture(params=_PARAMS, scope="module")
+def server(request, tmp_path_factory):
+    """(endpoint, is_wire): one server per backend per module."""
+    if request.param == "wire":
+        ep, srv = wire_endpoint()
+        yield ep, srv
+        srv.stop()
+    else:
+        ep = real_endpoint(str(tmp_path_factory.mktemp("envtest")))
+        yield ep, None
+        ep.close()
+
+
+def _lease(name, holder="node-1", labels=None):
+    return {
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": {
+            "name": name,
+            "namespace": NS,
+            **({"labels": labels} if labels else {}),
+        },
+        "spec": {"holderIdentity": holder},
+    }
+
+
+def _policy(name):
+    return {
+        "apiVersion": "tpunet.dev/v1alpha1",
+        "kind": "NetworkClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {
+            "configurationType": "tpu-so",
+            "nodeSelector": {"tpunet.dev/tpu": "true"},
+            "tpuScaleOut": {"layer": "L2"},
+        },
+    }
+
+
+class TestCreateSemantics:
+    def test_create_returns_201_with_uid_and_rv(self, server):
+        ep, _ = server
+        code, body = ep.request("POST", LEASES, _lease("conf-create"))
+        assert code == 201
+        assert body["kind"] == "Lease"
+        assert body["metadata"]["resourceVersion"]
+        assert body["metadata"]["uid"]
+
+    def test_duplicate_create_is_409_already_exists(self, server):
+        ep, _ = server
+        ep.request("POST", LEASES, _lease("conf-dup"))
+        code, body = ep.request("POST", LEASES, _lease("conf-dup"))
+        assert code == 409
+        assert body["kind"] == "Status"
+        assert body["status"] == "Failure"
+        assert body["reason"] == "AlreadyExists"
+        assert body["code"] == 409
+
+    def test_get_missing_is_404_not_found(self, server):
+        ep, _ = server
+        code, body = ep.request("GET", f"{LEASES}/conf-absent")
+        assert code == 404
+        assert body["kind"] == "Status"
+        assert body["reason"] == "NotFound"
+        assert body["code"] == 404
+
+    def test_list_body_shape(self, server):
+        ep, _ = server
+        ep.request("POST", LEASES, _lease("conf-list"))
+        code, body = ep.request("GET", LEASES)
+        assert code == 200
+        assert body["kind"] == "LeaseList"
+        assert any(
+            i["metadata"]["name"] == "conf-list" for i in body["items"]
+        )
+
+    def test_label_selector_filters_server_side(self, server):
+        ep, _ = server
+        ep.request("POST", LEASES, _lease("conf-sel-a", labels={"g": "x"}))
+        ep.request("POST", LEASES, _lease("conf-sel-b", labels={"g": "y"}))
+        code, body = ep.request("GET", f"{LEASES}?labelSelector=g%3Dx")
+        assert code == 200
+        names = {i["metadata"]["name"] for i in body["items"]}
+        assert "conf-sel-a" in names
+        assert "conf-sel-b" not in names
+
+
+class TestConflictSemantics:
+    def test_stale_resource_version_update_is_409_conflict(self, server):
+        ep, _ = server
+        _, created = ep.request("POST", LEASES, _lease("conf-stale"))
+        fresh = dict(created, spec={"holderIdentity": "node-2"})
+        code, updated = ep.request(
+            "PUT", f"{LEASES}/conf-stale", fresh
+        )
+        assert code == 200
+        assert (
+            updated["metadata"]["resourceVersion"]
+            != created["metadata"]["resourceVersion"]
+        )
+        # writing through the OLD resourceVersion must now conflict
+        stale = dict(created, spec={"holderIdentity": "node-3"})
+        code, body = ep.request("PUT", f"{LEASES}/conf-stale", stale)
+        assert code == 409
+        assert body["kind"] == "Status"
+        assert body["reason"] == "Conflict"
+
+    def test_status_subresource_conflict(self, server):
+        ep, _ = server
+        code, created = ep.request("POST", POLICIES, _policy("conf-pol"))
+        assert code == 201
+        # bump the object so the captured resourceVersion goes stale
+        bump = dict(created)
+        bump["metadata"] = dict(
+            created["metadata"], labels={"touched": "true"}
+        )
+        code, _ = ep.request("PUT", f"{POLICIES}/conf-pol", bump)
+        assert code == 200
+        stale = dict(created)
+        stale["status"] = {"state": "Working on it..", "targets": 1}
+        code, body = ep.request(
+            "PUT", f"{POLICIES}/conf-pol/status", stale
+        )
+        assert code == 409
+        assert body["reason"] == "Conflict"
+
+
+class TestServerSideApply:
+    def test_apply_requires_field_manager(self, server):
+        ep, _ = server
+        code, body = ep.request(
+            "PATCH", f"{LEASES}/conf-ssa-nofm", _lease("conf-ssa-nofm"),
+            content_type="application/apply-patch+yaml",
+        )
+        assert code == 400
+
+    def test_apply_creates_then_merges(self, server):
+        ep, _ = server
+        path = f"{LEASES}/conf-ssa?fieldManager=tpunet&force=true"
+        code, body = ep.request(
+            "PATCH", path, _lease("conf-ssa", holder="w0"),
+            content_type="application/apply-patch+yaml",
+        )
+        assert code in (200, 201)
+        assert body["spec"]["holderIdentity"] == "w0"
+        rv1 = body["metadata"]["resourceVersion"]
+        # idempotent re-apply with changed fields merges, bumps RV
+        code, body = ep.request(
+            "PATCH", path, _lease("conf-ssa", holder="w1"),
+            content_type="application/apply-patch+yaml",
+        )
+        assert code == 200
+        assert body["spec"]["holderIdentity"] == "w1"
+        assert body["metadata"]["resourceVersion"] != rv1
+
+
+def _next_for(events, name):
+    """Next event about ``name`` — a real apiserver's no-resourceVersion
+    watch first replays current state as ADDED events, so unrelated
+    objects from earlier tests must be skipped, not failed on."""
+    for ev in events:
+        if ev["object"].get("metadata", {}).get("name") == name:
+            return ev
+    raise AssertionError(f"stream ended without an event for {name}")
+
+
+class TestWatchSemantics:
+    def test_add_modify_delete_sequence(self, server):
+        ep, _ = server
+        events = ep.stream(f"{LEASES}?watch=true", timeout=15)
+        ep.request("POST", LEASES, _lease("conf-watch"))
+        ev = _next_for(events, "conf-watch")
+        assert ev["type"] == "ADDED"
+        current = ev["object"]
+        updated = dict(current, spec={"holderIdentity": "node-9"})
+        ep.request("PUT", f"{LEASES}/conf-watch", updated)
+        ev = _next_for(events, "conf-watch")
+        assert ev["type"] == "MODIFIED"
+        assert ev["object"]["spec"]["holderIdentity"] == "node-9"
+        ep.request("DELETE", f"{LEASES}/conf-watch")
+        ev = _next_for(events, "conf-watch")
+        assert ev["type"] == "DELETED"
+
+    def test_watch_resume_gone_is_error_410_expired(self, server):
+        """Too-old resourceVersion resume: the apiserver answers with an
+        ERROR event whose object is a Status{code:410, reason:Expired}.
+        Deterministically triggerable only on the wire server (the real
+        one would need etcd compaction), but the event SHAPE asserted
+        here is exactly the real server's."""
+        ep, wire = server
+        if wire is None:
+            pytest.skip("410 injection needs the wire server's fault seam")
+        wire.inject_gone_once()
+        events = ep.stream(f"{LEASES}?watch=true&resourceVersion=1")
+        ev = next(events)
+        assert ev["type"] == "ERROR"
+        status = ev["object"]
+        assert status["kind"] == "Status"
+        assert status["code"] == 410
+        assert status["reason"] == "Expired"
+
+
+class TestDeleteSemantics:
+    def test_delete_then_404(self, server):
+        ep, _ = server
+        ep.request("POST", LEASES, _lease("conf-del"))
+        code, body = ep.request("DELETE", f"{LEASES}/conf-del")
+        assert code == 200
+        # kube returns the deleted object (immediate deletion) — a
+        # Status success is also within contract for other resources
+        assert body["kind"] in ("Lease", "Status")
+        code, _ = ep.request("GET", f"{LEASES}/conf-del")
+        assert code == 404

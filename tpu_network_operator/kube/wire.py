@@ -319,6 +319,17 @@ class WireApiServer:
                     self._reply(404, _status_body(404, "NotFound", self.path))
                     return
                 av, kind, ns, name, _sub = route
+                q = parse_qs(urlparse(self.path).query)
+                if (
+                    "apply-patch" in self.headers.get("Content-Type", "")
+                    and not q.get("fieldManager", [""])[0]
+                ):
+                    # kube-apiserver rejects SSA without a field manager
+                    self._reply(400, _status_body(
+                        400, "BadRequest",
+                        "fieldManager is required for apply patch",
+                    ))
+                    return
                 patch = self._read_body()
                 if patch is None:
                     self._reply(400, _status_body(400, "BadRequest",
