@@ -184,6 +184,66 @@ def test_fuzz_admission_and_reconcile():
     assert rejected > 20, f"seed={SEED}: only {rejected} rejected"
 
 
+def test_fuzz_cr_churn_over_the_wire():
+    """The reference fuzzes CR create/delete against a live cluster via
+    KUBECONFIG (ref ``test/fuzz/fuzz_test.go:32-89``) with "no operator
+    crash" as the oracle.  The in-repo analog drives the same churn over
+    REAL HTTP transport — ApiClient against the wire apiserver with the
+    admission seams wired in — with a sharper oracle: rejections arrive
+    as typed AdmissionDeniedError (never a bare 400), every admitted CR
+    reconciles to a DaemonSet whose args re-parse through the agent's
+    parser, and deletes GC the DaemonSet.  Fewer iterations than the
+    in-process variant: each one crosses the wire."""
+    from tpu_network_operator.kube import errors as kerr
+    from tpu_network_operator.kube.client import ApiClient
+    from tpu_network_operator.kube.wire import WireApiServer
+
+    rng = random.Random(SEED + 7)
+    print(f"seed={SEED + 7}")
+    parser = build_parser()
+    admitted = rejected = 0
+    with WireApiServer(make_cluster()) as srv:
+        client = ApiClient(srv.url)
+        mgr = Manager(client, NAMESPACE)
+        for i in range(80):
+            name = f"wirefuzz-{i}"
+            obj = fuzz_policy(rng, name)
+            try:
+                client.create(obj)
+                admitted += 1
+            except kerr.AdmissionDeniedError:
+                rejected += 1
+                continue
+            except Exception as e:   # noqa: BLE001 — the oracle
+                raise AssertionError(
+                    f"seed={SEED + 7} iter={i}: non-admission error over "
+                    f"the wire: {type(e).__name__}: {e}\nobject: {obj}"
+                ) from e
+            mgr.enqueue(name)
+            mgr.drain()
+            dss = client.list(
+                "apps/v1", "DaemonSet", namespace=NAMESPACE,
+                field_index={".metadata.controller": name},
+            )
+            assert len(dss) == 1, f"seed={SEED + 7} iter={i}: no DaemonSet"
+            args = dss[0]["spec"]["template"]["spec"]["containers"][0]["args"]
+            parsed = parser.parse_args(args)
+            assert parsed.mode in ("L2", "L3")
+            if rng.random() < 0.4:
+                client.delete(API_VERSION, "NetworkClusterPolicy", name)
+                mgr.enqueue(name)
+                mgr.drain()
+                gone = client.list(
+                    "apps/v1", "DaemonSet", namespace=NAMESPACE,
+                    field_index={".metadata.controller": name},
+                )
+                assert not gone, (
+                    f"seed={SEED + 7} iter={i}: DaemonSet survived delete"
+                )
+    assert admitted > 5, f"seed={SEED + 7}: only {admitted} admitted"
+    assert rejected > 5, f"seed={SEED + 7}: only {rejected} rejected"
+
+
 def test_fuzz_from_dict_never_crashes_on_garbage():
     """from_dict + validation over structurally hostile objects: the only
     acceptable outcomes are clean admission errors or typed ValueErrors."""

@@ -143,8 +143,8 @@ class ApiClient:
                 if '"reason":"AlreadyExists"' in detail:
                     raise kerr.AlreadyExistsError(detail) from None
                 raise kerr.ConflictError(detail) from None
-            if e.code in (400, 422, 403):
-                raise kerr.ApiError(f"{e.code}: {detail}") from None
+            if "admission webhook denied the request" in detail:
+                raise kerr.AdmissionDeniedError(detail) from None
             raise kerr.ApiError(f"{e.code}: {detail}") from None
 
     # -- FakeCluster-compatible interface -------------------------------------

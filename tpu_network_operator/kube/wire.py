@@ -112,6 +112,15 @@ class WireApiServer:
                     )
                 elif isinstance(e, kerr.ConflictError):
                     self._reply(409, _status_body(409, "Conflict", str(e)))
+                elif isinstance(e, kerr.AdmissionDeniedError):
+                    # kube-apiserver surfaces webhook denials as 400 with
+                    # this message shape; the client maps it back to the
+                    # typed error so rejection stays distinguishable from
+                    # transport/bug 400s across the wire
+                    self._reply(400, _status_body(
+                        400, "Invalid",
+                        f"admission webhook denied the request: {e}",
+                    ))
                 else:
                     self._reply(400, _status_body(400, "BadRequest", str(e)))
 
