@@ -1,0 +1,171 @@
+"""HF Llama checkpoint import: end-to-end logits parity.
+
+A tiny randomly initialized ``transformers`` LlamaForCausalLM is the
+reference implementation; importing its state dict and running this
+framework's forward must reproduce its logits.  This pins the whole
+model stack — embedding, RMSNorm, split-half RoPE, GQA attention,
+SwiGLU, head — against the canonical implementation, not just against
+itself."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from tpu_network_operator.models.convert import (  # noqa: E402
+    cfg_from_hf,
+    from_hf_llama,
+)
+from tpu_network_operator.models.generate import generate  # noqa: E402
+from tpu_network_operator.models.llama import forward  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=500_000.0,
+        rms_norm_eps=1e-5, tie_word_embeddings=False,
+        attention_bias=False, mlp_bias=False,
+    )
+    torch.manual_seed(7)
+    model = transformers.LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+@pytest.fixture(scope="module")
+def imported(hf_model):
+    cfg = cfg_from_hf(hf_model.config, dtype=jnp.float32)
+    return from_hf_llama(hf_model.state_dict(), cfg), cfg
+
+
+class TestImport:
+    def test_tree_shapes(self, imported):
+        params, cfg = imported
+        assert params["embed"].shape == (256, 64)
+        assert params["layers"]["wq"].shape == (2, 64, 64)
+        assert params["layers"]["wk"].shape == (2, 64, 32)
+        assert params["layers"]["w_gate"].shape == (2, 64, 128)
+        assert params["lm_head"].shape == (64, 256)
+
+    def test_logits_match_transformers(self, hf_model, imported):
+        params, cfg = imported
+        toks = np.array([[3, 17, 200, 9, 45, 5, 128, 77, 2, 11]])
+        with torch.no_grad():
+            ref = hf_model(torch.tensor(toks)).logits.numpy()
+        out = np.asarray(forward(params, jnp.asarray(toks), cfg))
+        np.testing.assert_allclose(ref, out, rtol=2e-4, atol=2e-4)
+
+    def test_greedy_decode_matches_transformers(self, hf_model, imported):
+        params, cfg = imported
+        prompt = np.array([[5, 9, 33, 2]])
+        with torch.no_grad():
+            ref = hf_model.generate(
+                torch.tensor(prompt), max_new_tokens=8, do_sample=False,
+                num_beams=1, pad_token_id=0,
+            ).numpy()
+        out = np.asarray(
+            generate(params, jnp.asarray(prompt), cfg, max_new_tokens=8)
+        )
+        np.testing.assert_array_equal(ref, out)
+
+    def test_tied_embeddings_reuse_embed_as_head(self, hf_model):
+        cfg = cfg_from_hf(hf_model.config, dtype=jnp.float32)
+        sd = {
+            k: v for k, v in hf_model.state_dict().items()
+            if k != "lm_head.weight"
+        }
+        params = from_hf_llama(sd, cfg)
+        np.testing.assert_allclose(
+            np.asarray(params["lm_head"]),
+            np.asarray(params["embed"]).T,
+        )
+
+    def test_missing_tensor_is_a_clear_error(self, hf_model):
+        cfg = cfg_from_hf(hf_model.config, dtype=jnp.float32)
+        sd = dict(hf_model.state_dict())
+        del sd["model.layers.1.mlp.up_proj.weight"]
+        with pytest.raises(KeyError, match="up_proj"):
+            from_hf_llama(sd, cfg)
+
+
+class TestRopeScaling:
+    def test_llama31_rope_scaling_logits_match_transformers(self):
+        """Llama-3.1/3.2 checkpoints ship rope_type=llama3 frequency
+        scaling; importing must reproduce transformers' scaled logits,
+        not silently use unscaled RoPE."""
+        cfg = transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            # low theta + small original context: a large share of the
+            # frequency spectrum lands in the scaled band with non-tiny
+            # angles over this test's 48 positions, so the no-scaling
+            # divergence check below has teeth
+            rope_theta=10_000.0, rms_norm_eps=1e-5,
+            tie_word_embeddings=False,
+            rope_scaling={
+                "rope_type": "llama3", "factor": 8.0,
+                "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                "original_max_position_embeddings": 16,
+            },
+        )
+        torch.manual_seed(11)
+        model = transformers.LlamaForCausalLM(cfg)
+        model.eval()
+        ours = cfg_from_hf(model.config, dtype=jnp.float32)
+        assert ours.rope_scaling is not None
+        params = from_hf_llama(model.state_dict(), ours)
+        toks = np.arange(48)[None, :] % 256
+        with torch.no_grad():
+            ref = model(torch.tensor(toks)).logits.numpy()
+        out = np.asarray(forward(params, jnp.asarray(toks), ours))
+        np.testing.assert_allclose(ref, out, rtol=2e-4, atol=2e-4)
+        # and ignoring the scaling WOULD have diverged (the test bites):
+        # the angle tables change substantially, and even through this
+        # tiny random model the logits move well past the parity budget
+        import dataclasses
+
+        from tpu_network_operator.ops.rope import rope_angles
+
+        cos_s, _ = rope_angles(48, ours.head_dim, ours.rope_theta,
+                               scaling=ours.rope_scaling_dict)
+        cos_u, _ = rope_angles(48, ours.head_dim, ours.rope_theta)
+        assert np.abs(np.asarray(cos_s) - np.asarray(cos_u)).max() > 0.5
+        unscaled = dataclasses.replace(ours, rope_scaling=None)
+        bad = np.asarray(forward(params, jnp.asarray(toks), unscaled))
+        assert np.abs(bad - ref).max() > 1e-3
+
+    def test_unsupported_scaling_type_refused(self, hf_model):
+        hf_model.config.rope_scaling = {"rope_type": "yarn", "factor": 4.0}
+        try:
+            with pytest.raises(ValueError, match="rope_scaling"):
+                cfg_from_hf(hf_model.config)
+        finally:
+            hf_model.config.rope_scaling = None
+
+
+class TestSafetensorsPath:
+    def test_load_hf_checkpoint_streams_safetensors(self, hf_model, tmp_path,
+                                                    imported):
+        """A saved checkpoint directory loads through the shard-stream
+        path (no torch module instantiation) and matches the in-memory
+        import exactly."""
+        from tpu_network_operator.models.convert import load_hf_checkpoint
+
+        hf_model.save_pretrained(tmp_path, safe_serialization=True)
+        assert list(tmp_path.glob("*.safetensors"))
+        params, cfg = load_hf_checkpoint(str(tmp_path), dtype=jnp.float32)
+        ref_params, ref_cfg = imported
+        assert cfg == ref_cfg
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32)
+            ),
+            params, ref_params,
+        )

@@ -19,10 +19,14 @@ from .moe import MoEConfig  # noqa: F401
 
 
 def __getattr__(name):
-    # lazy: checkpoint pulls in orbax, which training/dryrun paths that
-    # never checkpoint shouldn't have to have installed
+    # lazy: checkpoint pulls in orbax and convert pulls in transformers
+    # — paths that never touch them shouldn't need those imports
     if name == "TrainCheckpointer":
         from .checkpoint import TrainCheckpointer
 
         return TrainCheckpointer
+    if name in ("cfg_from_hf", "from_hf_llama", "load_hf_checkpoint"):
+        from . import convert
+
+        return getattr(convert, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

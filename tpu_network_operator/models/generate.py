@@ -110,7 +110,8 @@ def forward_with_cache(
     the whole buffer (the pre-effective-length behavior)."""
     max_len = cache["k"].shape[2]
     x = params["embed"][tokens].astype(cfg.dtype)
-    cos, sin = rope_angles(max_len, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_angles(max_len, cfg.head_dim, cfg.rope_theta,
+                           scaling=cfg.rope_scaling_dict)
 
     def body(x, layer_in):
         lp, ck, cv = layer_in

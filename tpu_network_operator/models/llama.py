@@ -23,7 +23,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass
 from functools import partial
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +46,11 @@ class LlamaConfig:
     ffn: int = 14_336
     max_seq: int = 8192
     rope_theta: float = 500_000.0
+    # Llama-3.1-style rope scaling parameters (ops.rope), as an items
+    # tuple so the frozen config stays hashable; None = plain RoPE.
+    # Read via the rope_scaling_dict property; build from a mapping
+    # with LlamaConfig.rope_scaling_from(...).
+    rope_scaling: Optional[Tuple[Tuple[str, float], ...]] = None
     rms_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
@@ -64,6 +69,20 @@ class LlamaConfig:
     @property
     def head_dim(self) -> int:
         return self.hidden // self.heads
+
+    @property
+    def rope_scaling_dict(self) -> Optional[Dict[str, float]]:
+        return dict(self.rope_scaling) if self.rope_scaling else None
+
+    @staticmethod
+    def rope_scaling_from(params: Optional[Dict[str, float]]):
+        """Normalize a rope-scaling mapping into the hashable stored form."""
+        if not params:
+            return None
+        return tuple(sorted(
+            (k, float(v)) for k, v in params.items()
+            if isinstance(v, (int, float))
+        ))
 
     def num_params(self) -> int:
         """Exact parameter count (embeddings + untied head included)."""
@@ -275,7 +294,8 @@ def forward_hidden(
     # activation layout (batch over data+fsdp, optional seq sharding) is
     # pinned by the jit in/out shardings; XLA propagates it through the scan
 
-    cos, sin = rope_angles(tokens.shape[1], cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_angles(tokens.shape[1], cfg.head_dim, cfg.rope_theta,
+                           scaling=cfg.rope_scaling_dict)
 
     def block(x, lp):
         return _layer(cfg, cos, sin, x, lp, attn_fn)

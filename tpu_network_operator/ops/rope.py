@@ -20,18 +20,49 @@ numerics drift (pinned by tests/test_rope.py).
 
 from __future__ import annotations
 
-from typing import Tuple
+import math
+from typing import Mapping, Optional, Tuple
 
 import jax.numpy as jnp
 
 
+def _llama3_scaled_freqs(
+    freqs: jnp.ndarray, scaling: Mapping[str, float]
+) -> jnp.ndarray:
+    """Llama-3.1 frequency scaling (the public ``rope_type: llama3``
+    rule): long-wavelength components are slowed by ``factor``,
+    short-wavelength ones kept, and the band between
+    ``low_freq_factor``/``high_freq_factor`` wavelengths of the original
+    training context interpolates smoothly.  Matches ``transformers``'
+    implementation — pinned by the HF logits-parity test."""
+    factor = float(scaling.get("factor", 8.0))
+    low = float(scaling.get("low_freq_factor", 1.0))
+    high = float(scaling.get("high_freq_factor", 4.0))
+    orig = float(scaling.get("original_max_position_embeddings", 8192))
+    wavelen = 2.0 * math.pi / freqs
+    slowed = freqs / factor
+    smooth = (orig / wavelen - low) / (high - low)
+    blended = (1.0 - smooth) * slowed + smooth * freqs
+    return jnp.where(
+        wavelen > orig / low,
+        slowed,
+        jnp.where(wavelen < orig / high, freqs, blended),
+    )
+
+
 def rope_angles(
-    seq_len: int, head_dim: int, theta: float = 500_000.0, dtype=jnp.float32
+    seq_len: int, head_dim: int, theta: float = 500_000.0,
+    dtype=jnp.float32, scaling: Optional[Mapping[str, float]] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Precompute (cos, sin) tables of shape [seq_len, head_dim//2]."""
+    """Precompute (cos, sin) tables of shape [seq_len, head_dim//2].
+
+    ``scaling``: optional Llama-3.1-style rope-scaling parameters
+    (:func:`_llama3_scaled_freqs`); None = plain RoPE."""
     freqs = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if scaling:
+        freqs = _llama3_scaled_freqs(freqs, scaling)
     t = jnp.arange(seq_len, dtype=jnp.float32)
     angles = jnp.outer(t, freqs)
     return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)

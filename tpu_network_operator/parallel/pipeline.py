@@ -306,8 +306,11 @@ def _make_pipelined_step(
 
     def fwd(params, tokens):
         x = params["embed"][tokens].astype(cfg.dtype)
+        # getattr: this builder also serves MoEConfig, which has no
+        # rope-scaling field
         cos, sin = rope_angles(
-            tokens.shape[1], cfg.head_dim, cfg.rope_theta
+            tokens.shape[1], cfg.head_dim, cfg.rope_theta,
+            scaling=getattr(cfg, "rope_scaling_dict", None),
         )
         if seq_axis:
             # the stage body sees only its local sequence chunk: slice
@@ -437,7 +440,8 @@ def _make_1f1b_step(
         if b % M:
             raise ValueError(f"batch {b} not divisible by microbatches {M}")
         xtok = tokens.reshape(M, b // M, s1)
-        cos, sin = rope_angles(s, cfg.head_dim, cfg.rope_theta)
+        cos, sin = rope_angles(s, cfg.head_dim, cfg.rope_theta,
+                               scaling=cfg.rope_scaling_dict)
 
         def block(x, lp):
             return llama._layer(cfg, cos, sin, x, lp, attn_fn)

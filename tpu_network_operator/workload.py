@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import Optional
@@ -241,6 +242,18 @@ def cmd_train(args) -> int:
         from .models.llama import make_train_step
 
         cfg = _pick_preset(_llama_presets(), args.preset, "llama")
+        cfg_sidecar = (
+            os.path.join(args.checkpoint_dir, "cfg.json")
+            if args.checkpoint_dir else ""
+        )
+        if cfg_sidecar and os.path.exists(cfg_sidecar):
+            # imported checkpoints (workload convert) carry their true
+            # geometry — incl. rope scaling — which beats the preset
+            from .models.convert import cfg_from_json
+
+            with open(cfg_sidecar) as f:
+                cfg = cfg_from_json(f.read())
+            log(f"config from {cfg_sidecar} (overrides --preset)")
         if args.pipe > 1:
             from .parallel import make_pipeline_train_step
 
@@ -333,6 +346,53 @@ def cmd_train(args) -> int:
         "final_loss": round(loss_val, 4),
         "mesh": dict(mesh.shape),
         "resumed_from": start_step,
+    })
+    return 0
+
+
+def cmd_convert(args) -> int:
+    """HF Llama checkpoint -> framework train checkpoint (step 0) plus a
+    cfg.json sidecar; `workload train --checkpoint-dir` resumes from it
+    with the checkpoint's true geometry (incl. rope scaling)."""
+    import jax
+
+    from .models.checkpoint import TrainCheckpointer
+    from .models.convert import (
+        assign_shardings,
+        cfg_to_json,
+        load_hf_checkpoint,
+    )
+    from .models.llama import make_train_step
+
+    bootstrap = _init_distributed(args.bootstrap)
+    mesh = _build_mesh(args, bootstrap)
+    params, cfg = load_hf_checkpoint(args.hf_path)
+    log(f"imported {cfg.num_params() / 1e9:.2f}B params from {args.hf_path}")
+    params = assign_shardings(params, cfg, mesh)
+
+    optimizer = None
+    if args.optimizer == "adam8bit":
+        from .models.optim8bit import adamw8bit
+
+        optimizer = adamw8bit()
+    # the train step's own optimizer defaulting keeps the saved state's
+    # structure identical to what cmd_train will restore into
+    _, _, optimizer = make_train_step(cfg, mesh, optimizer=optimizer)
+    opt_state = jax.jit(optimizer.init)(params)
+
+    os.makedirs(args.checkpoint_dir, exist_ok=True)
+    with open(os.path.join(args.checkpoint_dir, "cfg.json"), "w") as f:
+        f.write(cfg_to_json(cfg))
+    with TrainCheckpointer(args.checkpoint_dir) as ckpt:
+        ckpt.save(0, params, opt_state)
+        ckpt.wait()
+    _emit({
+        "metric": "hf checkpoint import",
+        "value": round(cfg.num_params() / 1e9, 3),
+        "unit": "B params",
+        "checkpoint_dir": args.checkpoint_dir,
+        "rope_scaling": bool(cfg.rope_scaling),
+        "mesh": dict(mesh.shape),
     })
     return 0
 
@@ -449,6 +509,20 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--checkpoint-every", type=int, default=0)
     t.add_argument("--keep-checkpoints", type=int, default=3)
     t.set_defaults(fn=cmd_train)
+
+    cv = sub.add_parser(
+        "convert", help="import an HF Llama checkpoint into a train "
+                        "checkpoint (+cfg.json sidecar)"
+    )
+    _mesh_flags(cv)
+    cv.add_argument("--hf-path", required=True,
+                    help="local HF checkpoint directory")
+    cv.add_argument("--checkpoint-dir", required=True)
+    cv.add_argument("--optimizer", choices=["adamw", "adam8bit"],
+                    default="adamw",
+                    help="optimizer whose (fresh) state is saved alongside "
+                         "the imported params")
+    cv.set_defaults(fn=cmd_convert)
 
     g = sub.add_parser("generate", help="decode throughput")
     _mesh_flags(g)
