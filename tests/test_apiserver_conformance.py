@@ -215,6 +215,66 @@ class TestWatchSemantics:
         ev = _next_for(events, "conf-watch")
         assert ev["type"] == "DELETED"
 
+    def test_list_carries_resource_version(self, server):
+        ep, _ = server
+        code, body = ep.request("GET", LEASES)
+        assert code == 200
+        assert body["metadata"]["resourceVersion"]
+
+    def test_list_then_watch_replays_only_newer_events(self, server):
+        """The informer pattern: list, then watch from the list's
+        resourceVersion — objects that existed at list time must NOT be
+        replayed, events after it must arrive."""
+        ep, _ = server
+        ep.request("POST", LEASES, _lease("conf-ltw-old"))
+        code, lst = ep.request("GET", LEASES)
+        rv = lst["metadata"]["resourceVersion"]
+        events = ep.stream(
+            f"{LEASES}?watch=true&resourceVersion={rv}", timeout=15
+        )
+        ep.request("POST", LEASES, _lease("conf-ltw-new"))
+        for ev in events:
+            name = ev["object"].get("metadata", {}).get("name")
+            assert name != "conf-ltw-old", "pre-list state replayed"
+            if name == "conf-ltw-new":
+                assert ev["type"] == "ADDED"
+                break
+        else:
+            raise AssertionError("post-list event never arrived")
+
+    def test_field_selector_metadata_name(self, server):
+        ep, _ = server
+        ep.request("POST", LEASES, _lease("conf-fs-a"))
+        ep.request("POST", LEASES, _lease("conf-fs-b"))
+        code, body = ep.request(
+            "GET", f"{LEASES}?fieldSelector=metadata.name%3Dconf-fs-a"
+        )
+        assert code == 200
+        names = {i["metadata"]["name"] for i in body["items"]}
+        assert names == {"conf-fs-a"}
+
+    def test_watch_filters_by_field_selector(self, server):
+        ep, _ = server
+        events = ep.stream(
+            f"{LEASES}?watch=true&fieldSelector=metadata.name%3Dconf-wfs-b",
+            timeout=15,
+        )
+        ep.request("POST", LEASES, _lease("conf-wfs-a"))
+        ep.request("POST", LEASES, _lease("conf-wfs-b"))
+        ev = next(events)
+        assert ev["object"]["metadata"]["name"] == "conf-wfs-b"
+
+    def test_malformed_selector_and_rv_are_400(self, server):
+        ep, _ = server
+        code, body = ep.request(
+            "GET", f"{LEASES}?fieldSelector=metadata.name"
+        )
+        assert code == 400, body
+        code, body = ep.request(
+            "GET", f"{LEASES}?watch=true&resourceVersion=notanumber"
+        )
+        assert code == 400, body
+
     def test_watch_resume_gone_is_error_410_expired(self, server):
         """Too-old resourceVersion resume: the apiserver answers with an
         ERROR event whose object is a Status{code:410, reason:Expired}.

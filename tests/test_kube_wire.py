@@ -163,6 +163,57 @@ class TestWatchOverWire:
         assert any(o["metadata"]["name"] == "d3" for _, o in evs)
         w.stop()
 
+    def _raw_events(self, srv, rv, n, timeout=5.0):
+        """Open ?watch&resourceVersion=rv raw (no reconnect logic) and
+        decode up to n events."""
+        import json as json_mod
+        import urllib.request
+
+        url = (
+            f"{srv.url}/apis/tpunet.dev/v1alpha1/networkclusterpolicies"
+            f"?watch=true&resourceVersion={rv}"
+        )
+        out = []
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            for line in resp:
+                if line.strip():
+                    out.append(json_mod.loads(line))
+                if len(out) >= n:
+                    break
+        return out
+
+    def test_watch_resume_replays_events_after_rv(self, srv, client):
+        """A watch opened from an old resourceVersion replays retained
+        history newer than it before going live — the property that
+        makes the client's reconnect-with-last-rv lossless for events
+        landing in the gap."""
+        r1 = srv.cluster.create(make_policy("r1"))
+        srv.cluster.create(make_policy("r2"))
+        srv.cluster.delete(
+            "tpunet.dev/v1alpha1", "NetworkClusterPolicy", "r2"
+        )
+        evs = self._raw_events(
+            srv, r1["metadata"]["resourceVersion"], 2
+        )
+        assert [(e["type"], e["object"]["metadata"]["name"]) for e in evs] \
+            == [("ADDED", "r2"), ("DELETED", "r2")]
+
+    def test_watch_resume_past_retention_gets_genuine_410(self, srv, client):
+        """Not fault injection: resuming from a resourceVersion whose
+        successor events were compacted out of the history window gets
+        the real Expired ERROR event."""
+        srv.cluster.HISTORY_LIMIT = 4
+        c0 = srv.cluster.create(make_policy("c0"))
+        for i in range(8):                   # evict c0's successors
+            srv.cluster.create(make_policy(f"c{i + 1}"))
+        evs = self._raw_events(
+            srv, c0["metadata"]["resourceVersion"], 1
+        )
+        assert evs[0]["type"] == "ERROR"
+        status = evs[0]["object"]
+        assert status["code"] == 410 and status["reason"] == "Expired"
+        assert "injected" not in status["message"]
+
     def test_watch_410_gone_triggers_relist(self, srv, client):
         w = client.watch("tpunet.dev/v1alpha1", "NetworkClusterPolicy")
         time.sleep(0.3)

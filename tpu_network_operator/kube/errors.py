@@ -25,6 +25,14 @@ class AlreadyExistsError(ApiError):
     reason = "AlreadyExists"
 
 
+class ExpiredError(ApiError):
+    """Watch resume from a resourceVersion older than the retained
+    history window (kube-apiserver's 410 Gone / reason Expired)."""
+
+    code = 410
+    reason = "Expired"
+
+
 class ConflictError(ApiError):
     """resourceVersion mismatch on update (optimistic concurrency)."""
 

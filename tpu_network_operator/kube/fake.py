@@ -26,6 +26,7 @@ from .errors import (
     AdmissionDeniedError,
     AlreadyExistsError,
     ConflictError,
+    ExpiredError,
     NotFoundError,
 )
 
@@ -74,6 +75,10 @@ class Watch:
 class FakeCluster:
     """The fake apiserver.  Thread-safe; watches are per-GVK fan-out."""
 
+    # retained watch events per GVK; a resume older than the retained
+    # window gets 410 Expired (kube-apiserver's watch-cache compaction)
+    HISTORY_LIMIT = 1024
+
     def __init__(self):
         self._lock = threading.RLock()
         self._store: Dict[GVK, Dict[Key, Dict[str, Any]]] = {}
@@ -83,6 +88,9 @@ class FakeCluster:
         self._indexers: Dict[Tuple[GVK, str], Callable] = {}
         self._mutators: Dict[GVK, List[Callable]] = {}
         self._validators: Dict[GVK, List[Callable]] = {}
+        # per-GVK: (list of (rv, ev_type, obj), rv of last evicted event)
+        self._history: Dict[GVK, List[Tuple[int, str, Dict[str, Any]]]] = {}
+        self._evicted_rv: Dict[GVK, int] = {}
 
     # -- admission + indexer registration ------------------------------------
 
@@ -120,6 +128,12 @@ class FakeCluster:
 
     def _notify(self, ev: str, obj: Dict[str, Any]) -> None:
         gvk = (obj["apiVersion"], obj["kind"])
+        rv = int(_meta(obj).get("resourceVersion", "0") or 0)
+        hist = self._history.setdefault(gvk, [])
+        hist.append((rv, ev, copy.deepcopy(obj)))
+        if len(hist) > self.HISTORY_LIMIT:
+            evicted = hist.pop(0)
+            self._evicted_rv[gvk] = evicted[0]
         for w in self._watches.get(gvk, []):
             w.push(ev, obj)
 
@@ -248,6 +262,9 @@ class FakeCluster:
             obj = bucket.pop((namespace, name), None)
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            # deletions get their own resourceVersion (kube behavior) so
+            # watch resume can order them against other events
+            self._bump_rv(obj)
             self._notify(DELETED, obj)
             self._gc(obj)
 
@@ -309,10 +326,41 @@ class FakeCluster:
                 out.append(copy.deepcopy(obj))
             return out
 
-    def watch(self, api_version: str, kind: str) -> Watch:
+    @property
+    def current_rv(self) -> str:
+        """The store's resourceVersion high-water mark (list metadata)."""
         with self._lock:
+            return str(self._rv)
+
+    def list_with_rv(self, *args, **kwargs):
+        """(items, resourceVersion) captured atomically — a list body's
+        rv must cover exactly the snapshot it shipped, or list-then-watch
+        resume can permanently miss a concurrent write."""
+        with self._lock:
+            return self.list(*args, **kwargs), str(self._rv)
+
+    def watch(
+        self, api_version: str, kind: str,
+        since_rv: Optional[int] = None,
+    ) -> Watch:
+        """Subscribe to this GVK's events.  ``since_rv``: resume — replay
+        every retained event newer than that resourceVersion before going
+        live (exactly the kube watch-resume contract); raises
+        :class:`ExpiredError` when the window no longer proves
+        continuity (events past ``since_rv`` were compacted away), which
+        the wire layer surfaces as the 410 Gone ERROR event."""
+        with self._lock:
+            gvk = (api_version, kind)
             w = Watch()
-            self._watches.setdefault((api_version, kind), []).append(w)
+            if since_rv:
+                if since_rv < self._evicted_rv.get(gvk, 0):
+                    raise ExpiredError(
+                        f"too old resource version: {since_rv}"
+                    )
+                for rv, ev, obj in self._history.get(gvk, []):
+                    if rv > since_rv:
+                        w.push(ev, obj)
+            self._watches.setdefault(gvk, []).append(w)
             return w
 
     # -- cluster simulation ---------------------------------------------------

@@ -11,9 +11,15 @@ exercises its real wire paths (TLS handshake, chunked decode, watch
 reconnect, conflict mapping) instead of the in-process shortcut, and
 agent subprocesses in e2e tests get a cluster to report to.
 
-Deliberately NOT implemented: apiserver features the framework does not
-consume (field selectors server-side, OpenAPI discovery beyond /apis,
-resourceVersion semantical list pagination).
+Watch resume follows the real contract: list bodies carry the store's
+``metadata.resourceVersion`` high-water mark, ``?watch&resourceVersion=N``
+replays every retained event newer than N before going live, and a
+resume older than the retention window gets the genuine 410 Gone /
+``Expired`` ERROR event (``FakeCluster.HISTORY_LIMIT`` plays the role
+of etcd compaction).  ``fieldSelector`` is evaluated server-side for
+the dotted paths kube supports generically.  Deliberately NOT
+implemented: apiserver features the framework does not consume
+(OpenAPI discovery beyond /apis, list pagination/continue tokens).
 """
 
 from __future__ import annotations
@@ -43,6 +49,48 @@ KINDS = {
     "events": "Event",
     "configmaps": "ConfigMap",
 }
+
+
+def _field_select(items, selector: str):
+    """Server-side fieldSelector: the dotted-path = value (or !=) pairs
+    kube-apiserver supports for every resource (metadata.name,
+    metadata.namespace) plus the common spec paths (e.g. Pod
+    spec.nodeName).  Unknown paths simply select nothing — matching the
+    apiserver's behavior of erroring only on unsupported FIELDS is not
+    worth a per-kind table here; the framework only consumes the
+    generic metadata ones."""
+    clauses = []
+    for part in selector.split(","):
+        if "!=" in part:
+            path, want = part.split("!=", 1)
+            clauses.append((path.strip().split("."), want, False))
+        elif "=" in part:
+            path, want = part.split("=", 1)
+            clauses.append((path.strip().split("."), want.lstrip("="), True))
+        else:
+            # the real apiserver 400s on an unparsable requirement; a
+            # silently-dropped clause would select everything
+            raise ValueError(
+                f"unable to parse fieldSelector requirement {part!r}"
+            )
+
+    def value_at(obj, path):
+        cur = obj
+        for p in path:
+            if not isinstance(cur, dict):
+                return None
+            cur = cur.get(p)
+        return cur
+
+    def keep(obj):
+        for path, want, eq in clauses:
+            got = value_at(obj, path)
+            got = "" if got is None else str(got)
+            if (got == want) != eq:
+                return False
+        return True
+
+    return [o for o in items if keep(o)]
 
 
 def _status_body(code: int, reason: str, message: str) -> bytes:
@@ -206,18 +254,48 @@ class WireApiServer:
                                 kv.split("=", 1)
                                 for kv in q["labelSelector"][0].split(",")
                             )
-                        items = outer.cluster.list(
+                        # items + rv atomically: a later rv than the
+                        # snapshot would make list-then-watch skip the
+                        # concurrent write forever
+                        items, rv = outer.cluster.list_with_rv(
                             av, kind, namespace=ns or None,
                             label_selector=sel,
                         )
+                        if "fieldSelector" in q:
+                            items = _field_select(
+                                items, q["fieldSelector"][0]
+                            )
                         self._reply_obj({
                             "kind": f"{kind}List", "apiVersion": av,
+                            # the high-water mark a client may resume a
+                            # watch from (list-then-watch)
+                            "metadata": {"resourceVersion": rv},
                             "items": items,
                         })
                 except Exception as e:   # noqa: BLE001 — wire error mapping
                     self._reply_err(e)
 
             def _serve_watch(self, av, kind, ns, q):
+                # validate BEFORE the 200/chunked headers go out — a
+                # failure after that corrupts the chunk stream with a
+                # second status line
+                since = q.get("resourceVersion", [""])[0]
+                try:
+                    since_rv = int(since) if since else None
+                except ValueError:
+                    self._reply(400, _status_body(
+                        400, "Invalid",
+                        f"invalid resourceVersion {since!r}",
+                    ))
+                    return
+                fsel = q.get("fieldSelector", [""])[0]
+                if fsel:
+                    try:
+                        _field_select([], fsel)
+                    except ValueError as e:
+                        self._reply(400, _status_body(400, "Invalid", str(e)))
+                        return
+
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -228,24 +306,33 @@ class WireApiServer:
                     self.wfile.write(data + b"\r\n")
                     self.wfile.flush()
 
+                def gone(message: str):
+                    chunk(json.dumps({
+                        "type": "ERROR",
+                        "object": {
+                            "kind": "Status", "code": 410,
+                            "reason": "Expired", "message": message,
+                        },
+                    }).encode() + b"\n")
+                    chunk(b"")   # terminal chunk
+
                 # a watch response never completes normally; without this
                 # the keep-alive socket stays open after we return and the
                 # client never observes drops
                 self.close_connection = True
 
-                if q.get("resourceVersion") and outer._gone_once.is_set():
+                if since and outer._gone_once.is_set():
+                    # fault injection: expiry on demand, regardless of
+                    # the real retention window
                     outer._gone_once.clear()
-                    chunk(json.dumps({
-                        "type": "ERROR",
-                        "object": {
-                            "kind": "Status", "code": 410, "reason": "Expired",
-                            "message": "too old resource version",
-                        },
-                    }).encode() + b"\n")
-                    chunk(b"")   # terminal chunk
+                    gone("too old resource version (injected)")
                     return
-
-                w = outer.cluster.watch(av, kind)
+                try:
+                    w = outer.cluster.watch(av, kind, since_rv=since_rv)
+                except kerr.ExpiredError as e:
+                    # genuine compaction: events past `since` are gone
+                    gone(str(e))
+                    return
                 try:
                     while True:
                         if outer._drop_once.is_set():
@@ -258,6 +345,8 @@ class WireApiServer:
                         if ns and obj.get("metadata", {}).get(
                             "namespace", ""
                         ) != ns:
+                            continue
+                        if fsel and not _field_select([obj], fsel):
                             continue
                         chunk(json.dumps(
                             {"type": ev_type, "object": obj}
