@@ -274,6 +274,10 @@ class TestWatchSemantics:
             "GET", f"{LEASES}?watch=true&resourceVersion=notanumber"
         )
         assert code == 400, body
+        code, body = ep.request(
+            "GET", f"{LEASES}?watch=true&resourceVersion=-1"
+        )
+        assert code == 400, body
 
     def test_watch_resume_gone_is_error_410_expired(self, server):
         """Too-old resourceVersion resume: the apiserver answers with an

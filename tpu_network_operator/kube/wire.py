@@ -51,14 +51,14 @@ KINDS = {
 }
 
 
-def _field_select(items, selector: str):
-    """Server-side fieldSelector: the dotted-path = value (or !=) pairs
-    kube-apiserver supports for every resource (metadata.name,
+def _field_predicate(selector: str):
+    """Server-side fieldSelector: parse the dotted-path = value (or !=)
+    pairs kube-apiserver supports for every resource (metadata.name,
     metadata.namespace) plus the common spec paths (e.g. Pod
-    spec.nodeName).  Unknown paths simply select nothing — matching the
-    apiserver's behavior of erroring only on unsupported FIELDS is not
-    worth a per-kind table here; the framework only consumes the
-    generic metadata ones."""
+    spec.nodeName) into a ``keep(obj)`` predicate.  Unknown paths simply
+    select nothing — matching the apiserver's behavior of erroring only
+    on unsupported FIELDS is not worth a per-kind table here; the
+    framework only consumes the generic metadata ones."""
     clauses = []
     for part in selector.split(","):
         if "!=" in part:
@@ -90,6 +90,11 @@ def _field_select(items, selector: str):
                 return False
         return True
 
+    return keep
+
+
+def _field_select(items, selector: str):
+    keep = _field_predicate(selector)
     return [o for o in items if keep(o)]
 
 
@@ -282,16 +287,20 @@ class WireApiServer:
                 since = q.get("resourceVersion", [""])[0]
                 try:
                     since_rv = int(since) if since else None
+                    if since_rv is not None and since_rv < 0:
+                        raise ValueError(since)
                 except ValueError:
                     self._reply(400, _status_body(
                         400, "Invalid",
                         f"invalid resourceVersion {since!r}",
                     ))
                     return
+                keep = None
                 fsel = q.get("fieldSelector", [""])[0]
                 if fsel:
                     try:
-                        _field_select([], fsel)
+                        # parse once; the predicate runs per event below
+                        keep = _field_predicate(fsel)
                     except ValueError as e:
                         self._reply(400, _status_body(400, "Invalid", str(e)))
                         return
@@ -346,7 +355,7 @@ class WireApiServer:
                             "namespace", ""
                         ) != ns:
                             continue
-                        if fsel and not _field_select([obj], fsel):
+                        if keep is not None and not keep(obj):
                             continue
                         chunk(json.dumps(
                             {"type": ev_type, "object": obj}

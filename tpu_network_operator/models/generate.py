@@ -24,6 +24,7 @@ to cite.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Dict, Optional, Tuple
 
@@ -33,8 +34,27 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import causal_attention
 from ..ops.norms import rms_norm
+from ..ops.pallas_attention import flash_attention
+from ..ops.pallas_attention import supports as flash_supports
 from ..ops.rope import apply_rope_at, rope_angles
-from .llama import LlamaConfig, Params
+from .llama import LlamaConfig, Params, _backend
+
+
+def _prefill_flash_ok(cfg, pos, s: int, attn_len: int) -> bool:
+    """Route the prefill pass through the Pallas flash kernel: only when
+    the query block IS the whole filled prefix (static pos 0, s == view
+    length), on a single TPU (GSPMD opacity — see auto_attention, whose
+    platform view comes through the same ``_backend`` seam), for
+    kernel-supported shapes.  TPUNET_DECODE_FLASH=0/1 overrides the
+    backend gate for tests."""
+    if not (isinstance(pos, int) and pos == 0 and s == attn_len):
+        return False
+    if not flash_supports(s, s, cfg.head_dim):
+        return False
+    flag = os.environ.get("TPUNET_DECODE_FLASH", "")
+    if flag in ("0", "1"):
+        return flag == "1"
+    return jax.device_count() == 1 and _backend() == "tpu"
 
 
 def init_cache(
@@ -86,7 +106,14 @@ def _block_with_cache(cfg, cos, sin, pos, x, lp, ck, cv, attn_len=None):
     ckv, cvv = ck, cv
     if attn_len is not None and attn_len < ck.shape[1]:
         ckv, cvv = ck[:, :attn_len], cv[:, :attn_len]
-    a = causal_attention(q, ckv, cvv, q_offset=pos)
+    if _prefill_flash_ok(cfg, pos, s, ckv.shape[1]):
+        # prefill (pos==0, queries cover the whole filled prefix): the
+        # fresh q/k/v ARE the prefix, so the square causal flash kernel
+        # applies — the score matrix never leaves VMEM (single-TPU only;
+        # a pallas_call is GSPMD-opaque, same gate as auto_attention)
+        a = flash_attention(q, k, v)
+    else:
+        a = causal_attention(q, ckv, cvv, q_offset=pos)
     x = x + a.reshape(b, s, -1) @ lp["wo"]
 
     y = rms_norm(x, lp["ln_mlp"], cfg.rms_eps)
