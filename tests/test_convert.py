@@ -150,6 +150,86 @@ class TestRopeScaling:
             hf_model.config.rope_scaling = None
 
 
+class TestMixtralImport:
+    def test_logits_match_transformers(self):
+        """The sparse (MoE) stack pinned against transformers' Mixtral:
+        same top-k-renormalized routing, same expert SwiGLU, exercised
+        end-to-end.  capacity_factor = E/k makes the capacity router
+        lossless, so the two implementations are numerically identical
+        (see moe_cfg_from_hf)."""
+        from tpu_network_operator.models import moe
+        from tpu_network_operator.models.convert import (
+            from_hf_mixtral,
+            moe_cfg_from_hf,
+        )
+
+        hf_cfg = transformers.MixtralConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, num_local_experts=4,
+            num_experts_per_tok=2, max_position_embeddings=128,
+            rope_theta=1e6, rms_norm_eps=1e-5, tie_word_embeddings=False,
+        )
+        torch.manual_seed(5)
+        model = transformers.MixtralForCausalLM(hf_cfg)
+        model.eval()
+        cfg = moe_cfg_from_hf(
+            hf_cfg, dtype=jnp.float32,
+            capacity_factor=float(
+                hf_cfg.num_local_experts // hf_cfg.num_experts_per_tok
+            ),
+        )
+        params = from_hf_mixtral(model.state_dict(), cfg)
+        toks = np.array([[7, 250, 3, 99, 41, 5, 180, 66]])
+        with torch.no_grad():
+            ref = model(torch.tensor(toks)).logits.numpy()
+        out, _aux = moe.forward(params, jnp.asarray(toks), cfg)
+        np.testing.assert_allclose(
+            ref, np.asarray(out), rtol=5e-4, atol=5e-4
+        )
+
+    def test_sliding_window_refused(self):
+        from tpu_network_operator.models.convert import moe_cfg_from_hf
+
+        hf_cfg = transformers.MixtralConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=1, num_attention_heads=2,
+            num_key_value_heads=1, num_local_experts=2,
+            num_experts_per_tok=1, sliding_window=4096,
+        )
+        with pytest.raises(ValueError, match="sliding_window"):
+            moe_cfg_from_hf(hf_cfg)
+
+    def test_router_aux_coef_carried(self):
+        from tpu_network_operator.models.convert import moe_cfg_from_hf
+
+        hf_cfg = transformers.MixtralConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=1, num_attention_heads=2,
+            num_key_value_heads=1, num_local_experts=2,
+            num_experts_per_tok=1, router_aux_loss_coef=0.001,
+        )
+        assert moe_cfg_from_hf(hf_cfg).router_aux_weight == 0.001
+
+    def test_missing_expert_tensor_is_clear(self):
+        from tpu_network_operator.models.convert import (
+            from_hf_mixtral,
+            moe_cfg_from_hf,
+        )
+
+        hf_cfg = transformers.MixtralConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=1, num_attention_heads=2,
+            num_key_value_heads=1, num_local_experts=2,
+            num_experts_per_tok=1, tie_word_embeddings=False,
+        )
+        model = transformers.MixtralForCausalLM(hf_cfg)
+        sd = dict(model.state_dict())
+        del sd["model.layers.0.block_sparse_moe.experts.1.w2.weight"]
+        with pytest.raises(KeyError, match="experts.1.w2"):
+            from_hf_mixtral(sd, moe_cfg_from_hf(hf_cfg, dtype=jnp.float32))
+
+
 class TestSafetensorsPath:
     def test_load_hf_checkpoint_streams_safetensors(self, hf_model, tmp_path,
                                                     imported):

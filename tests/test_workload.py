@@ -122,6 +122,40 @@ def test_convert_then_train_resumes_with_imported_cfg(capsys, tmp_path):
     assert r["final_loss"] < 8.0
 
 
+def test_convert_mixtral_then_train_as_moe(capsys, tmp_path):
+    """The MoE half of the migration path: a Mixtral checkpoint converts
+    and `train` routes itself to the MoE family from the sidecar (no
+    --model flag needed)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=128, rope_theta=1e6,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(9)
+    hf_dir = tmp_path / "hf"
+    transformers.MixtralForCausalLM(hf_cfg).save_pretrained(
+        hf_dir, safe_serialization=True
+    )
+    ckpt_dir = tmp_path / "ckpt"
+    r = run(capsys, [
+        "convert", "--hf-path", str(hf_dir),
+        "--checkpoint-dir", str(ckpt_dir),
+    ])
+    assert r["family"] == "moe"
+    r = run(capsys, [
+        "train", "--preset", "tiny", "--steps", "2", "--batch", "8",
+        "--seq-len", "32", "--checkpoint-dir", str(ckpt_dir),
+        "--checkpoint-every", "1",
+    ])
+    assert r["resumed_from"] == 0
+    assert r["final_loss"] < 8.0
+
+
 def test_generate(capsys):
     r = run(capsys, [
         "generate", "--batch", "4", "--prompt-len", "8",

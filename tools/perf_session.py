@@ -1,0 +1,95 @@
+#!/usr/bin/env python3
+"""One-command hardware measurement session for the round-4 perf levers.
+
+Run on a machine with a live TPU (plain env — the axon platform must
+resolve).  Each phase shells out to the bench/workload entry points so
+a mid-session tunnel drop loses one phase, not the session; results
+append as JSON lines to ``perf_session.jsonl`` (stdout shows progress).
+
+Phases:
+1. bench ladder (the driver's own headline path, all fitting rungs);
+2. fused-RMSNorm ablation: the continuity rung with TPUNET_RMS_FUSED=0/1;
+3. effective-length decode: workload generate at a long max_len with
+   --decode-block 256 vs 0 (the VERDICT r3 #7 'Done' measurement);
+4. flash-prefill ablation: long-prompt generate with
+   TPUNET_DECODE_FLASH=0/1.
+
+Usage: python tools/perf_session.py [--out perf_session.jsonl]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_phase(out, name: str, argv, env=None, timeout=3600):
+    print(f"== {name}: {' '.join(argv)}", flush=True)
+    e = dict(os.environ)
+    e.update(env or {})
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            argv, cwd=ROOT, env=e, capture_output=True, text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        # a hung phase (tunnel drop mid-run) must not abort the session
+        row = {"phase": name, "rc": -1, "error": f"timeout after {timeout}s",
+               "seconds": round(time.time() - t0, 1)}
+        out.write(json.dumps(row) + "\n")
+        out.flush()
+        print(f"   -> TIMEOUT ({timeout}s)", flush=True)
+        return row
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    row = {"phase": name, "rc": proc.returncode,
+           "seconds": round(time.time() - t0, 1)}
+    try:
+        row["result"] = json.loads(lines[-1])
+    except (IndexError, ValueError):
+        row["error"] = (proc.stderr or proc.stdout)[-400:]
+    out.write(json.dumps(row) + "\n")
+    out.flush()
+    print(f"   -> rc={proc.returncode} ({row['seconds']}s)", flush=True)
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="perf_session.jsonl")
+    ap.add_argument("--iters", default="10")
+    args = ap.parse_args()
+    py = sys.executable
+
+    with open(args.out, "a") as out:
+        run_phase(out, "bench-ladder", [py, "bench.py"],
+                  env={"BENCH_ITERS": args.iters})
+        for flag in ("1", "0"):
+            run_phase(
+                out, f"rms-fused-{flag}", [py, "bench.py"],
+                env={"BENCH_CONFIG": "llama3-150m",
+                     "BENCH_ITERS": args.iters,
+                     "TPUNET_RMS_FUSED": flag},
+            )
+        gen = [py, "-m", "tpu_network_operator.workload", "generate",
+               "--preset", "llama3-150m", "--batch", "8",
+               "--prompt-len", "64", "--max-new-tokens", "512"]
+        for blk in ("256", "0"):
+            run_phase(out, f"decode-block-{blk}",
+                      gen + ["--decode-block", blk])
+        long_gen = [py, "-m", "tpu_network_operator.workload", "generate",
+                    "--preset", "llama3-150m", "--batch", "8",
+                    "--prompt-len", "1024", "--max-new-tokens", "32"]
+        for flag in ("1", "0"):
+            run_phase(out, f"flash-prefill-{flag}", long_gen,
+                      env={"TPUNET_DECODE_FLASH": flag})
+    print(f"done -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,6 +75,26 @@ def _np(t) -> np.ndarray:
     return np.asarray(t, np.float32)
 
 
+def _take(sd: Mapping[str, Any], name: str) -> np.ndarray:
+    """One tensor from the (possibly lazy) state dict, by exact name."""
+    if name not in sd:
+        raise KeyError(f"HF checkpoint lacks {name!r}")
+    return _np(sd[name])
+
+
+def _stack_layers(
+    sd: Mapping[str, Any], fmt: str, layers: int, dtype,
+    transpose: bool = True,
+) -> jnp.ndarray:
+    """Per-layer tensors stacked along the scan axis; ``transpose``
+    flips torch Linear's [out, in] into this framework's [in, out]."""
+    def one(i):
+        w = _take(sd, fmt.format(i=i))
+        return w.T if transpose else w
+
+    return jnp.asarray(np.stack([one(i) for i in range(layers)]), dtype)
+
+
 def from_hf_llama(
     state_dict: Mapping[str, Any], cfg: LlamaConfig
 ) -> Params:
@@ -85,26 +105,17 @@ def from_hf_llama(
     time instead of materializing the checkpoint up front."""
     sd = state_dict
 
-    def take(name: str) -> np.ndarray:
-        if name not in sd:
-            raise KeyError(f"HF checkpoint lacks {name!r}")
-        return _np(sd[name])
-
-    def stacked(fmt: str, transpose: bool) -> jnp.ndarray:
-        per_layer = []
-        for i in range(cfg.layers):
-            w = take(fmt.format(i=i))
-            per_layer.append(w.T if transpose else w)
-        return jnp.asarray(np.stack(per_layer), cfg.dtype)
-
     prefix = "model."
     if f"{prefix}embed_tokens.weight" not in sd and "embed_tokens.weight" in sd:
         prefix = ""   # bare LlamaModel state dict
 
-    embed = take(f"{prefix}embed_tokens.weight")
-    head_name = "lm_head.weight"
-    if head_name in sd:
-        lm_head = take(head_name).T
+    def stacked(fmt: str, transpose: bool = True) -> jnp.ndarray:
+        return _stack_layers(sd, prefix + fmt, cfg.layers, cfg.dtype,
+                             transpose)
+
+    embed = _take(sd, f"{prefix}embed_tokens.weight")
+    if "lm_head.weight" in sd:
+        lm_head = _take(sd, "lm_head.weight").T
     else:
         # tied embeddings: the output head is the embedding matrix
         lm_head = embed.T
@@ -112,33 +123,24 @@ def from_hf_llama(
     return {
         "embed": jnp.asarray(embed, cfg.dtype),
         "layers": {
-            "wq": stacked(
-                prefix + "layers.{i}.self_attn.q_proj.weight", True
-            ),
-            "wk": stacked(
-                prefix + "layers.{i}.self_attn.k_proj.weight", True
-            ),
-            "wv": stacked(
-                prefix + "layers.{i}.self_attn.v_proj.weight", True
-            ),
-            "wo": stacked(
-                prefix + "layers.{i}.self_attn.o_proj.weight", True
-            ),
-            "w_gate": stacked(
-                prefix + "layers.{i}.mlp.gate_proj.weight", True
-            ),
-            "w_up": stacked(prefix + "layers.{i}.mlp.up_proj.weight", True),
-            "w_down": stacked(
-                prefix + "layers.{i}.mlp.down_proj.weight", True
-            ),
+            "wq": stacked("layers.{i}.self_attn.q_proj.weight"),
+            "wk": stacked("layers.{i}.self_attn.k_proj.weight"),
+            "wv": stacked("layers.{i}.self_attn.v_proj.weight"),
+            "wo": stacked("layers.{i}.self_attn.o_proj.weight"),
+            "w_gate": stacked("layers.{i}.mlp.gate_proj.weight"),
+            "w_up": stacked("layers.{i}.mlp.up_proj.weight"),
+            "w_down": stacked("layers.{i}.mlp.down_proj.weight"),
             "ln_attn": stacked(
-                prefix + "layers.{i}.input_layernorm.weight", False
+                "layers.{i}.input_layernorm.weight", transpose=False
             ),
             "ln_mlp": stacked(
-                prefix + "layers.{i}.post_attention_layernorm.weight", False
+                "layers.{i}.post_attention_layernorm.weight",
+                transpose=False,
             ),
         },
-        "ln_final": jnp.asarray(take(f"{prefix}norm.weight"), cfg.dtype),
+        "ln_final": jnp.asarray(
+            _take(sd, f"{prefix}norm.weight"), cfg.dtype
+        ),
         "lm_head": jnp.asarray(lm_head, cfg.dtype),
     }
 
@@ -170,7 +172,8 @@ class _SafetensorsDict(Mapping):
 
 
 def load_hf_checkpoint(path: str, dtype=jnp.bfloat16):
-    """(params, cfg) from a local HF Llama checkpoint directory.
+    """(params, cfg) from a local HF checkpoint directory — dense Llama
+    or Mixtral MoE, dispatched on the config's ``model_type``.
 
     Prefers streaming tensors straight out of the ``*.safetensors``
     shards; torch-format checkpoints fall back to instantiating the
@@ -181,45 +184,165 @@ def load_hf_checkpoint(path: str, dtype=jnp.bfloat16):
     from transformers import AutoConfig
 
     hf_cfg = AutoConfig.from_pretrained(path)
-    cfg = cfg_from_hf(hf_cfg, dtype=dtype)
+    model_type = getattr(hf_cfg, "model_type", "llama")
+    if model_type == "llama":
+        cfg = cfg_from_hf(hf_cfg, dtype=dtype)
+        importer = from_hf_llama
+    elif model_type == "mixtral":
+        cfg = moe_cfg_from_hf(hf_cfg, dtype=dtype)
+        importer = from_hf_mixtral
+    else:
+        raise ValueError(
+            f"unsupported HF model_type {model_type!r} — this importer "
+            "handles llama (dense) and mixtral (MoE) checkpoints"
+        )
     shards = sorted(glob.glob(os.path.join(path, "*.safetensors")))
     if shards:
-        return from_hf_llama(_SafetensorsDict(shards), cfg), cfg
+        return importer(_SafetensorsDict(shards), cfg), cfg
     from transformers import AutoModelForCausalLM
 
     model = AutoModelForCausalLM.from_pretrained(path)
-    return from_hf_llama(model.state_dict(), cfg), cfg
+    return importer(model.state_dict(), cfg), cfg
 
 
-def cfg_to_json(cfg: LlamaConfig) -> str:
-    """Serialize a LlamaConfig (checkpoint sidecar, see
-    ``workload convert``): dtype by name, rope scaling as a mapping."""
+def moe_cfg_from_hf(hf_config: Any, **overrides):
+    """MoEConfig from a ``transformers`` MixtralConfig(-like) object.
+
+    Capacity note: this framework routes with static per-group expert
+    capacity (GShard-style, ``MoEConfig.capacity_factor``); Mixtral's
+    reference implementation never drops tokens.  A ``capacity_factor``
+    of ``num_local_experts / num_experts_per_tok`` (or more) makes the
+    two numerically identical — the parity test pins that — while
+    smaller factors trade exactness for the static-shape dispatch."""
+    from .moe import MoEConfig
+
+    window = getattr(hf_config, "sliding_window", None)
+    if window is not None:
+        # this framework attends over the full causal prefix; silently
+        # importing a sliding-window checkpoint would diverge from the
+        # HF reference past the window (Mixtral-8x7B ships null here)
+        raise ValueError(
+            f"sliding_window={window} is not supported — full causal "
+            "attention only; clear the field to import anyway"
+        )
+    fields = dict(
+        vocab_size=hf_config.vocab_size,
+        hidden=hf_config.hidden_size,
+        layers=hf_config.num_hidden_layers,
+        heads=hf_config.num_attention_heads,
+        kv_heads=hf_config.num_key_value_heads,
+        ffn=hf_config.intermediate_size,
+        experts=hf_config.num_local_experts,
+        experts_per_token=hf_config.num_experts_per_tok,
+        router_aux_weight=float(
+            getattr(hf_config, "router_aux_loss_coef", 0.01)
+        ),
+        max_seq=hf_config.max_position_embeddings,
+        rope_theta=float(hf_config.rope_theta),
+        rms_eps=float(hf_config.rms_norm_eps),
+    )
+    fields.update(overrides)
+    return MoEConfig(**fields)
+
+
+def from_hf_mixtral(state_dict: Mapping[str, Any], cfg) -> Params:
+    """Build the MoE parameter tree from an HF Mixtral state dict.
+
+    Expert naming: HF ``w1``/``w3``/``w2`` are the SwiGLU gate/up/down
+    projections; experts stack along a second leading axis [L, E, ...]
+    and the router keeps f32."""
+    sd = state_dict
+
+    def stacked_experts(w: str) -> jnp.ndarray:
+        return jnp.asarray(np.stack([
+            np.stack([
+                _take(
+                    sd,
+                    f"model.layers.{i}.block_sparse_moe.experts.{e}."
+                    f"{w}.weight",
+                ).T
+                for e in range(cfg.experts)
+            ])
+            for i in range(cfg.layers)
+        ]), cfg.dtype)
+
+    embed = _take(sd, "model.embed_tokens.weight")
+    lm_head = (
+        _take(sd, "lm_head.weight").T
+        if "lm_head.weight" in sd else embed.T   # tied embeddings
+    )
+    attn = "model.layers.{i}.self_attn."
+    return {
+        "embed": jnp.asarray(embed, cfg.dtype),
+        "layers": {
+            "wq": _stack_layers(sd, attn + "q_proj.weight",
+                                cfg.layers, cfg.dtype),
+            "wk": _stack_layers(sd, attn + "k_proj.weight",
+                                cfg.layers, cfg.dtype),
+            "wv": _stack_layers(sd, attn + "v_proj.weight",
+                                cfg.layers, cfg.dtype),
+            "wo": _stack_layers(sd, attn + "o_proj.weight",
+                                cfg.layers, cfg.dtype),
+            "router": _stack_layers(
+                sd, "model.layers.{i}.block_sparse_moe.gate.weight",
+                cfg.layers, jnp.float32,
+            ),
+            "w_gate": stacked_experts("w1"),
+            "w_up": stacked_experts("w3"),
+            "w_down": stacked_experts("w2"),
+            "ln_attn": _stack_layers(
+                sd, "model.layers.{i}.input_layernorm.weight",
+                cfg.layers, cfg.dtype, transpose=False,
+            ),
+            "ln_mlp": _stack_layers(
+                sd, "model.layers.{i}.post_attention_layernorm.weight",
+                cfg.layers, cfg.dtype, transpose=False,
+            ),
+        },
+        "ln_final": jnp.asarray(_take(sd, "model.norm.weight"), cfg.dtype),
+        "lm_head": jnp.asarray(lm_head, cfg.dtype),
+    }
+
+
+def cfg_to_json(cfg) -> str:
+    """Serialize a LlamaConfig/MoEConfig (checkpoint sidecar, see
+    ``workload convert``): dtype by name, a ``family`` tag for the
+    loader, rope scaling as a mapping."""
     import dataclasses
     import json
 
     d = dataclasses.asdict(cfg)
+    d["family"] = "llama" if isinstance(cfg, LlamaConfig) else "moe"
     d["dtype"] = jnp.dtype(cfg.dtype).name
-    if cfg.rope_scaling:
+    if getattr(cfg, "rope_scaling", None):
         d["rope_scaling"] = dict(cfg.rope_scaling)
     return json.dumps(d, indent=2, sort_keys=True)
 
 
-def cfg_from_json(text: str) -> LlamaConfig:
+def cfg_from_json(text: str):
     import json
 
+    from .moe import MoEConfig
+
     d = json.loads(text)
+    family = d.pop("family", "llama")
     d["dtype"] = jnp.dtype(d["dtype"]).type
+    if family == "moe":
+        return MoEConfig(**d)
     d["rope_scaling"] = LlamaConfig.rope_scaling_from(
         d.get("rope_scaling")
     )
     return LlamaConfig(**d)
 
 
-def assign_shardings(params: Params, cfg: LlamaConfig, mesh) -> Params:
-    """Device-put an imported (host) tree onto a mesh with the training
-    layout (:func:`.llama.param_shardings`)."""
+def assign_shardings(params: Params, cfg, mesh) -> Params:
+    """Device-put an imported (host) tree onto a mesh with the family's
+    training layout."""
     import jax
 
-    from .llama import param_shardings
+    if isinstance(cfg, LlamaConfig):
+        from .llama import param_shardings
+    else:
+        from .moe import param_shardings
 
     return jax.device_put(params, param_shardings(cfg, mesh))

@@ -45,16 +45,20 @@ def _prefill_flash_ok(cfg, pos, s: int, attn_len: int) -> bool:
     the query block IS the whole filled prefix (static pos 0, s == view
     length), on a single TPU (GSPMD opacity — see auto_attention, whose
     platform view comes through the same ``_backend`` seam), for
-    kernel-supported shapes.  TPUNET_DECODE_FLASH=0/1 overrides the
-    backend gate for tests."""
+    kernel-supported shapes.  TPUNET_DECODE_FLASH=0/1 overrides only
+    the BACKEND check (interpret-mode tests); the single-device gate is
+    load-bearing regardless — a replicated pallas_call on a multi-chip
+    mesh is wrong whatever the flag says."""
     if not (isinstance(pos, int) and pos == 0 and s == attn_len):
         return False
     if not flash_supports(s, s, cfg.head_dim):
         return False
+    if jax.device_count() != 1:
+        return False
     flag = os.environ.get("TPUNET_DECODE_FLASH", "")
     if flag in ("0", "1"):
         return flag == "1"
-    return jax.device_count() == 1 and _backend() == "tpu"
+    return _backend() == "tpu"
 
 
 def init_cache(

@@ -222,8 +222,28 @@ def cmd_train(args) -> int:
 
         optimizer = adamw8bit()   # library defaults mirror adamw's
 
+    # imported checkpoints (workload convert) carry their true geometry
+    # — incl. family and rope scaling — which beats --model/--preset
+    sidecar_cfg = None
+    cfg_sidecar = (
+        os.path.join(args.checkpoint_dir, "cfg.json")
+        if args.checkpoint_dir else ""
+    )
+    if cfg_sidecar and os.path.exists(cfg_sidecar):
+        from .models.convert import cfg_from_json
+        from .models.llama import LlamaConfig
+
+        with open(cfg_sidecar) as f:
+            sidecar_cfg = cfg_from_json(f.read())
+        family = (
+            "llama" if isinstance(sidecar_cfg, LlamaConfig) else "moe"
+        )
+        log(f"config from {cfg_sidecar} ({family}; overrides "
+            "--model/--preset)")
+        args.model = family
+
     if args.model == "moe":
-        cfg = _pick_preset(_moe_presets(), args.preset, "moe")
+        cfg = sidecar_cfg or _pick_preset(_moe_presets(), args.preset, "moe")
         if args.pipe > 1:
             from .parallel import make_moe_pipeline_train_step
 
@@ -241,19 +261,9 @@ def cmd_train(args) -> int:
     else:
         from .models.llama import make_train_step
 
-        cfg = _pick_preset(_llama_presets(), args.preset, "llama")
-        cfg_sidecar = (
-            os.path.join(args.checkpoint_dir, "cfg.json")
-            if args.checkpoint_dir else ""
+        cfg = sidecar_cfg or _pick_preset(
+            _llama_presets(), args.preset, "llama"
         )
-        if cfg_sidecar and os.path.exists(cfg_sidecar):
-            # imported checkpoints (workload convert) carry their true
-            # geometry — incl. rope scaling — which beats the preset
-            from .models.convert import cfg_from_json
-
-            with open(cfg_sidecar) as f:
-                cfg = cfg_from_json(f.read())
-            log(f"config from {cfg_sidecar} (overrides --preset)")
         if args.pipe > 1:
             from .parallel import make_pipeline_train_step
 
@@ -362,7 +372,7 @@ def cmd_convert(args) -> int:
         cfg_to_json,
         load_hf_checkpoint,
     )
-    from .models.llama import make_train_step
+    from .models.llama import LlamaConfig
 
     bootstrap = _init_distributed(args.bootstrap)
     mesh = _build_mesh(args, bootstrap)
@@ -375,8 +385,12 @@ def cmd_convert(args) -> int:
         from .models.optim8bit import adamw8bit
 
         optimizer = adamw8bit()
-    # the train step's own optimizer defaulting keeps the saved state's
-    # structure identical to what cmd_train will restore into
+    # the family's train-step builder defaults the optimizer, keeping
+    # the saved state's structure identical to what cmd_train restores
+    if isinstance(cfg, LlamaConfig):
+        from .models.llama import make_train_step
+    else:
+        from .models.moe import make_train_step
     _, _, optimizer = make_train_step(cfg, mesh, optimizer=optimizer)
     opt_state = jax.jit(optimizer.init)(params)
 
@@ -391,7 +405,8 @@ def cmd_convert(args) -> int:
         "value": round(cfg.num_params() / 1e9, 3),
         "unit": "B params",
         "checkpoint_dir": args.checkpoint_dir,
-        "rope_scaling": bool(cfg.rope_scaling),
+        "family": "llama" if isinstance(cfg, LlamaConfig) else "moe",
+        "rope_scaling": bool(getattr(cfg, "rope_scaling", None)),
         "mesh": dict(mesh.shape),
     })
     return 0
