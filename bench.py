@@ -165,11 +165,10 @@ def measure(name, cfg, batch, seq, n, kind, make_train_step, mesh, jax, jnp,
     optax.adamw, "adam8" for the int8/f8-moment AdamW (optim8bit)."""
     import gc
 
-    optimizer = None
-    if opt == "adam8":
-        from tpu_network_operator.models.optim8bit import adamw8bit
-
-        optimizer = adamw8bit(3e-4, weight_decay=0.1)
+    # "adam8bit" resolves inside make_sharded_train_step to adamw8bit
+    # (3e-4, wd 0.1 — the library defaults) wired with the mesh + param
+    # specs, so the fused update stays fused on multi-chip meshes
+    optimizer = "adam8bit" if opt == "adam8" else None
     step, init_all, _ = make_train_step(cfg, mesh, optimizer=optimizer)
     params, opt_state = init_all(jax.random.key(0))
     # realistic token stream (constant tokens collapse the loss in a few

@@ -196,10 +196,127 @@ class TestFusedKernel:
                      s0.v["w"].q, s0.v["w"].scale)
         for passed, orig in zip(seen[0], originals):
             assert passed is not orig   # copied -> donation hits the copy
-        assert np.asarray(s0.m["w"].q).shape == (32, 256)  # still alive
+        # moments are stored parameter-shaped (blocks along the last
+        # axis); the old state stays alive after the aliased update
+        assert np.asarray(s0.m["w"].q).shape == (16, 512)
+        assert np.asarray(s0.m["w"].scale).shape == (16, 2)
 
         seen.clear()
         jax.jit(lambda g, s, p: opt.update(g, s, p))(grads, s0, params)
         assert len(seen) == 1
         for passed in seen[0]:   # traced -> no copy inserted
             assert isinstance(passed, jax.core.Tracer)
+
+
+class TestMeshFused:
+    """The per-shard fused path (shard_map over the leaf's own
+    PartitionSpec) must be bit-identical to the single-device fused path
+    and to the jnp path on the same mesh: per-shard last-axis chunks are
+    whole blocks, so per-shard quantization blocks ARE global blocks."""
+
+    def _mesh(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+        return Mesh(devs, ("data", "fsdp", "tensor")), P
+
+    def _leaves(self):
+        key = jax.random.key(3)
+        params = {
+            # fused-eligible under (fsdp, tensor): local [32, 2048] =
+            # 256 blocks (>= the 32-aligned tiling floor)
+            "w": jax.random.normal(key, (64, 4096), jnp.bfloat16),
+            # 3-D, sharded on two dims like the real wq/w_gate leaves
+            "wq": jax.random.normal(key, (2, 64, 512), jnp.bfloat16),
+            # gate-rejected (local last 64 not a BLOCK multiple) -> jnp
+            "ln": jnp.ones((4, 128), jnp.bfloat16),
+        }
+        grads = jax.tree.map(
+            lambda p: jax.random.normal(
+                jax.random.key(5), p.shape, p.dtype
+            ) * 0.01,
+            params,
+        )
+        return params, grads
+
+    def _run(self, monkeypatch, fused: str, steps=3):
+        from tpu_network_operator.models.optim8bit import adamw8bit
+
+        mesh, P = self._mesh()
+        specs = {
+            "w": P("fsdp", "tensor"),
+            "wq": P(None, "fsdp", "tensor"),
+            "ln": P(None, "tensor"),
+        }
+        monkeypatch.setenv("TPUNET_ADAM8_FUSED", fused)
+        opt = adamw8bit(3e-3, weight_decay=0.1,
+                        mesh=mesh, param_specs=specs)
+        params, grads = self._leaves()
+        state = opt.init(params)
+        upd = None
+        for _ in range(steps):
+            upd, state = opt.update(grads, state, params)
+        return upd, state
+
+    def test_mesh_fused_matches_jnp(self, monkeypatch):
+        uf, sf = self._run(monkeypatch, "1")
+        uj, sj = self._run(monkeypatch, "0")
+        for leaf in ("w", "wq", "ln"):
+            np.testing.assert_allclose(
+                np.asarray(uf[leaf], np.float32),
+                np.asarray(uj[leaf], np.float32),
+                rtol=1e-2, atol=1e-6, err_msg=leaf,
+            )
+        # int8 first moment: identical blocks -> identical quantization
+        np.testing.assert_array_equal(
+            np.asarray(sf.m["w"].q), np.asarray(sj.m["w"].q)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sf.m["wq"].q), np.asarray(sj.m["wq"].q)
+        )
+
+    def test_mesh_plan_gates(self):
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_network_operator.models.optim8bit import _mesh_leaf_plan
+
+        mesh, _ = self._mesh()
+        # eligible: local [32, 2048] -> 256 blocks of 256
+        assert _mesh_leaf_plan(mesh, P("fsdp", "tensor"),
+                               (64, 4096)) == (32, 2048)
+        # local last dim 64: not a whole number of 256-blocks
+        assert _mesh_leaf_plan(mesh, P(None, "tensor"), (4, 128)) is None
+        # uneven divide
+        assert _mesh_leaf_plan(mesh, P("fsdp", None), (3, 512)) is None
+        # too few local blocks for a 32-aligned row tiling
+        assert _mesh_leaf_plan(mesh, P("fsdp", "tensor"),
+                               (8, 1024)) is None
+        # replicated spec: every device runs the full update
+        assert _mesh_leaf_plan(mesh, None, (32, 256)) == (32, 256)
+
+    def test_state_sharding_matches_params(self, monkeypatch):
+        """Under jit with the real train-step wiring, the stored moments
+        must carry the parameter's own sharding (the zero-collective
+        property the parameter-shaped storage exists for)."""
+        from jax.sharding import NamedSharding
+
+        from tpu_network_operator.models.optim8bit import adamw8bit
+
+        mesh, P = self._mesh()
+        spec = P("fsdp", "tensor")
+        monkeypatch.setenv("TPUNET_ADAM8_FUSED", "1")
+        opt = adamw8bit(mesh=mesh, param_specs={"w": spec})
+        p = jax.device_put(
+            jnp.ones((8, 1024), jnp.bfloat16), NamedSharding(mesh, spec)
+        )
+        g = jax.device_put(
+            jnp.full((8, 1024), 0.01, jnp.bfloat16),
+            NamedSharding(mesh, spec),
+        )
+        state = jax.jit(opt.init)({"w": p})
+        upd_fn = jax.jit(lambda g, s, p: opt.update(g, s, p))
+        _, state = upd_fn({"w": g}, state, {"w": p})
+        q = state.m["w"].q
+        assert q.shape == (8, 1024)
+        got = q.sharding.spec
+        assert tuple(got) [: 2] == ("fsdp", "tensor"), got

@@ -253,7 +253,7 @@ def auto_attention(cfg: LlamaConfig, mesh: Optional[Mesh] = None) -> Callable:
     return attn
 
 
-def _layer(cfg: LlamaConfig, cos, sin, x, lp, attn_fn):
+def _layer(cfg: LlamaConfig, cos, sin, x, lp, attn_fn, norm_fn):
     """One transformer block.  x: [B, S, H]; lp: this layer's params.
 
     Intermediates are tagged with ``checkpoint_name`` so the selective
@@ -262,7 +262,7 @@ def _layer(cfg: LlamaConfig, cos, sin, x, lp, attn_fn):
     from jax.ad_checkpoint import checkpoint_name
 
     # attention
-    y = rms_norm(x, lp["ln_attn"], cfg.rms_eps)
+    y = norm_fn(x, lp["ln_attn"], cfg.rms_eps)
     b, s, _ = y.shape
     q = (y @ lp["wq"]).reshape(b, s, cfg.heads, cfg.head_dim)
     k = (y @ lp["wk"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
@@ -273,7 +273,7 @@ def _layer(cfg: LlamaConfig, cos, sin, x, lp, attn_fn):
     x = checkpoint_name(x + a.reshape(b, s, -1) @ lp["wo"], "resid_mid")
 
     # mlp (SwiGLU)
-    y = rms_norm(x, lp["ln_mlp"], cfg.rms_eps)
+    y = norm_fn(x, lp["ln_mlp"], cfg.rms_eps)
     gate = checkpoint_name(y @ lp["w_gate"], "ffn_gate")
     up = checkpoint_name(y @ lp["w_up"], "ffn_up")
     gated = jax.nn.silu(gate) * up
@@ -285,11 +285,13 @@ def forward_hidden(
     tokens: jnp.ndarray,              # [B, S] int32
     cfg: LlamaConfig,
     attn_fn: Optional[Callable] = None,
+    norm_fn: Optional[Callable] = None,
 ) -> jnp.ndarray:
     """Final-norm hidden states [B, S, hidden] — everything before the
     vocab projection.  Split out so the training loss can chunk the
     projection (``cfg.xent_chunk``) without touching the transformer."""
     attn_fn = attn_fn or auto_attention(cfg)
+    norm_fn = norm_fn or rms_norm
     x = params["embed"][tokens].astype(cfg.dtype)
     # activation layout (batch over data+fsdp, optional seq sharding) is
     # pinned by the jit in/out shardings; XLA propagates it through the scan
@@ -298,7 +300,7 @@ def forward_hidden(
                            scaling=cfg.rope_scaling_dict)
 
     def block(x, lp):
-        return _layer(cfg, cos, sin, x, lp, attn_fn)
+        return _layer(cfg, cos, sin, x, lp, attn_fn, norm_fn)
 
     if cfg.remat:
         # remat the layer body: recompute in backward, keep HBM flat
@@ -307,7 +309,7 @@ def forward_hidden(
         block = jax.checkpoint(block, policy=remat_policy(cfg))
 
     x, _ = jax.lax.scan(lambda x, lp: (block(x, lp), None), x, params["layers"])
-    return rms_norm(x, params["ln_final"], cfg.rms_eps)
+    return norm_fn(x, params["ln_final"], cfg.rms_eps)
 
 
 def forward(
@@ -315,12 +317,13 @@ def forward(
     tokens: jnp.ndarray,              # [B, S] int32
     cfg: LlamaConfig,
     attn_fn: Optional[Callable] = None,
+    norm_fn: Optional[Callable] = None,
 ) -> jnp.ndarray:
     """Logits [B, S, vocab].  ``attn_fn`` defaults to :func:`auto_attention`
     without mesh context (Pallas flash on single-device TPU, plain fused XLA
-    attention elsewhere); sharded callers get their attn_fn from
+    attention elsewhere); sharded callers get their attn_fn/norm_fn from
     ``make_train_step``, and the ring path passes its own (parallel/ring)."""
-    x = forward_hidden(params, tokens, cfg, attn_fn)
+    x = forward_hidden(params, tokens, cfg, attn_fn, norm_fn)
     return (x @ params["lm_head"]).astype(jnp.float32)
 
 
@@ -329,16 +332,17 @@ def loss_fn(
     tokens: jnp.ndarray,               # [B, S+1]
     cfg: LlamaConfig,
     attn_fn: Optional[Callable] = None,
+    norm_fn: Optional[Callable] = None,
 ) -> jnp.ndarray:
     """Next-token cross entropy over [B, S]."""
     from .training import chunked_next_token_xent, next_token_xent
 
     if cfg.xent_chunk > 0:
-        x = forward_hidden(params, tokens[:, :-1], cfg, attn_fn)
+        x = forward_hidden(params, tokens[:, :-1], cfg, attn_fn, norm_fn)
         return chunked_next_token_xent(
             x, params["lm_head"], tokens, cfg.xent_chunk
         )
-    logits = forward(params, tokens[:, :-1], cfg, attn_fn)
+    logits = forward(params, tokens[:, :-1], cfg, attn_fn, norm_fn)
     return next_token_xent(logits, tokens)
 
 
@@ -353,11 +357,13 @@ def make_train_step(
 ):
     """Jitted (params, opt_state, tokens) -> (params, opt_state, loss) with
     full sharding annotations over the mesh."""
+    from ..ops.norms import make_norm_fn
     from .training import make_sharded_train_step
 
     attn_fn = attn_fn or auto_attention(cfg, mesh)
+    norm_fn = make_norm_fn(mesh, _activation_spec(cfg))
     return make_sharded_train_step(
-        lambda params, tokens: loss_fn(params, tokens, cfg, attn_fn),
+        lambda params, tokens: loss_fn(params, tokens, cfg, attn_fn, norm_fn),
         partial(init_params, cfg=cfg),
         param_shardings(cfg, mesh),
         NamedSharding(mesh, P(("data", "fsdp"), None)),

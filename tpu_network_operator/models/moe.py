@@ -40,7 +40,6 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import causal_attention
-from ..ops.norms import rms_norm
 from ..ops.rope import apply_rope, rope_angles
 
 Params = Dict[str, Any]
@@ -263,10 +262,25 @@ def _moe_ffn(cfg: MoEConfig, lp: Params, y: jnp.ndarray,
     return y_out, aux
 
 
+def _norm_fn_for(mesh: Optional[Mesh]):
+    """Mesh-aware RMSNorm dispatch (ops.norms.make_norm_fn) over the MoE
+    activation layout: batch over (data, fsdp), seq over ``seq`` when the
+    mesh has a non-trivial seq axis (the Ulysses attention path keeps
+    activations sequence-sharded between its all-to-alls)."""
+    from ..ops.norms import make_norm_fn
+
+    if mesh is None:
+        return make_norm_fn(None, None)
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    seq = "seq" if sizes.get("seq", 1) > 1 else None
+    return make_norm_fn(mesh, P(("data", "fsdp"), seq, None))
+
+
 def _layer(cfg: MoEConfig, cos, sin, x, lp, attn_fn,
-           mesh: Optional[Mesh] = None):
+           mesh: Optional[Mesh] = None, norm_fn=None):
     """One MoE transformer block.  x: [B,S,H] → (x', aux)."""
-    y = rms_norm(x, lp["ln_attn"], cfg.rms_eps)
+    norm_fn = norm_fn or _norm_fn_for(mesh)
+    y = norm_fn(x, lp["ln_attn"], cfg.rms_eps)
     b, s, _ = y.shape
     q = (y @ lp["wq"]).reshape(b, s, cfg.heads, cfg.head_dim)
     k = (y @ lp["wk"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
@@ -276,7 +290,7 @@ def _layer(cfg: MoEConfig, cos, sin, x, lp, attn_fn,
     a = attn_fn(q, k, v)
     x = x + a.reshape(b, s, -1) @ lp["wo"]
 
-    y = rms_norm(x, lp["ln_mlp"], cfg.rms_eps)
+    y = norm_fn(x, lp["ln_mlp"], cfg.rms_eps)
     ff, aux = _moe_ffn(cfg, lp, y, mesh)
     return x + ff, aux
 
@@ -294,11 +308,12 @@ def forward_hidden(
     """(final hidden [B,S,H], mean router aux loss) — pre vocab
     projection, so the training loss can chunk it (cfg.xent_chunk)."""
     attn_fn = attn_fn or causal_attention
+    norm_fn = _norm_fn_for(mesh)
     x = params["embed"][tokens].astype(cfg.dtype)
     cos, sin = rope_angles(tokens.shape[1], cfg.head_dim, cfg.rope_theta)
 
     def block(x, lp):
-        return _layer(cfg, cos, sin, x, lp, attn_fn, mesh)
+        return _layer(cfg, cos, sin, x, lp, attn_fn, mesh, norm_fn)
 
     if cfg.remat:
         from .training import remat_policy
@@ -308,7 +323,7 @@ def forward_hidden(
     x, auxes = jax.lax.scan(
         lambda x, lp: block(x, lp), x, params["layers"]
     )
-    return rms_norm(x, params["ln_final"], cfg.rms_eps), jnp.mean(auxes)
+    return norm_fn(x, params["ln_final"], cfg.rms_eps), jnp.mean(auxes)
 
 
 def forward(

@@ -9,9 +9,16 @@ docs/perf.md identifies for >50% MFU.
 
 Design (TPU-first):
 
-* moments are stored 1 byte/value plus ``f32[blocks, 1]`` per-256-block
-  scales — flat, padded, statically shaped, so XLA fuses the
-  dequant → adam math → requant chain into the update elementwise pass;
+* quantization blocks run along the LAST axis of each parameter tensor
+  (256 values per block when the last dim divides 256; one whole-row
+  block otherwise).  The quantized moments are stored PARAMETER-SHAPED:
+  ``q`` has exactly the parameter's shape and the scales have the
+  parameter's leading shape plus a trailing block index.  This makes the
+  at-rest state shardable with the parameter's own ``PartitionSpec`` —
+  the moment for a ``P(None, "fsdp", "tensor")`` weight is sharded
+  ``P(None, "fsdp", "tensor")`` too, so the whole optimizer update is
+  shard-local with ZERO collectives (a ZeRO-style sharded optimizer for
+  free), and orbax checkpoints stay portable across mesh shapes;
 * the first moment uses linear symmetric ``int8`` (m is well-centered);
   the second moment uses ``float8_e4m3fn`` — v spans orders of magnitude
   within a block (it is a squared gradient), and linear int8 flushes the
@@ -21,14 +28,20 @@ Design (TPU-first):
   (normally |m̂/√v̂| ≲ 1; the clip only engages when v̂ underflowed);
 * the optimizer math itself runs in f32 exactly like ``optax.adamw``:
   only the at-rest representation is compressed;
-* on a single device the whole update runs as ONE Pallas pass per leaf
+* the whole update runs as ONE Pallas pass per leaf
   (:func:`_fused_leaf_update`): dequant → adam math → requant → update,
-  with the moment buffers aliased in place.  The composable jnp path
-  builds the same chain from ~10 separate whole-array ops, and measured
-  ~165 ms/step slower at 1.5B params on v5e (docs/perf.md).  Multi-device
-  meshes keep the jnp path: a ``pallas_call`` is opaque to the GSPMD
-  partitioner, and the per-256-value quantization blocks run along the
-  *flat* parameter index, which does not line up with shard boundaries.
+  with the moment buffers aliased in place.  On a single device the
+  kernel is called directly; on a multi-device mesh each leaf runs the
+  SAME kernel per-shard under ``jax.shard_map`` with the parameter's own
+  spec (:func:`_mesh_fused_leaf`) — a ``pallas_call`` is opaque to the
+  GSPMD partitioner, so the shard_map wrapper is what lets the fused
+  path keep running at mesh scale.  Because the per-shard chunk of the
+  last axis is a whole number of blocks (:func:`_mesh_leaf_plan` gates
+  this), per-shard blocks ARE the global blocks: the mesh path is
+  bit-identical to the single-device path.  The composable jnp path
+  (the same chain from ~10 separate whole-array ops, measured ~165
+  ms/step slower at 1.5B params on v5e, docs/perf.md) remains the
+  fallback for non-TPU backends and gate-rejected leaves.
 
 ref: the reference repo has no optimizer (not an ML framework); this
 belongs to the validation-workload stack (SURVEY.md §7 stage 6).
@@ -39,12 +52,13 @@ from __future__ import annotations
 import functools
 import math
 import os
-from typing import Any, NamedTuple
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.pallas_utils import interpret as _pl_interpret
 from ..ops.pallas_utils import tile_rows
@@ -55,51 +69,84 @@ _F8_MAX = 448.0   # float8_e4m3fn max finite
 
 
 class _QTensor(NamedTuple):
-    """Block-quantized tensor: 1-byte values, per-block scales f32."""
+    """Block-quantized tensor, parameter-shaped (see module docstring).
 
-    q: jnp.ndarray        # int8 | float8_e4m3fn, [nblocks, BLOCK]
-    scale: jnp.ndarray    # f32  [nblocks, 1]
+    ``q``     — int8 | float8_e4m3fn, exactly the source tensor's shape;
+    ``scale`` — f32, ``q.shape[:-1] + (q.shape[-1] // block,)`` where
+                ``block`` is BLOCK when the last dim divides it, else the
+                whole last dim (one block per row, no padding ever).
+    """
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+
+def _leaf_block(last: int, block: int) -> int:
+    """Per-leaf block length along the last axis: ``block`` when it
+    divides evenly, else the whole row (coarser scale, zero padding)."""
+    if last and block and last % block == 0:
+        return block
+    return max(last, 1)
 
 
 def _blocked(x: jnp.ndarray, block: int) -> jnp.ndarray:
-    flat = x.astype(jnp.float32).ravel()
-    pad = (-flat.size) % block
-    return jnp.pad(flat, (0, pad)).reshape(-1, block)
+    """[..., last] → [..., nb, b] f32 view with blocks along the last
+    axis (``b = _leaf_block(last, block)``)."""
+    if x.ndim == 0:
+        x = x.reshape(1)
+    last = x.shape[-1]
+    b = _leaf_block(last, block)
+    return x.astype(jnp.float32).reshape(*x.shape[:-1], last // b, b)
 
 
 def _row_quant_i8(rows: jnp.ndarray):
-    """Per-row symmetric int8 requant of [nblocks, block] f32 rows.
-    Shared by :func:`quantize` and the fused kernel so the scale formula
-    (incl. the zero-block guard) can never drift between the two paths."""
-    scale = jnp.max(jnp.abs(rows), axis=1, keepdims=True) / 127.0
+    """Symmetric int8 requant along the last axis.  Shared by
+    :func:`quantize` and the fused kernel so the scale formula (incl. the
+    zero-block guard) can never drift between the two paths."""
+    scale = jnp.max(jnp.abs(rows), axis=-1, keepdims=True) / 127.0
     scale = jnp.where(scale == 0.0, 1.0, scale)
     q = jnp.clip(jnp.round(rows / scale), -127, 127).astype(jnp.int8)
     return q, scale
 
 
 def _row_quant_f8(rows: jnp.ndarray):
-    """Per-row float8-e4m3 requant (second moment); see _row_quant_i8."""
-    scale = jnp.max(jnp.abs(rows), axis=1, keepdims=True) / _F8_MAX
+    """float8-e4m3 requant along the last axis (second moment)."""
+    scale = jnp.max(jnp.abs(rows), axis=-1, keepdims=True) / _F8_MAX
     scale = jnp.where(scale == 0.0, 1.0, scale)
     return (rows / scale).astype(jnp.float8_e4m3fn), scale
 
 
+def _pack(x: jnp.ndarray, q3: jnp.ndarray, s3: jnp.ndarray) -> _QTensor:
+    """[..., nb, b] quantized view + keepdims scales → stored form."""
+    shape = x.shape if x.ndim else (1,)
+    qt = _QTensor(q=q3.reshape(shape), scale=s3.reshape(*shape[:-1], -1))
+    if x.ndim == 0:
+        qt = _QTensor(q=qt.q.reshape(()), scale=qt.scale)
+    return qt
+
+
 def quantize(x: jnp.ndarray, block: int = BLOCK) -> _QTensor:
     """Linear symmetric int8 (for the centered first moment)."""
-    q, scale = _row_quant_i8(_blocked(x, block))
-    return _QTensor(q=q, scale=scale)
+    rows = _blocked(x, block)
+    q, scale = _row_quant_i8(rows)
+    return _pack(x, q, scale)
 
 
 def quantize_f8(x: jnp.ndarray, block: int = BLOCK) -> _QTensor:
     """float8 e4m3 with per-block scale (for the wide-range second
     moment): in-block dynamic range ~1e5 instead of int8's 127."""
-    q, scale = _row_quant_f8(_blocked(x, block))
-    return _QTensor(q=q, scale=scale)
+    rows = _blocked(x, block)
+    q, scale = _row_quant_f8(rows)
+    return _pack(x, q, scale)
 
 
 def dequantize(qt: _QTensor, shape) -> jnp.ndarray:
-    flat = (qt.q.astype(jnp.float32) * qt.scale).ravel()
-    return flat[: math.prod(shape)].reshape(shape)
+    q = qt.q.reshape(1) if qt.q.ndim == 0 else qt.q
+    nb = qt.scale.shape[-1]
+    b = q.shape[-1] // nb
+    rows = q.astype(jnp.float32).reshape(*q.shape[:-1], nb, b)
+    out = (rows * qt.scale.reshape(*q.shape[:-1], nb, 1)).reshape(q.shape)
+    return out.reshape(shape)
 
 
 class Adam8State(NamedTuple):
@@ -151,7 +198,9 @@ def _fused_leaf_update(p2, g2, mq, ms, vq, vs, cc,
                        *, lr, b1, b2, eps, wd):
     """p2/g2: [nblocks, BLOCK] views of one leaf.  Returns
     (upd2, _QTensor(m), _QTensor(v)) with the moment buffers aliased
-    in place (one HBM pass total)."""
+    in place (one HBM pass total).  The returned _QTensors keep the
+    blocked [nblocks, BLOCK] / [nblocks, 1] view — callers reshape to
+    the stored parameter-shaped form."""
     nb, block = g2.shape
     rows = _tile_rows(nb)
     data = lambda i: (i, 0)   # noqa: E731 — BlockSpec index map
@@ -183,15 +232,81 @@ def _fused_leaf_update(p2, g2, mq, ms, vq, vs, cc,
     return upd2, _QTensor(q=nmq, scale=nms), _QTensor(q=nvq, scale=nvs)
 
 
-def _use_fused() -> bool:
-    """Fused path iff the program runs on exactly one TPU (see module
-    docstring — multi-device keeps the jnp path; non-TPU backends would
-    only reach the kernel's slow interpret mode, so they keep XLA's
-    fused jnp ops too); TPUNET_ADAM8_FUSED=0/1 overrides for tests."""
+def _single_leaf_ok(shape) -> bool:
+    """Gate for the direct (non-shard_map) kernel call on one leaf."""
+    if not shape:
+        return False
+    n = math.prod(shape)
+    return (
+        n > 0
+        and shape[-1] % BLOCK == 0
+        and _tile_rows(n // BLOCK) > 0
+    )
+
+
+# -- mesh (multi-device) fused path -------------------------------------------
+
+
+def _mesh_leaf_plan(mesh: Mesh, spec, shape) -> Optional[tuple]:
+    """Per-shard (local) shape of a leaf under its PartitionSpec, or
+    None when the fused per-shard kernel cannot run: a sharded dim that
+    does not divide evenly (``pallas_utils.local_shape`` — the walk
+    shared with the fused RMSNorm gate), a local last-axis chunk that is
+    not a whole number of BLOCK-sized quantization blocks (per-shard
+    blocks must BE global blocks for the mesh path to stay bit-identical
+    to the single-device path), or no 32-aligned row tiling."""
+    from ..ops.pallas_utils import local_shape
+
+    if not shape:
+        return None
+    local = local_shape(mesh, spec, shape)
+    if local is None or not _single_leaf_ok(local):
+        return None
+    return local
+
+
+def _mesh_fused_leaf(mesh: Mesh, spec, p, g, mq, ms, vq, vs, cc,
+                     *, lr, b1, b2, eps, wd):
+    """One leaf's fused update under ``shard_map`` with the leaf's own
+    spec: every device runs :func:`_fused_leaf_update` on its local
+    shard.  The scale arrays reuse the parameter spec verbatim — their
+    dims map 1:1 onto the parameter's (the trailing block index shards
+    exactly as the last parameter dim does).  check_vma=False:
+    replication checking cannot see through a pallas custom call."""
+    pspec = spec if spec is not None else P()
+
+    def body(cc_, p_, g_, mq_, ms_, vq_, vs_):
+        shp = p_.shape
+        upd2, nm, nv = _fused_leaf_update(
+            p_.reshape(-1, BLOCK), g_.reshape(-1, BLOCK),
+            mq_.reshape(-1, BLOCK), ms_.reshape(-1, 1),
+            vq_.reshape(-1, BLOCK), vs_.reshape(-1, 1), cc_,
+            lr=lr, b1=b1, b2=b2, eps=eps, wd=wd,
+        )
+        return (
+            upd2.reshape(shp),
+            nm.q.reshape(shp), nm.scale.reshape(ms_.shape),
+            nv.q.reshape(shp), nv.scale.reshape(vs_.shape),
+        )
+
+    upd, nmq, nms, nvq, nvs = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), pspec, pspec, pspec, pspec, pspec, pspec),
+        out_specs=(pspec, pspec, pspec, pspec, pspec),
+        check_vma=False,
+    )(cc, p, g, mq, ms, vq, vs)
+    return upd, _QTensor(q=nmq, scale=nms), _QTensor(q=nvq, scale=nvs)
+
+
+def _fused_mode() -> str:
+    """"on" / "off" / "auto" from TPUNET_ADAM8_FUSED; tests force the
+    kernel through interpret mode on CPU with "1"."""
     flag = os.environ.get("TPUNET_ADAM8_FUSED", "")
-    if flag in ("0", "1"):
-        return flag == "1"
-    return jax.device_count() == 1 and jax.default_backend() == "tpu"
+    if flag == "0":
+        return "off"
+    if flag == "1":
+        return "on"
+    return "auto"
 
 
 def adamw8bit(
@@ -201,12 +316,20 @@ def adamw8bit(
     eps: float = 1e-8,
     weight_decay: float = 0.1,
     block: int = BLOCK,
+    mesh: Optional[Mesh] = None,
+    param_specs: Any = None,
 ):
     """Drop-in for ``optax.adamw`` with int8 moment storage.  Returns an
     optax ``GradientTransformation``-shaped (init, update) pair.
 
-    Under jit (as ``make_sharded_train_step`` runs it) the fused
-    single-TPU path donates the previous state's moment buffers in place
+    ``mesh``/``param_specs`` (a pytree of PartitionSpec matching the
+    params) enable the per-shard fused path on a multi-device mesh —
+    ``training.make_sharded_train_step`` fills both automatically when
+    built with ``optimizer="adam8bit"``.  Without them a multi-device
+    program keeps the (fully partitionable) jnp path.
+
+    Under jit (as ``make_sharded_train_step`` runs it) the fused path
+    donates the previous state's moment buffers in place
     (``input_output_aliases``).  An *eager* call would silently
     invalidate the old ``Adam8State``'s arrays through the same aliasing,
     so eager updates copy the moment buffers first — slightly slower,
@@ -231,7 +354,19 @@ def adamw8bit(
         c1 = 1.0 - b1 ** count.astype(jnp.float32)
         c2 = 1.0 - b2 ** count.astype(jnp.float32)
         cc = jnp.stack([c1, c2])
-        fused = _use_fused()
+        mode = _fused_mode()
+        meshed = mesh is not None and mesh.size > 1
+        if mode == "off" or block != BLOCK:
+            fused_single = fused_mesh = False
+        elif mode == "on":
+            fused_single, fused_mesh = (not meshed), meshed
+        else:
+            on_tpu = jax.default_backend() == "tpu"
+            # without a mesh, the direct kernel call is only safe when
+            # the program really owns a single device (a pallas_call is
+            # GSPMD-opaque: under a sharded jit it would be replicated)
+            fused_single = on_tpu and not meshed and jax.device_count() == 1
+            fused_mesh = on_tpu and meshed
         # eager (non-traced) fused calls must not invalidate the caller's
         # old state through the in-place aliasing — copy the moments first
         tracing = isinstance(count, jax.core.Tracer)
@@ -240,24 +375,55 @@ def adamw8bit(
         flat_p = treedef.flatten_up_to(params)
         flat_m = treedef.flatten_up_to(state.m)
         flat_v = treedef.flatten_up_to(state.v)
+        flat_s = (
+            treedef.flatten_up_to(param_specs)
+            if param_specs is not None else [None] * len(flat_g)
+        )
 
+        kw = dict(lr=learning_rate, b1=b1, b2=b2, eps=eps,
+                  wd=weight_decay)
         new_m, new_v, updates = [], [], []
-        for g, p, mq, vq in zip(flat_g, flat_p, flat_m, flat_v):
-            if (fused and block == BLOCK and g.size
-                    and g.size % BLOCK == 0
-                    and _tile_rows(g.size // BLOCK) > 0):
+        for g, p, mq, vq, spec in zip(
+            flat_g, flat_p, flat_m, flat_v, flat_s
+        ):
+            plan = (
+                _mesh_leaf_plan(mesh, spec, g.shape) if fused_mesh
+                else None
+            )
+            if plan is not None or (
+                fused_single and _single_leaf_ok(g.shape)
+            ):
                 moments = (mq.q, mq.scale, vq.q, vq.scale)
                 if not tracing:
                     moments = tuple(jnp.array(x) for x in moments)
-                # single HBM pass; reshape to the blocked view is a
-                # bitcast (flat row-major), not a copy
-                upd2, nmq, nvq = _fused_leaf_update(
-                    p.reshape(-1, BLOCK), g.reshape(-1, BLOCK),
-                    *moments, cc,
-                    lr=learning_rate, b1=b1, b2=b2, eps=eps,
-                    wd=weight_decay,
-                )
-                updates.append(upd2.reshape(p.shape).astype(p.dtype))
+                if plan is not None:
+                    upd, nmq, nvq = _mesh_fused_leaf(
+                        mesh, spec, p, g, *moments, cc, **kw
+                    )
+                    updates.append(upd.astype(p.dtype))
+                else:
+                    # single HBM pass; reshape to the blocked view is a
+                    # bitcast (flat row-major), not a copy — with the
+                    # last dim a BLOCK multiple, flat 256-groups ARE the
+                    # last-axis quantization blocks
+                    mqv, msv, vqv, vsv = moments
+                    upd2, nmq, nvq = _fused_leaf_update(
+                        p.reshape(-1, BLOCK), g.reshape(-1, BLOCK),
+                        mqv.reshape(-1, BLOCK), msv.reshape(-1, 1),
+                        vqv.reshape(-1, BLOCK), vsv.reshape(-1, 1),
+                        cc, **kw,
+                    )
+                    updates.append(
+                        upd2.reshape(p.shape).astype(p.dtype)
+                    )
+                    nmq = _QTensor(
+                        q=nmq.q.reshape(p.shape),
+                        scale=nmq.scale.reshape(mq.scale.shape),
+                    )
+                    nvq = _QTensor(
+                        q=nvq.q.reshape(p.shape),
+                        scale=nvq.scale.reshape(vq.scale.shape),
+                    )
                 new_m.append(nmq)
                 new_v.append(nvq)
                 continue

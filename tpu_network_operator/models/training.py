@@ -114,9 +114,23 @@ def make_sharded_train_step(
     ``grads_fn``: (params, tokens) -> (loss, grads) computed WITHOUT
     autodiff through this builder — the hand-scheduled 1F1B pipeline
     produces its gradients inside its own kernel (``loss_fn`` is then
-    unused and may be None)."""
+    unused and may be None).
+
+    ``optimizer="adam8bit"`` resolves to :func:`..models.optim8bit.adamw8bit`
+    wired with this step's mesh and per-leaf PartitionSpecs (extracted
+    from ``p_shard``), which is what lets its fused per-shard update run
+    on multi-device meshes — callers that build ``adamw8bit()`` by hand
+    get the (partitionable) jnp path there instead."""
     import optax
 
+    if optimizer == "adam8bit":
+        from .optim8bit import adamw8bit
+
+        shard_leaves = jax.tree.leaves(p_shard)
+        optimizer = adamw8bit(
+            mesh=shard_leaves[0].mesh,
+            param_specs=jax.tree.map(lambda s: s.spec, p_shard),
+        )
     optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.1)
 
     def step(params, opt_state, tokens):

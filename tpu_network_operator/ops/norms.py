@@ -3,14 +3,10 @@
 :func:`rms_norm` is the single entry point every model file uses.  It
 dispatches between two implementations of identical f32 math:
 
-* the plain jnp path — the reference semantics, used on CPU, on
-  multi-device meshes (a ``pallas_call`` is opaque to the GSPMD
-  partitioner: under jit with sharded activations it would be
-  replicated onto every device, same constraint as
-  :mod:`.pallas_attention` and :mod:`..models.optim8bit`), and for
+* the plain jnp path — the reference semantics, used on CPU and for
   shapes the kernel's tiling gate rejects;
 * a fused single-pass Pallas TPU kernel with a custom VJP
-  (:func:`pallas_rms_norm`) on a single TPU.  XLA lowers the jnp path
+  (:func:`pallas_rms_norm`) on TPU.  XLA lowers the jnp path
   to a reduce kernel plus a consumer kernel — the activation is read
   twice forward and the backward chain re-reads it again across
   several fusions.  The Pallas forward reads x once and writes y plus
@@ -18,6 +14,16 @@ dispatches between two implementations of identical f32 math:
   backward reads x/dy once and emits dx plus per-tile dscale partials
   in one pass.  docs/perf.md identifies this elementwise traffic on
   the residual stream as part of the 1B preset's 59% forward ceiling.
+
+On a single device :func:`rms_norm` dispatches by itself.  On a
+multi-device mesh a ``pallas_call`` is opaque to the GSPMD partitioner
+(under jit with sharded activations it would be replicated onto every
+device, same constraint as :mod:`.pallas_attention` and
+:mod:`..models.optim8bit`), so the models thread a norm callable built
+by :func:`make_norm_fn`, which wraps the same kernel per-shard in
+``jax.shard_map`` over the activation layout — RMSNorm reduces only the
+(unsharded) hidden axis, so every batch/seq shard is independent and
+the mesh path is bit-identical to the single-device kernel.
 
 ``TPUNET_RMS_FUSED=0/1`` overrides the dispatch (tests force the kernel
 through interpret mode on CPU the same way the flash-attention suite
@@ -42,7 +48,7 @@ from .pallas_utils import interpret as _interpret
 from .pallas_utils import tile_rows
 
 LANES = 128      # TPU lane width: last block dim must be a multiple
-_ROW_CAP = 256   # rows per VMEM tile (256 x 4096 bf16 = 2 MiB)
+_ROW_CAP = 256   # rows per VMEM tile at hidden=4096 (256 x 4096 bf16 = 2 MiB)
 
 
 def _rms_norm_jnp(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
@@ -56,10 +62,19 @@ def _rms_norm_jnp(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray
 # -- fused Pallas path --------------------------------------------------------
 
 
-def _tile_rows(n: int) -> int:
+def _row_cap(hidden: int) -> int:
+    """Row cap scaled by ELEMENT count, not a fixed row count: the VMEM
+    budget per tile is rows*hidden elements (bf16 in + bf16 out + f32
+    intermediates ≈ 8 bytes/element live), so wider rows get fewer of
+    them — hidden 4096 keeps the measured 256-row tile (2 MiB bf16 in),
+    hidden 8192 halves it to 128 rather than doubling the footprint."""
+    return max(16, min(_ROW_CAP, (_ROW_CAP * 4096) // hidden))
+
+
+def _tile_rows(n: int, hidden: int) -> int:
     """16-aligned (bf16 sublane height; f32's 8 divides it) exact-divisor
     tiling, 0 when none exists — caller falls back to the jnp path."""
-    return tile_rows(n, _ROW_CAP, 16)
+    return tile_rows(n, _row_cap(hidden), 16)
 
 
 def supports(n_rows: int, hidden: int) -> bool:
@@ -68,7 +83,7 @@ def supports(n_rows: int, hidden: int) -> bool:
     return (
         hidden % LANES == 0
         and hidden <= 8192
-        and _tile_rows(n_rows) > 0
+        and _tile_rows(n_rows, hidden) > 0
     )
 
 
@@ -116,7 +131,7 @@ def _rms_flat(x2, s2, eps):
 
 def _rms_flat_fwd(x2, s2, eps):
     n, h = x2.shape
-    rows = _tile_rows(n)
+    rows = _tile_rows(n, h)
     wide, scale, stat = _row_specs(rows, h)
     y2, rstd = pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
@@ -135,7 +150,7 @@ def _rms_flat_fwd(x2, s2, eps):
 def _rms_flat_bwd(eps, res, dy2):
     x2, s2, rstd = res
     n, h = x2.shape
-    rows = _tile_rows(n)
+    rows = _tile_rows(n, h)
     nb = n // rows
     wide, scale, stat = _row_specs(rows, h)
     ds_part = pl.BlockSpec((1, h), lambda i: (i, 0),
@@ -167,16 +182,27 @@ def pallas_rms_norm(
     return y2.reshape(x.shape)
 
 
+def _fused_flag() -> str:
+    """"on"/"off"/"auto" from TPUNET_RMS_FUSED (tests force interpret
+    mode on CPU with "1"; never overrides the shape gate)."""
+    flag = os.environ.get("TPUNET_RMS_FUSED", "")
+    if flag == "0":
+        return "off"
+    if flag == "1":
+        return "on"
+    return "auto"
+
+
 def _use_fused(n_rows: int, hidden: int) -> bool:
-    """Fused path iff single TPU (multi-device keeps the jnp path —
-    see module docstring; non-TPU backends would only reach interpret
-    mode) and the shape gate passes; TPUNET_RMS_FUSED=0/1 overrides the
-    backend condition for tests — never the shape gate."""
+    """Fused path iff single TPU (a bare ``rms_norm`` call on a
+    multi-device program keeps the jnp path — the mesh-aware dispatch is
+    :func:`make_norm_fn`; non-TPU backends would only reach interpret
+    mode) and the shape gate passes."""
     if not supports(n_rows, hidden):
         return False
-    flag = os.environ.get("TPUNET_RMS_FUSED", "")
-    if flag in ("0", "1"):
-        return flag == "1"
+    flag = _fused_flag()
+    if flag != "auto":
+        return flag == "on"
     return jax.device_count() == 1 and jax.default_backend() == "tpu"
 
 
@@ -189,3 +215,67 @@ def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarr
     if n_rows and _use_fused(n_rows, h):
         return pallas_rms_norm(x, scale, eps)
     return _rms_norm_jnp(x, scale, eps)
+
+
+# -- mesh (multi-device) path -------------------------------------------------
+
+
+def _local_rows(shape, mesh, spec) -> int:
+    """Per-shard row count of an activation under its PartitionSpec, or
+    0 when the per-shard kernel cannot run: the hidden (last) axis
+    sharded, or a sharded dim that does not divide evenly."""
+    from .pallas_utils import local_shape
+
+    entries = tuple(spec) if spec is not None else ()
+    if len(entries) == len(shape) and entries and entries[-1] is not None:
+        return 0   # hidden (reduction) axis sharded
+    local = local_shape(mesh, spec, shape)
+    if local is None:
+        return 0
+    rows = 1
+    for dim in local[:-1]:
+        rows *= dim
+    return rows
+
+
+def sharded_rms_norm(mesh, spec, eps: float):
+    """The fused kernel per-shard under ``shard_map`` — each device
+    normalizes its own batch/seq rows (the reduction axis is the
+    unsharded hidden dim, so shards are independent and the result is
+    bit-identical to the single-device kernel).  check_vma=False:
+    replication checking cannot see through a pallas custom call."""
+    from jax.sharding import PartitionSpec as P
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, P(None)),
+        out_specs=spec, check_vma=False,
+    )
+    def norm(x, scale):
+        return pallas_rms_norm(x, scale, eps)
+
+    return norm
+
+
+def make_norm_fn(mesh=None, spec=None):
+    """``norm(x, scale, eps)`` for model code: the plain :func:`rms_norm`
+    dispatch off-mesh, the per-shard fused kernel on a multi-device mesh
+    when the layout gate passes (hidden unsharded, per-shard rows
+    tileable), the jnp path otherwise.  ``spec`` is the activation
+    PartitionSpec the model pins (e.g. ``P(("data","fsdp"), "seq",
+    None)``).  All checks are on static shapes — the choice bakes into
+    the compiled program."""
+
+    def norm(x, scale, eps=1e-5):
+        if mesh is None or mesh.size == 1:
+            return rms_norm(x, scale, eps)
+        flag = _fused_flag()
+        on = (
+            jax.default_backend() == "tpu" if flag == "auto"
+            else flag == "on"
+        )
+        rows = _local_rows(x.shape, mesh, spec) if on else 0
+        if not rows or not supports(rows, x.shape[-1]):
+            return _rms_norm_jnp(x, scale, eps)
+        return sharded_rms_norm(mesh, spec, eps)(x, scale)
+
+    return norm

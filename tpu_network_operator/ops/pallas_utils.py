@@ -1,9 +1,12 @@
 """Shared helpers for the Pallas TPU kernels (flash attention, fused
-RMSNorm, fused 8-bit Adam) — one copy of the interpret-mode predicate
-and the aligned-divisor row tiler, so the backend check and alignment
-rules cannot drift between kernels."""
+RMSNorm, fused 8-bit Adam) — one copy of the interpret-mode predicate,
+the aligned-divisor row tiler, and the PartitionSpec→local-shape walk,
+so the backend check, alignment rules and shard gates cannot drift
+between kernels."""
 
 from __future__ import annotations
+
+import math
 
 import jax
 
@@ -11,6 +14,30 @@ import jax
 def interpret() -> bool:
     """Run kernels in interpreter mode off-TPU (CPU CI, dry runs)."""
     return jax.default_backend() != "tpu"
+
+
+def local_shape(mesh, spec, shape):
+    """Per-shard (local) shape of a global ``shape`` under its
+    PartitionSpec on ``mesh``, or None when any sharded dim does not
+    divide its mesh-axis product evenly — the common gate both
+    shard_map-wrapped kernels (fused RMSNorm, fused 8-bit Adam) apply
+    before running per-shard."""
+    entries = tuple(spec) if spec is not None else ()
+    if len(entries) > len(shape):
+        return None
+    entries = entries + (None,) * (len(shape) - len(entries))
+    local = []
+    for dim, names in zip(shape, entries):
+        if names is None:
+            k = 1
+        elif isinstance(names, (tuple, list)):
+            k = math.prod(mesh.shape[n] for n in names)
+        else:
+            k = mesh.shape[names]
+        if k <= 0 or dim % k:
+            return None
+        local.append(dim // k)
+    return tuple(local)
 
 
 def tile_rows(n: int, cap: int, align: int) -> int:

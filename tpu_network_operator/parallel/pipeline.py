@@ -444,7 +444,10 @@ def _make_1f1b_step(
                                scaling=cfg.rope_scaling_dict)
 
         def block(x, lp):
-            return llama._layer(cfg, cos, sin, x, lp, attn_fn)
+            # bare rms_norm: inside the manual-over-pipe region the
+            # mesh-aware norm dispatch (ops.norms.make_norm_fn) cannot
+            # nest another shard_map, so the jnp path applies
+            return llama._layer(cfg, cos, sin, x, lp, attn_fn, rms_norm)
 
         if cfg.remat:
             block = jax.checkpoint(block, policy=remat_policy(cfg))
@@ -643,6 +646,7 @@ def make_pipeline_train_step(
     ``seq_axis``).
     """
     from ..models import llama
+    from ..ops.norms import rms_norm
 
     if schedule == "1f1b":
         if seq_axis is not None:
@@ -654,7 +658,8 @@ def make_pipeline_train_step(
 
     def make_block(cos, sin, attn):
         def block(x, lp):
-            return llama._layer(cfg, cos, sin, x, lp, attn)
+            # bare rms_norm: no nested shard_map inside the pipe region
+            return llama._layer(cfg, cos, sin, x, lp, attn, rms_norm)
         return block
 
     return _make_pipelined_step(

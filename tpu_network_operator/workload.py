@@ -215,12 +215,11 @@ def cmd_train(args) -> int:
 
         return make_ring_attn_fn(mesh)
 
-    optimizer = None
-    if args.optimizer == "adam8bit":
-        # int8/f8-moment AdamW: halves optimizer HBM (models/optim8bit)
-        from .models.optim8bit import adamw8bit
-
-        optimizer = adamw8bit()   # library defaults mirror adamw's
+    # int8/f8-moment AdamW: halves optimizer HBM (models/optim8bit).
+    # Passed as a sentinel — make_sharded_train_step resolves it with the
+    # mesh + per-leaf PartitionSpecs so the fused per-shard update runs
+    # on multi-device meshes too.
+    optimizer = "adam8bit" if args.optimizer == "adam8bit" else None
 
     # imported checkpoints (workload convert) carry their true geometry
     # — incl. family and rope scaling — which beats --model/--preset
@@ -380,11 +379,7 @@ def cmd_convert(args) -> int:
     log(f"imported {cfg.num_params() / 1e9:.2f}B params from {args.hf_path}")
     params = assign_shardings(params, cfg, mesh)
 
-    optimizer = None
-    if args.optimizer == "adam8bit":
-        from .models.optim8bit import adamw8bit
-
-        optimizer = adamw8bit()
+    optimizer = "adam8bit" if args.optimizer == "adam8bit" else None
     # the family's train-step builder defaults the optimizer, keeping
     # the saved state's structure identical to what cmd_train restores
     if isinstance(cfg, LlamaConfig):
