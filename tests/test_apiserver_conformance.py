@@ -309,3 +309,69 @@ class TestDeleteSemantics:
         assert body["kind"] in ("Lease", "Status")
         code, _ = ep.request("GET", f"{LEASES}/conf-del")
         assert code == 404
+
+
+class TestWatchInitialState:
+    def test_no_rv_watch_replays_current_state_as_added(self, server):
+        """resourceVersion unset = "get state and start at most recent":
+        the watch begins with synthetic ADDED events for every existing
+        instance (then goes live) — the contract kube documents and the
+        informer pattern's no-list bootstrap relies on."""
+        ep, _ = server
+        ep.request("POST", LEASES, _lease("conf-init-a"))
+        ep.request("POST", LEASES, _lease("conf-init-b"))
+        events = ep.stream(f"{LEASES}?watch=true", timeout=15)
+        seen = set()
+        for ev in events:
+            nm = ev["object"].get("metadata", {}).get("name")
+            if nm in ("conf-init-a", "conf-init-b"):
+                assert ev["type"] == "ADDED", ev
+                seen.add(nm)
+                if len(seen) == 2:
+                    break
+        assert seen == {"conf-init-a", "conf-init-b"}
+
+
+class TestListChunking:
+    def test_limit_and_continue_walk_the_collection(self, server):
+        """limit=N pages + opaque continue tokens cover the collection
+        exactly once, every page reporting the first page's
+        resourceVersion (one logical list)."""
+        import urllib.parse
+
+        ep, _ = server
+        names = {f"conf-page-{i}" for i in range(5)}
+        for n in sorted(names):
+            ep.request("POST", LEASES, _lease(n))
+        code, body = ep.request("GET", f"{LEASES}?limit=2")
+        assert code == 200
+        assert len(body["items"]) == 2
+        assert body["metadata"].get("continue")
+        rv0 = body["metadata"]["resourceVersion"]
+        got = [i["metadata"]["name"] for i in body["items"]]
+        while body["metadata"].get("continue"):
+            tok = urllib.parse.quote(body["metadata"]["continue"])
+            code, body = ep.request(
+                "GET", f"{LEASES}?limit=2&continue={tok}"
+            )
+            assert code == 200
+            assert len(body["items"]) <= 2
+            assert body["metadata"]["resourceVersion"] == rv0
+            got += [i["metadata"]["name"] for i in body["items"]]
+        assert names <= set(got), "pages did not cover the collection"
+        assert len(got) == len(set(got)), "page overlap"
+
+    def test_unlimited_list_has_no_continue(self, server):
+        ep, _ = server
+        ep.request("POST", LEASES, _lease("conf-nolimit"))
+        code, body = ep.request("GET", LEASES)
+        assert code == 200
+        assert not body["metadata"].get("continue")
+
+    def test_malformed_continue_token_is_400(self, server):
+        ep, _ = server
+        code, body = ep.request(
+            "GET", f"{LEASES}?limit=2&continue=%21%21notatoken%21%21"
+        )
+        assert code == 400
+        assert body["kind"] == "Status"

@@ -28,6 +28,9 @@ from . import templates
 log = logging.getLogger("tpunet.controller")
 
 OWNER_KEY = ".metadata.controller"   # ref controller :58
+# list chunk size for the status pass's namespace-wide lists (the kube
+# convention client-go's pager defaults to)
+LIST_PAGE_SIZE = 500
 
 # gaudinet host/container paths (ref controller :65-67)
 GAUDINET_PATH_HOST = "/etc/habanalabs/gaudinet.json"
@@ -435,6 +438,9 @@ class NetworkClusterPolicyReconciler:
                 "Lease",
                 namespace=self.namespace,
                 label_selector={rpt.AGENT_LABEL: "true"},
+                # chunked: a large fleet's report pass never asks the
+                # apiserver for one unbounded Lease list
+                limit=LIST_PAGE_SIZE,
             )
         except Exception as e:   # noqa: BLE001 — absence = no reports yet
             log.debug("agent report list failed: %s", e)
@@ -485,6 +491,9 @@ class NetworkClusterPolicyReconciler:
                 "Pod",
                 namespace=self.namespace,
                 field_index={OWNER_KEY: ds["metadata"]["name"]},
+                # the field index filters client-side, so the wire list
+                # is the whole namespace — chunk it
+                limit=LIST_PAGE_SIZE,
             )
         except Exception as e:   # noqa: BLE001 — index absence = no info
             log.debug("pod list for node correlation failed: %s", e)

@@ -143,7 +143,13 @@ class ApiClient:
                 if '"reason":"AlreadyExists"' in detail:
                     raise kerr.AlreadyExistsError(detail) from None
                 raise kerr.ConflictError(detail) from None
-            if "admission webhook denied the request" in detail:
+            # webhook denial: a real kube-apiserver quotes the webhook
+            # name — 'admission webhook "<name>" denied the request: …'
+            # (status code per the webhook, commonly 400/403); the
+            # in-repo wire server emits the unquoted form.  Match the
+            # stable halves of the message, not one server's exact shape.
+            if ("admission webhook" in detail
+                    and "denied the request" in detail):
                 raise kerr.AdmissionDeniedError(detail) from None
             raise kerr.ApiError(f"{e.code}: {detail}") from None
 
@@ -159,12 +165,33 @@ class ApiClient:
         namespace: str = "",
         label_selector: Optional[Dict[str, str]] = None,
         field_index: Optional[Dict[str, str]] = None,
+        limit: int = 0,
     ) -> List[Dict[str, Any]]:
-        url = self._url(api_version, kind, namespace)
+        """List, following the kube chunking contract when ``limit`` is
+        set: each request asks the server for at most ``limit`` items
+        and the ``metadata.continue`` token pages through the rest, so
+        no single response (or server-side marshaling pass) holds the
+        whole collection — the real apiserver's bound on large lists.
+        The full item set is still returned to the caller."""
+        base = self._url(api_version, kind, namespace)
+        params = []
         if label_selector:
             sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
-            url += f"?labelSelector={urllib.request.quote(sel)}"
-        items = self._request("GET", url).get("items", [])
+            params.append(f"labelSelector={urllib.request.quote(sel)}")
+        if limit:
+            params.append(f"limit={int(limit)}")
+        items: List[Dict[str, Any]] = []
+        cont = ""
+        while True:
+            parts = list(params)
+            if cont:
+                parts.append(f"continue={urllib.request.quote(cont)}")
+            url = base + ("?" + "&".join(parts) if parts else "")
+            body = self._request("GET", url)
+            items.extend(body.get("items", []))
+            cont = body.get("metadata", {}).get("continue", "")
+            if not (limit and cont):
+                break
         for obj in items:
             # list items come without apiVersion/kind; restore them so
             # downstream owner checks work uniformly

@@ -294,10 +294,14 @@ class FakeCluster:
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
         field_index: Optional[Dict[str, str]] = None,
+        limit: int = 0,
     ) -> List[Dict[str, Any]]:
         """List with optional namespace / label selector / field-index match
         (client.InNamespace + client.MatchingFields analog,
-        ref controller :331)."""
+        ref controller :331).  ``limit`` is accepted for signature parity
+        with :class:`..kube.client.ApiClient` — the in-process fake has
+        no wire to chunk, so the full set returns either way (the wire
+        server implements the real ``limit``/``continue`` contract)."""
         with self._lock:
             out = []
             for (ns, _), obj in sorted(self._bucket(api_version, kind).items()):

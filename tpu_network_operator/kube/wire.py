@@ -13,13 +13,17 @@ agent subprocesses in e2e tests get a cluster to report to.
 
 Watch resume follows the real contract: list bodies carry the store's
 ``metadata.resourceVersion`` high-water mark, ``?watch&resourceVersion=N``
-replays every retained event newer than N before going live, and a
-resume older than the retention window gets the genuine 410 Gone /
-``Expired`` ERROR event (``FakeCluster.HISTORY_LIMIT`` plays the role
-of etcd compaction).  ``fieldSelector`` is evaluated server-side for
-the dotted paths kube supports generically.  Deliberately NOT
-implemented: apiserver features the framework does not consume
-(OpenAPI discovery beyond /apis, list pagination/continue tokens).
+replays every retained event newer than N before going live, a watch
+WITHOUT a resourceVersion starts at "most recent" with the current
+store state replayed as synthetic ADDED events (the real apiserver's
+"get state and start at most recent"), and a resume older than the
+retention window gets the genuine 410 Gone / ``Expired`` ERROR event
+(``FakeCluster.HISTORY_LIMIT`` plays the role of etcd compaction).
+``fieldSelector`` is evaluated server-side for the dotted paths kube
+supports generically.  Deliberately NOT implemented: apiserver
+features the framework does not consume (OpenAPI discovery beyond
+/apis; list pagination is implemented — see ``limit``/``continue`` in
+``_serve_list``).
 """
 
 from __future__ import annotations
@@ -49,6 +53,35 @@ KINDS = {
     "events": "Event",
     "configmaps": "ConfigMap",
 }
+
+
+def _list_key(obj: Dict[str, Any]) -> Tuple[str, str]:
+    """etcd key order: (namespace, name) — the order real list pages
+    walk the keyspace in."""
+    md = obj.get("metadata", {})
+    return (md.get("namespace", ""), md.get("name", ""))
+
+
+def _continue_token(rv, after: Tuple[str, str]) -> str:
+    """Opaque continue token (base64url JSON, like the real apiserver's
+    etcd-key token): the original list's resourceVersion + the last
+    returned key."""
+    import base64
+
+    return base64.urlsafe_b64encode(json.dumps(
+        {"rv": rv, "k": list(after)}
+    ).encode()).decode()
+
+
+def _parse_continue(token: str) -> Tuple[Any, Tuple[str, str]]:
+    import base64
+
+    try:
+        body = json.loads(base64.urlsafe_b64decode(token.encode()))
+        k = body["k"]
+        return body["rv"], (str(k[0]), str(k[1]))
+    except Exception:   # noqa: BLE001 — any malformed token maps to 400
+        raise ValueError("invalid continue token") from None
 
 
 def _field_predicate(selector: str):
@@ -253,32 +286,81 @@ class WireApiServer:
                     elif q.get("watch", ["false"])[0] == "true":
                         self._serve_watch(av, kind, ns, q)
                     else:
-                        sel = None
-                        if "labelSelector" in q:
-                            sel = dict(
-                                kv.split("=", 1)
-                                for kv in q["labelSelector"][0].split(",")
-                            )
-                        # items + rv atomically: a later rv than the
-                        # snapshot would make list-then-watch skip the
-                        # concurrent write forever
-                        items, rv = outer.cluster.list_with_rv(
-                            av, kind, namespace=ns or None,
-                            label_selector=sel,
-                        )
-                        if "fieldSelector" in q:
-                            items = _field_select(
-                                items, q["fieldSelector"][0]
-                            )
-                        self._reply_obj({
-                            "kind": f"{kind}List", "apiVersion": av,
-                            # the high-water mark a client may resume a
-                            # watch from (list-then-watch)
-                            "metadata": {"resourceVersion": rv},
-                            "items": items,
-                        })
+                        self._serve_list(av, kind, ns, q)
                 except Exception as e:   # noqa: BLE001 — wire error mapping
                     self._reply_err(e)
+
+            def _serve_list(self, av, kind, ns, q):
+                """List with the kube chunking contract: ``limit=N``
+                returns at most N items (key order: namespace, name)
+                plus an opaque ``metadata.continue`` token and
+                ``remainingItemCount``; ``continue=tok`` resumes after
+                the token's key.  Divergences from a real apiserver,
+                accepted: pages come from the live store, not an RV
+                snapshot (identical absent concurrent writes — the case
+                the conformance tier pins), and selectors filter before
+                the limit is applied (real kube limits at the storage
+                layer, so its pages can run short)."""
+                sel = None
+                if "labelSelector" in q:
+                    sel = dict(
+                        kv.split("=", 1)
+                        for kv in q["labelSelector"][0].split(",")
+                    )
+                limit = 0
+                if "limit" in q:
+                    try:
+                        limit = int(q["limit"][0])
+                        if limit < 0:
+                            raise ValueError(limit)
+                    except ValueError:
+                        self._reply(400, _status_body(
+                            400, "BadRequest",
+                            f"invalid limit {q['limit'][0]!r}",
+                        ))
+                        return
+                after = None
+                cont = q.get("continue", [""])[0]
+                if cont:
+                    try:
+                        cont_rv, after = _parse_continue(cont)
+                    except ValueError:
+                        self._reply(400, _status_body(
+                            400, "BadRequest",
+                            "invalid continue token",
+                        ))
+                        return
+                # items + rv atomically: a later rv than the snapshot
+                # would make list-then-watch skip the concurrent write
+                # forever
+                items, rv = outer.cluster.list_with_rv(
+                    av, kind, namespace=ns or None,
+                    label_selector=sel,
+                )
+                if cont:
+                    # continuation pages keep reporting the original
+                    # list's resourceVersion (the kube contract: one
+                    # logical list, one RV)
+                    rv = cont_rv
+                if "fieldSelector" in q:
+                    items = _field_select(items, q["fieldSelector"][0])
+                items.sort(key=_list_key)
+                if after is not None:
+                    items = [o for o in items if _list_key(o) > after]
+                meta: Dict[str, Any] = {"resourceVersion": rv}
+                if limit and len(items) > limit:
+                    meta["continue"] = _continue_token(
+                        rv, _list_key(items[limit - 1])
+                    )
+                    meta["remainingItemCount"] = len(items) - limit
+                    items = items[:limit]
+                self._reply_obj({
+                    "kind": f"{kind}List", "apiVersion": av,
+                    # the high-water mark a client may resume a watch
+                    # from (list-then-watch)
+                    "metadata": meta,
+                    "items": items,
+                })
 
             def _serve_watch(self, av, kind, ns, q):
                 # validate BEFORE the 200/chunked headers go out — a
@@ -304,6 +386,19 @@ class WireApiServer:
                     except ValueError as e:
                         self._reply(400, _status_body(400, "Invalid", str(e)))
                         return
+
+                # no resourceVersion (or the "any" sentinel "0") = the
+                # real apiserver's "get state and start at most recent":
+                # the current store state is replayed as synthetic ADDED
+                # events, then the live stream continues from that
+                # high-water mark (events racing the list are recovered
+                # by the history replay in cluster.watch)
+                initial: List[Dict[str, Any]] = []
+                if not since_rv:
+                    initial, head_rv = outer.cluster.list_with_rv(
+                        av, kind, namespace=ns or None,
+                    )
+                    since_rv = int(head_rv)
 
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -343,6 +438,14 @@ class WireApiServer:
                     gone(str(e))
                     return
                 try:
+                    # initial state came from a namespace-scoped list;
+                    # only the field selector still applies here
+                    for obj in initial:
+                        if keep is not None and not keep(obj):
+                            continue
+                        chunk(json.dumps(
+                            {"type": "ADDED", "object": obj}
+                        ).encode() + b"\n")
                     while True:
                         if outer._drop_once.is_set():
                             outer._drop_once.clear()
