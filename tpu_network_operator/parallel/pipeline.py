@@ -121,59 +121,73 @@ def _stage_kernel(
     return out, aux_mean
 
 
-def _1f1b_tables(n_stages: int, n_micro: int):
-    """Host-side list-scheduled 1F1B (PipeDream-flush) tick tables.
+def _1f1b_tables(n_stages: int, n_micro: int, v: int = 1):
+    """Host-side list-scheduled 1F1B (PipeDream-flush) tick tables, with
+    optional virtual-stage interleaving (Megatron-style).
 
-    Returns two ``[T, S]`` int32 arrays: ``fwd[t, r]`` / ``bwd[t, r]`` is
-    the microbatch stage ``r`` forwards / backwards at tick ``t`` (-1 =
-    idle in that direction).  One compute unit per stage per tick;
-    backward is preferred over forward once ready (drains saved
-    activations), and forwards are capped at ``S - r`` in flight — the
-    1F1B memory bound (stage 0 holds at most S live microbatch inputs
-    instead of GPipe's M).  For the canonical M >= S case the schedule
-    completes in 2(M + S - 1) ticks — the same bubble as GPipe, with
-    bounded memory.
+    The pipeline has ``V = S*v`` *virtual* stages; virtual stage
+    ``vs = c*S + r`` is model chunk ``c`` on device ``r``, so activations
+    always hop to the right neighbour (chunk boundaries wrap rank
+    ``S-1 → 0``).  Returns four ``[T, S]`` int32 arrays:
+    ``(fwd_mb, fwd_ck, bwd_mb, bwd_ck)`` — the microbatch and chunk each
+    device forwards / backwards at each tick (mb -1 = idle; the chunk
+    entry is then meaningless).  One forward unit and one backward unit
+    per device per tick; backward prefers the DEEPEST ready chunk
+    (drains toward the loss), forwards are capped at ``V - vs`` in
+    flight per virtual stage — the 1F1B memory bound (live stage inputs
+    stay O(V) instead of GPipe's M).  Interleaving divides the
+    fill/drain bubble by ``v``: each device's chunks give it work while
+    its deeper neighbours fill.
     """
     import numpy as np
 
-    S, M = n_stages, n_micro
-    tf = [[-1] * M for _ in range(S)]     # tick stage r forwarded mb m
-    tb = [[-1] * M for _ in range(S)]
-    nf, nb = [0] * S, [0] * S             # next fwd/bwd mb per stage
-    rows_f, rows_b = [], []
+    S, M, V = n_stages, n_micro, n_stages * v
+    tf = [[-1] * M for _ in range(V)]     # tick vs forwarded mb
+    tb = [[-1] * M for _ in range(V)]
+    nf, nb = [0] * V, [0] * V             # next fwd/bwd mb per vs
+    rows = []
     t = 0
     while any(x < M for x in nb):
-        if t > 4 * (M + S) + 8:           # pragma: no cover — safety net
+        if t > 4 * v * (M + V) + 8:       # pragma: no cover — safety net
             raise RuntimeError("1f1b scheduler failed to converge")
-        row_f, row_b = [-1] * S, [-1] * S
+        rf_mb, rf_ck = [-1] * S, [0] * S
+        rb_mb, rb_ck = [-1] * S, [0] * S
         for r in range(S):
-            g = nb[r]
-            b_ready = (
-                g < M
-                and 0 <= tf[r][g] < t     # own forward done, earlier tick
-                and (r == S - 1 or 0 <= tb[r + 1][g] < t)
-            )
-            if b_ready:
-                row_b[r] = g
-                tb[r][g] = t
-                nb[r] += 1
-            # a backward and a forward may share a tick (the kernel
-            # executes one masked unit of each every tick regardless);
-            # the in-flight cap is checked after the backward retires
-            f = nf[r]
-            f_ready = (
-                f < M
-                and (r == 0 or 0 <= tf[r - 1][f] < t)
-                and (f - nb[r]) < max(S - r, 1)
-            )
-            if f_ready:
-                row_f[r] = f
-                tf[r][f] = t
-                nf[r] += 1
-        rows_f.append(row_f)
-        rows_b.append(row_b)
+            # backward: deepest ready chunk first (retires before the
+            # same tick's forward banks; the kernel runs bwd first)
+            for c in range(v - 1, -1, -1):
+                vs = c * S + r
+                g = nb[vs]
+                if (
+                    g < M
+                    and 0 <= tf[vs][g] < t   # own forward, earlier tick
+                    and (vs == V - 1 or 0 <= tb[vs + 1][g] < t)
+                ):
+                    rb_mb[r], rb_ck[r] = g, c
+                    tb[vs][g] = t
+                    nb[vs] += 1
+                    break
+            # forward: deepest ready chunk first (reaches the loss stage
+            # sooner, so backwards can start draining); the in-flight
+            # cap is checked after the backward retires
+            for c in range(v - 1, -1, -1):
+                vs = c * S + r
+                f = nf[vs]
+                if (
+                    f < M
+                    and (vs == 0 or 0 <= tf[vs - 1][f] < t)
+                    and (f - nb[vs]) < max(V - vs, 1)
+                ):
+                    rf_mb[r], rf_ck[r] = f, c
+                    tf[vs][f] = t
+                    nf[vs] += 1
+                    break
+        rows.append((rf_mb, rf_ck, rb_mb, rb_ck))
         t += 1
-    return np.asarray(rows_f, np.int32), np.asarray(rows_b, np.int32)
+    arrs = tuple(
+        np.asarray([row[i] for row in rows], np.int32) for i in range(4)
+    )
+    return arrs
 
 
 def pipeline_apply(
@@ -351,66 +365,101 @@ def _make_1f1b_step(
     n_microbatches: int,
     optimizer,
     attn_fn: Optional[Callable],
+    *,
+    param_specs_fn: Callable,
+    init_fn: Callable,
+    make_block: Callable,          # (cos, sin, attn) -> (x, lp) -> out
+    with_aux: bool,
+    aux_weight: float,
+    seq_axis: Optional[str] = None,
+    virtual_stages: int = 1,
 ):
-    """Hand-scheduled 1F1B training step for the dense (Llama) family.
+    """Hand-scheduled 1F1B training step (both model families; optional
+    virtual-stage interleaving and ring sequence parallelism).
 
     Reverse-mode AD of the GPipe forward scan necessarily runs ALL
     forward ticks before any backward tick, so every in-flight
     microbatch's stage activations stay live — memory grows with M.
     1F1B interleaves each microbatch's backward as soon as its forward
-    clears the last stage, bounding live stage inputs at S.  That
-    interleaving cannot be expressed through autodiff of a single
-    forward region, so this builder drives the whole loss+gradient
-    computation inside one manual-over-``pipe`` kernel:
+    clears the last stage, bounding live stage inputs at the virtual
+    stage count.  That interleaving cannot be expressed through autodiff
+    of a single forward region, so this builder drives the whole
+    loss+gradient computation inside one manual-over-``pipe`` kernel:
 
     * host-side static tick tables (:func:`_1f1b_tables`) say which
-      microbatch each stage forwards/backwards at each tick;
+      (microbatch, chunk) each device forwards/backwards at each tick;
+      with ``virtual_stages=v > 1`` each device holds v layer chunks
+      (Megatron interleaving: chunk c on device r is virtual stage
+      ``c*S + r``; the stored layer leaves are [v, L/v, ...] with the
+      second axis pipe-sharded, so execution order still equals the
+      canonical layer order) and the fill/drain bubble divides by v;
     * wire arrivals (activations rightward, cotangents leftward) are
-      banked into depth-S ring buffers as they land — the ppermute wire
-      itself is one slot overwritten every tick, and a stage at its
-      in-flight cap consumes an arrival several ticks late;
-    * a forward unit runs the local layer stack from the banked input;
-      the backward unit recomputes the stack under ``jax.vjp`` from the
-      same banked input — activation memory is two [S, b_micro, s, h]
-      buffers per stage regardless of M (the recompute matches what
+      banked into per-chunk ring buffers as they land — the ppermute
+      wire itself is one slot overwritten every tick, and a stage at
+      its in-flight cap consumes an arrival several ticks late.  A
+      chunk-boundary hop (rank S-1 → 0) banks under the NEXT chunk;
+    * a forward unit runs one chunk's layer stack from the banked
+      input; the backward unit recomputes it under ``jax.vjp`` from the
+      same banked input — activation memory is two [v, D, b_micro, s, h]
+      buffers per device regardless of M (the recompute matches what
       ``cfg.remat`` policies already pay);
-    * every stage executes the SAME program every tick — one masked
+    * every device executes the SAME program every tick — one masked
       forward unit plus one masked backward vjp whose scalar objective
-      is ``is_last·loss(y) + <y, masked_grad_in>``.  Stage-dependent
-      ``lax.cond`` branches would deadlock here: the auto tensor/fsdp
-      axes put GSPMD collectives inside the branch bodies, and devices
-      on different pipe ranks would disagree about which collectives
-      run.  The masking makes the last stage's vjp seed the true loss
-      gradient (final-norm -> lm_head -> cross-entropy are folded into
-      the same vjp; the embedding lookup is folded in for stage 0)
-      while interior stages propagate the received cotangent;
+      is ``is_last_vs·loss(y) + <y, masked_grad_in>`` (+ the router aux
+      term on every active backward for the MoE family, which is how
+      interior stages' routers receive their aux gradient).
+      Stage-dependent ``lax.cond`` branches would deadlock here: the
+      auto tensor/fsdp/expert axes put GSPMD collectives inside the
+      branch bodies, and devices on different pipe ranks would disagree
+      about which collectives run.  The masking makes the last virtual
+      stage's vjp seed the true loss gradient (final-norm -> lm_head ->
+      cross-entropy are folded into the same vjp; the embedding lookup
+      is folded in for virtual stage 0) while interior stages propagate
+      the received cotangent;
+    * ``seq_axis``: the manual region extends over {pipe, seq}, tokens
+      stay replicated (so next-token targets need no halo exchange),
+      activations carry each shard's sequence chunk, attention is the
+      raw in-manual ring body and rope angles are sliced to absolute
+      positions — same composition contract as the GPipe path;
     * activations hop right and gradients hop left with one
       ``ppermute`` pair per tick; parameter grads accumulate in f32.
 
-    Composes with the auto (data/fsdp/tensor) axes like the GPipe path;
-    ``seq_axis`` and the MoE family are not supported on this schedule.
+    Composes with the auto (data/fsdp/tensor/expert) axes like the
+    GPipe path.
     """
-    from ..models import llama
-    from ..models.training import (
-        make_sharded_train_step,
-        next_token_xent,
-        remat_policy,
-    )
+    import numpy as np
+
+    from ..models.training import make_sharded_train_step, remat_policy
     from ..ops.attention import causal_attention
     from ..ops.norms import rms_norm
     from ..ops.rope import rope_angles
 
-    attn_fn = attn_fn or causal_attention
+    if seq_axis:
+        from .ring import ring_attn_in_manual
+
+        attn = partial(ring_attn_in_manual, axis=seq_axis)
+    else:
+        attn = attn_fn or causal_attention
     n_stages = mesh.shape["pipe"]
     M = n_microbatches
-    if cfg.layers % n_stages:
+    v = virtual_stages
+    if cfg.layers % (n_stages * v):
         raise ValueError(
-            f"layers {cfg.layers} not divisible by stages {n_stages}"
+            f"layers {cfg.layers} not divisible by stages*virtual "
+            f"{n_stages}*{v}"
         )
 
-    specs = llama.param_specs(cfg)
+    # stored layer layout: v == 1 keeps the flat [L, ...] leaves with
+    # the leading axis pipe-sharded — byte-identical to the GPipe/plain
+    # builders, so 1f1b checkpoints stay interchangeable with them.
+    # v > 1 stores [v, L/v, ...] with the SECOND axis pipe-sharded:
+    # device r's chunk c is rows [c, r*per:(r+1)*per] = original layers
+    # c*S*per + r*per + k, i.e. executing chunks in (c, r) order IS the
+    # canonical layer order — same network either way.
+    lead = ("pipe",) if v == 1 else (None, "pipe")
+    specs = param_specs_fn(cfg)
     specs["layers"] = jax.tree.map(
-        lambda s: P(*(("pipe",) + tuple(s)[1:])),
+        lambda s: P(*(lead + tuple(s)[1:])),
         specs["layers"],
         is_leaf=lambda x: isinstance(x, P),
     )
@@ -422,127 +471,227 @@ def _make_1f1b_step(
     repl = NamedSharding(mesh, P())
     # manual-over-pipe view of the same layout
     pipe_specs = {
-        "embed": P(), "layers": P("pipe"), "ln_final": P(), "lm_head": P(),
+        "embed": P(), "layers": P(*lead), "ln_final": P(),
+        "lm_head": P(),
     }
 
-    fwd_rows, bwd_rows = _1f1b_tables(n_stages, M)
+    def init_chunked(key):
+        params = init_fn(key)
+        if v > 1:
+            params["layers"] = jax.tree.map(
+                lambda a: a.reshape((v, a.shape[0] // v) + a.shape[1:]),
+                params["layers"],
+            )
+        return params
+
+    fmb, fck, bmb, bck = _1f1b_tables(n_stages, M, v)
     # each tick banks the PREVIOUS tick's wire arrivals, identified by
     # the sending neighbor's schedule row (see the kernel's tick())
-    import numpy as np
+    pad_mb = np.full((1, n_stages), -1, np.int32)
+    pad_ck = np.zeros((1, n_stages), np.int32)
+    # [T, 8, S]: fwd mb/ck, bwd mb/ck, prev-tick fwd mb/ck + bwd mb/ck
+    tables = np.stack([
+        fmb, fck, bmb, bck,
+        np.vstack([pad_mb, fmb[:-1]]), np.vstack([pad_ck, fck[:-1]]),
+        np.vstack([pad_mb, bmb[:-1]]), np.vstack([pad_ck, bck[:-1]]),
+    ], axis=1)
 
-    pad = np.full((1, n_stages), -1, np.int32)
-    prev_fwd = np.vstack([pad, fwd_rows[:-1]])
-    prev_bwd = np.vstack([pad, bwd_rows[:-1]])
+    seq_size = mesh.shape[seq_axis] if seq_axis else 1
+    axis_names = {"pipe", seq_axis} if seq_axis else {"pipe"}
 
     def grads_fn(params, tokens):
         b, s1 = tokens.shape
         s = s1 - 1
         if b % M:
             raise ValueError(f"batch {b} not divisible by microbatches {M}")
+        if s % seq_size:
+            raise ValueError(f"seq {s} not divisible by seq axis {seq_size}")
+        sl = s // seq_size
         xtok = tokens.reshape(M, b // M, s1)
         cos, sin = rope_angles(s, cfg.head_dim, cfg.rope_theta,
-                               scaling=cfg.rope_scaling_dict)
+                               scaling=getattr(cfg, "rope_scaling_dict",
+                                               None))
 
-        def block(x, lp):
-            # bare rms_norm: inside the manual-over-pipe region the
-            # mesh-aware norm dispatch (ops.norms.make_norm_fn) cannot
-            # nest another shard_map, so the jnp path applies
-            return llama._layer(cfg, cos, sin, x, lp, attn_fn, rms_norm)
-
+        if seq_axis:
+            def block_raw(x, lp):
+                # slice the replicated angle tables to this shard's
+                # absolute positions (same rule as the GPipe path)
+                i = jax.lax.axis_index(seq_axis)
+                cos_l = jax.lax.dynamic_slice_in_dim(cos, i * sl, sl, 0)
+                sin_l = jax.lax.dynamic_slice_in_dim(sin, i * sl, sl, 0)
+                return make_block(cos_l, sin_l, attn)(x, lp)
+        else:
+            block_raw = make_block(cos, sin, attn)
+        if with_aux:
+            block = block_raw
+        else:
+            def block(x, lp):
+                return block_raw(x, lp), jnp.zeros((), jnp.float32)
         if cfg.remat:
             block = jax.checkpoint(block, policy=remat_policy(cfg))
 
         # explicit ppermutes are never differentiated here (the kernel
         # computes its own grads), but XLA's CPU backend still rejects
-        # bf16 collectives in manual regions — same rule as pipeline_apply
+        # bf16 psums in manual regions — same rule as pipeline_apply
         wire_dt = (
             jnp.float32 if jax.default_backend() == "cpu" else cfg.dtype
         )
 
-        def kernel(p, xtok, fwd_rows, bwd_rows, prev_fwd, prev_bwd):
+        def kernel(p, xtok, tables):
             rank = jax.lax.axis_index("pipe")
             n = jax.lax.axis_size("pipe")
+            sidx = jax.lax.axis_index(seq_axis) if seq_axis else 0
             bm = xtok.shape[1]
             h = cfg.hidden
-            D = n                               # ring-buffer depth = S
+            # ring-buffer depth: live (arrived-or-executed, not yet
+            # backwarded) microbatches per virtual stage span a window
+            # of at most V+1 consecutive ids (in-flight cap V - vs,
+            # plus one arrival racing ahead)
+            V = n * v
+            D = min(V + 1, M)
+            lleaf = jax.tree.leaves(p["layers"])[0]
+            # local per-device layer count x stages (v==1 leaves are
+            # flat [L/S, ...]; v>1 leaves are [v, per, ...])
+            L_total = (
+                lleaf.shape[0] if v == 1
+                else lleaf.shape[0] * lleaf.shape[1]
+            ) * n
+            # in-vjp coefficient for the router aux term: after the
+            # final grads/(M*s) normalization this contributes
+            # aux_weight * d(mean over L*M*seq groups)/dp — matching
+            # the GPipe kernel's aux estimator
+            aux_lambda = (
+                aux_weight * s / (L_total * seq_size) if with_aux else 0.0
+            )
 
-            def stack_f(p_, x_in):
-                y, _ = jax.lax.scan(
-                    lambda x, lp: (block(x, lp), None), x_in, p_["layers"]
+            def stack_f(p_, ck, x_in):
+                layers = p_["layers"]
+                if v == 1:
+                    # flat [per, ...] leaves: add the trivial chunk axis
+                    # (a view — vjp flows straight back to the flat leaf)
+                    layers = jax.tree.map(lambda a: a[None], layers)
+                chunk = jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, jnp.clip(ck, 0, v - 1), axis=0, keepdims=False
+                    ),
+                    layers,
                 )
-                return y
 
-            is_last = (rank == n - 1).astype(jnp.float32)
+                def body(carry, lp):
+                    x, aux = carry
+                    x2, a = block(x, lp)
+                    return (x2, aux + a.astype(jnp.float32)), None
 
-            def fwd_one(p_, x_recv, tok_mb):
-                # stage 0's input is the embedding, not the wire
-                emb = p_["embed"][tok_mb[:, :-1]].astype(cfg.dtype)
-                x_in = jnp.where(rank == 0, emb, x_recv)
-                return stack_f(p_, x_in)
+                (y, aux), _ = jax.lax.scan(
+                    body, (x_in, jnp.zeros((), jnp.float32)), chunk
+                )
+                return y, aux
 
-            def bwd_unit(p_, x_saved, tok_mb, grad_in, active):
+            def fwd_one(p_, ck, x_recv, tok_mb):
+                # virtual stage 0's input is the embedding, not the wire
+                tok_loc = jax.lax.dynamic_slice(
+                    tok_mb, (0, sidx * sl), (bm, sl)
+                )
+                emb = p_["embed"][tok_loc].astype(cfg.dtype)
+                x_in = jnp.where((rank == 0) & (ck == 0), emb, x_recv)
+                return stack_f(p_, ck, x_in)
+
+            def local_xent(logits, targets):
+                # batch mean, LOCAL-position sum: psummed over pipe+seq
+                # and normalized by (M*s) outside the scan
+                logz = jax.nn.logsumexp(logits, axis=-1)
+                gold = jnp.take_along_axis(
+                    logits, targets[..., None], axis=-1
+                )[..., 0]
+                return jnp.sum(jnp.mean(logz - gold, axis=0))
+
+            def bwd_unit(p_, ck, x_saved, tok_mb, grad_in, active, seed):
                 """One masked backward: vjp of a scalar that is the true
-                loss on an active last stage and <y, grad_in> on an
-                active interior stage (zero when idle), so one uniform
-                linearization serves every stage — no collective-bearing
+                loss on the active last VIRTUAL stage, <y, grad_in> on
+                an active interior stage (zero when idle) — plus the aux
+                term on every active stage — so one uniform
+                linearization serves every device: no collective-bearing
                 branches."""
-                seed_loss = active * is_last
-                gmask = (active * (1.0 - is_last)) * grad_in.astype(
+                activef = active.astype(jnp.float32)
+                seedf = seed.astype(jnp.float32)
+                gmask = (activef * (1.0 - seedf)) * grad_in.astype(
                     jnp.float32
+                )
+                targets = jax.lax.dynamic_slice(
+                    tok_mb, (0, sidx * sl + 1), (bm, sl)
                 )
 
                 def f(p__, x__):
-                    y = fwd_one(p__, x__, tok_mb)
+                    y, aux = fwd_one(p__, ck, x__, tok_mb)
                     z = rms_norm(y, p__["ln_final"], cfg.rms_eps)
                     logits = (z @ p__["lm_head"]).astype(jnp.float32)
-                    loss = next_token_xent(logits, tok_mb)
-                    scalar = seed_loss * loss + jnp.sum(
-                        y.astype(jnp.float32) * gmask
+                    xent = local_xent(logits, targets)
+                    scalar = (
+                        seedf * xent
+                        + (activef * aux_lambda) * aux
+                        + jnp.sum(y.astype(jnp.float32) * gmask)
                     )
-                    return scalar, loss
+                    return scalar, (xent, aux)
 
-                _, vjpf, loss = jax.vjp(f, p_, x_saved, has_aux=True)
+                _, vjpf, (xent, aux) = jax.vjp(
+                    f, p_, x_saved, has_aux=True
+                )
                 dp, dx = vjpf(jnp.float32(1.0))
                 dp = jax.tree.map(lambda a: a.astype(jnp.float32), dp)
-                return dp, dx, loss * seed_loss
+                return dp, dx, xent * seedf, aux * activef
 
-            def _bank(buf, mb, valid, value):
-                """Write ``value`` into slot ``mb % D`` when valid; ring
-                slots never collide while an entry is live because live
-                microbatches are <= D consecutive integers (the in-flight
-                cap)."""
+            def _bank(buf, ck, mb, valid, value):
+                """Write ``value`` into [chunk, mb % D] when valid; live
+                microbatches per virtual stage span < D consecutive ids
+                (see D above), so live ring slots never collide."""
                 slot = jnp.clip(mb, 0, M - 1) % D
-                cur = jax.lax.dynamic_index_in_dim(
-                    buf, slot, axis=0, keepdims=False
+                ckc = jnp.clip(ck, 0, v - 1)
+                cur = jax.lax.dynamic_slice(
+                    buf, (ckc, slot, 0, 0, 0), (1, 1) + buf.shape[2:]
                 )
-                banked = jnp.where(valid, value, cur)
-                return jax.lax.dynamic_update_slice_in_dim(
-                    buf, banked[None], slot, axis=0
+                banked = jnp.where(valid, value[None, None], cur)
+                return jax.lax.dynamic_update_slice(
+                    buf, banked, (ckc, slot, 0, 0, 0)
                 )
 
-            def _slot(buf, mb):
-                return jax.lax.dynamic_index_in_dim(
-                    buf, jnp.clip(mb, 0, M - 1) % D, axis=0, keepdims=False
+            def _slot(buf, ck, mb):
+                out = jax.lax.dynamic_slice(
+                    buf,
+                    (jnp.clip(ck, 0, v - 1),
+                     jnp.clip(mb, 0, M - 1) % D, 0, 0, 0),
+                    (1, 1) + buf.shape[2:],
                 )
+                return out[0, 0]
 
             def tick(carry, rows):
-                act_recv, grad_recv, abuf, gbuf, dacc, lacc = carry
-                row_f, row_b, prev_f, prev_b = rows
-                f = jnp.take(row_f, rank)
-                g = jnp.take(row_b, rank)
+                act_recv, grad_recv, abuf, gbuf, dacc, lacc, aacc = carry
+                f = jnp.take(rows[0], rank)
+                fc = jnp.take(rows[1], rank)
+                g = jnp.take(rows[2], rank)
+                gc = jnp.take(rows[3], rank)
 
                 # bank last tick's wire arrivals FIRST.  The ppermute
                 # wires are single slots overwritten every tick, but a
                 # capped stage may consume an activation (or a gradient)
                 # several ticks after its neighbor produced it — reading
                 # the wire directly silently trains on idle-tick garbage
-                # for 3+ stages.  The neighbor's schedule row says which
-                # microbatch (if any) is on the wire.
-                af = jnp.take(prev_f, (rank - 1) % n)
-                abuf = _bank(abuf, af, (rank > 0) & (af >= 0),
+                # for 3+ virtual stages.  The neighbor's schedule row
+                # says which (microbatch, chunk) is on the wire; a hop
+                # across the chunk boundary (sender rank S-1, receiver
+                # rank 0) lands in the receiver's NEXT chunk.
+                af = jnp.take(rows[4], (rank - 1) % n)
+                afc = jnp.take(rows[5], (rank - 1) % n) + jnp.where(
+                    rank == 0, 1, 0
+                )
+                abuf = _bank(abuf, afc, af,
+                             (af >= 0) & (afc < v),
                              act_recv.astype(cfg.dtype))
-                ag = jnp.take(prev_b, (rank + 1) % n)
-                gbuf = _bank(gbuf, ag, (rank < n - 1) & (ag >= 0),
+                ag = jnp.take(rows[6], (rank + 1) % n)
+                agc = jnp.take(rows[7], (rank + 1) % n) - jnp.where(
+                    rank == n - 1, 1, 0
+                )
+                gbuf = _bank(gbuf, agc, ag,
+                             (ag >= 0) & (agc >= 0),
                              grad_recv.astype(cfg.dtype))
 
                 # backward unit (stage input + arrived cotangent from
@@ -550,19 +699,21 @@ def _make_1f1b_step(
                 tok_b = jax.lax.dynamic_index_in_dim(
                     xtok, jnp.clip(g, 0, M - 1), axis=0, keepdims=False
                 )
-                dp, dx, lmb = bwd_unit(
-                    p, _slot(abuf, g), tok_b, _slot(gbuf, g),
-                    (g >= 0).astype(jnp.float32),
+                dp, dx, lmb, amb = bwd_unit(
+                    p, gc, _slot(abuf, gc, g), tok_b,
+                    _slot(gbuf, gc, g), g >= 0,
+                    (g >= 0) & (rank == n - 1) & (gc == v - 1),
                 )
                 dacc = jax.tree.map(jnp.add, dacc, dp)
                 lacc = lacc + lmb
+                aacc = aacc + amb
 
                 # forward unit (masked: idle ticks chew zeros, like the
                 # GPipe kernel's fill/drain ticks)
                 tok_f = jax.lax.dynamic_index_in_dim(
                     xtok, jnp.clip(f, 0, M - 1), axis=0, keepdims=False
                 )
-                y = fwd_one(p, _slot(abuf, f), tok_f)
+                y, _ = fwd_one(p, fc, _slot(abuf, fc, f), tok_f)
 
                 right = [(i, (i + 1) % n) for i in range(n)]
                 left = [(i, (i - 1) % n) for i in range(n)]
@@ -572,52 +723,81 @@ def _make_1f1b_step(
                 grad_next = jax.lax.ppermute(
                     dx.astype(wire_dt), "pipe", left
                 )
-                return (act_next, grad_next, abuf, gbuf, dacc, lacc), None
+                return (
+                    act_next, grad_next, abuf, gbuf, dacc, lacc, aacc
+                ), None
 
             carry0 = (
-                jnp.zeros((bm, s, h), wire_dt),
-                jnp.zeros((bm, s, h), wire_dt),
-                jnp.zeros((D, bm, s, h), cfg.dtype),
-                jnp.zeros((D, bm, s, h), cfg.dtype),
+                jnp.zeros((bm, sl, h), wire_dt),
+                jnp.zeros((bm, sl, h), wire_dt),
+                jnp.zeros((v, D, bm, sl, h), cfg.dtype),
+                jnp.zeros((v, D, bm, sl, h), cfg.dtype),
                 jax.tree.map(
                     lambda a: jnp.zeros(a.shape, jnp.float32), p
                 ),
                 jnp.float32(0.0),
+                jnp.float32(0.0),
             )
-            (_, _, _, _, dacc, lacc), _ = jax.lax.scan(
-                tick, carry0, (fwd_rows, bwd_rows, prev_fwd, prev_bwd)
+            (_, _, _, _, dacc, lacc, aacc), _ = jax.lax.scan(
+                tick, carry0, jnp.asarray(tables)
             )
-            # layer grads live on their stage; the replicated leaves
-            # (embed on stage 0, head/final-norm on the last stage) are
-            # psum-combined so every stage returns the full gradient
+            # layer grads live on their stage (replicated over seq ->
+            # psum); the replicated leaves (embed on virtual stage 0,
+            # head/final-norm on the last) psum over pipe (+seq) so
+            # every device returns the full gradient
+            all_axes = ("pipe",) + ((seq_axis,) if seq_axis else ())
             grads = {
-                "embed": jax.lax.psum(dacc["embed"], "pipe"),
-                "layers": dacc["layers"],
-                "ln_final": jax.lax.psum(dacc["ln_final"], "pipe"),
-                "lm_head": jax.lax.psum(dacc["lm_head"], "pipe"),
+                "embed": jax.lax.psum(dacc["embed"], all_axes),
+                "layers": jax.tree.map(
+                    (lambda a: jax.lax.psum(a, seq_axis))
+                    if seq_axis else (lambda a: a),
+                    dacc["layers"],
+                ),
+                "ln_final": jax.lax.psum(dacc["ln_final"], all_axes),
+                "lm_head": jax.lax.psum(dacc["lm_head"], all_axes),
             }
-            grads = jax.tree.map(lambda a: a / M, grads)
-            loss = jax.lax.psum(lacc, "pipe") / M
+            grads = jax.tree.map(lambda a: a / (M * s), grads)
+            loss = jax.lax.psum(lacc, all_axes) / (M * s)
+            if with_aux:
+                loss = loss + aux_weight * jax.lax.psum(
+                    aacc, all_axes
+                ) / (L_total * M * seq_size)
             return grads, loss
 
         grads32, loss = jax.shard_map(
             kernel,
             mesh=mesh,
-            axis_names={"pipe"},
-            in_specs=(pipe_specs, P(), P(), P(), P(), P()),
+            axis_names=axis_names,
+            in_specs=(pipe_specs, P(), P()),
             out_specs=(pipe_specs, P()),
             check_vma=False,
-        )(params, xtok, jnp.asarray(fwd_rows), jnp.asarray(bwd_rows),
-          jnp.asarray(prev_fwd), jnp.asarray(prev_bwd))
+        )(params, xtok, tables)
         grads = jax.tree.map(
             lambda g_, p_: g_.astype(p_.dtype), grads32, params
         )
         return loss, grads
 
     return make_sharded_train_step(
-        None, partial(llama.init_params, cfg=cfg), p_shard, tok_shard,
+        None, init_chunked, p_shard, tok_shard,
         repl, optimizer, grads_fn=grads_fn,
     )
+
+
+def _parse_schedule(schedule: str, virtual_stages: int):
+    """(use_1f1b, v): "gpipe" | "1f1b" | "interleaved" (1F1B with
+    ``virtual_stages`` chunks per device; must be >= 2)."""
+    if schedule == "gpipe":
+        return False, 1
+    if schedule == "1f1b":
+        return True, 1
+    if schedule == "interleaved":
+        if virtual_stages < 2:
+            raise ValueError(
+                "schedule='interleaved' needs virtual_stages >= 2 "
+                f"(got {virtual_stages})"
+            )
+        return True, virtual_stages
+    raise ValueError(f"unknown pipeline schedule {schedule!r}")
 
 
 def make_pipeline_train_step(
@@ -628,6 +808,7 @@ def make_pipeline_train_step(
     attn_fn: Optional[Callable] = None,
     seq_axis: Optional[str] = None,
     schedule: str = "gpipe",
+    virtual_stages: int = 2,
 ):
     """Pipeline-parallel Llama training step over the mesh's ``pipe`` axis.
 
@@ -637,24 +818,20 @@ def make_pipeline_train_step(
     batch streams through in microbatches.  Composes with data/fsdp
     (batch) and tensor (head/ffn) axes, which remain auto-partitioned,
     and — via ``seq_axis="seq"`` — with ring sequence parallelism
-    (activations sequence-sharded through the stages).
+    (activations sequence-sharded through the stages), on every
+    schedule.
 
     ``schedule``: "gpipe" (autodiff through the fill-drain scan; live
-    activations grow with ``n_microbatches``) or "1f1b" (hand-scheduled
+    activations grow with ``n_microbatches``), "1f1b" (hand-scheduled
     one-forward-one-backward; live stage inputs bounded at the stage
-    count — see :func:`_make_1f1b_step`; dense family only, no
-    ``seq_axis``).
+    count — see :func:`_make_1f1b_step`), or "interleaved" (1F1B with
+    ``virtual_stages`` layer chunks per device — the fill/drain bubble
+    divides by the chunk count; layer leaves are stored [v, L/v, ...]).
     """
     from ..models import llama
     from ..ops.norms import rms_norm
 
-    if schedule == "1f1b":
-        if seq_axis is not None:
-            raise ValueError("schedule='1f1b' does not compose with "
-                             "seq_axis yet — use the gpipe schedule")
-        return _make_1f1b_step(cfg, mesh, n_microbatches, optimizer, attn_fn)
-    if schedule != "gpipe":
-        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    use_1f1b, v = _parse_schedule(schedule, virtual_stages)
 
     def make_block(cos, sin, attn):
         def block(x, lp):
@@ -662,6 +839,14 @@ def make_pipeline_train_step(
             return llama._layer(cfg, cos, sin, x, lp, attn, rms_norm)
         return block
 
+    if use_1f1b:
+        return _make_1f1b_step(
+            cfg, mesh, n_microbatches, optimizer, attn_fn,
+            param_specs_fn=llama.param_specs,
+            init_fn=partial(llama.init_params, cfg=cfg),
+            make_block=make_block, with_aux=False, aux_weight=0.0,
+            seq_axis=seq_axis, virtual_stages=v,
+        )
     return _make_pipelined_step(
         cfg, mesh, n_microbatches, optimizer, attn_fn,
         llama.param_specs, partial(llama.init_params, cfg=cfg),
@@ -676,13 +861,19 @@ def make_moe_pipeline_train_step(
     optimizer=None,
     attn_fn: Optional[Callable] = None,
     seq_axis: Optional[str] = None,
+    schedule: str = "gpipe",
+    virtual_stages: int = 2,
 ):
     """Pipeline-parallel MoE training step: stages over ``pipe``, experts
     over ``expert`` (the MoE all-to-all stays auto-partitioned inside the
     manual-over-pipe region), batch over data/fsdp.  The router aux loss
     accumulates per valid (layer, microbatch) tick inside the pipeline —
-    see ``_stage_kernel`` — giving the microbatched estimator of
-    ``moe.loss_fn``'s batch-mean aux.
+    see ``_stage_kernel`` (GPipe) and the 1F1B kernel's per-backward aux
+    term — giving the microbatched estimator of ``moe.loss_fn``'s
+    batch-mean aux.
+
+    ``schedule``: same three schedules as the dense builder — "gpipe",
+    "1f1b", "interleaved" (see :func:`make_pipeline_train_step`).
 
     ``seq_axis``: compose with ring sequence parallelism.  Routing
     groups become (batch row × seq shard)-local — per-expert capacity is
@@ -690,6 +881,8 @@ def make_moe_pipeline_train_step(
     standard local-group MoE formulation — and the aux estimator extends
     its mean over seq shards."""
     from ..models import moe
+
+    use_1f1b, v = _parse_schedule(schedule, virtual_stages)
 
     def make_block(cos, sin, attn):
         def block(x, lp):
@@ -699,6 +892,15 @@ def make_moe_pipeline_train_step(
             return moe._layer(cfg, cos, sin, x, lp, attn, mesh=None)
         return block
 
+    if use_1f1b:
+        return _make_1f1b_step(
+            cfg, mesh, n_microbatches, optimizer, attn_fn,
+            param_specs_fn=moe.param_specs,
+            init_fn=partial(moe.init_params, cfg=cfg),
+            make_block=make_block, with_aux=True,
+            aux_weight=cfg.router_aux_weight,
+            seq_axis=seq_axis, virtual_stages=v,
+        )
     return _make_pipelined_step(
         cfg, mesh, n_microbatches, optimizer, attn_fn,
         moe.param_specs, partial(moe.init_params, cfg=cfg),

@@ -250,6 +250,8 @@ def cmd_train(args) -> int:
                 cfg, mesh, n_microbatches=args.microbatches,
                 optimizer=optimizer,
                 seq_axis="seq" if args.seq > 1 else None,
+                schedule=args.pp_schedule,
+                virtual_stages=args.virtual_stages,
             )
         else:
             from .models.moe import make_train_step
@@ -271,6 +273,7 @@ def cmd_train(args) -> int:
                 optimizer=optimizer,
                 seq_axis="seq" if args.seq > 1 else None,
                 schedule=args.pp_schedule,
+                virtual_stages=args.virtual_stages,
             )
         else:
             step, init_all, _ = make_train_step(
@@ -508,9 +511,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "synthetic fixed batch")
     t.add_argument("--microbatches", type=int, default=4)
     t.add_argument("--pp-schedule", default="gpipe",
-                   choices=["gpipe", "1f1b"],
-                   help="pipeline schedule (dense family; 1f1b bounds "
-                        "live activations at the stage count)")
+                   choices=["gpipe", "1f1b", "interleaved"],
+                   help="pipeline schedule (both families; 1f1b bounds "
+                        "live activations at the virtual stage count, "
+                        "interleaved also divides the bubble by "
+                        "--virtual-stages)")
+    t.add_argument("--virtual-stages", type=int, default=2,
+                   help="layer chunks per device for "
+                        "--pp-schedule=interleaved")
     t.add_argument("--optimizer", choices=["adamw", "adam8bit"],
                    default="adamw",
                    help="adam8bit: int8/f8 moment storage, half the "
