@@ -43,9 +43,22 @@ test-e2e: ## End-to-end: operator + fake cluster + agent against fake host
 fuzz: ## Randomized CR fuzz against the admission+reconcile pipeline
 	$(PYTHON) -m pytest tests/fuzz -x -q -m "not slow"
 
+.PHONY: test-cluster
+test-cluster: ## kind-cluster e2e + live fuzz (needs kind/docker/kubectl; skips cleanly without — ref test/e2e + test/fuzz)
+	$(PYTHON) -m pytest tests/cluster -x -q
+
 .PHONY: bench
-bench: ## Benchmark (tokens/sec/chip + ICI all-reduce when multi-chip)
+bench: ## Benchmark (tokens/sec/chip + decode + ICI all-reduce when multi-chip)
 	$(PYTHON) bench.py
+
+.PHONY: tpu-probe
+tpu-probe: ## Cheap tunnel liveness check (rc 0 = chip visible; see docs/perf.md "Bench first")
+	timeout 240 $(PYTHON) -c "import jax; print(jax.devices())"
+
+.PHONY: perf-session
+perf-session: ## BENCH-FIRST discipline: probe, then run the full hardware measurement session the moment the tunnel is up (tools/perf_session.py; appends perf_session.jsonl)
+	$(MAKE) tpu-probe
+	$(PYTHON) tools/perf_session.py
 
 .PHONY: dryrun
 dryrun: ## Multi-chip sharding dry-run on a virtual 8-device CPU mesh

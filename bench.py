@@ -150,6 +150,58 @@ def train_mem_estimate(cfg, batch: int, seq: int, opt8: bool = False) -> int:
     return param_bytes + logits + resid
 
 
+def measure_decode(cfg, batches, prompt_len, new_tokens, n, mesh, jax, jnp):
+    """Decode rung (VERDICT r4 #7): tokens/sec of the jitted
+    prefill+decode loop (models/generate) over a batch sweep, so the
+    effective-length decode and flash-prefill levers are tracked
+    round-over-round like train throughput.  Returns {best, rows};
+    tokens/sec counts NEW tokens only, prefill amortized in."""
+    import gc
+    import time
+
+    from tpu_network_operator.models.generate import make_generate_fn
+    from tpu_network_operator.models.llama import init_params, param_shardings
+
+    rows = []
+    for batch in batches:
+        gen = make_generate_fn(
+            cfg, new_tokens, mesh=mesh if n > 1 else None
+        )
+        if n > 1:
+            params = jax.jit(
+                lambda k: init_params(k, cfg),
+                out_shardings=param_shardings(cfg, mesh),
+            )(jax.random.key(0))
+        else:
+            params = jax.jit(lambda k: init_params(k, cfg))(
+                jax.random.key(0)
+            )
+        prompt = jax.random.randint(
+            jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size,
+            jnp.int32,
+        )
+        out = gen(params, prompt)           # compile + warm
+        jax.block_until_ready(out)
+        iters = 3
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = gen(params, prompt)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        tps = batch * new_tokens / dt
+        rows.append({
+            "batch": batch,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "tokens_per_sec": round(tps, 1),
+            "tokens_per_sec_per_chip": round(tps / max(1, n), 1),
+        })
+        del params, gen, out
+        gc.collect()
+    best = max(rows, key=lambda r: r["tokens_per_sec"])
+    return {"config": "decode", "best": best, "rows": rows}
+
+
 def train_flops_per_token(cfg, seq: int) -> float:
     """Model FLOPs per trained token: 6x matmul params (fwd 2 + bwd 4;
     the embedding gather is not a matmul) + causal attention scores
@@ -352,6 +404,24 @@ def main() -> None:
         results = sweep(mesh, axis=axis, ops=["all_reduce"],
                         sizes_mb=[16.0, 64.0, 256.0], iters=5)
         extras["ici_allreduce_busbw_gbps"] = round(peak_busbw(results), 2)
+
+    # decode rung (VERDICT r4 #7): track inference tokens/sec alongside
+    # train throughput, round-over-round.  Best-effort — a decode
+    # failure must not discard the train measurement.
+    base_name = rows[0]["config"].split("+")[0]
+    dec_cfg = next(
+        (c for (cand_name, c, _, _, _) in ladder if cand_name == base_name),
+        None,
+    )
+    if dec_cfg is not None:
+        try:
+            extras["decode"] = measure_decode(
+                dec_cfg, batches=[8, 32], prompt_len=128, new_tokens=512,
+                n=n, mesh=mesh, jax=jax, jnp=jnp,
+            )
+            log(f"decode best: {extras['decode']['best']}")
+        except Exception as e:   # noqa: BLE001 — keep the train rows
+            log(f"decode rung failed ({type(e).__name__}: {str(e)[:120]})")
 
     head = rows[0]
     print(json.dumps({

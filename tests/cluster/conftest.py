@@ -1,0 +1,128 @@
+"""Binary-gated kind-cluster tier (ref ``test/e2e/e2e_test.go:32-122``).
+
+Everything here needs ``kind`` + ``docker`` + ``kubectl`` on PATH (CI's
+ubuntu runners; skipped cleanly elsewhere — the ``tests/test_chart.py``
+gating pattern).  One kind cluster and one deployed operator per
+session; set ``TPUNET_CLUSTER_KUBECONFIG`` to reuse a pre-existing
+cluster (then no create/teardown happens, matching how the reference
+fuzz tier targets whatever ``KUBECONFIG`` points at).
+"""
+
+import os
+import shutil
+import subprocess
+import time
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+CLUSTER = "tpunet-e2e"
+NAMESPACE = "tpunet-system"
+OPERATOR_IMG = "ghcr.io/tpunet/tpu-network-operator:latest"
+# pinned cert-manager release, the reference's install pattern
+# (``test/utils/utils.go:43-107`` applies the upstream release YAML)
+CERT_MANAGER_URL = (
+    "https://github.com/cert-manager/cert-manager/releases/download/"
+    "v1.14.4/cert-manager.yaml"
+)
+
+
+def _run(cmd, timeout=600, check=True, env=None, cwd=None):
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout,
+        env=env, cwd=cwd or ROOT,
+    )
+    if check and proc.returncode != 0:
+        raise AssertionError(
+            f"{' '.join(cmd)} failed rc={proc.returncode}:\n"
+            f"{proc.stdout}\n{proc.stderr}"
+        )
+    return proc
+
+
+def kubectl(kubeconfig, *args, timeout=120, check=True):
+    return _run(
+        ["kubectl", f"--kubeconfig={kubeconfig}", *args],
+        timeout=timeout, check=check,
+    )
+
+
+def wait_for(predicate, timeout, what, interval=3.0):
+    """Poll ``predicate`` until truthy (returning its value) or fail —
+    the reference's wait loop (``e2e_test.go:85-118``)."""
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        last = predicate()
+        if last:
+            return last
+        time.sleep(interval)
+    raise AssertionError(f"timed out after {timeout}s waiting for {what}; "
+                         f"last={last!r}")
+
+
+@pytest.fixture(scope="session")
+def kind_kubeconfig(tmp_path_factory):
+    pre = os.environ.get("TPUNET_CLUSTER_KUBECONFIG")
+    if pre:
+        yield pre
+        return
+    missing = [t for t in ("kind", "docker", "kubectl")
+               if shutil.which(t) is None]
+    if missing:
+        pytest.skip(f"cluster tier needs {missing} on PATH")
+    kc = str(tmp_path_factory.mktemp("kind") / "kubeconfig")
+    _run(["kind", "create", "cluster", "--name", CLUSTER,
+          "--kubeconfig", kc, "--wait", "120s"], timeout=600)
+    try:
+        yield kc
+    finally:
+        _run(["kind", "delete", "cluster", "--name", CLUSTER],
+             check=False, timeout=300)
+
+
+@pytest.fixture(scope="session")
+def deployed_operator(kind_kubeconfig):
+    """Image build + kind load + cert-manager + ``make deploy`` + wait
+    for exactly one Running controller-manager pod (the reference's e2e
+    body, ``e2e_test.go:32-122``), yielding the kubeconfig path.
+
+    With ``TPUNET_CLUSTER_KUBECONFIG`` (pre-existing, possibly non-kind
+    cluster) the build/load steps are skipped — the operator image must
+    already be reachable from that cluster; only deploy+wait runs."""
+    kc = kind_kubeconfig
+    if not os.environ.get("TPUNET_CLUSTER_KUBECONFIG"):
+        if shutil.which("docker") is None:
+            pytest.skip(
+                "cluster tier needs docker to build the operator image"
+            )
+        _run(["docker", "build", "-f", "build/Dockerfile.operator",
+              "-t", OPERATOR_IMG, "."], timeout=1800)
+        _run(["kind", "load", "docker-image", OPERATOR_IMG,
+              "--name", CLUSTER], timeout=600)
+
+    kubectl(kc, "apply", "-f", CERT_MANAGER_URL, timeout=300)
+    kubectl(kc, "-n", "cert-manager", "wait", "--for=condition=Available",
+            "deployment", "--all", "--timeout=300s", timeout=360)
+
+    kubectl(kc, "apply", "-k", "deploy/default", timeout=300)
+    # the loaded image must not be re-pulled from the registry
+    kubectl(kc, "-n", NAMESPACE, "patch", "deployment",
+            "tpunet-controller-manager", "--type=json", "-p",
+            '[{"op":"add","path":"/spec/template/spec/containers/0/'
+            'imagePullPolicy","value":"IfNotPresent"}]')
+
+    def one_running_manager():
+        proc = kubectl(
+            kc, "-n", NAMESPACE, "get", "pods", "-l",
+            "app.kubernetes.io/name=tpu-network-operator",
+            "-o", "jsonpath={.items[*].status.phase}", check=False,
+        )
+        phases = proc.stdout.split()
+        return phases == ["Running"]
+
+    wait_for(one_running_manager, 300, "one Running controller-manager pod")
+    yield kc
+    kubectl(kc, "delete", "-k", "deploy/default", check=False, timeout=300)

@@ -1,0 +1,47 @@
+"""Golden-transcript replay (VERDICT r4 #5): the wire server must match
+the committed apiserver transcript — the offline leg of the two-sided
+pin (tools/record_conformance.py has the full scheme; CI's conformance
+job re-records the same script against a REAL kube-apiserver and
+--checks it against this fixture, so the fixture cannot drift from
+reality while this test keeps ``kube/wire.py`` from drifting from the
+fixture)."""
+
+import json
+import os
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "apiserver_transcript.json")
+
+
+def test_wire_server_matches_committed_transcript():
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    from tests.apiserver_harness import wire_endpoint
+    from tools.record_conformance import diff_transcripts, run_script
+
+    with open(FIXTURE) as f:
+        want = json.load(f)
+    assert want["steps"], "empty fixture"
+    ep, srv = wire_endpoint()
+    try:
+        got = run_script(ep)
+    finally:
+        srv.stop()
+    problems = diff_transcripts(got, want["steps"])
+    assert not problems, "wire server diverged from the committed " \
+        "transcript:\n" + "\n".join(problems)
+
+
+def test_transcript_covers_the_contract_surface():
+    """The fixture must keep covering the operations the framework
+    depends on — a shrunken re-record cannot silently weaken the pin."""
+    with open(FIXTURE) as f:
+        steps = {s["name"] for s in json.load(f)["steps"]}
+    assert {
+        "create", "create-duplicate", "get-missing", "get", "list",
+        "list-selected", "list-limited", "list-bad-continue",
+        "apply-create", "apply-merge", "watch-no-rv", "delete",
+        "get-after-delete",
+    } <= steps

@@ -1,0 +1,275 @@
+"""Record / check golden apiserver transcripts (VERDICT r4 #5).
+
+A fixed, deterministic operation script runs against an apiserver
+backend — the in-repo wire server or a REAL ``kube-apiserver``+``etcd``
+(envtest binaries) — and every exchange (status code + normalized
+response body, watch event sequences) is recorded.
+
+The committed fixture ``tests/apiserver_transcript.json`` is the wire
+CONTRACT, pinned from both sides:
+
+* locally (no binaries needed), ``tests/test_apiserver_transcript.py``
+  re-runs the script against the wire server and asserts every exchange
+  matches the fixture — so ``kube/wire.py`` cannot drift from the
+  recorded contract;
+* in CI, the conformance job re-records the script against the real
+  kube-apiserver and ``--check``s it against the committed fixture — so
+  the fixture cannot drift from reality.  A divergence on either side
+  fails its leg, which is exactly the point.
+
+Server-managed noise (uids, resourceVersions, timestamps,
+managedFields, human-phrased Status messages, opaque continue tokens)
+is normalized away before recording; what remains — codes, reasons,
+kinds, object spec/identity, event types and order — is the portable
+apiserver contract this framework relies on (ref
+``internal/controller/suite_test.go:61-102`` pins the same surface by
+booting envtest).
+
+Usage:
+    python tools/record_conformance.py --backend wire --out tests/apiserver_transcript.json
+    python tools/record_conformance.py --backend real --check tests/apiserver_transcript.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NS = "default"
+LEASES = f"/apis/coordination.k8s.io/v1/namespaces/{NS}/leases"
+
+_DROP_KEYS = {
+    # server-managed identity/bookkeeping
+    "uid", "resourceVersion", "creationTimestamp", "managedFields",
+    "generation", "selfLink", "deletionTimestamp",
+    # human-phrased (wording differs between servers); the typed
+    # reason/code carry the contract
+    "message", "details",
+    # remainingItemCount is optional per the kube API contract (the
+    # real server omits it in several selector/consistency modes)
+    "remainingItemCount",
+}
+
+
+def normalize(obj):
+    """Strip server-managed noise; opaque continue tokens reduce to a
+    presence marker."""
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in sorted(obj.items()):
+            if k in _DROP_KEYS:
+                continue
+            if k == "continue":
+                out[k] = "<token>" if v else ""
+                continue
+            out[k] = normalize(v)
+        return out
+    if isinstance(obj, list):
+        return [normalize(v) for v in obj]
+    return obj
+
+
+def _lease(name, holder="node-1", labels=None):
+    return {
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": {
+            "name": name,
+            "namespace": NS,
+            **({"labels": labels} if labels else {}),
+        },
+        "spec": {"holderIdentity": holder},
+    }
+
+
+def _normalize_list(body):
+    """List bodies additionally get their items sorted by name (etcd
+    key order vs insertion order must not matter) and filtered to this
+    script's objects (a real cluster may hold unrelated leases)."""
+    n = normalize(body)
+    if isinstance(n, dict) and isinstance(n.get("items"), list):
+        items = [
+            i for i in n["items"]
+            if str(i.get("metadata", {}).get("name", "")).startswith("tr-")
+        ]
+        n["items"] = sorted(
+            items, key=lambda i: i.get("metadata", {}).get("name", "")
+        )
+    return n
+
+
+def run_script(ep):
+    """Execute the fixed op script against ``ep``; return the transcript
+    (a list of {name, expect} steps)."""
+    steps = []
+
+    def rec(name, code, body, list_body=False):
+        steps.append({
+            "name": name,
+            "code": code,
+            "body": _normalize_list(body) if list_body else normalize(body),
+        })
+
+    code, body = ep.request("POST", LEASES, _lease("tr-a"))
+    rec("create", code, body)
+    code, body = ep.request("POST", LEASES, _lease("tr-a"))
+    rec("create-duplicate", code, body)
+    code, body = ep.request("GET", f"{LEASES}/tr-absent")
+    rec("get-missing", code, body)
+    code, body = ep.request("GET", f"{LEASES}/tr-a")
+    rec("get", code, body)
+    ep.request("POST", LEASES, _lease("tr-b", labels={"g": "x"}))
+    code, body = ep.request("GET", LEASES)
+    rec("list", code, body, list_body=True)
+    code, body = ep.request("GET", f"{LEASES}?labelSelector=g%3Dx")
+    rec("list-selected", code, body, list_body=True)
+    code, body = ep.request("GET", f"{LEASES}?limit=1")
+    # chunked first page: exactly one item + a continue marker
+    body = _normalize_list(body)
+    body["items"] = [f"<{len(body.get('items', []))} item(s)>"]
+    rec("list-limited", code, body)
+    code, body = ep.request(
+        "GET", f"{LEASES}?limit=1&continue=%21%21notatoken%21%21"
+    )
+    rec("list-bad-continue", code, body)
+
+    path = f"{LEASES}/tr-ssa?fieldManager=tpunet&force=true"
+    code, body = ep.request(
+        "PATCH", path, _lease("tr-ssa", holder="w0"),
+        content_type="application/apply-patch+yaml",
+    )
+    rec("apply-create", code, body)
+    code, body = ep.request(
+        "PATCH", path, _lease("tr-ssa", holder="w1"),
+        content_type="application/apply-patch+yaml",
+    )
+    rec("apply-merge", code, body)
+
+    # watch: open without resourceVersion (initial-state replay), then
+    # mutate and collect the event sequence for this script's objects
+    events = ep.stream(f"{LEASES}?watch=true", timeout=15)
+    ep.request("POST", LEASES, _lease("tr-w"))
+    ep.request("DELETE", f"{LEASES}/tr-w")
+    seen = []
+    initial_needed = {"tr-a", "tr-b", "tr-ssa"}
+    for ev in events:
+        name = str(ev.get("object", {}).get("metadata", {}).get("name", ""))
+        if not name.startswith("tr-"):
+            continue
+        if name in initial_needed:
+            initial_needed.discard(name)
+            seen.append({"type": ev["type"], "name": name, "phase": "initial"})
+            continue
+        if name == "tr-w":
+            seen.append({"type": ev["type"], "name": name, "phase": "live"})
+            if ev["type"] == "DELETED":
+                break
+    # initial replay order is unspecified — sort that prefix
+    initial = sorted(
+        (e for e in seen if e["phase"] == "initial"),
+        key=lambda e: e["name"],
+    )
+    live = [e for e in seen if e["phase"] == "live"]
+    steps.append({"name": "watch-no-rv", "code": 200,
+                  "body": {"initial": initial, "live": live}})
+
+    code, body = ep.request("DELETE", f"{LEASES}/tr-a")
+    rec("delete", code, {"kind": body.get("kind", "")}
+        if isinstance(body, dict) else body)
+    code, body = ep.request("GET", f"{LEASES}/tr-a")
+    rec("get-after-delete", code, body)
+    return steps
+
+
+def diff_transcripts(got, want):
+    """Human-readable list of step mismatches (empty = match)."""
+    problems = []
+    by_name = {s["name"]: s for s in want}
+    for step in got:
+        ref = by_name.get(step["name"])
+        if ref is None:
+            problems.append(f"{step['name']}: not in committed fixture")
+            continue
+        if step["code"] != ref["code"]:
+            problems.append(
+                f"{step['name']}: code {step['code']} != {ref['code']}"
+            )
+        if step["body"] != ref["body"]:
+            problems.append(
+                f"{step['name']}: body mismatch\n"
+                f"  got:  {json.dumps(step['body'], sort_keys=True)[:400]}\n"
+                f"  want: {json.dumps(ref['body'], sort_keys=True)[:400]}"
+            )
+    missing = set(by_name) - {s["name"] for s in got}
+    if missing:
+        problems.append(f"steps missing from recording: {sorted(missing)}")
+    return problems
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--backend", choices=["wire", "real"], default="wire")
+    ap.add_argument("--out", help="write the recorded transcript here")
+    ap.add_argument("--check",
+                    help="diff the recording against this committed fixture; "
+                         "exit 1 on divergence")
+    args = ap.parse_args()
+    if not args.out and not args.check:
+        ap.error("need --out and/or --check")
+
+    from tests.apiserver_harness import (
+        envtest_bin_dir,
+        real_endpoint,
+        wire_endpoint,
+    )
+
+    srv = None
+    if args.backend == "wire":
+        ep, srv = wire_endpoint()
+    else:
+        if not envtest_bin_dir():
+            print("no envtest binaries (KUBEBUILDER_ASSETS / "
+                  "TPUNET_ENVTEST_BIN_DIR); cannot record from real")
+            return 2
+        import tempfile
+
+        ep = real_endpoint(tempfile.mkdtemp(prefix="tpunet-record-"))
+    try:
+        steps = run_script(ep)
+    finally:
+        if srv is not None:
+            srv.stop()
+        else:
+            ep.close()
+
+    doc = {
+        "provenance": args.backend,
+        "script": "tools/record_conformance.py",
+        "steps": steps,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {len(steps)} steps to {args.out}")
+    if args.check:
+        with open(args.check) as f:
+            want = json.load(f)
+        problems = diff_transcripts(steps, want["steps"])
+        if problems:
+            print(f"TRANSCRIPT DIVERGENCE ({args.backend} backend vs "
+                  f"{args.check}):")
+            for p in problems:
+                print(f"- {p}")
+            return 1
+        print(f"{args.backend} backend matches {args.check} "
+              f"({len(steps)} steps)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -96,6 +96,68 @@ class ApiClient:
             f"https://{host}:{port}", token=token, ca_file=f"{SA_DIR}/ca.crt"
         )
 
+    @classmethod
+    def from_kubeconfig(
+        cls, path: Optional[str] = None, context: Optional[str] = None
+    ) -> "ApiClient":
+        """clientcmd analog: server/CA/credentials from a kubeconfig
+        (``path`` > $KUBECONFIG > ~/.kube/config).  Supports bearer
+        tokens and client certificates (what kind/minikube emit);
+        base64 ``*-data`` fields are materialized to temp files for the
+        ssl module.  This is what the live-cluster tiers (kind e2e,
+        KUBECONFIG fuzz — ref ``test/fuzz/fuzz_test.go:32-89``) build
+        their client from."""
+        import atexit
+        import base64
+        import os
+        import tempfile
+
+        import yaml
+
+        path = path or os.environ.get("KUBECONFIG") or os.path.expanduser(
+            "~/.kube/config"
+        )
+        with open(path) as f:
+            kc = yaml.safe_load(f)
+        ctx_name = context or kc.get("current-context", "")
+        by_name = lambda sect: {e["name"]: e[sect[:-1]]   # noqa: E731
+                                for e in kc.get(sect, [])}
+        ctx = by_name("contexts").get(ctx_name)
+        if ctx is None:
+            raise kerr.ApiError(f"kubeconfig context {ctx_name!r} not found")
+        cluster = by_name("clusters")[ctx["cluster"]]
+        user = by_name("users").get(ctx.get("user", ""), {})
+
+        def matfile(inline_key: str, file_key: str, src: Dict[str, Any]):
+            if src.get(file_key):
+                return src[file_key]
+            data = src.get(inline_key)
+            if not data:
+                return None
+            # 0600 by tempfile default (client keys); removed at exit
+            # so repeated runs do not accumulate key material on disk
+            tf = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
+            tf.write(base64.b64decode(data))
+            tf.close()
+            atexit.register(
+                lambda p=tf.name: os.path.exists(p) and os.unlink(p)
+            )
+            return tf.name
+
+        ca = matfile("certificate-authority-data", "certificate-authority",
+                     cluster)
+        self = cls(
+            cluster["server"],
+            token=user.get("token"),
+            ca_file=ca,
+            insecure=bool(cluster.get("insecure-skip-tls-verify")),
+        )
+        cert = matfile("client-certificate-data", "client-certificate", user)
+        key = matfile("client-key-data", "client-key", user)
+        if cert and key:
+            self._ctx.load_cert_chain(cert, key)
+        return self
+
     # -- HTTP plumbing --------------------------------------------------------
 
     def _url(

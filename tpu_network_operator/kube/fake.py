@@ -229,19 +229,17 @@ class FakeCluster:
         return self.update(obj, subresource="status")
 
     def apply(
-        self, obj: Dict[str, Any], field_manager: str = "tpunet"
-    ) -> Dict[str, Any]:
+        self, obj: Dict[str, Any], field_manager: str = "tpunet",
+        return_created: bool = False,
+    ) -> Any:
         """Server-side apply analog (mirrors ApiClient.apply and the wire
         server's PATCH handler): create if absent, deep-merge if present
-        (dicts merge recursively, lists/scalars replace)."""
-        m = obj.get("metadata", {})
-        try:
-            current = self.get(
-                obj["apiVersion"], obj["kind"], m.get("name", ""),
-                m.get("namespace", ""),
-            )
-        except NotFoundError:
-            return self.create(obj)
+        (dicts merge recursively, lists/scalars replace).
+
+        ``return_created=True`` → (obj, created) with the created-ness
+        decided ATOMICALLY against concurrent applies (create/update
+        races retry, exactly one caller observes created=True) — the
+        wire server keys its 201-vs-200 answer off this."""
 
         def merge(base, patch):
             out = dict(base)
@@ -252,7 +250,24 @@ class FakeCluster:
                     out[k] = v
             return out
 
-        return self.update(merge(current, obj))
+        m = obj.get("metadata", {})
+        while True:
+            try:
+                current = self.get(
+                    obj["apiVersion"], obj["kind"], m.get("name", ""),
+                    m.get("namespace", ""),
+                )
+            except NotFoundError:
+                try:
+                    out = self.create(obj)
+                    return (out, True) if return_created else out
+                except AlreadyExistsError:
+                    continue   # lost the create race: merge instead
+            try:
+                out = self.update(merge(current, obj))
+            except ConflictError:
+                continue       # concurrent writer bumped the rv: re-read
+            return (out, False) if return_created else out
 
     def delete(
         self, api_version: str, kind: str, name: str, namespace: str = ""

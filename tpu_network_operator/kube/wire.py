@@ -551,7 +551,14 @@ class WireApiServer:
                 if ns:
                     patch["metadata"]["namespace"] = ns
                 try:
-                    self._reply_obj(outer.cluster.apply(patch))
+                    # real kube answers 201 Created when the apply
+                    # CREATED the object, 200 on a merge; created-ness
+                    # is decided atomically inside the store (concurrent
+                    # applies race-retry there, one winner)
+                    obj, created = outer.cluster.apply(
+                        patch, return_created=True
+                    )
+                    self._reply_obj(obj, code=201 if created else 200)
                 except Exception as e:   # noqa: BLE001
                     self._reply_err(e)
 
