@@ -162,20 +162,17 @@ def measure_decode(cfg, batches, prompt_len, new_tokens, n, mesh, jax, jnp):
     from tpu_network_operator.models.generate import make_generate_fn
     from tpu_network_operator.models.llama import init_params, param_shardings
 
+    # params/gen depend only on cfg — init once, retrace per batch shape
+    gen = make_generate_fn(cfg, new_tokens, mesh=mesh if n > 1 else None)
+    if n > 1:
+        params = jax.jit(
+            lambda k: init_params(k, cfg),
+            out_shardings=param_shardings(cfg, mesh),
+        )(jax.random.key(0))
+    else:
+        params = jax.jit(lambda k: init_params(k, cfg))(jax.random.key(0))
     rows = []
     for batch in batches:
-        gen = make_generate_fn(
-            cfg, new_tokens, mesh=mesh if n > 1 else None
-        )
-        if n > 1:
-            params = jax.jit(
-                lambda k: init_params(k, cfg),
-                out_shardings=param_shardings(cfg, mesh),
-            )(jax.random.key(0))
-        else:
-            params = jax.jit(lambda k: init_params(k, cfg))(
-                jax.random.key(0)
-            )
         prompt = jax.random.randint(
             jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size,
             jnp.int32,
@@ -196,8 +193,10 @@ def measure_decode(cfg, batches, prompt_len, new_tokens, n, mesh, jax, jnp):
             "tokens_per_sec": round(tps, 1),
             "tokens_per_sec_per_chip": round(tps / max(1, n), 1),
         })
-        del params, gen, out
+        del out
         gc.collect()
+    del params, gen
+    gc.collect()
     best = max(rows, key=lambda r: r["tokens_per_sec"])
     return {"config": "decode", "best": best, "rows": rows}
 

@@ -87,6 +87,11 @@ def main() -> int:
         for flag in ("1", "0"):
             run_phase(out, f"flash-prefill-{flag}", long_gen,
                       env={"TPUNET_DECODE_FLASH": flag})
+        # 5. remat/offload policy search at the 1B geometry — the
+        # docs/perf.md remat x1.3 term (VERDICT r4 #8)
+        run_phase(out, "remat-search",
+                  [py, "tools/remat_search.py", "--config", "llama3-1b"],
+                  timeout=7200)
     print(f"done -> {args.out}")
     return 0
 

@@ -88,12 +88,15 @@ def _lease(name, holder="node-1", labels=None):
 
 def _normalize_list(body):
     """List bodies additionally get their items sorted by name (etcd
-    key order vs insertion order must not matter) and filtered to this
-    script's objects (a real cluster may hold unrelated leases)."""
+    key order vs insertion order must not matter), filtered to this
+    script's objects (a real cluster may hold unrelated leases), and
+    stripped of per-item TypeMeta — a real apiserver omits
+    apiVersion/kind on list items, the wire server stores them."""
     n = normalize(body)
     if isinstance(n, dict) and isinstance(n.get("items"), list):
         items = [
-            i for i in n["items"]
+            {k: v for k, v in i.items() if k not in ("apiVersion", "kind")}
+            for i in n["items"]
             if str(i.get("metadata", {}).get("name", "")).startswith("tr-")
         ]
         n["items"] = sorted(

@@ -1,0 +1,121 @@
+"""Remat/offload policy search at the bench geometry (VERDICT r4 #8).
+
+docs/perf.md's decomposition blames the remat x1.3 recompute term for
+the gap between the measured ~45% MFU and the 59% forward ceiling at
+the 1B rung.  This sweeps the policy axis of that trade on REAL
+hardware: every memory-fitting combination of
+
+* remat_policy: dots / ffn / ffn_offload (saved FFN set in pinned host
+  memory — near-zero HBM AND near-zero recompute, paid in host-link
+  bandwidth) / ffn_lite / full,
+* batch size (bigger batch amortizes the fixed per-step work but eats
+  the HBM a cheaper policy frees),
+
+on the chosen config (default llama3-1b, chunked xent, fused 8-bit
+Adam), reusing bench.py's measurement loop so numbers are directly
+comparable to the ladder.  Results append to ``remat_search.jsonl``;
+the best row prints last as one JSON line (bench-style).
+
+Usage (on a machine with a live TPU):
+    python tools/remat_search.py [--config llama3-1b] [--batches 4,8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+POLICIES = ("dots", "ffn", "ffn_offload", "ffn_lite", "full")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="llama3-1b")
+    ap.add_argument("--batches", default="4,8")
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--xent-chunk", type=int, default=512)
+    ap.add_argument("--out", default="remat_search.jsonl")
+    args = ap.parse_args()
+
+    import bench
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_network_operator.models import LlamaConfig, make_train_step
+    from tpu_network_operator.parallel import make_mesh, plan_axes
+
+    devices = bench.init_devices(jax.devices)
+    n = len(devices)
+    kind = getattr(devices[0], "device_kind", "cpu")
+    hbm = bench.hbm_bytes(devices[0]) * n
+    mesh = make_mesh(plan_axes(n))
+
+    presets = {
+        "tiny": LlamaConfig.tiny,          # CI smoke only
+        "llama3-150m": LlamaConfig.llama3_150m,
+        "llama3-1b": LlamaConfig.llama3_1b,
+        "llama3-3b": LlamaConfig.llama3_3b,
+        "llama3-8b": LlamaConfig.llama3_8b,
+    }
+    base = presets[args.config]()
+    if args.config == "tiny":
+        base = dataclasses.replace(base, remat=True)
+
+    rows = []
+    with open(args.out, "a") as out:
+        for policy in POLICIES:
+            for batch in (int(b) for b in args.batches.split(",")):
+                cfg = dataclasses.replace(
+                    base, xent_chunk=args.xent_chunk, remat_policy=policy,
+                )
+                name = f"{args.config}/{policy}/b{batch}"
+                # ffn_offload's saved set leaves HBM — estimate as
+                # "full" for the fit filter (host side is plentiful)
+                est_cfg = (
+                    dataclasses.replace(cfg, remat_policy="full")
+                    if policy == "ffn_offload" else cfg
+                )
+                est = bench.train_mem_estimate(
+                    est_cfg, batch * max(1, n), args.seq, opt8=True
+                )
+                if est > 0.95 * hbm:
+                    print(f"skip {name}: est {est / 2**30:.1f} GiB "
+                          f"> budget", flush=True)
+                    continue
+                try:
+                    row = bench.measure(
+                        name, cfg, batch * max(1, n), args.seq, n, kind,
+                        make_train_step, mesh, jax, jnp, opt="adam8",
+                    )
+                except Exception as e:   # noqa: BLE001 — OOM -> next
+                    print(f"fail {name}: {type(e).__name__}: "
+                          f"{str(e)[:120]}", flush=True)
+                    continue
+                rows.append(row)
+                out.write(json.dumps(row) + "\n")
+                out.flush()
+                print(f"done {name}: "
+                      f"{row['tokens_per_sec_per_chip']} tok/s/chip "
+                      f"(mfu {row['mfu']})", flush=True)
+    if not rows:
+        raise SystemExit("no policy/batch combination ran to completion")
+    rows.sort(key=lambda r: -r["tokens_per_sec_per_chip"])
+    best = rows[0]
+    print(json.dumps({
+        "metric": f"{best['config']} remat-search best",
+        "value": best["tokens_per_sec_per_chip"],
+        "unit": "tokens/sec/chip",
+        "mfu": best["mfu"],
+        "rows": rows,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -213,6 +213,10 @@ class ApiClient:
             if ("admission webhook" in detail
                     and "denied the request" in detail):
                 raise kerr.AdmissionDeniedError(detail) from None
+            if e.code == 422:
+                # CRD structural-schema rejection (real apiserver only —
+                # the wire server has no OpenAPI validator)
+                raise kerr.InvalidError(detail) from None
             raise kerr.ApiError(f"{e.code}: {detail}") from None
 
     # -- FakeCluster-compatible interface -------------------------------------

@@ -47,6 +47,16 @@ class AdmissionDeniedError(ApiError):
     reason = "AdmissionDenied"
 
 
+class InvalidError(ApiError):
+    """The apiserver's structural (CRD OpenAPI) schema rejected the
+    object — kube's 422 Unprocessable Entity / reason Invalid.  A
+    different admission layer than the webhook, same meaning for
+    callers: the object was refused, not the transport."""
+
+    code = 422
+    reason = "Invalid"
+
+
 def is_not_found(err: Exception) -> bool:
     return isinstance(err, NotFoundError)
 

@@ -572,8 +572,12 @@ class WireApiServer:
                     return
                 av, kind, ns, name, _sub = route
                 try:
+                    # real kube returns the DELETED OBJECT on immediate
+                    # deletion (what every kind here has — no
+                    # finalizers); a Status success is its async shape
+                    obj = outer.cluster.get(av, kind, name, ns)
                     outer.cluster.delete(av, kind, name, ns)
-                    self._reply_obj({"kind": "Status", "status": "Success"})
+                    self._reply_obj(obj)
                 except Exception as e:   # noqa: BLE001
                     self._reply_err(e)
 

@@ -18,13 +18,20 @@ import jax.numpy as jnp
 def remat_policy(cfg):
     """Checkpoint policy from a model config's ``remat_policy`` field.
 
-    "dots"     — save every matmul output (fastest, most HBM);
-    "ffn"      — save the post-attention residual + the two SwiGLU
-                 intermediates (the FFN matmuls are ~70% of layer FLOPs,
-                 so this recovers most of "dots" at ~40% of its bytes);
-    "ffn_lite" — residual + gate only (half the FFN bytes, the up
-                 projection is recomputed);
-    "full"     — save nothing (minimum HBM, max recompute).
+    "dots"        — save every matmul output (fastest, most HBM);
+    "ffn"         — save the post-attention residual + the two SwiGLU
+                    intermediates (the FFN matmuls are ~70% of layer
+                    FLOPs, so this recovers most of "dots" at ~40% of
+                    its bytes);
+    "ffn_lite"    — residual + gate only (half the FFN bytes, the up
+                    projection is recomputed);
+    "ffn_offload" — the "ffn" set, but offloaded to pinned HOST memory
+                    instead of kept in HBM: near-zero HBM cost AND
+                    near-zero recompute, paid in host-link bandwidth
+                    (the docs/perf.md remat x1.3 term is the target;
+                    measure with tools/remat_search.py — the 1B rung's
+                    saved-FFN stream is ~100 MB/step each way);
+    "full"        — save nothing (minimum HBM, max recompute).
 
     The named intermediates are tagged in ``llama._layer``.
     """
@@ -38,6 +45,21 @@ def remat_policy(cfg):
     if policy == "ffn_lite":
         return jax.checkpoint_policies.save_only_these_names(
             "resid_mid", "ffn_gate"
+        )
+    if policy == "ffn_offload":
+        if jax.default_backend() != "tpu":
+            # the device-placement custom calls behind host offload are
+            # unimplemented off-TPU; tests/dryrun get the same SAVE SET
+            # in device memory (identical numerics, different residency)
+            return jax.checkpoint_policies.save_only_these_names(
+                "resid_mid", "ffn_gate", "ffn_up"
+            )
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=[
+                "resid_mid", "ffn_gate", "ffn_up"
+            ],
+            offload_src="device", offload_dst="pinned_host",
         )
     return jax.checkpoint_policies.nothing_saveable
 
