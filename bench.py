@@ -137,14 +137,26 @@ def train_mem_estimate(cfg, batch: int, seq: int, opt8: bool = False) -> int:
     logit_seq = cfg.xent_chunk if cfg.xent_chunk else seq
     logits = batch * logit_seq * cfg.vocab_size * 4 * 2   # fwd + bwd copies
     policy = getattr(cfg, "remat_policy", "dots")
-    per_tok = {
-        # bytes/2 per token of saved activations per layer
-        "dots": (cfg.heads + 2 * cfg.kv_heads) * cfg.head_dim
-                + 2 * cfg.hidden + 2 * cfg.ffn,
-        "ffn": cfg.hidden + 2 * cfg.ffn,        # resid_mid + gate + up
-        "ffn_lite": cfg.hidden + cfg.ffn,       # resid_mid + gate
-        "full": cfg.hidden,                     # scan carry only
-    }.get(policy, cfg.hidden)
+    if policy == "ffn_offload":
+        # on TPU the saved set lives on HOST (scan carry only in HBM);
+        # off-TPU training.remat_policy falls back to keeping it in
+        # device memory — charge the real residency either way
+        try:
+            import jax
+
+            on_tpu = jax.default_backend() == "tpu"
+        except Exception:   # noqa: BLE001 — no backend yet: be safe
+            on_tpu = False
+        per_tok = cfg.hidden if on_tpu else cfg.hidden + 2 * cfg.ffn
+    else:
+        per_tok = {
+            # bytes/2 per token of saved activations per layer
+            "dots": (cfg.heads + 2 * cfg.kv_heads) * cfg.head_dim
+                    + 2 * cfg.hidden + 2 * cfg.ffn,
+            "ffn": cfg.hidden + 2 * cfg.ffn,     # resid_mid + gate + up
+            "ffn_lite": cfg.hidden + cfg.ffn,    # resid_mid + gate
+            "full": cfg.hidden,                  # scan carry only
+        }.get(policy, cfg.hidden)
     resid = batch * seq * per_tok * cfg.layers * 2
     param_bytes = p * (6 if opt8 else 8)   # 2+2+1+1 vs 2+2+2+2
     return param_bytes + logits + resid
@@ -302,12 +314,18 @@ def main() -> None:
     one_b = LlamaConfig.llama3_1b()
 
     def fam(name, cfg, batch):
-        """A family's rungs: fused-8-bit-adam + saved-FFN remat first
-        (fastest when it fits), then the plain bf16-adamw/full-remat
-        base.  The ladder measures every fitting rung of the headline
-        family and keeps the fastest, so ordering here is just
-        preference, not commitment."""
+        """A family's rungs: host-offloaded FFN residuals first (HBM
+        cost of "full", recompute cost of "ffn" — the attention block
+        is still recomputed; docs/perf.md round-5 lever 4), then
+        fused-8-bit-adam + saved-FFN remat, then the plain
+        bf16-adamw/full-remat base.  The ladder measures every fitting
+        rung of the headline family and keeps the fastest, so ordering
+        here is just preference, not commitment."""
         return [
+            (f"{name}+offload+adam8",
+             dataclasses.replace(cfg, xent_chunk=512,
+                                 remat_policy="ffn_offload"),
+             batch, 2048, "adam8"),
             (f"{name}+ffn+adam8",
              dataclasses.replace(cfg, xent_chunk=512, remat_policy="ffn"),
              batch, 2048, "adam8"),

@@ -75,14 +75,10 @@ def main() -> int:
                     base, xent_chunk=args.xent_chunk, remat_policy=policy,
                 )
                 name = f"{args.config}/{policy}/b{batch}"
-                # ffn_offload's saved set leaves HBM — estimate as
-                # "full" for the fit filter (host side is plentiful)
-                est_cfg = (
-                    dataclasses.replace(cfg, remat_policy="full")
-                    if policy == "ffn_offload" else cfg
-                )
+                # train_mem_estimate charges ffn_offload its real
+                # residency per backend (host on TPU, device off it)
                 est = bench.train_mem_estimate(
-                    est_cfg, batch * max(1, n), args.seq, opt8=True
+                    cfg, batch * max(1, n), args.seq, opt8=True
                 )
                 if est > 0.95 * hbm:
                     print(f"skip {name}: est {est / 2**30:.1f} GiB "
