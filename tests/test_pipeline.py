@@ -250,10 +250,12 @@ class TestPipelineTrainStep:
             temps[sched] = mem.temp_size_in_bytes
         assert temps["1f1b"] < temps["gpipe"], temps
 
-    def test_interleaved_matches_gpipe_losses(self):
+    @pytest.mark.parametrize("v", [2, 4])
+    def test_interleaved_matches_gpipe_losses(self, v):
         """Interleaved 1F1B stores layers [v, L/v, ...] but executes
         them in canonical order — same network, same loss series as
-        GPipe on the same mesh."""
+        GPipe on the same mesh.  v=4 with 2 stages exercises the
+        deepest virtual chain (8 virtual stages, one layer per chunk)."""
         import dataclasses
 
         cfg = dataclasses.replace(LlamaConfig.tiny(), layers=8)
@@ -265,7 +267,7 @@ class TestPipelineTrainStep:
             mesh = make_mesh(plan_axes(8, pipe=2, tensor=2))
             step, init_all, _ = make_pipeline_train_step(
                 cfg, mesh, n_microbatches=4, schedule=sched,
-                virtual_stages=2,
+                virtual_stages=v,
             )
             p, o = init_all(jax.random.key(0))
             series = []
