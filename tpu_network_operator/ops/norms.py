@@ -11,8 +11,8 @@ dispatches between two implementations of identical f32 math:
   twice forward and the backward chain re-reads it again across
   several fusions.  The Pallas forward reads x once and writes y plus
   the per-row ``rstd`` (one f32 lane-row per activation row); the
-  backward reads x/dy once and emits dx plus per-tile dscale partials
-  in one pass.  docs/perf.md identifies this elementwise traffic on
+  backward reads x/dy once and emits dx plus the full dscale row,
+  accumulated across the sequential grid in one resident VMEM block.  docs/perf.md identifies this elementwise traffic on
   the residual stream as part of the 1B preset's 59% forward ceiling.
 
 On a single device :func:`rms_norm` dispatches by itself.  On a
@@ -101,8 +101,10 @@ def _fwd_kernel(x_ref, s_ref, y_ref, r_ref, *, eps):
 
 def _bwd_kernel(x_ref, s_ref, r_ref, dy_ref, dx_ref, ds_ref):
     """dx = rstd * (g - xh * mean(g * xh)) with g = dy * scale and
-    xh = x * rstd; dscale partial = column-sum of dy * xh over this
-    tile's rows (summed across tiles outside the kernel)."""
+    xh = x * rstd.  dscale accumulates across the sequential TPU grid
+    into one resident (1, H) block (constant index map) — a (1, H) tile
+    per grid step over an (nb, H) array is not a legal Mosaic block
+    (rows must be 8-divisible or the whole array dim)."""
     x32 = x_ref[...].astype(jnp.float32)
     dy32 = dy_ref[...].astype(jnp.float32)
     rstd = r_ref[..., 0:1]
@@ -110,7 +112,12 @@ def _bwd_kernel(x_ref, s_ref, r_ref, dy_ref, dx_ref, ds_ref):
     g = dy32 * s_ref[...].astype(jnp.float32)
     mean_gxh = jnp.mean(g * xh, axis=-1, keepdims=True)
     dx_ref[...] = (rstd * (g - xh * mean_gxh)).astype(dx_ref.dtype)
-    ds_ref[...] = jnp.sum(dy32 * xh, axis=0, keepdims=True)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        ds_ref[...] = jnp.zeros_like(ds_ref)
+
+    ds_ref[...] += jnp.sum(dy32 * xh, axis=0, keepdims=True)
 
 
 def _row_specs(rows: int, h: int):
@@ -153,20 +160,20 @@ def _rms_flat_bwd(eps, res, dy2):
     rows = _tile_rows(n, h)
     nb = n // rows
     wide, scale, stat = _row_specs(rows, h)
-    ds_part = pl.BlockSpec((1, h), lambda i: (i, 0),
-                           memory_space=pltpu.VMEM)
+    ds_acc = pl.BlockSpec((1, h), lambda i: (0, 0),
+                          memory_space=pltpu.VMEM)
     dx2, ds = pl.pallas_call(
         _bwd_kernel,
         grid=(nb,),
         in_specs=[wide, scale, stat, wide],
-        out_specs=[wide, ds_part],
+        out_specs=[wide, ds_acc],
         out_shape=[
             jax.ShapeDtypeStruct((n, h), x2.dtype),
-            jax.ShapeDtypeStruct((nb, h), jnp.float32),
+            jax.ShapeDtypeStruct((1, h), jnp.float32),
         ],
         interpret=_interpret(),
     )(x2, s2, rstd, dy2)
-    return dx2, ds.sum(axis=0, keepdims=True).astype(s2.dtype)
+    return dx2, ds.astype(s2.dtype)
 
 
 _rms_flat.defvjp(_rms_flat_fwd, _rms_flat_bwd)
