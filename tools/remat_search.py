@@ -38,6 +38,11 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="llama3-1b")
     ap.add_argument("--batches", default="4,8")
+    ap.add_argument("--opts", default="adam8",
+                    help="comma list of optimizers to sweep: adam8 "
+                         "(fused int8/f8 moments) and/or adamw (optax "
+                         "bf16 baseline) — round-5 hardware runs showed "
+                         "the optimizer axis matters as much as remat")
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--xent-chunk", type=int, default=512)
     ap.add_argument("--out", default="remat_search.jsonl")
@@ -69,16 +74,21 @@ def main() -> int:
 
     rows = []
     with open(args.out, "a") as out:
+        opts = [o.strip() for o in args.opts.split(",") if o.strip()]
+        bad = set(opts) - {"adam8", "adamw"}
+        if bad:
+            raise SystemExit(f"--opts must be adam8/adamw, got {sorted(bad)}")
         for policy in POLICIES:
+          for opt in opts:
             for batch in (int(b) for b in args.batches.split(",")):
                 cfg = dataclasses.replace(
                     base, xent_chunk=args.xent_chunk, remat_policy=policy,
                 )
-                name = f"{args.config}/{policy}/b{batch}"
+                name = f"{args.config}/{policy}/{opt}/b{batch}"
                 # train_mem_estimate charges ffn_offload its real
                 # residency per backend (host on TPU, device off it)
                 est = bench.train_mem_estimate(
-                    cfg, batch * max(1, n), args.seq, opt8=True
+                    cfg, batch * max(1, n), args.seq, opt8=opt == "adam8"
                 )
                 if est > 0.95 * hbm:
                     print(f"skip {name}: est {est / 2**30:.1f} GiB "
@@ -87,7 +97,8 @@ def main() -> int:
                 try:
                     row = bench.measure(
                         name, cfg, batch * max(1, n), args.seq, n, kind,
-                        make_train_step, mesh, jax, jnp, opt="adam8",
+                        make_train_step, mesh, jax, jnp,
+                        opt="adam8" if opt == "adam8" else None,
                     )
                 except Exception as e:   # noqa: BLE001 — OOM -> next
                     print(f"fail {name}: {type(e).__name__}: "
