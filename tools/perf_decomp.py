@@ -49,7 +49,9 @@ def main() -> int:
     ap.add_argument("--config", default="llama3-1b",
                     choices=["llama3-150m", "llama3-1b", "llama3-3b",
                              "llama3-8b"])
-    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=4,
+                    help="per-chip batch, scaled by the device count "
+                         "like bench.py's ladder rungs")
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--remat-policy", default="full")
@@ -83,7 +85,7 @@ def main() -> int:
         presets[args.config](), xent_chunk=512,
         remat_policy=args.remat_policy,
     )
-    b, s = args.batch, args.seq
+    b, s = args.batch * max(1, n), args.seq
     tokens = jax.random.randint(
         jax.random.key(1), (b, s + 1), 0, cfg.vocab_size, jnp.int32
     )

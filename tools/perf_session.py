@@ -12,7 +12,10 @@ Phases:
 3. effective-length decode: workload generate at a long max_len with
    --decode-block 256 vs 0 (the VERDICT r3 #7 'Done' measurement);
 4. flash-prefill ablation: long-prompt generate with
-   TPUNET_DECODE_FLASH=0/1.
+   TPUNET_DECODE_FLASH=0/1;
+5. remat/offload/optimizer policy search at the 1B geometry
+   (tools/remat_search.py);
+6. stage-by-stage MFU decomposition (tools/perf_decomp.py).
 
 Usage: python tools/perf_session.py [--out perf_session.jsonl]
 """
@@ -90,8 +93,14 @@ def main() -> int:
         # 5. remat/offload policy search at the 1B geometry — the
         # docs/perf.md remat x1.3 term (VERDICT r4 #8)
         run_phase(out, "remat-search",
-                  [py, "tools/remat_search.py", "--config", "llama3-1b"],
-                  timeout=7200)
+                  [py, "tools/remat_search.py", "--config", "llama3-1b",
+                   "--opts", "adamw,adam8"],
+                  env={"BENCH_ITERS": args.iters}, timeout=7200)
+        # 6. stage-by-stage MFU decomposition at the headline geometry
+        # (fwd ceiling / remat multiplier / optimizer share / MXU probe)
+        run_phase(out, "perf-decomp",
+                  [py, "tools/perf_decomp.py", "--config", "llama3-1b",
+                   "--batch", "4", "--iters", args.iters])
     print(f"done -> {args.out}")
     return 0
 
