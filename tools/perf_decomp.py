@@ -90,12 +90,7 @@ def main() -> int:
         jax.random.key(1), (b, s + 1), 0, cfg.vocab_size, jnp.int32
     )
 
-    def sync(x):
-        # fetch the smallest output leaf (the scalar loss) — pulling a
-        # multi-GiB grad/param leaf through the tunnel is slow and the
-        # axon transport rejects very large host transfers
-        leaf = min(jax.tree.leaves(x), key=lambda a: a.size)
-        return jax.device_get(leaf)
+    sync = bench.fence_scalar
 
     attn = auto_attention(cfg, mesh if n > 1 else None)
     params = jax.jit(lambda k: init_params(k, cfg))(jax.random.key(0))
