@@ -144,15 +144,24 @@ def forward_with_cache(
     cos, sin = rope_angles(max_len, cfg.head_dim, cfg.rope_theta,
                            scaling=cfg.rope_scaling_dict)
 
-    def body(x, layer_in):
-        lp, ck, cv = layer_in
+    # cache lives in the scan CARRY with indexed slice updates, not as
+    # stacked ys: a ys output re-allocates and rewrites the WHOLE cache
+    # every call (measured ~1.3 GB/token at 1B b64 — a double-digit
+    # share of the decode step); the carry form updates in place and
+    # only the fresh [B, s] K/V slices touch HBM
+    def body(carry, lp):
+        x, ck_all, cv_all, j = carry
+        ck = jax.lax.dynamic_index_in_dim(ck_all, j, 0, keepdims=False)
+        cv = jax.lax.dynamic_index_in_dim(cv_all, j, 0, keepdims=False)
         x, ck, cv = _block_with_cache(
             cfg, cos, sin, pos, x, lp, ck, cv, attn_len
         )
-        return x, (ck, cv)
+        ck_all = jax.lax.dynamic_update_index_in_dim(ck_all, ck, j, 0)
+        cv_all = jax.lax.dynamic_update_index_in_dim(cv_all, cv, j, 0)
+        return (x, ck_all, cv_all, j + 1), None
 
-    x, (ck, cv) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"])
+    (x, ck, cv, _), _ = jax.lax.scan(
+        body, (x, cache["k"], cache["v"], jnp.int32(0)), params["layers"]
     )
     x = rms_norm(x, params["ln_final"], cfg.rms_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)

@@ -28,7 +28,9 @@ Two per-chunk implementations, chosen statically by shape:
   sum to the exact full-attention gradient while dK/dV accumulators rotate
   home with their chunks.
 * **xla** fallback: plain einsum online-softmax (small head_dim / odd
-  chunk sizes / non-TPU-non-interpret contexts).
+  chunk sizes / non-TPU-non-interpret contexts); GQA grouped in the
+  einsums, so here too K/V rotate unrepeated (up to the minimal factor
+  the ``head_axis`` sharding forces).
 
 Compute/communication overlap is left to XLA's latency-hiding scheduler —
 the ppermute of step j+1 is independent of step j's matmuls, which is
@@ -50,61 +52,68 @@ from ..ops.attention import repeat_kv
 _NEG = -1e30
 
 
-def _chunk_scores(q, k, scale):
-    """[B,Sq,H,D] x [B,Sk,H,D] -> f32 logits [B,H,Sq,Sk]."""
-    return jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+def _chunk_scores(qg, k, scale):
+    """[B,Sq,Hkv,R,D] x [B,Sk,Hkv,D] -> f32 logits [B,Hkv,R,Sq,Sk].
+    GQA stays grouped — K is never head-expanded."""
+    return jnp.einsum("bqhrd,bkhd->bhrqk", qg, k).astype(jnp.float32) * scale
 
 
 def _ring_body(axis_name: str, n: int, scale: float, j, carry):
     """One ring step: accumulate this K/V chunk, rotate K/V backwards."""
-    k, v, m, l, o, q, my = carry
+    k, v, m, l, o, qg, my = carry
 
     src = (my - j) % n
-    logits = _chunk_scores(q, k, scale)          # [B,H,Sq,Sk]
+    logits = _chunk_scores(qg, k, scale)         # [B,Hkv,R,Sq,Sk]
     sq, sk = logits.shape[-2], logits.shape[-1]
 
     diag_mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
     keep = jnp.where(
-        src == my, diag_mask[None, None],
+        src == my, diag_mask[None, None, None],
         jnp.where(src < my, True, False),
     )
     logits = jnp.where(keep, logits, _NEG)
 
-    m_c = jnp.max(logits, axis=-1)               # [B,H,Sq]
+    m_c = jnp.max(logits, axis=-1)               # [B,Hkv,R,Sq]
     m_new = jnp.maximum(m, m_c)
-    p = jnp.exp(logits - m_new[..., None])       # [B,H,Sq,Sk]
+    p = jnp.exp(logits - m_new[..., None])       # [B,Hkv,R,Sq,Sk]
     l_c = jnp.sum(p, axis=-1)
     alpha = jnp.exp(m - m_new)
     l = l * alpha + l_c
     o = o * alpha[..., None] + jnp.einsum(
-        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32)
+        "bhrqk,bkhd->bhrqd", p, v.astype(jnp.float32)
     )
     m = m_new
 
-    # rotate K/V to the next device (ring hop on ICI)
+    # rotate K/V to the next device (ring hop on ICI) — kv-head shaped,
+    # so GQA models move heads/kv_heads-x less than the repeated form
     perm = [(i, (i + 1) % n) for i in range(n)]
     k = jax.lax.ppermute(k, axis_name, perm)
     v = jax.lax.ppermute(v, axis_name, perm)
-    return (k, v, m, l, o, q, my)
+    return (k, v, m, l, o, qg, my)
 
 
 def _ring_kernel(axis_name: str, scale: float, q, k, v):
-    """Per-device kernel under shard_map.  q,k,v: [B, S_local, H, D]."""
+    """Per-device kernel under shard_map.  q: [B, S_local, H, D];
+    k/v: [B, S_local, Hkv, D] with Hkv dividing H (GQA unrepeated)."""
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
 
     b, sq, h, d = q.shape
-    m = jnp.full((b, h, sq), _NEG, jnp.float32)
-    l = jnp.zeros((b, h, sq), jnp.float32)
-    o = jnp.zeros((b, h, sq, d), jnp.float32)
+    hkv = k.shape[2]
+    r = h // hkv
+    qg = q.reshape(b, sq, hkv, r, d)
+    m = jnp.full((b, hkv, r, sq), _NEG, jnp.float32)
+    l = jnp.zeros((b, hkv, r, sq), jnp.float32)
+    o = jnp.zeros((b, hkv, r, sq, d), jnp.float32)
 
-    carry = (k, v, m, l, o, q, my)
+    carry = (k, v, m, l, o, qg, my)
     carry = jax.lax.fori_loop(
         0, n, partial(_ring_body, axis_name, n, scale), carry
     )
     _, _, m, l, o, _, _ = carry
-    out = o / jnp.maximum(l, 1e-30)[..., None]   # [B,H,Sq,D]
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    out = o / jnp.maximum(l, 1e-30)[..., None]   # [B,Hkv,R,Sq,D]
+    out = jnp.transpose(out, (0, 3, 1, 2, 4))    # [B,Sq,Hkv,R,D]
+    return out.reshape(b, sq, h, d).astype(q.dtype)
 
 
 # -- flash (Pallas-per-chunk) path --------------------------------------------
@@ -295,9 +304,17 @@ def ring_attention(
             check_vma=False,
         )(q, k, v)
 
-    if hkv != h:
-        k = repeat_kv(k, h // hkv)
-        v = repeat_kv(v, h // hkv)
+    # GQA stays unrepeated through the ring (the XLA kernel groups the
+    # query heads), EXCEPT the minimal factor head_axis sharding needs:
+    # the K/V head dim must still divide the tensor shards
+    t = mesh.shape.get(head_axis, 1) if head_axis else 1
+    if hkv != h and hkv % max(t, 1):
+        rep = next(
+            f for f in range(1, h // hkv + 1)
+            if (h // hkv) % f == 0 and (hkv * f) % max(t, 1) == 0
+        )
+        k = repeat_kv(k, rep)
+        v = repeat_kv(v, rep)
 
     spec = P(batch_axes, axis, head_axis, None)
 
@@ -322,10 +339,6 @@ def ring_attn_in_manual(q, k, v, axis: str = "seq") -> jnp.ndarray:
     collectives, XLA per-chunk math (a ``pallas_call`` under the auto
     batch/tensor axes would be replicated by the partitioner).
     """
-    h, hkv = q.shape[2], k.shape[2]
-    if hkv != h:
-        k = repeat_kv(k, h // hkv)
-        v = repeat_kv(v, h // hkv)
     if jax.default_backend() == "cpu":
         # XLA's CPU backend aborts on bf16 collectives inside a
         # manual-SUBSET region (same bug the pipeline's f32 boundary
