@@ -66,6 +66,73 @@ class TestKVCache:
         )
 
 
+class TestInt8KVCache:
+    @pytest.fixture(autouse=True)
+    def _no_flash_prefill(self, monkeypatch):
+        # pin the CAUSAL (dequantizing) route: the flash prefill path
+        # deliberately attends over exact fresh k/v, which would make
+        # these quant-noise comparisons vacuous (err == 0 regardless of
+        # the quantizer) if the flash gate ever opened here
+        monkeypatch.setenv("TPUNET_DECODE_FLASH", "0")
+
+    def test_cache_halves_and_dequantizes_close(self, tiny, tiny_params):
+        """int8 cache: value buffers are int8 + per-row-head f32 scales
+        (half the at-rest bytes), and prefill logits stay within
+        KV-quant noise of the exact cache."""
+        toks = jax.random.randint(jax.random.key(1), (2, 12), 0, 256)
+        cq = init_cache(tiny, 2, 16, "int8")
+        assert cq["k"].dtype == jnp.int8
+        assert cq["k_scale"].shape == cq["k"].shape[:-1]
+        exact, _ = forward_with_cache(
+            tiny_params, toks, init_cache(tiny, 2, 16), 0, tiny,
+            attn_len=12,
+        )
+        quant, _ = forward_with_cache(
+            tiny_params, toks, cq, 0, tiny, attn_len=12
+        )
+        err = np.abs(np.asarray(quant) - np.asarray(exact)).max()
+        ref = np.abs(np.asarray(exact)).max()
+        assert err < 0.05 * max(ref, 1.0), (err, ref)
+
+    def test_decode_steps_stay_close(self, tiny, tiny_params):
+        """Multi-step decode through the quantized cache tracks the
+        exact-cache logits (each step re-reads quantized history)."""
+        toks = jax.random.randint(jax.random.key(2), (2, 6), 0, 256)
+        logits = {}
+        for kd in ("native", "int8"):
+            cache = init_cache(tiny, 2, 12, kd)
+            lg, cache = forward_with_cache(
+                tiny_params, toks, cache, 0, tiny, attn_len=6
+            )
+            tok = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
+            rows = []
+            for i in range(4):
+                lg, cache = forward_with_cache(
+                    tiny_params, tok[:, None], cache, 6 + i, tiny,
+                    attn_len=7 + i,
+                )
+                tok = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
+                rows.append(np.asarray(lg[:, 0]))
+            logits[kd] = np.stack(rows, axis=1)
+        err = np.abs(logits["int8"] - logits["native"]).max()
+        ref = np.abs(logits["native"]).max()
+        assert err < 0.08 * max(ref, 1.0), (err, ref)
+
+    def test_generate_end_to_end(self, tiny, tiny_params):
+        """kv_dtype='int8' runs the full prompt->tokens path and mostly
+        agrees with the exact cache even on a random-init model (whose
+        near-flat logits are the adversarial case for argmax flips)."""
+        prompt = jax.random.randint(jax.random.key(3), (2, 8), 0, 256)
+        out = {
+            kd: np.asarray(
+                generate(tiny_params, prompt, tiny, 16, kv_dtype=kd)
+            )
+            for kd in ("native", "int8")
+        }
+        assert out["int8"].shape == out["native"].shape
+        assert (out["int8"] == out["native"]).mean() > 0.6
+
+
 class TestGenerate:
     def test_greedy_matches_teacher_forcing(self, tiny, tiny_params):
         prompt = jax.random.randint(jax.random.key(3), (2, 8), 0, 256)

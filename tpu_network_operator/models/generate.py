@@ -62,10 +62,30 @@ def _prefill_flash_ok(cfg, pos, s: int, attn_len: int) -> bool:
 
 
 def init_cache(
-    cfg: LlamaConfig, batch: int, max_len: int
+    cfg: LlamaConfig, batch: int, max_len: int, kv_dtype: str = "native"
 ) -> Dict[str, jnp.ndarray]:
-    """Zeroed KV cache: k/v of [L, B, max_len, Hkv, D]."""
+    """Zeroed KV cache: k/v of [L, B, max_len, Hkv, D].
+
+    ``kv_dtype="int8"``: block-quantized cache — int8 values plus an f32
+    scale per (layer, batch, position, kv-head), quantized over the head
+    dim.  Halves the cache's HBM residency (the capacity ceiling on
+    batch x context per chip); the measured quality cost on real
+    checkpoints is the usual KV-quant noise, and the zeroed scales make
+    unfilled rows dequantize to exact zeros."""
     shape = (cfg.layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    if kv_dtype == "int8":
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+            "v_scale": jnp.zeros(shape[:-1], jnp.float32),
+        }
+    if kv_dtype != "native":
+        # a typo'd dtype must not silently hand back the full-size
+        # bf16 cache to a caller who sized batch x context for int8
+        raise ValueError(
+            f"kv_dtype must be 'native' or 'int8', got {kv_dtype!r}"
+        )
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -73,23 +93,45 @@ def init_cache(
 
 
 def cache_specs() -> Dict[str, P]:
-    """PartitionSpecs matching the training head layout."""
+    """PartitionSpecs matching the training head layout (scale entries
+    apply only when the cache is int8-quantized)."""
     spec = P(None, ("data", "fsdp"), None, "tensor", None)
-    return {"k": spec, "v": spec}
+    sspec = P(None, ("data", "fsdp"), None, "tensor")
+    return {"k": spec, "v": spec, "k_scale": sspec, "v_scale": sspec}
+
+
+def _quant_rows(x):
+    """[B, s, Hkv, D] -> (int8 values, f32 scale over D per row-head)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0
+    scale = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _dequant(q, scale, dtype):
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 def cache_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
     return {k: NamedSharding(mesh, s) for k, s in cache_specs().items()}
 
 
-def _block_with_cache(cfg, cos, sin, pos, x, lp, ck, cv, attn_len=None):
+def _block_with_cache(cfg, cos, sin, pos, x, lp, ck, cv, attn_len=None,
+                      cks=None, cvs=None):
     """One block over cached keys/values.
 
     x: [B, s, H] new tokens at absolute positions [pos, pos+s);
     ck/cv: [B, max_len, Hkv, D] this layer's cache.  ``attn_len`` (static)
     bounds the filled prefix: attention reads only cache[:, :attn_len],
     so decode work scales with generated length, not the full buffer.
-    Returns (x', ck', cv').
+    ``cks``/``cvs``: per-row-head f32 scales when the cache is int8 —
+    fresh rows are quantized on insert and the causal path dequantizes
+    the attended view (fresh rows included).  The flash PREFILL route
+    deliberately attends over the exact fresh k/v instead (a pure
+    quality bonus for the prompt pass; the cache still stores the
+    quantized rows every later step re-reads).
+    Returns (x', ck', cv', cks', cvs').
     """
     b, s, _ = x.shape
     y = rms_norm(x, lp["ln_attn"], cfg.rms_eps)
@@ -100,8 +142,16 @@ def _block_with_cache(cfg, cos, sin, pos, x, lp, ck, cv, attn_len=None):
     q = apply_rope_at(q, cos, sin, positions)
     k = apply_rope_at(k, cos, sin, positions)
 
-    ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+    if cks is not None:
+        kq, k_sc = _quant_rows(k)
+        vq, v_sc = _quant_rows(v)
+        ck = jax.lax.dynamic_update_slice(ck, kq, (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, vq, (0, pos, 0, 0))
+        cks = jax.lax.dynamic_update_slice(cks, k_sc, (0, pos, 0))
+        cvs = jax.lax.dynamic_update_slice(cvs, v_sc, (0, pos, 0))
+    else:
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
 
     # q_offset=pos makes query i attend cache slots <= pos+i; unwritten
     # future slots (within the view) are masked out by exactly that, so
@@ -110,6 +160,10 @@ def _block_with_cache(cfg, cos, sin, pos, x, lp, ck, cv, attn_len=None):
     ckv, cvv = ck, cv
     if attn_len is not None and attn_len < ck.shape[1]:
         ckv, cvv = ck[:, :attn_len], cv[:, :attn_len]
+    if cks is not None:
+        lim = ckv.shape[1]
+        ckv = _dequant(ckv, cks[:, :lim], cfg.dtype)
+        cvv = _dequant(cvv, cvs[:, :lim], cfg.dtype)
     if _prefill_flash_ok(cfg, pos, s, ckv.shape[1]):
         # prefill (pos==0, queries cover the whole filled prefix): the
         # fresh q/k/v ARE the prefix, so the square causal flash kernel
@@ -122,7 +176,7 @@ def _block_with_cache(cfg, cos, sin, pos, x, lp, ck, cv, attn_len=None):
 
     y = rms_norm(x, lp["ln_mlp"], cfg.rms_eps)
     gated = jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"])
-    return x + gated @ lp["w_down"], ck, cv
+    return x + gated @ lp["w_down"], ck, cv, cks, cvs
 
 
 def forward_with_cache(
@@ -149,23 +203,34 @@ def forward_with_cache(
     # every call (measured ~1.3 GB/token at 1B b64 — a double-digit
     # share of the decode step); the carry form updates in place and
     # only the fresh [B, s] K/V slices touch HBM
-    def body(carry, lp):
-        x, ck_all, cv_all, j = carry
-        ck = jax.lax.dynamic_index_in_dim(ck_all, j, 0, keepdims=False)
-        cv = jax.lax.dynamic_index_in_dim(cv_all, j, 0, keepdims=False)
-        x, ck, cv = _block_with_cache(
-            cfg, cos, sin, pos, x, lp, ck, cv, attn_len
-        )
-        ck_all = jax.lax.dynamic_update_index_in_dim(ck_all, ck, j, 0)
-        cv_all = jax.lax.dynamic_update_index_in_dim(cv_all, cv, j, 0)
-        return (x, ck_all, cv_all, j + 1), None
+    quant = "k_scale" in cache
+    names = ("k", "v", "k_scale", "v_scale") if quant else ("k", "v")
 
-    (x, ck, cv, _), _ = jax.lax.scan(
-        body, (x, cache["k"], cache["v"], jnp.int32(0)), params["layers"]
+    def body(carry, lp):
+        x, bufs, j = carry
+        views = tuple(
+            jax.lax.dynamic_index_in_dim(b_, j, 0, keepdims=False)
+            for b_ in bufs
+        )
+        out = _block_with_cache(
+            cfg, cos, sin, pos, x, lp, views[0], views[1], attn_len,
+            *(views[2:] if quant else (None, None)),
+        )
+        x, new_views = out[0], [s_ for s_ in out[1:] if s_ is not None]
+        bufs = tuple(
+            jax.lax.dynamic_update_index_in_dim(b_, nv, j, 0)
+            for b_, nv in zip(bufs, new_views)
+        )
+        return (x, bufs, j + 1), None
+
+    (x, bufs, _), _ = jax.lax.scan(
+        body,
+        (x, tuple(cache[n] for n in names), jnp.int32(0)),
+        params["layers"],
     )
     x = rms_norm(x, params["ln_final"], cfg.rms_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
-    return logits, {"k": ck, "v": cv}
+    return logits, dict(zip(names, bufs))
 
 
 def _sample(
@@ -217,13 +282,16 @@ def generate(
     max_len: Optional[int] = None,
     mesh: Optional[Mesh] = None,
     decode_block: int = 256,
+    kv_dtype: str = "native",
 ) -> jnp.ndarray:
     """Prompt + sampled continuation, [B, S + max_new_tokens].
 
     Jit-safe (shapes static in prompt length and budget); greedy when
     ``temperature == 0`` (then ``key``/``top_k``/``top_p`` are unused).
     With a ``mesh``, the KV cache is pinned to the training head layout
-    (:func:`cache_specs`).
+    (:func:`cache_specs`).  ``kv_dtype="int8"`` block-quantizes the KV
+    cache (see :func:`init_cache`) — half the cache HBM, so roughly
+    double the batch x context capacity per chip, at KV-quant noise.
 
     ``decode_block``: effective-length decode granularity.  The decode
     scan is split into segments; all steps in a segment attend over one
@@ -242,7 +310,7 @@ def generate(
     if key is None:
         key = jax.random.key(0)
 
-    cache = init_cache(cfg, b, max_len)
+    cache = init_cache(cfg, b, max_len, kv_dtype)
     if mesh is not None:
         cache = {
             name: jax.lax.with_sharding_constraint(
@@ -299,6 +367,7 @@ def make_generate_fn(
     top_p: float = 1.0,
     mesh: Optional[Mesh] = None,
     decode_block: int = 256,
+    kv_dtype: str = "native",
 ):
     """Jitted generate with params/prompt shardings pinned when a mesh is
     given (batch on data/fsdp; params as trained)."""
@@ -307,7 +376,7 @@ def make_generate_fn(
     gen = partial(
         generate, cfg=cfg, max_new_tokens=max_new_tokens,
         temperature=temperature, top_k=top_k, top_p=top_p, mesh=mesh,
-        decode_block=decode_block,
+        decode_block=decode_block, kv_dtype=kv_dtype,
     )
     if mesh is None:
         return jax.jit(gen)

@@ -429,7 +429,7 @@ def cmd_generate(args) -> int:
     gen = make_generate_fn(
         cfg, args.max_new_tokens, temperature=args.temperature,
         top_k=args.top_k, top_p=args.top_p, mesh=mesh,
-        decode_block=args.decode_block,
+        decode_block=args.decode_block, kv_dtype=args.kv_dtype,
     )
 
     def run_once():
@@ -458,6 +458,7 @@ def cmd_generate(args) -> int:
         "unit": "tokens/sec",
         "batch": args.batch,
         "new_tokens": args.max_new_tokens,
+        "kv_dtype": args.kv_dtype,
         "out_shape": list(out.shape),
         "mesh": dict(mesh.shape),
     })
@@ -556,6 +557,11 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--decode-block", type=int, default=256,
                    help="effective-length decode granularity; 0 = attend "
                         "over the full KV buffer every step")
+    g.add_argument("--kv-dtype", default="native",
+                   choices=["native", "int8"],
+                   help="int8 block-quantizes the KV cache: half the "
+                        "cache HBM (2x batch x context capacity) at "
+                        "KV-quant noise")
     g.set_defaults(fn=cmd_generate)
     return p
 
