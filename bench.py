@@ -36,25 +36,77 @@ def log(msg):
 # re-calling jax.devices() genuinely re-dials the backend.
 INIT_ATTEMPTS = max(1, int(os.environ.get("BENCH_INIT_ATTEMPTS", "6")))
 INIT_BACKOFFS = (5, 10, 20, 40, 60)
+# Per-attempt wall clock: some tunnel-down states make jax.devices()
+# HANG instead of raising (observed 2026-07-31) — without a watchdog
+# the whole bench dies to the driver's timeout with NO JSON line.
+INIT_ATTEMPT_TIMEOUT = float(os.environ.get("BENCH_INIT_TIMEOUT", "180"))
 
 
-def init_devices(devices_fn, sleep=time.sleep):
-    """``jax.devices()`` with bounded retry + backoff.
+class _WatchdogTimeout(TimeoutError):
+    """Raised ONLY by :func:`_call_with_timeout`'s deadline — a backend
+    that itself raises a (socket/gRPC) TimeoutError must stay
+    retryable, so the watchdog needs its own type."""
 
-    Raises the last backend error only after the full budget (~2.5 min
-    default) is spent, so a transient TPU-tunnel outage does not zero a
-    whole round's numbers."""
+
+def _call_with_timeout(fn, timeout):
+    """Run ``fn()`` on a daemon thread with a deadline.  Returns
+    (ok, value_or_exception); on deadline the thread is abandoned (it
+    cannot be killed, but the caller regains control and can emit a
+    structured failure instead of hanging forever).  ``timeout <= 0``
+    disables the watchdog (plain in-thread call)."""
+    import threading
+
+    if timeout is None or timeout <= 0:
+        try:
+            return True, fn()
+        except BaseException as e:  # noqa: BLE001 — reported to caller
+            return False, e
+
+    box = {}
+
+    def worker():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — SystemExit/KI too:
+            box["error"] = e        # an empty box would mask the cause
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        return False, _WatchdogTimeout(
+            f"backend init still hung after {timeout:.0f}s"
+        )
+    if "error" in box:
+        return False, box["error"]
+    return True, box["value"]
+
+
+def init_devices(devices_fn, sleep=time.sleep, timeout=None):
+    """``jax.devices()`` with a per-attempt watchdog plus bounded
+    retry + backoff.
+
+    Raises the last backend error only after the full budget is spent,
+    so a transient TPU-tunnel outage does not zero a whole round's
+    numbers — and a HUNG backend init (the other observed outage mode)
+    becomes a raised timeout instead of an output-less bench."""
+    if timeout is None:
+        timeout = INIT_ATTEMPT_TIMEOUT
     last = None
     for attempt in range(INIT_ATTEMPTS):
-        try:
-            return devices_fn()
-        except Exception as e:  # backend init failure — retry
-            last = e
-            if attempt < INIT_ATTEMPTS - 1:
-                pause = INIT_BACKOFFS[min(attempt, len(INIT_BACKOFFS) - 1)]
-                log(f"backend init failed (attempt {attempt + 1}/"
-                    f"{INIT_ATTEMPTS}): {str(e)[:200]}; retry in {pause}s")
-                sleep(pause)
+        ok, out = _call_with_timeout(devices_fn, timeout)
+        if ok:
+            return out
+        last = out
+        if isinstance(last, _WatchdogTimeout):
+            # the abandoned thread holds jax's init lock — further
+            # attempts would queue behind the same hang, so fail fast
+            break
+        if attempt < INIT_ATTEMPTS - 1:
+            pause = INIT_BACKOFFS[min(attempt, len(INIT_BACKOFFS) - 1)]
+            log(f"backend init failed (attempt {attempt + 1}/"
+                f"{INIT_ATTEMPTS}): {str(last)[:200]}; retry in {pause}s")
+            sleep(pause)
     raise last
 
 

@@ -55,6 +55,54 @@ class TestInitDevices:
         assert sleeps == sorted(sleeps)
         assert sleeps[-1] == bench.INIT_BACKOFFS[-1]
 
+    def test_hung_init_fails_fast_with_timeout(self):
+        """A HANGING jax.devices() (observed tunnel-down mode,
+        2026-07-31) must surface as a raised watchdog timeout after ONE
+        attempt — the abandoned thread holds jax's init lock, so
+        retrying would queue behind the same hang — instead of an
+        output-less bench killed by the driver's timeout."""
+        import threading
+
+        release = threading.Event()
+        sleeps = []
+        try:
+            with pytest.raises(TimeoutError, match="hung"):
+                bench.init_devices(
+                    lambda: release.wait(60), sleep=sleeps.append,
+                    timeout=0.2,
+                )
+        finally:
+            release.set()   # unblock the abandoned worker thread
+        assert sleeps == []   # fail-fast: no retry of a hang
+
+    def test_backend_raised_timeout_stays_retryable(self):
+        """socket.timeout IS TimeoutError on py3.10+ — a backend that
+        raises one quickly is a transient dial failure and must use the
+        full retry budget, unlike the watchdog's own deadline."""
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 2:
+                raise TimeoutError("dial timed out")
+            return ["dev0"]
+
+        sleeps = []
+        out = bench.init_devices(flaky, sleep=sleeps.append, timeout=30)
+        assert out == ["dev0"]
+        assert calls["n"] == 2 and len(sleeps) == 1
+
+    def test_zero_timeout_disables_watchdog(self):
+        ok, out = bench._call_with_timeout(lambda: "x", 0)
+        assert ok and out == "x"
+
+    def test_worker_base_exception_is_reported(self):
+        def bail():
+            raise SystemExit(3)
+
+        ok, err = bench._call_with_timeout(bail, 30)
+        assert not ok and isinstance(err, SystemExit)
+
 
 class TestFailureLine:
     def test_emit_failure_is_one_json_line(self, capsys):
