@@ -102,6 +102,10 @@ def init_devices(devices_fn, sleep=time.sleep, timeout=None):
             # the abandoned thread holds jax's init lock — further
             # attempts would queue behind the same hang, so fail fast
             break
+        if not isinstance(last, Exception):
+            # KeyboardInterrupt/SystemExit are not transient backend
+            # failures — propagate immediately, never retry
+            raise last
         if attempt < INIT_ATTEMPTS - 1:
             pause = INIT_BACKOFFS[min(attempt, len(INIT_BACKOFFS) - 1)]
             log(f"backend init failed (attempt {attempt + 1}/"

@@ -15,7 +15,8 @@ Phases:
    TPUNET_DECODE_FLASH=0/1;
 5. remat/offload/optimizer policy search at the 1B geometry
    (tools/remat_search.py);
-6. stage-by-stage MFU decomposition (tools/perf_decomp.py).
+6. stage-by-stage MFU decomposition (tools/perf_decomp.py);
+7. int8-KV decode cost ablation at the tracked b64 geometry.
 
 Usage: python tools/perf_session.py [--out perf_session.jsonl]
 """
@@ -65,14 +66,24 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="perf_session.jsonl")
     ap.add_argument("--iters", default="10")
+    ap.add_argument("--phases", default="",
+                    help="comma list of phase-name substrings to run "
+                         "(empty = all); e.g. --phases decode-kv,bench")
     args = ap.parse_args()
     py = sys.executable
+    wanted = [p.strip() for p in args.phases.split(",") if p.strip()]
+
+    def maybe_run_phase(out, name, argv, env=None, timeout=3600):
+        if wanted and not any(w in name for w in wanted):
+            print(f"-- {name}: skipped (--phases)", flush=True)
+            return None
+        return run_phase(out, name, argv, env=env, timeout=timeout)
 
     with open(args.out, "a") as out:
-        run_phase(out, "bench-ladder", [py, "bench.py"],
+        maybe_run_phase(out, "bench-ladder", [py, "bench.py"],
                   env={"BENCH_ITERS": args.iters})
         for flag in ("1", "0"):
-            run_phase(
+            maybe_run_phase(
                 out, f"rms-fused-{flag}", [py, "bench.py"],
                 env={"BENCH_CONFIG": "llama3-150m",
                      "BENCH_ITERS": args.iters,
@@ -82,25 +93,33 @@ def main() -> int:
                "--preset", "llama3-150m", "--batch", "8",
                "--prompt-len", "64", "--max-new-tokens", "512"]
         for blk in ("256", "0"):
-            run_phase(out, f"decode-block-{blk}",
+            maybe_run_phase(out, f"decode-block-{blk}",
                       gen + ["--decode-block", blk])
         long_gen = [py, "-m", "tpu_network_operator.workload", "generate",
                     "--preset", "llama3-150m", "--batch", "8",
                     "--prompt-len", "1024", "--max-new-tokens", "32"]
         for flag in ("1", "0"):
-            run_phase(out, f"flash-prefill-{flag}", long_gen,
+            maybe_run_phase(out, f"flash-prefill-{flag}", long_gen,
                       env={"TPUNET_DECODE_FLASH": flag})
         # 5. remat/offload policy search at the 1B geometry — the
         # docs/perf.md remat x1.3 term (VERDICT r4 #8)
-        run_phase(out, "remat-search",
+        maybe_run_phase(out, "remat-search",
                   [py, "tools/remat_search.py", "--config", "llama3-1b",
                    "--opts", "adamw,adam8"],
                   env={"BENCH_ITERS": args.iters}, timeout=7200)
         # 6. stage-by-stage MFU decomposition at the headline geometry
         # (fwd ceiling / remat multiplier / optimizer share / MXU probe)
-        run_phase(out, "perf-decomp",
+        maybe_run_phase(out, "perf-decomp",
                   [py, "tools/perf_decomp.py", "--config", "llama3-1b",
                    "--batch", "4", "--iters", args.iters])
+        # 7. int8-KV decode cost at the tracked geometry (the round-5
+        # tunnel drop left exactly this unmeasured; the capacity win is
+        # already in BASELINE.md — this prices it)
+        dec = [py, "-m", "tpu_network_operator.workload", "generate",
+               "--preset", "llama3-1b", "--batch", "64",
+               "--prompt-len", "128", "--max-new-tokens", "512"]
+        for kd in ("native", "int8"):
+            maybe_run_phase(out, f"decode-kv-{kd}", dec + ["--kv-dtype", kd])
     print(f"done -> {args.out}")
     return 0
 
