@@ -96,6 +96,17 @@ class TestInitDevices:
         ok, out = bench._call_with_timeout(lambda: "x", 0)
         assert ok and out == "x"
 
+    def test_system_exit_propagates_without_retry(self):
+        """KeyboardInterrupt/SystemExit are not transient backend
+        failures — no backoff budget may be burned on them."""
+        def bail():
+            raise SystemExit(3)
+
+        sleeps = []
+        with pytest.raises(SystemExit):
+            bench.init_devices(bail, sleep=sleeps.append, timeout=30)
+        assert sleeps == []
+
     def test_worker_base_exception_is_reported(self):
         def bail():
             raise SystemExit(3)

@@ -164,6 +164,19 @@ def test_generate(capsys):
     assert r["unit"] == "tokens/sec"
     assert r["value"] > 0
     assert r["out_shape"] == [4, 16]
+    assert r["kv_dtype"] == "native"
+
+
+def test_generate_int8_kv(capsys):
+    """--kv-dtype int8 plumbs to the quantized cache and still decodes
+    on the sharded mesh (the scale arrays shard like the cache)."""
+    r = run(capsys, [
+        "generate", "--batch", "8", "--prompt-len", "8",
+        "--max-new-tokens", "8", "--kv-dtype", "int8",
+    ])
+    assert r["kv_dtype"] == "int8"
+    assert r["value"] > 0
+    assert r["out_shape"] == [8, 16]
 
 
 def test_train_from_bootstrap_file(capsys, tmp_path):

@@ -73,11 +73,11 @@ def main() -> int:
     py = sys.executable
     wanted = [p.strip() for p in args.phases.split(",") if p.strip()]
 
-    def maybe_run_phase(out, name, argv, env=None, timeout=3600):
+    def maybe_run_phase(out, name, argv, **kw):
         if wanted and not any(w in name for w in wanted):
             print(f"-- {name}: skipped (--phases)", flush=True)
             return None
-        return run_phase(out, name, argv, env=env, timeout=timeout)
+        return run_phase(out, name, argv, **kw)
 
     with open(args.out, "a") as out:
         maybe_run_phase(out, "bench-ladder", [py, "bench.py"],
