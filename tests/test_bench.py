@@ -177,3 +177,34 @@ class TestMeasureDecode:
             assert r["tokens_per_sec"] > 0
             assert r["new_tokens"] == 8
         assert out["best"] in out["rows"]
+
+
+class TestControllerBench:
+    def test_reports_cached_vs_uncached_artifact(self, tmp_path):
+        """The controller bench phase (tools/controller_bench.py) at toy
+        scale: BENCH-style JSON artifact with reconciles/sec and
+        apiserver-requests-per-reconcile for cached vs uncached mode,
+        and the cached mode's warm passes issue ZERO read requests."""
+        out = tmp_path / "controller_bench.json"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "controller_bench.py"),
+             "--policies", "3", "--nodes", "3", "--rounds", "2",
+             "--out", str(out)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert row == json.loads(out.read_text())
+        assert row["unit"] == "reconciles/sec" and row["value"] > 0
+        modes = {(r["mode"], r["workers"]) for r in row["rows"]}
+        assert {("uncached", 1), ("cached", 1), ("cached", 4)} <= modes
+        assert row["cached_reads_per_reconcile"] == 0.0
+        # writes may rarely appear (conflict retry when a trigger event
+        # outruns the cache stream) but stay far below uncached reads
+        assert row["cached_requests_per_reconcile"] < 1.0
+        assert row["uncached_requests_per_reconcile"] >= 3.0
+        for r in row["rows"]:
+            assert r["reconciles_per_sec"] > 0
+            if r["mode"] == "cached":
+                assert r["apiserver_reads_per_reconcile"] == 0.0

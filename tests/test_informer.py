@@ -1,0 +1,283 @@
+"""Informer cache + CachedClient correctness.
+
+The cache contract the reconciler now leans on: reads served from a
+watch-fed local store are equivalent to reads against the apiserver —
+same objects, same NotFound, same field-index and label-selector
+semantics — while issuing zero GET/LIST wire requests once warm.
+"""
+
+import time
+
+import pytest
+
+from tpu_network_operator.kube import NotFoundError
+from tpu_network_operator.kube.client import ApiClient
+from tpu_network_operator.kube.fake import FakeCluster
+from tpu_network_operator.kube.informer import CachedClient, Informer, Store
+from tpu_network_operator.kube.wire import WireApiServer
+
+NS = "tpunet-system"
+
+
+def mk(kind, name, namespace="", labels=None, rv=None, **extra):
+    obj = {
+        "apiVersion": "v1",
+        "kind": kind,
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": labels or {}},
+        **extra,
+    }
+    if rv is not None:
+        obj["metadata"]["resourceVersion"] = str(rv)
+    return obj
+
+
+class TestStore:
+    def test_upsert_get_delete(self):
+        s = Store()
+        s.upsert(mk("Pod", "a", NS))
+        assert s.get("a", NS)["metadata"]["name"] == "a"
+        assert s.get("a") is None          # wrong namespace
+        s.delete(NS, "a")
+        assert s.get("a", NS) is None
+        assert len(s) == 0
+
+    def test_reads_are_copies(self):
+        """A caller mutating a cached object (the reconciler's DS
+        projection does exactly this) must not corrupt the store."""
+        s = Store()
+        s.upsert(mk("Pod", "a", NS, spec={"nodeName": "n1"}))
+        got = s.list(namespace=NS)[0]
+        got["spec"]["nodeName"] = "mutated"
+        assert s.get("a", NS)["spec"]["nodeName"] == "n1"
+
+    def test_label_selector(self):
+        s = Store()
+        s.upsert(mk("Lease", "l1", NS, labels={"agent": "true"}))
+        s.upsert(mk("Lease", "l2", NS, labels={"agent": "false"}))
+        names = [o["metadata"]["name"]
+                 for o in s.list(label_selector={"agent": "true"})]
+        assert names == ["l1"]
+
+    def test_field_index_evaluated_at_insert(self):
+        s = Store()
+        s.register_index("by-node", lambda o: [o["spec"]["nodeName"]])
+        s.upsert(mk("Pod", "a", NS, spec={"nodeName": "n1"}))
+        s.upsert(mk("Pod", "b", NS, spec={"nodeName": "n2"}))
+        got = s.list(field_index={"by-node": "n1"})
+        assert [o["metadata"]["name"] for o in got] == ["a"]
+        # re-upsert moving the pod re-indexes it (stale postings pruned)
+        s.upsert(mk("Pod", "a", NS, spec={"nodeName": "n2"}))
+        assert s.list(field_index={"by-node": "n1"}) == []
+        assert len(s.list(field_index={"by-node": "n2"})) == 2
+        s.delete(NS, "b")
+        assert len(s.list(field_index={"by-node": "n2"})) == 1
+
+    def test_index_backfills_existing_objects(self):
+        s = Store()
+        s.upsert(mk("Pod", "a", NS, spec={"nodeName": "n1"}))
+        s.register_index("by-node", lambda o: [o["spec"]["nodeName"]])
+        assert len(s.list(field_index={"by-node": "n1"})) == 1
+
+    def test_unregistered_index_is_programming_error(self):
+        s = Store()
+        with pytest.raises(KeyError):
+            s.list(field_index={"nope": "x"})
+
+
+class TestInformerOverFake:
+    def test_seed_then_watch_updates(self):
+        fake = FakeCluster()
+        fake.create(mk("ConfigMap", "pre", NS))
+        inf = Informer(fake, "v1", "ConfigMap", namespace=NS).start()
+        assert inf.store.get("pre", NS)                  # seeded by LIST
+        fake.create(mk("ConfigMap", "live", NS))
+        inf.sync()                                       # watch-fed
+        assert inf.store.get("live", NS)
+        fake.delete("v1", "ConfigMap", "live", NS)
+        inf.sync()
+        assert inf.store.get("live", NS) is None
+
+    def test_namespace_scope_filters_watch(self):
+        fake = FakeCluster()
+        inf = Informer(fake, "v1", "ConfigMap", namespace=NS).start()
+        fake.create(mk("ConfigMap", "other", "elsewhere"))
+        inf.sync()
+        assert inf.store.get("other", "elsewhere") is None
+
+    def test_stale_event_does_not_regress_store(self):
+        fake = FakeCluster()
+        inf = Informer(fake, "v1", "ConfigMap", namespace=NS).start()
+        fresh = mk("ConfigMap", "c", NS, rv=100, data={"v": "new"})
+        inf.store.upsert(fresh)
+        # a replayed older event (watch reconnect duplicates) must lose
+        inf._apply("MODIFIED", mk("ConfigMap", "c", NS, rv=7,
+                                  data={"v": "old"}))
+        assert inf.store.get("c", NS)["data"]["v"] == "new"
+
+    def test_stale_delete_does_not_remove_recreated_object(self):
+        """A buffered DELETED (rv n) draining after the seed list already
+        holds the re-created successor (rv n+1) must not remove it."""
+        fake = FakeCluster()
+        inf = Informer(fake, "v1", "ConfigMap", namespace=NS).start()
+        inf.store.upsert(mk("ConfigMap", "c", NS, rv=100))
+        inf._apply("DELETED", mk("ConfigMap", "c", NS, rv=40))
+        assert inf.store.get("c", NS) is not None
+        # a delete at/after the stored rv still applies
+        inf._apply("DELETED", mk("ConfigMap", "c", NS, rv=101))
+        assert inf.store.get("c", NS) is None
+
+    def test_resync_prunes_deletions_missed_by_watch(self):
+        fake = FakeCluster()
+        fake.create(mk("ConfigMap", "ghost", NS))
+        inf = Informer(fake, "v1", "ConfigMap", namespace=NS).start()
+        # simulate a deletion the watch never delivered (watch was down)
+        inf._watch.stop()
+        fake.delete("v1", "ConfigMap", "ghost", NS)
+        assert inf.store.get("ghost", NS) is not None    # stale
+        inf.resync()
+        assert inf.store.get("ghost", NS) is None
+
+    def test_resync_does_not_resurrect_mid_relist_delete(self):
+        """An object whose DELETED event the pump applies while the
+        resync LIST is in flight must stay deleted — the stale snapshot
+        copy must not be upserted back as a zombie."""
+        from types import SimpleNamespace
+
+        fake = FakeCluster()
+        fake.create(mk("ConfigMap", "z", NS))
+        inf = Informer(fake, "v1", "ConfigMap", namespace=NS).start()
+        assert inf.store.get("z", NS) is not None
+
+        def racing_list(av, kind, **kw):
+            items = fake.list(av, kind, **kw)   # snapshot includes "z"
+            fake.delete("v1", "ConfigMap", "z", NS)
+            inf.sync()                          # pump runs mid-relist
+            return items
+
+        inf.client = SimpleNamespace(list=racing_list)
+        inf.resync()
+        assert inf.store.get("z", NS) is None
+
+    def test_event_handlers_fire_after_store_update(self):
+        fake = FakeCluster()
+        inf = Informer(fake, "v1", "ConfigMap", namespace=NS).start()
+        seen = []
+        inf.add_event_handler(
+            lambda ev, obj: seen.append(
+                (ev, inf.store.get(obj["metadata"]["name"], NS) is not None)
+            )
+        )
+        fake.create(mk("ConfigMap", "h", NS))
+        inf.sync()
+        assert seen == [("ADDED", True)]   # store current when handler ran
+
+
+class TestCachedClient:
+    def _cached(self, fake):
+        cached = CachedClient(fake)
+        cached.cache("v1", "ConfigMap", namespace=NS)
+        cached.start()
+        return cached
+
+    def test_reads_from_cache_writes_pass_through(self):
+        fake = FakeCluster()
+        cached = self._cached(fake)
+        cached.create(mk("ConfigMap", "a", NS))      # write → apiserver
+        assert fake.get("v1", "ConfigMap", "a", NS)
+        before = dict(fake.request_counts)
+        got = cached.get("v1", "ConfigMap", "a", NS)
+        assert got["metadata"]["name"] == "a"
+        assert cached.list("v1", "ConfigMap", namespace=NS)
+        after = dict(fake.request_counts)
+        assert before == after, "cached reads must not touch the apiserver"
+
+    def test_cache_miss_reads_through_to_inner(self):
+        fake = FakeCluster()
+        cached = self._cached(fake)
+        with pytest.raises(NotFoundError):
+            cached.get("v1", "ConfigMap", "missing", NS)
+        cached.create(mk("ConfigMap", "blink", NS))
+        cached.delete("v1", "ConfigMap", "blink", NS)
+        with pytest.raises(NotFoundError):
+            cached.get("v1", "ConfigMap", "blink", NS)
+        # a cache miss for an object that DOES exist (trigger event beat
+        # the cache stream) reads through instead of dropping to NotFound
+        fake.create(mk("ConfigMap", "raced", NS))
+        cached.list("v1", "ConfigMap", namespace=NS)   # drain the queue
+        cached.informer("v1", "ConfigMap").store.delete(NS, "raced")  # lag
+        assert cached.get("v1", "ConfigMap", "raced", NS)
+
+    def test_uncached_kind_and_foreign_namespace_fall_through(self):
+        fake = FakeCluster()
+        cached = self._cached(fake)
+        fake.create(mk("Secret", "s", NS))
+        assert cached.get("v1", "Secret", "s", NS)   # un-cached kind
+        fake.create(mk("ConfigMap", "far", "other-ns"))
+        assert cached.get("v1", "ConfigMap", "far", "other-ns")
+        counts = dict(fake.request_counts)
+        assert counts[("get", "Secret")] >= 1
+        assert counts[("get", "ConfigMap")] >= 1
+
+    def test_register_index_reaches_cache_and_inner(self):
+        fake = FakeCluster()
+        cached = self._cached(fake)
+        cached.register_index(
+            "v1", "ConfigMap", "by-tier",
+            lambda o: [o["metadata"].get("labels", {}).get("tier", "")],
+        )
+        cached.create(mk("ConfigMap", "web", NS, labels={"tier": "web"}))
+        cached.create(mk("ConfigMap", "db", NS, labels={"tier": "db"}))
+        got = cached.list("v1", "ConfigMap", namespace=NS,
+                          field_index={"by-tier": "web"})
+        assert [o["metadata"]["name"] for o in got] == ["web"]
+        # inner client answers the same query (fallthrough parity)
+        raw = fake.list("v1", "ConfigMap", namespace=NS,
+                        field_index={"by-tier": "web"})
+        assert [o["metadata"]["name"] for o in raw] == ["web"]
+
+    def test_cache_objects_gauge(self):
+        from tpu_network_operator.controller.health import Metrics
+
+        fake = FakeCluster()
+        metrics = Metrics()
+        cached = CachedClient(fake, metrics=metrics)
+        cached.cache("v1", "ConfigMap", namespace=NS)
+        cached.start()
+        cached.create(mk("ConfigMap", "a", NS))
+        cached.list("v1", "ConfigMap", namespace=NS)
+        assert 'tpunet_cache_objects{kind="ConfigMap"} 1.0' in metrics.render()
+
+
+class TestCachedClientOverWire:
+    """The same split client against the real wire path: ApiClient +
+    WireApiServer, watch streams feeding the cache over HTTP."""
+
+    def test_warm_cache_serves_reads_without_wire_requests(self):
+        srv = WireApiServer().start()
+        try:
+            client = ApiClient(srv.url)
+            cached = CachedClient(client)
+            cached.cache("v1", "ConfigMap", namespace=NS)
+            cached.start()
+            cached.create(mk("ConfigMap", "a", NS))
+            # the watch stream delivers the create asynchronously
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                try:
+                    cached.get("v1", "ConfigMap", "a", NS)
+                    break
+                except NotFoundError:
+                    time.sleep(0.02)
+            before = dict(client.request_counts)
+            for _ in range(10):
+                cached.get("v1", "ConfigMap", "a", NS)
+                cached.list("v1", "ConfigMap", namespace=NS)
+            after = dict(client.request_counts)
+            assert before == after, (
+                "warm cached reads must issue zero wire requests"
+            )
+            cached.stop()
+            client.close()
+        finally:
+            srv.stop()

@@ -265,6 +265,12 @@ def test_manager_counts_reconciles():
     # which registers no custom metric at all)
     assert 'tpunet_policy_targets{policy="p1"} 0' in rendered
     assert 'tpunet_policy_all_good{policy="p1"} 0.0' in rendered
+    # reconcile latency histogram: prometheus exposition with cumulative
+    # le buckets, _sum and _count
+    assert "# TYPE tpunet_reconcile_duration_seconds histogram" in rendered
+    assert 'tpunet_reconcile_duration_seconds_bucket{le="+Inf"}' in rendered
+    assert "tpunet_reconcile_duration_seconds_count" in rendered
+    assert "tpunet_reconcile_duration_seconds_sum" in rendered
     # deleting the CR retracts its series (no phantom export)
     cluster.delete("tpunet.dev/v1alpha1", "NetworkClusterPolicy", "p1")
     mgr.drain()
@@ -407,3 +413,27 @@ def test_operator_flag_parsing():
     assert op_main._port_of(args.metrics_bind_address) == 8443
     assert args.leader_elect and args.namespace == "tpunet-system"
     assert op_main._port_of("0") == 0
+    # controller scaling knobs (docs/operator-guide.md "Scaling the
+    # control plane")
+    assert args.concurrent_reconciles == 4
+    assert args.cache_resync_seconds == 300.0
+    args = op_main.build_parser().parse_args(["--concurrent-reconciles", "8"])
+    assert args.concurrent_reconciles == 8
+
+
+def test_apiserver_request_counter_series():
+    """The request-accounting seam: FakeCluster (and ApiClient, same
+    seam) exports tpunet_apiserver_requests_total{verb,kind} when a
+    registry is attached."""
+    metrics = Metrics()
+    cluster = FakeCluster()
+    cluster.metrics = metrics
+    cluster.create(make_policy())
+    cluster.list("tpunet.dev/v1alpha1", "NetworkClusterPolicy")
+    cluster.list("tpunet.dev/v1alpha1", "NetworkClusterPolicy")
+    rendered = metrics.render()
+    assert ('tpunet_apiserver_requests_total'
+            '{kind="NetworkClusterPolicy",verb="create"} 1') in rendered
+    assert ('tpunet_apiserver_requests_total'
+            '{kind="NetworkClusterPolicy",verb="list"} 2') in rendered
+    assert cluster.request_counts[("list", "NetworkClusterPolicy")] == 2

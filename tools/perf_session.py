@@ -16,7 +16,10 @@ Phases:
 5. remat/offload/optimizer policy search at the 1B geometry
    (tools/remat_search.py);
 6. stage-by-stage MFU decomposition (tools/perf_decomp.py);
-7. int8-KV decode cost ablation at the tracked b64 geometry.
+7. int8-KV decode cost ablation at the tracked b64 geometry;
+8. controller-plane bench: reconciles/sec + apiserver requests per
+   reconcile, cached vs uncached (tools/controller_bench.py — no TPU
+   needed).
 
 Usage: python tools/perf_session.py [--out perf_session.jsonl]
 """
@@ -120,6 +123,11 @@ def main() -> int:
                "--prompt-len", "128", "--max-new-tokens", "512"]
         for kd in ("native", "int8"):
             maybe_run_phase(out, f"decode-kv-{kd}", dec + ["--kv-dtype", kd])
+        # 8. controller plane: reconciles/sec + apiserver requests per
+        # reconcile, cached vs uncached (needs no TPU — the wire harness
+        # runs anywhere; tracked per-round like the train rungs)
+        maybe_run_phase(out, "controller-bench",
+                  [py, "tools/controller_bench.py"], timeout=600)
     print(f"done -> {args.out}")
     return 0
 

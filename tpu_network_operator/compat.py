@@ -1,0 +1,30 @@
+"""Version-compat shims for the jax API surface this repo targets.
+
+The codebase is written against the jax>=0.8 API (``jax.shard_map`` with
+``check_vma``); older runtimes (0.4.x) ship the same primitive as
+``jax.experimental.shard_map.shard_map`` with the ``check_rep`` keyword
+instead.  Importing :data:`shard_map` from here gives every call site one
+spelling that works on both — call sites keep writing the modern
+``check_vma=`` form and the shim translates when needed.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:
+    from jax import shard_map as _shard_map   # jax >= 0.8
+except ImportError:   # jax < 0.8: experimental home, check_rep keyword
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    @functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+__all__ = ["shard_map"]

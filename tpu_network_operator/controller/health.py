@@ -23,10 +23,19 @@ log = logging.getLogger("tpunet.health")
 class Metrics:
     """Process-wide metric registry (tiny prometheus_client analog)."""
 
+    # prometheus_client's default duration buckets — reconcile latency
+    # lands mid-range, and sharing the canonical edges keeps dashboards
+    # portable
+    HISTOGRAM_BUCKETS = (
+        0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    )
+
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Counter = Counter()
         self._gauges: Dict[Tuple[str, tuple], float] = {}
+        # (name, labels) -> [bucket counts..., +Inf count, sum]
+        self._histograms: Dict[Tuple[str, tuple], List[float]] = {}
         self.start_time = time.time()
 
     def inc(self, name: str, labels: Optional[Dict[str, str]] = None, by: float = 1):
@@ -42,6 +51,24 @@ class Metrics:
         exported as healthy phantoms until restart."""
         with self._lock:
             self._gauges.pop((name, _label_key(labels)), None)
+
+    def observe(self, name: str, value: float,
+                labels: Optional[Dict[str, str]] = None):
+        """Record one histogram observation (cumulative le buckets,
+        prometheus exposition semantics)."""
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                # one slot per finite bucket + the +Inf count + the sum
+                h = self._histograms[key] = [0.0] * (
+                    len(self.HISTOGRAM_BUCKETS) + 2
+                )
+            for i, le in enumerate(self.HISTOGRAM_BUCKETS):
+                if value <= le:
+                    h[i] += 1
+            h[-2] += 1          # +Inf / _count
+            h[-1] += value      # _sum
 
     def render(self) -> str:
         """Prometheus text exposition format."""
@@ -60,6 +87,19 @@ class Metrics:
                 by_name.setdefault(f"# TYPE {name} gauge", []).append(
                     f"{name}{_fmt_labels(labels)} {val}"
                 )
+            for (name, labels), h in sorted(self._histograms.items()):
+                series = by_name.setdefault(f"# TYPE {name} histogram", [])
+                for le, count in zip(self.HISTOGRAM_BUCKETS, h):
+                    series.append(
+                        f"{name}_bucket{_fmt_labels(labels + (('le', le),))}"
+                        f" {count:g}"
+                    )
+                series.append(
+                    f'{name}_bucket{_fmt_labels(labels + (("le", "+Inf"),))}'
+                    f" {h[-2]:g}"
+                )
+                series.append(f"{name}_sum{_fmt_labels(labels)} {h[-1]:g}")
+                series.append(f"{name}_count{_fmt_labels(labels)} {h[-2]:g}")
         for header, series in by_name.items():
             lines.append(header)
             lines.extend(series)

@@ -22,7 +22,10 @@ import sys
 import threading
 from typing import List, Optional
 
+from ..agent.report import LEASE_API
+from ..api.v1alpha1.types import API_VERSION, NetworkClusterPolicy
 from ..kube.client import ApiClient, is_openshift
+from ..kube.informer import CachedClient
 from .health import DEFAULT as METRICS, CachedTokenAuthenticator, HealthServer
 from .leader import LeaderElector
 from .manager import Manager
@@ -77,6 +80,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="agent-report Lease list cache window: one "
                         "namespace-wide list serves all policies' status "
                         "passes for this long (0 = refetch every pass)")
+    p.add_argument("--concurrent-reconciles", type=int, default=4,
+                   help="workqueue worker count (controller-runtime's "
+                        "MaxConcurrentReconciles)")
+    p.add_argument("--cache-resync-seconds", type=float, default=300.0,
+                   help="informer cache relist interval — the backstop "
+                        "that prunes objects deleted while a watch was "
+                        "down (0 = watch-only, never relist)")
     return p
 
 
@@ -106,8 +116,26 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
     log.info("starting manager (namespace=%s, openshift=%s)",
              args.namespace, openshift)
 
-    mgr = Manager(client, namespace=args.namespace, is_openshift=openshift,
-                  metrics=METRICS)
+    # apiserver-request accounting on the raw client; the informer cache
+    # layered above it is what keeps the steady-state count flat
+    if hasattr(client, "metrics"):
+        client.metrics = METRICS
+
+    # informer cache over every kind the reconcile loop reads
+    # (controller-runtime's cache-backed manager client): steady-state
+    # reconciles then cost zero GET/LIST round-trips — the watch streams
+    # carry all updates.  Leader election and TokenReview stay on the raw
+    # client below: election correctness must never ride a cached read.
+    cached = CachedClient(client, metrics=METRICS,
+                          resync_interval=args.cache_resync_seconds)
+    cached.cache(API_VERSION, NetworkClusterPolicy.KIND)
+    cached.cache("apps/v1", "DaemonSet", namespace=args.namespace)
+    cached.cache("v1", "Pod", namespace=args.namespace)
+    cached.cache(LEASE_API, "Lease", namespace=args.namespace)
+
+    mgr = Manager(cached, namespace=args.namespace, is_openshift=openshift,
+                  metrics=METRICS,
+                  concurrent_reconciles=args.concurrent_reconciles)
     mgr.reconciler.REPORT_CACHE_SECONDS = args.report_cache_seconds
 
     servers = []
@@ -165,9 +193,11 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
     started = threading.Event()
 
     def start_controllers():
+        cached.start()   # seed lists + watches before the first reconcile
         mgr.start()
         started.set()
-        log.info("controllers started")
+        log.info("controllers started (workers=%d)",
+                 args.concurrent_reconciles)
 
     elector = None
     if args.leader_elect:
@@ -185,6 +215,10 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
         webhook_server.start()
     if health:
         health.add_readyz("controllers-started", started.is_set)
+        health.add_readyz(
+            "cache-synced",
+            lambda: not started.is_set() or cached.has_synced(),
+        )
 
     if elector:
         threading.Thread(
@@ -200,6 +234,7 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
     if elector:
         elector.stop()
     mgr.stop()
+    cached.stop()
     if webhook_server:
         webhook_server.stop()
     for s in servers:

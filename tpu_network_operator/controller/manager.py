@@ -5,43 +5,127 @@ controller-runtime analog (ref ``cmd/operator/main.go:169-229`` +
 the CR and its owned DaemonSets, maps DaemonSet events back to the owning CR
 (the ``Owns`` relationship), deduplicates into a workqueue, and runs the
 reconciler per item.  The hot loop is the workqueue drain, exactly as in the
-reference (SURVEY.md §3.1).
+reference (SURVEY.md §3.1) — here drained by ``concurrent_reconciles``
+workers (controller-runtime's MaxConcurrentReconciles), over a queue with
+the client-go processing-set contract: a key being reconciled is never
+handed to a second worker, and a key re-enqueued mid-reconcile runs again
+after the in-flight pass completes.
 """
 
 from __future__ import annotations
 
 import logging
-import queue
 import threading
+import time
+from collections import deque
 from typing import Optional
 
 from ..api.v1alpha1.types import API_VERSION, NetworkClusterPolicy
+from ..kube.informer import LIST_PAGE_SIZE
 from .reconciler import NetworkClusterPolicyReconciler, controller_of
 
 log = logging.getLogger("tpunet.manager")
+
+
+class WorkQueue:
+    """client-go workqueue semantics (util/workqueue.Type): FIFO with
+    dedup, and two invariants that make concurrent workers safe:
+
+    * a key handed to a worker (``get``) sits in the *processing* set and
+      is never handed to a second worker until ``done``;
+    * an ``add`` while the key is processing marks it *dirty* — it is
+      re-queued by ``done``, so an event arriving mid-reconcile is
+      honored, not lost (the seed's pop-then-reconcile dropped these).
+    """
+
+    def __init__(self, metrics=None):
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._dirty: set = set()
+        self._processing: set = set()
+        self._metrics = metrics
+
+    def _export_depth(self) -> None:
+        # caller holds _cond — the gauge tracks every transition
+        # (add/get/done-requeue), not just enqueues
+        if self._metrics:
+            self._metrics.set_gauge(
+                "tpunet_workqueue_depth", float(len(self._queue))
+            )
+
+    def add(self, item) -> None:
+        with self._cond:
+            if item in self._dirty:
+                return              # already queued (or queued-behind)
+            self._dirty.add(item)
+            if item in self._processing:
+                return              # done() will re-queue it
+            self._queue.append(item)
+            self._export_depth()
+            self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None):
+        """Next key, or None on timeout.  The key moves to processing —
+        the caller MUST pair this with :meth:`done`."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._queue:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            item = self._queue.popleft()
+            self._processing.add(item)
+            self._dirty.discard(item)
+            self._export_depth()
+            return item
+
+    def done(self, item) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._export_depth()
+                self._cond.notify()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def idle(self) -> bool:
+        """Nothing queued and nothing in flight."""
+        with self._cond:
+            return not self._queue and not self._processing
 
 
 class Manager:
     def __init__(
         self, client, namespace: str, is_openshift: bool = False,
         metrics=None, resync_interval: float = 60.0,
+        concurrent_reconciles: int = 4,
     ):
         self.client = client
         self.namespace = namespace
         self.metrics = metrics
         self.resync_interval = resync_interval
+        self.concurrent_reconciles = max(1, int(concurrent_reconciles))
         self.reconciler = NetworkClusterPolicyReconciler(
             client, namespace, is_openshift, metrics=metrics
         )
-        self._queue: "queue.Queue[str]" = queue.Queue()
-        self._pending = set()
-        self._pending_lock = threading.Lock()
+        self._queue = WorkQueue(metrics=metrics)
         self._stop = threading.Event()
         self._threads = []
         # rate-limited requeue (controller-runtime's default item backoff:
         # 5ms base, exponential, capped) — without it a permanently-failing
-        # item spins the worker hot
+        # item spins the worker hot.  Mutated from worker threads and
+        # Timer callbacks, so every access holds _failures_lock; entries
+        # are pruned on success AND on policy deletion (a deleted
+        # permanently-failing CR must not leak its counter forever).
         self._failures: dict = {}
+        self._failures_lock = threading.Lock()
+        self._backoff_timers: dict = {}
         self._backoff_base = 0.005
         self._backoff_max = 30.0
         # watches start at construction so no event is missed between
@@ -49,29 +133,25 @@ class Manager:
         self._w_policies = client.watch(API_VERSION, NetworkClusterPolicy.KIND)
         self._w_daemonsets = client.watch("apps/v1", "DaemonSet")
 
-    # -- workqueue with dedup (controller-runtime workqueue analog) ----------
+    # -- workqueue (see WorkQueue for the dedup/processing contract) ----------
 
     def enqueue(self, name: str) -> None:
-        with self._pending_lock:
-            if name in self._pending:
-                return
-            self._pending.add(name)
-        self._queue.put(name)
-
-    def _pop(self, timeout: Optional[float]) -> Optional[str]:
-        try:
-            name = self._queue.get(timeout=timeout)
-        except queue.Empty:
-            return None
-        with self._pending_lock:
-            self._pending.discard(name)
-        return name
+        self._queue.add(name)
 
     # -- event sources --------------------------------------------------------
 
     def _handle_policy_event(self, ev) -> None:
-        _, obj = ev
-        self.enqueue(obj["metadata"]["name"])
+        ev_type, obj = ev
+        name = obj["metadata"]["name"]
+        if ev_type == "DELETED":
+            # prune backoff state: the failure counter (and any pending
+            # requeue timer) for a deleted policy must not outlive it
+            with self._failures_lock:
+                self._failures.pop(name, None)
+                timer = self._backoff_timers.pop(name, None)
+            if timer is not None:
+                timer.cancel()
+        self.enqueue(name)
 
     def _handle_daemonset_event(self, ev) -> None:
         """Owns(DaemonSet): map the event to the owning CR (ref
@@ -103,44 +183,82 @@ class Manager:
 
     def _worker(self) -> None:
         while not self._stop.is_set():
-            name = self._pop(timeout=0.2)
+            name = self._queue.get(timeout=0.2)
             if name is None:
                 continue
-            self._reconcile_one(name)
+            try:
+                self._reconcile_one(name)
+            finally:
+                self._queue.done(name)
 
-    def _requeue_after_failure(self, name: str) -> None:
-        count = self._failures.get(name, 0) + 1
-        self._failures[name] = count
-        delay = min(self._backoff_base * (2 ** count), self._backoff_max)
-        timer = threading.Timer(delay, self.enqueue, args=(name,))
+    def _schedule_requeue(self, name: str, delay: float) -> None:
+        """Re-enqueue ``name`` after ``delay`` on a tracked timer (one
+        pending timer per key; stop() cancels them all)."""
+        timer = threading.Timer(delay, self._fire_backoff, args=(name,))
         timer.daemon = True
+        with self._failures_lock:
+            old = self._backoff_timers.get(name)
+            self._backoff_timers[name] = timer
+        if old is not None:
+            old.cancel()
         timer.start()
 
+    def _requeue_after_failure(self, name: str) -> None:
+        with self._failures_lock:
+            count = self._failures.get(name, 0) + 1
+            self._failures[name] = count
+        delay = min(self._backoff_base * (2 ** count), self._backoff_max)
+        self._schedule_requeue(name, delay)
+
+    def _fire_backoff(self, name: str) -> None:
+        with self._failures_lock:
+            self._backoff_timers.pop(name, None)
+        self.enqueue(name)
+
     def _reconcile_one(self, name: str) -> None:
+        t0 = time.monotonic()
         try:
             result = self.reconciler.reconcile(name)
-            self._failures.pop(name, None)
+            with self._failures_lock:
+                self._failures.pop(name, None)
             if self.metrics:
                 self.metrics.inc(
                     "tpunet_reconcile_total",
                     {"result": "requeue" if result.requeue else "success"},
                 )
             if result.requeue:
-                self.enqueue(name)
+                if result.requeue_after > 0:
+                    # RequeueAfter: delay the retry (e.g. waiting out the
+                    # cache's watch-delivery lag) instead of hot-looping
+                    self._schedule_requeue(name, result.requeue_after)
+                else:
+                    self.enqueue(name)
         except Exception:
             log.exception("reconcile failed for %s; requeueing with backoff", name)
             if self.metrics:
                 self.metrics.inc("tpunet_reconcile_total", {"result": "error"})
             self._requeue_after_failure(name)
+        finally:
+            if self.metrics:
+                self.metrics.observe(
+                    "tpunet_reconcile_duration_seconds",
+                    time.monotonic() - t0,
+                )
 
     def start(self) -> None:
-        """Start watches + one worker in the background (mgr.Start analog)."""
+        """Start watches + ``concurrent_reconciles`` workers in the
+        background (mgr.Start analog)."""
         self.reconciler.setup()
-        # seed: reconcile everything that already exists (informer initial list)
-        for obj in self.client.list(API_VERSION, NetworkClusterPolicy.KIND):
+        # seed: reconcile everything that already exists (informer initial
+        # list) — chunked, like every other wire list in the control plane
+        for obj in self.client.list(
+            API_VERSION, NetworkClusterPolicy.KIND, limit=LIST_PAGE_SIZE
+        ):
             self.enqueue(obj["metadata"]["name"])
-        for fn in (self._watch_policies, self._watch_daemonsets,
-                   self._worker, self._resync_loop):
+        loops = [self._watch_policies, self._watch_daemonsets,
+                 self._resync_loop]
+        loops += [self._worker] * self.concurrent_reconciles
+        for fn in loops:
             th = threading.Thread(target=fn, daemon=True)
             th.start()
             self._threads.append(th)
@@ -154,7 +272,8 @@ class Manager:
         while not self._stop.wait(self.resync_interval):
             try:
                 for obj in self.client.list(
-                    API_VERSION, NetworkClusterPolicy.KIND
+                    API_VERSION, NetworkClusterPolicy.KIND,
+                    limit=LIST_PAGE_SIZE,
                 ):
                     self.enqueue(obj["metadata"]["name"])
             except Exception as e:   # noqa: BLE001 — next tick retries
@@ -162,6 +281,11 @@ class Manager:
 
     def stop(self) -> None:
         self._stop.set()
+        with self._failures_lock:
+            timers = list(self._backoff_timers.values())
+            self._backoff_timers.clear()
+        for timer in timers:
+            timer.cancel()
         for th in self._threads:
             th.join(timeout=2)
 
@@ -187,9 +311,12 @@ class Manager:
         n = 0
         while n < max_iters:
             self._pump_events()
-            name = self._pop(timeout=0)
+            name = self._queue.get(timeout=0)
             if name is None:
                 return n
-            self._reconcile_one(name)
+            try:
+                self._reconcile_one(name)
+            finally:
+                self._queue.done(name)
             n += 1
         return n

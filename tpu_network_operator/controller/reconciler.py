@@ -16,6 +16,7 @@ from __future__ import annotations
 import copy
 import logging
 import os.path
+import threading
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
@@ -23,14 +24,12 @@ from ..api import apimachinery as am
 from ..api.v1alpha1 import types as t
 from ..api.v1alpha1.types import NetworkClusterPolicy
 from ..kube import errors as kerr
+from ..kube.informer import LIST_PAGE_SIZE   # noqa: F401 — re-exported
 from . import templates
 
 log = logging.getLogger("tpunet.controller")
 
 OWNER_KEY = ".metadata.controller"   # ref controller :58
-# list chunk size for the status pass's namespace-wide lists (the kube
-# convention client-go's pager defaults to)
-LIST_PAGE_SIZE = 500
 
 # gaudinet host/container paths (ref controller :65-67)
 GAUDINET_PATH_HOST = "/etc/habanalabs/gaudinet.json"
@@ -61,9 +60,11 @@ POLICY_GAUGES = (
 
 @dataclass
 class Result:
-    """ctrl.Result analog."""
+    """ctrl.Result analog: ``requeue_after`` > 0 delays the re-enqueue
+    (RequeueAfter), 0 re-enqueues immediately."""
 
     requeue: bool = False
+    requeue_after: float = 0.0
 
 
 def controller_of(obj: Dict[str, Any]) -> Optional[Dict[str, Any]]:
@@ -250,6 +251,9 @@ class NetworkClusterPolicyReconciler:
         self.metrics = metrics
         self._reports_cache: Optional[Dict[str, List[Any]]] = None
         self._reports_cached_at = 0.0
+        # concurrent workers share one reconciler instance; the bucket
+        # cache is its only cross-key mutable state
+        self._reports_lock = threading.Lock()
 
     # -- setup ----------------------------------------------------------------
 
@@ -372,7 +376,14 @@ class NetworkClusterPolicyReconciler:
 
         project(ds, policy, self.namespace)
         self._own(policy, ds)
-        self.client.create(ds)
+        try:
+            self.client.create(ds)
+        except kerr.AlreadyExistsError:
+            # the cached owned-DaemonSet list can lag the apiserver by
+            # the watch delivery delay; a racing reconcile created it
+            # first — retry after the typical delivery delay so the
+            # stale window cannot spin a hot create/409 loop
+            return Result(requeue=True, requeue_after=0.05)
         log.info("scale-out daemonset created: %s", ds["metadata"]["name"])
 
         if self.is_openshift:
@@ -426,12 +437,18 @@ class NetworkClusterPolicyReconciler:
 
         from ..agent import report as rpt
 
-        now = time_mod.time()
-        if (
-            self._reports_cache is not None
-            and now - self._reports_cached_at < self.REPORT_CACHE_SECONDS
-        ):
-            return self._reports_cache
+        # the lock covers only the cache check and the store — the list +
+        # parse run outside it, so concurrent workers serialize on the
+        # shared map, not on I/O (an expired window means a few workers
+        # may refresh at once; last-writer-wins is fine for a freshness
+        # cache and each writer stores a complete, self-consistent map)
+        with self._reports_lock:
+            now = time_mod.time()
+            if (
+                self._reports_cache is not None
+                and now - self._reports_cached_at < self.REPORT_CACHE_SECONDS
+            ):
+                return self._reports_cache
         try:
             leases = self.client.list(
                 rpt.LEASE_API,
@@ -445,6 +462,15 @@ class NetworkClusterPolicyReconciler:
         except Exception as e:   # noqa: BLE001 — absence = no reports yet
             log.debug("agent report list failed: %s", e)
             return {}
+        buckets = self._parse_buckets(leases, now, rpt)
+        with self._reports_lock:
+            self._reports_cache = buckets
+            self._reports_cached_at = now
+        return buckets
+
+    def _parse_buckets(
+        self, leases: List[Dict[str, Any]], now: float, rpt
+    ) -> Dict[str, List[Any]]:
         buckets: Dict[str, List[Any]] = {}
         for lease in leases:
             policy_name = (
@@ -468,7 +494,10 @@ class NetworkClusterPolicyReconciler:
             if (
                 rep.ok
                 and renewed is not None
-                and time_mod.time() - renewed > self.REPORT_TTL_SECONDS
+                # one clock read per pass (``now``): every lease ages
+                # against the same instant, so a long parse loop cannot
+                # flip later leases stale that earlier ones were not
+                and now - renewed > self.REPORT_TTL_SECONDS
             ):
                 out.append(rpt.ProvisioningReport(
                     node=rep.node, policy=rep.policy, ok=False,
@@ -476,8 +505,6 @@ class NetworkClusterPolicyReconciler:
                 ))
                 continue
             out.append(rep)
-        self._reports_cache = buckets
-        self._reports_cached_at = now
         return buckets
 
     def _target_nodes(self, ds: Dict[str, Any]) -> set:
@@ -567,7 +594,10 @@ class NetworkClusterPolicyReconciler:
             try:
                 self.client.update_status(policy.to_dict())
             except kerr.ConflictError:
-                return Result(requeue=True)
+                # over a cached read the CR copy (and its rv) stays stale
+                # until the watch delivers — retry after the delivery
+                # delay, not in a hot PUT/409 loop
+                return Result(requeue=True, requeue_after=0.05)
         return Result()
 
     # -- entry point ----------------------------------------------------------
@@ -599,6 +629,12 @@ class NetworkClusterPolicyReconciler:
         self._update_daemonset(ds, policy)
         if ds["spec"]["template"]["spec"] != original_spec:
             log.info("DS template drift; updating %s", ds["metadata"]["name"])
-            self.client.update(ds)
+            try:
+                self.client.update(ds)
+            except kerr.ConflictError:
+                # cached DS copy carried a stale rv (watch lag after a
+                # racing update) — a normal self-healing race, not an
+                # error; retry once the cache has the successor
+                return Result(requeue=True, requeue_after=0.05)
 
         return self._update_status(policy, ds)

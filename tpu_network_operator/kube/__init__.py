@@ -9,6 +9,10 @@ this package provides the same seams from scratch:
   test-time integration surface (envtest analog, SURVEY.md §4.2).
 * :mod:`.client`  — a real HTTP API client (in-cluster or kubeconfig) with
   the same interface, for production use.
+* :mod:`.informer` — watch-fed informer caches + the split
+  :class:`~.informer.CachedClient` (reads from cache, writes through),
+  the controller-runtime cache layer that flattens steady-state
+  apiserver traffic to the watch streams alone.
 """
 
 from .errors import (  # noqa: F401
@@ -20,3 +24,4 @@ from .errors import (  # noqa: F401
     ignore_not_found,
 )
 from .fake import FakeCluster  # noqa: F401
+from .informer import CachedClient, Informer, Store  # noqa: F401

@@ -22,6 +22,7 @@ import ssl
 import threading
 import urllib.error
 import urllib.request
+from collections import Counter
 from typing import Any, Callable, Dict, List, Optional
 
 from . import errors as kerr
@@ -75,6 +76,23 @@ class ApiClient:
         self._indexers: Dict[tuple, Dict[str, Callable]] = {}
         self._watch_threads: List[threading.Thread] = []
         self._stopping = threading.Event()
+        # apiserver-request accounting: every wire round-trip increments
+        # (verb, kind), and the prometheus series when a registry is
+        # attached — the seam the informer cache exists to flatten
+        self.request_counts: Counter = Counter()
+        self._count_lock = threading.Lock()
+        self.metrics = None
+
+    def _count_request(self, verb: str, kind: str) -> None:
+        # lost-increment guard: workers and watch threads count
+        # concurrently, and Counter.__iadd__ is not atomic
+        with self._count_lock:
+            self.request_counts[(verb, kind)] += 1
+        if self.metrics:
+            self.metrics.inc(
+                "tpunet_apiserver_requests_total",
+                {"verb": verb, "kind": kind},
+            )
 
     # -- construction ---------------------------------------------------------
 
@@ -180,8 +198,15 @@ class ApiClient:
         return path
 
     def _request(
-        self, method: str, url: str, body: Optional[Dict[str, Any]] = None
+        self,
+        method: str,
+        url: str,
+        body: Optional[Dict[str, Any]] = None,
+        *,
+        verb: str = "",
+        kind: str = "",
     ) -> Dict[str, Any]:
+        self._count_request(verb or method.lower(), kind)
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
         req.add_header("Accept", "application/json")
@@ -222,7 +247,10 @@ class ApiClient:
     # -- FakeCluster-compatible interface -------------------------------------
 
     def get(self, api_version: str, kind: str, name: str, namespace: str = ""):
-        return self._request("GET", self._url(api_version, kind, namespace, name))
+        return self._request(
+            "GET", self._url(api_version, kind, namespace, name),
+            verb="get", kind=kind,
+        )
 
     def list(
         self,
@@ -253,7 +281,7 @@ class ApiClient:
             if cont:
                 parts.append(f"continue={urllib.request.quote(cont)}")
             url = base + ("?" + "&".join(parts) if parts else "")
-            body = self._request("GET", url)
+            body = self._request("GET", url, verb="list", kind=kind)
             items.extend(body.get("items", []))
             cont = body.get("metadata", {}).get("continue", "")
             if not (limit and cont):
@@ -284,13 +312,16 @@ class ApiClient:
     def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
         av, kind = obj["apiVersion"], obj["kind"]
         ns = obj.get("metadata", {}).get("namespace", "")
-        return self._request("POST", self._url(av, kind, ns), obj)
+        return self._request(
+            "POST", self._url(av, kind, ns), obj, verb="create", kind=kind
+        )
 
     def update(self, obj: Dict[str, Any]) -> Dict[str, Any]:
         av, kind = obj["apiVersion"], obj["kind"]
         m = obj.get("metadata", {})
         return self._request(
-            "PUT", self._url(av, kind, m.get("namespace", ""), m["name"]), obj
+            "PUT", self._url(av, kind, m.get("namespace", ""), m["name"]), obj,
+            verb="update", kind=kind,
         )
 
     def update_status(self, obj: Dict[str, Any]) -> Dict[str, Any]:
@@ -300,6 +331,7 @@ class ApiClient:
             "PUT",
             self._url(av, kind, m.get("namespace", ""), m["name"], "status"),
             obj,
+            verb="update", kind=kind,
         )
 
     def apply(
@@ -313,6 +345,7 @@ class ApiClient:
         m = obj.get("metadata", {})
         url = self._url(av, kind, m.get("namespace", ""), m["name"])
         url += f"?fieldManager={field_manager}&force=true"
+        self._count_request("patch", kind)
         data = json.dumps(obj).encode()
         req = urllib.request.Request(url, data=data, method="PATCH")
         req.add_header("Accept", "application/json")
@@ -333,7 +366,8 @@ class ApiClient:
 
     def delete(self, api_version: str, kind: str, name: str, namespace: str = ""):
         return self._request(
-            "DELETE", self._url(api_version, kind, namespace, name)
+            "DELETE", self._url(api_version, kind, namespace, name),
+            verb="delete", kind=kind,
         )
 
     def register_index(
@@ -367,6 +401,7 @@ class ApiClient:
                 wurl += f"&resourceVersion={rv}"
             req = urllib.request.Request(wurl)
             req.add_header("Accept", "application/json")
+            self._count_request("watch", kind)
             if self.token:
                 req.add_header("Authorization", f"Bearer {self.token}")
             try:

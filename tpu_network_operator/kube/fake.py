@@ -20,6 +20,7 @@ import fnmatch
 import queue
 import threading
 import time
+from collections import Counter
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from .errors import (
@@ -91,6 +92,24 @@ class FakeCluster:
         # per-GVK: (list of (rv, ev_type, obj), rv of last evicted event)
         self._history: Dict[GVK, List[Tuple[int, str, Dict[str, Any]]]] = {}
         self._evicted_rv: Dict[GVK, int] = {}
+        # apiserver-request accounting, the same seam ApiClient._request
+        # instruments: (verb, kind) -> calls, plus the prometheus series
+        # when a registry is attached.  Tests and the controller bench
+        # read this to prove cache-backed reconciles issue zero requests.
+        self.request_counts: Counter = Counter()
+        self.metrics = None
+
+    def _count_request(self, verb: str, kind: str) -> None:
+        # Counter.__iadd__ is a read-modify-write; concurrent workers
+        # would lose increments without the store lock (an RLock, so
+        # callers that take it next are fine)
+        with self._lock:
+            self.request_counts[(verb, kind)] += 1
+        if self.metrics:
+            self.metrics.inc(
+                "tpunet_apiserver_requests_total",
+                {"verb": verb, "kind": kind},
+            )
 
     # -- admission + indexer registration ------------------------------------
 
@@ -156,6 +175,7 @@ class FakeCluster:
     # -- CRUD (client.Client analog) -----------------------------------------
 
     def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        self._count_request("create", obj.get("kind", ""))
         with self._lock:
             obj = copy.deepcopy(obj)
             obj = self._admit(obj, None)
@@ -178,6 +198,7 @@ class FakeCluster:
     def get(
         self, api_version: str, kind: str, name: str, namespace: str = ""
     ) -> Dict[str, Any]:
+        self._count_request("get", kind)
         with self._lock:
             bucket = self._bucket(api_version, kind)
             obj = bucket.get((namespace, name))
@@ -188,6 +209,7 @@ class FakeCluster:
     def update(self, obj: Dict[str, Any], *, subresource: str = "") -> Dict[str, Any]:
         """Update; ``subresource="status"`` only replaces .status
         (r.Status().Update analog, ref controller :298)."""
+        self._count_request("update", obj.get("kind", ""))
         with self._lock:
             bucket = self._bucket(obj["apiVersion"], obj["kind"])
             key = _key(obj)
@@ -272,6 +294,7 @@ class FakeCluster:
     def delete(
         self, api_version: str, kind: str, name: str, namespace: str = ""
     ) -> None:
+        self._count_request("delete", kind)
         with self._lock:
             bucket = self._bucket(api_version, kind)
             obj = bucket.pop((namespace, name), None)
@@ -317,6 +340,7 @@ class FakeCluster:
         with :class:`..kube.client.ApiClient` — the in-process fake has
         no wire to chunk, so the full set returns either way (the wire
         server implements the real ``limit``/``continue`` contract)."""
+        self._count_request("list", kind)
         with self._lock:
             out = []
             for (ns, _), obj in sorted(self._bucket(api_version, kind).items()):
@@ -368,6 +392,7 @@ class FakeCluster:
         :class:`ExpiredError` when the window no longer proves
         continuity (events past ``since_rv`` were compacted away), which
         the wire layer surfaces as the 410 Gone ERROR event."""
+        self._count_request("watch", kind)
         with self._lock:
             gvk = (api_version, kind)
             w = Watch()

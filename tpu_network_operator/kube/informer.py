@@ -1,0 +1,532 @@
+"""Watch-fed informer cache + split client (client-go informer analog).
+
+The reconciler's hot loop re-LISTed the apiserver on every pass — the
+owned-DaemonSet list, the namespace-wide Pod list behind the owner
+field-index, the agent-report Lease list — so a fleet of M policies x N
+nodes cost O(M x (M+N)) wire objects per resync tick.  controller-runtime
+solves this with an informer cache: one initial LIST per (apiVersion,
+kind), then a long-lived WATCH keeps a local store current, and every
+``Get``/``List`` the reconciler issues is served from memory.  This module
+is that layer, built over the watch seam both :class:`..kube.fake.FakeCluster`
+and :class:`..kube.client.ApiClient` already expose:
+
+* :class:`Store` — thread-safe per-GVK object store with field indexes
+  evaluated at insert time (the same ``register_index`` contract the fake
+  implements) and label-selector filtering at lookup;
+* :class:`Informer` — seeds a Store with one chunked LIST, then applies
+  the watch stream; stale events (an older resourceVersion racing the
+  seed list) are dropped, and :meth:`Informer.resync` re-lists to prune
+  anything deleted while a watch was down (the relist-on-410 backstop);
+* :class:`CachedClient` — controller-runtime's split client: reads come
+  from the informer stores, writes pass through to the inner client.
+
+Freshness model: every cached read first drains the watch queue
+(non-blocking), so a read observes everything the apiserver has already
+streamed — the same read-your-watch consistency client-go gives, and
+exact consistency against the in-process fake (whose watch push is
+synchronous with the write).  Steady-state apiserver traffic is the watch
+connections themselves: zero GET/LIST requests.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from .fake import match_labels
+
+log = logging.getLogger("tpunet.kube.informer")
+
+Key = Tuple[str, str]   # (namespace, name)
+
+# list chunk size for seed/resync LISTs — the kube convention client-go's
+# pager defaults to; the reconciler and manager import this too so every
+# wire list in the control plane pages the same way
+LIST_PAGE_SIZE = 500
+
+
+def _rv(obj: Dict[str, Any]) -> int:
+    """resourceVersion as an orderable int; 0 when absent/opaque (an
+    unorderable rv is treated as newest — apply rather than drop)."""
+    try:
+        return int(obj.get("metadata", {}).get("resourceVersion", "0") or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+class Store:
+    """Thread-safe object store for one GVK with insert-time field indexes.
+
+    The index contract is :meth:`FakeCluster.register_index`'s:
+    ``fn(obj_dict) -> list[str]``; a lookup against an unregistered index
+    name raises ``KeyError`` (client-go treats it as a programming error,
+    and silently matching nothing would hide exactly that bug)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objs: Dict[Key, Dict[str, Any]] = {}
+        self._indexers: Dict[str, Callable] = {}
+        # index name -> indexed value -> keys (maintained at insert time,
+        # so an indexed list never scans the store)
+        self._index: Dict[str, Dict[str, Set[Key]]] = {}
+
+    def register_index(self, name: str, fn: Callable) -> None:
+        with self._lock:
+            self._indexers[name] = fn
+            postings = self._index[name] = {}
+            for key, obj in self._objs.items():   # backfill existing objects
+                for val in fn(obj) or []:
+                    postings.setdefault(val, set()).add(key)
+
+    def _unindex(self, key: Key, obj: Dict[str, Any]) -> None:
+        for name, fn in self._indexers.items():
+            for val in fn(obj) or []:
+                posting = self._index[name].get(val)
+                if posting:
+                    posting.discard(key)
+                    if not posting:
+                        del self._index[name][val]
+
+    def upsert(self, obj: Dict[str, Any]) -> None:
+        m = obj.get("metadata", {})
+        key = (m.get("namespace", ""), m.get("name", ""))
+        with self._lock:
+            old = self._objs.get(key)
+            if old is not None:
+                self._unindex(key, old)
+            self._objs[key] = obj
+            for name, fn in self._indexers.items():
+                for val in fn(obj) or []:
+                    self._index[name].setdefault(val, set()).add(key)
+
+    def delete(self, namespace: str, name: str) -> None:
+        key = (namespace, name)
+        with self._lock:
+            obj = self._objs.pop(key, None)
+            if obj is not None:
+                self._unindex(key, obj)
+
+    def get(self, name: str, namespace: str = "") -> Optional[Dict[str, Any]]:
+        with self._lock:
+            obj = self._objs.get((namespace, name))
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def rv_of(self, name: str, namespace: str = "") -> Optional[int]:
+        """Stored resourceVersion as an int (0 if unparseable), None when
+        absent — the event pump's staleness check, without paying
+        :meth:`get`'s deepcopy per event."""
+        with self._lock:
+            obj = self._objs.get((namespace, name))
+            return _rv(obj) if obj is not None else None
+
+    def keys(self) -> List[Key]:
+        with self._lock:
+            return list(self._objs)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._objs)
+
+    def list(
+        self,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        field_index: Optional[Dict[str, str]] = None,
+    ) -> List[Dict[str, Any]]:
+        with self._lock:
+            if field_index:
+                keys: Optional[Set[Key]] = None
+                for idx_name, want in field_index.items():
+                    if idx_name not in self._indexers:
+                        raise KeyError(
+                            f"no field index {idx_name!r} registered; "
+                            "call register_index() first"
+                        )
+                    posting = self._index[idx_name].get(want, set())
+                    keys = posting if keys is None else keys & posting
+                candidates = [self._objs[k] for k in sorted(keys or ())]
+            else:
+                candidates = [self._objs[k] for k in sorted(self._objs)]
+            out = []
+            for obj in candidates:
+                meta = obj.get("metadata", {})
+                if namespace is not None and meta.get("namespace", "") != namespace:
+                    continue
+                if label_selector and not match_labels(
+                    meta.get("labels", {}) or {}, label_selector
+                ):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+
+class Informer:
+    """One GVK's watch-fed cache: seed list, then apply the event stream.
+
+    The watch starts BEFORE the seed list so no event between the two is
+    lost; events already covered by the seed (older resourceVersion) are
+    dropped on replay.  ``namespace`` scopes both (``""`` = cluster-wide,
+    which for the fake's GVK-wide watch means a namespace filter here)."""
+
+    def __init__(
+        self,
+        client,
+        api_version: str,
+        kind: str,
+        namespace: str = "",
+        metrics=None,
+    ):
+        self.client = client
+        self.api_version = api_version
+        self.kind = kind
+        self.namespace = namespace
+        self.metrics = metrics
+        self.store = Store()
+        self._watch = None
+        self._synced = False
+        # RLock: an event handler may read back through the informer
+        self._pump_lock = threading.RLock()
+        # set while a resync LIST is in flight; _apply records the keys
+        # it touches so the prune pass cannot kill post-snapshot objects
+        self._resync_active = False
+        self._resync_touched: set = set()
+        self._handlers: List[Callable[[str, Dict[str, Any]], None]] = []
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "Informer":
+        """Open the watch, then seed the store with one chunked LIST."""
+        if self._watch is None:
+            try:
+                self._watch = self.client.watch(
+                    self.api_version, self.kind, namespace=self.namespace
+                )
+            except TypeError:
+                # FakeCluster.watch is GVK-wide (no namespace parameter);
+                # _apply filters by namespace instead
+                self._watch = self.client.watch(self.api_version, self.kind)
+        self.resync()
+        self._synced = True
+        return self
+
+    def stop(self) -> None:
+        if self._watch is not None:
+            self._watch.stop()
+
+    def has_synced(self) -> bool:
+        return self._synced
+
+    def add_event_handler(
+        self, fn: Callable[[str, Dict[str, Any]], None]
+    ) -> None:
+        """``fn(event_type, obj)`` after each store update (the shared-
+        informer handler seam; the store is already current when called)."""
+        self._handlers.append(fn)
+
+    # -- event application -----------------------------------------------------
+
+    def _in_scope(self, obj: Dict[str, Any]) -> bool:
+        if not self.namespace:
+            return True
+        return obj.get("metadata", {}).get("namespace", "") == self.namespace
+
+    def _apply(self, ev_type: str, obj: Dict[str, Any]) -> None:
+        if not self._in_scope(obj):
+            return
+        m = obj.get("metadata", {})
+        key_ns, key_name = m.get("namespace", ""), m.get("name", "")
+        if self._resync_active:
+            self._resync_touched.add((key_ns, key_name))
+        current_rv = self.store.rv_of(key_name, key_ns)
+        # replayed/duplicate event older than what the seed list (or a
+        # later event) already stored: applying it would regress state —
+        # for DELETED too (a stale delete racing the seed list of a
+        # re-created object must not remove the live successor)
+        if current_rv is not None and _rv(obj) and _rv(obj) < current_rv:
+            return
+        if ev_type == "DELETED":
+            self.store.delete(key_ns, key_name)
+        else:
+            # the watch queue item is exclusively ours (Watch.push deep-
+            # copied it), so the store takes ownership without a copy
+            self.store.upsert(obj)
+        self._update_gauge()
+        if self._handlers:
+            # handlers get their own copy — mutating the callback arg
+            # must not corrupt the stored object
+            safe = copy.deepcopy(obj)
+            for fn in self._handlers:
+                try:
+                    fn(ev_type, safe)
+                except Exception:   # noqa: BLE001 — must not kill the pump
+                    log.exception("informer handler failed for %s", self.kind)
+
+    def _update_gauge(self) -> None:
+        if self.metrics:
+            self.metrics.set_gauge(
+                "tpunet_cache_objects", float(len(self.store)),
+                {"kind": self.kind},
+            )
+
+    def sync(self) -> int:
+        """Drain every immediately-available watch event into the store
+        (non-blocking).  Called before each cached read, so a read always
+        observes everything the apiserver has already streamed."""
+        if self._watch is None:
+            return 0
+        n = 0
+        with self._pump_lock:
+            while True:
+                ev = self._watch.next(timeout=0)
+                if ev is None:
+                    return n
+                self._apply(*ev)
+                n += 1
+
+    def resync(self) -> None:
+        """Full relist: upsert everything live, prune everything gone.
+        The backstop for deletions missed while a watch was down (the
+        client's relist-on-410 replays state but cannot replay absence).
+        The wire LIST runs OUTSIDE the pump lock (a fleet-sized Pod
+        relist must not stall every cached read for its duration);
+        correctness against the concurrent pump comes from rv-guarding
+        the upserts and from skipping the prune for any key the pump
+        touched while the LIST was in flight."""
+        with self._pump_lock:
+            self._resync_touched = set()
+            self._resync_active = True
+        try:
+            items = self.client.list(
+                self.api_version, self.kind,
+                namespace=self.namespace, limit=LIST_PAGE_SIZE,
+            )
+        except Exception:
+            with self._pump_lock:
+                self._resync_active = False
+            raise
+        with self._pump_lock:
+            self._resync_active = False
+            touched = self._resync_touched
+            live = set()
+            for obj in items:
+                m = obj.get("metadata", {})
+                key = (m.get("namespace", ""), m.get("name", ""))
+                live.add(key)
+                if key in touched:
+                    # the pump applied a newer event (possibly a DELETE)
+                    # for this key while the LIST was in flight — its
+                    # state postdates the snapshot, never overwrite it
+                    continue
+                current_rv = self.store.rv_of(key[1], key[0])
+                if current_rv is not None and _rv(obj) and _rv(obj) < current_rv:
+                    continue
+                # both client.list implementations return exclusively-
+                # owned objects (the fake deepcopies, the wire client
+                # parses fresh JSON) — the store takes them as-is
+                self.store.upsert(obj)
+            for key in self.store.keys():
+                # a key the pump touched during the LIST may postdate the
+                # snapshot (e.g. created after it) — never prune those
+                if key not in live and key not in touched:
+                    self.store.delete(*key)
+            self._update_gauge()
+
+
+class CachedClient:
+    """controller-runtime's split client: reads from informer caches,
+    writes (and anything un-cached) through to the inner client.
+
+    Usage::
+
+        cached = CachedClient(client, metrics=REGISTRY)
+        cached.cache(API_VERSION, "NetworkClusterPolicy")
+        cached.cache("apps/v1", "DaemonSet", namespace=ns)
+        cached.start()
+        mgr = Manager(cached, ...)
+
+    ``get``/``list`` for a cached (apiVersion, kind) whose namespace falls
+    inside the informer's scope are served from the store after a
+    non-blocking drain of the watch queue; a ``get`` miss reads through
+    to the inner client (the authoritative 404), so a trigger event
+    outrunning the cache stream cannot drop a reconcile.  Everything
+    else (writes, un-cached kinds, out-of-scope namespaces) passes
+    through unchanged, so the reconciler keeps one client interface for
+    both."""
+
+    def __init__(self, inner, metrics=None, resync_interval: float = 0.0):
+        self.inner = inner
+        self.metrics = metrics
+        self.resync_interval = resync_interval
+        self._informers: Dict[Tuple[str, str], Informer] = {}
+        self._stop = threading.Event()
+        self._resync_thread: Optional[threading.Thread] = None
+        self._pump_thread: Optional[threading.Thread] = None
+        self._started = False
+
+    # -- informer management ---------------------------------------------------
+
+    def cache(
+        self, api_version: str, kind: str, namespace: str = ""
+    ) -> Informer:
+        inf = Informer(
+            self.inner, api_version, kind,
+            namespace=namespace, metrics=self.metrics,
+        )
+        self._informers[(api_version, kind)] = inf
+        if self._started:
+            inf.start()
+        return inf
+
+    def informer(self, api_version: str, kind: str) -> Optional[Informer]:
+        return self._informers.get((api_version, kind))
+
+    def start(self) -> "CachedClient":
+        for inf in self._informers.values():
+            inf.start()
+        self._started = True
+        if self.resync_interval > 0 and self._resync_thread is None:
+            self._resync_thread = threading.Thread(
+                target=self._resync_loop, daemon=True
+            )
+            self._resync_thread.start()
+        if self._pump_thread is None:
+            # background drain: without it an idle operator (no
+            # reconciles → no cached reads → no sync) would let the
+            # watch queues of churning kinds (leader-election Lease
+            # renewals, pod heartbeats) grow without bound
+            self._pump_thread = threading.Thread(
+                target=self._pump_loop, daemon=True
+            )
+            self._pump_thread.start()
+        return self
+
+    def _pump_loop(self) -> None:
+        while not self._stop.is_set():
+            busy = False
+            for inf in list(self._informers.values()):
+                try:
+                    busy = inf.sync() > 0 or busy
+                except Exception:   # noqa: BLE001 — pump must survive
+                    log.exception("informer pump failed for %s", inf.kind)
+            if not busy:
+                self._stop.wait(0.05)
+
+    def _resync_loop(self) -> None:
+        while not self._stop.wait(self.resync_interval):
+            # copy: cache() after start() may grow the dict mid-iteration
+            for inf in list(self._informers.values()):
+                try:
+                    inf.resync()
+                except Exception as e:   # noqa: BLE001 — next tick retries
+                    log.debug("cache resync %s failed: %s", inf.kind, e)
+
+    def resync(self) -> None:
+        for inf in self._informers.values():
+            inf.resync()
+
+    def has_synced(self) -> bool:
+        return all(inf.has_synced() for inf in self._informers.values())
+
+    def stop(self) -> None:
+        self._stop.set()
+        for inf in self._informers.values():
+            inf.stop()
+        if self._resync_thread is not None:
+            self._resync_thread.join(timeout=2)
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout=2)
+
+    def _serving(
+        self, api_version: str, kind: str, namespace: Optional[str]
+    ) -> Optional[Informer]:
+        """The informer that can answer this read, or None (fall through
+        to the inner client)."""
+        inf = self._informers.get((api_version, kind))
+        if inf is None or not inf.has_synced():
+            return None
+        if inf.namespace and namespace != inf.namespace:
+            return None   # read outside the cached scope (incl. all-namespaces)
+        return inf
+
+    # -- reads (cache-backed) --------------------------------------------------
+
+    def get(
+        self, api_version: str, kind: str, name: str, namespace: str = ""
+    ) -> Dict[str, Any]:
+        inf = self._serving(api_version, kind, namespace)
+        if inf is None:
+            return self.inner.get(api_version, kind, name, namespace)
+        inf.sync()
+        obj = inf.store.get(name, namespace)
+        if obj is None:
+            # read-through on miss: a trigger event can outrun the cache
+            # stream (they are separate connections over the real wire),
+            # and answering NotFound for a just-created object would
+            # silently drop its reconcile.  The inner GET is authoritative
+            # either way — a true 404 raises, a cache-lag hit returns the
+            # live object — and it only fires on the rare miss path, so
+            # warm steady-state reads stay at zero requests.
+            return self.inner.get(api_version, kind, name, namespace)
+        return obj
+
+    def list(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        field_index: Optional[Dict[str, str]] = None,
+        limit: int = 0,
+    ) -> List[Dict[str, Any]]:
+        inf = self._serving(api_version, kind, namespace)
+        if inf is None:
+            return self.inner.list(
+                api_version, kind, namespace=namespace,
+                label_selector=label_selector, field_index=field_index,
+                limit=limit,
+            )
+        inf.sync()
+        return inf.store.list(
+            namespace=namespace, label_selector=label_selector,
+            field_index=field_index,
+        )
+
+    # -- writes + everything else: pass through --------------------------------
+
+    def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self.inner.create(obj)
+
+    def update(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self.inner.update(obj)
+
+    def update_status(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self.inner.update_status(obj)
+
+    def apply(self, obj: Dict[str, Any], **kw) -> Any:
+        return self.inner.apply(obj, **kw)
+
+    def delete(self, api_version: str, kind: str, name: str, namespace: str = ""):
+        return self.inner.delete(api_version, kind, name, namespace)
+
+    def watch(self, api_version: str, kind: str, namespace: str = ""):
+        try:
+            return self.inner.watch(api_version, kind, namespace=namespace)
+        except TypeError:   # FakeCluster.watch has no namespace parameter
+            return self.inner.watch(api_version, kind)
+
+    def register_index(
+        self, api_version: str, kind: str, name: str, fn: Callable
+    ) -> None:
+        inf = self._informers.get((api_version, kind))
+        if inf is not None:
+            inf.store.register_index(name, fn)
+        # register on the inner client too: fallthrough reads (un-synced
+        # informer, out-of-scope namespace) keep the same index contract
+        self.inner.register_index(api_version, kind, name, fn)
+
+    def __getattr__(self, name: str):
+        # FakeCluster test conveniences (add_node, dump, ...), ApiClient
+        # lifecycle (close) — anything not part of the read/write seam
+        return getattr(self.inner, name)

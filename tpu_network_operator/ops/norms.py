@@ -253,8 +253,10 @@ def sharded_rms_norm(mesh, spec, eps: float):
     replication checking cannot see through a pallas custom call."""
     from jax.sharding import PartitionSpec as P
 
+    from ..compat import shard_map
+
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(spec, P(None)),
+        shard_map, mesh=mesh, in_specs=(spec, P(None)),
         out_specs=spec, check_vma=False,
     )
     def norm(x, scale):

@@ -402,8 +402,9 @@ def sharded_flash_attention(mesh, *, block_q: int = 512, block_k: int = 512,
     ``tensor`` factor). The ``seq`` axis must be unsharded here — sequence
     sharding is the ring path's job.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..compat import shard_map
 
     qspec = P(("data", "fsdp"), None, "tensor", None)
 

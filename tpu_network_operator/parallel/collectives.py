@@ -19,7 +19,8 @@ from typing import Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from ..compat import shard_map
 
 
 @dataclass
