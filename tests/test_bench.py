@@ -179,6 +179,58 @@ class TestMeasureDecode:
         assert out["best"] in out["rows"]
 
 
+class TestProbeBench:
+    def test_partition_detection_artifact(self, tmp_path):
+        """The probe-mesh bench phase (tools/probe_bench.py) at the
+        acceptance geometry: 20 nodes on the fake fabric, one injected
+        full partition.  The BENCH_probe.json artifact must show the
+        label retracted within 3 probe intervals, restored after the
+        heal, and zero label flapping anywhere else in the mesh."""
+        out = tmp_path / "BENCH_probe.json"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "probe_bench.py"),
+             "--nodes", "20", "--out", str(out)],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert row == json.loads(out.read_text())
+        # the driver's contract keys
+        assert set(row) >= {"metric", "value", "unit", "vs_baseline"}
+        assert row["unit"] == "probe intervals"
+        assert row["nodes"] == 20
+        # acceptance: partition detected and label removed within 3
+        # probe intervals...
+        assert 0 < row["detection_intervals"] <= 3
+        assert row["value"] == row["detection_intervals"]
+        # ...restored after recovery (down once, up once — no flapping)
+        assert row["victim_label_transitions"] == 2
+        assert row["label_convergence_seconds"] > 0
+        # ...and the rest of the mesh never flapped (quorum absorbs the
+        # dead peer)
+        assert row["other_label_flaps"] == 0
+        # quarantine re-probe backoff engaged while partitioned
+        assert row["backoff_interval_seconds"] > row["interval_seconds"]
+
+    def test_deterministic_across_runs(self, tmp_path):
+        """Same seed → identical mesh outcome (the fake fabric's whole
+        point: failure-detection numbers are reproducible)."""
+        runs = []
+        for _ in range(2):
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                              "probe_bench.py"),
+                 "--nodes", "6", "--seed", "77"],
+                capture_output=True, text=True, timeout=300,
+            )
+            assert proc.returncode == 0, proc.stderr[-800:]
+            row = json.loads(proc.stdout.strip().splitlines()[-1])
+            row.pop("wall_seconds")
+            runs.append(row)
+        assert runs[0] == runs[1]
+
+
 class TestControllerBench:
     def test_reports_cached_vs_uncached_artifact(self, tmp_path):
         """The controller bench phase (tools/controller_bench.py) at toy

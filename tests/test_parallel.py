@@ -118,6 +118,23 @@ class TestRingAttention:
             np.asarray(ref), np.asarray(out), atol=2e-5
         )
 
+    def test_gqa_repeat_factor_picks_minimal(self):
+        from tpu_network_operator.parallel.ring import _gqa_repeat_factor
+
+        # hkv=2 on a 4-way head axis: repeat x2 → 4 divisible by 4
+        assert _gqa_repeat_factor(8, 2, 4) == 2
+        # already divisible: factor 1
+        assert _gqa_repeat_factor(8, 4, 2) == 1
+
+    def test_gqa_no_factor_raises_named_valueerror(self):
+        """Regression: an impossible head-shard geometry must raise an
+        explicit ValueError naming h/hkv/head-axis size, not leak the
+        bare StopIteration the old ``next()`` produced."""
+        from tpu_network_operator.parallel.ring import _gqa_repeat_factor
+
+        with pytest.raises(ValueError, match=r"h=8, hkv=4.*size 3"):
+            _gqa_repeat_factor(8, 4, 3)
+
 
 class TestFlashRing:
     """The Pallas-per-chunk ring path (flash-compatible shapes: d>=64,

@@ -1,0 +1,1145 @@
+"""Dataplane probe mesh (probe/ subsystem) — unit + integration tier.
+
+Covers the full feedback loop the ISSUE names: responder/prober
+round-trips over the deterministic fake transport (and once over real
+UDP), gate hysteresis + quorum edge cases, webhook rejection of invalid
+``probe:`` specs, agent-side label gating (partition → NFD label
+removed → recovery → label restored, no flapping), and reconciler-side
+aggregation (peer ConfigMap distribution, connectivity matrix,
+DataplaneDegraded condition, quarantine + backoff, probe gauges).
+"""
+
+import json
+
+import pytest
+
+from tpu_network_operator.probe import (
+    FakeFabric,
+    ProbeRunner,
+    Prober,
+    ProbeSnapshot,
+    ReadinessGate,
+    Responder,
+    UdpTransport,
+)
+from tpu_network_operator.probe import prober as prober_mod
+
+NAMESPACE = "tpunet-system"
+
+
+def make_mesh(n, quorum=0, seed=7, interval=5.0, loss=0.0, **kw):
+    """n ProbeRunners on one fabric, all peers known to all."""
+    fabric = FakeFabric(seed=seed, latency=0.0005, jitter=0.0001)
+    peers = {f"n{i}": f"10.0.0.{i}:8477" for i in range(n)}
+    runners = {}
+    for name, addr in peers.items():
+        r = ProbeRunner(
+            fabric, addr, name, lambda p=peers: p,
+            interval=interval, quorum=quorum, **kw,
+        )
+        r.responder.start()
+        runners[name] = r
+    if loss:
+        for i in range(n):
+            fabric.set_loss(f"10.0.0.{i}", loss)
+    return fabric, runners
+
+
+def rounds(fabric, runners, n, interval=5.0):
+    for _ in range(n):
+        for r in runners.values():
+            r.step()
+        fabric.advance(interval)
+
+
+class TestWireFormat:
+    def test_round_trip(self):
+        payload = prober_mod.encode(prober_mod.KIND_REQUEST, 42, 1.5)
+        assert prober_mod.decode(payload) == (prober_mod.KIND_REQUEST, 42, 1.5)
+
+    def test_garbage_rejected(self):
+        assert prober_mod.decode(b"") is None
+        assert prober_mod.decode(b"x" * 25) is None
+        # right length, wrong magic
+        import struct
+        assert prober_mod.decode(
+            struct.pack("!4sBQd", b"nope", 0, 1, 0.0)
+        ) is None
+
+
+class TestFakeFabric:
+    def test_deterministic_loss(self):
+        """Same seed → identical delivery outcomes."""
+        outcomes = []
+        for _ in range(2):
+            fabric, runners = make_mesh(3, seed=99, loss=0.3)
+            rounds(fabric, runners, 10)
+            outcomes.append(
+                (fabric.delivered, fabric.dropped,
+                 [r.last_snapshot.loss_ratio for r in runners.values()])
+            )
+        assert outcomes[0] == outcomes[1]
+        assert outcomes[0][1] > 0          # loss actually injected
+
+    def test_partition_blocks_both_directions(self):
+        fabric, runners = make_mesh(3)
+        rounds(fabric, runners, 3)
+        fabric.partition("10.0.0.1")
+        rounds(fabric, runners, 3)
+        # the partitioned node reaches nobody; peers cannot reach it
+        assert runners["n1"].last_snapshot.peers_reachable == 0
+        assert "n1" in runners["n0"].last_snapshot.unreachable
+
+    def test_pairwise_cut(self):
+        fabric, runners = make_mesh(3)
+        rounds(fabric, runners, 3)
+        fabric.cut("10.0.0.0", "10.0.0.2")
+        rounds(fabric, runners, 3)
+        assert runners["n0"].last_snapshot.unreachable == ["n2"]
+        assert runners["n2"].last_snapshot.unreachable == ["n0"]
+        # the third corner is untouched
+        assert runners["n1"].last_snapshot.unreachable == []
+
+
+class TestProberResponder:
+    def test_fake_round_trip_measures_rtt(self):
+        fabric, runners = make_mesh(2)
+        rounds(fabric, runners, 3)
+        snap = runners["n0"].last_snapshot
+        assert snap.peers_total == 1 and snap.peers_reachable == 1
+        # request + reply = two one-way latencies (+ jitter)
+        assert 0.9 < snap.rtt_p50_ms < 1.4
+        assert snap.loss_ratio == 0.0
+        assert runners["n1"].responder.requests >= 3
+
+    def test_udp_round_trip(self):
+        """One real-socket round-trip on loopback: the production
+        transport speaks the same contract as the fake."""
+        transport = UdpTransport()
+        resp_ep = transport.open("127.0.0.1:0")
+        responder = Responder(resp_ep).start()
+        try:
+            probe_ep = transport.open("127.0.0.1:0")
+            prober = Prober(probe_ep, transport.clock, window=4,
+                            timeout=2.0)
+            prober.set_peers({"peer": resp_ep.addr})
+            snap = prober.run_round()
+            assert snap.peers_reachable == 1
+            assert snap.rtt_p50_ms > 0
+            probe_ep.close()
+        finally:
+            responder.stop()
+            resp_ep.close()
+
+    def test_malformed_peer_address_does_not_abort_the_round(self):
+        """A bad 'host' entry (no port) that slipped into the peer list
+        must count as that one peer lost — not raise out of run_round
+        and freeze every window mesh-wide."""
+        transport = UdpTransport()
+        resp_ep = transport.open("127.0.0.1:0")
+        responder = Responder(resp_ep).start()
+        try:
+            probe_ep = transport.open("127.0.0.1:0")
+            prober = Prober(probe_ep, transport.clock, window=4,
+                            timeout=1.0)
+            prober.set_peers({"good": resp_ep.addr, "bad": "10.0.0.5"})
+            snap = prober.run_round()
+            assert snap.peers_total == 2
+            assert "good" not in snap.unreachable
+            assert prober.windows["bad"].outcomes[-1] is None
+            probe_ep.close()
+        finally:
+            responder.stop()
+            resp_ep.close()
+
+    def test_valid_endpoint(self):
+        from tpu_network_operator.probe.transport import valid_endpoint
+
+        assert valid_endpoint("10.0.0.1:8477")
+        assert not valid_endpoint("10.0.0.1")          # no port
+        assert not valid_endpoint(":8477")             # no host
+        assert not valid_endpoint("10.0.0.1:notaport")
+        assert not valid_endpoint("10.0.0.1:99999")
+        assert not valid_endpoint("")
+
+    def test_departed_peer_forgotten(self):
+        """A peer dropped from the controller-distributed list must not
+        linger as a phantom blackhole."""
+        fabric, runners = make_mesh(3)
+        rounds(fabric, runners, 3)
+        prober = runners["n0"].prober
+        prober.set_peers({"n1": "10.0.0.1:8477"})
+        snap = prober.run_round()
+        assert snap.peers_total == 1
+        assert "n2" not in prober.windows
+
+
+class TestReadinessGate:
+    def snap(self, reachable, total):
+        return ProbeSnapshot(peers_total=total, peers_reachable=reachable)
+
+    def test_single_bad_round_does_not_flap(self):
+        gate = ReadinessGate(fail_threshold=2)
+        assert gate.ready
+        gate.observe(self.snap(0, 3))
+        assert gate.ready                      # one bad round absorbed
+        gate.observe(self.snap(3, 3))
+        assert gate.ready and gate.transitions == 0
+
+    def test_degrades_after_threshold_and_recovers_with_hysteresis(self):
+        gate = ReadinessGate(fail_threshold=2, recovery_threshold=2)
+        gate.observe(self.snap(0, 3))
+        gate.observe(self.snap(0, 3))
+        assert not gate.ready
+        gate.observe(self.snap(3, 3))
+        assert not gate.ready                  # one good round ≠ recovered
+        gate.observe(self.snap(3, 3))
+        assert gate.ready
+        assert gate.transitions == 2           # down once, up once
+
+    def test_quorum_zero_means_all_peers(self):
+        gate = ReadinessGate(quorum=0, fail_threshold=1)
+        gate.observe(self.snap(2, 3))
+        assert not gate.ready
+
+    def test_exactly_at_quorum_is_ready(self):
+        gate = ReadinessGate(quorum=2, fail_threshold=1)
+        gate.observe(self.snap(2, 5))
+        assert gate.ready
+        gate.observe(self.snap(1, 5))
+        assert not gate.ready
+
+    def test_quorum_clamped_to_live_peer_count(self):
+        """A shrunken mesh (quorum > peers) must not deadlock readiness."""
+        gate = ReadinessGate(quorum=10, fail_threshold=1)
+        gate.observe(self.snap(2, 2))
+        assert gate.ready
+
+    def test_zero_peers_vacuously_ready(self):
+        """Single-node policy: no fabric to validate."""
+        gate = ReadinessGate(quorum=0, fail_threshold=1)
+        gate.observe(self.snap(0, 0))
+        assert gate.ready
+
+    def test_expected_peers_pins_quorum_base(self):
+        """A silently shrunken peer list (wedged agents dropped out)
+        must not lower the bar when expectedPeers pins the base."""
+        gate = ReadinessGate(quorum=8, expected_peers=16, fail_threshold=1)
+        # mesh shrank to 8 live peers, all reachable: without the pin
+        # min(quorum, live)=8 would pass — with it, required stays 8
+        # and reaching all 8 still satisfies quorum=8
+        gate.observe(self.snap(8, 8))
+        assert gate.ready
+        # but quorum=0 (all-of-expected) against the shrunken mesh fails
+        strict = ReadinessGate(quorum=0, expected_peers=16,
+                               fail_threshold=1)
+        strict.observe(self.snap(8, 8))
+        assert not strict.ready
+
+    def test_marathon_outage_never_overflows_backoff(self):
+        """Regression: ~23h of degraded rounds pushed fail_streak past
+        1024, where 2.0**streak raised OverflowError OUTSIDE the probe
+        thread's try — killing probing permanently."""
+        gate = ReadinessGate(fail_threshold=2)
+        for _ in range(2000):
+            gate.observe(self.snap(0, 3))
+        assert gate.current_interval(10.0) == 80.0    # capped, no raise
+
+    def test_backoff_engages_while_degraded_and_resets(self):
+        gate = ReadinessGate(fail_threshold=2, recovery_threshold=1)
+        for _ in range(2):
+            gate.observe(self.snap(0, 3))
+        assert gate.current_interval(10.0) == 10.0    # just degraded
+        gate.observe(self.snap(0, 3))
+        assert gate.current_interval(10.0) == 20.0
+        gate.observe(self.snap(0, 3))
+        assert gate.current_interval(10.0) == 40.0
+        for _ in range(10):
+            gate.observe(self.snap(0, 3))
+        assert gate.current_interval(10.0) == 80.0    # capped at 8x
+        gate.observe(self.snap(3, 3))
+        assert gate.ready
+        assert gate.current_interval(10.0) == 10.0
+
+
+class TestMeshScenarios:
+    def test_partition_detected_within_three_intervals(self):
+        """The acceptance budget at mesh scale: full partition of one
+        node → its gate drops within 3 probe rounds; quorum keeps every
+        other node ready."""
+        fabric, runners = make_mesh(8, quorum=6)
+        rounds(fabric, runners, 4)
+        assert all(r.ready() for r in runners.values())
+        fabric.partition("10.0.0.3")
+        for i in range(3):
+            rounds(fabric, runners, 1)
+        assert not runners["n3"].ready()
+        for name, r in runners.items():
+            if name != "n3":
+                assert r.ready(), f"{name} flapped"
+
+    def test_recovery_restores_without_flapping(self):
+        fabric, runners = make_mesh(5, quorum=3)
+        rounds(fabric, runners, 4)
+        fabric.partition("10.0.0.2")
+        rounds(fabric, runners, 4)
+        assert not runners["n2"].ready()
+        fabric.heal("10.0.0.2")
+        rounds(fabric, runners, 6)
+        assert runners["n2"].ready()
+        assert runners["n2"].gate.transitions == 2
+        assert all(
+            runners[f"n{i}"].gate.transitions == 0 for i in (0, 1, 3, 4)
+        )
+
+    def test_prober_bind_failure_closes_responder_socket(self):
+        """If the ephemeral prober endpoint fails to open after the
+        responder bound the well-known port, the responder socket must
+        not leak (a dead bind would squat the probe port forever)."""
+        fabric = FakeFabric(seed=5)
+
+        class FlakyTransport:
+            def __init__(self):
+                self.opened = 0
+
+            def clock(self):
+                return fabric.clock()
+
+            def open(self, addr):
+                self.opened += 1
+                if self.opened == 2:
+                    raise OSError("no ephemeral port for you")
+                return fabric.open(addr)
+
+        with pytest.raises(OSError):
+            ProbeRunner(FlakyTransport(), "10.0.0.1:8477", "n", lambda: {})
+        assert "10.0.0.1:8477" not in fabric.endpoints
+
+    def test_cold_start_never_fetched_peers_stays_ready(self):
+        """Before the FIRST successful peer-list fetch there is nothing
+        to judge: an expectedPeers-pinned gate must not count empty
+        cold-start rounds as below quorum and retract a healthy node's
+        label minutes after start."""
+        fabric = FakeFabric(seed=9)
+        r = ProbeRunner(
+            fabric, "10.0.0.1:8477", "n", lambda: None,
+            interval=5, expected_peers=16, fail_threshold=2,
+        )
+        r.responder.start()
+        for _ in range(5):
+            r.step()
+            fabric.advance(5)
+        assert r.ready(), "cold start flapped the gate"
+        assert r.gate.fail_streak == 0
+
+    def test_supplier_failure_keeps_last_mesh(self):
+        """A peer-list fetch blip (supplier → None) must not empty the
+        mesh into a vacuous pass."""
+        fabric = FakeFabric(seed=3)
+        peers = {"a": "10.0.0.0:8477", "b": "10.0.0.1:8477"}
+        feed = {"peers": peers}
+        r = ProbeRunner(
+            fabric, peers["a"], "a", lambda: feed["peers"], interval=5,
+        )
+        r.responder.start()
+        rb = ProbeRunner(fabric, peers["b"], "b", lambda: peers, interval=5)
+        rb.responder.start()
+        r.step()
+        assert r.last_snapshot.peers_total == 1
+        feed["peers"] = None
+        r.step()
+        assert r.last_snapshot.peers_total == 1   # kept, not emptied
+
+
+class TestWebhookProbeSpec:
+    def make(self, **kw):
+        from tpu_network_operator.api.v1alpha1 import ProbeSpec
+
+        kw.setdefault("interval_seconds", 10)
+        return ProbeSpec(enabled=True, **kw)
+
+    def check(self, p):
+        from tpu_network_operator.api.v1alpha1.webhook import (
+            validate_probe_spec,
+        )
+
+        validate_probe_spec(p)
+
+    def test_valid_spec_passes(self):
+        self.check(self.make(port=8477, window=20, quorum=3,
+                             expected_peers=8))
+
+    def test_interval_zero_or_negative_rejected(self):
+        from tpu_network_operator.api.v1alpha1.webhook import AdmissionError
+
+        for bad in (0, -5):
+            with pytest.raises(AdmissionError, match="intervalSeconds"):
+                self.check(self.make(interval_seconds=bad))
+
+    def test_quorum_exceeding_expected_peers_rejected(self):
+        from tpu_network_operator.api.v1alpha1.webhook import AdmissionError
+
+        with pytest.raises(AdmissionError, match="unsatisfiable"):
+            self.check(self.make(quorum=9, expected_peers=8))
+        # exactly-at is satisfiable
+        self.check(self.make(quorum=8, expected_peers=8))
+
+    def test_port_and_window_ranges(self):
+        from tpu_network_operator.api.v1alpha1.webhook import AdmissionError
+
+        with pytest.raises(AdmissionError, match="port"):
+            self.check(self.make(port=80))
+        with pytest.raises(AdmissionError, match="window"):
+            self.check(self.make(window=5000))
+
+    def test_window_too_short_to_detect_rejected(self):
+        """window=1 can never accumulate the 2 consecutive misses that
+        mark a peer unreachable — admitting it would silently disable
+        partition detection while claiming to probe."""
+        from tpu_network_operator.api.v1alpha1.webhook import AdmissionError
+
+        with pytest.raises(AdmissionError, match="never detect"):
+            self.check(self.make(window=1))
+        self.check(self.make(window=2))        # shortest useful window
+        self.check(self.make(window=0))        # 0 = default (20)
+
+    def test_defaulting_pins_the_contract(self):
+        """Mutating admission fills every zero knob on enable, so the
+        DaemonSet projection never depends on agent-side defaults."""
+        from tpu_network_operator.api.v1alpha1 import (
+            NetworkClusterPolicy,
+            default_policy,
+        )
+
+        p = NetworkClusterPolicy()
+        p.spec.configuration_type = "tpu-so"
+        p.spec.tpu_scale_out.probe.enabled = True
+        probe = default_policy(p).spec.tpu_scale_out.probe
+        assert probe.port == 8477
+        assert probe.interval_seconds == 10
+        assert probe.window == 20
+        assert probe.failure_threshold == 2
+        assert probe.recovery_threshold == 2
+
+    def test_disabled_probe_left_untouched(self):
+        from tpu_network_operator.api.v1alpha1 import (
+            NetworkClusterPolicy,
+            default_policy,
+        )
+
+        p = NetworkClusterPolicy()
+        p.spec.configuration_type = "tpu-so"
+        probe = default_policy(p).spec.tpu_scale_out.probe
+        assert probe.port == 0 and probe.window == 0
+        # interval has no zero sentinel — the dataclass default IS the
+        # contract value, present from construction
+        assert probe.interval_seconds == 10
+
+
+class TestAgentLabelGating:
+    """Partition → NFD label removed → recovery → label re-added, via
+    the agent's real monitor tick + a real ProbeRunner on the fake
+    fabric (reporting off: the label file is the observable)."""
+
+    def setup_agent(self, tmp_path, quorum=0):
+        from tpu_network_operator import nfd
+        from tpu_network_operator.agent import cli as agent_cli
+
+        nfd_dir = (
+            tmp_path / "etc/kubernetes/node-feature-discovery/features.d"
+        )
+        nfd_dir.mkdir(parents=True)
+        fabric = FakeFabric(seed=11)
+        peers = {
+            "self": "10.0.0.1:8477",
+            "peer-a": "10.0.0.2:8477",
+            "peer-b": "10.0.0.3:8477",
+        }
+        runners = {}
+        for name, addr in peers.items():
+            r = ProbeRunner(fabric, addr, name, lambda p=peers: p,
+                            interval=5, quorum=quorum)
+            r.responder.start()
+            runners[name] = r
+        config = agent_cli.CmdConfig(
+            backend="tpu", mode="L2", probe_enabled=True,
+            nfd_root=str(tmp_path),
+        )
+        label_file = nfd_dir / nfd.labels.NFD_FILE_NAME
+        nfd.write_readiness_label(nfd.TPU_READY_LABEL, root=str(tmp_path))
+        return fabric, runners, config, label_file
+
+    def tick(self, config, runner):
+        from tpu_network_operator import nfd
+        from tpu_network_operator.agent import cli as agent_cli
+
+        state = getattr(self, "_state", None)
+        if state is None:
+            state = self._state = agent_cli._MonitorState()
+        agent_cli._monitor_tick(
+            config, {}, "", nfd.TPU_READY_LABEL, state,
+            probe_runner=runner,
+        )
+
+    def test_partition_removes_label_recovery_restores(self, tmp_path):
+        fabric, runners, config, label_file = self.setup_agent(tmp_path)
+        me = runners["self"]
+        rounds(fabric, runners, 3)
+        self.tick(config, me)
+        assert label_file.exists()
+
+        fabric.partition("10.0.0.1")
+        rounds(fabric, runners, 3)
+        self.tick(config, me)
+        assert not label_file.exists(), "degraded node kept its label"
+
+        fabric.heal("10.0.0.1")
+        rounds(fabric, runners, 3)
+        self.tick(config, me)
+        assert label_file.exists(), "recovered node not re-labeled"
+
+    def test_gate_flip_retracts_label_immediately_without_tick(
+        self, tmp_path
+    ):
+        """The transition hook removes the label the moment the gate
+        degrades — a blackholed node must not advertise readiness for
+        up to a whole monitor tick (60s) after detection."""
+        from tpu_network_operator.agent import cli as agent_cli
+
+        fabric, runners, config, label_file = self.setup_agent(tmp_path)
+        me = runners["self"]
+        me.on_transition = lambda ready: agent_cli._on_probe_transition(
+            config, {}, "unused-label", me, ready
+        )
+        rounds(fabric, runners, 3)
+        assert label_file.exists()
+        fabric.partition("10.0.0.1")
+        rounds(fabric, runners, 3)      # NO monitor tick in between
+        assert not label_file.exists(), (
+            "label survived until the monitor tick"
+        )
+        # recovery does NOT restore from the hook (monitor owns the
+        # combined verdict); the next tick does
+        fabric.heal("10.0.0.1")
+        rounds(fabric, runners, 3)
+        assert not label_file.exists()
+        self.tick(config, me)
+        assert label_file.exists()
+
+    def test_tick_label_reassert_rechecks_gate_not_stale_sample(
+        self, tmp_path, monkeypatch
+    ):
+        """TOCTOU guard: if the gate flips down while the tick is
+        publishing, the tick must NOT re-write the label from its
+        stale tick-top reading — that would undo the hook's
+        retraction for up to a whole recheck interval."""
+        from tpu_network_operator import nfd
+        from tpu_network_operator.agent import cli as agent_cli
+
+        nfd_dir = (
+            tmp_path / "etc/kubernetes/node-feature-discovery/features.d"
+        )
+        nfd_dir.mkdir(parents=True)
+        label_file = nfd_dir / nfd.labels.NFD_FILE_NAME
+
+        class FlippingRunner:
+            """ready() True at the tick top, False by label-write time
+            (the gate flipped during the publish round-trip)."""
+
+            def __init__(self):
+                self.calls = 0
+
+            def ready(self):
+                self.calls += 1
+                return self.calls == 1
+
+            def export(self):
+                return None
+
+        config = agent_cli.CmdConfig(
+            backend="tpu", mode="L2", probe_enabled=True,
+            nfd_root=str(tmp_path),
+        )
+        state = agent_cli._MonitorState()
+        agent_cli._monitor_tick(
+            config, {}, "", nfd.TPU_READY_LABEL, state,
+            probe_runner=FlippingRunner(),
+        )
+        assert not label_file.exists(), "stale ready() re-labeled the node"
+        # the RECOVERY branch needs the same guard: last_bad nonempty,
+        # bad computes clean at the top, gate flips during the publish
+        state = agent_cli._MonitorState(last_bad=["ens9"])
+        agent_cli._monitor_tick(
+            config, {}, "", nfd.TPU_READY_LABEL, state,
+            probe_runner=FlippingRunner(),
+        )
+        assert not label_file.exists(), (
+            "recovery branch re-labeled from a stale ready() sample"
+        )
+
+    def test_hook_failure_report_merges_interface_degradation(
+        self, tmp_path, monkeypatch
+    ):
+        """A concurrent interface failure already in the monitor's bad
+        set must survive in the hook's failure report — the hook must
+        not clobber status.errors down to just the probe marker."""
+        from tpu_network_operator.agent import cli as agent_cli
+
+        captured = []
+        monkeypatch.setattr(
+            agent_cli, "_publish_failure_report",
+            lambda config, error, **kw: captured.append(error) or True,
+        )
+        config = agent_cli.CmdConfig(
+            backend="tpu", probe_enabled=True, nfd_root=str(tmp_path),
+        )
+        state = agent_cli._MonitorState(last_bad=["ens9"])
+        agent_cli._on_probe_transition(
+            config, {}, "label", None, ready=False, monitor_state=state,
+        )
+        assert captured == [
+            "interfaces degraded: ens9; probe mesh below quorum"
+        ]
+
+    def test_peer_supplier_ttl_limits_fetch_rate(self, monkeypatch):
+        """One underlying peer-list fetch per refresh window: probing
+        every 10s must not turn into fleet-wide ConfigMap GETs every
+        10s."""
+        from tpu_network_operator.agent import cli as agent_cli
+
+        fetches = []
+        monkeypatch.setattr(
+            agent_cli, "_probe_peers",
+            lambda config, node: fetches.append(1) or {"p": "1.2.3.4:8477"},
+        )
+        supplier = agent_cli._make_peer_supplier(
+            agent_cli.CmdConfig(backend="tpu"), "n"
+        )
+        for _ in range(5):
+            assert supplier() == {"p": "1.2.3.4:8477"}
+        assert len(fetches) == 1
+
+    def test_one_lost_round_does_not_flap_label(self, tmp_path):
+        fabric, runners, config, label_file = self.setup_agent(tmp_path)
+        me = runners["self"]
+        rounds(fabric, runners, 3)
+        self.tick(config, me)
+        # one fully-lost round (partition shorter than the gate
+        # threshold): label must survive
+        fabric.partition("10.0.0.1")
+        rounds(fabric, runners, 1)
+        fabric.heal("10.0.0.1")
+        self.tick(config, me)
+        assert label_file.exists()
+
+    def test_probe_marker_joins_degradation_list(self, tmp_path):
+        from tpu_network_operator.agent import cli as agent_cli
+
+        fabric, runners, config, label_file = self.setup_agent(tmp_path)
+        me = runners["self"]
+        fabric.partition("10.0.0.1")
+        rounds(fabric, runners, 3)
+        self.tick(config, me)
+        assert self._state.last_bad == [agent_cli.PROBE_DEGRADED]
+
+    def test_healthy_steady_tick_republishes_mesh_stats(
+        self, tmp_path, monkeypatch
+    ):
+        """With a live runner, healthy steady-state ticks must re-publish
+        the full report (fresh rtt/loss), not renewTime-only heartbeat
+        it — else the connectivity matrix freezes at provision-time
+        values."""
+        from tpu_network_operator.agent import cli as agent_cli
+
+        fabric, runners, config, label_file = self.setup_agent(tmp_path)
+        me = runners["self"]
+        rounds(fabric, runners, 3)
+        calls = []
+        monkeypatch.setattr(
+            agent_cli, "_publish_report",
+            lambda *a, **k: calls.append("publish") or True,
+        )
+        monkeypatch.setattr(
+            agent_cli, "_publish_failure_report",
+            lambda *a, **k: calls.append("failure") or True,
+        )
+        monkeypatch.setattr(
+            agent_cli, "_renew_report",
+            lambda *a, **k: calls.append("renew"),
+        )
+        self.tick(config, me)          # healthy, unchanged
+        self.tick(config, me)
+        assert calls == ["publish", "publish"]
+        # degraded steady state republishes too: a worsening outage
+        # must not freeze the matrix at its first snapshot
+        fabric.partition("10.0.0.1")
+        rounds(fabric, runners, 3)
+        calls.clear()
+        self.tick(config, me)          # transition -> failure report
+        self.tick(config, me)          # steady degraded -> fresh stats
+        assert calls == ["failure", "failure"]
+
+
+class TestAgentProbeWiring:
+    def test_flags_reach_config(self):
+        from tpu_network_operator.agent import cli as agent_cli
+
+        args = agent_cli.build_parser().parse_args([
+            "--backend=tpu", "--probe=true", "--probe-port=9000",
+            "--probe-interval=5s", "--probe-window=30",
+            "--probe-quorum=4",
+        ])
+        assert args.probe_enabled and args.probe_port == 9000
+        assert agent_cli.parse_wait(args.probe_interval) == 5.0
+        assert args.probe_window == 30 and args.probe_quorum == 4
+
+    def test_probe_endpoint_prefers_l3_dcn_address(self):
+        from tpu_network_operator.agent import cli as agent_cli
+        from tpu_network_operator.agent import netlink as nl
+        from tpu_network_operator.agent import network as net
+
+        cfg = agent_cli.CmdConfig(
+            backend="tpu", mode="L3", probe_enabled=True, probe_port=8477,
+        )
+        nc = net.NetworkConfiguration(
+            link=nl.Link(index=2, name="ens9", flags=nl.IFF_UP,
+                         mtu=1500, mac="aa:bb:cc:dd:ee:ff")
+        )
+        nc.local_addr = "10.1.0.1"
+        live_runner = object()
+        assert agent_cli._probe_endpoint(
+            cfg, {"ens9": nc}, live_runner
+        ) == "10.1.0.1:8477"
+
+    def test_probe_endpoint_empty_when_disabled(self):
+        from tpu_network_operator.agent import cli as agent_cli
+
+        cfg = agent_cli.CmdConfig(backend="tpu", probe_enabled=False)
+        assert agent_cli._probe_endpoint(cfg, {}, object()) == ""
+
+    def test_dead_responder_advertises_no_endpoint(self):
+        """Regression: probe enabled but the runner failed to start
+        (squatted port → None) must NOT advertise an endpoint — peers
+        would count the silent node unreachable and an all-peers quorum
+        would retract readiness across the whole mesh."""
+        from tpu_network_operator.agent import cli as agent_cli
+
+        cfg = agent_cli.CmdConfig(
+            backend="tpu", probe_enabled=True, probe_port=8477,
+        )
+        import os
+        os.environ["NODE_IP"] = "10.0.0.9"
+        try:
+            assert agent_cli._probe_endpoint(cfg, {}, None) == ""
+            assert agent_cli._probe_endpoint(cfg, {}, object()) == (
+                "10.0.0.9:8477"
+            )
+        finally:
+            del os.environ["NODE_IP"]
+
+    def test_runner_not_started_for_gaudi(self, caplog):
+        import logging
+
+        from tpu_network_operator.agent import cli as agent_cli
+
+        cfg = agent_cli.CmdConfig(backend="gaudi", probe_enabled=True)
+        with caplog.at_level(logging.WARNING, logger="tpunet.agent"):
+            assert agent_cli._start_probe_runner(cfg) is None
+        # requested-but-unstartable probing must not be silent
+        assert any("tpu-only" in r.message for r in caplog.records)
+
+    def test_probe_flag_rejects_typos(self):
+        """--probe gates a safety mesh: '--probe=ture' must error, not
+        silently parse as False and skip fabric validation."""
+        import pytest as _pytest
+
+        from tpu_network_operator.agent import cli as agent_cli
+
+        parser = agent_cli.build_parser()
+        assert parser.parse_args(["--probe=false"]).probe_enabled is False
+        with _pytest.raises(SystemExit):
+            parser.parse_args(["--probe=ture"])
+
+    def test_window_clamped_to_detection_minimum(self):
+        """Defense in depth below the webhook: a direct --probe-window=1
+        caller still gets a window able to mark peers unreachable."""
+        from tpu_network_operator.probe.prober import PeerWindow
+
+        w = PeerWindow(1)
+        w.record(None)
+        w.record(None)
+        assert not w.reachable
+
+
+class TestReconcilerProbe:
+    """Controller half of the loop against the fake apiserver."""
+
+    def env(self):
+        from tests.test_controller import make_cluster
+        from tpu_network_operator.controller.health import Metrics
+        from tpu_network_operator.controller.manager import Manager
+
+        fake = make_cluster()
+        metrics = Metrics()
+        mgr = Manager(fake, NAMESPACE, metrics=metrics)
+        return fake, mgr, metrics
+
+    def probe_cr(self, name="mesh", quorum=0, nodes=3):
+        from tpu_network_operator.api.v1alpha1 import NetworkClusterPolicy
+
+        p = NetworkClusterPolicy()
+        p.metadata.name = name
+        p.spec.configuration_type = "tpu-so"
+        p.spec.node_selector = {"tpunet.dev/tpu": "true"}
+        p.spec.tpu_scale_out.layer = "L2"
+        p.spec.tpu_scale_out.probe.enabled = True
+        p.spec.tpu_scale_out.probe.quorum = quorum
+        return p
+
+    def report(self, fake, node, policy="mesh", ok=True, reachable=2,
+               total=2, state="Healthy", unreachable=(), endpoint=None):
+        from tpu_network_operator.agent import report as rpt
+
+        fake.apply(rpt.lease_for(rpt.ProvisioningReport(
+            node=node, policy=policy, ok=ok,
+            probe_endpoint=(
+                f"10.0.0.{node[-1]}:8477" if endpoint is None else endpoint
+            ),
+            probe={
+                "peersTotal": total, "peersReachable": reachable,
+                "unreachable": sorted(unreachable),
+                "rttP50Ms": 0.8, "rttP99Ms": 1.2,
+                "lossRatio": 0.0, "state": state,
+            },
+        ), NAMESPACE))
+
+    def reconcile(self, fake, mgr, name="mesh"):
+        mgr.enqueue(name)
+        mgr.drain()
+
+    def seed(self, fake, mgr, nodes=3, quorum=0):
+        from tpu_network_operator.api.v1alpha1.types import API_VERSION
+
+        for i in range(nodes):
+            fake.add_node(f"node-{i}", {"tpunet.dev/tpu": "true"})
+        fake.create(self.probe_cr(quorum=quorum).to_dict())
+        self.reconcile(fake, mgr)
+        fake.simulate_daemonset_controller()
+        return API_VERSION
+
+    def test_probe_args_projected(self):
+        fake, mgr, _ = self.env()
+        fake.create(self.probe_cr().to_dict())
+        self.reconcile(fake, mgr)
+        args = fake.get("apps/v1", "DaemonSet", "mesh", NAMESPACE)[
+            "spec"]["template"]["spec"]["containers"][0]["args"]
+        # webhook-defaulted knobs, fully pinned (every spec knob reaches
+        # the agent — none may silently fall back to agent defaults)
+        for flag in ("--probe=true", "--probe-port=8477",
+                     "--probe-interval=10s", "--probe-window=20",
+                     "--probe-quorum=0", "--probe-fail-threshold=2",
+                     "--probe-recovery-threshold=2"):
+            assert flag in args, args
+
+    def test_no_probe_args_when_disabled(self):
+        from tests.test_controller import tpu_cr
+
+        fake, mgr, _ = self.env()
+        fake.create(tpu_cr(name="plain").to_dict())
+        self.reconcile(fake, mgr, "plain")
+        args = fake.get("apps/v1", "DaemonSet", "plain", NAMESPACE)[
+            "spec"]["template"]["spec"]["containers"][0]["args"]
+        assert not any(a.startswith("--probe") for a in args)
+
+    def test_peer_configmap_distributed_and_gc_owned(self):
+        fake, mgr, _ = self.env()
+        av = self.seed(fake, mgr)
+        for i in range(3):
+            self.report(fake, f"node-{i}")
+        self.reconcile(fake, mgr)
+        cm = fake.get("v1", "ConfigMap", "tpunet-peers-mesh", NAMESPACE)
+        peers = json.loads(cm["data"]["peers"])
+        assert peers == {
+            "node-0": "10.0.0.0:8477",
+            "node-1": "10.0.0.1:8477",
+            "node-2": "10.0.0.2:8477",
+        }
+        assert cm["metadata"]["ownerReferences"][0]["name"] == "mesh"
+        # a malformed endpoint from a skewed agent is dropped at
+        # distribution time, never handed to the mesh's probers
+        self.report(fake, "node-1", endpoint="10.0.0.1")   # no port
+        self.reconcile(fake, mgr)
+        cm = fake.get("v1", "ConfigMap", "tpunet-peers-mesh", NAMESPACE)
+        assert "node-1" not in json.loads(cm["data"]["peers"])
+        # CR deletion garbage-collects the peer list with the DaemonSet
+        fake.delete(av, "NetworkClusterPolicy", "mesh")
+        assert fake.dump("ConfigMap/*") == []
+
+    def test_connectivity_matrix_in_status(self):
+        fake, mgr, _ = self.env()
+        av = self.seed(fake, mgr)
+        for i in range(3):
+            self.report(fake, f"node-{i}")
+        self.reconcile(fake, mgr)
+        cr = fake.get(av, "NetworkClusterPolicy", "mesh")
+        rows = cr["status"]["probeNodes"]
+        assert [r["node"] for r in rows] == ["node-0", "node-1", "node-2"]
+        assert all(r["state"] == "Reachable" for r in rows)
+        assert all(r["peersReachable"] == 2 for r in rows)
+        conds = {c["type"]: c for c in cr["status"]["conditions"]}
+        assert conds["DataplaneDegraded"]["status"] == "False"
+
+    def test_partition_degrades_quarantines_and_recovers(self):
+        """The condition arc: degraded on first bad pass, Quarantined
+        after 3 consecutive, cleared on recovery — with the re-probe
+        backoff requeue while degraded."""
+        fake, mgr, metrics = self.env()
+        av = self.seed(fake, mgr)
+        for i in range(3):
+            self.report(fake, f"node-{i}")
+        self.reconcile(fake, mgr)
+
+        # streak advance is rate-limited to one per probe interval —
+        # drive it with an injected clock (10s = the defaulted interval)
+        clock = [1000.0]
+        mgr.reconciler._probe_clock = lambda: clock[0]
+
+        # node-2 partitions: its row collapses, peers see it gone
+        self.report(fake, "node-2", reachable=0, state="Degraded",
+                    unreachable=["node-0", "node-1"])
+        for i in (0, 1):
+            self.report(fake, f"node-{i}", reachable=1,
+                        unreachable=["node-2"])
+        result = mgr.reconciler.reconcile("mesh")
+        assert result.requeue and result.requeue_after > 0
+        cr = fake.get(av, "NetworkClusterPolicy", "mesh")
+        rows = {r["node"]: r for r in cr["status"]["probeNodes"]}
+        assert rows["node-2"]["state"] == "Degraded"
+        assert rows["node-2"]["unreachable"] == ["node-0", "node-1"]
+        # peers still reporting a Healthy gate stay Reachable: the
+        # controller defers to the agent gate's hysteresis (its label
+        # decision), never declaring an outage the label didn't reflect
+        assert rows["node-0"]["state"] == "Reachable"
+        cond = {c["type"]: c for c in cr["status"]["conditions"]}[
+            "DataplaneDegraded"]
+        assert cond["status"] == "True"
+        first_transition = cond["lastTransitionTime"]
+
+        # a burst of reconciles within one probe interval re-reads the
+        # SAME snapshot: the streak must NOT advance (no quarantine off
+        # one probe round)
+        for _ in range(3):
+            mgr.reconciler.reconcile("mesh")
+        cr = fake.get(av, "NetworkClusterPolicy", "mesh")
+        rows = {r["node"]: r for r in cr["status"]["probeNodes"]}
+        assert rows["node-2"]["state"] == "Degraded"
+
+        # two more degraded passes a full interval apart → quarantine,
+        # growing backoff
+        delays = [result.requeue_after]
+        for _ in range(2):
+            clock[0] += 10.0
+            result = mgr.reconciler.reconcile("mesh")
+            delays.append(result.requeue_after)
+        assert delays == sorted(delays) and delays[-1] > delays[0]
+        cr = fake.get(av, "NetworkClusterPolicy", "mesh")
+        rows = {r["node"]: r for r in cr["status"]["probeNodes"]}
+        assert rows["node-2"]["state"] == "Quarantined"
+        cond = {c["type"]: c for c in cr["status"]["conditions"]}[
+            "DataplaneDegraded"]
+        assert "quarantined" in cond["message"]
+        # no flip → transition timestamp stable
+        assert cond["lastTransitionTime"] == first_transition
+
+        # recovery clears everything
+        for i in range(3):
+            self.report(fake, f"node-{i}")
+        result = mgr.reconciler.reconcile("mesh")
+        assert not result.requeue
+        cr = fake.get(av, "NetworkClusterPolicy", "mesh")
+        assert all(
+            r["state"] == "Reachable" for r in cr["status"]["probeNodes"]
+        )
+        cond = {c["type"]: c for c in cr["status"]["conditions"]}[
+            "DataplaneDegraded"]
+        assert cond["status"] == "False"
+
+    def test_marathon_quarantine_streak_never_overflows_requeue(self):
+        """Regression: a streak past 1024 made 2**streak overflow and
+        fail every reconcile of the policy until restart."""
+        fake, mgr, _ = self.env()
+        self.seed(fake, mgr)
+        self.report(fake, "node-0", reachable=0, state="Degraded",
+                    unreachable=["node-1", "node-2"])
+        mgr.reconciler._probe_failing[("mesh", "node-0")] = (2000, 0.0)
+        result = mgr.reconciler.reconcile("mesh")
+        assert result.requeue
+        assert result.requeue_after == 60.0      # capped, no raise
+
+    def test_quorum_tolerates_dead_peer(self):
+        """quorum=1: peers that still reach one node stay Reachable even
+        while node-2 is dark."""
+        fake, mgr, _ = self.env()
+        av = self.seed(fake, mgr, quorum=1)
+        self.report(fake, "node-2", reachable=0, state="Degraded",
+                    unreachable=["node-0", "node-1"])
+        for i in (0, 1):
+            self.report(fake, f"node-{i}", reachable=1,
+                        unreachable=["node-2"])
+        self.reconcile(fake, mgr)
+        cr = fake.get(av, "NetworkClusterPolicy", "mesh")
+        rows = {r["node"]: r["state"] for r in cr["status"]["probeNodes"]}
+        assert rows == {
+            "node-0": "Reachable",
+            "node-1": "Reachable",
+            "node-2": "Degraded",
+        }
+
+    def test_probe_metrics_exported_and_retracted(self):
+        fake, mgr, metrics = self.env()
+        av = self.seed(fake, mgr)
+        for i in range(3):
+            self.report(fake, f"node-{i}")
+        self.reconcile(fake, mgr)
+        text = metrics.render()
+        assert (
+            'tpunet_probe_peers_reachable{node="node-0",policy="mesh"} 2'
+            in text
+        )
+        assert 'tpunet_probe_loss_ratio{node="node-1",policy="mesh"} 0.0' in text
+        assert (
+            'tpunet_probe_rtt_seconds'
+            '{node="node-2",policy="mesh",quantile="p50"} 0.0008'
+        ) in text
+        # CR deletion retracts every per-node series
+        fake.delete(av, "NetworkClusterPolicy", "mesh")
+        self.reconcile(fake, mgr)
+        assert "tpunet_probe_" not in metrics.render()
+
+    def test_single_node_policy_vacuously_healthy(self):
+        """Quorum edge: one node, zero peers — never degraded."""
+        fake, mgr, _ = self.env()
+        av = self.seed(fake, mgr, nodes=1)
+        self.report(fake, "node-0", reachable=0, total=0)
+        self.reconcile(fake, mgr)
+        cr = fake.get(av, "NetworkClusterPolicy", "mesh")
+        rows = cr["status"]["probeNodes"]
+        assert len(rows) == 1
+        assert rows[0]["node"] == "node-0"
+        assert rows[0]["state"] == "Reachable"
+        cond = {c["type"]: c for c in cr["status"]["conditions"]}[
+            "DataplaneDegraded"]
+        assert cond["status"] == "False"
+
+    def test_disable_transition_cleans_up_peer_configmap(self):
+        """Flipping probe off deletes the distributed peer list once
+        (stale membership must not await a re-enable) and clears the
+        matrix/condition; steady disabled passes issue no deletes."""
+        fake, mgr, _ = self.env()
+        av = self.seed(fake, mgr)
+        for i in range(3):
+            self.report(fake, f"node-{i}")
+        self.reconcile(fake, mgr)
+        assert fake.get("v1", "ConfigMap", "tpunet-peers-mesh", NAMESPACE)
+
+        cr = fake.get(av, "NetworkClusterPolicy", "mesh")
+        cr["spec"]["tpuScaleOut"]["probe"]["enabled"] = False
+        fake.update(cr)
+        self.reconcile(fake, mgr)
+        with pytest.raises(Exception):
+            fake.get("v1", "ConfigMap", "tpunet-peers-mesh", NAMESPACE)
+        cr = fake.get(av, "NetworkClusterPolicy", "mesh")
+        assert "probeNodes" not in cr["status"]
+        assert "conditions" not in cr["status"]
+        # steady disabled pass: no further delete attempts
+        before = dict(fake.request_counts)
+        self.reconcile(fake, mgr)
+        after = dict(fake.request_counts)
+        assert after.get(("delete", "ConfigMap"), 0) == \
+            before.get(("delete", "ConfigMap"), 0)
+
+    def test_disable_before_first_probe_round_still_cleans_up(self):
+        """Endpoints reported (peer CM distributed) but no probe data
+        yet (matrix empty): disabling inside that window must still
+        delete the peer ConfigMap — stale membership must not await a
+        re-enable."""
+        fake, mgr, _ = self.env()
+        av = self.seed(fake, mgr)
+        from tpu_network_operator.agent import report as rpt
+
+        for i in range(3):
+            # endpoint only — agent has not completed a probe round
+            fake.apply(rpt.lease_for(rpt.ProvisioningReport(
+                node=f"node-{i}", policy="mesh", ok=True,
+                probe_endpoint=f"10.0.0.{i}:8477",
+            ), NAMESPACE))
+        self.reconcile(fake, mgr)
+        assert fake.get("v1", "ConfigMap", "tpunet-peers-mesh", NAMESPACE)
+        cr = fake.get(av, "NetworkClusterPolicy", "mesh")
+        assert "probeNodes" not in cr["status"]        # no rows yet
+
+        cr["spec"]["tpuScaleOut"]["probe"]["enabled"] = False
+        fake.update(cr)
+        self.reconcile(fake, mgr)
+        with pytest.raises(Exception):
+            fake.get("v1", "ConfigMap", "tpunet-peers-mesh", NAMESPACE)
+
+    def test_admission_rejects_bad_probe_spec_end_to_end(self):
+        from tpu_network_operator.kube import AdmissionDeniedError
+
+        fake, _, _ = self.env()
+        bad = self.probe_cr()
+        bad.spec.tpu_scale_out.probe.quorum = 9
+        bad.spec.tpu_scale_out.probe.expected_peers = 4
+        with pytest.raises(AdmissionDeniedError, match="unsatisfiable"):
+            fake.create(bad.to_dict())
+
+    def test_report_with_unknown_future_fields_still_parses(self):
+        """Version-skew hardening: a NEWER agent's report carrying
+        fields this controller does not know must parse (dropping the
+        extras), not flip the node to 'unparseable report' not-ready."""
+        from tpu_network_operator.agent import report as rpt
+
+        raw = json.dumps({
+            "node": "n", "ok": True,
+            "some_v9_field": {"x": 1}, "another_new_one": 7,
+        })
+        rep = rpt.ProvisioningReport.from_json(raw)
+        assert rep.node == "n" and rep.ok is True
+
+    def test_degradation_error_names_the_failure_kind(self):
+        from tpu_network_operator.agent import cli as agent_cli
+
+        err = agent_cli._degradation_error
+        assert err(["ens9"]) == "interfaces degraded: ens9"
+        assert err([agent_cli.PROBE_DEGRADED]) == "probe mesh below quorum"
+        assert err(["ens9", agent_cli.PROBE_DEGRADED]) == (
+            "interfaces degraded: ens9; probe mesh below quorum"
+        )
+
+    def test_quorum_rule_shared_between_agent_and_controller(self):
+        """One required_peers() serves both sides — spot-check the
+        semantics at the seams."""
+        from tpu_network_operator.probe.prober import required_peers
+
+        assert required_peers(0, 0, 5) == 5        # all live peers
+        assert required_peers(3, 0, 5) == 3        # plain quorum
+        assert required_peers(10, 0, 5) == 5       # clamped to live
+        assert required_peers(0, 16, 8) == 16      # pinned base
+        assert required_peers(8, 16, 8) == 8       # quorum under pin
+        assert required_peers(0, 0, 0) == 0        # single-node policy
+
+    def test_report_round_trip_preserves_probe_fields(self):
+        from tpu_network_operator.agent import report as rpt
+
+        rep = rpt.ProvisioningReport(
+            node="n", probe_endpoint="10.0.0.1:8477",
+            probe={"peersTotal": 3, "peersReachable": 2},
+        )
+        back = rpt.ProvisioningReport.from_json(rep.to_json())
+        assert back.probe_endpoint == "10.0.0.1:8477"
+        assert back.probe == {"peersTotal": 3, "peersReachable": 2}
+        with pytest.raises(ValueError, match="probe"):
+            rpt.ProvisioningReport.from_json(json.dumps(
+                {"node": "n", "probe": "not-a-dict"}
+            ))

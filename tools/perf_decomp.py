@@ -71,6 +71,25 @@ def main() -> int:
 
     devices = bench.init_devices(jax.devices)
     n = len(devices)
+    # Single-chip assumption: the fwd/grad probes below and the matmul
+    # ceiling chain are plain unsharded jits, while make_train_step
+    # compiles against the mesh.  On n > 1 the probes would silently
+    # replicate (each chip computing the full batch) and every derived
+    # MFU/multiplier would compare sharded against replicated work —
+    # numbers that look plausible and mean nothing.  Until the probes
+    # pin in_shardings from the mesh, refuse multi-chip outright.
+    if n != 1:
+        print(json.dumps({
+            "metric": "mfu decomposition", "value": 0.0, "unit": "mfu",
+            "vs_baseline": 0.0,
+            "error": (
+                f"perf_decomp assumes a single chip (found {n} devices): "
+                "its stage probes are unsharded jits and would replicate "
+                "across the mesh; run with one device (e.g. "
+                "JAX_PLATFORMS=cpu or a 1-chip slice)"
+            ),
+        }))
+        return 1
     kind = getattr(devices[0], "device_kind", "cpu")
     peak = bench.peak_flops(kind)
     mesh = make_mesh(plan_axes(n))

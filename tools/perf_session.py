@@ -19,7 +19,9 @@ Phases:
 7. int8-KV decode cost ablation at the tracked b64 geometry;
 8. controller-plane bench: reconciles/sec + apiserver requests per
    reconcile, cached vs uncached (tools/controller_bench.py — no TPU
-   needed).
+   needed);
+9. probe-mesh bench: DCN partition detection latency + label
+   convergence at 20 nodes (tools/probe_bench.py — no TPU needed).
 
 Usage: python tools/perf_session.py [--out perf_session.jsonl]
 """
@@ -128,6 +130,12 @@ def main() -> int:
         # runs anywhere; tracked per-round like the train rungs)
         maybe_run_phase(out, "controller-bench",
                   [py, "tools/controller_bench.py"], timeout=600)
+        # 9. dataplane probe mesh: partition detection latency +
+        # label-convergence time at 20 nodes on the deterministic fake
+        # fabric (no TPU, no sockets; acceptance budget 3 intervals)
+        maybe_run_phase(out, "probe-bench",
+                  [py, "tools/probe_bench.py", "--nodes", "20",
+                   "--out", "BENCH_probe.json"], timeout=600)
     print(f"done -> {args.out}")
     return 0
 

@@ -1,0 +1,198 @@
+#!/usr/bin/env python3
+"""Probe-mesh failure-detection benchmark — prints ONE JSON line.
+
+Simulates an N-node dataplane probe mesh entirely in-process on the
+deterministic FakeFabric (no sockets, seeded RNG, manual clock): every
+node runs the SAME ProbeRunner the agent runs (responder + prober +
+readiness gate), its NFD ``tpu-scale-out`` label mirrored from the gate
+verdict.  Retraction timing is exact — the agent retracts via the
+runner's on_transition hook the moment the gate flips — while
+restoration in the shipped agent additionally waits for the next idle
+monitor tick (up to --recheck-interval), so the convergence number here
+is the gate-level floor.
+
+Timeline: warm the mesh → inject a full partition of one node → measure
+how many probe intervals until its label is retracted (the acceptance
+budget is 3) → let the quarantine backoff engage → heal → measure
+label-convergence time back to ready, and assert nobody else's label
+flapped along the way (their quorum tolerates the dead peer).
+
+Usage: python tools/probe_bench.py [--nodes 20] [--interval 5]
+       [--loss 0.01] [--out BENCH_probe.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+class SimNode:
+    """One mesh member: agent-equivalent runner + its label state."""
+
+    def __init__(self, fabric, name, addr, peers, interval, quorum):
+        from tpu_network_operator.probe import ProbeRunner
+
+        self.name = name
+        self.addr = addr
+        self.runner = ProbeRunner(
+            fabric, addr, name, lambda: peers,
+            interval=interval, quorum=quorum,
+        )
+        self.runner.responder.start()
+        self.label = True          # the monitor wrote it at provision time
+        self.transitions = 0
+        self.next_due = 0.0
+
+    def maybe_step(self, now, interval):
+        if now < self.next_due:
+            return
+        self.runner.step()
+        ready = self.runner.ready()
+        if ready != self.label:
+            self.label = ready
+            self.transitions += 1
+        # degraded gates stretch their own cadence (quarantine backoff)
+        self.next_due = now + self.runner.gate.current_interval(interval)
+
+
+def run_mesh(n_nodes, interval, loss, seed):
+    from tpu_network_operator.probe import FakeFabric
+
+    fabric = FakeFabric(seed=seed, latency=0.0005, jitter=0.0002)
+    peers = {
+        f"node-{i:03d}": f"10.0.{i // 256}.{i % 256}:8477"
+        for i in range(n_nodes)
+    }
+    # tolerate one dead peer: the quorum that keeps the healthy majority
+    # labeled while exactly the partitioned node drops out
+    quorum = max(n_nodes - 2, 1)
+    nodes = [
+        SimNode(fabric, name, addr, peers, interval, quorum)
+        for name, addr in peers.items()
+    ]
+    if loss:
+        for addr in peers.values():
+            fabric.set_loss(addr.rpartition(":")[0], loss)
+
+    def tick():
+        now = fabric.clock()
+        for node in nodes:
+            node.maybe_step(now, interval)
+        fabric.advance(interval)
+
+    def tick_until(pred, budget_ticks):
+        for i in range(budget_ticks):
+            tick()
+            if pred():
+                return i + 1
+        return -1
+
+    # warm: fill windows until every label is steady-ready
+    for _ in range(5):
+        tick()
+    assert all(node.label for node in nodes), "mesh never converged ready"
+    for node in nodes:
+        node.transitions = 0
+
+    victim = nodes[n_nodes // 2]
+    victim_host = victim.addr.rpartition(":")[0]
+    log(f"== partitioning {victim.name} ({victim_host}) at "
+        f"t={fabric.clock():.0f}s")
+    t_partition = fabric.clock()
+    fabric.partition(victim_host)
+    detect_ticks = tick_until(lambda: not victim.label, 20)
+    detection_seconds = fabric.clock() - t_partition - interval
+    # the partition lands mid-window: detection counts whole probe
+    # intervals from injection to label retraction
+    detection_intervals = detect_ticks
+
+    # let the quarantine backoff engage (stretched re-probe cadence)
+    for _ in range(4):
+        tick()
+    backoff_interval = victim.runner.gate.current_interval(interval)
+
+    log(f"== healing at t={fabric.clock():.0f}s "
+        f"(backoff interval {backoff_interval:.0f}s)")
+    t_heal = fabric.clock()
+    fabric.heal(victim_host)
+    recover_ticks = tick_until(lambda: victim.label, 40)
+    convergence_seconds = fabric.clock() - t_heal - interval
+
+    # steady tail: no flapping after recovery
+    for _ in range(5):
+        tick()
+
+    others_flapped = sum(
+        node.transitions for node in nodes if node is not victim
+    )
+    return {
+        "nodes": n_nodes,
+        "interval_seconds": interval,
+        "quorum": quorum,
+        "loss": loss,
+        "victim": victim.name,
+        "detection_intervals": detection_intervals,
+        "detection_seconds": round(detection_seconds, 3),
+        "recovery_intervals": recover_ticks,
+        "label_convergence_seconds": round(convergence_seconds, 3),
+        "backoff_interval_seconds": round(backoff_interval, 3),
+        "victim_label_transitions": victim.transitions,
+        "other_label_flaps": others_flapped,
+        "datagrams_delivered": fabric.delivered,
+        "datagrams_dropped": fabric.dropped,
+        "victim_snapshot": (
+            victim.runner.export() or {}
+        ),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=20)
+    ap.add_argument("--interval", type=float, default=5.0,
+                    help="probe interval in simulated seconds")
+    ap.add_argument("--loss", type=float, default=0.01,
+                    help="ambient per-hop datagram loss ratio")
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--out", default="",
+                    help="also write the JSON artifact to this path")
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    mesh = run_mesh(args.nodes, args.interval, args.loss, args.seed)
+    wall = time.perf_counter() - t0
+    log(f"   -> detected in {mesh['detection_intervals']} intervals, "
+        f"converged back in {mesh['label_convergence_seconds']}s sim "
+        f"({wall:.2f}s wall)")
+
+    result = {
+        "metric": "probe mesh partition detection latency",
+        "value": mesh["detection_intervals"],
+        "unit": "probe intervals",
+        # acceptance budget: detected within 3 probe intervals — report
+        # the fraction of budget consumed (< 1.0 = inside budget)
+        "vs_baseline": round(mesh["detection_intervals"] / 3.0, 3),
+        "wall_seconds": round(wall, 3),
+        **mesh,
+    }
+    line = json.dumps(result)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional
 
 from .. import nfd
 from ..lldp import detect_lldp
+from ..probe import prober as probe_defaults
 from . import netlink as nl
 from . import network as net
 from .gaudinet import write_gaudinet
@@ -66,6 +67,21 @@ class CmdConfig:
     # idle-time data-plane recheck cadence (continuous readiness):
     # degraded links retract the label/report, recovery restores them
     recheck_interval: float = 60.0
+    # dataplane probe mesh (probe/ subsystem): UDP echo responder +
+    # peer prober gating the readiness label on fabric connectivity.
+    # Defaults come from the probe package — the one copy the CRD
+    # layer and the projection also alias.
+    probe_enabled: bool = False
+    probe_port: int = probe_defaults.DEFAULT_PORT
+    probe_interval: float = float(probe_defaults.DEFAULT_INTERVAL_SECONDS)
+    probe_window: int = probe_defaults.DEFAULT_WINDOW
+    probe_quorum: int = 0        # min reachable peers; 0 = all
+    probe_expected_peers: int = 0   # pinned quorum base; 0 = live peers
+    probe_fail_threshold: int = probe_defaults.DEFAULT_FAIL_THRESHOLD
+    probe_recovery_threshold: int = probe_defaults.DEFAULT_RECOVERY_THRESHOLD
+    # transport seam: tests/bench inject a probe.FakeFabric; None =
+    # real UDP sockets
+    probe_transport: Optional[object] = None
     # seams
     ops: nl.LinkOps = field(default_factory=nl.LinkOps)
     # host-root override for the NFD features dir; env-settable so a
@@ -202,6 +218,7 @@ def _publish_report(
     config: CmdConfig,
     configs: Dict[str, net.NetworkConfiguration],
     coordinator: str,
+    probe_runner=None,
 ) -> bool:
     """Write the per-node provisioning report Lease (VERDICT r3 #3).
     True when it landed (or reporting is off: nothing to sync)."""
@@ -219,11 +236,16 @@ def _publish_report(
         configs=configs,
         bootstrap_path=config.bootstrap,
         coordinator=coordinator,
+        probe_endpoint=_probe_endpoint(config, configs, probe_runner),
+        probe_mesh=probe_runner.export() if probe_runner else None,
     )
     return rpt.write_report(client, config.report_namespace, rep)
 
 
-def _publish_failure_report(config: CmdConfig, error: str) -> bool:
+def _publish_failure_report(
+    config: CmdConfig, error: str, probe_runner=None,
+    configs: Optional[Dict[str, net.NetworkConfiguration]] = None,
+) -> bool:
     """ok=False report on a hard provisioning failure: the reconciler
     shows the node's error in status.errors instead of an opaque
     'Working on it..' while the DaemonSet restarts the pod."""
@@ -243,6 +265,14 @@ def _publish_failure_report(config: CmdConfig, error: str) -> bool:
             backend=config.backend,
             mode=config.mode,
             error=error,
+            # even a degraded node keeps answering and reporting probes:
+            # the reconciler's connectivity matrix needs the failing
+            # row, not a blank
+            probe_endpoint=(
+                _probe_endpoint(config, configs, probe_runner)
+                if configs else ""
+            ),
+            probe=probe_runner.export() if probe_runner else None,
         ),
     )
 
@@ -266,6 +296,213 @@ def _retract_report(config: CmdConfig) -> None:
     from . import report as rpt
 
     rpt.delete_report(client, config.report_namespace, node)
+
+
+# -- dataplane probe mesh (probe/ subsystem) ---------------------------------
+
+# entry added to the idle monitor's degradation list when the probe
+# gate is below quorum — rides the same retract/restore/publish-retry
+# machinery as a downed link
+PROBE_DEGRADED = "probe:quorum-lost"
+
+
+def _degradation_error(bad: List[str]) -> str:
+    """status.errors text for a degradation set.  Names the actual
+    failure kind: an operator triaging 'interfaces degraded' inspects
+    local NICs — wrong tree when the links are fine and the probe mesh
+    is below quorum."""
+    ifaces = [b for b in bad if b != PROBE_DEGRADED]
+    parts = []
+    if ifaces:
+        parts.append("interfaces degraded: " + ",".join(ifaces))
+    if PROBE_DEGRADED in bad:
+        parts.append("probe mesh below quorum")
+    return "; ".join(parts)
+
+
+def _probe_endpoint(
+    config: CmdConfig, configs: Dict[str, net.NetworkConfiguration],
+    probe_runner=None,
+) -> str:
+    """Where peers should probe this node: the first usable DCN
+    interface's LLDP-derived address (L3), else the node IP from the
+    downward API.  Empty = this node cannot be probed (and reports no
+    endpoint, so the controller leaves it out of the peer list).
+
+    Gated on a LIVE runner, not just the spec: if the responder failed
+    to start (squatted port), advertising the dead endpoint would make
+    every peer count this node unreachable and — under an all-peers
+    quorum — retract readiness across the whole mesh."""
+    if not config.probe_enabled or probe_runner is None:
+        return ""
+    host = ""
+    for name in net.usable_interfaces(configs, config.mode == L3):
+        addr = configs[name].local_addr
+        if addr:
+            host = addr
+            break
+    host = host or os.environ.get("NODE_IP", "")
+    return f"{host}:{config.probe_port}" if host else ""
+
+
+# last "peer list fetch failed" warning per policy: a PERMANENTLY
+# broken fetch (e.g. missing configmaps RBAC) must be visible in agent
+# logs — probing that silently never learns any peers passes the gate
+# vacuously — but not re-warned every 10s probe round
+_PEER_WARN_INTERVAL = 300.0
+_peer_warned_at: Dict[str, float] = {}
+
+
+def _probe_peers(config: CmdConfig, node: str):
+    """Fetch the controller-distributed peer list for this policy
+    (minus self).  None on any failure — the runner keeps its last
+    known mesh rather than vacuously passing an empty one."""
+    import json as json_mod
+    import time
+
+    ctx = _report_ctx(config)
+    if ctx is None:
+        return None
+    _, client = ctx
+    from . import report as rpt
+
+    from ..kube import errors as kerr
+
+    try:
+        cm = client.get(
+            "v1", "ConfigMap",
+            rpt.peer_configmap_name(config.policy_name),
+            config.report_namespace,
+        )
+        peers = json_mod.loads((cm.get("data", {}) or {}).get("peers", "{}"))
+    except kerr.NotFoundError:
+        # expected bootstrap race: the controller has not distributed
+        # the peer list yet — not an RBAC problem, don't warn
+        log.debug("peer list not distributed yet")
+        return None
+    except Exception as e:   # noqa: BLE001 — keep the last known mesh
+        now = time.monotonic()
+        if now - _peer_warned_at.get(config.policy_name, -1e9) \
+                >= _PEER_WARN_INTERVAL:
+            _peer_warned_at[config.policy_name] = now
+            log.warning(
+                "probe peer list fetch failed (keeping last known "
+                "mesh; check agent configmaps RBAC): %s", e,
+            )
+        return None
+    if not isinstance(peers, dict):
+        return None
+    return {
+        str(n): str(a) for n, a in peers.items()
+        if n != node and isinstance(a, str) and a
+    }
+
+
+def _on_probe_transition(
+    config: CmdConfig,
+    configs: Dict[str, net.NetworkConfiguration],
+    ready_label: str,
+    runner,
+    ready: bool,
+    monitor_state: Optional["_MonitorState"] = None,
+) -> None:
+    """Gate-flip hook, invoked from the probing thread the moment the
+    verdict changes.  Retraction is time-critical — waiting for the
+    next monitor tick (default 60s) would let a blackholed node keep
+    advertising readiness for up to a full tick after detection — so
+    the label comes off and the failure report goes out HERE.
+    Restoration is deliberately left to the monitor tick: it is not
+    time-critical, and only the monitor holds the combined verdict
+    (links may be down too).  The failure report merges the monitor's
+    last known degradation set so a concurrent interface failure is
+    not clobbered out of status.errors until the next tick."""
+    if ready:
+        return
+    nfd.remove_readiness_label(root=config.nfd_root)
+    bad = set(monitor_state.last_bad) if monitor_state else set()
+    _publish_failure_report(
+        config, _degradation_error(sorted(bad | {PROBE_DEGRADED})),
+        probe_runner=runner, configs=configs,
+    )
+
+
+# peer-list refresh cadence, deliberately much slower than the probe
+# round: membership changes at provisioning speed, not probing speed —
+# fetching the ConfigMap every 10s round per node would reintroduce
+# exactly the steady-state apiserver read load the informer work
+# removed from the controller
+PEER_REFRESH_SECONDS = 60.0
+
+
+def _make_peer_supplier(config: CmdConfig, node: str):
+    """TTL-memoized peers supplier: one ConfigMap GET per
+    PEER_REFRESH_SECONDS (success or failure), the cached answer in
+    between.  A cached None still means "keep the last known mesh"."""
+    import time
+
+    cache = {"at": -1e9, "peers": None}
+
+    def supplier():
+        now = time.monotonic()
+        if now - cache["at"] >= PEER_REFRESH_SECONDS:
+            cache["at"] = now
+            cache["peers"] = _probe_peers(config, node)
+        return cache["peers"]
+
+    return supplier
+
+
+def _start_probe_runner(
+    config: CmdConfig,
+    configs: Optional[Dict[str, net.NetworkConfiguration]] = None,
+    ready_label: str = "",
+    monitor_state: Optional["_MonitorState"] = None,
+):
+    """Responder + prober + gate on the DCN probe port; None when the
+    mesh is off.  The runner outlives transient peer-list/API failures
+    (its loop catches everything) and is stopped by cmd_run teardown."""
+    if not config.probe_enabled:
+        return None
+    if config.backend != "tpu":
+        # never silent: requested probing that cannot start must be
+        # visible, like the bind-failure path below
+        log.warning(
+            "--probe requested but backend is %r (probe mesh is "
+            "tpu-only); probing off", config.backend,
+        )
+        return None
+    from ..probe import ProbeRunner, UdpTransport
+
+    node = os.environ.get("NODE_NAME", "") or "local"
+    transport = config.probe_transport or UdpTransport()
+    try:
+        runner = ProbeRunner(
+            transport,
+            bind_addr=f"0.0.0.0:{config.probe_port}",
+            node=node,
+            peers_supplier=_make_peer_supplier(config, node),
+            interval=config.probe_interval,
+            window=config.probe_window,
+            quorum=config.probe_quorum,
+            expected_peers=config.probe_expected_peers,
+            fail_threshold=config.probe_fail_threshold,
+            recovery_threshold=config.probe_recovery_threshold,
+        )
+    except OSError as e:
+        # a squatted probe port degrades to no probing, not a dead agent
+        log.error("probe responder bind failed (probing off): %s", e)
+        return None
+    runner.on_transition = lambda ready: _on_probe_transition(
+        config, configs or {}, ready_label, runner, ready,
+        monitor_state=monitor_state,
+    )
+    runner.start()
+    log.info(
+        "probe mesh on :%d (interval %.0fs, quorum %s)",
+        config.probe_port, config.probe_interval,
+        config.probe_quorum or "all",
+    )
+    return runner
 
 
 def _detect_and_apply_lldp(
@@ -474,16 +711,37 @@ def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
             return 0
 
         if config.keep_running:
-            # report first, then label: the cluster-visible record of WHAT
-            # was provisioned precedes the schedulability signal
-            synced = _publish_report(config, configs, coordinator)
-            if nfd.write_readiness_label(ready_label, root=config.nfd_root):
-                log.info("wrote NFD readiness label")
-            if wait_signal:
-                _idle_monitor(
-                    config, configs, coordinator, ready_label,
-                    initial_synced=synced,
+            # probe mesh first: by the time the node advertises
+            # readiness it is already answering peers' probes (a node
+            # that labels before it echoes would look blackholed to the
+            # rest of the mesh for one probe window).  The monitor
+            # state is shared with the transition hook so the hook's
+            # failure report can merge any interface degradation the
+            # monitor already knows about.
+            monitor_state = _MonitorState()
+            probe_runner = _start_probe_runner(
+                config, configs, ready_label, monitor_state
+            )
+            try:
+                # report first, then label: the cluster-visible record
+                # of WHAT was provisioned precedes the schedulability
+                # signal
+                synced = _publish_report(
+                    config, configs, coordinator, probe_runner=probe_runner
                 )
+                if nfd.write_readiness_label(
+                    ready_label, root=config.nfd_root
+                ):
+                    log.info("wrote NFD readiness label")
+                if wait_signal:
+                    _idle_monitor(
+                        config, configs, coordinator, ready_label,
+                        initial_synced=synced, probe_runner=probe_runner,
+                        state=monitor_state,
+                    )
+            finally:
+                if probe_runner is not None:
+                    probe_runner.stop()
             post_cleanups(config, configs)
         return 0
     except (
@@ -500,74 +758,143 @@ def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
         return 1
 
 
+@dataclass
+class _MonitorState:
+    """Cross-tick idle-monitor state (separate from the loop so tests
+    and the probe bench can drive ticks synchronously)."""
+
+    last_bad: List[str] = field(default_factory=list)
+    # whether the last publish landed — a failed publish must be
+    # retried, not heartbeat-renewed into a bare Lease the reconciler
+    # can never see
+    report_synced: bool = True
+
+
+def _monitor_tick(
+    config: CmdConfig,
+    configs: Dict[str, net.NetworkConfiguration],
+    coordinator: str,
+    ready_label: str,
+    state: _MonitorState,
+    probe_runner=None,
+) -> None:
+    """One continuous-readiness pass: re-verify the data plane (links,
+    L3 addressing, probe-mesh quorum), retract the NFD label + publish
+    an ok=False report on degradation, restore both on recovery, and
+    heartbeat the report Lease on healthy passes."""
+    bad = net.verify_configured(configs, config.ops, config.mode == L3)
+    if probe_runner is not None and not probe_runner.ready():
+        # below-quorum fabric connectivity is a degradation exactly like
+        # a downed link: the gate already debounced it
+        # (failure/recovery thresholds), so no extra damping here
+        bad = sorted(bad + [PROBE_DEGRADED])
+    if bad != state.last_bad:
+        # degradation set CHANGED (including nonempty → different
+        # nonempty: the report must name the currently-broken
+        # interfaces, not the first that broke)
+        if bad:
+            log.warning(
+                "data plane degraded: %s — retracting readiness", bad,
+            )
+            nfd.remove_readiness_label(root=config.nfd_root)
+            state.report_synced = _publish_failure_report(
+                config, _degradation_error(bad),
+                probe_runner=probe_runner, configs=configs,
+            )
+        else:
+            log.info("data plane recovered — restoring readiness")
+            state.report_synced = _publish_report(
+                config, configs, coordinator, probe_runner=probe_runner
+            )
+            if probe_runner is None or probe_runner.ready():
+                # same TOCTOU guard as the steady branch: the gate may
+                # have flipped down during the publish round-trip, and
+                # re-labeling would undo the hook's retraction
+                nfd.write_readiness_label(
+                    ready_label, root=config.nfd_root
+                )
+    elif not state.report_synced or probe_runner is not None:
+        # ONE publish path for two reasons to rewrite the report body:
+        # a failed earlier publish must be retried until the
+        # cluster-visible report matches reality (renewing a stale body
+        # would keep the WRONG report fresh forever), and a live mesh
+        # must republish fresh probe stats every tick in BOTH
+        # directions — renewTime-only heartbeats would freeze the
+        # connectivity matrix and the tpunet_probe_* gauges at their
+        # last-transition snapshot, worst exactly while an operator is
+        # triaging a worsening outage.
+        state.report_synced = (
+            _publish_report(
+                config, configs, coordinator, probe_runner=probe_runner
+            )
+            if not bad
+            else _publish_failure_report(
+                config, _degradation_error(bad),
+                probe_runner=probe_runner, configs=configs,
+            )
+        )
+        if (
+            probe_runner is not None and not bad
+            and probe_runner.ready()
+        ):
+            # re-assert the label the gate's hook may have retracted
+            # out-of-band — re-checking ready() HERE rather than the
+            # tick-top sample: the gate can flip during the publish
+            # round-trip above, and re-labeling a just-detected
+            # partition would undo the hook's retraction
+            nfd.write_readiness_label(ready_label, root=config.nfd_root)
+    elif not bad:
+        _renew_report(config)
+    state.last_bad = bad
+
+
 def _idle_monitor(
     config: CmdConfig,
     configs: Dict[str, net.NetworkConfiguration],
     coordinator: str,
     ready_label: str,
     initial_synced: bool = True,
+    probe_runner=None,
+    state: Optional[_MonitorState] = None,
 ) -> None:
     """The idle steady state (ref main.go:252-255) upgraded to continuous
     readiness: every ``recheck_interval`` the agent re-verifies the data
-    plane.  Degradation (link down / L3 address gone) retracts the NFD
-    label and publishes an ok=False report — a broken node must stop
+    plane via :func:`_monitor_tick`.  A broken node must stop
     advertising readiness long before its pod dies; recovery restores
-    both.  Healthy passes refresh the report Lease's renewTime so the
-    reconciler can age out reports from wedged agents."""
+    it.  Healthy passes refresh the report Lease's renewTime so the
+    reconciler can age out reports from wedged agents.  ``state`` may
+    be the instance already shared with the probe transition hook."""
     ev = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: ev.set())
 
-    last_bad: List[str] = []
-    # whether the provisioning pass's publish landed — a failed initial
-    # publish must be retried here, not heartbeat-renewed into a bare
-    # Lease the reconciler can never see
-    report_synced = initial_synced
+    if state is None:
+        state = _MonitorState()
+    state.report_synced = initial_synced
     while not ev.wait(config.recheck_interval):
         # one transient error (netlink hiccup, API blip) must not kill
         # the agent: a crashed monitor skips post_cleanups and leaves the
         # node advertising readiness with nobody watching it
         try:
-            bad = net.verify_configured(
-                configs, config.ops, config.mode == L3
+            _monitor_tick(
+                config, configs, coordinator, ready_label, state,
+                probe_runner=probe_runner,
             )
-            if bad != last_bad:
-                # degradation set CHANGED (including nonempty →
-                # different nonempty: the report must name the
-                # currently-broken interfaces, not the first that broke)
-                if bad:
-                    log.warning(
-                        "data plane degraded: %s — retracting readiness",
-                        bad,
-                    )
-                    nfd.remove_readiness_label(root=config.nfd_root)
-                    report_synced = _publish_failure_report(
-                        config, "interfaces degraded: " + ",".join(bad)
-                    )
-                else:
-                    log.info("data plane recovered — restoring readiness")
-                    report_synced = _publish_report(
-                        config, configs, coordinator
-                    )
-                    nfd.write_readiness_label(
-                        ready_label, root=config.nfd_root
-                    )
-            elif not report_synced:
-                # the last transition's publish failed: retry until the
-                # cluster-visible report matches reality (renewing a
-                # stale body would keep the WRONG report fresh forever)
-                report_synced = (
-                    _publish_report(config, configs, coordinator)
-                    if not bad
-                    else _publish_failure_report(
-                        config, "interfaces degraded: " + ",".join(bad)
-                    )
-                )
-            elif not bad:
-                _renew_report(config)
-            last_bad = bad
         except Exception as e:   # noqa: BLE001 — stay alive, retry next tick
             log.warning("idle recheck failed (will retry): %s", e)
+
+
+def _parse_strict_bool(s: str) -> bool:
+    """Unlike the permissive --configure lambda, an unrecognized value
+    here ERRORS: --probe gates a readiness-safety mesh, and a typo
+    ('--probe=ture') silently parsing as False would disable fabric
+    validation while the operator believes it is active."""
+    low = s.lower()
+    if low in ("1", "true", "yes"):
+        return True
+    if low in ("0", "false", "no"):
+        return False
+    raise ValueError(f"expected true/false, got {s!r}")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -605,6 +932,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "bootstrap lock before teardown (e.g. 45s)")
     p.add_argument("--recheck-interval", default="60s",
                    help="idle data-plane health recheck cadence")
+    p.add_argument("--probe", dest="probe_enabled", default=False,
+                   type=_parse_strict_bool,
+                   help="run the dataplane probe mesh (UDP echo "
+                        "responder + peer prober gating readiness)")
+    p.add_argument("--probe-port", type=int,
+                   default=probe_defaults.DEFAULT_PORT)
+    p.add_argument("--probe-interval",
+                   default=f"{probe_defaults.DEFAULT_INTERVAL_SECONDS}s",
+                   help="probe round cadence (e.g. 5s)")
+    p.add_argument("--probe-window", type=int,
+                   default=probe_defaults.DEFAULT_WINDOW,
+                   help="sliding window of probes per peer")
+    p.add_argument("--probe-quorum", type=int, default=0,
+                   help="min reachable peers for readiness (0 = all)")
+    p.add_argument("--probe-expected-peers", type=int, default=0,
+                   help="pinned quorum base: a shrunken peer list counts "
+                        "missing peers as unreachable (0 = live peers)")
+    p.add_argument("--probe-fail-threshold", type=int,
+                   default=probe_defaults.DEFAULT_FAIL_THRESHOLD,
+                   help="consecutive below-quorum rounds before the "
+                        "readiness label is retracted")
+    p.add_argument("--probe-recovery-threshold", type=int,
+                   default=probe_defaults.DEFAULT_RECOVERY_THRESHOLD,
+                   help="consecutive healthy rounds before it is restored")
     return p
 
 
@@ -666,6 +1017,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         policy_name=args.policy_name,
         drain_timeout=parse_wait(args.drain_timeout),
         recheck_interval=parse_wait(args.recheck_interval),
+        probe_enabled=args.probe_enabled,
+        probe_port=args.probe_port,
+        probe_interval=parse_wait(args.probe_interval),
+        probe_window=args.probe_window,
+        probe_quorum=args.probe_quorum,
+        probe_expected_peers=args.probe_expected_peers,
+        probe_fail_threshold=args.probe_fail_threshold,
+        probe_recovery_threshold=args.probe_recovery_threshold,
     )
     try:
         return cmd_run(config)

@@ -24,7 +24,7 @@ import errno
 import json
 import logging
 import socket
-from dataclasses import asdict, dataclass, field
+from dataclasses import asdict, dataclass, field, fields
 from typing import Dict, List, Optional
 
 log = logging.getLogger("tpunet.agent")
@@ -51,6 +51,12 @@ class ProvisioningReport:
     coordinator_reachable: Optional[bool] = None
     dcn_interfaces: List[str] = field(default_factory=list)
     error: str = ""
+    # dataplane probe mesh (probe/ subsystem): where this node answers
+    # peer probes ("host:port"; empty = probing off), and the latest
+    # mesh snapshot (ProbeSnapshot.to_report() + gate "state") — the
+    # reconciler folds these into the CR's connectivity matrix
+    probe_endpoint: str = ""
+    probe: Optional[Dict] = None
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), sort_keys=True)
@@ -65,9 +71,15 @@ class ProvisioningReport:
         d = json.loads(raw)
         if not isinstance(d, dict):
             raise ValueError("report must be a JSON object")
-        rep = ProvisioningReport(**d)
+        # tolerate unknown keys: a NEWER agent's report (extra fields)
+        # must stay parseable by this controller during version skew —
+        # rejecting it would flip every upgraded node to not-ready
+        known = {f.name for f in fields(ProvisioningReport)}
+        rep = ProvisioningReport(**{
+            k: v for k, v in d.items() if k in known
+        })
         for field_name in ("node", "policy", "backend", "mode",
-                           "coordinator", "error"):
+                           "coordinator", "error", "probe_endpoint"):
             if not isinstance(getattr(rep, field_name), str):
                 raise ValueError(f"report field {field_name!r} not a string")
         for field_name in ("interfaces_configured", "interfaces_total"):
@@ -77,6 +89,8 @@ class ProvisioningReport:
             isinstance(i, str) for i in rep.dcn_interfaces
         ):
             raise ValueError("report field 'dcn_interfaces' not a str list")
+        if rep.probe is not None and not isinstance(rep.probe, dict):
+            raise ValueError("report field 'probe' not an object")
         return ProvisioningReport(**{
             **asdict(rep),
             "ok": rep.ok is True,
@@ -111,6 +125,17 @@ def coordinator_reachable(address: str, timeout: float = 3.0) -> bool:
 
 def lease_name(node: str) -> str:
     return f"tpunet-agent-{node}"
+
+
+# controller-distributed probe peer list: one ConfigMap per policy in
+# the operator namespace, data.peers = JSON {node: "host:port"}.  The
+# reconciler derives it from the reports above; agents poll it for the
+# mesh membership they probe.
+PEER_CONFIGMAP_PREFIX = "tpunet-peers-"
+
+
+def peer_configmap_name(policy: str) -> str:
+    return PEER_CONFIGMAP_PREFIX + policy
 
 
 def _now_micro() -> str:
@@ -212,8 +237,16 @@ def report_from_result(
     bootstrap_path: str,
     coordinator: str = "",
     probe=coordinator_reachable,
+    probe_endpoint: str = "",
+    probe_mesh: Optional[Dict] = None,
 ) -> ProvisioningReport:
-    """Assemble the report from the agent's post-pass state."""
+    """Assemble the report from the agent's post-pass state.
+
+    ``probe_endpoint``/``probe_mesh`` carry the dataplane probe mesh's
+    answer address and latest snapshot (ProbeRunner.export()); the mesh
+    verdict does NOT feed ``ok`` here — the idle monitor publishes an
+    explicit failure report when the gate degrades, so the initial
+    provisioning report stays a statement about provisioning."""
     import os
 
     from .network import usable_interfaces
@@ -240,4 +273,6 @@ def report_from_result(
         coordinator=coordinator,
         coordinator_reachable=reachable,
         dcn_interfaces=usable,
+        probe_endpoint=probe_endpoint,
+        probe=probe_mesh,
     )

@@ -10,7 +10,11 @@ from .types import (  # noqa: F401
     API_VERSION,
     CONFIG_TYPE_GAUDI_SO,
     CONFIG_TYPE_TPU_SO,
+    CONDITION_DATAPLANE_DEGRADED,
     GaudiScaleOutSpec,
+    NodeProbeStatus,
+    PolicyCondition,
+    ProbeSpec,
     TpuScaleOutSpec,
     NetworkClusterPolicy,
     NetworkClusterPolicyList,

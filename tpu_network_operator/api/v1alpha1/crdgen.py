@@ -129,6 +129,82 @@ def openapi_schema() -> Dict[str, Any]:
                                     "routes (0 = agent default, 30s)."
                                 ),
                             },
+                            "probe": {
+                                "type": "object",
+                                "description": (
+                                    "Dataplane probe mesh: each agent "
+                                    "answers UDP echo probes on its DCN "
+                                    "endpoint and probes all peers; node "
+                                    "readiness is gated on reaching the "
+                                    "quorum."
+                                ),
+                                "properties": {
+                                    "enabled": {"type": "boolean"},
+                                    "port": {
+                                        "type": "integer",
+                                        "minimum": 0,
+                                        "maximum": 65535,
+                                        "description": (
+                                            "UDP echo port (0 = 8477)."
+                                        ),
+                                    },
+                                    "intervalSeconds": {
+                                        "type": "integer",
+                                        "minimum": 1,
+                                        "maximum": 3600,
+                                        "description": (
+                                            "Probe round cadence "
+                                            "(absent = 10s)."
+                                        ),
+                                    },
+                                    "window": {
+                                        "type": "integer",
+                                        "minimum": 0,
+                                        "maximum": 1000,
+                                        "description": (
+                                            "Sliding window of probes per "
+                                            "peer (0 = 20)."
+                                        ),
+                                    },
+                                    "quorum": {
+                                        "type": "integer",
+                                        "minimum": 0,
+                                        "description": (
+                                            "Min reachable peers for "
+                                            "readiness (0 = all peers)."
+                                        ),
+                                    },
+                                    "expectedPeers": {
+                                        "type": "integer",
+                                        "minimum": 0,
+                                        "description": (
+                                            "Expected mesh size; pins the "
+                                            "quorum base (0 = derive from "
+                                            "reports)."
+                                        ),
+                                    },
+                                    "failureThreshold": {
+                                        "type": "integer",
+                                        "minimum": 0,
+                                        "maximum": 100,
+                                        "description": (
+                                            "Consecutive below-quorum "
+                                            "rounds before the readiness "
+                                            "label is retracted (0 = 2)."
+                                        ),
+                                    },
+                                    "recoveryThreshold": {
+                                        "type": "integer",
+                                        "minimum": 0,
+                                        "maximum": 100,
+                                        "description": (
+                                            "Consecutive healthy rounds "
+                                            "before it is restored "
+                                            "(0 = 2)."
+                                        ),
+                                    },
+                                },
+                            },
                         },
                     },
                 },
@@ -140,6 +216,49 @@ def openapi_schema() -> Dict[str, Any]:
                     "ready": {"type": "integer", "format": "int32"},
                     "state": {"type": "string"},
                     "errors": {"type": "array", "items": {"type": "string"}},
+                    "probeNodes": {
+                        "type": "array",
+                        "description": (
+                            "Per-node probe mesh view (the policy's "
+                            "connectivity matrix, one row per node)."
+                        ),
+                        "items": {
+                            "type": "object",
+                            "properties": {
+                                "node": {"type": "string"},
+                                "peersTotal": {"type": "integer"},
+                                "peersReachable": {"type": "integer"},
+                                "unreachable": {
+                                    "type": "array",
+                                    "items": {"type": "string"},
+                                },
+                                "rttP50Ms": {"type": "number"},
+                                "rttP99Ms": {"type": "number"},
+                                "lossRatio": {"type": "number"},
+                                "state": {
+                                    "type": "string",
+                                    "enum": [
+                                        "Reachable",
+                                        "Degraded",
+                                        "Quarantined",
+                                    ],
+                                },
+                            },
+                        },
+                    },
+                    "conditions": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "properties": {
+                                "type": {"type": "string"},
+                                "status": {"type": "string"},
+                                "reason": {"type": "string"},
+                                "message": {"type": "string"},
+                                "lastTransitionTime": {"type": "string"},
+                            },
+                        },
+                    },
                 },
             },
         },

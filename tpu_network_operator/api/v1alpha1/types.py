@@ -43,6 +43,62 @@ DEFAULT_TPU_AGENT_IMAGE = "ghcr.io/tpunet/tpu-linkdiscovery:latest"
 DEFAULT_COORDINATOR_PORT = 8476        # jax.distributed default port
 DEFAULT_BOOTSTRAP_PATH = "/etc/tpu/jax-coordinator.json"
 
+# dataplane probe mesh defaults: aliased from the probe package (the
+# single source of the contract — agents and controller must agree);
+# the webhook fills these on enable so the projection is fully pinned
+from ...probe import prober as _probe_defaults  # noqa: E402
+
+DEFAULT_PROBE_PORT = _probe_defaults.DEFAULT_PORT
+DEFAULT_PROBE_INTERVAL_SECONDS = _probe_defaults.DEFAULT_INTERVAL_SECONDS
+DEFAULT_PROBE_WINDOW = _probe_defaults.DEFAULT_WINDOW
+DEFAULT_PROBE_FAILURE_THRESHOLD = _probe_defaults.DEFAULT_FAIL_THRESHOLD
+DEFAULT_PROBE_RECOVERY_THRESHOLD = _probe_defaults.DEFAULT_RECOVERY_THRESHOLD
+# a sliding window shorter than this can never mark a peer unreachable
+# (the webhook rejects such windows as silently detection-disabling)
+PROBE_PEER_FAIL_AFTER = _probe_defaults.PEER_FAIL_AFTER
+
+# NodeProbeStatus / DataplaneDegraded condition states
+PROBE_STATE_REACHABLE = "Reachable"
+PROBE_STATE_DEGRADED = "Degraded"
+PROBE_STATE_QUARANTINED = "Quarantined"
+CONDITION_DATAPLANE_DEGRADED = "DataplaneDegraded"
+
+
+@dataclass
+class ProbeSpec:
+    """Active DCN connectivity validation knobs (``probe:`` under
+    ``tpuScaleOut``).  When enabled, every agent runs a UDP echo
+    responder on its DCN endpoint and probes all peers it learns from
+    the controller-distributed peer list; the NFD readiness label is
+    then gated on reaching at least ``quorum`` peers (0 = all)."""
+
+    enabled: bool = j("enabled", False)
+    # UDP echo port on the DCN interface (0 = DEFAULT_PROBE_PORT)
+    port: int = j("port", 0)
+    # probe round cadence per peer.  Unlike the other knobs, 0 is NOT a
+    # defaulting sentinel: a zero cadence can never probe, so absent
+    # defaults to DEFAULT_PROBE_INTERVAL_SECONDS here and an explicit
+    # <= 0 is rejected by the webhook (one self-consistent contract)
+    interval_seconds: int = j(
+        "intervalSeconds", DEFAULT_PROBE_INTERVAL_SECONDS
+    )
+    # sliding window of probes per peer feeding loss/RTT stats
+    # (0 = DEFAULT_PROBE_WINDOW)
+    window: int = j("window", 0)
+    # min reachable peers for readiness; 0 = every peer.  Clamped to the
+    # live peer count at runtime so a shrunken mesh cannot deadlock.
+    quorum: int = j("quorum", 0)
+    # expected mesh size (peers per node); 0 = derive from agent
+    # reports.  Setting it pins the quorum base: the webhook rejects
+    # quorum > expectedPeers as unsatisfiable.
+    expected_peers: int = j("expectedPeers", 0)
+    # consecutive below-quorum probe rounds before the agent retracts
+    # the readiness label (0 = DEFAULT_PROBE_FAILURE_THRESHOLD)
+    failure_threshold: int = j("failureThreshold", 0)
+    # consecutive healthy rounds before it is restored — label flap
+    # damping (0 = DEFAULT_PROBE_RECOVERY_THRESHOLD)
+    recovery_threshold: int = j("recoveryThreshold", 0)
+
 
 @dataclass
 class GaudiScaleOutSpec:
@@ -96,6 +152,9 @@ class TpuScaleOutSpec:
     # routes/links (agent --drain-timeout; 0 = agent default 30s).  The
     # projected DaemonSet grace period scales to cover it.
     drain_timeout_seconds: int = j("drainTimeoutSeconds", 0)
+    # Dataplane probe mesh: active peer-to-peer DCN validation gating
+    # node readiness (probe/ subsystem).
+    probe: ProbeSpec = j("probe", factory=ProbeSpec)
 
 
 @dataclass
@@ -115,6 +174,36 @@ class NetworkClusterPolicySpec:
 
 
 @dataclass
+class NodeProbeStatus:
+    """One node's view of the probe mesh — one row of the per-policy
+    connectivity matrix (aggregated from agent reports by the
+    reconciler; no reference analog)."""
+
+    node: str = j("node", "")
+    peers_total: int = j("peersTotal", 0)
+    peers_reachable: int = j("peersReachable", 0)
+    # peer node names this node cannot reach (the matrix's off-diagonal
+    # failures; a full row here = the node is partitioned)
+    unreachable: List[str] = j("unreachable", factory=list)
+    rtt_p50_ms: float = j("rttP50Ms", 0.0)
+    rtt_p99_ms: float = j("rttP99Ms", 0.0)
+    loss_ratio: float = j("lossRatio", 0.0)
+    # Reachable | Degraded | Quarantined
+    state: str = j("state", "")
+
+
+@dataclass
+class PolicyCondition:
+    """metav1.Condition subset (the DataplaneDegraded carrier)."""
+
+    type: str = j("type", "")
+    status: str = j("status", "")          # "True" | "False"
+    reason: str = j("reason", "")
+    message: str = j("message", "")
+    last_transition_time: str = j("lastTransitionTime", "")
+
+
+@dataclass
 class NetworkClusterPolicyStatus:
     """Observed state (ref ``networkconfiguration_types.go:69-74``)."""
 
@@ -124,6 +213,9 @@ class NetworkClusterPolicyStatus:
     ready_nodes: int = j("ready", 0, required=True)
     state: str = j("state", "", required=True)
     errors: List[str] = j("errors", factory=list, required=True)
+    # dataplane probe mesh (omit-empty: absent unless probing is on)
+    probe_nodes: List[NodeProbeStatus] = j("probeNodes", factory=list)
+    conditions: List[PolicyCondition] = j("conditions", factory=list)
 
 
 @dataclass

@@ -67,6 +67,18 @@ def default_policy(policy: NetworkClusterPolicy) -> NetworkClusterPolicy:
             so.coordinator_port = t.DEFAULT_COORDINATOR_PORT
         if not so.bootstrap_path:
             so.bootstrap_path = t.DEFAULT_BOOTSTRAP_PATH
+        if so.probe.enabled:
+            # pin the probe contract too: the projected agent args never
+            # depend on agent-side defaults
+            p = so.probe
+            if not p.port:
+                p.port = t.DEFAULT_PROBE_PORT
+            if not p.window:
+                p.window = t.DEFAULT_PROBE_WINDOW
+            if not p.failure_threshold:
+                p.failure_threshold = t.DEFAULT_PROBE_FAILURE_THRESHOLD
+            if not p.recovery_threshold:
+                p.recovery_threshold = t.DEFAULT_PROBE_RECOVERY_THRESHOLD
     return policy
 
 
@@ -112,6 +124,47 @@ def validate_gaudi_so_spec(s: t.GaudiScaleOutSpec) -> None:
     _validate_common_so(s.layer, s.mtu, s.pull_policy, "gaudiScaleOut")
 
 
+def validate_probe_spec(p: t.ProbeSpec) -> None:
+    """Dataplane probe mesh knobs.  Zero means "agent default" for the
+    port/window/threshold fields (the mutating webhook fills them on
+    enable), so only explicit out-of-range values are rejected there.
+    ``intervalSeconds`` has NO zero sentinel (absent already means the
+    default via the dataclass) — an explicit <= 0 cadence can never
+    probe and is rejected outright."""
+    if p.interval_seconds <= 0 or p.interval_seconds > 3600:
+        raise AdmissionError(
+            "tpuScaleOut.probe: intervalSeconds must be 1-3600"
+        )
+    if p.port and not (1024 <= p.port <= 65535):
+        raise AdmissionError("tpuScaleOut.probe: port must be 1024-65535")
+    if p.window < 0 or p.window > 1000:
+        raise AdmissionError("tpuScaleOut.probe: window must be 0-1000")
+    if p.window and p.window < t.PROBE_PEER_FAIL_AFTER:
+        # a 1-probe window can never accumulate the consecutive misses
+        # that mark a peer unreachable — probing would silently report
+        # a partitioned fabric as healthy forever
+        raise AdmissionError(
+            f"tpuScaleOut.probe: window must be 0 (default) or >= "
+            f"{t.PROBE_PEER_FAIL_AFTER} — a shorter window can never "
+            f"detect an unreachable peer"
+        )
+    if p.quorum < 0 or p.expected_peers < 0:
+        raise AdmissionError(
+            "tpuScaleOut.probe: quorum/expectedPeers must be >= 0"
+        )
+    if p.expected_peers and p.quorum > p.expected_peers:
+        raise AdmissionError(
+            f"tpuScaleOut.probe: quorum ({p.quorum}) exceeds "
+            f"expectedPeers ({p.expected_peers}) — unsatisfiable"
+        )
+    for name, val in (("failureThreshold", p.failure_threshold),
+                      ("recoveryThreshold", p.recovery_threshold)):
+        if val < 0 or val > 100:
+            raise AdmissionError(
+                f"tpuScaleOut.probe: {name} must be 0-100"
+            )
+
+
 def validate_tpu_so_spec(s: t.TpuScaleOutSpec) -> None:
     _validate_common_so(s.layer, s.mtu, s.pull_policy, "tpuScaleOut")
     if s.topology_source not in TOPOLOGY_SOURCES:
@@ -135,6 +188,7 @@ def validate_tpu_so_spec(s: t.TpuScaleOutSpec) -> None:
         raise AdmissionError(
             "tpuScaleOut: drainTimeoutSeconds must be 0-600"
         )
+    validate_probe_spec(s.probe)
 
 
 def validate_spec(spec: NetworkClusterPolicySpec) -> List[str]:

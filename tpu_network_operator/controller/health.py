@@ -52,6 +52,19 @@ class Metrics:
         with self._lock:
             self._gauges.pop((name, _label_key(labels)), None)
 
+    def remove_matching(self, name: str, labels: Optional[Dict[str, str]] = None):
+        """Drop every series of ``name`` whose labels include all of
+        ``labels`` — the per-node retraction primitive: a policy's probe
+        gauges carry a ``node`` label the caller cannot enumerate after
+        the node (or the whole policy) is gone."""
+        want = set(_label_key(labels))
+        with self._lock:
+            for key in [
+                k for k in self._gauges
+                if k[0] == name and want <= set(k[1])
+            ]:
+                del self._gauges[key]
+
     def observe(self, name: str, value: float,
                 labels: Optional[Dict[str, str]] = None):
         """Record one histogram observation (cumulative le buckets,

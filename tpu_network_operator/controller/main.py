@@ -132,6 +132,11 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
     cached.cache("apps/v1", "DaemonSet", namespace=args.namespace)
     cached.cache("v1", "Pod", namespace=args.namespace)
     cached.cache(LEASE_API, "Lease", namespace=args.namespace)
+    # probe peer-list ConfigMaps are deliberately NOT cached: caching
+    # "v1 ConfigMap" would store/watch every CM in the namespace (CA
+    # bundles, co-located app configs, up to 1MiB each) to serve one
+    # tiny read per probing status pass — the pass-through GET is
+    # cheaper at any realistic policy count
 
     mgr = Manager(cached, namespace=args.namespace, is_openshift=openshift,
                   metrics=METRICS,

@@ -25,6 +25,8 @@ from ..api.v1alpha1 import types as t
 from ..api.v1alpha1.types import NetworkClusterPolicy
 from ..kube import errors as kerr
 from ..kube.informer import LIST_PAGE_SIZE   # noqa: F401 — re-exported
+from ..probe.prober import required_peers
+from ..probe.transport import valid_endpoint
 from . import templates
 
 log = logging.getLogger("tpunet.controller")
@@ -57,6 +59,23 @@ POLICY_GAUGES = (
     "tpunet_policy_all_good",
 )
 
+# per-node probe mesh gauges ({policy, node[, quantile]} labels);
+# retracted with Metrics.remove_matching on every status pass (departed
+# nodes) and on CR deletion (the whole policy's series)
+PROBE_GAUGES = (
+    "tpunet_probe_rtt_seconds",
+    "tpunet_probe_loss_ratio",
+    "tpunet_probe_peers_reachable",
+)
+
+# dataplane quarantine: consecutive degraded status passes before a
+# node is marked Quarantined in the connectivity matrix, and the
+# bounded-exponential re-probe requeue that replaces label-flap-speed
+# rechecking while the fabric stays broken
+PROBE_QUARANTINE_PASSES = 3
+PROBE_REPROBE_BASE_SECONDS = 5.0
+PROBE_REPROBE_MAX_SECONDS = 60.0
+
 
 @dataclass
 class Result:
@@ -65,6 +84,16 @@ class Result:
 
     requeue: bool = False
     requeue_after: float = 0.0
+
+
+def _as_int(v: Any) -> int:
+    """Report payloads come from the cluster (any agent version, maybe
+    mangled) — coerce defensively instead of TypeError-ing a pass."""
+    return int(v) if isinstance(v, (int, float)) and not isinstance(v, bool) else 0
+
+
+def _as_float(v: Any) -> float:
+    return float(v) if isinstance(v, (int, float)) and not isinstance(v, bool) else 0.0
 
 
 def controller_of(obj: Dict[str, Any]) -> Optional[Dict[str, Any]]:
@@ -212,6 +241,28 @@ def update_tpu_scale_out_daemonset(
         f"--coordinator-port={so.coordinator_port or t.DEFAULT_COORDINATOR_PORT}",
         f"--bootstrap={bootstrap_container}",
     ]
+    if so.probe.enabled:
+        # dataplane probe mesh: the webhook pinned the knobs on enable,
+        # but project the `or default` form anyway (defense in depth —
+        # a CR written past the webhook must not emit `--probe-port=0`)
+        args += [
+            "--probe=true",
+            f"--probe-port={so.probe.port or t.DEFAULT_PROBE_PORT}",
+            "--probe-interval="
+            f"{so.probe.interval_seconds or t.DEFAULT_PROBE_INTERVAL_SECONDS}s",
+            f"--probe-window={so.probe.window or t.DEFAULT_PROBE_WINDOW}",
+            f"--probe-quorum={so.probe.quorum}",
+        ]
+        if so.probe.expected_peers:
+            args.append(
+                f"--probe-expected-peers={so.probe.expected_peers}"
+            )
+        args += [
+            "--probe-fail-threshold="
+            f"{so.probe.failure_threshold or t.DEFAULT_PROBE_FAILURE_THRESHOLD}",
+            "--probe-recovery-threshold="
+            f"{so.probe.recovery_threshold or t.DEFAULT_PROBE_RECOVERY_THRESHOLD}",
+        ]
     if so.dcn_interfaces:
         # explicit DCN NIC override; absent = agent auto-discovery
         # (ref --interfaces projection analog, controller :176-203)
@@ -254,6 +305,20 @@ class NetworkClusterPolicyReconciler:
         # concurrent workers share one reconciler instance; the bucket
         # cache is its only cross-key mutable state
         self._reports_lock = threading.Lock()
+        # dataplane quarantine bookkeeping per (policy, node):
+        # (streak, last_advance_ts).  The streak advances at most once
+        # per probe interval of wall time — a burst of reconciles (DS
+        # rollout events) re-reading the SAME degraded snapshot must
+        # not quarantine a node off one probe round.  The workqueue
+        # never runs one policy on two workers, but the dict spans
+        # policies — lock it.  _probe_clock is a test seam.
+        self._probe_failing: Dict[Any, Any] = {}
+        self._probe_lock = threading.Lock()
+        import time as _time
+
+        # monotonic: an NTP step must not fast-forward (or freeze) the
+        # once-per-interval streak advance
+        self._probe_clock = _time.monotonic
 
     # -- setup ----------------------------------------------------------------
 
@@ -530,6 +595,217 @@ class NetworkClusterPolicyReconciler:
             for p in pods
         } - {""}
 
+    # -- dataplane probe mesh -------------------------------------------------
+
+    @staticmethod
+    def _probe_enabled(policy: NetworkClusterPolicy) -> bool:
+        return (
+            policy.spec.configuration_type == t.CONFIG_TYPE_TPU_SO
+            and policy.spec.tpu_scale_out.probe.enabled
+        )
+
+    def _sync_probe_peers(
+        self, policy: NetworkClusterPolicy, reports: List[Any]
+    ) -> None:
+        """Distribute the mesh membership: one owned ConfigMap per
+        policy mapping node → probe endpoint, derived from the agents'
+        own reports (a node joins the mesh by reporting where it
+        answers).  Apply only on change, so a steady mesh costs zero
+        writes per pass."""
+        import json
+
+        from ..agent import report as rpt
+
+        # drop malformed endpoints HERE: one bad "host" (no port) from a
+        # skewed/buggy agent would otherwise crash every peer's probe
+        # round at send() and silently freeze mesh validation fleet-wide
+        desired = {
+            r.node: r.probe_endpoint
+            for r in reports
+            if r.probe_endpoint and valid_endpoint(r.probe_endpoint)
+        }
+        name = rpt.peer_configmap_name(policy.metadata.name)
+        payload = json.dumps(desired, sort_keys=True)
+        try:
+            cur = self.client.get("v1", "ConfigMap", name, self.namespace)
+            if (cur.get("data", {}) or {}).get("peers") == payload:
+                return
+        except kerr.NotFoundError:
+            pass
+        except Exception as e:   # noqa: BLE001 — apply below self-heals
+            log.debug("peer ConfigMap read failed: %s", e)
+        cm = {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": self.namespace},
+            "data": {"peers": payload},
+        }
+        self._own(policy, cm)
+        try:
+            self.client.apply(cm, field_manager="tpunet-operator-probe")
+            log.info("probe peer list updated: %s (%d peers)",
+                     name, len(desired))
+        except Exception as e:   # noqa: BLE001 — next pass retries
+            log.warning("peer ConfigMap apply failed: %s", e)
+
+    def _aggregate_probe(
+        self, policy: NetworkClusterPolicy, reports: List[Any]
+    ):
+        """Fold per-node probe snapshots into the policy's connectivity
+        matrix + quarantine state.  Returns ``(rows, degraded_nodes,
+        requeue_after)`` — a nonzero requeue_after is the bounded
+        re-probe backoff while any node stays degraded."""
+        spec = policy.spec.tpu_scale_out.probe
+        pname = policy.metadata.name
+        rows: List[t.NodeProbeStatus] = []
+        degraded: List[str] = []
+        max_streak = 0
+        seen = set()
+        interval = float(
+            spec.interval_seconds or t.DEFAULT_PROBE_INTERVAL_SECONDS
+        )
+        now = self._probe_clock()
+        for rep in sorted(reports, key=lambda r: r.node):
+            probe = rep.probe if isinstance(rep.probe, dict) else None
+            seen.add(rep.node)
+            if probe is None:
+                continue   # agent has not completed a probe round yet
+            peers_total = _as_int(probe.get("peersTotal"))
+            reachable = _as_int(probe.get("peersReachable"))
+            required = required_peers(
+                spec.quorum, spec.expected_peers, peers_total
+            )
+            # the Degraded verdict DEFERS to the agent gate (it damps
+            # single-round blips with its fail/recovery thresholds and
+            # owns the label decision — the controller must not declare
+            # an outage the label never reflected); the raw
+            # reachable-vs-required check is only the fallback for
+            # version-skewed reports without a gate state
+            gate_state = probe.get("state")
+            if gate_state in ("Healthy", "Degraded"):
+                is_degraded = gate_state == "Degraded"
+            else:
+                is_degraded = reachable < required
+            key = (pname, rep.node)
+            with self._probe_lock:
+                if is_degraded:
+                    streak, last_advance = self._probe_failing.get(
+                        key, (0, 0.0)
+                    )
+                    # one advance per probe interval of wall time: a
+                    # burst of reconcile passes re-reading one snapshot
+                    # must not fast-forward quarantine.  The agent gate
+                    # already damped sub-threshold blips before ever
+                    # reporting Degraded, so quarantine here means the
+                    # gate-level outage persisted >= 2 more intervals.
+                    if streak == 0 or now - last_advance >= interval:
+                        streak += 1
+                        self._probe_failing[key] = (streak, now)
+                else:
+                    self._probe_failing.pop(key, None)
+                    streak = 0
+            if is_degraded:
+                degraded.append(rep.node)
+                max_streak = max(max_streak, streak)
+            state = (
+                t.PROBE_STATE_QUARANTINED
+                if streak >= PROBE_QUARANTINE_PASSES
+                else t.PROBE_STATE_DEGRADED
+                if is_degraded
+                else t.PROBE_STATE_REACHABLE
+            )
+            unreachable = probe.get("unreachable")
+            rows.append(t.NodeProbeStatus(
+                node=rep.node,
+                peers_total=peers_total,
+                peers_reachable=reachable,
+                unreachable=[
+                    str(p) for p in unreachable
+                ] if isinstance(unreachable, list) else [],
+                rtt_p50_ms=_as_float(probe.get("rttP50Ms")),
+                rtt_p99_ms=_as_float(probe.get("rttP99Ms")),
+                loss_ratio=_as_float(probe.get("lossRatio")),
+                state=state,
+            ))
+        # departed nodes must not hold a quarantine streak forever
+        with self._probe_lock:
+            for key in [
+                k for k in self._probe_failing
+                if k[0] == pname and k[1] not in seen
+            ]:
+                del self._probe_failing[key]
+        requeue_after = 0.0
+        if degraded:
+            # exponent clamped BEFORE exponentiating: a node degraded
+            # overnight pushes the streak past 1024, where 2**streak
+            # overflows float and would fail every reconcile of the
+            # policy until restart
+            requeue_after = min(
+                PROBE_REPROBE_BASE_SECONDS * (2 ** min(max_streak - 1, 8)),
+                PROBE_REPROBE_MAX_SECONDS,
+            )
+        return rows, degraded, requeue_after
+
+    def _prune_probe_state(self, policy_name: str) -> None:
+        """Deleted policy: drop its quarantine streaks and gauge series
+        (same phantom-retraction contract as POLICY_GAUGES)."""
+        with self._probe_lock:
+            for key in [
+                k for k in self._probe_failing if k[0] == policy_name
+            ]:
+                del self._probe_failing[key]
+        if self.metrics:
+            for gauge in PROBE_GAUGES:
+                self.metrics.remove_matching(gauge, {"policy": policy_name})
+
+    def _export_probe_metrics(
+        self, policy_name: str, rows: List[t.NodeProbeStatus]
+    ) -> None:
+        if not self.metrics:
+            return
+        # retract-then-set: a departed node's series must not linger as
+        # a healthy phantom between passes
+        for gauge in PROBE_GAUGES:
+            self.metrics.remove_matching(gauge, {"policy": policy_name})
+        for row in rows:
+            labels = {"policy": policy_name, "node": row.node}
+            self.metrics.set_gauge(
+                "tpunet_probe_peers_reachable", row.peers_reachable, labels
+            )
+            self.metrics.set_gauge(
+                "tpunet_probe_loss_ratio", row.loss_ratio, labels
+            )
+            for quantile, ms in (("p50", row.rtt_p50_ms),
+                                 ("p99", row.rtt_p99_ms)):
+                self.metrics.set_gauge(
+                    "tpunet_probe_rtt_seconds", ms / 1e3,
+                    {**labels, "quantile": quantile},
+                )
+
+    @staticmethod
+    def _set_condition(
+        status: t.NetworkClusterPolicyStatus, cond_type: str,
+        cond_status: str, reason: str, message: str,
+    ) -> None:
+        """Upsert a status condition, bumping lastTransitionTime only on
+        an actual status flip (metav1 condition semantics — otherwise
+        every pass would churn the CR)."""
+        import time
+
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        for cond in status.conditions:
+            if cond.type == cond_type:
+                if cond.status != cond_status:
+                    cond.last_transition_time = now
+                cond.status = cond_status
+                cond.reason = reason
+                cond.message = message
+                return
+        status.conditions.append(t.PolicyCondition(
+            type=cond_type, status=cond_status, reason=reason,
+            message=message, last_transition_time=now,
+        ))
+
     def _update_status(
         self, policy: NetworkClusterPolicy, ds: Dict[str, Any]
     ) -> Result:
@@ -567,6 +843,76 @@ class NetworkClusterPolicyReconciler:
         else:
             state = STATE_ALL_GOOD
 
+        # dataplane probe mesh: peer distribution + connectivity matrix
+        # + DataplaneDegraded/quarantine.  Entirely skipped when the
+        # policy does not probe, so non-probing reconciles stay
+        # zero-extra-request.
+        old_probe_status = am.to_dict(policy.status.probe_nodes)
+        old_conditions = am.to_dict(policy.status.conditions)
+        probe_requeue = 0.0
+        if self._probe_enabled(policy):
+            self._sync_probe_peers(policy, reports)
+            rows, degraded, probe_requeue = self._aggregate_probe(
+                policy, reports
+            )
+            policy.status.probe_nodes = rows
+            quarantined = sorted(
+                r.node for r in rows
+                if r.state == t.PROBE_STATE_QUARANTINED
+            )
+            if degraded:
+                message = (
+                    f"{len(degraded)}/{len(rows)} nodes below probe "
+                    f"quorum: " + ", ".join(
+                        n + (" (quarantined)" if n in quarantined else "")
+                        for n in sorted(degraded)
+                    )
+                )
+                self._set_condition(
+                    policy.status, t.CONDITION_DATAPLANE_DEGRADED,
+                    "True",
+                    "QuarantinedNodes" if quarantined else "BelowQuorum",
+                    message,
+                )
+            else:
+                self._set_condition(
+                    policy.status, t.CONDITION_DATAPLANE_DEGRADED,
+                    "False", "QuorumReached",
+                    f"all {len(rows)} probed nodes reach quorum",
+                )
+            self._export_probe_metrics(policy.metadata.name, rows)
+        else:
+            # probing switched off: clear the matrix + condition so the
+            # status never shows stale connectivity.  The one-time
+            # cleanup also deletes the distributed peer list — left
+            # behind, a re-enable would adopt stale membership — while
+            # steady disabled passes stay zero-request.  Transition
+            # detection keys on the CONDITION, not the matrix rows:
+            # every enabled status pass sets the condition (even before
+            # any agent completes a probe round), so a disable inside
+            # that window still cleans up.
+            was_probing = policy.status.probe_nodes or any(
+                c.type == t.CONDITION_DATAPLANE_DEGRADED
+                for c in policy.status.conditions
+            )
+            if was_probing:
+                from ..agent import report as rpt_mod
+
+                try:
+                    self.client.delete(
+                        "v1", "ConfigMap",
+                        rpt_mod.peer_configmap_name(policy.metadata.name),
+                        self.namespace,
+                    )
+                except Exception as e:   # noqa: BLE001 — already gone is fine
+                    log.debug("peer ConfigMap delete: %s", e)
+                self._prune_probe_state(policy.metadata.name)
+            policy.status.probe_nodes = []
+            policy.status.conditions = [
+                c for c in policy.status.conditions
+                if c.type != t.CONDITION_DATAPLANE_DEGRADED
+            ]
+
         if self.metrics:
             labels = {"policy": policy.metadata.name}
             values = {
@@ -584,6 +930,8 @@ class NetworkClusterPolicyReconciler:
             or policy.status.ready_nodes != ready
             or policy.status.state != state
             or policy.status.errors != errors
+            or am.to_dict(policy.status.probe_nodes) != old_probe_status
+            or am.to_dict(policy.status.conditions) != old_conditions
         )
         policy.status.targets = targets
         policy.status.ready_nodes = ready
@@ -598,6 +946,10 @@ class NetworkClusterPolicyReconciler:
                 # until the watch delivers — retry after the delivery
                 # delay, not in a hot PUT/409 loop
                 return Result(requeue=True, requeue_after=0.05)
+        if probe_requeue > 0:
+            # degraded fabric: re-probe on the quarantine backoff
+            # schedule instead of waiting a full resync period
+            return Result(requeue=True, requeue_after=probe_requeue)
         return Result()
 
     # -- entry point ----------------------------------------------------------
@@ -612,6 +964,7 @@ class NetworkClusterPolicyReconciler:
             if self.metrics:
                 for gauge in POLICY_GAUGES:
                     self.metrics.remove_gauge(gauge, {"policy": name})
+            self._prune_probe_state(name)
             return Result()
         policy = NetworkClusterPolicy.from_dict(raw)
 

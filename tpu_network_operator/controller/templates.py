@@ -114,6 +114,14 @@ spec:
             fieldRef:
               apiVersion: v1
               fieldPath: spec.nodeName
+        # probe mesh answer address fallback when no LLDP-derived DCN
+        # address exists (L2 mode) — without it the node silently
+        # advertises no probe endpoint and drops out of the peer list
+        - name: NODE_IP
+          valueFrom:
+            fieldRef:
+              apiVersion: v1
+              fieldPath: status.hostIP
         image: ghcr.io/tpunet/tpu-linkdiscovery:latest
         imagePullPolicy: IfNotPresent
         name: configurator

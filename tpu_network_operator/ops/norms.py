@@ -12,8 +12,9 @@ dispatches between two implementations of identical f32 math:
   several fusions.  The Pallas forward reads x once and writes y plus
   the per-row ``rstd`` (one f32 lane-row per activation row); the
   backward reads x/dy once and emits dx plus the full dscale row,
-  accumulated across the sequential grid in one resident VMEM block.  docs/perf.md identifies this elementwise traffic on
-  the residual stream as part of the 1B preset's 59% forward ceiling.
+  accumulated across the sequential grid in one resident VMEM block.
+  docs/perf.md identifies this elementwise traffic on the residual
+  stream as part of the 1B preset's 59% forward ceiling.
 
 On a single device :func:`rms_norm` dispatches by itself.  On a
 multi-device mesh a ``pallas_call`` is opaque to the GSPMD partitioner

@@ -241,6 +241,24 @@ def sp_flash_enabled() -> bool:
     return forced if forced is not None else jax.default_backend() == "tpu"
 
 
+def _gqa_repeat_factor(h: int, hkv: int, t: int) -> int:
+    """Smallest K/V head repeat that (a) divides the GQA group evenly and
+    (b) makes the repeated head count divisible by the ``t``-way head
+    shard.  Raises a named ValueError instead of the bare StopIteration
+    a ``next()`` would leak when no factor exists (e.g. h=8, hkv=4 on a
+    3-way head axis) — a generator-raised StopIteration inside jit
+    tracing surfaces as an inscrutable RuntimeError."""
+    groups = h // hkv
+    for f in range(1, groups + 1):
+        if groups % f == 0 and (hkv * f) % t == 0:
+            return f
+    raise ValueError(
+        f"no GQA repeat factor: h={h}, hkv={hkv} cannot be repeated to a "
+        f"multiple of head-axis size {t}; reshard the head axis to a "
+        f"divisor of hkv or disable head sharding"
+    )
+
+
 def _use_flash(sq_local, head_dim, h, hkv, mesh, head_axis) -> bool:
     """Static gate for ``impl="auto"``: :func:`sp_flash_enabled` plus
     flash-compatible local shapes and GQA groups intact per head shard."""
@@ -310,10 +328,7 @@ def ring_attention(
     # the K/V head dim must still divide the tensor shards
     t = mesh.shape.get(head_axis, 1) if head_axis else 1
     if hkv != h and hkv % max(t, 1):
-        rep = next(
-            f for f in range(1, h // hkv + 1)
-            if (h // hkv) % f == 0 and (hkv * f) % max(t, 1) == 0
-        )
+        rep = _gqa_repeat_factor(h, hkv, max(t, 1))
         k = repeat_kv(k, rep)
         v = repeat_kv(v, rep)
 

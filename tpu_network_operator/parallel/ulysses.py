@@ -37,7 +37,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from ..compat import shard_map
 
 from ..ops.attention import causal_attention, repeat_kv
 

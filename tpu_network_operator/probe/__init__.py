@@ -1,0 +1,33 @@
+"""Dataplane probe mesh: active DCN connectivity validation.
+
+Local agent success (links up, bootstrap written) proves a node can
+*configure* its fabric attachment — not that packets actually cross the
+DCN to its peers.  A miscabled or blackholed link otherwise surfaces
+only when the training job's first cross-slice collective hangs.  This
+package closes that gap with a lightweight UDP echo mesh: every agent
+answers probes on its DCN endpoint (:class:`Responder`) and periodically
+probes every peer it learns from the controller-distributed peer list
+(:class:`Prober`), measuring reachability, RTT quantiles, and loss over
+a sliding window.  A hysteresis gate (:class:`ReadinessGate`) turns the
+raw measurements into a flap-free readiness verdict that the agent uses
+to gate the NFD ``tpu-scale-out=true`` label, and the measurements ride
+the existing provisioning-report channel back to the reconciler, which
+aggregates them into the per-policy connectivity matrix on the CR
+status (cf. *Throughput-Optimized Networks at Scale*: continuous
+path-level health telemetry as first-class cluster state).
+
+Transports are pluggable: :class:`UdpTransport` for real sockets,
+:class:`FakeFabric` for deterministic in-process meshes with injected
+loss/latency/partitions (no sockets, seeded RNG) — the unit tests and
+``tools/probe_bench.py`` simulate M×N meshes on it.
+"""
+
+from .transport import FakeFabric, UdpTransport  # noqa: F401
+from .prober import (  # noqa: F401
+    PeerWindow,
+    Prober,
+    ProbeSnapshot,
+    ReadinessGate,
+    Responder,
+)
+from .runner import ProbeRunner  # noqa: F401

@@ -1,0 +1,336 @@
+"""Probe wire format, responder, per-peer sliding windows, readiness gate.
+
+One probe = one 21-byte datagram: magic (4), kind (1, request/reply),
+sequence number (8), sender send-timestamp (8).  The responder echoes the request with
+the kind flipped and the timestamp untouched, so RTT is computed purely
+from the prober's own clock — no cross-node clock sync needed.
+
+The gate turns raw per-round snapshots into a flap-free verdict:
+
+* a peer counts *unreachable* only after ``PEER_FAIL_AFTER`` consecutive
+  unanswered probes (one random drop is loss, not a partition);
+* the node's readiness flips down only after ``fail_threshold``
+  consecutive rounds below quorum, and back up only after
+  ``recovery_threshold`` consecutive healthy rounds — so a partition is
+  detected within ~3 probe intervals while a single lucky/unlucky round
+  never toggles the NFD label;
+* while degraded the gate stretches the re-probe interval (bounded
+  exponential backoff) — a quarantined node keeps validating its fabric
+  without hammering a dead link at full cadence.
+"""
+
+from __future__ import annotations
+
+import struct
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+MAGIC = b"tpnp"
+KIND_REQUEST = 0
+KIND_REPLY = 1
+_WIRE = struct.Struct("!4sBQd")    # magic, kind, seq, t_send
+
+# consecutive unanswered probes before a peer counts unreachable
+PEER_FAIL_AFTER = 2
+
+# THE defaults of the probe contract — the CRD layer
+# (api/v1alpha1/types.py), the webhook defaulter, the DaemonSet
+# projection, and the agent CLI all alias these, so the mesh cannot
+# drift into agents and controller disagreeing on a knob
+DEFAULT_PORT = 8477
+DEFAULT_INTERVAL_SECONDS = 10
+DEFAULT_WINDOW = 20
+DEFAULT_FAIL_THRESHOLD = 2
+DEFAULT_RECOVERY_THRESHOLD = 2
+DEFAULT_PROBE_TIMEOUT = 1.0
+
+
+def encode(kind: int, seq: int, t_send: float) -> bytes:
+    return _WIRE.pack(MAGIC, kind, seq, t_send)
+
+
+def decode(payload: bytes) -> Optional[Tuple[int, int, float]]:
+    """``(kind, seq, t_send)``; None for foreign/garbage datagrams (the
+    probe port is reachable by anything on the fabric)."""
+    if len(payload) != _WIRE.size:
+        return None
+    magic, kind, seq, t_send = _WIRE.unpack(payload)
+    if magic != MAGIC or kind not in (KIND_REQUEST, KIND_REPLY):
+        return None
+    return kind, seq, t_send
+
+
+class Responder:
+    """UDP echo half: answer probe requests on the node's DCN endpoint.
+
+    Over a :class:`~.transport.FakeFabric` endpoint it attaches as the
+    synchronous delivery handler; over UDP, :meth:`start` spawns the
+    recv loop thread.  Stateless beyond counters — safe to run for the
+    agent's whole keep-running life."""
+
+    def __init__(self, endpoint):
+        self.endpoint = endpoint
+        self.requests = 0
+        self._thread = None
+        self._stop = None
+
+    def handle(self, payload: bytes, src: str, at: float = 0.0) -> None:
+        decoded = decode(payload)
+        if decoded is None or decoded[0] != KIND_REQUEST:
+            return
+        _, seq, t_send = decoded
+        self.requests += 1
+        self.endpoint.send(src, encode(KIND_REPLY, seq, t_send), at=at)
+
+    def start(self) -> "Responder":
+        if hasattr(self.endpoint, "set_handler"):
+            self.endpoint.set_handler(self.handle)
+            return self
+        import threading
+
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.is_set():
+                pkt = self.endpoint.recv(timeout=0.2)
+                if pkt is not None:
+                    self.handle(*pkt)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+            self._thread.join(timeout=2)
+
+
+class PeerWindow:
+    """Sliding window of one peer's probe outcomes (RTT seconds, or None
+    for an unanswered probe).  The size is clamped to PEER_FAIL_AFTER:
+    a shorter window could never accumulate the consecutive misses that
+    mark a peer unreachable, structurally disabling detection — the
+    webhook rejects such windows on the CR path, and this clamp covers
+    direct/skewed callers."""
+
+    def __init__(self, size: int = DEFAULT_WINDOW):
+        self.outcomes: Deque[Optional[float]] = deque(
+            maxlen=max(size, PEER_FAIL_AFTER)
+        )
+
+    def record(self, rtt: Optional[float]) -> None:
+        self.outcomes.append(rtt)
+
+    @property
+    def fail_streak(self) -> int:
+        n = 0
+        for rtt in reversed(self.outcomes):
+            if rtt is not None:
+                break
+            n += 1
+        return n
+
+    @property
+    def reachable(self) -> bool:
+        """Answered recently enough: some history, and fewer than
+        PEER_FAIL_AFTER consecutive misses at the tail."""
+        return bool(self.outcomes) and self.fail_streak < PEER_FAIL_AFTER
+
+    def loss_ratio(self) -> float:
+        if not self.outcomes:
+            return 0.0
+        lost = sum(1 for r in self.outcomes if r is None)
+        return lost / len(self.outcomes)
+
+    def rtts(self) -> List[float]:
+        return [r for r in self.outcomes if r is not None]
+
+
+def required_peers(quorum: int, expected_peers: int, peers_total: int) -> int:
+    """THE quorum rule, shared by the agent's :class:`ReadinessGate` and
+    the controller's status aggregation so their verdicts cannot drift:
+    the base is the live peer count unless ``expected_peers`` pins it
+    (a silently shrunken mesh must not lower the bar); ``quorum=0``
+    demands the whole base, a positive quorum is clamped to it."""
+    base = (expected_peers if expected_peers > 0 else peers_total)
+    if quorum <= 0:
+        return base
+    return min(quorum, base)
+
+
+def quantile(sorted_values: List[float], q: float) -> float:
+    """Nearest-rank quantile on a pre-sorted list; 0.0 when empty."""
+    if not sorted_values:
+        return 0.0
+    idx = min(len(sorted_values) - 1, max(0, round(q * (len(sorted_values) - 1))))
+    return sorted_values[idx]
+
+
+@dataclass
+class ProbeSnapshot:
+    """One round's aggregated mesh view — what rides the agent report."""
+
+    peers_total: int = 0
+    peers_reachable: int = 0
+    unreachable: List[str] = field(default_factory=list)
+    rtt_p50_ms: float = 0.0
+    rtt_p99_ms: float = 0.0
+    loss_ratio: float = 0.0
+
+    def to_report(self) -> Dict:
+        """Wire form for ``ProvisioningReport.probe`` (camelCase, same
+        convention as the CRD)."""
+        return {
+            "peersTotal": self.peers_total,
+            "peersReachable": self.peers_reachable,
+            "unreachable": list(self.unreachable),
+            "rttP50Ms": round(self.rtt_p50_ms, 3),
+            "rttP99Ms": round(self.rtt_p99_ms, 3),
+            "lossRatio": round(self.loss_ratio, 4),
+        }
+
+
+class Prober:
+    """Active half: one request per peer per round, replies matched by
+    sequence number, outcomes folded into per-peer windows."""
+
+    def __init__(self, endpoint, clock, window: int = DEFAULT_WINDOW,
+                 timeout: float = DEFAULT_PROBE_TIMEOUT):
+        self.endpoint = endpoint
+        self.clock = clock
+        self.window = max(window, 1)
+        self.timeout = timeout
+        self.peers: Dict[str, str] = {}          # name -> addr
+        self.windows: Dict[str, PeerWindow] = {}
+        self._seq = 0
+
+    def set_peers(self, peers: Dict[str, str]) -> None:
+        """Adopt the controller-distributed peer list.  Windows survive
+        address-stable peers; departed peers are forgotten (a drained
+        node must not count as a blackhole forever)."""
+        self.peers = dict(peers)
+        for name in list(self.windows):
+            if name not in self.peers:
+                del self.windows[name]
+        for name in self.peers:
+            self.windows.setdefault(name, PeerWindow(self.window))
+
+    def run_round(self) -> ProbeSnapshot:
+        """Send one probe to every peer, collect replies until the round
+        deadline, record outcomes, and return the aggregate snapshot."""
+        pending: Dict[int, str] = {}
+        for name, addr in sorted(self.peers.items()):
+            self._seq += 1
+            pending[self._seq] = name
+            try:
+                self.endpoint.send(
+                    addr, encode(KIND_REQUEST, self._seq, self.clock())
+                )
+            except Exception:   # noqa: BLE001 — one bad peer address
+                # (malformed entry that slipped past distribution-time
+                # validation) counts as that peer lost; it must not
+                # abort the whole round and freeze every window
+                continue
+        deadline = self.clock() + self.timeout
+        rtts: Dict[str, float] = {}
+        while pending:
+            remaining = deadline - self.clock()
+            if remaining <= 0:
+                break
+            pkt = self.endpoint.recv(timeout=remaining)
+            if pkt is None:
+                break
+            payload, _, arrival = pkt
+            decoded = decode(payload)
+            if decoded is None or decoded[0] != KIND_REPLY:
+                continue
+            _, seq, t_send = decoded
+            name = pending.pop(seq, None)
+            if name is not None:
+                rtts[name] = max(arrival - t_send, 0.0)
+        for name in self.peers:
+            self.windows[name].record(rtts.get(name))
+        return self.snapshot()
+
+    def snapshot(self) -> ProbeSnapshot:
+        unreachable = sorted(
+            name for name, w in self.windows.items() if not w.reachable
+        )
+        all_rtts = sorted(
+            rtt for w in self.windows.values() for rtt in w.rtts()
+        )
+        losses = [w.loss_ratio() for w in self.windows.values()]
+        return ProbeSnapshot(
+            peers_total=len(self.peers),
+            peers_reachable=len(self.peers) - len(unreachable),
+            unreachable=unreachable,
+            rtt_p50_ms=quantile(all_rtts, 0.50) * 1e3,
+            rtt_p99_ms=quantile(all_rtts, 0.99) * 1e3,
+            loss_ratio=sum(losses) / len(losses) if losses else 0.0,
+        )
+
+
+class ReadinessGate:
+    """Hysteresis between raw snapshots and the label-worthy verdict.
+
+    ``quorum=0`` demands every peer (the strictest default); a nonzero
+    quorum is clamped to the quorum base so readiness cannot demand more
+    peers than exist.  The base is the live peer count — unless
+    ``expected_peers`` pins it, in which case a silently shrunken mesh
+    (wedged agents dropping out of the peer list) counts the missing
+    peers as unreachable instead of lowering the bar.  Zero peers
+    (single-node policy, no pin) passes vacuously — there is no fabric
+    to validate."""
+
+    def __init__(self, quorum: int = 0,
+                 fail_threshold: int = DEFAULT_FAIL_THRESHOLD,
+                 recovery_threshold: int = DEFAULT_RECOVERY_THRESHOLD,
+                 backoff_factor: float = 2.0, backoff_max: float = 8.0,
+                 expected_peers: int = 0):
+        self.quorum = max(quorum, 0)
+        self.expected_peers = max(expected_peers, 0)
+        self.fail_threshold = max(fail_threshold, 1)
+        self.recovery_threshold = max(recovery_threshold, 1)
+        self.backoff_factor = backoff_factor
+        self.backoff_max = backoff_max
+        self.ready = True      # provisioning already vouched for the node
+        self.fail_streak = 0
+        self.ok_streak = 0
+        self.transitions = 0
+
+    def required(self, peers_total: int) -> int:
+        return required_peers(self.quorum, self.expected_peers, peers_total)
+
+    def observe(self, snap: ProbeSnapshot) -> bool:
+        """Fold one round in; returns True when readiness flipped."""
+        if snap.peers_reachable >= self.required(snap.peers_total):
+            self.fail_streak = 0
+            self.ok_streak += 1
+        else:
+            self.ok_streak = 0
+            self.fail_streak += 1
+        before = self.ready
+        if self.ready and self.fail_streak >= self.fail_threshold:
+            self.ready = False
+        elif not self.ready and self.ok_streak >= self.recovery_threshold:
+            self.ready = True
+        if self.ready != before:
+            self.transitions += 1
+        return self.ready != before
+
+    def current_interval(self, base: float) -> float:
+        """Probe cadence: base while healthy; bounded exponential
+        backoff while degraded (the quarantine re-probe schedule).
+        The exponent is clamped BEFORE exponentiating: fail_streak
+        grows without bound during a long outage, and 2.0**1025 raises
+        OverflowError — which would kill the probe thread."""
+        if self.ready or self.fail_streak <= self.fail_threshold:
+            return base
+        exponent = min(self.fail_streak - self.fail_threshold, 16)
+        return base * min(self.backoff_factor ** exponent, self.backoff_max)
+
+    @property
+    def state(self) -> str:
+        return "Healthy" if self.ready else "Degraded"

@@ -1,0 +1,168 @@
+"""ProbeRunner: the agent-side composition of responder + prober + gate.
+
+One runner per agent: answers peer probes on the node's DCN probe port,
+probes every peer from the controller-distributed list each interval,
+and exposes the gate verdict + latest snapshot to the agent's idle
+monitor (which owns the NFD label and the report publishes).
+
+Two drive modes share all logic:
+
+* :meth:`start` — background thread at ``interval`` cadence (stretched
+  by the gate's degraded backoff), for the real agent;
+* :meth:`step` — one synchronous round, for tests and
+  ``tools/probe_bench.py`` (deterministic over a FakeFabric).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, Optional
+
+from .prober import (
+    DEFAULT_FAIL_THRESHOLD,
+    DEFAULT_INTERVAL_SECONDS,
+    DEFAULT_PROBE_TIMEOUT,
+    DEFAULT_RECOVERY_THRESHOLD,
+    DEFAULT_WINDOW,
+    Prober,
+    ProbeSnapshot,
+    ReadinessGate,
+    Responder,
+)
+
+log = logging.getLogger("tpunet.probe")
+
+DEFAULT_INTERVAL = float(DEFAULT_INTERVAL_SECONDS)
+
+# PeersSupplier: () -> {node: "host:port"} | None.  None = "could not
+# refresh" (keep the last known list — a control-plane blip must not
+# vacuously pass the gate by emptying the mesh).
+PeersSupplier = Callable[[], Optional[Dict[str, str]]]
+
+
+class ProbeRunner:
+    def __init__(
+        self,
+        transport,
+        bind_addr: str,
+        node: str,
+        peers_supplier: PeersSupplier,
+        interval: float = DEFAULT_INTERVAL,
+        window: int = DEFAULT_WINDOW,
+        quorum: int = 0,
+        expected_peers: int = 0,
+        fail_threshold: int = DEFAULT_FAIL_THRESHOLD,
+        recovery_threshold: int = DEFAULT_RECOVERY_THRESHOLD,
+        probe_timeout: float = DEFAULT_PROBE_TIMEOUT,
+    ):
+        self.node = node
+        self.interval = max(interval, 0.1)
+        self._supplier = peers_supplier
+        # two endpoints: the responder owns the well-known probe port;
+        # the prober sends from an ephemeral port so the responder's
+        # recv loop never swallows reply datagrams
+        self.responder_endpoint = transport.open(bind_addr)
+        host = bind_addr.rpartition(":")[0]
+        try:
+            self.prober_endpoint = transport.open(f"{host}:0")
+        except Exception:
+            # don't leak the already-bound responder socket: a dead
+            # bind would squat the probe port for the agent's lifetime
+            self.responder_endpoint.close()
+            raise
+        self.responder = Responder(self.responder_endpoint)
+        self.prober = Prober(
+            self.prober_endpoint, transport.clock,
+            window=window, timeout=min(probe_timeout, self.interval),
+        )
+        self.gate = ReadinessGate(
+            quorum=quorum,
+            expected_peers=expected_peers,
+            fail_threshold=fail_threshold,
+            recovery_threshold=recovery_threshold,
+        )
+        self.last_snapshot: Optional[ProbeSnapshot] = None
+        # whether the supplier has EVER returned a peer list — the gate
+        # stays un-judged until the mesh membership is actually known
+        self._peers_known = False
+        # invoked as on_transition(ready: bool) from the probing thread
+        # whenever the gate verdict flips — the agent hooks its
+        # immediate label retraction here so a detected partition does
+        # not keep advertising readiness until the next (much slower)
+        # monitor tick
+        self.on_transition: Optional[Callable[[bool], None]] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- one round (tests / bench / the thread body) --------------------------
+
+    def step(self) -> ProbeSnapshot:
+        peers = self._supplier()
+        if peers is not None:
+            self._peers_known = True
+            peers = {n: a for n, a in peers.items() if n != self.node}
+            self.prober.set_peers(peers)
+        snap = self.prober.run_round()
+        self.last_snapshot = snap
+        if not self._peers_known:
+            # never fetched a peer list (cold start before the
+            # controller distributes it, or an apiserver blip cached
+            # for a refresh window): there is nothing to judge — an
+            # expectedPeers-pinned gate would otherwise count these
+            # empty-mesh rounds as below quorum and retract the label
+            # of a perfectly healthy, freshly-started node
+            return snap
+        if self.gate.observe(snap):
+            log.warning(
+                "probe mesh %s: %d/%d peers reachable (quorum %d), "
+                "unreachable=%s",
+                self.gate.state.lower(), snap.peers_reachable,
+                snap.peers_total, self.gate.required(snap.peers_total),
+                snap.unreachable,
+            )
+            if self.on_transition is not None:
+                try:
+                    self.on_transition(self.gate.ready)
+                except Exception as e:   # noqa: BLE001 — keep probing
+                    log.warning("probe transition hook failed: %s", e)
+        return snap
+
+    # -- background mode ------------------------------------------------------
+
+    def start(self) -> "ProbeRunner":
+        self.responder.start()
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.gate.current_interval(self.interval)):
+                try:
+                    self.step()
+                except Exception as e:   # noqa: BLE001 — probing must outlive blips
+                    log.warning("probe round failed (will retry): %s", e)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        self.responder.stop()
+        self.responder_endpoint.close()
+        self.prober_endpoint.close()
+
+    # -- agent-facing verdicts ------------------------------------------------
+
+    def ready(self) -> bool:
+        return self.gate.ready
+
+    def export(self) -> Optional[Dict]:
+        """Latest snapshot in report wire form (+ gate state), or None
+        before the first round."""
+        if self.last_snapshot is None:
+            return None
+        out = self.last_snapshot.to_report()
+        out["state"] = self.gate.state
+        return out

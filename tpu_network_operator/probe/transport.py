@@ -1,0 +1,243 @@
+"""Probe transports: real UDP sockets and a deterministic fake fabric.
+
+Both present the same tiny datagram contract so the prober/responder
+logic is transport-blind:
+
+* ``transport.open(addr)`` → endpoint (``addr`` is ``"host:port"``;
+  port 0 binds ephemeral);
+* ``endpoint.send(dest_addr, payload)`` — fire-and-forget datagram;
+* ``endpoint.recv(timeout)`` → ``(payload, src_addr, arrival)`` or
+  ``None`` — ``arrival`` is a transport-clock timestamp, the RTT base;
+* ``transport.clock()`` — monotonic seconds on that transport's clock.
+
+:class:`FakeFabric` is the test/bench fabric: delivery is in-process
+(no sockets), time is a manual clock the harness advances, loss and
+latency jitter come from a seeded RNG, and partitions/link-cuts are
+injected per endpoint or per pair — so an M×N mesh with a blackholed
+node is a deterministic, sub-millisecond simulation.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+import socket
+from typing import Callable, Dict, List, Optional, Tuple
+
+Packet = Tuple[bytes, str, float]          # (payload, src_addr, arrival)
+
+
+def split_addr(addr: str) -> Tuple[str, int]:
+    """``"host:port"`` → ``(host, port)`` (IPv4/hostname only)."""
+    host, _, port_s = addr.rpartition(":")
+    return host, int(port_s)
+
+
+def valid_endpoint(addr: str) -> bool:
+    """Whether ``addr`` is a usable ``host:port``.  The peer list is
+    assembled from agent-reported strings — one malformed entry must be
+    dropped at distribution time, not crash every prober's round."""
+    if not isinstance(addr, str):
+        return False
+    host, _, port_s = addr.rpartition(":")
+    if not host:
+        return False
+    try:
+        return 0 < int(port_s) <= 65535
+    except ValueError:
+        return False
+
+
+# -- real UDP ----------------------------------------------------------------
+
+
+class UdpEndpoint:
+    """One bound UDP socket speaking the ``"host:port"`` address form."""
+
+    def __init__(self, transport: "UdpTransport", addr: str):
+        self._transport = transport
+        host, port = split_addr(addr)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((host, port))
+        bound_host, bound_port = self._sock.getsockname()[:2]
+        # ephemeral bind (port 0): report the real port back
+        self.addr = f"{host or bound_host}:{bound_port}"
+
+    def send(self, dest_addr: str, payload: bytes, at: float = 0.0) -> None:
+        try:
+            self._sock.sendto(payload, split_addr(dest_addr))
+        except OSError:
+            pass   # unreachable peer = a lost probe, not a crash
+
+    def recv(self, timeout: float) -> Optional[Packet]:
+        self._sock.settimeout(max(timeout, 1e-4))
+        try:
+            payload, src = self._sock.recvfrom(65535)
+        except (socket.timeout, OSError):
+            return None
+        return payload, f"{src[0]}:{src[1]}", self._transport.clock()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class UdpTransport:
+    """Real sockets, real clock — the production agent transport."""
+
+    def open(self, addr: str) -> UdpEndpoint:
+        return UdpEndpoint(self, addr)
+
+    def clock(self) -> float:
+        import time
+
+        return time.monotonic()
+
+
+# -- deterministic fake fabric ----------------------------------------------
+
+
+class FakeEndpoint:
+    """In-process endpoint on a :class:`FakeFabric`.
+
+    An endpoint either queues inbound packets for :meth:`recv` (the
+    prober side) or dispatches them synchronously to a handler set via
+    :meth:`set_handler` (the responder side — the fake analog of the
+    responder's recv thread, without threads)."""
+
+    def __init__(self, fabric: "FakeFabric", addr: str):
+        self._fabric = fabric
+        self.addr = addr
+        self.inbox: List[Tuple[float, int, bytes, str]] = []   # heap
+        self.handler: Optional[Callable[[bytes, str, float], None]] = None
+        self._seq = itertools.count()   # heap tiebreak: preserve FIFO
+
+    def set_handler(self, fn: Callable[[bytes, str, float], None]) -> None:
+        self.handler = fn
+
+    def send(self, dest_addr: str, payload: bytes, at: float = 0.0) -> None:
+        self._fabric.deliver(
+            self.addr, dest_addr, payload, at or self._fabric.clock()
+        )
+
+    def recv(self, timeout: float) -> Optional[Packet]:
+        """Pop the earliest queued packet, advancing the fabric clock to
+        its arrival when it lies within ``timeout`` — the simulation of
+        a blocking socket read."""
+        if not self.inbox:
+            return None
+        arrival, _, payload, src = self.inbox[0]
+        now = self._fabric.clock()
+        if arrival > now + timeout:
+            return None
+        heapq.heappop(self.inbox)
+        self._fabric.now_s = max(now, arrival)
+        return payload, src, arrival
+
+    def close(self) -> None:
+        self._fabric.endpoints.pop(self.addr, None)
+
+
+class FakeFabric:
+    """Deterministic in-process datagram fabric with fault injection.
+
+    * ``latency`` — one-way delivery delay; ``jitter`` adds a uniform
+      random extra (seeded RNG, so RTT quantiles are reproducible);
+    * :meth:`set_loss` — per-endpoint drop probability (either
+      direction);
+    * :meth:`partition` / :meth:`heal` — full blackhole of an endpoint
+      address prefix (``"10.0.0.7"`` cuts every port on that host);
+    * :meth:`cut` / :meth:`uncut` — one pairwise link;
+    * :meth:`advance` — the manual clock (nothing here sleeps).
+    """
+
+    def __init__(self, seed: int = 1234, latency: float = 0.0005,
+                 jitter: float = 0.0):
+        self.rng = random.Random(seed)
+        self.latency = latency
+        self.jitter = jitter
+        self.now_s = 0.0
+        self.endpoints: Dict[str, FakeEndpoint] = {}
+        self.loss: Dict[str, float] = {}
+        self.partitioned: set = set()
+        self.cuts: set = set()
+        self.delivered = 0
+        self.dropped = 0
+
+    def open(self, addr: str) -> FakeEndpoint:
+        ep = FakeEndpoint(self, addr)
+        self.endpoints[addr] = ep
+        return ep
+
+    def clock(self) -> float:
+        return self.now_s
+
+    def advance(self, dt: float) -> None:
+        self.now_s += dt
+
+    # -- fault injection ------------------------------------------------------
+
+    def set_loss(self, addr: str, ratio: float) -> None:
+        """Drop probability for packets to OR from ``addr`` (host or
+        host:port); 0 clears."""
+        if ratio <= 0:
+            self.loss.pop(addr, None)
+        else:
+            self.loss[addr] = min(ratio, 1.0)
+
+    def partition(self, addr: str) -> None:
+        """Blackhole ``addr`` (host or host:port): nothing in, nothing
+        out — the full-partition failure the mesh exists to detect."""
+        self.partitioned.add(addr)
+
+    def heal(self, addr: str) -> None:
+        self.partitioned.discard(addr)
+
+    def cut(self, a: str, b: str) -> None:
+        self.cuts.add(frozenset((a, b)))
+
+    def uncut(self, a: str, b: str) -> None:
+        self.cuts.discard(frozenset((a, b)))
+
+    def _hosts(self, addr: str) -> Tuple[str, str]:
+        return addr, addr.rpartition(":")[0]
+
+    def _blackholed(self, src: str, dst: str) -> bool:
+        for key in self._hosts(src) + self._hosts(dst):
+            if key in self.partitioned:
+                return True
+        for a in self._hosts(src):
+            for b in self._hosts(dst):
+                if frozenset((a, b)) in self.cuts:
+                    return True
+        return False
+
+    def _loss_ratio(self, src: str, dst: str) -> float:
+        return max(
+            (self.loss.get(k, 0.0) for k in self._hosts(src) + self._hosts(dst)),
+            default=0.0,
+        )
+
+    # -- delivery -------------------------------------------------------------
+
+    def deliver(self, src: str, dst: str, payload: bytes, at: float) -> None:
+        ep = self.endpoints.get(dst)
+        if ep is None or self._blackholed(src, dst):
+            self.dropped += 1
+            return
+        if self.rng.random() < self._loss_ratio(src, dst):
+            self.dropped += 1
+            return
+        arrival = at + self.latency
+        if self.jitter:
+            arrival += self.jitter * self.rng.random()
+        self.delivered += 1
+        if ep.handler is not None:
+            # responder path: synchronous dispatch at arrival time, so a
+            # reply sent from the handler stacks a second one-way latency
+            ep.handler(payload, src, arrival)
+        else:
+            heapq.heappush(ep.inbox, (arrival, next(ep._seq), payload, src))
