@@ -148,6 +148,63 @@ class TestMisc:
             os.unlink(f.name)
 
 
+class TestLogFstrings:
+    """G004: f-string-interpolated log calls in controller/ and agent/
+    pre-interpolate the record template away — the JSON formatter and
+    log aggregation need %-style lazy args."""
+
+    CONTROLLER = "tpu_network_operator/controller/reconciler.py"
+    AGENT = "tpu_network_operator/agent/cli.py"
+    ELSEWHERE = "tpu_network_operator/probe/runner.py"
+
+    def codes_at(self, path, src):
+        tree = ast.parse(src)
+        return {c for c, _ in (
+            (f.code, f.message)
+            for f in lint.Checker(path, tree, src).run()
+        )}
+
+    def test_fstring_log_call_flagged_in_controller(self):
+        src = 'import logging\nlog = logging.getLogger("x")\n' \
+              'def f(n):\n    log.info(f"reconciled {n}")\n'
+        assert "G004" in self.codes_at(self.CONTROLLER, src)
+
+    def test_fstring_log_call_flagged_in_agent(self):
+        src = 'import logging\nlog = logging.getLogger("x")\n' \
+              'def f(e):\n    log.warning(f"failed: {e}")\n'
+        assert "G004" in self.codes_at(self.AGENT, src)
+
+    def test_all_log_methods_covered(self):
+        for meth in ("debug", "info", "warning", "error", "exception",
+                     "critical"):
+            src = 'import logging\nlog = logging.getLogger("x")\n' \
+                  f'def f(n):\n    log.{meth}(f"x {{n}}")\n'
+            assert "G004" in self.codes_at(self.CONTROLLER, src), meth
+
+    def test_lazy_percent_args_ok(self):
+        src = 'import logging\nlog = logging.getLogger("x")\n' \
+              'def f(n):\n    log.info("reconciled %s", n)\n'
+        assert "G004" not in self.codes_at(self.CONTROLLER, src)
+
+    def test_outside_scoped_dirs_not_flagged(self):
+        src = 'import logging\nlog = logging.getLogger("x")\n' \
+              'def f(n):\n    log.info(f"round {n}")\n'
+        assert "G004" not in self.codes_at(self.ELSEWHERE, src)
+        assert "G004" not in self.codes_at("<test>", src)
+
+    def test_non_logger_attribute_call_not_flagged(self):
+        src = 'class R:\n    def info(self, m):\n        pass\n' \
+              'rec = R()\ndef f(n):\n    rec.info(f"row {n}")\n'
+        assert "G004" not in self.codes_at(self.CONTROLLER, src)
+
+    def test_fstring_elsewhere_in_call_not_flagged(self):
+        # only the TEMPLATE argument matters; f-string in later args is
+        # someone's data, not the record template
+        src = 'import logging\nlog = logging.getLogger("x")\n' \
+              'def f(n):\n    log.info("got %s", f"row {n}")\n'
+        assert "G004" not in self.codes_at(self.CONTROLLER, src)
+
+
 def test_repo_is_lint_clean():
     """The gate itself: the whole repo must stay at zero findings."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,789 @@
+"""obs/ observability layer: tracer + flight recorder, Kubernetes
+EventRecorder (dedup/aggregation/rate limit), JSON structured logs with
+trace injection, the /debug/traces endpoint, reconciler transition
+Events, and the acceptance flow — one provisioning pass on the fake
+cluster yielding ONE stitched trace (controller reconcile span + agent
+phase spans sharing a trace ID, retrievable from /debug/traces)."""
+
+import io
+import json
+import logging
+import threading
+import urllib.request
+
+import pytest
+
+from tests.test_controller import make_cluster, tpu_cr
+from tpu_network_operator.controller.health import HealthServer, Metrics
+from tpu_network_operator.controller.manager import Manager
+from tpu_network_operator.kube.fake import FakeCluster
+from tpu_network_operator.obs import (
+    TRACE_ANNOTATION,
+    EventRecorder,
+    JsonFormatter,
+    Tracer,
+)
+from tpu_network_operator.obs import trace as trace_mod
+
+NAMESPACE = "tpunet-system"
+
+
+# -- tracer -------------------------------------------------------------------
+
+
+class TestTracer:
+    def test_span_records_on_end_only(self):
+        tr = Tracer()
+        span = tr.span("op", attributes={"k": "v"})
+        assert len(tr) == 0          # half-open spans are not evidence
+        span.end()
+        (rec,) = tr.snapshot()
+        assert rec["name"] == "op"
+        assert rec["attributes"] == {"k": "v"}
+        assert rec["durationMs"] >= 0
+        assert rec["traceId"] and rec["spanId"]
+        assert rec["parentId"] == ""
+        span.end()                   # idempotent: no double record
+        assert len(tr) == 1
+
+    def test_child_inherits_trace_via_context(self):
+        tr = Tracer()
+        with tr.span("parent") as parent:
+            assert trace_mod.current_trace_id() == parent.trace_id
+            with tr.span("child") as child:
+                assert child.trace_id == parent.trace_id
+                assert child.parent_id == parent.span_id
+        assert trace_mod.current_trace_id() == ""
+        assert {s["name"] for s in tr.snapshot()} == {"parent", "child"}
+
+    def test_explicit_trace_id_adopted(self):
+        tr = Tracer()
+        with tr.span("agent.provision", trace_id="cafe1234cafe1234"):
+            pass
+        assert tr.snapshot()[0]["traceId"] == "cafe1234cafe1234"
+
+    def test_explicit_parent(self):
+        tr = Tracer()
+        root = tr.span("root")
+        child = tr.span("late-child", parent=root)
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+
+    def test_exception_marks_error(self):
+        tr = Tracer()
+        with pytest.raises(RuntimeError):
+            with tr.span("boom"):
+                raise RuntimeError("kaput")
+        (rec,) = tr.snapshot()
+        assert rec["status"] == "error"
+        assert "kaput" in rec["attributes"]["error"]
+
+    def test_ring_buffer_bounded(self):
+        tr = Tracer(capacity=8)
+        for i in range(50):
+            tr.span(f"s{i}").end()
+        snap = tr.snapshot()
+        assert len(snap) == 8
+        assert snap[-1]["name"] == "s49"   # newest kept, oldest evicted
+
+    def test_snapshot_filter_and_limit(self):
+        tr = Tracer()
+        with tr.span("a", trace_id="t1" * 8):
+            pass
+        with tr.span("b", trace_id="t2" * 8):
+            pass
+        assert [s["name"] for s in tr.snapshot(trace_id="t1" * 8)] == ["a"]
+        assert len(tr.snapshot(limit=1)) == 1
+        assert tr.trace_ids() == ["t1" * 8, "t2" * 8]
+
+    def test_ingest_dedups_by_span_id(self):
+        tr = Tracer()
+        spans = [{"name": "agent.discovery", "spanId": "aaaa",
+                  "traceId": "", "durationMs": 5.0}]
+        fresh = tr.ingest(spans, trace_id="feed" * 4, source="agent/n1")
+        assert len(fresh) == 1
+        assert fresh[0]["traceId"] == "feed" * 4
+        assert fresh[0]["attributes"]["source"] == "agent/n1"
+        # a report Lease is re-read every status pass: same span again
+        assert tr.ingest(spans, trace_id="feed" * 4) == []
+        assert len(tr) == 1
+        # garbage degrades to skipped, not raised
+        assert tr.ingest([None, "x", {}, {"spanId": ""}]) == []
+
+    def test_ingest_dedup_survives_ring_eviction(self):
+        """The dedup memory must cover the fleet's live report-span
+        population, not just the ring: agents republish the same spans
+        every monitor tick, and an evicted ID re-ingested as 'fresh'
+        would re-observe the phase histograms forever."""
+        tr = Tracer(capacity=64)   # ring far smaller than the fleet
+        fleet = [
+            [{"name": "agent.provision", "spanId": f"s{i:05d}",
+              "durationMs": 1.0}]
+            for i in range(3000)
+        ]
+        for spans in fleet:
+            tr.ingest(spans)
+        # next status pass re-reads every Lease: nothing is fresh
+        assert all(tr.ingest(spans) == [] for spans in fleet)
+
+    def test_thread_isolation(self):
+        tr = Tracer()
+        seen = {}
+
+        def worker(name):
+            with tr.span(name) as sp:
+                seen[name] = (sp.trace_id, trace_mod.current_trace_id())
+
+        threads = [threading.Thread(target=worker, args=(f"w{i}",))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        trace_ids = {v[0] for v in seen.values()}
+        assert len(trace_ids) == 4   # no cross-thread parent leakage
+        assert all(tid == cur for tid, cur in seen.values())
+
+
+# -- event recorder -----------------------------------------------------------
+
+
+def _ref(name="pol-a"):
+    return {"apiVersion": "tpunet.dev/v1alpha1",
+            "kind": "NetworkClusterPolicy", "name": name}
+
+
+class TestEventRecorder:
+    def test_identical_events_dedup_into_one_object(self):
+        fake = FakeCluster()
+        clock = [0.0]
+        rec = EventRecorder(fake, NAMESPACE, clock=lambda: clock[0])
+        for _ in range(5):
+            clock[0] += 1.0
+            rec.event(_ref(), "Warning", "DataplaneDegraded",
+                      "1/3 nodes below probe quorum: node-2")
+        evs = fake.events(involved_name="pol-a")
+        assert len(evs) == 1
+        assert evs[0]["count"] == 5
+        assert evs[0]["type"] == "Warning"
+        assert evs[0]["reason"] == "DataplaneDegraded"
+        assert evs[0]["firstTimestamp"] <= evs[0]["lastTimestamp"]
+        assert evs[0]["source"] == {"component": "tpunet-operator"}
+
+    def test_distinct_reasons_stay_distinct(self):
+        fake = FakeCluster()
+        rec = EventRecorder(fake, NAMESPACE, clock=lambda: 0.0)
+        rec.event(_ref(), "Normal", "DaemonSetCreated", "created")
+        rec.event(_ref(), "Normal", "Ready", "all good")
+        assert len(fake.events(involved_name="pol-a")) == 2
+
+    def test_similar_messages_aggregate(self):
+        """Beyond the threshold, per-message series stop: a flapping
+        node minting a fresh message per flip collapses into one
+        aggregate Event whose count keeps growing."""
+        fake = FakeCluster()
+        clock = [0.0]
+        rec = EventRecorder(fake, NAMESPACE, aggregation_threshold=3,
+                            burst=100, clock=lambda: clock[0])
+        for i in range(10):
+            clock[0] += 1.0
+            rec.event(_ref(), "Warning", "DataplaneDegraded",
+                      f"flip #{i}")
+        evs = fake.events(involved_name="pol-a")
+        # 3 distinct pre-threshold Events + ONE aggregate
+        assert len(evs) == 4
+        agg = [e for e in evs
+               if e["message"].startswith("(combined from similar events)")]
+        assert len(agg) == 1
+        assert agg[0]["count"] == 7
+
+    def test_token_bucket_rate_limits_per_object(self):
+        fake = FakeCluster()
+        metrics = Metrics()
+        clock = [0.0]
+        rec = EventRecorder(fake, NAMESPACE, metrics=metrics, burst=3,
+                            refill_seconds=300.0, clock=lambda: clock[0])
+        emitted = [
+            rec.event(_ref(), "Normal", f"R{i}", "m") is not None
+            for i in range(6)
+        ]
+        assert emitted == [True] * 3 + [False] * 3
+        # a DIFFERENT object has its own bucket
+        assert rec.event(_ref("pol-b"), "Normal", "R0", "m") is not None
+        # refill: one token per refill_seconds
+        clock[0] = 300.0
+        assert rec.event(_ref(), "Normal", "R9", "m") is not None
+        assert rec.event(_ref(), "Normal", "R10", "m") is None
+        assert metrics._counters[(
+            "tpunet_events_suppressed_total", (("reason", "R3"),)
+        )] == 1
+
+    def test_recurring_event_count_survives_prune_windows(self):
+        """A message still recurring must keep its dedup state across
+        correlator prune passes — expiring on first-seen age would
+        reset the merged Event's count every 10 minutes, destroying
+        the 'happened N times since T' evidence."""
+        fake = FakeCluster()
+        clock = [0.0]
+        rec = EventRecorder(fake, NAMESPACE, burst=100,
+                            refill_seconds=60.0, clock=lambda: clock[0])
+        for _ in range(30):          # one flap every 2min for an hour
+            clock[0] += 120.0
+            rec.event(_ref(), "Warning", "DataplaneDegraded",
+                      "1/3 nodes below probe quorum: node-2")
+        evs = fake.events(involved_name="pol-a")
+        assert len(evs) == 1
+        assert evs[0]["count"] == 30
+
+    def test_idle_token_buckets_pruned(self):
+        """Node churn must not leak bucket entries: a fully-refilled
+        bucket idle past the correlator window is dropped."""
+        fake = FakeCluster()
+        clock = [0.0]
+        rec = EventRecorder(fake, NAMESPACE, burst=2, refill_seconds=1.0,
+                            clock=lambda: clock[0])
+        rec.event(_ref("departed-node"), "Normal", "Ready", "m")
+        assert len(rec._buckets) == 1
+        # well past the window AND fully refilled -> prune on next emit
+        clock[0] = 1300.0
+        rec.event(_ref("live-node"), "Normal", "Ready", "m")
+        keys = {k[2] for k in rec._buckets}
+        assert "departed-node" not in keys
+        assert "live-node" in keys
+
+    def test_best_effort_on_broken_client(self):
+        class Dead:
+            def apply(self, *a, **kw):
+                raise ConnectionError("apiserver down")
+
+        rec = EventRecorder(Dead(), NAMESPACE)
+        assert rec.event(_ref(), "Normal", "Ready", "m") is None   # no raise
+
+    def test_involved_object_passthrough_from_wire_object(self):
+        fake = FakeCluster()
+        rec = EventRecorder(fake, NAMESPACE)
+        node = fake.create({"apiVersion": "v1", "kind": "Node",
+                            "metadata": {"name": "node-1"}})
+        rec.event(node, "Warning", "ReadinessRetracted", "m")
+        (ev,) = fake.events(involved_name="node-1")
+        assert ev["involvedObject"]["kind"] == "Node"
+        assert ev["involvedObject"]["uid"] == node["metadata"]["uid"]
+
+
+# -- JSON logs ----------------------------------------------------------------
+
+
+class TestJsonLogging:
+    def _logger(self):
+        buf = io.StringIO()
+        handler = logging.StreamHandler(buf)
+        handler.setFormatter(JsonFormatter())
+        logger = logging.getLogger("tpunet.test.obs")
+        logger.handlers = [handler]
+        logger.propagate = False
+        logger.setLevel(logging.DEBUG)
+        return logger, buf
+
+    def test_record_shape_and_lazy_args(self):
+        logger, buf = self._logger()
+        logger.info("probe mesh on :%d (quorum %s)", 8477, "all")
+        row = json.loads(buf.getvalue())
+        assert row["msg"] == "probe mesh on :8477 (quorum all)"
+        assert row["level"] == "INFO"
+        assert row["logger"] == "tpunet.test.obs"
+        assert row["ts"].endswith("Z")
+        assert "trace" not in row            # no active span
+
+    def test_trace_context_injected(self):
+        logger, buf = self._logger()
+        tr = Tracer()
+        with tr.span("controller.reconcile") as span:
+            logger.warning("drift on %s", "mesh")
+        row = json.loads(buf.getvalue())
+        assert row["trace"] == span.trace_id
+        assert row["span"] == span.span_id
+
+    def test_extra_fields_merged(self):
+        logger, buf = self._logger()
+        logger.info("m", extra={"policy": "mesh", "nodes": 3})
+        row = json.loads(buf.getvalue())
+        assert row["policy"] == "mesh" and row["nodes"] == 3
+
+    def test_exception_formatted(self):
+        logger, buf = self._logger()
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            logger.exception("failed")
+        row = json.loads(buf.getvalue())
+        assert "ValueError: boom" in row["exc"]
+
+    def test_setup_logging_validates_format(self):
+        from tpu_network_operator.obs import setup_logging
+
+        with pytest.raises(ValueError, match="unknown log format"):
+            setup_logging(logging.INFO, log_format="yaml")
+
+    def test_operator_and_agent_flags(self):
+        from tpu_network_operator.agent.cli import build_parser as agent_p
+        from tpu_network_operator.controller.main import (
+            build_parser as op_p,
+        )
+
+        assert op_p().parse_args(["--log-format", "json"]).log_format \
+            == "json"
+        args = agent_p().parse_args(
+            ["--log-format", "json", "--trace-id", "ab" * 8]
+        )
+        assert args.log_format == "json" and args.trace_id == "ab" * 8
+
+
+# -- /debug/traces + exposition satellites ------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestDebugTracesEndpoint:
+    def test_serves_flight_recorder(self):
+        tr = Tracer()
+        with tr.span("controller.reconcile", trace_id="ad" * 8,
+                     attributes={"policy": "mesh"}):
+            pass
+        with tr.span("other", trace_id="be" * 8):
+            pass
+        srv = HealthServer(port=0, tracer=tr)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            status, body = _get(f"{base}/debug/traces")
+            assert status == 200
+            data = json.loads(body)
+            assert {s["name"] for s in data["spans"]} \
+                == {"controller.reconcile", "other"}
+            assert set(data["traceIds"]) == {"ad" * 8, "be" * 8}
+            # per-trace filter
+            _, body = _get(f"{base}/debug/traces?trace={'ad' * 8}")
+            spans = json.loads(body)["spans"]
+            assert [s["name"] for s in spans] == ["controller.reconcile"]
+            assert spans[0]["attributes"]["policy"] == "mesh"
+            # limit
+            _, body = _get(f"{base}/debug/traces?limit=1")
+            assert len(json.loads(body)["spans"]) == 1
+        finally:
+            srv.stop()
+
+    def test_404_without_tracer(self):
+        srv = HealthServer(port=0)
+        srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(f"http://127.0.0.1:{srv.port}/debug/traces")
+            assert err.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_auth_gate_shared_with_metrics(self):
+        srv = HealthServer(port=0, metrics=Metrics(), tracer=Tracer(),
+                           metrics_auth=lambda tok: tok == "s3cr3t")
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(f"{base}/debug/traces")
+            assert err.value.code == 403
+            req = urllib.request.Request(
+                f"{base}/debug/traces",
+                headers={"Authorization": "Bearer s3cr3t"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 200
+        finally:
+            srv.stop()
+
+    def test_stop_joins_serve_thread(self):
+        """Satellite: stop() must join the serve thread so teardown
+        cannot leak threads that race the next test's port bind."""
+        srv = HealthServer(port=0)
+        srv.start()
+        thread = srv._thread
+        assert thread.is_alive()
+        srv.stop()
+        assert not thread.is_alive()
+        assert srv._thread is None
+
+
+class TestExpositionFormat:
+    def test_help_lines_accompany_type(self):
+        m = Metrics()
+        m.inc("tpunet_reconcile_total", {"result": "success"})
+        m.set_gauge("tpunet_workqueue_depth", 2.0)
+        m.observe("tpunet_reconcile_duration_seconds", 0.05)
+        lines = m.render().splitlines()
+        for name in ("tpunet_uptime_seconds", "tpunet_reconcile_total",
+                     "tpunet_workqueue_depth",
+                     "tpunet_reconcile_duration_seconds"):
+            type_idx = next(
+                i for i, ln in enumerate(lines)
+                if ln.startswith(f"# TYPE {name} ")
+            )
+            assert lines[type_idx - 1].startswith(f"# HELP {name} ")
+            # real help text, not an empty stub
+            assert len(lines[type_idx - 1].split(None, 3)[3]) > 10
+
+    def test_unregistered_metric_still_gets_help(self):
+        m = Metrics()
+        m.inc("my_custom_total")
+        assert "# HELP my_custom_total " in m.render()
+
+    def test_label_values_escaped(self):
+        """Satellite: backslash, quote and newline in label values must
+        be escaped or every series after them corrupts on scrape."""
+        m = Metrics()
+        m.set_gauge("tpunet_policy_all_good", 0.0, {
+            "policy": 'we"ird\\name\nline2',
+        })
+        rendered = m.render()
+        assert (
+            'policy="we\\"ird\\\\name\\nline2"' in rendered
+        )
+        # exactly one physical line for the series (newline escaped)
+        series = [ln for ln in rendered.splitlines()
+                  if ln.startswith("tpunet_policy_all_good")]
+        assert len(series) == 1
+
+    def test_histogram_le_labels_unchanged(self):
+        m = Metrics()
+        m.observe("tpunet_reconcile_duration_seconds", 0.003)
+        out = m.render()
+        assert 'le="0.005"} 1' in out
+        assert 'le="+Inf"} 1' in out
+
+    def test_phase_histogram_buckets_cover_human_timescales(self):
+        """Provisioning phases run at probe-interval timescales (probe
+        convergence >= 10s by default); on the shared 5ms-10s reconcile
+        buckets every observation would land in +Inf with zero quantile
+        resolution."""
+        m = Metrics()
+        m.observe("tpunet_provision_phase_seconds", 45.0,
+                  {"phase": "probe-convergence"})
+        out = m.render()
+        assert 'le="60.0"} 1' in out        # resolved, not just +Inf
+        assert 'le="30.0"} 0' in out
+        assert 'le="300.0"} 1' in out
+
+
+# -- reconciler transition events + trace stamping ----------------------------
+
+
+class TestReconcilerObservability:
+    def env(self):
+        fake = make_cluster()
+        metrics = Metrics()
+        tracer = Tracer()
+        events = EventRecorder(fake, NAMESPACE, metrics=metrics)
+        mgr = Manager(fake, NAMESPACE, metrics=metrics,
+                      tracer=tracer, events=events)
+        return fake, mgr, tracer, metrics
+
+    def reconcile(self, mgr, name="tpu-slice"):
+        mgr.enqueue(name)
+        mgr.drain()
+
+    def test_create_stamps_trace_and_emits_event(self):
+        fake, mgr, tracer, _ = self.env()
+        fake.create(tpu_cr().to_dict())
+        self.reconcile(mgr)
+        ds = fake.get("apps/v1", "DaemonSet", "tpu-slice", NAMESPACE)
+        stamped = ds["metadata"]["annotations"][TRACE_ANNOTATION]
+        # the POD TEMPLATE carries the stamp too (the downward API can
+        # only expose a pod's own annotations), and the template env
+        # projects it as TPUNET_TRACE_ID for the agent to adopt
+        template = ds["spec"]["template"]
+        assert template["metadata"]["annotations"][TRACE_ANNOTATION] \
+            == stamped
+        env = {e["name"]: e for e in
+               template["spec"]["containers"][0]["env"]}
+        assert env["TPUNET_TRACE_ID"]["valueFrom"]["fieldRef"][
+            "fieldPath"] == "metadata.annotations['tpunet.dev/trace-id']"
+        # the stamp IS a recorded reconcile span's trace
+        reconcile_spans = [
+            s for s in tracer.snapshot()
+            if s["name"] == "controller.reconcile"
+            and s["traceId"] == stamped
+        ]
+        assert reconcile_spans
+        assert reconcile_spans[0]["attributes"]["policy"] == "tpu-slice"
+        (ev,) = fake.events(involved_name="tpu-slice",
+                            reason="DaemonSetCreated")
+        assert ev["type"] == "Normal"
+        assert "tpu-slice" in ev["message"]
+
+    def test_drift_update_restamps_and_emits(self):
+        fake, mgr, _, _ = self.env()
+        fake.create(tpu_cr().to_dict())
+        self.reconcile(mgr)
+        first = fake.get("apps/v1", "DaemonSet", "tpu-slice", NAMESPACE)[
+            "metadata"]["annotations"][TRACE_ANNOTATION]
+        cr = fake.get("tpunet.dev/v1alpha1", "NetworkClusterPolicy",
+                      "tpu-slice")
+        cr["spec"]["tpuScaleOut"]["mtu"] = 9000
+        fake.update(cr)
+        self.reconcile(mgr)
+        ds = fake.get("apps/v1", "DaemonSet", "tpu-slice", NAMESPACE)
+        assert ds["metadata"]["annotations"][TRACE_ANNOTATION] != first
+        assert fake.events(involved_name="tpu-slice",
+                           reason="DaemonSetUpdated")
+
+    def test_steady_reconcile_does_not_restamp(self):
+        fake, mgr, _, _ = self.env()
+        fake.create(tpu_cr().to_dict())
+        self.reconcile(mgr)
+        before = fake.get("apps/v1", "DaemonSet", "tpu-slice", NAMESPACE)[
+            "metadata"]["annotations"][TRACE_ANNOTATION]
+        for _ in range(3):
+            self.reconcile(mgr)
+        after = fake.get("apps/v1", "DaemonSet", "tpu-slice", NAMESPACE)[
+            "metadata"]["annotations"][TRACE_ANNOTATION]
+        assert after == before
+
+    def test_state_transition_events(self):
+        from tests.test_controller import _agent_report
+
+        fake, mgr, _, _ = self.env()
+        fake.add_node("node-1", {"tpunet.dev/tpu": "true"})
+        fake.create(tpu_cr().to_dict())
+        self.reconcile(mgr)
+        fake.simulate_daemonset_controller()
+        self.reconcile(mgr)
+        assert fake.events(involved_name="tpu-slice",
+                           reason="Provisioning")
+        _agent_report(fake, "node-1", policy="tpu-slice")
+        self.reconcile(mgr)
+        (ready,) = fake.events(involved_name="tpu-slice", reason="Ready")
+        assert ready["type"] == "Normal"
+        # agent degrades -> Warning Degraded with the node's error
+        _agent_report(fake, "node-1", policy="tpu-slice", ok=False,
+                      error="links down")
+        self.reconcile(mgr)
+        (deg,) = fake.events(involved_name="tpu-slice", reason="Degraded")
+        assert deg["type"] == "Warning"
+        assert "links down" in deg["message"]
+        # steady degraded passes do NOT bump the event again
+        self.reconcile(mgr)
+        (deg2,) = fake.events(involved_name="tpu-slice", reason="Degraded")
+        assert deg2["count"] == 1
+
+    def test_phase_histogram_observed_once_per_span(self):
+        from tpu_network_operator.agent import report as rpt
+
+        fake, mgr, tracer, metrics = self.env()
+        fake.add_node("node-1", {"tpunet.dev/tpu": "true"})
+        fake.create(tpu_cr().to_dict())
+        self.reconcile(mgr)
+        fake.simulate_daemonset_controller()
+        fake.apply(rpt.lease_for(rpt.ProvisioningReport(
+            node="node-1", policy="tpu-slice", ok=True,
+            trace_id="fe" * 8,
+            spans=[
+                {"name": "agent.provision", "spanId": "r00t",
+                 "traceId": "fe" * 8, "durationMs": 120.0},
+                {"name": "agent.discovery", "spanId": "d15c",
+                 "traceId": "fe" * 8, "parentId": "r00t",
+                 "durationMs": 80.0},
+                # hostile inputs: non-numeric duration and a novel
+                # phase name — both skipped, neither fails the pass
+                {"name": "agent.discovery", "spanId": "badd",
+                 "traceId": "fe" * 8, "durationMs": "abc"},
+                {"name": "agent.evil-cafebabe", "spanId": "ca11",
+                 "traceId": "fe" * 8, "durationMs": 1.0},
+            ],
+        ), NAMESPACE))
+        self.reconcile(mgr)
+        self.reconcile(mgr)   # re-read: ingest must dedup
+        key = ("tpunet_provision_phase_seconds",
+               (("phase", "discovery"),))
+        assert metrics._histograms[key][-2] == 1      # observed ONCE
+        assert metrics._histograms[key][-1] == pytest.approx(0.08)
+        # only allowlisted phase names become label values: a malicious
+        # or skewed agent must not grow the registry one series per
+        # novel span name
+        phase_series = [
+            k for k in metrics._histograms
+            if k[0] == "tpunet_provision_phase_seconds"
+        ]
+        assert len(phase_series) == 2     # provision + discovery only
+        stitched = tracer.snapshot(trace_id="fe" * 8)
+        assert {"agent.provision", "agent.discovery"} \
+            <= {s["name"] for s in stitched}
+
+
+class TestProbeTransitionEvents:
+    """DataplaneDegraded / quarantine event arc (rides the probe
+    aggregation fixtures from tests/test_probe.py)."""
+
+    def env(self):
+        from tests.test_probe import TestReconcilerProbe
+
+        rig = TestReconcilerProbe()
+        fake, mgr, metrics = rig.env()
+        mgr.reconciler.tracer = Tracer()
+        mgr.reconciler.events = EventRecorder(
+            fake, NAMESPACE, metrics=metrics
+        )
+        return rig, fake, mgr
+
+    def test_dataplane_flip_and_quarantine_events(self):
+        rig, fake, mgr = self.env()
+        rig.seed(fake, mgr)
+        for i in range(3):
+            rig.report(fake, f"node-{i}")
+        rig.reconcile(fake, mgr)
+        assert fake.events(reason="DataplaneDegraded") == []
+
+        clock = [1000.0]
+        mgr.reconciler._probe_clock = lambda: clock[0]
+        rig.report(fake, "node-2", reachable=0, state="Degraded",
+                   unreachable=["node-0", "node-1"])
+        mgr.reconciler.reconcile("mesh")
+        (ev,) = fake.events(involved_name="mesh",
+                            reason="DataplaneDegraded")
+        assert ev["type"] == "Warning" and "node-2" in ev["message"]
+
+        # steady degraded passes: flip-edge detection, no re-emission
+        mgr.reconciler.reconcile("mesh")
+        (ev,) = fake.events(involved_name="mesh",
+                            reason="DataplaneDegraded")
+        assert ev["count"] == 1
+
+        # 3 interval-spaced degraded passes -> quarantine event
+        for _ in range(2):
+            clock[0] += 10.0
+            mgr.reconciler.reconcile("mesh")
+        (q,) = fake.events(involved_name="mesh", reason="NodeQuarantined")
+        assert q["type"] == "Warning" and "node-2" in q["message"]
+
+        # recovery -> DataplaneRecovered + NodeUnquarantined
+        for i in range(3):
+            rig.report(fake, f"node-{i}")
+        mgr.reconciler.reconcile("mesh")
+        assert fake.events(involved_name="mesh",
+                           reason="DataplaneRecovered")
+        assert fake.events(involved_name="mesh",
+                           reason="NodeUnquarantined")
+
+
+# -- the acceptance flow: one stitched trace ----------------------------------
+
+
+class TestStitchedTrace:
+    def test_provisioning_flow_yields_one_trace(self, tmp_path,
+                                                monkeypatch):
+        """CR -> reconcile (span + trace stamp on the DaemonSet) ->
+        agent full pass adopting the stamp (phase spans) -> report
+        Lease carries the spans -> reconciler stitches them -> ONE
+        trace behind /debug/traces."""
+        from tests.fake_ops import FakeLinkOps
+        from tests.test_agent import FakeMetadataServer
+        from tpu_network_operator.agent import cli as agent_cli
+        from tpu_network_operator.api.v1alpha1 import (
+            NetworkClusterPolicy,
+            default_policy,
+            validate_create,
+            validate_update,
+        )
+        from tpu_network_operator.kube.wire import WireApiServer
+
+        with WireApiServer() as srv:
+            fake = srv.cluster
+            fake.register_admission(
+                "tpunet.dev/v1alpha1", "NetworkClusterPolicy",
+                mutate=lambda obj: default_policy(
+                    NetworkClusterPolicy.from_dict(obj)
+                ).to_dict(),
+                validate=lambda obj, old: (
+                    validate_update(NetworkClusterPolicy.from_dict(obj))
+                    if old
+                    else validate_create(NetworkClusterPolicy.from_dict(obj))
+                ),
+            )
+            tracer = Tracer()
+            mgr = Manager(fake, NAMESPACE, metrics=Metrics(),
+                          tracer=tracer,
+                          events=EventRecorder(fake, NAMESPACE))
+            fake.add_node("node-1", {"tpunet.dev/tpu": "true"})
+            fake.create(tpu_cr(layer="L2").to_dict())
+            mgr.enqueue("tpu-slice")
+            mgr.drain()
+            ds = fake.get("apps/v1", "DaemonSet", "tpu-slice", NAMESPACE)
+            trace_id = ds["metadata"]["annotations"][TRACE_ANNOTATION]
+
+            # -- agent side: the DaemonSet pod (downward API hands the
+            # stamp over as TPUNET_TRACE_ID / --trace-id)
+            attrs = {
+                "accelerator-type": "v5litepod-16",
+                "tpu-env": (
+                    "ACCELERATOR_TYPE: 'v5litepod-16'\n"
+                    "TOPOLOGY: '4x4'\nWORKER_ID: '1'\n"
+                ),
+                "worker-network-config": json.dumps(
+                    [{"workerId": 0, "ipAddress": "10.0.0.5"},
+                     {"workerId": 1, "ipAddress": "10.0.0.6"}]
+                ),
+            }
+            ops = FakeLinkOps()
+            ops.add_fake_link("ens9", 2, "42:01:0a:00:00:05")
+            monkeypatch.setenv("NODE_NAME", "node-1")
+            monkeypatch.setenv("TPUNET_KUBE_URL", srv.url)
+            # keep the report Lease in place after the pass (the real
+            # agent retracts only at SIGTERM teardown; wait_signal=False
+            # runs straight through it)
+            monkeypatch.setattr(
+                agent_cli, "_retract_report", lambda config: None
+            )
+            with FakeMetadataServer(attrs) as meta:
+                monkeypatch.setenv("TPUNET_METADATA_URL", meta.url)
+                cfg = agent_cli.CmdConfig(
+                    backend="tpu", mode="L2", configure=True,
+                    keep_running=True, interfaces="ens9",
+                    bootstrap=str(tmp_path / "bootstrap.json"),
+                    ops=ops, nfd_root=str(tmp_path),
+                    report_namespace=NAMESPACE,
+                    policy_name="tpu-slice",
+                    trace_id=trace_id,
+                )
+                assert agent_cli.cmd_run(cfg, wait_signal=False) == 0
+
+            # -- controller side: status pass ingests the report spans
+            fake.simulate_daemonset_controller()
+            mgr.enqueue("tpu-slice")
+            mgr.drain()
+
+            stitched = tracer.snapshot(trace_id=trace_id)
+            names = {s["name"] for s in stitched}
+            assert "controller.reconcile" in names
+            assert {"agent.provision", "agent.discovery",
+                    "agent.link-up", "agent.bootstrap"} <= names
+            assert {s["traceId"] for s in stitched} == {trace_id}
+            # parent links hold: phases hang off the agent root span
+            root = next(s for s in stitched
+                        if s["name"] == "agent.provision")
+            discovery = next(s for s in stitched
+                             if s["name"] == "agent.discovery")
+            assert discovery["parentId"] == root["spanId"]
+
+            # -- and the whole trace is retrievable over HTTP
+            health = HealthServer(port=0, tracer=tracer)
+            health.start()
+            try:
+                _, body = _get(
+                    f"http://127.0.0.1:{health.port}"
+                    f"/debug/traces?trace={trace_id}"
+                )
+                served = {s["name"] for s in json.loads(body)["spans"]}
+                assert "controller.reconcile" in served
+                assert "agent.provision" in served
+            finally:
+                health.stop()

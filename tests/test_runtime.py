@@ -402,6 +402,83 @@ def test_token_review_cache_bounded():
     assert len(auth._cache) <= 8
 
 
+def test_token_review_concurrent_misses_coalesce():
+    """Concurrent cache misses for the SAME token must cost ONE backend
+    TokenReview (singleflight): the ThreadingHTTPServer dispatches each
+    scrape on its own thread, and N simultaneous first-scrapes paying N
+    reviews is exactly the stampede the cache exists to prevent."""
+    from tpu_network_operator.controller.health import CachedTokenAuthenticator
+
+    n_threads = 8
+    release = threading.Event()
+    entered = threading.Event()
+    calls = []
+    calls_lock = threading.Lock()
+
+    def slow_review(tok):
+        with calls_lock:
+            calls.append(tok)
+        entered.set()
+        release.wait(5.0)        # hold every concurrent miss in flight
+        return tok == "good"
+
+    auth = CachedTokenAuthenticator(slow_review, clock=lambda: 0.0)
+    results = [None] * n_threads
+
+    def scrape(i):
+        results[i] = auth("good")
+
+    threads = [threading.Thread(target=scrape, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    entered.wait(5.0)            # the leader is inside the review...
+    release.set()                # ...now let it (and everyone) finish
+    for t in threads:
+        t.join(timeout=5.0)
+    assert results == [True] * n_threads
+    assert calls == ["good"]     # exactly one TokenReview round-trip
+
+
+def test_token_review_leader_failure_does_not_poison_waiters():
+    """If the coalescing leader's review raises, waiters degrade to
+    their own review instead of failing closed on someone else's
+    exception."""
+    from tpu_network_operator.controller.health import CachedTokenAuthenticator
+
+    calls = []
+    barrier = threading.Barrier(2, timeout=5.0)
+
+    def review(tok):
+        calls.append(tok)
+        if len(calls) == 1:
+            barrier.wait()       # waiter is queued behind us
+            raise ConnectionError("apiserver blip")
+        return True
+
+    auth = CachedTokenAuthenticator(review, clock=lambda: 0.0)
+    results = {}
+
+    def leader():
+        try:
+            auth("good")
+        except ConnectionError:
+            results["leader"] = "raised"
+
+    def waiter():
+        barrier.wait()
+        results["waiter"] = auth("good")
+
+    t1 = threading.Thread(target=leader)
+    t2 = threading.Thread(target=waiter)
+    t1.start()
+    t2.start()
+    t1.join(timeout=5.0)
+    t2.join(timeout=5.0)
+    assert results == {"leader": "raised", "waiter": True}
+    assert calls == ["good", "good"]
+
+
 # -- entrypoint ---------------------------------------------------------------
 
 

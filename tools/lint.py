@@ -15,6 +15,11 @@ AST checker covering the highest-signal subset:
   B006  mutable default argument (list/dict/set literal)
   E711  comparison to None with ==/!=
   B011  assert on a non-empty tuple literal (always true)
+  G004  f-string-interpolated log call (`log.info(f"...")`) in
+        controller/ and agent/ — those records must stay structured
+        (%-style lazy args) so the JSON formatter and log aggregation
+        keep a stable message template; also skips interpolation cost
+        on disabled levels
 
 Zero third-party dependencies; exits 1 on any finding.  Run as
 `python tools/lint.py [paths...]` (defaults to the package, tests, tools
@@ -37,6 +42,15 @@ DEFAULT_TARGETS = [
     "bench.py",
     "__graft_entry__.py",
 ]
+
+# G004 scope: the log streams the obs/ JSON formatter structures — an
+# f-string log call pre-interpolates the template away
+STRUCTURED_LOG_DIRS = (
+    "tpu_network_operator/controller",
+    "tpu_network_operator/agent",
+)
+LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical"}
+LOGGER_NAMES = {"log", "logger", "logging"}
 
 BUILTINS = set(dir(builtins)) | {
     "__file__", "__name__", "__doc__", "__package__", "__spec__",
@@ -203,6 +217,10 @@ class Checker:
         self.source = source
         self.findings: List[Finding] = []
         self.is_init = os.path.basename(path) == "__init__.py"
+        norm = path.replace(os.sep, "/")
+        self.check_log_fstrings = any(
+            d in norm for d in STRUCTURED_LOG_DIRS
+        )
 
     def report(self, node, code, message):
         self.findings.append(
@@ -422,6 +440,22 @@ class Checker:
                 self.report(
                     node, "B011", "assert on tuple literal is always true"
                 )
+        if (
+            self.check_log_fstrings
+            and isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in LOG_METHODS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in LOGGER_NAMES
+            and node.args
+            and isinstance(node.args[0], ast.JoinedStr)
+        ):
+            self.report(
+                node, "G004",
+                f"f-string-interpolated log call "
+                f"(log.{node.func.attr}(f\"...\")); use %-style lazy "
+                f"args to keep the record template structured",
+            )
 
 
 def lint_file(path: str) -> List[Finding]:

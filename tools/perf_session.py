@@ -21,7 +21,10 @@ Phases:
    reconcile, cached vs uncached (tools/controller_bench.py — no TPU
    needed);
 9. probe-mesh bench: DCN partition detection latency + label
-   convergence at 20 nodes (tools/probe_bench.py — no TPU needed).
+   convergence at 20 nodes (tools/probe_bench.py — no TPU needed);
+10. observability bench: tracing/event overhead at p50 reconcile
+    latency (<2% budget) + Event dedup proof (tools/obs_bench.py —
+    no TPU needed).
 
 Usage: python tools/perf_session.py [--out perf_session.jsonl]
 """
@@ -136,6 +139,14 @@ def main() -> int:
         maybe_run_phase(out, "probe-bench",
                   [py, "tools/probe_bench.py", "--nodes", "20",
                    "--out", "BENCH_probe.json"], timeout=600)
+        # 10. observability: tracing overhead at p50 reconcile latency
+        # with the obs/ stack on vs off (acceptance budget < 2%) and
+        # the N-identical-flips -> one aggregated Event dedup proof
+        # (no TPU, in-process fake apiserver)
+        maybe_run_phase(out, "obs-bench",
+                  [py, "tools/obs_bench.py", "--policies", "25",
+                   "--nodes", "20", "--out", "BENCH_obs.json"],
+                  timeout=600)
     print(f"done -> {args.out}")
     return 0
 

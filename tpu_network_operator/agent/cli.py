@@ -24,6 +24,8 @@ from typing import Dict, List, Optional
 
 from .. import nfd
 from ..lldp import detect_lldp
+from ..obs import trace as obs_trace
+from ..obs.trace import timed_phases
 from ..probe import prober as probe_defaults
 from . import netlink as nl
 from . import network as net
@@ -82,6 +84,19 @@ class CmdConfig:
     # transport seam: tests/bench inject a probe.FakeFabric; None =
     # real UDP sockets
     probe_transport: Optional[object] = None
+    # tracing (obs/): the provisioning attempt's trace ID — projected by
+    # the operator (tpunet.dev/trace-id stamp → downward API →
+    # TPUNET_TRACE_ID) so the agent's phase spans join the reconcile
+    # trace; empty = mint a fresh one.  ``tracer`` is a seam for tests;
+    # None = a per-run obs.Tracer.
+    trace_id: str = field(
+        default_factory=lambda: os.environ.get("TPUNET_TRACE_ID", "")
+    )
+    tracer: Optional[object] = None
+    # node-Event recorder (obs.EventRecorder), built lazily on first
+    # emit and kept here so its dedup/rate-limit state survives across
+    # monitor ticks; a seam for tests like ``tracer``
+    events_recorder: Optional[object] = None
     # seams
     ops: nl.LinkOps = field(default_factory=nl.LinkOps)
     # host-root override for the NFD features dir; env-settable so a
@@ -168,6 +183,7 @@ def _wait_for_drain(config: CmdConfig) -> None:
 
 
 _CLIENT_CACHE: Dict[str, object] = {}
+_RECORDER_INIT_LOCK = threading.Lock()
 
 
 def _kube_client():
@@ -214,6 +230,17 @@ def _report_ctx(config: CmdConfig):
     return node, client
 
 
+def _trace_payload(config: CmdConfig):
+    """(trace_id, spans) for the report Lease: every finished span of
+    this provisioning attempt's trace, in wire form.  The reconciler
+    dedups by span ID, so republishing the same spans every monitor
+    tick is free on the controller side."""
+    if config.tracer is None or not config.trace_id:
+        return config.trace_id, None
+    spans = config.tracer.snapshot(trace_id=config.trace_id)
+    return config.trace_id, spans or None
+
+
 def _publish_report(
     config: CmdConfig,
     configs: Dict[str, net.NetworkConfiguration],
@@ -228,6 +255,7 @@ def _publish_report(
     node, client = ctx
     from . import report as rpt
 
+    trace_id, spans = _trace_payload(config)
     rep = rpt.report_from_result(
         node=node,
         policy=config.policy_name,
@@ -238,6 +266,8 @@ def _publish_report(
         coordinator=coordinator,
         probe_endpoint=_probe_endpoint(config, configs, probe_runner),
         probe_mesh=probe_runner.export() if probe_runner else None,
+        trace_id=trace_id,
+        spans=spans,
     )
     return rpt.write_report(client, config.report_namespace, rep)
 
@@ -255,6 +285,7 @@ def _publish_failure_report(
     node, client = ctx
     from . import report as rpt
 
+    trace_id, spans = _trace_payload(config)
     return rpt.write_report(
         client,
         config.report_namespace,
@@ -273,6 +304,9 @@ def _publish_failure_report(
                 if configs else ""
             ),
             probe=probe_runner.export() if probe_runner else None,
+            # the failure's phase spans are exactly the triage evidence
+            trace_id=trace_id,
+            spans=spans,
         ),
     )
 
@@ -296,6 +330,35 @@ def _retract_report(config: CmdConfig) -> None:
     from . import report as rpt
 
     rpt.delete_report(client, config.report_namespace, node)
+
+
+def _emit_node_event(
+    config: CmdConfig, event_type: str, reason: str, message: str
+) -> None:
+    """Best-effort Kubernetes Event against this Node — the cluster-
+    visible record of a label retract/restore (kubectl describe node
+    shows WHY the label flipped without grepping agent logs).  The
+    recorder lives on the config (the established seam carrier) so its
+    dedup/rate-limit state survives across monitor ticks."""
+    ctx = _report_ctx(config)
+    if ctx is None:
+        return
+    node, client = ctx
+    if config.events_recorder is None:
+        # double-checked under a lock: the probe-gate hook (probing
+        # thread) and the monitor tick can race the first emit, and two
+        # recorders would split the dedup/rate-limit state
+        with _RECORDER_INIT_LOCK:
+            if config.events_recorder is None:
+                from ..obs import EventRecorder
+
+                config.events_recorder = EventRecorder(
+                    client, config.report_namespace, source="tpunet-agent"
+                )
+    config.events_recorder.event(
+        {"apiVersion": "v1", "kind": "Node", "name": node},
+        event_type, reason, message,
+    )
 
 
 # -- dataplane probe mesh (probe/ subsystem) ---------------------------------
@@ -420,9 +483,16 @@ def _on_probe_transition(
         return
     nfd.remove_readiness_label(root=config.nfd_root)
     bad = set(monitor_state.last_bad) if monitor_state else set()
+    error = _degradation_error(sorted(bad | {PROBE_DEGRADED}))
     _publish_failure_report(
-        config, _degradation_error(sorted(bad | {PROBE_DEGRADED})),
-        probe_runner=runner, configs=configs,
+        config, error, probe_runner=runner, configs=configs,
+    )
+    # SAME message construction as the monitor tick's emit: when the
+    # tick re-detects this degradation it produces an identical Event
+    # that dedups into this one, instead of a second Warning per flip
+    _emit_node_event(
+        config, "Warning", "ReadinessRetracted",
+        error + "; readiness label retracted",
     )
 
 
@@ -573,44 +643,53 @@ def _configure_network(
 def _configure_network_inner(
     config: CmdConfig, configs: Dict[str, net.NetworkConfiguration]
 ) -> None:
+    phase = timed_phases(config.tracer)
     if config.disable_nm and configs:
         from ..nm import disable_network_manager_for_interfaces
 
         disable_network_manager_for_interfaces(list(configs))
 
-    net.interfaces_up(configs, config.ops)
-    net.interfaces_set_mtu(configs, config.ops, config.mtu)
-    net.remove_existing_ips(configs, config.ops)
+    with phase("agent.link-up", interfaces=len(configs)):
+        net.interfaces_up(configs, config.ops)
+        net.interfaces_set_mtu(configs, config.ops, config.mtu)
+        net.remove_existing_ips(configs, config.ops)
 
     if config.mode == L3 and configs:
-        found = _detect_and_apply_lldp(config, configs)
-        # kernel addressing only in configure mode with at least one peer
-        # (ref main.go:211-212 — dry-run must never add addresses/routes);
-        # a partial result is a hard failure (ref main.go:213-216): the pod
-        # exits non-zero and the DaemonSet retry is the recovery path
-        if config.configure and found:
-            configured, total = net.configure_interfaces(configs, config.ops)
-            if configured < total:
-                raise RuntimeError(
-                    f"not all interfaces were configured "
-                    f"({configured}/{total})"
+        with phase("agent.routing", interfaces=len(configs)) as routing_span:
+            found = _detect_and_apply_lldp(config, configs)
+            # kernel addressing only in configure mode with at least one
+            # peer (ref main.go:211-212 — dry-run must never add
+            # addresses/routes); a partial result is a hard failure (ref
+            # main.go:213-216): the pod exits non-zero and the DaemonSet
+            # retry is the recovery path
+            if config.configure and found:
+                configured, total = net.configure_interfaces(
+                    configs, config.ops
                 )
-            log.info("configured %d of %d interfaces", configured, total)
-        elif config.configure:
-            # zero LLDP answers means zero usable L3 paths.  Deliberate
-            # deviation from the reference, which skips configuration and
-            # still labels the node ready (main.go:211-212,240-246):
-            # here an L3 node with no data plane must not advertise
-            # readiness it cannot back (VERDICT r2 #2 / weak #3) — exit
-            # non-zero and let the DaemonSet retry
-            log.warning("configured 0 of %d interfaces", len(configs))
-            raise RuntimeError(
-                "no LLDP peers found on any interface"
-            )
-        if config.gaudinet and config.backend == "gaudi":
-            write_gaudinet(config.gaudinet, configs)
-        if config.networkd:
-            write_systemd_networkd(config.networkd, configs)
+                if routing_span is not None:
+                    routing_span.set_attribute("configured", configured)
+                if configured < total:
+                    raise RuntimeError(
+                        f"not all interfaces were configured "
+                        f"({configured}/{total})"
+                    )
+                log.info("configured %d of %d interfaces", configured, total)
+            elif config.configure:
+                # zero LLDP answers means zero usable L3 paths.
+                # Deliberate deviation from the reference, which skips
+                # configuration and still labels the node ready
+                # (main.go:211-212,240-246): here an L3 node with no
+                # data plane must not advertise readiness it cannot back
+                # (VERDICT r2 #2 / weak #3) — exit non-zero and let the
+                # DaemonSet retry
+                log.warning("configured 0 of %d interfaces", len(configs))
+                raise RuntimeError(
+                    "no LLDP peers found on any interface"
+                )
+            if config.gaudinet and config.backend == "gaudi":
+                write_gaudinet(config.gaudinet, configs)
+            if config.networkd:
+                write_systemd_networkd(config.networkd, configs)
     net.log_results(configs, config.ops, config.mode == L3)
 
 
@@ -660,6 +739,39 @@ def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
         nfd.TPU_READY_LABEL if config.backend == "tpu" else nfd.GAUDI_READY_LABEL
     )
 
+    # tracing (obs/): one root span per provisioning attempt.  The
+    # trace ID is the operator's stamp when projected (so the
+    # controller's reconcile span and these phase spans stitch into one
+    # trace), freshly minted otherwise; the finished spans ride the
+    # report Lease back to the controller.
+    if config.tracer is None:
+        config.tracer = obs_trace.Tracer(capacity=64)
+    if not config.trace_id:
+        config.trace_id = obs_trace.new_trace_id()
+    root = config.tracer.span(
+        "agent.provision",
+        trace_id=config.trace_id,
+        attributes={
+            "node": os.environ.get("NODE_NAME", "") or "local",
+            "policy": config.policy_name,
+            "backend": config.backend,
+            "mode": config.mode,
+        },
+    )
+    phase = timed_phases(config.tracer)
+    root.__enter__()
+    root_open = [True]
+
+    def _end_root(error: str = "") -> None:
+        # the root span closes when the provisioning attempt's outcome
+        # is known (before the report publish, so the Lease carries it),
+        # NOT at process exit — keep-running idles for days
+        if root_open[0]:
+            root_open[0] = False
+            if error:
+                root.set_status("error").set_attribute("error", error)
+            root.__exit__(None, None, None)
+
     try:
         metadata_client: Optional[MetadataClient] = None
         topo: Optional[tpu_topology.TpuTopology] = None
@@ -668,8 +780,9 @@ def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
             # all metadata reads happen BEFORE any link mutation so a
             # flaky metadata server cannot strand a half-configured node
             metadata_client = MetadataClient()
-            topo = _tpu_discovery(config, metadata_client)
-            worker_net_config = metadata_client.worker_network_config()
+            with phase("agent.discovery", source=config.topology_source):
+                topo = _tpu_discovery(config, metadata_client)
+                worker_net_config = metadata_client.worker_network_config()
 
         coordinator = ""
         names = _resolve_interfaces(config, metadata_client)
@@ -695,15 +808,20 @@ def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
                 # dry-run must not leave a readiness artifact behind
                 # (unlike gaudinet.json, which the reference writes even
                 # in dry-run — the bootstrap is a signal, not a dump)
-                coordinator = _tpu_emit_bootstrap(
-                    config, worker_net_config, topo, configs
-                )
+                with phase("agent.bootstrap", path=config.bootstrap):
+                    coordinator = _tpu_emit_bootstrap(
+                        config, worker_net_config, topo, configs
+                    )
         except Exception:
             # a failure after link mutation must not leave the node in a
             # half-provisioned state the next pod can't reason about
             if configs:
                 post_cleanups(config, configs)
             raise
+
+        # provisioning outcome decided: close the root span so the
+        # publishes below carry the complete trace
+        _end_root()
 
         if not config.configure:
             # dry-run: observe, then put links back (ref main.go:235-237)
@@ -722,6 +840,14 @@ def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
             probe_runner = _start_probe_runner(
                 config, configs, ready_label, monitor_state
             )
+            if probe_runner is not None:
+                # "probe convergence" phase: from mesh start to the
+                # gate's first judged verdict; the runner ends it from
+                # the probing thread (it may postdate the report
+                # publish — the monitor's republish carries it then)
+                probe_runner.attach_convergence_span(config.tracer.span(
+                    "agent.probe-convergence", parent=root,
+                ))
             try:
                 # report first, then label: the cluster-visible record
                 # of WHAT was provisioned precedes the schedulability
@@ -751,11 +877,18 @@ def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
         RuntimeError,
     ) as e:
         log.error("%s", e)
+        # close the root span as an error FIRST so the failure report
+        # below carries the trace of what was attempted
+        _end_root(str(e))
         if config.configure:
             # surface the failure in the CR: a not-ok report feeds
             # status.errors (cleanup above retracted any stale ok one)
             _publish_failure_report(config, str(e))
         return 1
+    finally:
+        # unexpected exception types propagate past the handler above;
+        # the attempt's evidence must still land in the recorder
+        _end_root("unhandled error")
 
 
 @dataclass
@@ -801,6 +934,10 @@ def _monitor_tick(
                 config, _degradation_error(bad),
                 probe_runner=probe_runner, configs=configs,
             )
+            _emit_node_event(
+                config, "Warning", "ReadinessRetracted",
+                _degradation_error(bad) + "; readiness label retracted",
+            )
         else:
             log.info("data plane recovered — restoring readiness")
             state.report_synced = _publish_report(
@@ -812,6 +949,10 @@ def _monitor_tick(
                 # re-labeling would undo the hook's retraction
                 nfd.write_readiness_label(
                     ready_label, root=config.nfd_root
+                )
+                _emit_node_event(
+                    config, "Normal", "ReadinessRestored",
+                    "data plane recovered; readiness label restored",
                 )
     elif not state.report_synced or probe_runner is not None:
         # ONE publish path for two reasons to rewrite the report body:
@@ -956,6 +1097,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--probe-recovery-threshold", type=int,
                    default=probe_defaults.DEFAULT_RECOVERY_THRESHOLD,
                    help="consecutive healthy rounds before it is restored")
+    p.add_argument("--trace-id", default="",
+                   help="trace ID for this provisioning attempt "
+                        "(default: TPUNET_TRACE_ID env — the operator's "
+                        "tpunet.dev/trace-id stamp via the downward API "
+                        "— else freshly minted)")
+    p.add_argument("--log-format", default="text",
+                   choices=["text", "json"],
+                   help="log record format; json injects trace context")
     return p
 
 
@@ -974,10 +1123,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     level = logging.DEBUG if args.verbosity >= 3 else (
         logging.INFO if args.verbosity >= 1 else logging.WARNING
     )
-    logging.basicConfig(
-        level=level,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    from ..obs import setup_logging as obs_setup_logging
+
+    obs_setup_logging(
+        level,
+        log_format=args.log_format,
         stream=sys.stderr,
+        text_format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
     # LinkOps provider seam: the subprocess-level analog of the reference's
     # fake-netlink function table (network_test.go:212-361).  A test sets
@@ -1025,6 +1177,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         probe_expected_peers=args.probe_expected_peers,
         probe_fail_threshold=args.probe_fail_threshold,
         probe_recovery_threshold=args.probe_recovery_threshold,
+        trace_id=(
+            args.trace_id or os.environ.get("TPUNET_TRACE_ID", "")
+        ),
     )
     try:
         return cmd_run(config)

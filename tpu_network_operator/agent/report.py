@@ -57,6 +57,13 @@ class ProvisioningReport:
     # reconciler folds these into the CR's connectivity matrix
     probe_endpoint: str = ""
     probe: Optional[Dict] = None
+    # tracing back-channel (obs/): the provisioning attempt's trace ID
+    # (adopted from the operator's tpunet.dev/trace-id stamp when
+    # present, else minted) and its finished phase spans in wire form —
+    # the reconciler ingests these so /debug/traces shows the
+    # controller reconcile and the agent provisioning as ONE trace
+    trace_id: str = ""
+    spans: Optional[List[Dict]] = None
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), sort_keys=True)
@@ -79,7 +86,8 @@ class ProvisioningReport:
             k: v for k, v in d.items() if k in known
         })
         for field_name in ("node", "policy", "backend", "mode",
-                           "coordinator", "error", "probe_endpoint"):
+                           "coordinator", "error", "probe_endpoint",
+                           "trace_id"):
             if not isinstance(getattr(rep, field_name), str):
                 raise ValueError(f"report field {field_name!r} not a string")
         for field_name in ("interfaces_configured", "interfaces_total"):
@@ -91,6 +99,11 @@ class ProvisioningReport:
             raise ValueError("report field 'dcn_interfaces' not a str list")
         if rep.probe is not None and not isinstance(rep.probe, dict):
             raise ValueError("report field 'probe' not an object")
+        if rep.spans is not None and (
+            not isinstance(rep.spans, list)
+            or not all(isinstance(s, dict) for s in rep.spans)
+        ):
+            raise ValueError("report field 'spans' not an object list")
         return ProvisioningReport(**{
             **asdict(rep),
             "ok": rep.ok is True,
@@ -239,6 +252,8 @@ def report_from_result(
     probe=coordinator_reachable,
     probe_endpoint: str = "",
     probe_mesh: Optional[Dict] = None,
+    trace_id: str = "",
+    spans: Optional[List[Dict]] = None,
 ) -> ProvisioningReport:
     """Assemble the report from the agent's post-pass state.
 
@@ -246,7 +261,9 @@ def report_from_result(
     answer address and latest snapshot (ProbeRunner.export()); the mesh
     verdict does NOT feed ``ok`` here — the idle monitor publishes an
     explicit failure report when the gate degrades, so the initial
-    provisioning report stays a statement about provisioning."""
+    provisioning report stays a statement about provisioning.
+    ``trace_id``/``spans`` carry the provisioning attempt's trace back
+    to the controller (obs/ stitching)."""
     import os
 
     from .network import usable_interfaces
@@ -275,4 +292,6 @@ def report_from_result(
         dcn_interfaces=usable,
         probe_endpoint=probe_endpoint,
         probe=probe_mesh,
+        trace_id=trace_id,
+        spans=spans,
     )

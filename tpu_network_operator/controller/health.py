@@ -10,14 +10,48 @@ authn/authz-protected ``--metrics-secure`` mode.
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
+import urllib.parse
 from collections import Counter
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
 log = logging.getLogger("tpunet.health")
+
+# HELP text for every metric the operator exports (scrapers warn on
+# TYPE without HELP; docs/operator-guide.md "Observability" is the
+# human-facing copy of this table).  Unknown names fall back to a
+# generated line so third-party registrations still expose HELP.
+METRIC_HELP: Dict[str, str] = {
+    "tpunet_uptime_seconds": "Seconds since the operator process started.",
+    "tpunet_reconcile_total":
+        "Reconcile passes by result (success/requeue/error).",
+    "tpunet_reconcile_duration_seconds":
+        "Wall-clock latency of one reconcile pass.",
+    "tpunet_workqueue_depth": "Keys waiting in the reconcile workqueue.",
+    "tpunet_apiserver_requests_total":
+        "Kubernetes API round-trips by verb and kind.",
+    "tpunet_cache_objects": "Objects held per informer cache store.",
+    "tpunet_policy_targets":
+        "Nodes the policy's DaemonSet wants scheduled.",
+    "tpunet_policy_ready_nodes":
+        "Nodes whose agent reported a successful provisioning pass.",
+    "tpunet_policy_all_good":
+        "1 when every target node is provisioned and ready.",
+    "tpunet_probe_rtt_seconds":
+        "Probe-mesh round-trip time quantiles per node.",
+    "tpunet_probe_loss_ratio": "Probe-mesh datagram loss ratio per node.",
+    "tpunet_probe_peers_reachable":
+        "Peers the node's prober currently reaches.",
+    "tpunet_provision_phase_seconds":
+        "Agent provisioning phase durations, stitched from report traces.",
+    "tpunet_events_emitted_total": "Kubernetes Events written, by reason.",
+    "tpunet_events_suppressed_total":
+        "Events dropped by the per-object rate limiter, by reason.",
+}
 
 
 class Metrics:
@@ -29,6 +63,20 @@ class Metrics:
     HISTOGRAM_BUCKETS = (
         0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
     )
+    # per-metric overrides: provisioning phases run at human timescales
+    # (probe convergence is >= one probe interval, 10s by default;
+    # real-node discovery/link-up can take tens of seconds) — on the
+    # default buckets they would all land in +Inf with zero quantile
+    # resolution
+    BUCKETS_BY_NAME = {
+        "tpunet_provision_phase_seconds": (
+            0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 120.0,
+            300.0,
+        ),
+    }
+
+    def buckets_for(self, name: str) -> tuple:
+        return self.BUCKETS_BY_NAME.get(name, self.HISTOGRAM_BUCKETS)
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -70,39 +118,41 @@ class Metrics:
         """Record one histogram observation (cumulative le buckets,
         prometheus exposition semantics)."""
         key = (name, _label_key(labels))
+        buckets = self.buckets_for(name)
         with self._lock:
             h = self._histograms.get(key)
             if h is None:
                 # one slot per finite bucket + the +Inf count + the sum
-                h = self._histograms[key] = [0.0] * (
-                    len(self.HISTOGRAM_BUCKETS) + 2
-                )
-            for i, le in enumerate(self.HISTOGRAM_BUCKETS):
+                h = self._histograms[key] = [0.0] * (len(buckets) + 2)
+            for i, le in enumerate(buckets):
                 if value <= le:
                     h[i] += 1
             h[-2] += 1          # +Inf / _count
             h[-1] += value      # _sum
 
     def render(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format (# HELP + # TYPE per
+        metric family — scrapers warn on TYPE without HELP)."""
         lines: List[str] = []
         with self._lock:
+            lines.append(_help_line("tpunet_uptime_seconds"))
             lines.append(
                 "# TYPE tpunet_uptime_seconds gauge\n"
                 f"tpunet_uptime_seconds {time.time() - self.start_time:.1f}"
             )
-            by_name: Dict[str, List[str]] = {}
+            # family key: (metric name, exposition kind)
+            by_name: Dict[Tuple[str, str], List[str]] = {}
             for (name, labels), val in sorted(self._counters.items()):
-                by_name.setdefault(f"# TYPE {name} counter", []).append(
+                by_name.setdefault((name, "counter"), []).append(
                     f"{name}{_fmt_labels(labels)} {val}"
                 )
             for (name, labels), val in sorted(self._gauges.items()):
-                by_name.setdefault(f"# TYPE {name} gauge", []).append(
+                by_name.setdefault((name, "gauge"), []).append(
                     f"{name}{_fmt_labels(labels)} {val}"
                 )
             for (name, labels), h in sorted(self._histograms.items()):
-                series = by_name.setdefault(f"# TYPE {name} histogram", [])
-                for le, count in zip(self.HISTOGRAM_BUCKETS, h):
+                series = by_name.setdefault((name, "histogram"), [])
+                for le, count in zip(self.buckets_for(name), h):
                     series.append(
                         f"{name}_bucket{_fmt_labels(labels + (('le', le),))}"
                         f" {count:g}"
@@ -113,8 +163,9 @@ class Metrics:
                 )
                 series.append(f"{name}_sum{_fmt_labels(labels)} {h[-1]:g}")
                 series.append(f"{name}_count{_fmt_labels(labels)} {h[-2]:g}")
-        for header, series in by_name.items():
-            lines.append(header)
+        for (name, kind), series in by_name.items():
+            lines.append(_help_line(name))
+            lines.append(f"# TYPE {name} {kind}")
             lines.extend(series)
         return "\n".join(lines) + "\n"
 
@@ -123,10 +174,31 @@ def _label_key(labels: Optional[Dict[str, str]]) -> tuple:
     return tuple(sorted((labels or {}).items()))
 
 
+def _help_line(name: str) -> str:
+    text = METRIC_HELP.get(name, f"{name} (no help registered).")
+    # HELP text is a raw line: escape per exposition format
+    text = text.replace("\\", "\\\\").replace("\n", "\\n")
+    return f"# HELP {name} {text}"
+
+
+def _escape_label_value(v) -> str:
+    r"""Exposition-format label value escaping: ``\`` -> ``\\``,
+    ``"`` -> ``\"``, newline -> ``\n``.  Label values come from the
+    cluster (policy/node names, report error strings routed into
+    labels) — an unescaped quote or newline silently corrupts every
+    series after it on the scrape."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _fmt_labels(labels: tuple) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in labels)
     return "{" + inner + "}"
 
 
@@ -144,6 +216,14 @@ class CachedTokenAuthenticator:
     ``ttl`` seconds, failures for the shorter ``failure_ttl`` (so a
     just-granted token is not locked out for a full window).  Tokens are
     keyed by SHA-256 — raw credentials never sit in the map.
+
+    Concurrent misses for the SAME token coalesce into one backend
+    review (singleflight): the first caller authenticates, the rest
+    wait on its result and re-read the cache — the ThreadingHTTPServer
+    dispatches each scrape on its own thread, and N simultaneous
+    first-scrapes must not cost N TokenReviews.  If the leader's review
+    raises, waiters fall back to their own review rather than failing
+    closed on someone else's exception.
     """
 
     def __init__(
@@ -161,29 +241,57 @@ class CachedTokenAuthenticator:
         self._clock = clock
         self._lock = threading.Lock()
         self._cache: Dict[str, Tuple[bool, float]] = {}
+        # key -> Event: a review for this token is in flight (coalescing)
+        self._inflight: Dict[str, threading.Event] = {}
 
     def __call__(self, token: str) -> bool:
         import hashlib
 
         key = hashlib.sha256(token.encode()).hexdigest()
         now = self._clock()
+        leader = False
         with self._lock:
             hit = self._cache.get(key)
             if hit is not None and hit[1] > now:
                 return hit[0]
-        ok = bool(self._authenticate(token))
-        with self._lock:
-            if key not in self._cache and len(self._cache) >= self._max_entries:
-                # drop expired entries first; if the map is still full,
-                # evict the soonest-to-expire (bounded memory under a
-                # token-spraying client)
-                for k in [k for k, (_, exp) in self._cache.items() if exp <= now]:
-                    del self._cache[k]
-                if len(self._cache) >= self._max_entries:
-                    del self._cache[min(self._cache, key=lambda k: self._cache[k][1])]
-            self._cache[key] = (
-                ok, now + (self._ttl if ok else self._failure_ttl)
-            )
+            pending = self._inflight.get(key)
+            if pending is None:
+                pending = self._inflight[key] = threading.Event()
+                leader = True
+        if not leader:
+            # another thread is already reviewing this token: wait for
+            # it, then serve its freshly-cached verdict.  The wait is
+            # bounded — a wedged leader must not hang every scrape —
+            # and a timeout (or a leader whose review raised) degrades
+            # to an own review below.
+            pending.wait(timeout=10.0)
+            now = self._clock()
+            with self._lock:
+                hit = self._cache.get(key)
+                if hit is not None and hit[1] > now:
+                    return hit[0]
+        try:
+            ok = bool(self._authenticate(token))
+            # the verdict must be IN the cache before the finally block
+            # wakes the waiters, or a preempted leader lets every waiter
+            # miss and pay its own review — the stampede again
+            with self._lock:
+                if key not in self._cache and len(self._cache) >= self._max_entries:
+                    # drop expired entries first; if the map is still full,
+                    # evict the soonest-to-expire (bounded memory under a
+                    # token-spraying client)
+                    for k in [k for k, (_, exp) in self._cache.items() if exp <= now]:
+                        del self._cache[k]
+                    if len(self._cache) >= self._max_entries:
+                        del self._cache[min(self._cache, key=lambda k: self._cache[k][1])]
+                self._cache[key] = (
+                    ok, now + (self._ttl if ok else self._failure_ttl)
+                )
+        finally:
+            if leader:
+                with self._lock:
+                    self._inflight.pop(key, None)
+                pending.set()
         return ok
 
 
@@ -201,15 +309,21 @@ class HealthServer:
         metrics: Optional[Metrics] = None,
         metrics_auth: Optional[Callable[[str], bool]] = None,
         tls_cert_dir: Optional[str] = None,
+        tracer=None,
     ):
         """``metrics=None`` means NO /metrics endpoint on this server (the
         probe port must not leak the registry the secure port protects).
         ``metrics_auth`` is a bearer-token authenticator (TokenReview in
         production).  ``tls_cert_dir`` wraps the listener in TLS using
-        ``tls.crt``/``tls.key`` — the ``--metrics-secure`` serving mode."""
+        ``tls.crt``/``tls.key`` — the ``--metrics-secure`` serving mode.
+        ``tracer`` (an :class:`..obs.Tracer`) additionally serves the
+        flight recorder as JSON from ``/debug/traces`` (same
+        authenticator gate as /metrics: span attributes carry object
+        names the probe port must not leak)."""
         self.checks: Dict[str, Callable[[], bool]] = {"ping": lambda: True}
         self.ready_checks: Dict[str, Callable[[], bool]] = {"ping": lambda: True}
         self.metrics = metrics
+        self.tracer = tracer
         self._metrics_auth = metrics_auth
 
         outer = self
@@ -228,27 +342,56 @@ class HealthServer:
                 self.end_headers()
                 self.wfile.write(payload)
 
+            def _authorized(self) -> bool:
+                if not outer._metrics_auth:
+                    return True
+                auth = self.headers.get("Authorization", "")
+                token = auth.removeprefix("Bearer ").strip()
+                return bool(token) and outer._metrics_auth(token)
+
             def do_GET(self):   # noqa: N802
-                if self.path.rstrip("/") == "/healthz":
+                parsed = urllib.parse.urlsplit(self.path)
+                path = parsed.path.rstrip("/")
+                if path == "/healthz":
                     ok = all(fn() for fn in outer.checks.values())
                     self._respond(200 if ok else 500, "ok" if ok else "unhealthy")
-                elif self.path.rstrip("/") == "/readyz":
+                elif path == "/readyz":
                     ok = all(fn() for fn in outer.ready_checks.values())
                     self._respond(200 if ok else 500, "ok" if ok else "not ready")
-                elif self.path.rstrip("/") == "/metrics":
+                elif path == "/metrics":
                     if outer.metrics is None:
                         self._respond(404, "metrics not served here")
                         return
-                    if outer._metrics_auth:
-                        auth = self.headers.get("Authorization", "")
-                        token = auth.removeprefix("Bearer ").strip()
-                        if not token or not outer._metrics_auth(token):
-                            self._respond(403, "forbidden")
-                            return
+                    if not self._authorized():
+                        self._respond(403, "forbidden")
+                        return
                     self._respond(
                         200,
                         outer.metrics.render(),
                         "text/plain; version=0.0.4",
+                    )
+                elif path == "/debug/traces":
+                    if outer.tracer is None:
+                        self._respond(404, "traces not served here")
+                        return
+                    if not self._authorized():
+                        self._respond(403, "forbidden")
+                        return
+                    q = urllib.parse.parse_qs(parsed.query)
+                    try:
+                        limit = int(q.get("limit", ["0"])[0])
+                    except ValueError:
+                        limit = 0
+                    spans = outer.tracer.snapshot(
+                        trace_id=q.get("trace", [""])[0], limit=limit,
+                    )
+                    self._respond(
+                        200,
+                        json.dumps({
+                            "spans": spans,
+                            "traceIds": outer.tracer.trace_ids(),
+                        }),
+                        "application/json",
                     )
                 else:
                     self._respond(404, "not found")
@@ -287,3 +430,10 @@ class HealthServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        # join the serve thread: test teardown (and the operator's
+        # shutdown path) must not leave a thread racing the next
+        # HealthServer's bind on the same port.  Bounded — a handler
+        # wedged in a slow check callback must not hang shutdown.
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

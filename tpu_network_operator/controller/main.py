@@ -26,6 +26,8 @@ from ..agent.report import LEASE_API
 from ..api.v1alpha1.types import API_VERSION, NetworkClusterPolicy
 from ..kube.client import ApiClient, is_openshift
 from ..kube.informer import CachedClient
+from ..obs import EventRecorder, Tracer
+from ..obs import logging as obs_logging
 from .health import DEFAULT as METRICS, CachedTokenAuthenticator, HealthServer
 from .leader import LeaderElector
 from .manager import Manager
@@ -76,6 +78,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kube-api", default="",
                    help="apiserver URL override (default: in-cluster config)")
     p.add_argument("--zap-log-level", "--v", dest="log_level", default="info")
+    p.add_argument("--log-format", default="text",
+                   choices=list(obs_logging.LOG_FORMATS),
+                   help="log record format; json injects trace context "
+                        "into every record")
+    p.add_argument("--trace-buffer", type=int, default=1024,
+                   help="flight-recorder capacity (spans) served from "
+                        "/debug/traces")
     p.add_argument("--report-cache-seconds", type=float, default=2.0,
                    help="agent-report Lease list cache window: one "
                         "namespace-wide list serves all policies' status "
@@ -90,19 +99,20 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def setup_logging(level: str) -> None:
+def setup_logging(level: str, log_format: str = "text") -> None:
     levels = {"debug": logging.DEBUG, "info": logging.INFO,
               "error": logging.ERROR}
-    logging.basicConfig(
-        level=levels.get(level, logging.INFO),
-        format="%(asctime)s\t%(levelname)s\t%(name)s\t%(message)s",
+    obs_logging.setup_logging(
+        levels.get(level, logging.INFO),
+        log_format=log_format,
         stream=sys.stderr,
+        text_format="%(asctime)s\t%(levelname)s\t%(name)s\t%(message)s",
     )
 
 
 def run(argv: Optional[List[str]] = None, client=None) -> int:
     args = build_parser().parse_args(argv)
-    setup_logging(args.log_level)
+    setup_logging(args.log_level, args.log_format)
 
     if client is None:
         if args.kube_api:
@@ -138,9 +148,18 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
     # tiny read per probing status pass — the pass-through GET is
     # cheaper at any realistic policy count
 
+    # observability: in-process tracer (flight recorder behind
+    # /debug/traces) + the Kubernetes Event recorder.  Events ride the
+    # RAW client — an Event documents a transition the cache may lag.
+    tracer = Tracer(capacity=args.trace_buffer)
+    recorder = EventRecorder(
+        client, args.namespace, source="tpunet-operator", metrics=METRICS
+    )
+
     mgr = Manager(cached, namespace=args.namespace, is_openshift=openshift,
                   metrics=METRICS,
-                  concurrent_reconciles=args.concurrent_reconciles)
+                  concurrent_reconciles=args.concurrent_reconciles,
+                  tracer=tracer, events=recorder)
     mgr.reconciler.REPORT_CACHE_SECONDS = args.report_cache_seconds
 
     servers = []
@@ -170,9 +189,13 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
                     "--metrics-secure: no serving cert in %s; metrics "
                     "served over plain HTTP", args.webhook_cert_dir,
                 )
+        # the metrics listener also serves /debug/traces (same authn
+        # gate): span attributes carry object names the unauthenticated
+        # probe port must not leak
         servers.append(HealthServer(
             port=_port_of(args.metrics_bind_address),
             metrics=METRICS, metrics_auth=auth, tls_cert_dir=tls_dir,
+            tracer=tracer,
         ))
 
     webhook_server = None

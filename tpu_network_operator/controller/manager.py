@@ -104,15 +104,17 @@ class Manager:
     def __init__(
         self, client, namespace: str, is_openshift: bool = False,
         metrics=None, resync_interval: float = 60.0,
-        concurrent_reconciles: int = 4,
+        concurrent_reconciles: int = 4, tracer=None, events=None,
     ):
         self.client = client
         self.namespace = namespace
         self.metrics = metrics
+        self.tracer = tracer
         self.resync_interval = resync_interval
         self.concurrent_reconciles = max(1, int(concurrent_reconciles))
         self.reconciler = NetworkClusterPolicyReconciler(
-            client, namespace, is_openshift, metrics=metrics
+            client, namespace, is_openshift, metrics=metrics,
+            tracer=tracer, events=events,
         )
         self._queue = WorkQueue(metrics=metrics)
         self._stop = threading.Event()
@@ -217,8 +219,25 @@ class Manager:
 
     def _reconcile_one(self, name: str) -> None:
         t0 = time.monotonic()
+        # one span per workqueue item: the root of the stitched
+        # provisioning trace (the reconciler stamps this span's trace ID
+        # onto objects it applies; agent spans join it via the report
+        # Lease).  Entered/exited manually so the no-tracer path stays
+        # allocation-free.
+        span = (
+            self.tracer.span(
+                "controller.reconcile", attributes={"policy": name}
+            )
+            if self.tracer is not None else None
+        )
         try:
+            if span is not None:
+                span.__enter__()
             result = self.reconciler.reconcile(name)
+            if span is not None:
+                span.set_attribute(
+                    "result", "requeue" if result.requeue else "success"
+                )
             with self._failures_lock:
                 self._failures.pop(name, None)
             if self.metrics:
@@ -235,10 +254,14 @@ class Manager:
                     self.enqueue(name)
         except Exception:
             log.exception("reconcile failed for %s; requeueing with backoff", name)
+            if span is not None:
+                span.set_status("error").set_attribute("result", "error")
             if self.metrics:
                 self.metrics.inc("tpunet_reconcile_total", {"result": "error"})
             self._requeue_after_failure(name)
         finally:
+            if span is not None:
+                span.__exit__(None, None, None)
             if self.metrics:
                 self.metrics.observe(
                     "tpunet_reconcile_duration_seconds",

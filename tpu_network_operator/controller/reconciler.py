@@ -25,6 +25,8 @@ from ..api.v1alpha1 import types as t
 from ..api.v1alpha1.types import NetworkClusterPolicy
 from ..kube import errors as kerr
 from ..kube.informer import LIST_PAGE_SIZE   # noqa: F401 — re-exported
+from ..obs import events as obs_events
+from ..obs.trace import TRACE_ANNOTATION, current_trace_id
 from ..probe.prober import required_peers
 from ..probe.transport import valid_endpoint
 from . import templates
@@ -58,6 +60,16 @@ POLICY_GAUGES = (
     "tpunet_policy_ready_nodes",
     "tpunet_policy_all_good",
 )
+
+# agent provisioning phases allowed into the
+# tpunet_provision_phase_seconds{phase} histogram.  An allowlist, not
+# a prefix check: span names come from the cluster (any agent, maybe
+# compromised), and each novel name would permanently allocate a new
+# series in a registry with no eviction
+PROVISION_PHASES = frozenset({
+    "provision", "discovery", "link-up", "routing", "bootstrap",
+    "probe-convergence",
+})
 
 # per-node probe mesh gauges ({policy, node[, quantile]} labels);
 # retracted with Metrics.remove_matching on every status pass (departed
@@ -294,12 +306,19 @@ class NetworkClusterPolicyReconciler:
     """ref ``NetworkClusterPolicyReconciler`` controller :50-55."""
 
     def __init__(
-        self, client, namespace: str, is_openshift: bool = False, metrics=None
+        self, client, namespace: str, is_openshift: bool = False,
+        metrics=None, tracer=None, events=None,
     ):
         self.client = client
         self.namespace = namespace
         self.is_openshift = is_openshift
         self.metrics = metrics
+        # observability seams (obs/): both optional — a reconciler
+        # without them behaves exactly as before.  ``tracer`` also
+        # stitches agent-reported provisioning spans into the flight
+        # recorder; ``events`` emits v1 Events on transitions.
+        self.tracer = tracer
+        self.events = events
         self._reports_cache: Optional[Dict[str, List[Any]]] = None
         self._reports_cached_at = 0.0
         # concurrent workers share one reconciler instance; the bucket
@@ -416,6 +435,90 @@ class NetworkClusterPolicyReconciler:
             am.to_dict(r) for r in meta.owner_references
         ]
 
+    # -- observability --------------------------------------------------------
+
+    @staticmethod
+    def _policy_ref(policy: NetworkClusterPolicy) -> Dict[str, Any]:
+        return {
+            "apiVersion": t.API_VERSION,
+            "kind": NetworkClusterPolicy.KIND,
+            "name": policy.metadata.name,
+        }
+
+    def _emit(
+        self, policy: NetworkClusterPolicy, event_type: str,
+        reason: str, message: str,
+    ) -> None:
+        """Best-effort Event against the policy (no-op without a
+        recorder; the recorder itself dedups/rate-limits)."""
+        if self.events is not None:
+            self.events.event(
+                self._policy_ref(policy), event_type, reason, message
+            )
+
+    @staticmethod
+    def _stamp_trace(obj: Dict[str, Any]) -> None:
+        """Stamp the active trace ID onto an object this reconcile is
+        about to apply — the correlation hook: the agent adopts the
+        annotation so its provisioning spans join THIS reconcile's
+        trace.  A DaemonSet is stamped on BOTH its own metadata (the
+        operator-facing record) and the pod template's (the downward
+        API can only expose a pod's OWN annotations, which come from
+        the template — templates.py projects it as TPUNET_TRACE_ID).
+        Stamped only on actual writes (create / drift update), so
+        steady-state no-op passes never dirty objects with fresh
+        IDs."""
+        trace_id = current_trace_id()
+        if not trace_id:
+            return
+        obj.setdefault("metadata", {}).setdefault(
+            "annotations", {}
+        )[TRACE_ANNOTATION] = trace_id
+        template = obj.get("spec", {}).get("template")
+        if isinstance(template, dict):
+            template.setdefault("metadata", {}).setdefault(
+                "annotations", {}
+            )[TRACE_ANNOTATION] = trace_id
+
+    def _ingest_report_traces(self, reports: List[Any]) -> None:
+        """Stitch agent-reported provisioning spans into the flight
+        recorder (dedup'd by span ID — reports are re-read every status
+        pass) and observe each NEW phase span into the
+        ``tpunet_provision_phase_seconds{phase}`` histogram."""
+        if self.tracer is None:
+            return
+        for rep in reports:
+            spans = getattr(rep, "spans", None)
+            if not spans:
+                continue
+            fresh = self.tracer.ingest(
+                spans, trace_id=getattr(rep, "trace_id", ""),
+                source=f"agent/{rep.node}",
+            )
+            if not self.metrics:
+                continue
+            for span in fresh:
+                dur = span.get("durationMs")
+                name = str(span.get("name", ""))
+                phase = name.removeprefix("agent.")
+                # span payloads come from the cluster (any agent
+                # version, maybe mangled or malicious) — a non-numeric
+                # duration must be skipped, not TypeError the whole
+                # pass, and only KNOWN phase names may become label
+                # values (unbounded cardinality = unbounded registry)
+                if (
+                    not isinstance(dur, (int, float))
+                    or isinstance(dur, bool)
+                    or not name.startswith("agent.")
+                    or phase not in PROVISION_PHASES
+                ):
+                    continue
+                self.metrics.observe(
+                    "tpunet_provision_phase_seconds",
+                    float(dur) / 1e3,
+                    {"phase": phase},
+                )
+
     def _create_daemonset(self, policy: NetworkClusterPolicy) -> Result:
         """ref ``createDaemonSet`` :243-254 + ``createGaudiScaleOutDaemonset``
         :206-241 (switch on configurationType)."""
@@ -441,6 +544,7 @@ class NetworkClusterPolicyReconciler:
 
         project(ds, policy, self.namespace)
         self._own(policy, ds)
+        self._stamp_trace(ds)
         try:
             self.client.create(ds)
         except kerr.AlreadyExistsError:
@@ -450,6 +554,11 @@ class NetworkClusterPolicyReconciler:
             # stale window cannot spin a hot create/409 loop
             return Result(requeue=True, requeue_after=0.05)
         log.info("scale-out daemonset created: %s", ds["metadata"]["name"])
+        self._emit(
+            policy, obs_events.TYPE_NORMAL, "DaemonSetCreated",
+            f"created agent DaemonSet {self.namespace}/"
+            f"{ds['metadata']['name']}",
+        )
 
         if self.is_openshift:
             self._create_openshift_collateral(policy, sa_name)
@@ -782,6 +891,90 @@ class NetworkClusterPolicyReconciler:
                     {**labels, "quantile": quantile},
                 )
 
+    def _emit_probe_transitions(
+        self,
+        policy: NetworkClusterPolicy,
+        old_conditions: List[Dict[str, Any]],
+        old_rows: List[Dict[str, Any]],
+        rows: List[t.NodeProbeStatus],
+        degraded: List[str],
+    ) -> None:
+        """Events on dataplane transitions: DataplaneDegraded condition
+        flips and per-node quarantine enter/exit.  Flip detection runs
+        against the PRE-pass status snapshots, so a steady degraded (or
+        steady healthy) pass emits nothing — the recorder's dedup is the
+        backstop, not the first line of defense."""
+        old_dp = next(
+            (
+                c.get("status") for c in old_conditions or []
+                if c.get("type") == t.CONDITION_DATAPLANE_DEGRADED
+            ),
+            None,
+        )
+        if degraded and old_dp != "True":
+            self._emit(
+                policy, obs_events.TYPE_WARNING, "DataplaneDegraded",
+                f"{len(degraded)}/{len(rows)} nodes below probe quorum: "
+                + ", ".join(sorted(degraded)),
+            )
+        elif not degraded and old_dp == "True":
+            self._emit(
+                policy, obs_events.TYPE_NORMAL, "DataplaneRecovered",
+                f"all {len(rows)} probed nodes reach quorum again",
+            )
+        old_state = {
+            r.get("node", ""): r.get("state", "")
+            for r in old_rows or []
+        }
+        for row in rows:
+            was = old_state.get(row.node, "")
+            if (
+                row.state == t.PROBE_STATE_QUARANTINED
+                and was != t.PROBE_STATE_QUARANTINED
+            ):
+                self._emit(
+                    policy, obs_events.TYPE_WARNING, "NodeQuarantined",
+                    f"node {row.node} degraded "
+                    f"{PROBE_QUARANTINE_PASSES} consecutive passes; "
+                    f"quarantined pending fabric recovery",
+                )
+            elif (
+                was == t.PROBE_STATE_QUARANTINED
+                and row.state != t.PROBE_STATE_QUARANTINED
+            ):
+                self._emit(
+                    policy, obs_events.TYPE_NORMAL, "NodeUnquarantined",
+                    f"node {row.node} reaches probe quorum again; "
+                    f"quarantine lifted",
+                )
+
+    def _emit_state_transition(
+        self, policy: NetworkClusterPolicy, old_state: str, state: str,
+        errors: List[str],
+    ) -> None:
+        """Events on the policy's headline state machine flips."""
+        if state == old_state:
+            return
+        if state == STATE_ALL_GOOD:
+            self._emit(
+                policy, obs_events.TYPE_NORMAL, "Ready",
+                f"all {policy.status.targets} target nodes provisioned",
+            )
+        elif state == STATE_WORKING:
+            detail = ("; ".join(errors[:3])) or "waiting on agent reports"
+            self._emit(
+                policy,
+                obs_events.TYPE_WARNING if old_state == STATE_ALL_GOOD
+                else obs_events.TYPE_NORMAL,
+                "Degraded" if old_state == STATE_ALL_GOOD else "Provisioning",
+                detail,
+            )
+        elif state == STATE_NO_TARGETS:
+            self._emit(
+                policy, obs_events.TYPE_NORMAL, "NoTargets",
+                "no nodes match the policy's nodeSelector",
+            )
+
     @staticmethod
     def _set_condition(
         status: t.NetworkClusterPolicyStatus, cond_type: str,
@@ -828,6 +1021,9 @@ class NetworkClusterPolicyReconciler:
         target_nodes = self._target_nodes(ds)
         if target_nodes:
             reports = [r for r in reports if r.node in target_nodes]
+        # stitch agent provisioning spans into the flight recorder so
+        # /debug/traces shows one trace per provisioning flow
+        self._ingest_report_traces(reports)
         ok_nodes = sorted(r.node for r in reports if r.ok)
         errors = sorted(
             f"{r.node}: {r.error or 'provisioning incomplete'}"
@@ -842,6 +1038,7 @@ class NetworkClusterPolicyReconciler:
             state = STATE_WORKING
         else:
             state = STATE_ALL_GOOD
+        old_state = policy.status.state
 
         # dataplane probe mesh: peer distribution + connectivity matrix
         # + DataplaneDegraded/quarantine.  Entirely skipped when the
@@ -881,6 +1078,9 @@ class NetworkClusterPolicyReconciler:
                     f"all {len(rows)} probed nodes reach quorum",
                 )
             self._export_probe_metrics(policy.metadata.name, rows)
+            self._emit_probe_transitions(
+                policy, old_conditions, old_probe_status, rows, degraded
+            )
         else:
             # probing switched off: clear the matrix + condition so the
             # status never shows stale connectivity.  The one-time
@@ -937,6 +1137,7 @@ class NetworkClusterPolicyReconciler:
         policy.status.ready_nodes = ready
         policy.status.errors = errors
         policy.status.state = state
+        self._emit_state_transition(policy, old_state, state, errors)
 
         if updated:
             try:
@@ -982,6 +1183,10 @@ class NetworkClusterPolicyReconciler:
         self._update_daemonset(ds, policy)
         if ds["spec"]["template"]["spec"] != original_spec:
             log.info("DS template drift; updating %s", ds["metadata"]["name"])
+            # re-stamp: the drift update starts a new provisioning
+            # attempt (pods roll), so the object carries the reconcile
+            # trace that caused it
+            self._stamp_trace(ds)
             try:
                 self.client.update(ds)
             except kerr.ConflictError:
@@ -989,5 +1194,10 @@ class NetworkClusterPolicyReconciler:
                 # racing update) — a normal self-healing race, not an
                 # error; retry once the cache has the successor
                 return Result(requeue=True, requeue_after=0.05)
+            self._emit(
+                policy, obs_events.TYPE_NORMAL, "DaemonSetUpdated",
+                f"re-projected agent DaemonSet {self.namespace}/"
+                f"{ds['metadata']['name']} after template drift",
+            )
 
         return self._update_status(policy, ds)

@@ -41,6 +41,10 @@ spec:
     metadata:
       labels:
         app: tpunet-network-tools
+      # provisioning trace hand-off (obs/, same contract as the tpu
+      # template): reconciler-stamped, empty default for standalone use
+      annotations:
+        tpunet.dev/trace-id: ""
     spec:
       hostNetwork: true
       volumes:
@@ -55,6 +59,14 @@ spec:
             fieldRef:
               apiVersion: v1
               fieldPath: spec.nodeName
+        # the reconciler's trace stamp, via the pod's own annotation —
+        # the agent adopts it so its provisioning spans join the
+        # operator's reconcile trace
+        - name: TPUNET_TRACE_ID
+          valueFrom:
+            fieldRef:
+              apiVersion: v1
+              fieldPath: metadata.annotations['tpunet.dev/trace-id']
         image: ghcr.io/tpunet/network-linkdiscovery:latest
         imagePullPolicy: IfNotPresent
         name: configurator
@@ -100,6 +112,12 @@ spec:
     metadata:
       labels:
         app: tpunet-tpu-network-tools
+      # provisioning trace hand-off (obs/): the reconciler overwrites
+      # this with its reconcile span's trace ID on create/drift; the
+      # empty default keeps the downward-API env below resolvable when
+      # the manifest is applied standalone
+      annotations:
+        tpunet.dev/trace-id: ""
     spec:
       hostNetwork: true
       volumes:
@@ -122,6 +140,14 @@ spec:
             fieldRef:
               apiVersion: v1
               fieldPath: status.hostIP
+        # the reconciler's trace stamp, via the pod's own annotation —
+        # the agent adopts it so its provisioning spans join the
+        # operator's reconcile trace
+        - name: TPUNET_TRACE_ID
+          valueFrom:
+            fieldRef:
+              apiVersion: v1
+              fieldPath: metadata.annotations['tpunet.dev/trace-id']
         image: ghcr.io/tpunet/tpu-linkdiscovery:latest
         imagePullPolicy: IfNotPresent
         name: configurator

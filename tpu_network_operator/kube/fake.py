@@ -505,6 +505,29 @@ class FakeCluster:
 
     # -- test conveniences ----------------------------------------------------
 
+    def events(
+        self,
+        involved_name: Optional[str] = None,
+        reason: Optional[str] = None,
+        namespace: Optional[str] = None,
+    ) -> List[Dict[str, Any]]:
+        """The stored v1 Events (obs.EventRecorder output), optionally
+        filtered by involved-object name and/or reason, sorted by
+        lastTimestamp then name — the assertion surface for transition
+        tests (envtest uses a plain typed client for the same thing)."""
+        out = []
+        for ev in self.list("v1", "Event", namespace=namespace):
+            inv = ev.get("involvedObject", {}) or {}
+            if involved_name is not None and inv.get("name") != involved_name:
+                continue
+            if reason is not None and ev.get("reason") != reason:
+                continue
+            out.append(ev)
+        out.sort(key=lambda e: (
+            e.get("lastTimestamp", ""), e.get("metadata", {}).get("name", "")
+        ))
+        return out
+
     def dump(self, pattern: str = "*") -> List[str]:
         """Sorted 'kind/namespace/name' listing for assertions."""
         with self._lock:

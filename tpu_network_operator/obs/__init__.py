@@ -1,0 +1,43 @@
+"""End-to-end observability: tracing, Kubernetes Events, structured logs.
+
+The reference operator exposes only healthz/readyz and registers no
+custom metrics (SURVEY.md §5.5).  PR 1-2 added a metrics registry and
+probe gauges; this package adds the remaining three introspection
+surfaces a production control plane needs (ROADMAP north star: heavy
+traffic at fleet scale):
+
+* :mod:`.trace` — a lightweight in-process tracer: trace/span IDs,
+  parent links, attributes, durations, and a bounded ring-buffer
+  "flight recorder" the HealthServer serves as JSON from
+  ``/debug/traces``.  Controller reconciles and agent provisioning
+  attempts share trace IDs (stamped onto applied objects, carried back
+  in the report Lease) so one provisioning flow reads as ONE trace.
+* :mod:`.events` — a client-go EventBroadcaster analog: v1 Events with
+  correlator-style dedup/aggregation and token-bucket rate limiting,
+  written against :class:`..kube.client.ApiClient` /
+  :class:`..kube.fake.FakeCluster`.
+* :mod:`.logging` — an opt-in JSON log formatter (``--log-format=json``)
+  that injects the active trace context into every record, so the two
+  unstructured log streams become one correlatable event stream.
+"""
+
+from .events import EventRecorder
+from .logging import JsonFormatter, setup_logging
+from .trace import (
+    TRACE_ANNOTATION,
+    Span,
+    Tracer,
+    current_span,
+    current_trace_id,
+)
+
+__all__ = [
+    "EventRecorder",
+    "JsonFormatter",
+    "setup_logging",
+    "Span",
+    "Tracer",
+    "TRACE_ANNOTATION",
+    "current_span",
+    "current_trace_id",
+]

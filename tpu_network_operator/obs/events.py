@@ -1,0 +1,249 @@
+"""Kubernetes EventRecorder analog (client-go EventBroadcaster-style).
+
+The reference operator emits no Events at all; an operator triaging a
+label flip has to grep two log streams.  This recorder writes real
+``v1`` Events against :class:`..kube.client.ApiClient` /
+:class:`..kube.fake.FakeCluster` with the two behaviors that make
+Events safe at fleet scale (client-go's EventCorrelator, ref
+``client-go/tools/record``):
+
+* **dedup/aggregation** — an identical (object, type, reason, message)
+  re-emitted N times becomes ONE Event with ``count=N`` and a bumped
+  ``lastTimestamp``; many *similar* events (same reason, distinct
+  messages — e.g. a flapping node producing a new message per flip)
+  collapse into an aggregate Event once they exceed
+  ``aggregation_threshold`` within the correlator window;
+* **token-bucket rate limiting** — per involved object: ``burst``
+  events immediately, then one per ``refill_seconds``.  A hot reconcile
+  loop can never turn the apiserver into an Event firehose; suppressed
+  events count into ``tpunet_events_suppressed_total``.
+
+Event names are deterministic hashes of the dedup key so the write path
+is a server-side apply (create-or-merge), never a read-modify-write.
+Emission is best-effort: an Event that fails to write must never fail
+the reconcile that produced it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+log = logging.getLogger("tpunet.obs.events")
+
+TYPE_NORMAL = "Normal"
+TYPE_WARNING = "Warning"
+
+# client-go EventSourceObjectSpamFilter defaults: 25 burst, refill one
+# token per 5 minutes, per involved object
+DEFAULT_BURST = 25
+DEFAULT_REFILL_SECONDS = 300.0
+# similar-event aggregation: distinct messages for one (object, type,
+# reason) beyond this collapse into a single aggregate Event
+DEFAULT_AGGREGATION_THRESHOLD = 10
+# correlator state is pruned past this age (client-go's 10min window)
+CORRELATOR_WINDOW_SECONDS = 600.0
+
+
+def _rfc3339(epoch: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(epoch))
+
+
+def object_ref(obj: Dict[str, Any]) -> Dict[str, Any]:
+    """v1 ObjectReference from a wire-form object dict."""
+    meta = obj.get("metadata", {}) or {}
+    ref = {
+        "apiVersion": obj.get("apiVersion", ""),
+        "kind": obj.get("kind", ""),
+        "name": meta.get("name", ""),
+    }
+    if meta.get("namespace"):
+        ref["namespace"] = meta["namespace"]
+    if meta.get("uid"):
+        ref["uid"] = meta["uid"]
+    return ref
+
+
+class EventRecorder:
+    """Dedup + aggregation + rate limiting in front of Event writes.
+
+    ``clock`` is injectable (monotonic-style) for tests/bench; wall
+    timestamps on the emitted Events always come from ``time.time`` so
+    they stay meaningful to kubectl."""
+
+    def __init__(
+        self,
+        client,
+        namespace: str,
+        source: str = "tpunet-operator",
+        metrics=None,
+        burst: int = DEFAULT_BURST,
+        refill_seconds: float = DEFAULT_REFILL_SECONDS,
+        aggregation_threshold: int = DEFAULT_AGGREGATION_THRESHOLD,
+        clock=time.monotonic,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.source = source
+        self.metrics = metrics
+        self.burst = max(1, int(burst))
+        self.refill_seconds = float(refill_seconds)
+        self.aggregation_threshold = max(2, int(aggregation_threshold))
+        self._clock = clock
+        self._lock = threading.Lock()
+        # dedup key -> (count, first_wall_ts); key includes the message
+        self._counts: Dict[Tuple, Tuple[int, float]] = {}
+        # aggregation key (no message) -> {message: first_seen_clock}
+        self._similar: Dict[Tuple, Dict[str, float]] = {}
+        # per-object token bucket: ref key -> (tokens, last_refill_clock)
+        self._buckets: Dict[Tuple, Tuple[float, float]] = {}
+        self._last_prune = clock()
+
+    # -- the one public entry point -------------------------------------------
+
+    def event(
+        self,
+        involved: Dict[str, Any],
+        event_type: str,
+        reason: str,
+        message: str,
+    ) -> Optional[Dict[str, Any]]:
+        """Record one event against ``involved`` (a wire-form object
+        dict or a ready-made ObjectReference).  Returns the Event dict
+        that was written (None when rate-limited or the write failed)."""
+        ref = (
+            involved
+            if "metadata" not in involved
+            else object_ref(involved)
+        )
+        now = self._clock()
+        wall = time.time()
+        ref_key = (ref.get("kind", ""), ref.get("namespace", ""),
+                   ref.get("name", ""))
+        agg_key = ref_key + (event_type, reason)
+        with self._lock:
+            self._prune(now)
+            if not self._take_token(ref_key, now):
+                if self.metrics:
+                    self.metrics.inc(
+                        "tpunet_events_suppressed_total", {"reason": reason}
+                    )
+                return None
+            key_message, message = self._aggregate(agg_key, message, now)
+            key = agg_key + (key_message,)
+            count, first_wall = self._counts.get(key, (0, wall))
+            count += 1
+            self._counts[key] = (count, first_wall)
+        ev = self._build(ref, event_type, reason, message, count,
+                         first_wall, wall, key)
+        try:
+            self.client.apply(ev, field_manager="tpunet-events")
+        except Exception as e:   # noqa: BLE001 — events are best-effort
+            log.debug("event write failed (%s/%s): %s", reason, message, e)
+            return None
+        if self.metrics:
+            self.metrics.inc(
+                "tpunet_events_emitted_total", {"reason": reason}
+            )
+        return ev
+
+    # -- correlator internals --------------------------------------------------
+
+    def _aggregate(
+        self, agg_key: Tuple, message: str, now: float
+    ) -> Tuple[str, str]:
+        """client-go EventAggregator: once an (object, type, reason)
+        produces more than ``aggregation_threshold`` DISTINCT messages
+        inside the window, stop storing per-message series and fold
+        everything further into ONE aggregate Event.  Returns
+        ``(key_message, display_message)`` — the dedup key for the
+        aggregate is a STABLE marker (so every further variant bumps the
+        same Event's count) while the displayed message tracks the
+        latest variant, exactly what kubectl shows for combined
+        events."""
+        msgs = self._similar.setdefault(agg_key, {})
+        if message not in msgs and len(msgs) >= self.aggregation_threshold:
+            # refresh the aggregate's liveness marker: a hot aggregate
+            # must not have its count wiped because the ORIGINAL
+            # messages aged past the window (client-go refreshes the
+            # correlator entry on every occurrence)
+            msgs["\x00aggregate"] = now
+            return (
+                "\x00aggregate",
+                "(combined from similar events): " + message,
+            )
+        # last-seen, not first-seen: a message still recurring keeps its
+        # dedup state alive across prune passes — expiring it would
+        # reset the merged Event's count/firstTimestamp each window,
+        # destroying the "happened N times since T" evidence
+        msgs[message] = now
+        return message, message
+
+    def _take_token(self, ref_key: Tuple, now: float) -> bool:
+        tokens, last = self._buckets.get(ref_key, (float(self.burst), now))
+        if self.refill_seconds > 0:
+            tokens = min(
+                float(self.burst),
+                tokens + (now - last) / self.refill_seconds,
+            )
+        if tokens < 1.0:
+            self._buckets[ref_key] = (tokens, now)
+            return False
+        self._buckets[ref_key] = (tokens - 1.0, now)
+        return True
+
+    def _prune(self, now: float) -> None:
+        """Drop correlator state older than the window so a long-lived
+        operator's dedup maps cannot grow without bound — including the
+        per-object token buckets: under node churn (autoscaled pools)
+        every object that ever emitted leaves a bucket entry, and a
+        fully-refilled bucket idle past the window carries no state
+        worth keeping."""
+        if now - self._last_prune < CORRELATOR_WINDOW_SECONDS:
+            return
+        self._last_prune = now
+        for ref_key in list(self._buckets):
+            tokens, last = self._buckets[ref_key]
+            refilled = (
+                self.refill_seconds <= 0
+                or tokens + (now - last) / self.refill_seconds
+                >= float(self.burst)
+            )
+            if refilled and now - last >= CORRELATOR_WINDOW_SECONDS:
+                del self._buckets[ref_key]
+        for agg_key in list(self._similar):
+            msgs = {
+                m: t for m, t in self._similar[agg_key].items()
+                if now - t < CORRELATOR_WINDOW_SECONDS
+            }
+            if msgs:
+                self._similar[agg_key] = msgs
+            else:
+                del self._similar[agg_key]
+                for key in [k for k in self._counts if k[:5] == agg_key]:
+                    del self._counts[key]
+
+    # -- wire form -------------------------------------------------------------
+
+    def _build(
+        self, ref: Dict[str, Any], event_type: str, reason: str,
+        message: str, count: int, first_wall: float, wall: float, key: Tuple,
+    ) -> Dict[str, Any]:
+        digest = hashlib.sha256(repr(key).encode()).hexdigest()[:12]
+        name = f"{ref.get('name', 'unknown') or 'unknown'}.{digest}"
+        return {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"name": name, "namespace": self.namespace},
+            "involvedObject": dict(ref),
+            "type": event_type,
+            "reason": reason,
+            "message": message,
+            "count": count,
+            "firstTimestamp": _rfc3339(first_wall),
+            "lastTimestamp": _rfc3339(wall),
+            "source": {"component": self.source},
+        }

@@ -1,0 +1,100 @@
+"""Structured JSON log formatter with trace-context injection.
+
+``--log-format=json`` (controller entrypoint and agent CLI) switches
+both processes from the free-text ``%(asctime)s ...`` lines to one JSON
+object per record.  Every record carries the active trace/span IDs from
+:mod:`.trace`'s context variable, so a log aggregator can join the
+controller's reconcile records with the agent's provisioning records on
+``trace`` — the correlation the tentpole exists for.
+
+Field reference (docs/operator-guide.md "Observability"):
+
+==========  ==================================================
+``ts``      ISO-8601 UTC timestamp with milliseconds
+``level``   ``DEBUG``/``INFO``/``WARNING``/``ERROR``/``CRITICAL``
+``logger``  logger name (``tpunet.controller``, ``tpunet.agent``, ...)
+``msg``     fully-interpolated message
+``trace``   active trace ID (omitted outside any span)
+``span``    active span ID (omitted outside any span)
+``exc``     formatted traceback (only on exception records)
+==========  ==================================================
+
+Extra fields passed via ``logging``'s ``extra=`` mapping are merged in
+verbatim (non-serializable values degrade to ``str``), so call sites
+can attach structure without a formatter change.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Optional
+
+from .trace import current_span
+
+# logging.LogRecord's own attribute surface; anything else on a record
+# arrived via ``extra=`` and belongs in the JSON output
+_RESERVED = frozenset(vars(
+    logging.LogRecord("", 0, "", 0, "", (), None)
+)) | {"message", "asctime", "taskName"}
+
+LOG_FORMATS = ("text", "json")
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per record, trace context injected."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": self._iso(record.created),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        span = current_span()
+        if span is not None:
+            out["trace"] = span.trace_id
+            out["span"] = span.span_id
+        for key, value in record.__dict__.items():
+            if key not in _RESERVED and not key.startswith("_"):
+                out[key] = value
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+    @staticmethod
+    def _iso(created: float) -> str:
+        base = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(created))
+        return f"{base}.{int((created % 1) * 1000):03d}Z"
+
+
+def setup_logging(
+    level: int,
+    log_format: str = "text",
+    stream=None,
+    text_format: Optional[str] = None,
+) -> None:
+    """``logging.basicConfig`` analog shared by the controller
+    entrypoint and the agent CLI: ``text`` keeps each caller's existing
+    free-text line format, ``json`` swaps in :class:`JsonFormatter`."""
+    if log_format not in LOG_FORMATS:
+        raise ValueError(
+            f"unknown log format {log_format!r} (expected one of "
+            f"{'/'.join(LOG_FORMATS)})"
+        )
+    handler = logging.StreamHandler(stream or sys.stderr)
+    if log_format == "json":
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(
+            text_format or "%(asctime)s %(name)s %(levelname)s %(message)s"
+        ))
+    root = logging.getLogger()
+    root.setLevel(level)
+    # replace, don't stack: calling twice (tests, embedded runs) must
+    # not double every line
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    root.addHandler(handler)

@@ -1,0 +1,295 @@
+"""In-process tracer + flight recorder.
+
+OpenTelemetry is not in the container, and the control plane does not
+need a wire exporter — it needs an answer to "which reconcile flipped
+this node's label and how long did each provisioning phase take" that
+survives until an operator asks.  So: spans with trace/span IDs, parent
+links, attributes and durations, kept in a bounded ring buffer (the
+flight recorder) that :class:`..controller.health.HealthServer` serves
+as JSON from ``/debug/traces``.
+
+Correlation contract (the reason this is one trace, not two logs):
+
+* the controller opens a ``controller.reconcile`` span per workqueue
+  item; the reconciler stamps its trace ID onto every object it applies
+  (the :data:`TRACE_ANNOTATION` metadata annotation);
+* the agent mints a ``agent.provision`` span per provisioning attempt
+  (child spans per phase), adopting the stamped trace ID when the
+  operator projected one, and carries the finished spans back in its
+  report Lease (:class:`..agent.report.ProvisioningReport`);
+* the reconciler :meth:`Tracer.ingest`\\ s those spans into its own
+  recorder, so ``/debug/traces?trace=<id>`` returns the stitched view.
+
+The active span rides a :class:`contextvars.ContextVar`, so worker
+threads trace independently and the JSON log formatter
+(:mod:`.logging`) can inject trace context into every record without
+plumbing arguments through call sites.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import secrets
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+# metadata annotation the reconciler stamps on objects it applies; the
+# agent adopts it (via the downward API in a real cluster, the
+# --trace-id flag / TPUNET_TRACE_ID env in tests) so both halves of a
+# provisioning flow share one trace ID
+TRACE_ANNOTATION = "tpunet.dev/trace-id"
+
+# W3C traceparent sizes: 16-byte trace ID, 8-byte span ID.  The span
+# width matters: the reconciler dedups ingested spans fleet-wide by
+# span ID alone, and narrower random IDs would silently drop colliding
+# spans from the stitched trace (and their histogram observations)
+_TRACE_ID_BYTES = 16
+_SPAN_ID_BYTES = 8
+
+# the active span for THIS thread/context (None between requests)
+_CURRENT: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "tpunet_current_span", default=None
+)
+
+
+def new_trace_id() -> str:
+    return secrets.token_hex(_TRACE_ID_BYTES)
+
+
+def new_span_id() -> str:
+    return secrets.token_hex(_SPAN_ID_BYTES)
+
+
+def current_span() -> Optional["Span"]:
+    """The span active in this thread/context, or None."""
+    return _CURRENT.get()
+
+
+def current_trace_id() -> str:
+    """The active trace ID, or "" outside any span — what the
+    reconciler stamps and the log formatter injects."""
+    span = _CURRENT.get()
+    return span.trace_id if span is not None else ""
+
+
+class Span:
+    """One timed operation.  Created via :meth:`Tracer.span` /
+    :meth:`Tracer.start_span`; recorded into the flight recorder on
+    :meth:`end` (never before — half-open spans are not evidence)."""
+
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "attributes",
+        "status", "start_ts", "duration_ms", "_t0", "_tracer", "_token",
+    )
+
+    def __init__(
+        self,
+        tracer: Optional["Tracer"],
+        name: str,
+        trace_id: str,
+        parent_id: str = "",
+        attributes: Optional[Dict[str, Any]] = None,
+    ):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = new_span_id()
+        self.parent_id = parent_id
+        self.attributes: Dict[str, Any] = dict(attributes or {})
+        self.status = "ok"
+        self.start_ts = time.time()
+        self.duration_ms: Optional[float] = None
+        self._t0 = time.perf_counter()
+        self._tracer = tracer
+        self._token: Optional[contextvars.Token] = None
+
+    def set_attribute(self, key: str, value: Any) -> "Span":
+        self.attributes[key] = value
+        return self
+
+    def set_status(self, status: str) -> "Span":
+        self.status = status
+        return self
+
+    def end(self) -> "Span":
+        if self.duration_ms is None:   # idempotent: first end wins
+            self.duration_ms = (time.perf_counter() - self._t0) * 1e3
+            if self._tracer is not None:
+                self._tracer._record(self)
+        return self
+
+    # -- context-manager protocol (the common call shape) ---------------------
+
+    def __enter__(self) -> "Span":
+        self._token = _CURRENT.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            self.status = "error"
+            self.attributes.setdefault("error", f"{exc_type.__name__}: {exc}")
+        if self._token is not None:
+            _CURRENT.reset(self._token)
+            self._token = None
+        self.end()
+
+    # -- wire form -------------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "start": round(self.start_ts, 6),
+            "durationMs": (
+                None if self.duration_ms is None
+                else round(self.duration_ms, 3)
+            ),
+            "status": self.status,
+            "attributes": dict(self.attributes),
+        }
+
+
+class Tracer:
+    """Span factory + bounded flight recorder.
+
+    ``capacity`` bounds memory: the recorder keeps the newest spans and
+    evicts the oldest (ring-buffer semantics), so a long-lived operator
+    holds the last ~N operations' worth of evidence, never more."""
+
+    def __init__(self, capacity: int = 1024):
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=max(1, int(capacity)))
+        # span IDs already recorded/ingested, insertion-ordered for
+        # bounded pruning.  The limit must cover the fleet's LIVE
+        # report-span population, not just the ring: every agent
+        # republishes its finished spans in its report Lease each
+        # monitor tick, and an evicted ID would be re-ingested as
+        # "fresh" every status pass — re-observing the phase histograms
+        # without bound.  25 policies x 20 nodes x ~6 spans ≈ 3k live
+        # IDs; 16k (~1MB) clears that with headroom, and scales up with
+        # an operator-sized ring.
+        self._seen: "OrderedDict[str, None]" = OrderedDict()
+        self._seen_limit = max(8 * self._spans.maxlen, 16384)
+
+    # -- span creation ---------------------------------------------------------
+
+    def span(
+        self,
+        name: str,
+        trace_id: str = "",
+        attributes: Optional[Dict[str, Any]] = None,
+        parent: Optional[Span] = None,
+    ) -> Span:
+        """A span parented to ``parent`` (explicit) or the current
+        context span (same trace); with no parent it roots a new trace
+        (or joins an explicit ``trace_id`` — how the agent adopts the
+        operator's stamp).  Use as a context manager; the span records
+        itself on exit."""
+        if parent is None and not trace_id:
+            parent = _CURRENT.get()
+        if parent is not None:
+            return Span(
+                self, name, parent.trace_id,
+                parent_id=parent.span_id, attributes=attributes,
+            )
+        return Span(
+            self, name, trace_id or new_trace_id(), attributes=attributes
+        )
+
+    start_span = span   # OTel-familiar alias
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            if span.span_id in self._seen:
+                return
+            self._remember(span.span_id)
+            self._spans.append(span.to_dict())
+
+    def _remember(self, span_id: str) -> None:
+        self._seen[span_id] = None
+        while len(self._seen) > self._seen_limit:
+            self._seen.popitem(last=False)
+
+    # -- stitching -------------------------------------------------------------
+
+    def ingest(self, spans: List[Dict[str, Any]], trace_id: str = "",
+               source: str = "") -> List[Dict[str, Any]]:
+        """Adopt externally-produced span dicts (the agent's report
+        payload) into the recorder, deduplicating by span ID — a report
+        Lease is re-read on every status pass, and re-ingesting the same
+        provisioning attempt would both bloat the recorder and double-
+        observe the phase histograms.  Returns ONLY the newly-ingested
+        spans, so callers can observe metrics exactly once per span."""
+        fresh: List[Dict[str, Any]] = []
+        with self._lock:
+            for raw in spans or []:
+                if not isinstance(raw, dict):
+                    continue
+                span_id = str(raw.get("spanId", "") or "")
+                if not span_id or span_id in self._seen:
+                    continue
+                self._remember(span_id)
+                rec = dict(raw)
+                if trace_id and not rec.get("traceId"):
+                    rec["traceId"] = trace_id
+                if source:
+                    rec.setdefault("attributes", {})
+                    if isinstance(rec["attributes"], dict):
+                        rec["attributes"].setdefault("source", source)
+                self._spans.append(rec)
+                fresh.append(rec)
+        return fresh
+
+    # -- flight-recorder reads -------------------------------------------------
+
+    def snapshot(
+        self, trace_id: str = "", limit: int = 0
+    ) -> List[Dict[str, Any]]:
+        """Recorded spans, oldest first; optionally one trace only and/or
+        the newest ``limit``."""
+        with self._lock:
+            out = [
+                dict(s) for s in self._spans
+                if not trace_id or s.get("traceId") == trace_id
+            ]
+        if limit > 0:
+            out = out[-limit:]
+        return out
+
+    def trace_ids(self) -> List[str]:
+        """Distinct trace IDs currently held, oldest-seen first."""
+        seen: "OrderedDict[str, None]" = OrderedDict()
+        with self._lock:
+            for s in self._spans:
+                tid = s.get("traceId", "")
+                if tid:
+                    seen.setdefault(tid, None)
+        return list(seen)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+
+def timed_phases(
+    tracer: Optional["Tracer"],
+) -> Callable[..., Iterator[Optional[Span]]]:
+    """Tiny helper for call sites that trace a sequence of named phases
+    under one parent but must keep working when tracing is off
+    (``tracer=None``): returns a contextmanager factory ``phase(name)``
+    yielding the span or None.  Parenting and trace ID come from the
+    ambient context span, so call it inside the parent's ``with``."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def phase(name: str, **attributes: Any):
+        if tracer is None:
+            yield None
+            return
+        with tracer.span(name, attributes=attributes) as sp:
+            yield sp
+
+    return phase

@@ -86,6 +86,10 @@ class ProbeRunner:
         # whether the supplier has EVER returned a peer list — the gate
         # stays un-judged until the mesh membership is actually known
         self._peers_known = False
+        # obs/ "probe convergence" span: attached by the agent, ended
+        # here on the gate's first judged round (time from mesh start
+        # to the first verdict — the last provisioning phase)
+        self._convergence_span = None
         # invoked as on_transition(ready: bool) from the probing thread
         # whenever the gate verdict flips — the agent hooks its
         # immediate label retraction here so a detected partition does
@@ -96,6 +100,24 @@ class ProbeRunner:
         self._stop = threading.Event()
 
     # -- one round (tests / bench / the thread body) --------------------------
+
+    def attach_convergence_span(self, span) -> None:
+        """Agent hook: ``span`` (an :class:`..obs.Span`) is ended on the
+        gate's first judged round, measuring mesh-convergence time as
+        the final provisioning phase."""
+        self._convergence_span = span
+
+    def _end_convergence_span(self, snap: ProbeSnapshot) -> None:
+        span, self._convergence_span = self._convergence_span, None
+        if span is None:
+            return
+        try:
+            span.set_attribute("peersTotal", snap.peers_total)
+            span.set_attribute("peersReachable", snap.peers_reachable)
+            span.set_attribute("ready", self.gate.ready)
+            span.end()
+        except Exception as e:   # noqa: BLE001 — tracing must not kill probing
+            log.debug("convergence span end failed: %s", e)
 
     def step(self) -> ProbeSnapshot:
         peers = self._supplier()
@@ -126,6 +148,9 @@ class ProbeRunner:
                     self.on_transition(self.gate.ready)
                 except Exception as e:   # noqa: BLE001 — keep probing
                     log.warning("probe transition hook failed: %s", e)
+        # first judged round: close the convergence span with the
+        # verdict the gate just formed
+        self._end_convergence_span(snap)
         return snap
 
     # -- background mode ------------------------------------------------------
