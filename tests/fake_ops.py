@@ -42,6 +42,10 @@ class FakeLinkOps:
     mtu_set: Dict[str, int] = field(default_factory=dict)
     ups: List[str] = field(default_factory=list)
     downs: List[str] = field(default_factory=list)
+    # per-interface cumulative counters (the /sys/class/net statistics
+    # fake); absent counters read 0.  Tests drive anomaly scenarios by
+    # ramping these between monitor ticks (bump_counters).
+    counters: Dict[str, Dict[str, int]] = field(default_factory=dict)
 
     def add_fake_link(self, name: str, index: int, mac: str,
                       up: bool = False, mtu: int = 1500) -> nl.Link:
@@ -117,6 +121,26 @@ class FakeLinkOps:
             {"dst": r.dst, "gateway": r.gateway, "oif": r.oif}
             for r in self.routes
         ]
+
+    def iface_counters(self, name: str) -> Dict[str, int]:
+        if name not in self.links:
+            raise nl.NetlinkError(19, f"netlink: no such device: {name}")
+        out = {c: 0 for c in nl.IFACE_COUNTERS}
+        out.update(self.counters.get(name, {}))
+        return out
+
+    def all_counters(self, names) -> Dict[str, Dict[str, int]]:
+        """Bulk-read contract of netlink.read_all_counters: missing
+        interfaces are absent, not raised."""
+        return {
+            n: self.iface_counters(n) for n in names if n in self.links
+        }
+
+    def bump_counters(self, name: str, **deltas: int) -> None:
+        """Advance cumulative counters (rx_errors=500, rx_packets=1000...)."""
+        cur = self.counters.setdefault(name, {})
+        for counter, delta in deltas.items():
+            cur[counter] = cur.get(counter, 0) + delta
 
     def subscribe(self):
         return FakeSubscription(self)

@@ -201,6 +201,10 @@ class TestTpuProjection:
             "--topology-source=auto",
             "--coordinator-port=8476",
             "--bootstrap=/host/etc/tpu/jax-coordinator.json",
+            "--telemetry-window=5",
+            "--telemetry-error-ratio=0.01",
+            "--telemetry-drop-rate=100",
+            "--telemetry-stall-ticks=3",
             "--wait=90s",
         ]
         vol_names = {

@@ -155,7 +155,9 @@ class TestLogFstrings:
 
     CONTROLLER = "tpu_network_operator/controller/reconciler.py"
     AGENT = "tpu_network_operator/agent/cli.py"
-    ELSEWHERE = "tpu_network_operator/probe/runner.py"
+    # models/ logs through user-facing scripts, not the structured
+    # operator/agent streams — the one package family still out of scope
+    ELSEWHERE = "tpu_network_operator/models/llama.py"
 
     def codes_at(self, path, src):
         tree = ast.parse(src)
@@ -173,6 +175,17 @@ class TestLogFstrings:
         src = 'import logging\nlog = logging.getLogger("x")\n' \
               'def f(e):\n    log.warning(f"failed: {e}")\n'
         assert "G004" in self.codes_at(self.AGENT, src)
+
+    def test_obs_probe_kube_in_scope(self):
+        """The structured-log discipline covers every package whose
+        records reach the operator/agent streams — obs/, probe/ and
+        kube/ joined controller/ and agent/."""
+        src = 'import logging\nlog = logging.getLogger("x")\n' \
+              'def f(n):\n    log.info(f"round {n}")\n'
+        for path in ("tpu_network_operator/obs/events.py",
+                     "tpu_network_operator/probe/runner.py",
+                     "tpu_network_operator/kube/informer.py"):
+            assert "G004" in self.codes_at(path, src), path
 
     def test_all_log_methods_covered(self):
         for meth in ("debug", "info", "warning", "error", "exception",

@@ -375,6 +375,38 @@ class TestDebugTracesEndpoint:
         finally:
             srv.stop()
 
+    def test_query_parameter_edge_cases(self):
+        """?limit=0 and negative limits mean "no limit", an unknown
+        trace ID returns an empty span list (but still the recorder's
+        trace index), and a non-numeric limit degrades to no limit —
+        none of them may 500."""
+        tr = Tracer()
+        for i in range(3):
+            with tr.span(f"span-{i}", trace_id="ad" * 8):
+                pass
+        srv = HealthServer(port=0, tracer=tr)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            status, body = _get(f"{base}/debug/traces?limit=0")
+            assert status == 200
+            assert len(json.loads(body)["spans"]) == 3
+            status, body = _get(f"{base}/debug/traces?limit=-5")
+            assert status == 200
+            assert len(json.loads(body)["spans"]) == 3
+            status, body = _get(f"{base}/debug/traces?limit=bogus")
+            assert status == 200
+            assert len(json.loads(body)["spans"]) == 3
+            status, body = _get(
+                f"{base}/debug/traces?trace={'ff' * 8}"
+            )
+            assert status == 200
+            data = json.loads(body)
+            assert data["spans"] == []
+            assert data["traceIds"] == ["ad" * 8]
+        finally:
+            srv.stop()
+
     def test_404_without_tracer(self):
         srv = HealthServer(port=0)
         srv.start()
@@ -453,6 +485,46 @@ class TestExpositionFormat:
         series = [ln for ln in rendered.splitlines()
                   if ln.startswith("tpunet_policy_all_good")]
         assert len(series) == 1
+
+    def test_remove_matching_telemetry_families(self):
+        """The per-node retraction primitive against the telemetry
+        families: dropping one node's series must leave the other
+        node's intact, across all three families."""
+        m = Metrics()
+        for node in ("node-0", "node-1"):
+            labels = {"policy": "pol", "node": node, "interface": "ens9"}
+            m.set_gauge("tpunet_iface_rx_bytes_total", 1.0, labels)
+            m.set_gauge("tpunet_iface_errors_total", 2.0, labels)
+            m.set_gauge("tpunet_iface_error_ratio", 0.5, labels)
+        for family in ("tpunet_iface_rx_bytes_total",
+                       "tpunet_iface_errors_total",
+                       "tpunet_iface_error_ratio"):
+            m.remove_matching(family, {"policy": "pol", "node": "node-1"})
+        rendered = m.render()
+        assert 'node="node-0"' in rendered
+        assert 'node="node-1"' not in rendered
+        # whole-policy retraction clears the rest
+        for family in ("tpunet_iface_rx_bytes_total",
+                       "tpunet_iface_errors_total",
+                       "tpunet_iface_error_ratio"):
+            m.remove_matching(family, {"policy": "pol"})
+        assert "tpunet_iface" not in m.render()
+
+    def test_remove_matching_label_escaping_round_trip(self):
+        """A node name needing exposition escaping must still retract:
+        remove_matching matches on the RAW stored label values, so the
+        escaped render and the retraction key must agree."""
+        m = Metrics()
+        hostile = 'no"de\\one\nx'
+        m.set_gauge("tpunet_iface_error_ratio", 1.0, {
+            "policy": "pol", "node": hostile, "interface": "ens9",
+        })
+        rendered = m.render()
+        assert 'node="no\\"de\\\\one\\nx"' in rendered
+        assert len([ln for ln in rendered.splitlines()
+                    if ln.startswith("tpunet_iface_error_ratio")]) == 1
+        m.remove_matching("tpunet_iface_error_ratio", {"node": hostile})
+        assert "tpunet_iface_error_ratio{" not in m.render()
 
     def test_histogram_le_labels_unchanged(self):
         m = Metrics()

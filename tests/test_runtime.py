@@ -70,6 +70,11 @@ def test_mutate_noop_when_fully_specified():
             "layer": "L3", "image": "x:y", "pullPolicy": "Always",
             "topologySource": "metadata", "coordinatorPort": 9000,
             "bootstrapPath": "/etc/tpu/b.json", "mtu": 8000,
+            # telemetry is default-on, so "fully specified" includes
+            # its knobs (else the webhook pins them and patches)
+            "telemetry": {"enabled": True, "window": 5,
+                          "errorRatio": 0.01, "dropRate": 100.0,
+                          "stallTicks": 3},
         }
     )
     resp = review_mutate(review(obj))["response"]

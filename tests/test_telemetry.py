@@ -1,0 +1,1003 @@
+"""Dataplane telemetry pipeline tests: sysfs counter sampling, sliding
+windows + anomaly detection (agent/telemetry.py), label gating through
+the monitor tick, the report Lease back-channel, reconciler fleet
+rollups (status.telemetry + DataplaneTelemetryDegraded + tpunet_iface_*
+families), version-skew visibility, and the tools/diag.py support
+bundle asserted file by file against FakeCluster."""
+
+import importlib.util
+import io
+import json
+import os
+import sys
+import tarfile
+
+import pytest
+
+from tests.fake_ops import FakeLinkOps
+from tpu_network_operator import nfd
+from tpu_network_operator.agent import cli as agent_cli
+from tpu_network_operator.agent import netlink as nl
+from tpu_network_operator.agent import network as net
+from tpu_network_operator.agent import report as rpt
+from tpu_network_operator.agent import telemetry as telem
+from tpu_network_operator.api.v1alpha1 import (
+    API_VERSION,
+    AdmissionError,
+    NetworkClusterPolicy,
+    default_policy,
+    validate_create,
+)
+from tpu_network_operator.controller.health import Metrics
+from tpu_network_operator.controller.reconciler import (
+    NetworkClusterPolicyReconciler,
+)
+from tpu_network_operator.kube.fake import FakeCluster
+from tpu_network_operator.obs import EventRecorder
+
+NAMESPACE = "tpunet-system"
+
+
+def _configs(ops, names):
+    return {
+        n: net.NetworkConfiguration(link=ops.links[n],
+                                    orig_flags=ops.links[n].flags)
+        for n in names
+    }
+
+
+def make_ops(n_ifaces=2, traffic=10_000):
+    ops = FakeLinkOps()
+    for i in range(n_ifaces):
+        name = f"ens{9 + i}"
+        ops.add_fake_link(name, i + 2, f"02:00:00:00:{i:02x}:01", up=True)
+        ops.bump_counters(name, rx_packets=traffic, tx_packets=traffic,
+                          rx_bytes=traffic * 100, tx_bytes=traffic * 100)
+    return ops
+
+
+def make_monitor(**kw):
+    clock = [0.0]
+    kw.setdefault("clock", lambda: clock[0])
+    return telem.TelemetryMonitor(**kw), clock
+
+
+# -- sysfs reader -------------------------------------------------------------
+
+
+class TestSysfsReader:
+    def fake_tree(self, tmp_path, monkeypatch, counters):
+        root = tmp_path / "sys"
+        stats = root / "class/net/ens9/statistics"
+        stats.mkdir(parents=True)
+        for counter, val in counters.items():
+            if counter == "carrier_changes":
+                (root / "class/net/ens9/carrier_changes").write_text(
+                    f"{val}\n"
+                )
+            else:
+                (stats / counter).write_text(f"{val}\n")
+        monkeypatch.setenv("SYSFS_ROOT", str(root) + "/")
+        return root
+
+    def test_reads_statistics_and_carrier(self, tmp_path, monkeypatch):
+        self.fake_tree(tmp_path, monkeypatch, {
+            "rx_bytes": 123, "tx_packets": 7, "carrier_changes": 3,
+        })
+        out = nl.read_iface_counters("ens9")
+        assert out["rx_bytes"] == 123
+        assert out["tx_packets"] == 7
+        assert out["carrier_changes"] == 3
+        # unexported counters read 0, never raise
+        assert out["rx_errors"] == 0
+        assert set(out) == set(nl.IFACE_COUNTERS)
+
+    def test_missing_device_raises_enodev(self, tmp_path, monkeypatch):
+        self.fake_tree(tmp_path, monkeypatch, {})
+        with pytest.raises(nl.NetlinkError):
+            nl.read_iface_counters("ens99")
+
+    def test_garbage_counter_file_reads_zero(self, tmp_path, monkeypatch):
+        root = self.fake_tree(tmp_path, monkeypatch, {})
+        (root / "class/net/ens9/statistics/rx_bytes").write_text("nope\n")
+        assert nl.read_iface_counters("ens9")["rx_bytes"] == 0
+
+    def test_bulk_read_honors_sysfs_root_fake_tree(
+        self, tmp_path, monkeypatch
+    ):
+        """With a SYSFS_ROOT fake tree active, the bulk reader must NOT
+        consult the host's real /proc/net/dev — the fake tree is
+        authoritative (the e2e seam contract)."""
+        self.fake_tree(tmp_path, monkeypatch, {"rx_bytes": 55})
+        out = nl.read_all_counters(["ens9", "missing0"])
+        assert out["ens9"]["rx_bytes"] == 55
+        assert "missing0" not in out   # bulk contract: absent, not raised
+
+    def test_bulk_read_real_proc(self):
+        """On the real host (no fake tree) the bulk read parses
+        /proc/net/dev; loopback always exists."""
+        out = nl.read_all_counters(["lo"])
+        assert "lo" in out
+        assert out["lo"]["rx_bytes"] >= 0
+        assert set(out["lo"]) == set(nl.IFACE_COUNTERS)
+
+
+# -- windows + anomaly detection ----------------------------------------------
+
+
+class TestAnomalyDetection:
+    def test_error_ratio_ramp_flags_on_first_delta(self):
+        ops = make_ops(1)
+        configs = _configs(ops, ["ens9"])
+        mon, clock = make_monitor()
+        assert mon.sample(configs, ops) == []       # seed: no delta yet
+        clock[0] += 60
+        ops.bump_counters("ens9", rx_packets=1000, rx_errors=5000)
+        assert mon.sample(configs, ops) == [
+            "telemetry:ens9:error-ratio"
+        ]
+
+    def test_clean_traffic_never_flags(self):
+        ops = make_ops(2)
+        configs = _configs(ops, ["ens9", "ens10"])
+        mon, clock = make_monitor()
+        for _ in range(8):
+            clock[0] += 60
+            for n in configs:
+                ops.bump_counters(n, rx_packets=1000, tx_packets=1000,
+                                  rx_bytes=1 << 20, tx_bytes=1 << 20)
+            assert mon.sample(configs, ops) == []
+
+    def test_error_ratio_recovers_when_window_slides_past_burst(self):
+        ops = make_ops(1)
+        configs = _configs(ops, ["ens9"])
+        mon, clock = make_monitor(window=3)
+        mon.sample(configs, ops)
+        clock[0] += 60
+        ops.bump_counters("ens9", rx_packets=1000, rx_errors=5000)
+        assert mon.sample(configs, ops)              # burst flagged
+        quiet_ticks = 0
+        for _ in range(5):
+            clock[0] += 60
+            ops.bump_counters("ens9", rx_packets=1000, tx_packets=1000)
+            if not mon.sample(configs, ops):
+                break
+            quiet_ticks += 1
+        # window=3: the burst ages out after at most 3 quiet samples —
+        # damping, not instant forgiveness
+        assert 1 <= quiet_ticks <= 3
+        assert mon.sample(configs, ops) == []
+
+    def test_drop_spike_uses_rate_not_total(self):
+        ops = make_ops(1)
+        configs = _configs(ops, ["ens9"])
+        mon, clock = make_monitor(drop_rate=100.0)
+        mon.sample(configs, ops)
+        # 50 drops/s over the window: under the 100/s threshold
+        clock[0] += 60
+        ops.bump_counters("ens9", rx_packets=1000, rx_dropped=3000)
+        assert mon.sample(configs, ops) == []
+        # 150 drops/s: spike
+        clock[0] += 60
+        ops.bump_counters("ens9", rx_packets=1000, rx_dropped=15000)
+        assert mon.sample(configs, ops) == ["telemetry:ens9:drop-spike"]
+
+    def test_counter_stall_needs_oper_up_prior_traffic_full_depth(self):
+        ops = make_ops(1)
+        configs = _configs(ops, ["ens9"])
+        mon, clock = make_monitor(window=4, stall_ticks=3)
+        mon.sample(configs, ops)
+        flagged_at = None
+        for i in range(4):
+            clock[0] += 60
+            bad = mon.sample(configs, ops)           # rx frozen
+            if bad and flagged_at is None:
+                flagged_at = i + 1
+        assert flagged_at == 2                        # >= stall_ticks depth
+        assert mon.sample(configs, ops) == [
+            "telemetry:ens9:counter-stall"
+        ]
+        # traffic resumes -> recovers
+        clock[0] += 60
+        ops.bump_counters("ens9", rx_packets=500)
+        assert mon.sample(configs, ops) == []
+
+    def test_idle_interface_with_no_prior_traffic_not_stalled(self):
+        ops = FakeLinkOps()
+        ops.add_fake_link("ens9", 2, "02:00:00:00:00:01", up=True)
+        configs = _configs(ops, ["ens9"])
+        mon, clock = make_monitor(window=3, stall_ticks=2)
+        for _ in range(6):
+            clock[0] += 60
+            assert mon.sample(configs, ops) == []
+
+    def test_oper_down_interface_not_stalled(self):
+        ops = make_ops(1)
+        ops.links["ens9"].operstate = 0
+        configs = _configs(ops, ["ens9"])
+        mon, clock = make_monitor(window=3, stall_ticks=2)
+        for _ in range(5):
+            clock[0] += 60
+            assert mon.sample(configs, ops) == []
+
+    def test_counter_reset_reseeds_instead_of_negative_rates(self):
+        ops = make_ops(1)
+        configs = _configs(ops, ["ens9"])
+        mon, clock = make_monitor()
+        mon.sample(configs, ops)
+        clock[0] += 60
+        ops.bump_counters("ens9", rx_packets=1000)
+        mon.sample(configs, ops)
+        # driver reload: counters restart from zero
+        ops.counters["ens9"] = {"rx_packets": 10}
+        clock[0] += 60
+        assert mon.sample(configs, ops) == []
+        export = mon.export()["interfaces"]["ens9"]
+        # reseeded window: no delta yet, so no rates published
+        assert "rxBytesPerSec" not in export
+
+    def test_departed_interface_pruned(self):
+        ops = make_ops(2)
+        configs = _configs(ops, ["ens9", "ens10"])
+        mon, clock = make_monitor()
+        mon.sample(configs, ops)
+        del configs["ens10"]
+        clock[0] += 60
+        mon.sample(configs, ops)
+        assert set(mon.export()["interfaces"]) == {"ens9"}
+
+    def test_export_rates_and_ratio(self):
+        ops = make_ops(1)
+        configs = _configs(ops, ["ens9"])
+        mon, clock = make_monitor()
+        mon.sample(configs, ops)
+        clock[0] += 100
+        ops.bump_counters("ens9", rx_bytes=200_000, rx_packets=1000,
+                          rx_errors=1000)
+        bad = mon.sample(configs, ops)
+        out = mon.export()["interfaces"]["ens9"]
+        assert out["rxBytesPerSec"] == 2000.0
+        assert out["errorRatio"] == 0.5
+        assert out["anomalies"] == ["error-ratio"]
+        assert bad == ["telemetry:ens9:error-ratio"]
+
+    def test_bulk_read_failure_falls_back_and_keeps_verdict(self):
+        """One transient bulk-read failure must NOT wipe the windows
+        and clear an active anomaly — that would restore the label of
+        a still-erroring NIC for a tick (flap).  The sampler falls back
+        to per-interface reads instead."""
+        ops = make_ops(1)
+        configs = _configs(ops, ["ens9"])
+        mon, clock = make_monitor()
+        mon.sample(configs, ops)
+        clock[0] += 60
+        ops.bump_counters("ens9", rx_packets=1000, rx_errors=5000)
+        assert mon.sample(configs, ops) == ["telemetry:ens9:error-ratio"]
+
+        real_bulk = ops.all_counters
+        calls = {"n": 0}
+
+        def flaky_bulk(names):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("proc read failed")
+            return real_bulk(names)
+
+        ops.all_counters = flaky_bulk
+        clock[0] += 60
+        ops.bump_counters("ens9", rx_packets=1000)
+        # burst still in the window: the verdict must survive the blip
+        assert mon.sample(configs, ops) == ["telemetry:ens9:error-ratio"]
+        assert "ens9" in mon.export()["interfaces"]
+
+    def test_concurrent_export_during_sample_is_safe(self):
+        """The probe transition hook exports from the probing thread
+        while the monitor thread samples — the monitor's lock must keep
+        the hook's time-critical failure report from being dropped by a
+        dict-changed-during-iteration error."""
+        import threading
+
+        ops = make_ops(4)
+        configs = _configs(ops, sorted(ops.links))
+        mon, clock = make_monitor()
+        stop = threading.Event()
+        errors = []
+
+        def exporter():
+            while not stop.is_set():
+                try:
+                    mon.export()
+                except Exception as e:   # noqa: BLE001 — the assertion
+                    errors.append(e)
+                    return
+
+        thread = threading.Thread(target=exporter)
+        thread.start()
+        try:
+            for i in range(300):
+                clock[0] += 60
+                # churn the interface set so export's iteration races
+                # real insert/delete, not just value updates
+                subset = dict(list(configs.items())[: 1 + i % 4])
+                for n in subset:
+                    ops.bump_counters(n, rx_packets=100)
+                mon.sample(subset, ops)
+        finally:
+            stop.set()
+            thread.join(timeout=5)
+        assert errors == []
+
+    def test_thresholds_zero_or_negative_fall_back_to_defaults(self):
+        mon = telem.TelemetryMonitor(window=-3, error_ratio=-1.0,
+                                     drop_rate=0.0, stall_ticks=0)
+        assert mon.window == telem.DEFAULT_WINDOW
+        assert mon.error_ratio == telem.DEFAULT_ERROR_RATIO
+        assert mon.drop_rate == telem.DEFAULT_DROP_RATE
+        assert mon.stall_ticks == telem.DEFAULT_STALL_TICKS
+
+
+# -- monitor-tick label gating ------------------------------------------------
+
+
+class TestMonitorTickGating:
+    def setup_node(self, tmp_path, n_ifaces=2):
+        nfd_dir = (
+            tmp_path / "etc/kubernetes/node-feature-discovery/features.d"
+        )
+        nfd_dir.mkdir(parents=True)
+        ops = make_ops(n_ifaces)
+        configs = _configs(ops, sorted(ops.links))
+        config = agent_cli.CmdConfig(
+            backend="tpu", mode="L2", ops=ops, nfd_root=str(tmp_path),
+        )
+        state = agent_cli._MonitorState()
+        mon, clock = make_monitor()
+        state.telemetry = mon
+        label_file = nfd_dir / nfd.labels.NFD_FILE_NAME
+        nfd.write_readiness_label(nfd.TPU_READY_LABEL, root=str(tmp_path))
+        return ops, configs, config, state, clock, label_file
+
+    def tick(self, config, configs, state, clock, ops, ramp=0):
+        clock[0] += 60
+        for n in configs:
+            ops.bump_counters(n, rx_packets=1000, tx_packets=1000)
+        if ramp:
+            ops.bump_counters("ens9", rx_errors=ramp)
+        agent_cli._monitor_tick(
+            config, configs, "", nfd.TPU_READY_LABEL, state,
+        )
+
+    def test_error_ramp_retracts_within_3_ticks_then_recovers(
+        self, tmp_path
+    ):
+        """The acceptance scenario at agent level: injected rx-error
+        ramp -> label gone within 3 ticks; counters quiet -> restored."""
+        ops, configs, config, state, clock, label_file = \
+            self.setup_node(tmp_path)
+        self.tick(config, configs, state, clock, ops)
+        assert label_file.exists()
+        ticks = 0
+        for _ in range(3):
+            ticks += 1
+            self.tick(config, configs, state, clock, ops, ramp=5000)
+            if not label_file.exists():
+                break
+        assert not label_file.exists()
+        assert ticks <= 3
+        assert state.last_bad == ["telemetry:ens9:error-ratio"]
+
+        for _ in range(telem.DEFAULT_WINDOW + 1):
+            self.tick(config, configs, state, clock, ops)
+        assert label_file.exists(), "quiet counters did not restore"
+        assert state.last_bad == []
+
+    def test_degradation_error_names_telemetry_separately(self):
+        text = agent_cli._degradation_error([
+            "ens9", "telemetry:ens10:error-ratio", agent_cli.PROBE_DEGRADED,
+        ])
+        assert text == (
+            "interfaces degraded: ens9; "
+            "telemetry anomalies: ens10:error-ratio; "
+            "probe mesh below quorum"
+        )
+
+    def test_telemetry_disabled_never_samples(self, tmp_path):
+        ops, configs, config, state, clock, label_file = \
+            self.setup_node(tmp_path)
+        config.telemetry_enabled = False
+        state.telemetry = None
+        for _ in range(3):
+            clock[0] += 60
+            ops.bump_counters("ens9", rx_errors=9000)
+            agent_cli._monitor_tick(
+                config, configs, "", nfd.TPU_READY_LABEL, state,
+            )
+        assert state.telemetry is None
+        assert label_file.exists()
+
+    def test_failure_report_carries_telemetry_payload(
+        self, tmp_path, monkeypatch
+    ):
+        captured = []
+        monkeypatch.setattr(
+            agent_cli, "_report_ctx",
+            lambda config: ("node-1", FakeCluster()),
+        )
+        monkeypatch.setattr(
+            rpt, "write_report",
+            lambda client, ns, rep: captured.append(rep) or True,
+        )
+        ops, configs, config, state, clock, label_file = \
+            self.setup_node(tmp_path)
+        config.report_namespace = NAMESPACE
+        self.tick(config, configs, state, clock, ops)
+        self.tick(config, configs, state, clock, ops, ramp=5000)
+        assert captured, "no report published"
+        rep = captured[-1]
+        assert rep.ok is False
+        assert "telemetry anomalies: ens9:error-ratio" in rep.error
+        assert rep.telemetry["interfaces"]["ens9"]["anomalies"] == [
+            "error-ratio"
+        ]
+        assert rep.agent_version != ""
+
+    def test_flag_surface(self):
+        args = agent_cli.build_parser().parse_args([
+            "--telemetry=false", "--telemetry-window", "7",
+            "--telemetry-error-ratio", "0.05",
+            "--telemetry-drop-rate", "10",
+            "--telemetry-stall-ticks", "4",
+        ])
+        assert args.telemetry_enabled is False
+        assert args.telemetry_window == 7
+        assert args.telemetry_error_ratio == 0.05
+        assert args.telemetry_drop_rate == 10.0
+        assert args.telemetry_stall_ticks == 4
+        with pytest.raises(SystemExit):
+            agent_cli.build_parser().parse_args(["--telemetry=ture"])
+
+
+# -- report round-trip --------------------------------------------------------
+
+
+class TestReportRoundTrip:
+    def test_telemetry_and_version_survive_json(self):
+        rep = rpt.ProvisioningReport(
+            node="n1", ok=True,
+            telemetry={"interfaces": {"ens9": {"rxBytes": 5}}},
+            agent_version="0.1.0",
+        )
+        back = rpt.ProvisioningReport.from_json(rep.to_json())
+        assert back.telemetry == {"interfaces": {"ens9": {"rxBytes": 5}}}
+        assert back.agent_version == "0.1.0"
+
+    def test_absent_fields_default_for_old_agents(self):
+        back = rpt.ProvisioningReport.from_json(
+            json.dumps({"node": "n1", "ok": True})
+        )
+        assert back.telemetry is None
+        assert back.agent_version == ""
+
+    def test_mangled_telemetry_rejected(self):
+        with pytest.raises(ValueError):
+            rpt.ProvisioningReport.from_json(
+                json.dumps({"node": "n1", "telemetry": [1, 2]})
+            )
+        with pytest.raises(ValueError):
+            rpt.ProvisioningReport.from_json(
+                json.dumps({"node": "n1", "agent_version": 7})
+            )
+
+    def test_report_from_result_stamps_version(self):
+        rep = rpt.report_from_result(
+            node="n1", policy="p", backend="tpu", mode="L2",
+            configs={}, bootstrap_path="", coordinator="",
+            telemetry={"interfaces": {}},
+        )
+        from tpu_network_operator import __version__
+
+        assert rep.agent_version == __version__
+        assert rep.telemetry == {"interfaces": {}}
+
+
+# -- CRD surface --------------------------------------------------------------
+
+
+class TestCrdSurface:
+    def make(self, **telemetry):
+        p = NetworkClusterPolicy()
+        p.metadata.name = "pol"
+        p.spec.configuration_type = "tpu-so"
+        p.spec.node_selector = {"pool": "a"}
+        for k, v in telemetry.items():
+            setattr(p.spec.tpu_scale_out.telemetry, k, v)
+        return p
+
+    def test_defaulting_pins_the_contract(self):
+        tl = default_policy(self.make()).spec.tpu_scale_out.telemetry
+        assert tl.enabled is True
+        assert tl.window == telem.DEFAULT_WINDOW
+        assert tl.error_ratio == telem.DEFAULT_ERROR_RATIO
+        assert tl.drop_rate == telem.DEFAULT_DROP_RATE
+        assert tl.stall_ticks == telem.DEFAULT_STALL_TICKS
+
+    def test_disabled_left_untouched(self):
+        tl = default_policy(
+            self.make(enabled=False)
+        ).spec.tpu_scale_out.telemetry
+        assert tl.window == 0 and tl.error_ratio == 0.0
+
+    def test_validation_rejects_out_of_range(self):
+        for bad in (
+            {"window": 1}, {"window": 101}, {"error_ratio": 1.5},
+            {"error_ratio": -0.1}, {"drop_rate": -1.0},
+            {"stall_ticks": -1}, {"stall_ticks": 200},
+        ):
+            with pytest.raises(AdmissionError):
+                validate_create(self.make(**bad))
+        validate_create(self.make(window=2, error_ratio=0.5,
+                                  drop_rate=10.0, stall_ticks=2))
+
+    def test_validation_rejects_stall_deeper_than_window(self):
+        """stallTicks > window can never fire (the deque holds at most
+        window samples) — silently-disabled detection is rejected, like
+        window=1.  Compared as the agent will resolve the zeroes."""
+        with pytest.raises(AdmissionError, match="never fire"):
+            validate_create(self.make(window=3, stall_ticks=10))
+        with pytest.raises(AdmissionError, match="never fire"):
+            # window absent -> 5; an explicit stallTicks of 6 loses
+            validate_create(self.make(stall_ticks=6))
+        validate_create(self.make(window=10, stall_ticks=10))
+
+    def test_schema_covers_telemetry(self):
+        from tpu_network_operator.api.v1alpha1 import crdgen
+
+        schema = crdgen.openapi_schema()
+        tl = schema["properties"]["spec"]["properties"]["tpuScaleOut"][
+            "properties"]["telemetry"]["properties"]
+        assert set(tl) == {"enabled", "window", "errorRatio", "dropRate",
+                           "stallTicks"}
+        status = schema["properties"]["status"]["properties"]
+        assert "telemetry" in status and "agentVersions" in status
+
+    def test_projection_pins_flags(self):
+        from tpu_network_operator.controller.reconciler import (
+            update_tpu_scale_out_daemonset,
+        )
+        from tpu_network_operator.controller import templates
+
+        ds = templates.tpu_discovery_daemonset()
+        policy = default_policy(self.make())
+        update_tpu_scale_out_daemonset(ds, policy, NAMESPACE)
+        args = ds["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--telemetry-window=5" in args
+        assert "--telemetry-error-ratio=0.01" in args
+        assert "--telemetry-drop-rate=100" in args
+        assert "--telemetry-stall-ticks=3" in args
+        assert not any(a.startswith("--telemetry=") for a in args)
+
+        ds = templates.tpu_discovery_daemonset()
+        policy = default_policy(self.make(enabled=False))
+        update_tpu_scale_out_daemonset(ds, policy, NAMESPACE)
+        args = ds["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--telemetry=false" in args
+        assert not any(a.startswith("--telemetry-") for a in args)
+
+
+# -- reconciler rollups -------------------------------------------------------
+
+
+def telemetry_payload(error_ratio=0.0, anomalies=(), rx_bytes=1 << 20,
+                      errors=0, packets=100_000):
+    return {"interfaces": {"ens9": {
+        "rxBytes": rx_bytes, "txBytes": rx_bytes,
+        "rxPackets": packets, "txPackets": packets,
+        "rxErrors": errors, "txErrors": 0,
+        "rxDropped": 0, "txDropped": 0, "carrierChanges": 1,
+        "errorRatio": error_ratio,
+        **({"anomalies": list(anomalies)} if anomalies else {}),
+    }}}
+
+
+class TestReconcilerRollup:
+    def setup_fleet(self, n_nodes=3):
+        fake = FakeCluster()
+        metrics = Metrics()
+        recorder = EventRecorder(fake, NAMESPACE, metrics=metrics)
+        policy = NetworkClusterPolicy()
+        policy.metadata.name = "pol"
+        policy.spec.configuration_type = "tpu-so"
+        policy.spec.node_selector = {"tpunet.dev/pool": "pol"}
+        fake.create(default_policy(policy).to_dict())
+        for i in range(n_nodes):
+            fake.add_node(f"node-{i}", {"tpunet.dev/pool": "pol"})
+        rec = NetworkClusterPolicyReconciler(
+            fake, NAMESPACE, metrics=metrics, events=recorder,
+        )
+        rec.setup()
+        rec.reconcile("pol")
+        fake.simulate_daemonset_controller()
+        return fake, metrics, rec
+
+    def publish(self, fake, node, payload, version="0.1.0"):
+        fake.apply(rpt.lease_for(rpt.ProvisioningReport(
+            node=node, policy="pol", ok=True,
+            telemetry=payload, agent_version=version,
+        ), NAMESPACE))
+
+    def get_cr(self, fake):
+        return fake.get(API_VERSION, "NetworkClusterPolicy", "pol")
+
+    def test_rollup_surfaces_worst_node_and_condition(self):
+        fake, metrics, rec = self.setup_fleet()
+        self.publish(fake, "node-0", telemetry_payload(0.001))
+        self.publish(fake, "node-1", telemetry_payload(
+            0.42, anomalies=["error-ratio"], errors=4200,
+        ))
+        self.publish(fake, "node-2", telemetry_payload(0.002))
+        rec.reconcile("pol")
+        status = self.get_cr(fake)["status"]
+        tstat = status["telemetry"]
+        assert tstat["nodesReporting"] == 3
+        assert tstat["anomalousNodes"] == ["node-1"]
+        assert tstat["anomalies"] == ["node-1/ens9: error-ratio"]
+        assert tstat["worstNode"] == "node-1"
+        assert tstat["worstErrorRatio"] == 0.42
+        assert 0 < tstat["aggregateErrorRatio"] < 0.42
+        cond = next(c for c in status["conditions"]
+                    if c["type"] == "DataplaneTelemetryDegraded")
+        assert cond["status"] == "True"
+        assert cond["reason"] == "CounterAnomalies"
+        # exactly one Event for the flip
+        assert len(fake.events(involved_name="pol",
+                               reason="DataplaneTelemetryDegraded")) == 1
+        # metric families exported with {policy,node,interface}
+        rendered = metrics.render()
+        assert ('tpunet_iface_error_ratio{interface="ens9",node="node-1"'
+                ',policy="pol"} 0.42') in rendered
+        assert 'tpunet_iface_rx_bytes_total{' in rendered
+        assert 'tpunet_iface_errors_total{' in rendered
+
+    def test_steady_degraded_emits_once_recovery_emits_once(self):
+        fake, metrics, rec = self.setup_fleet(1)
+        self.publish(fake, "node-0", telemetry_payload(
+            0.3, anomalies=["error-ratio"], errors=100,
+        ))
+        for _ in range(4):
+            rec.reconcile("pol")
+        assert len(fake.events(involved_name="pol",
+                               reason="DataplaneTelemetryDegraded")) == 1
+        self.publish(fake, "node-0", telemetry_payload(0.0))
+        for _ in range(3):
+            rec.reconcile("pol")
+        events = fake.events(involved_name="pol",
+                             reason="DataplaneTelemetryRecovered")
+        assert len(events) == 1
+        cond = next(
+            c for c in self.get_cr(fake)["status"]["conditions"]
+            if c["type"] == "DataplaneTelemetryDegraded"
+        )
+        assert cond["status"] == "False"
+        assert cond["reason"] == "CountersNominal"
+
+    def test_no_samples_means_no_status_telemetry(self):
+        fake, metrics, rec = self.setup_fleet(1)
+        fake.apply(rpt.lease_for(rpt.ProvisioningReport(
+            node="node-0", policy="pol", ok=True,
+        ), NAMESPACE))
+        rec.reconcile("pol")
+        assert "telemetry" not in self.get_cr(fake)["status"]
+
+    def test_departed_node_series_retracted(self):
+        fake, metrics, rec = self.setup_fleet(2)
+        for n in ("node-0", "node-1"):
+            self.publish(fake, n, telemetry_payload(0.01))
+        rec.reconcile("pol")
+        assert 'node="node-1"' in metrics.render()
+        # node-1 leaves: lease retracted, pod gone
+        fake.delete(rpt.LEASE_API, "Lease",
+                    rpt.lease_name("node-1"), NAMESPACE)
+        fake.delete("v1", "Node", "node-1")
+        fake.simulate_daemonset_controller()
+        rec.reconcile("pol")
+        rendered = metrics.render()
+        assert 'node="node-0"' in rendered
+        assert 'tpunet_iface_error_ratio{interface="ens9",node="node-1"' \
+            not in rendered
+
+    def test_policy_delete_retracts_all_series(self):
+        fake, metrics, rec = self.setup_fleet(1)
+        self.publish(fake, "node-0", telemetry_payload(0.01))
+        rec.reconcile("pol")
+        assert "tpunet_iface_error_ratio" in metrics.render()
+        fake.delete(API_VERSION, "NetworkClusterPolicy", "pol")
+        rec.reconcile("pol")
+        assert "tpunet_iface_error_ratio" not in metrics.render()
+
+    def test_disable_cleans_status_and_series(self):
+        fake, metrics, rec = self.setup_fleet(1)
+        self.publish(fake, "node-0", telemetry_payload(
+            0.3, anomalies=["error-ratio"],
+        ))
+        rec.reconcile("pol")
+        assert "telemetry" in self.get_cr(fake)["status"]
+        cr = self.get_cr(fake)
+        cr["spec"]["tpuScaleOut"]["telemetry"] = {"enabled": False}
+        fake.update(cr)
+        rec.reconcile("pol")
+        status = self.get_cr(fake)["status"]
+        assert "telemetry" not in status
+        assert not any(c["type"] == "DataplaneTelemetryDegraded"
+                       for c in status.get("conditions", []))
+        assert "tpunet_iface_error_ratio" not in metrics.render()
+
+    def test_mangled_payloads_never_crash_the_pass(self):
+        fake, metrics, rec = self.setup_fleet(1)
+        self.publish(fake, "node-0", {
+            "interfaces": {
+                "ens9": {"errorRatio": "NaNsense", "anomalies": "nope"},
+                "bogus": [1, 2],
+            },
+        })
+        rec.reconcile("pol")
+        tstat = self.get_cr(fake)["status"]["telemetry"]
+        assert tstat["nodesReporting"] == 1
+        # omit-empty wire form: an empty anomaly set serializes absent
+        assert tstat.get("anomalousNodes", []) == []
+
+    def test_interface_cardinality_bounded(self):
+        from tpu_network_operator.controller import reconciler as rmod
+
+        fake, metrics, rec = self.setup_fleet(1)
+        payload = {"interfaces": {
+            f"eth{i}": {"rxBytes": 1, "errorRatio": 0.0}
+            for i in range(40)
+        }}
+        self.publish(fake, "node-0", payload)
+        rec.reconcile("pol")
+        series = [
+            ln for ln in metrics.render().splitlines()
+            if ln.startswith("tpunet_iface_rx_bytes_total{")
+        ]
+        assert len(series) == rmod.MAX_TELEMETRY_IFACES
+
+    def test_anomaly_past_metric_cap_still_surfaces(self):
+        """The cardinality cap bounds METRIC rows only: an anomaly on
+        the interface that sorts last must still flip the condition the
+        agent's own label verdict already reflects."""
+        fake, metrics, rec = self.setup_fleet(1)
+        ifaces = {
+            f"eth{i:02d}": {"rxBytes": 1, "errorRatio": 0.0}
+            for i in range(10)
+        }
+        ifaces["zzz9"] = {"rxBytes": 1, "errorRatio": 0.9,
+                          "anomalies": ["error-ratio"]}
+        self.publish(fake, "node-0", {"interfaces": ifaces})
+        rec.reconcile("pol")
+        status = self.get_cr(fake)["status"]
+        tstat = status["telemetry"]
+        assert tstat["anomalousNodes"] == ["node-0"]
+        assert tstat["anomalies"] == ["node-0/zzz9: error-ratio"]
+        assert tstat["worstErrorRatio"] == 0.9
+        cond = next(c for c in status["conditions"]
+                    if c["type"] == "DataplaneTelemetryDegraded")
+        assert cond["status"] == "True"
+        # while the metric rows stay capped
+        series = [
+            ln for ln in metrics.render().splitlines()
+            if ln.startswith("tpunet_iface_rx_bytes_total{")
+        ]
+        assert 'interface="zzz9"' not in "".join(series)
+
+    def test_agent_version_skew_visible(self):
+        fake, metrics, rec = self.setup_fleet(3)
+        self.publish(fake, "node-0", None, version="0.1.0")
+        self.publish(fake, "node-1", None, version="0.1.0")
+        self.publish(fake, "node-2", None, version="0.2.0")
+        rec.reconcile("pol")
+        assert self.get_cr(fake)["status"]["agentVersions"] == {
+            "0.1.0": 2, "0.2.0": 1,
+        }
+
+    def test_build_info_gauge_exported(self):
+        from tpu_network_operator import __version__
+        from tpu_network_operator.controller.health import set_build_info
+
+        metrics = Metrics()
+        set_build_info(metrics)
+        assert (
+            f'tpunet_build_info{{version="{__version__}"}} 1.0'
+            in metrics.render()
+        )
+
+    def test_manager_sets_build_info(self):
+        from tpu_network_operator.controller.manager import Manager
+
+        metrics = Metrics()
+        Manager(FakeCluster(), NAMESPACE, metrics=metrics)
+        assert "tpunet_build_info" in metrics.render()
+
+
+# -- support bundle -----------------------------------------------------------
+
+
+def _load_diag():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "diag.py")
+    spec = importlib.util.spec_from_file_location("tpunet_diag", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestSupportBundle:
+    def make_cluster(self):
+        from tpu_network_operator.obs import Tracer
+
+        fake = FakeCluster()
+        policy = NetworkClusterPolicy()
+        policy.metadata.name = "pol"
+        policy.spec.configuration_type = "tpu-so"
+        policy.spec.node_selector = {"pool": "a"}
+        fake.create(default_policy(policy).to_dict())
+        fake.apply(rpt.lease_for(rpt.ProvisioningReport(
+            node="node-0", policy="pol", ok=True,
+            telemetry=telemetry_payload(0.01), agent_version="0.1.0",
+        ), NAMESPACE))
+        fake.apply(rpt.lease_for(rpt.ProvisioningReport(
+            node="node-1", policy="pol", ok=False, error="boom",
+        ), NAMESPACE))
+        fake.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": rpt.peer_configmap_name("pol"),
+                         "namespace": NAMESPACE},
+            "data": {"peers": "{}"},
+        })
+        fake.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "unrelated-app-config",
+                         "namespace": NAMESPACE,
+                         "annotations": {"db-password": "hunter2"}},
+            "data": {"password": "hunter2"},
+        })
+        recorder = EventRecorder(fake, NAMESPACE)
+        recorder.event(
+            {"apiVersion": API_VERSION, "kind": "NetworkClusterPolicy",
+             "name": "pol"},
+            "Warning", "DataplaneTelemetryDegraded", "1/1 nodes anomalous",
+        )
+        metrics = Metrics()
+        metrics.set_gauge("tpunet_iface_error_ratio", 0.01,
+                          {"policy": "pol", "node": "node-0",
+                           "interface": "ens9"})
+        tracer = Tracer()
+        with tracer.span("controller.reconcile", trace_id="ab" * 16):
+            pass
+        return fake, metrics, tracer
+
+    def test_bundle_contents_file_by_file(self, tmp_path):
+        diag = _load_diag()
+        fake, metrics, tracer = self.make_cluster()
+        out = tmp_path / "bundle.tar.gz"
+        members = diag.collect_bundle(
+            fake, NAMESPACE, str(out), metrics=metrics, tracer=tracer,
+        )
+        assert members == [
+            "configmaps/tpunet-peers-pol.json",
+            "events.json",
+            "manifest.json",
+            "metrics.txt",
+            "policies.json",
+            "reports/node-0.json",
+            "reports/node-1.json",
+            "telemetry/node-0.json",
+            "traces.json",
+        ]
+        with tarfile.open(out) as tar:
+            assert sorted(tar.getnames()) == members
+            read = {
+                name: tar.extractfile(name).read().decode()
+                for name in members
+            }
+        manifest = json.loads(read["manifest.json"])
+        assert manifest["namespace"] == NAMESPACE
+        assert manifest["files"] == [
+            m for m in members if m != "manifest.json"
+        ]
+        policies = json.loads(read["policies.json"])
+        assert policies[0]["metadata"]["name"] == "pol"
+        telem_dump = json.loads(read["telemetry/node-0.json"])
+        assert telem_dump["interfaces"]["ens9"]["errorRatio"] == 0.01
+        events = json.loads(read["events.json"])
+        assert events[0]["reason"] == "DataplaneTelemetryDegraded"
+        assert "tpunet_iface_error_ratio" in read["metrics.txt"]
+        traces = json.loads(read["traces.json"])
+        assert traces["spans"][0]["name"] == "controller.reconcile"
+        # the co-located app ConfigMap is NEVER collected
+        assert not any("unrelated" in m for m in members)
+
+    def test_redaction_masks_secret_shaped_values(self):
+        diag = _load_diag()
+        out = diag.redact({
+            "metadata": {
+                "annotations": {
+                    "kubectl.kubernetes.io/last-applied-configuration":
+                        '{"whole": "object"}',
+                    "my-token": "sk-12345",
+                },
+                "managedFields": [{"manager": "x"}],
+            },
+            "spec": {
+                "password": "hunter2",
+                "note": "header was Authorization: Bearer abc.def.ghi ok",
+                "fine": "value",
+            },
+        })
+        annotations = out["metadata"]["annotations"]
+        assert "kubectl.kubernetes.io/last-applied-configuration" \
+            not in annotations
+        assert annotations["my-token"] == diag.REDACTED
+        assert "managedFields" not in out["metadata"]
+        assert out["spec"]["password"] == diag.REDACTED
+        assert diag.REDACTED in out["spec"]["note"]
+        assert "abc.def.ghi" not in out["spec"]["note"]
+        assert out["spec"]["fine"] == "value"
+        # ANY key ending in "key" is masked (the documented *key rule)
+        more = diag.redact({"sshKey": "AAAA", "signing_key": "BBBB",
+                            "keynote": "public"})
+        assert more["sshKey"] == diag.REDACTED
+        assert more["signing_key"] == diag.REDACTED
+        assert more["keynote"] == "public"
+
+    def test_endpoint_bodies_scrubbed_of_bearer_tokens(self, tmp_path):
+        """metrics.txt and traces.json get the same redaction guarantee
+        as the object dumps: a credential embedded in a metric label or
+        span attribute must not ship in the bundle."""
+        diag = _load_diag()
+        fake = FakeCluster()
+        out = tmp_path / "bundle.tar.gz"
+        diag.collect_bundle(
+            fake, NAMESPACE, str(out),
+            metrics_text=('up{err="auth: Bearer sk.12345 rejected"} 1\n'),
+            traces_json=json.dumps({"spans": [{
+                "name": "x",
+                "attributes": {"error": "401 Bearer abc.def denied"},
+            }]}),
+        )
+        with tarfile.open(out) as tar:
+            metrics_txt = tar.extractfile("metrics.txt").read().decode()
+            traces = tar.extractfile("traces.json").read().decode()
+        assert "sk.12345" not in metrics_txt
+        assert diag.REDACTED in metrics_txt
+        assert "abc.def" not in traces
+        assert diag.REDACTED in traces
+
+    def test_cluster_errors_become_errors_json(self, tmp_path):
+        diag = _load_diag()
+
+        class ExplodingCluster(FakeCluster):
+            def list(self, api_version, kind, **kw):
+                if kind == "Event":
+                    raise RuntimeError("events forbidden")
+                return super().list(api_version, kind, **kw)
+
+        out = tmp_path / "bundle.tar.gz"
+        members = diag.collect_bundle(ExplodingCluster(), NAMESPACE,
+                                      str(out))
+        assert "errors.json" in members
+        with tarfile.open(out) as tar:
+            errors = json.loads(
+                tar.extractfile("errors.json").read().decode()
+            )
+        assert "events" in errors and "forbidden" in errors["events"]
+
+    def test_hostile_node_name_cannot_traverse(self, tmp_path):
+        diag = _load_diag()
+        fake = FakeCluster()
+        fake.apply(rpt.lease_for(rpt.ProvisioningReport(
+            node="../../etc/passwd", policy="pol", ok=True,
+        ), NAMESPACE))
+        out = tmp_path / "bundle.tar.gz"
+        members = diag.collect_bundle(fake, NAMESPACE, str(out))
+        assert all(".." not in m for m in members)
+        assert any(m.startswith("reports/") for m in members)

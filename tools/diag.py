@@ -1,0 +1,307 @@
+#!/usr/bin/env python3
+"""Support-bundle collector — the operator's ``must-gather`` analog.
+
+One command snapshots everything a support engineer needs to triage a
+dataplane incident without cluster access of their own: the
+NetworkClusterPolicy CRs (spec + status rollups), the namespace Events,
+the distributed probe peer ConfigMaps, the per-node provisioning-report
+Leases (including their telemetry counter samples, split out per node
+for direct diffing), the ``/metrics`` exposition and the
+``/debug/traces`` flight recorder — all into one gzip tarball.
+
+Everything is **redacted before it is written**: values under
+secret-shaped keys (token/secret/password/authorization/credential/
+key), ``kubectl.kubernetes.io/last-applied-configuration`` annotations
+(they embed whole objects, including anything a user pasted into them)
+and ``managedFields`` are dropped or masked.  Secrets themselves are
+never listed at all.
+
+The collector takes any client with the framework's ``list`` surface,
+so it runs unchanged against :class:`tpu_network_operator.kube.fake
+.FakeCluster` — which is how ``tests/test_telemetry.py`` asserts the
+bundle's contents file by file.
+
+Usage:
+    python tools/diag.py --kube-api http://... --namespace tpunet-system \
+        [--metrics-url http://...:8443/metrics] [--traces-url .../debug/traces] \
+        [--token-env TPUNET_KUBE_TOKEN] [--out tpunet-diag.tar.gz]
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import re
+import sys
+import tarfile
+import time
+from typing import Any, Dict, List, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+REDACTED = "**REDACTED**"
+# any mapping key matching this has its VALUE masked, recursively —
+# including ANY key ending in "key" (sshKey, signing_key, ...): over-
+# redacting a harmless field is cheap, leaking a credential is not
+SECRET_KEY_RE = re.compile(
+    r"(token|secret|password|passwd|authorization|credential|key$)",
+    re.IGNORECASE,
+)
+# metadata entries dropped outright (they embed whole foreign objects)
+DROP_KEYS = (
+    "managedFields",
+    "kubectl.kubernetes.io/last-applied-configuration",
+)
+# Bearer tokens / JWTs appearing inside free-form string values
+BEARER_RE = re.compile(r"(Bearer\s+)[A-Za-z0-9._~+/-]+=*")
+
+
+def redact(obj: Any) -> Any:
+    """Deep-copying redaction: secret-shaped keys masked, embedded
+    bearer tokens scrubbed from strings, managedFields/last-applied
+    dropped."""
+    if isinstance(obj, dict):
+        out: Dict[str, Any] = {}
+        for k, v in obj.items():
+            if k in DROP_KEYS:
+                continue
+            if SECRET_KEY_RE.search(str(k)):
+                out[k] = REDACTED
+            else:
+                out[k] = redact(v)
+        return out
+    if isinstance(obj, list):
+        return [redact(v) for v in obj]
+    if isinstance(obj, str):
+        return BEARER_RE.sub(r"\1" + REDACTED, obj)
+    return obj
+
+
+def _jdump(obj: Any) -> str:
+    return json.dumps(obj, indent=2, sort_keys=True) + "\n"
+
+
+def _safe_name(name: str) -> str:
+    """Cluster-supplied names become tarball member paths — never let
+    one traverse out of its directory (separators replaced, ``..``
+    sequences collapsed)."""
+    name = re.sub(r"[^A-Za-z0-9_.-]", "_", name)
+    return re.sub(r"\.\.+", "_", name) or "unnamed"
+
+
+def collect_files(
+    client,
+    namespace: str,
+    metrics_text: str = "",
+    traces_json: str = "",
+) -> Dict[str, str]:
+    """Gather every bundle member as {relative path: content}.  Each
+    section is best-effort: a forbidden or failing list yields an
+    ``errors.json`` entry instead of aborting the bundle — a support
+    bundle with holes beats no bundle mid-incident."""
+    from tpu_network_operator import __version__
+    from tpu_network_operator.agent import report as rpt
+    from tpu_network_operator.api.v1alpha1 import types as t
+
+    files: Dict[str, str] = {}
+    errors: Dict[str, str] = {}
+
+    def section(name, fn):
+        try:
+            fn()
+        except Exception as e:   # noqa: BLE001 — partial bundle > no bundle
+            errors[name] = f"{type(e).__name__}: {e}"
+
+    def policies():
+        items = client.list(t.API_VERSION, t.NetworkClusterPolicy.KIND)
+        files["policies.json"] = _jdump(redact(items))
+
+    def events():
+        items = client.list("v1", "Event", namespace=namespace)
+        files["events.json"] = _jdump(redact(items))
+
+    def peer_configmaps():
+        for cm in client.list("v1", "ConfigMap", namespace=namespace):
+            name = cm.get("metadata", {}).get("name", "")
+            if not name.startswith(rpt.PEER_CONFIGMAP_PREFIX):
+                continue   # only the operator's own peer lists; never
+                # co-located app config (could hold anything)
+            files[f"configmaps/{_safe_name(name)}.json"] = _jdump(
+                redact(cm)
+            )
+
+    def reports():
+        leases = client.list(
+            rpt.LEASE_API, "Lease", namespace=namespace,
+            label_selector={rpt.AGENT_LABEL: "true"},
+        )
+        for lease in leases:
+            node = _safe_name(
+                lease.get("spec", {}).get("holderIdentity", "")
+                or lease.get("metadata", {}).get("name", "")
+            )
+            files[f"reports/{node}.json"] = _jdump(redact(lease))
+            raw = (
+                lease.get("metadata", {}).get("annotations", {}) or {}
+            ).get(rpt.REPORT_ANNOTATION, "")
+            try:
+                rep = rpt.ProvisioningReport.from_json(raw)
+            except Exception:   # noqa: BLE001 — raw lease already captured
+                continue
+            if rep.telemetry is not None:
+                files[f"telemetry/{node}.json"] = _jdump(
+                    redact(rep.telemetry)
+                )
+
+    section("policies", policies)
+    section("events", events)
+    section("configmaps", peer_configmaps)
+    section("reports", reports)
+
+    # the endpoint bodies get the same guarantee as the object dumps:
+    # metric label values and span attributes come from error strings
+    # that can embed credentials — scrub bearer tokens from the raw
+    # text, and deep-redact the traces JSON when it parses
+    if metrics_text:
+        metrics_text = BEARER_RE.sub(r"\1" + REDACTED, metrics_text)
+        files["metrics.txt"] = metrics_text if metrics_text.endswith("\n") \
+            else metrics_text + "\n"
+    if traces_json:
+        try:
+            traces_json = _jdump(redact(json.loads(traces_json))).rstrip(
+                "\n"
+            )
+        except ValueError:
+            traces_json = BEARER_RE.sub(r"\1" + REDACTED, traces_json)
+        files["traces.json"] = traces_json if traces_json.endswith("\n") \
+            else traces_json + "\n"
+    if errors:
+        files["errors.json"] = _jdump(errors)
+
+    files["manifest.json"] = _jdump({
+        "tool": "tpunet-diag",
+        "operatorVersion": __version__,
+        "namespace": namespace,
+        "createdAt": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        ),
+        "files": sorted(f for f in files if f != "manifest.json"),
+        "redaction": (
+            "values under secret-shaped keys masked; managedFields and "
+            "last-applied-configuration dropped; bearer tokens scrubbed "
+            "from strings; Secrets never collected"
+        ),
+    })
+    return files
+
+
+def write_bundle(files: Dict[str, str], out_path: str) -> str:
+    with tarfile.open(out_path, "w:gz") as tar:
+        for name in sorted(files):
+            payload = files[name].encode()
+            info = tarfile.TarInfo(name=name)
+            info.size = len(payload)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(payload))
+    return out_path
+
+
+def collect_bundle(
+    client,
+    namespace: str,
+    out_path: str,
+    metrics=None,
+    tracer=None,
+    metrics_text: str = "",
+    traces_json: str = "",
+) -> List[str]:
+    """One-call collection: accepts live ``metrics``/``tracer`` objects
+    (in-process use and tests) or pre-fetched endpoint bodies (the CLI).
+    Returns the bundle's member names."""
+    if metrics is not None and not metrics_text:
+        metrics_text = metrics.render()
+    if tracer is not None and not traces_json:
+        traces_json = json.dumps({
+            "spans": tracer.snapshot(),
+            "traceIds": tracer.trace_ids(),
+        })
+    files = collect_files(
+        client, namespace,
+        metrics_text=metrics_text, traces_json=traces_json,
+    )
+    write_bundle(files, out_path)
+    return sorted(files)
+
+
+def _http_get(url: str, token: str = "") -> str:
+    import urllib.request
+
+    req = urllib.request.Request(url)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.read().decode()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpunet-diag",
+        description="collect a redacted tpunet support bundle",
+    )
+    ap.add_argument("--kube-api", default=os.environ.get(
+        "TPUNET_KUBE_URL", ""),
+        help="apiserver URL (default: in-cluster config)")
+    ap.add_argument("--namespace",
+                    default=os.environ.get("OPERATOR_NAMESPACE", "default"))
+    ap.add_argument("--metrics-url", default="",
+                    help="operator /metrics endpoint to snapshot")
+    ap.add_argument("--traces-url", default="",
+                    help="operator /debug/traces endpoint to snapshot")
+    ap.add_argument("--token-env", default="TPUNET_KUBE_TOKEN",
+                    help="env var holding the bearer token for the "
+                         "endpoints above (never passed on argv)")
+    ap.add_argument("--out", default="",
+                    help="bundle path (default tpunet-diag-<ts>.tar.gz)")
+    args = ap.parse_args(argv)
+
+    from tpu_network_operator.kube.client import ApiClient
+
+    token = os.environ.get(args.token_env, "")
+    if args.kube_api:
+        client = ApiClient(args.kube_api, token=token or None)
+    else:
+        client = ApiClient.in_cluster()
+
+    metrics_text = traces_json = ""
+    for url, attr in ((args.metrics_url, "metrics_text"),
+                      (args.traces_url, "traces_json")):
+        if not url:
+            continue
+        try:
+            body = _http_get(url, token)
+        except Exception as e:   # noqa: BLE001 — partial bundle > none
+            print(f"warning: fetch {url} failed: {e}", file=sys.stderr)
+            continue
+        if attr == "metrics_text":
+            metrics_text = body
+        else:
+            traces_json = body
+
+    out = args.out or time.strftime(
+        "tpunet-diag-%Y%m%d-%H%M%S.tar.gz", time.gmtime()
+    )
+    members = collect_bundle(
+        client, args.namespace, out,
+        metrics_text=metrics_text, traces_json=traces_json,
+    )
+    print(f"wrote {out} ({len(members)} files)")
+    for m in members:
+        print(f"  {m}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,10 +16,10 @@ AST checker covering the highest-signal subset:
   E711  comparison to None with ==/!=
   B011  assert on a non-empty tuple literal (always true)
   G004  f-string-interpolated log call (`log.info(f"...")`) in
-        controller/ and agent/ — those records must stay structured
-        (%-style lazy args) so the JSON formatter and log aggregation
-        keep a stable message template; also skips interpolation cost
-        on disabled levels
+        controller/, agent/, obs/, probe/ and kube/ — those records
+        must stay structured (%-style lazy args) so the JSON formatter
+        and log aggregation keep a stable message template; also skips
+        interpolation cost on disabled levels
 
 Zero third-party dependencies; exits 1 on any finding.  Run as
 `python tools/lint.py [paths...]` (defaults to the package, tests, tools
@@ -44,10 +44,15 @@ DEFAULT_TARGETS = [
 ]
 
 # G004 scope: the log streams the obs/ JSON formatter structures — an
-# f-string log call pre-interpolates the template away
+# f-string log call pre-interpolates the template away.  Every package
+# whose records reach the operator/agent processes is in scope (obs/,
+# probe/ and kube/ all log through those same handlers).
 STRUCTURED_LOG_DIRS = (
     "tpu_network_operator/controller",
     "tpu_network_operator/agent",
+    "tpu_network_operator/obs",
+    "tpu_network_operator/probe",
+    "tpu_network_operator/kube",
 )
 LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical"}
 LOGGER_NAMES = {"log", "logger", "logging"}

@@ -24,7 +24,10 @@ Phases:
    convergence at 20 nodes (tools/probe_bench.py — no TPU needed);
 10. observability bench: tracing/event overhead at p50 reconcile
     latency (<2% budget) + Event dedup proof (tools/obs_bench.py —
-    no TPU needed).
+    no TPU needed);
+11. dataplane telemetry bench: counter-sampling overhead at p50
+    monitor-tick latency (<2% budget) + rx-error-ramp label-gating
+    proof (tools/telemetry_bench.py — no TPU needed).
 
 Usage: python tools/perf_session.py [--out perf_session.jsonl]
 """
@@ -146,6 +149,14 @@ def main() -> int:
         maybe_run_phase(out, "obs-bench",
                   [py, "tools/obs_bench.py", "--policies", "25",
                    "--nodes", "20", "--out", "BENCH_obs.json"],
+                  timeout=600)
+        # 11. dataplane telemetry: NIC-counter sampling overhead at p50
+        # monitor-tick latency (acceptance budget < 2%) and the
+        # injected rx-error ramp flipping the readiness label within 3
+        # ticks, rolled up through the reconciler (no TPU, in-process)
+        maybe_run_phase(out, "telemetry-bench",
+                  [py, "tools/telemetry_bench.py", "--nodes", "20",
+                   "--interfaces", "4", "--out", "BENCH_telemetry.json"],
                   timeout=600)
     print(f"done -> {args.out}")
     return 0

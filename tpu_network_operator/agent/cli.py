@@ -29,6 +29,7 @@ from ..obs.trace import timed_phases
 from ..probe import prober as probe_defaults
 from . import netlink as nl
 from . import network as net
+from . import telemetry as telem
 from .gaudinet import write_gaudinet
 from .systemd_networkd import write_systemd_networkd
 from .tpu import bootstrap as tpu_bootstrap
@@ -84,6 +85,16 @@ class CmdConfig:
     # transport seam: tests/bench inject a probe.FakeFabric; None =
     # real UDP sockets
     probe_transport: Optional[object] = None
+    # dataplane telemetry (agent/telemetry.py): per-interface counter
+    # sampling + anomaly detection each monitor tick.  On by default —
+    # sampling is a handful of sysfs reads — with the thresholds
+    # projected from the CRD's tpuScaleOut.telemetry spec (0 = module
+    # defaults, the zero-sentinel convention)
+    telemetry_enabled: bool = True
+    telemetry_window: int = 0
+    telemetry_error_ratio: float = 0.0
+    telemetry_drop_rate: float = 0.0
+    telemetry_stall_ticks: int = 0
     # tracing (obs/): the provisioning attempt's trace ID — projected by
     # the operator (tpunet.dev/trace-id stamp → downward API →
     # TPUNET_TRACE_ID) so the agent's phase spans join the reconcile
@@ -246,6 +257,7 @@ def _publish_report(
     configs: Dict[str, net.NetworkConfiguration],
     coordinator: str,
     probe_runner=None,
+    telemetry=None,
 ) -> bool:
     """Write the per-node provisioning report Lease (VERDICT r3 #3).
     True when it landed (or reporting is off: nothing to sync)."""
@@ -268,6 +280,7 @@ def _publish_report(
         probe_mesh=probe_runner.export() if probe_runner else None,
         trace_id=trace_id,
         spans=spans,
+        telemetry=telemetry.export() if telemetry else None,
     )
     return rpt.write_report(client, config.report_namespace, rep)
 
@@ -275,6 +288,7 @@ def _publish_report(
 def _publish_failure_report(
     config: CmdConfig, error: str, probe_runner=None,
     configs: Optional[Dict[str, net.NetworkConfiguration]] = None,
+    telemetry=None,
 ) -> bool:
     """ok=False report on a hard provisioning failure: the reconciler
     shows the node's error in status.errors instead of an opaque
@@ -307,6 +321,10 @@ def _publish_failure_report(
             # the failure's phase spans are exactly the triage evidence
             trace_id=trace_id,
             spans=spans,
+            # counters are exactly the evidence a triager needs next
+            # (is the link down, or up-and-corrupting?)
+            telemetry=telemetry.export() if telemetry else None,
+            agent_version=rpt.agent_version_string(),
         ),
     )
 
@@ -373,11 +391,21 @@ def _degradation_error(bad: List[str]) -> str:
     """status.errors text for a degradation set.  Names the actual
     failure kind: an operator triaging 'interfaces degraded' inspects
     local NICs — wrong tree when the links are fine and the probe mesh
-    is below quorum."""
-    ifaces = [b for b in bad if b != PROBE_DEGRADED]
+    is below quorum, or the links pass traffic but the counters show it
+    arriving corrupted (telemetry anomalies)."""
+    ifaces = [
+        b for b in bad
+        if b != PROBE_DEGRADED and not b.startswith(telem.DEGRADED_PREFIX)
+    ]
+    anomalies = [
+        b[len(telem.DEGRADED_PREFIX):] for b in bad
+        if b.startswith(telem.DEGRADED_PREFIX)
+    ]
     parts = []
     if ifaces:
         parts.append("interfaces degraded: " + ",".join(ifaces))
+    if anomalies:
+        parts.append("telemetry anomalies: " + ",".join(anomalies))
     if PROBE_DEGRADED in bad:
         parts.append("probe mesh below quorum")
     return "; ".join(parts)
@@ -486,6 +514,7 @@ def _on_probe_transition(
     error = _degradation_error(sorted(bad | {PROBE_DEGRADED}))
     _publish_failure_report(
         config, error, probe_runner=runner, configs=configs,
+        telemetry=monitor_state.telemetry if monitor_state else None,
     )
     # SAME message construction as the monitor tick's emit: when the
     # tick re-detects this degradation it produces an identical Event
@@ -901,6 +930,11 @@ class _MonitorState:
     # retried, not heartbeat-renewed into a bare Lease the reconciler
     # can never see
     report_synced: bool = True
+    # dataplane telemetry sampler: counter windows must survive between
+    # ticks (deltas need history), so the monitor builds it once per
+    # provisioning attempt and keeps it here.  Tests/bench pre-seed it
+    # with a manual-clock instance.
+    telemetry: Optional[telem.TelemetryMonitor] = None
 
 
 def _monitor_tick(
@@ -912,10 +946,26 @@ def _monitor_tick(
     probe_runner=None,
 ) -> None:
     """One continuous-readiness pass: re-verify the data plane (links,
-    L3 addressing, probe-mesh quorum), retract the NFD label + publish
-    an ok=False report on degradation, restore both on recovery, and
-    heartbeat the report Lease on healthy passes."""
+    L3 addressing, counter telemetry, probe-mesh quorum), retract the
+    NFD label + publish an ok=False report on degradation, restore both
+    on recovery, and heartbeat the report Lease on healthy passes."""
     bad = net.verify_configured(configs, config.ops, config.mode == L3)
+    if config.telemetry_enabled and configs:
+        # counter telemetry: sample every provisioned interface, and
+        # let anomalies (error-ratio, drop spikes, counter stalls) join
+        # the degradation list — an up-but-corrupting link retracts the
+        # label exactly like a downed one.  Window-delta detection is
+        # the damping (see agent/telemetry.py).
+        if state.telemetry is None:
+            state.telemetry = telem.TelemetryMonitor(
+                window=config.telemetry_window,
+                error_ratio=config.telemetry_error_ratio,
+                drop_rate=config.telemetry_drop_rate,
+                stall_ticks=config.telemetry_stall_ticks,
+            )
+        bad = sorted(
+            set(bad) | set(state.telemetry.sample(configs, config.ops))
+        )
     if probe_runner is not None and not probe_runner.ready():
         # below-quorum fabric connectivity is a degradation exactly like
         # a downed link: the gate already debounced it
@@ -933,6 +983,7 @@ def _monitor_tick(
             state.report_synced = _publish_failure_report(
                 config, _degradation_error(bad),
                 probe_runner=probe_runner, configs=configs,
+                telemetry=state.telemetry,
             )
             _emit_node_event(
                 config, "Warning", "ReadinessRetracted",
@@ -941,7 +992,8 @@ def _monitor_tick(
         else:
             log.info("data plane recovered — restoring readiness")
             state.report_synced = _publish_report(
-                config, configs, coordinator, probe_runner=probe_runner
+                config, configs, coordinator, probe_runner=probe_runner,
+                telemetry=state.telemetry,
             )
             if probe_runner is None or probe_runner.ready():
                 # same TOCTOU guard as the steady branch: the gate may
@@ -954,24 +1006,30 @@ def _monitor_tick(
                     config, "Normal", "ReadinessRestored",
                     "data plane recovered; readiness label restored",
                 )
-    elif not state.report_synced or probe_runner is not None:
-        # ONE publish path for two reasons to rewrite the report body:
+    elif (
+        not state.report_synced
+        or probe_runner is not None
+        or state.telemetry is not None
+    ):
+        # ONE publish path for three reasons to rewrite the report body:
         # a failed earlier publish must be retried until the
         # cluster-visible report matches reality (renewing a stale body
         # would keep the WRONG report fresh forever), and a live mesh
-        # must republish fresh probe stats every tick in BOTH
-        # directions — renewTime-only heartbeats would freeze the
-        # connectivity matrix and the tpunet_probe_* gauges at their
-        # last-transition snapshot, worst exactly while an operator is
-        # triaging a worsening outage.
+        # or telemetry sampler must republish fresh stats every tick in
+        # BOTH directions — renewTime-only heartbeats would freeze the
+        # connectivity matrix, the tpunet_probe_* gauges, and the
+        # counter rollups at their last-transition snapshot, worst
+        # exactly while an operator is triaging a worsening outage.
         state.report_synced = (
             _publish_report(
-                config, configs, coordinator, probe_runner=probe_runner
+                config, configs, coordinator, probe_runner=probe_runner,
+                telemetry=state.telemetry,
             )
             if not bad
             else _publish_failure_report(
                 config, _degradation_error(bad),
                 probe_runner=probe_runner, configs=configs,
+                telemetry=state.telemetry,
             )
         )
         if (
@@ -1097,6 +1155,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--probe-recovery-threshold", type=int,
                    default=probe_defaults.DEFAULT_RECOVERY_THRESHOLD,
                    help="consecutive healthy rounds before it is restored")
+    p.add_argument("--telemetry", dest="telemetry_enabled", default=True,
+                   type=_parse_strict_bool,
+                   help="sample per-interface counters each recheck and "
+                        "gate readiness on anomaly detection "
+                        "(error-ratio, drop spikes, counter stalls)")
+    p.add_argument("--telemetry-window", type=int,
+                   default=telem.DEFAULT_WINDOW,
+                   help="sliding window of counter samples per interface")
+    p.add_argument("--telemetry-error-ratio", type=float,
+                   default=telem.DEFAULT_ERROR_RATIO,
+                   help="error/(error+packet) window ratio that counts "
+                        "as a dataplane anomaly")
+    p.add_argument("--telemetry-drop-rate", type=float,
+                   default=telem.DEFAULT_DROP_RATE,
+                   help="dropped packets per second over the window "
+                        "that counts as a drop spike")
+    p.add_argument("--telemetry-stall-ticks", type=int,
+                   default=telem.DEFAULT_STALL_TICKS,
+                   help="min window depth before an oper-up interface "
+                        "with a frozen rx counter counts as stalled")
     p.add_argument("--trace-id", default="",
                    help="trace ID for this provisioning attempt "
                         "(default: TPUNET_TRACE_ID env — the operator's "
@@ -1177,6 +1255,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         probe_expected_peers=args.probe_expected_peers,
         probe_fail_threshold=args.probe_fail_threshold,
         probe_recovery_threshold=args.probe_recovery_threshold,
+        telemetry_enabled=args.telemetry_enabled,
+        telemetry_window=args.telemetry_window,
+        telemetry_error_ratio=args.telemetry_error_ratio,
+        telemetry_drop_rate=args.telemetry_drop_rate,
+        telemetry_stall_ticks=args.telemetry_stall_ticks,
         trace_id=(
             args.trace_id or os.environ.get("TPUNET_TRACE_ID", "")
         ),

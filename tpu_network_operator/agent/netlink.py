@@ -402,6 +402,117 @@ def route_list() -> List[Dict]:
     return out
 
 
+# -- per-interface counters (sysfs) -------------------------------------------
+
+# the counter set the dataplane telemetry pipeline samples each monitor
+# tick (agent/telemetry.py); all are cumulative kernel counters from
+# /sys/class/net/<if>/statistics/* except carrier_changes, which lives
+# one level up (uapi: rtnl_link_stats64 + IFLA_CARRIER_CHANGES)
+IFACE_COUNTERS = (
+    "rx_bytes", "tx_bytes",
+    "rx_packets", "tx_packets",
+    "rx_errors", "tx_errors",
+    "rx_dropped", "tx_dropped",
+    "carrier_changes",
+)
+
+
+def _sysfs_root() -> str:
+    # the same seam network.py's discovery glob honors (SYSFS_ROOT,
+    # ref network.go:76-82) so a fake sysfs tree redirects both
+    return os.environ.get("SYSFS_ROOT", "/sys/")
+
+
+def read_iface_counters(name: str) -> Dict[str, int]:
+    """One sample of the interface's cumulative counters.
+
+    Raises :class:`NetlinkError` (ENODEV) when the interface is gone —
+    the same contract as :func:`link_by_name`, so the telemetry sampler
+    degrades exactly like the link verifier.  An individual unreadable
+    counter file reads as 0 (not every driver exports every counter)."""
+    base = os.path.join(_sysfs_root(), "class/net", name)
+    if not os.path.isdir(base):
+        raise NetlinkError(19, f"netlink: no such device: {name}")
+    out: Dict[str, int] = {}
+    for counter in IFACE_COUNTERS:
+        path = (
+            os.path.join(base, counter)
+            if counter == "carrier_changes"
+            else os.path.join(base, "statistics", counter)
+        )
+        try:
+            with open(path) as f:
+                out[counter] = int(f.read().strip())
+        except (OSError, ValueError):
+            out[counter] = 0
+    return out
+
+
+def _read_carrier_changes(name: str) -> int:
+    try:
+        path = os.path.join(
+            _sysfs_root(), "class/net", name, "carrier_changes"
+        )
+        with open(path) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return 0
+
+
+# /proc/net/dev columns after the "iface:" prefix — rx first, tx second
+# (uapi: net/core/net-procfs.c dev_seq_printf_stats)
+_PROC_NET_DEV_RX = {"rx_bytes": 0, "rx_packets": 1, "rx_errors": 2,
+                    "rx_dropped": 3}
+_PROC_NET_DEV_TX = {"tx_bytes": 8, "tx_packets": 9, "tx_errors": 10,
+                    "tx_dropped": 11}
+
+
+def read_all_counters(names) -> Dict[str, Dict[str, int]]:
+    """Bulk counter sample: ONE ``/proc/net/dev`` parse covers every
+    interface's rx/tx counters (node-exporter's trick — per-file sysfs
+    reads cost ~9 syscall round-trips per interface per tick, the bulk
+    read costs one for the whole node), plus one sysfs read per
+    interface for ``carrier_changes`` (not in /proc/net/dev).
+
+    Interfaces that are gone are simply absent from the result (the
+    per-interface :func:`read_iface_counters` contract of raising is
+    awkward for a bulk read).  When a ``SYSFS_ROOT`` fake tree is
+    active, /proc is NOT consulted — the fake tree is authoritative —
+    and everything falls back to per-interface sysfs reads."""
+    table: Dict[str, Dict[str, int]] = {}
+    if not os.environ.get("SYSFS_ROOT", ""):
+        try:
+            with open("/proc/net/dev") as f:
+                lines = f.read().splitlines()[2:]   # two header lines
+            for line in lines:
+                iface, _, rest = line.partition(":")
+                cols = rest.split()
+                if len(cols) < 12:
+                    continue
+                row = {
+                    c: int(cols[i]) for c, i in _PROC_NET_DEV_RX.items()
+                }
+                row.update(
+                    (c, int(cols[i])) for c, i in _PROC_NET_DEV_TX.items()
+                )
+                table[iface.strip()] = row
+        except (OSError, ValueError):
+            table = {}
+    out: Dict[str, Dict[str, int]] = {}
+    for name in names:
+        row = table.get(name)
+        if row is not None:
+            counters = dict(row)
+            counters["carrier_changes"] = _read_carrier_changes(name)
+            out[name] = counters
+        else:
+            try:
+                out[name] = read_iface_counters(name)
+            except NetlinkError:
+                continue
+    return out
+
+
 # -- link event subscription (echo wait) --------------------------------------
 
 
@@ -469,3 +580,5 @@ class LinkOps:
     route_append: callable = route_append
     route_list: callable = route_list
     subscribe: callable = LinkSubscription
+    iface_counters: callable = read_iface_counters
+    all_counters: callable = read_all_counters

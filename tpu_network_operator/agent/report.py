@@ -64,9 +64,24 @@ class ProvisioningReport:
     # controller reconcile and the agent provisioning as ONE trace
     trace_id: str = ""
     spans: Optional[List[Dict]] = None
+    # dataplane telemetry (agent/telemetry.py): latest per-interface
+    # counter sample + window rates ({"interfaces": {name: {...}}}) —
+    # the reconciler folds these into status.telemetry and the
+    # tpunet_iface_* metric families
+    telemetry: Optional[Dict] = None
+    # reporting agent's package version, for fleet-wide skew visibility
+    # (status.agentVersions); "" from agents predating the field
+    agent_version: str = ""
 
     def to_json(self) -> str:
-        return json.dumps(asdict(self), sort_keys=True)
+        # a shallow field dict, not dataclasses.asdict: asdict deep-
+        # copies every nested container (the telemetry/probe payloads),
+        # and this runs on every monitor-tick publish — json.dumps
+        # never mutates, so the copy bought nothing
+        return json.dumps(
+            {f.name: getattr(self, f.name) for f in fields(self)},
+            sort_keys=True,
+        )
 
     @staticmethod
     def from_json(raw: str) -> "ProvisioningReport":
@@ -87,7 +102,7 @@ class ProvisioningReport:
         })
         for field_name in ("node", "policy", "backend", "mode",
                            "coordinator", "error", "probe_endpoint",
-                           "trace_id"):
+                           "trace_id", "agent_version"):
             if not isinstance(getattr(rep, field_name), str):
                 raise ValueError(f"report field {field_name!r} not a string")
         for field_name in ("interfaces_configured", "interfaces_total"):
@@ -99,6 +114,8 @@ class ProvisioningReport:
             raise ValueError("report field 'dcn_interfaces' not a str list")
         if rep.probe is not None and not isinstance(rep.probe, dict):
             raise ValueError("report field 'probe' not an object")
+        if rep.telemetry is not None and not isinstance(rep.telemetry, dict):
+            raise ValueError("report field 'telemetry' not an object")
         if rep.spans is not None and (
             not isinstance(rep.spans, list)
             or not all(isinstance(s, dict) for s in rep.spans)
@@ -134,6 +151,17 @@ def coordinator_reachable(address: str, timeout: float = 3.0) -> bool:
             return True
         log.warning("coordinator %s unreachable: %s", address, e)
         return False
+
+
+def agent_version_string() -> str:
+    """This agent's package version — stamped into every report it
+    writes so the controller can surface fleet-wide version skew."""
+    try:
+        from .. import __version__
+
+        return __version__
+    except Exception:   # noqa: BLE001 — version is advisory
+        return ""
 
 
 def lease_name(node: str) -> str:
@@ -254,6 +282,7 @@ def report_from_result(
     probe_mesh: Optional[Dict] = None,
     trace_id: str = "",
     spans: Optional[List[Dict]] = None,
+    telemetry: Optional[Dict] = None,
 ) -> ProvisioningReport:
     """Assemble the report from the agent's post-pass state.
 
@@ -263,7 +292,8 @@ def report_from_result(
     explicit failure report when the gate degrades, so the initial
     provisioning report stays a statement about provisioning.
     ``trace_id``/``spans`` carry the provisioning attempt's trace back
-    to the controller (obs/ stitching)."""
+    to the controller (obs/ stitching); ``telemetry`` the latest
+    per-interface counter sample (TelemetryMonitor.export())."""
     import os
 
     from .network import usable_interfaces
@@ -294,4 +324,6 @@ def report_from_result(
         probe=probe_mesh,
         trace_id=trace_id,
         spans=spans,
+        telemetry=telemetry,
+        agent_version=agent_version_string(),
     )

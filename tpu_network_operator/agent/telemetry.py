@@ -1,0 +1,293 @@
+"""Dataplane telemetry: per-interface counter sampling + anomaly detection.
+
+The probe mesh (probe/) proves packets cross the fabric; this module
+watches the *quality* of the paths that already pass: a scale-out NIC
+that is up and probe-reachable can silently accumulate rx/tx errors,
+drops, or carrier flaps that will degrade HCCL/JAX collectives long
+before a probe misses.  Each idle-monitor tick samples the kernel's
+cumulative counters (``/sys/class/net/<if>/statistics``, via the
+:class:`~.netlink.LinkOps` seam so tests inject fakes), keeps a sliding
+window of samples per interface, derives deltas/rates over the window,
+and flags three anomaly classes:
+
+* ``error-ratio`` — (rx+tx) error delta vs packet delta over the window
+  exceeds the threshold (default 1%): a dirty link corrupting frames;
+* ``drop-spike`` — (rx+tx) dropped packets per second over the window
+  exceeds the threshold (default 100/s): queue overrun / ring exhaustion;
+* ``counter-stall`` — the link reports oper-up but the rx packet counter
+  has not moved across a FULL window on an interface that previously
+  carried traffic: a silently blackholed path.
+
+Anomalous interfaces join the monitor's degradation list
+(``telemetry:<iface>:<kind>`` entries), so the ``tpu-scale-out`` label
+rides the established retract/restore path; the full per-interface
+sample rides the report Lease for the reconciler's fleet rollups.
+
+Detection is window-delta based, which is also the damping: a
+single-tick error burst stays visible (and the label stays retracted)
+until the window slides past it — recovery is therefore bounded by
+``window`` ticks after counters go quiet, never instant off one clean
+sample.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from . import netlink as nl
+
+log = logging.getLogger("tpunet.agent")
+
+# defaults aliased by api/v1alpha1/types.py (the CRD layer) and
+# projected as agent flags — one copy of the contract, like the probe
+# defaults
+DEFAULT_WINDOW = 5            # samples per interface (≈ ticks of history)
+DEFAULT_ERROR_RATIO = 0.01    # errors / (errors + packets) over the window
+DEFAULT_DROP_RATE = 100.0     # dropped packets per second over the window
+DEFAULT_STALL_TICKS = 3       # min window depth before a stall verdict
+
+ANOMALY_ERROR_RATIO = "error-ratio"
+ANOMALY_DROP_SPIKE = "drop-spike"
+ANOMALY_STALL = "counter-stall"
+
+# degradation-list namespace (agent/cli.py routes these into the report
+# error text separately from plain interface names)
+DEGRADED_PREFIX = "telemetry:"
+
+
+def error_ratio(err_delta: int, pkt_delta: int) -> float:
+    """Errors as a fraction of frames seen.  Errored frames usually do
+    NOT count into rx/tx_packets, so the denominator is their sum — a
+    dead link ramping only errors reads 1.0, a clean busy link 0.0."""
+    return err_delta / max(err_delta + pkt_delta, 1)
+
+
+class InterfaceWindow:
+    """Sliding window of counter samples for ONE interface."""
+
+    def __init__(self, window: int = DEFAULT_WINDOW):
+        self.samples: deque = deque(maxlen=max(2, int(window)))
+        # window deltas, memoized per observe(): both the anomaly check
+        # and the report export need them, and this runs inside the
+        # monitor tick's latency budget
+        self._delta_cache: Optional[Dict[str, float]] = None
+
+    def observe(self, ts: float, counters: Dict[str, int]) -> None:
+        """Append one sample.  Takes ownership of ``counters`` (every
+        reader builds a fresh dict per call; copying again here would
+        tax the monitor tick for nothing)."""
+        if self.samples:
+            _, last = self.samples[-1]
+            if any(
+                counters.get(c, 0) < last.get(c, 0)
+                for c in nl.IFACE_COUNTERS
+            ):
+                # a counter moved backwards: driver reload / counter
+                # wrap / agent restart re-reading a replaced NIC.  The
+                # old window's deltas are meaningless — reseed rather
+                # than report a giant negative (or bogus huge) rate
+                self.samples.clear()
+        self.samples.append((ts, counters))
+        self._delta_cache = None
+
+    def _deltas(self) -> Optional[Dict[str, float]]:
+        """(per-counter delta, elapsed seconds) over the window, or
+        None before the second sample (no delta to judge yet)."""
+        if len(self.samples) < 2:
+            return None
+        if self._delta_cache is not None:
+            return self._delta_cache
+        t0, first = self.samples[0]
+        t1, last = self.samples[-1]
+        out = {
+            c: float(last.get(c, 0) - first.get(c, 0))
+            for c in nl.IFACE_COUNTERS
+        }
+        out["elapsed"] = max(t1 - t0, 1e-9)
+        self._delta_cache = out
+        return out
+
+    def export(self) -> Dict[str, object]:
+        """Wire form for the report Lease: latest cumulative counters
+        plus window rates/ratio (camelCase keys, report convention)."""
+        _, latest = self.samples[-1]
+        out: Dict[str, object] = {
+            "rxBytes": latest.get("rx_bytes", 0),
+            "txBytes": latest.get("tx_bytes", 0),
+            "rxPackets": latest.get("rx_packets", 0),
+            "txPackets": latest.get("tx_packets", 0),
+            "rxErrors": latest.get("rx_errors", 0),
+            "txErrors": latest.get("tx_errors", 0),
+            "rxDropped": latest.get("rx_dropped", 0),
+            "txDropped": latest.get("tx_dropped", 0),
+            "carrierChanges": latest.get("carrier_changes", 0),
+        }
+        d = self._deltas()
+        if d is not None:
+            elapsed = d["elapsed"]
+            out["rxBytesPerSec"] = round(d["rx_bytes"] / elapsed, 3)
+            out["txBytesPerSec"] = round(d["tx_bytes"] / elapsed, 3)
+            out["errorRatio"] = round(error_ratio(
+                int(d["rx_errors"] + d["tx_errors"]),
+                int(d["rx_packets"] + d["tx_packets"]),
+            ), 6)
+        return out
+
+    def anomalies(
+        self,
+        oper_up: bool,
+        error_ratio_threshold: float,
+        drop_rate_threshold: float,
+        stall_ticks: int,
+    ) -> List[str]:
+        d = self._deltas()
+        if d is None:
+            return []
+        out: List[str] = []
+        err_delta = int(d["rx_errors"] + d["tx_errors"])
+        pkt_delta = int(d["rx_packets"] + d["tx_packets"])
+        if err_delta and error_ratio(err_delta, pkt_delta) \
+                >= error_ratio_threshold:
+            out.append(ANOMALY_ERROR_RATIO)
+        if (d["rx_dropped"] + d["tx_dropped"]) / d["elapsed"] \
+                >= drop_rate_threshold:
+            out.append(ANOMALY_DROP_SPIKE)
+        _, latest = self.samples[-1]
+        if (
+            oper_up
+            and len(self.samples) >= max(stall_ticks, 2)
+            and d["rx_packets"] == 0
+            and latest.get("rx_packets", 0) > 0
+        ):
+            # oper-up, carried traffic before, nothing received across
+            # the whole window: silently blackholed.  The prior-traffic
+            # requirement keeps legitimately idle interfaces (freshly
+            # provisioned, no job yet) out of the verdict.
+            out.append(ANOMALY_STALL)
+        return out
+
+
+class TelemetryMonitor:
+    """Per-interface windows + the monitor-tick entry point.
+
+    Lives on the agent's cross-tick ``_MonitorState`` so window history
+    survives between ticks; ``clock`` is injectable for tests/bench."""
+
+    def __init__(
+        self,
+        window: int = 0,
+        error_ratio: float = 0.0,
+        drop_rate: float = 0.0,
+        stall_ticks: int = 0,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        import time
+
+        # <= 0 = default, matching the CRD's zero-sentinel convention
+        # so the projected flags can pass raw spec values through (the
+        # agent never trusts operator input — a negative threshold
+        # would flag everything or nothing)
+        self.window = int(window) if window > 0 else DEFAULT_WINDOW
+        self.error_ratio = (
+            float(error_ratio) if error_ratio > 0 else DEFAULT_ERROR_RATIO
+        )
+        self.drop_rate = (
+            float(drop_rate) if drop_rate > 0 else DEFAULT_DROP_RATE
+        )
+        self.stall_ticks = (
+            int(stall_ticks) if stall_ticks > 0 else DEFAULT_STALL_TICKS
+        )
+        self._clock = clock or time.monotonic
+        self._ifaces: Dict[str, InterfaceWindow] = {}
+        # last sample's anomaly kinds per interface — exported in the
+        # report so the reconciler's rollup sees WHICH interfaces are
+        # anomalous, not just that the label dropped
+        self._anomalies: Dict[str, List[str]] = {}
+        # the monitor thread samples; the probe gate's transition hook
+        # exports from the PROBING thread (its time-critical failure
+        # report carries the counters) — unsynchronized, a concurrent
+        # tick would mutate _ifaces mid-iteration and the hook's report
+        # would be silently dropped
+        self._lock = threading.Lock()
+
+    def sample(self, configs, ops) -> List[str]:
+        """One tick: read every provisioned interface's counters,
+        advance its window, return the degradation-list entries
+        (``telemetry:<iface>:<kind>``, sorted).  A counter-read failure
+        drops the interface's window (the link verifier owns dead-link
+        detection) and never fails the tick."""
+        now = self._clock()
+        # one bulk read for the whole node when the ops table offers it
+        # (read_all_counters: a single /proc/net/dev parse instead of 9
+        # sysfs files per interface); per-interface reads otherwise
+        bulk_reader = getattr(ops, "all_counters", None)
+        bulk = None
+        if callable(bulk_reader):
+            try:
+                bulk = bulk_reader(list(configs))
+            except Exception as e:   # noqa: BLE001 — sampling is advisory
+                # fall back to per-interface reads (bulk stays None):
+                # an empty bulk dict would read as "every interface
+                # gone", wiping the windows AND any active anomaly —
+                # one transient read failure must not restore the label
+                # of a still-erroring NIC
+                log.debug("bulk counter sample failed: %s", e)
+        with self._lock:
+            return self._sample_locked(configs, ops, now, bulk)
+
+    def _sample_locked(self, configs, ops, now, bulk) -> List[str]:
+        bad: List[str] = []
+        # insertion order, not sorted(): the caller sorts the combined
+        # degradation list anyway, and this loop sits inside the
+        # monitor tick's latency budget
+        for name in configs:
+            if bulk is not None:
+                counters = bulk.get(name)
+                if counters is None:
+                    self._ifaces.pop(name, None)
+                    self._anomalies.pop(name, None)
+                    continue
+            else:
+                try:
+                    counters = ops.iface_counters(name)
+                except Exception as e:   # noqa: BLE001 — advisory
+                    log.debug("counter sample failed for %r: %s", name, e)
+                    self._ifaces.pop(name, None)
+                    self._anomalies.pop(name, None)
+                    continue
+            win = self._ifaces.get(name)
+            if win is None:
+                win = self._ifaces[name] = InterfaceWindow(self.window)
+            win.observe(now, counters)
+            oper_up = bool(getattr(configs[name].link, "oper_up", False))
+            kinds = win.anomalies(
+                oper_up, self.error_ratio, self.drop_rate, self.stall_ticks
+            )
+            self._anomalies[name] = kinds
+            bad += [f"{DEGRADED_PREFIX}{name}:{kind}" for kind in kinds]
+        # interfaces no longer provisioned must not hold stale windows
+        for name in [n for n in self._ifaces if n not in configs]:
+            del self._ifaces[name]
+            self._anomalies.pop(name, None)
+        return sorted(bad)
+
+    def export(self) -> Optional[Dict[str, object]]:
+        """Report-Lease wire form, or None before the first sample.
+        Thread-safe: the probe transition hook calls this from the
+        probing thread while the monitor thread may be mid-sample."""
+        ifaces: Dict[str, Dict[str, object]] = {}
+        with self._lock:
+            for name, win in sorted(self._ifaces.items()):
+                if not win.samples:
+                    continue
+                out = win.export()
+                anomalies = self._anomalies.get(name)
+                if anomalies:
+                    out["anomalies"] = list(anomalies)
+                ifaces[name] = out
+        if not ifaces:
+            return None
+        return {"interfaces": ifaces}

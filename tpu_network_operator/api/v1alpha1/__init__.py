@@ -11,10 +11,13 @@ from .types import (  # noqa: F401
     CONFIG_TYPE_GAUDI_SO,
     CONFIG_TYPE_TPU_SO,
     CONDITION_DATAPLANE_DEGRADED,
+    CONDITION_TELEMETRY_DEGRADED,
     GaudiScaleOutSpec,
     NodeProbeStatus,
     PolicyCondition,
     ProbeSpec,
+    TelemetrySpec,
+    TelemetryStatus,
     TpuScaleOutSpec,
     NetworkClusterPolicy,
     NetworkClusterPolicyList,

@@ -205,6 +205,62 @@ def openapi_schema() -> Dict[str, Any]:
                                     },
                                 },
                             },
+                            "telemetry": {
+                                "type": "object",
+                                "description": (
+                                    "Dataplane counter telemetry: each "
+                                    "agent samples per-interface rx/tx "
+                                    "counters every recheck and gates "
+                                    "node readiness on anomaly "
+                                    "detection (on by default)."
+                                ),
+                                "properties": {
+                                    "enabled": {"type": "boolean"},
+                                    "window": {
+                                        "type": "integer",
+                                        "minimum": 0,
+                                        "maximum": 100,
+                                        "description": (
+                                            "Counter samples kept per "
+                                            "interface (0 = 5; 1 is "
+                                            "rejected — no delta)."
+                                        ),
+                                    },
+                                    "errorRatio": {
+                                        "type": "number",
+                                        "minimum": 0,
+                                        "maximum": 1,
+                                        "description": (
+                                            "errors/(errors+packets) "
+                                            "over the window that "
+                                            "counts as an anomaly "
+                                            "(0 = 0.01)."
+                                        ),
+                                    },
+                                    "dropRate": {
+                                        "type": "number",
+                                        "minimum": 0,
+                                        "description": (
+                                            "Dropped packets/second "
+                                            "over the window that "
+                                            "counts as a drop spike "
+                                            "(0 = 100)."
+                                        ),
+                                    },
+                                    "stallTicks": {
+                                        "type": "integer",
+                                        "minimum": 0,
+                                        "maximum": 100,
+                                        "description": (
+                                            "Min window depth before "
+                                            "an oper-up interface with "
+                                            "a frozen rx counter "
+                                            "counts as stalled "
+                                            "(0 = 3)."
+                                        ),
+                                    },
+                                },
+                            },
                         },
                     },
                 },
@@ -258,6 +314,36 @@ def openapi_schema() -> Dict[str, Any]:
                                 "lastTransitionTime": {"type": "string"},
                             },
                         },
+                    },
+                    "telemetry": {
+                        "type": "object",
+                        "description": (
+                            "Fleet rollup of the agents' NIC counter "
+                            "telemetry."
+                        ),
+                        "properties": {
+                            "nodesReporting": {"type": "integer"},
+                            "anomalousNodes": {
+                                "type": "array",
+                                "items": {"type": "string"},
+                            },
+                            "anomalies": {
+                                "type": "array",
+                                "items": {"type": "string"},
+                            },
+                            "worstNode": {"type": "string"},
+                            "worstErrorRatio": {"type": "number"},
+                            "aggregateErrorRatio": {"type": "number"},
+                        },
+                    },
+                    "agentVersions": {
+                        "type": "object",
+                        "additionalProperties": {"type": "integer"},
+                        "description": (
+                            "Agent package version -> node count, from "
+                            "the report Leases (version-skew "
+                            "visibility)."
+                        ),
                     },
                 },
             },

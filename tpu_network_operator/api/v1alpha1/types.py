@@ -18,7 +18,7 @@ re-sanitization (defense in depth, ref SURVEY.md §5.6).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from ..apimachinery import KubeObject, ObjectMeta, j
 
@@ -63,6 +63,17 @@ PROBE_STATE_DEGRADED = "Degraded"
 PROBE_STATE_QUARANTINED = "Quarantined"
 CONDITION_DATAPLANE_DEGRADED = "DataplaneDegraded"
 
+# dataplane telemetry defaults: aliased from the agent sampler (one
+# copy of the contract, like the probe defaults above)
+from ...agent import telemetry as _telemetry_defaults  # noqa: E402
+
+DEFAULT_TELEMETRY_WINDOW = _telemetry_defaults.DEFAULT_WINDOW
+DEFAULT_TELEMETRY_ERROR_RATIO = _telemetry_defaults.DEFAULT_ERROR_RATIO
+DEFAULT_TELEMETRY_DROP_RATE = _telemetry_defaults.DEFAULT_DROP_RATE
+DEFAULT_TELEMETRY_STALL_TICKS = _telemetry_defaults.DEFAULT_STALL_TICKS
+
+CONDITION_TELEMETRY_DEGRADED = "DataplaneTelemetryDegraded"
+
 
 @dataclass
 class ProbeSpec:
@@ -98,6 +109,32 @@ class ProbeSpec:
     # consecutive healthy rounds before it is restored — label flap
     # damping (0 = DEFAULT_PROBE_RECOVERY_THRESHOLD)
     recovery_threshold: int = j("recoveryThreshold", 0)
+
+
+@dataclass
+class TelemetrySpec:
+    """Dataplane counter telemetry knobs (``telemetry:`` under
+    ``tpuScaleOut``).  On by default: every agent samples per-interface
+    rx/tx counters each monitor recheck, reports them in its Lease, and
+    retracts the readiness label on anomaly (error-ratio, drop spikes,
+    counter-stall-while-oper-up) via the established retract/restore
+    path.  All threshold zeroes mean "agent default" (the mutating
+    webhook pins them, matching the probe spec's contract)."""
+
+    enabled: bool = j("enabled", True)
+    # sliding window of counter samples per interface (0 = 5); also the
+    # recovery bound — anomalies stay flagged until the window slides
+    # past the burst
+    window: int = j("window", 0)
+    # errors/(errors+packets) over the window that counts as an anomaly
+    # (0 = 0.01)
+    error_ratio: float = j("errorRatio", 0.0)
+    # dropped packets per second over the window that counts as a drop
+    # spike (0 = 100)
+    drop_rate: float = j("dropRate", 0.0)
+    # min window depth before an oper-up interface with a frozen rx
+    # counter counts as stalled (0 = 3)
+    stall_ticks: int = j("stallTicks", 0)
 
 
 @dataclass
@@ -155,6 +192,9 @@ class TpuScaleOutSpec:
     # Dataplane probe mesh: active peer-to-peer DCN validation gating
     # node readiness (probe/ subsystem).
     probe: ProbeSpec = j("probe", factory=ProbeSpec)
+    # Dataplane counter telemetry: passive NIC-counter sampling +
+    # anomaly gating (agent/telemetry.py); on by default.
+    telemetry: TelemetrySpec = j("telemetry", factory=TelemetrySpec)
 
 
 @dataclass
@@ -193,6 +233,25 @@ class NodeProbeStatus:
 
 
 @dataclass
+class TelemetryStatus:
+    """Fleet rollup of the agents' counter telemetry — the policy-level
+    answer to "is any NIC silently corrupting traffic" (aggregated from
+    report Leases by the reconciler; no reference analog)."""
+
+    # nodes whose latest report carried a telemetry sample
+    nodes_reporting: int = j("nodesReporting", 0)
+    # nodes with at least one active interface anomaly
+    anomalous_nodes: List[str] = j("anomalousNodes", factory=list)
+    # flat anomaly list: "node/iface: kind" (bounded; triage entry point)
+    anomalies: List[str] = j("anomalies", factory=list)
+    # the node with the highest per-interface window error ratio
+    worst_node: str = j("worstNode", "")
+    worst_error_ratio: float = j("worstErrorRatio", 0.0)
+    # fleet-wide errors/(errors+packets) over the reported counters
+    aggregate_error_ratio: float = j("aggregateErrorRatio", 0.0)
+
+
+@dataclass
 class PolicyCondition:
     """metav1.Condition subset (the DataplaneDegraded carrier)."""
 
@@ -216,6 +275,12 @@ class NetworkClusterPolicyStatus:
     # dataplane probe mesh (omit-empty: absent unless probing is on)
     probe_nodes: List[NodeProbeStatus] = j("probeNodes", factory=list)
     conditions: List[PolicyCondition] = j("conditions", factory=list)
+    # dataplane counter telemetry rollup (omit-empty: absent until any
+    # agent reports a sample)
+    telemetry: Optional[TelemetryStatus] = j("telemetry", None)
+    # fleet version skew: agent package version -> node count, from the
+    # report Leases (omit-empty)
+    agent_versions: Dict[str, int] = j("agentVersions", factory=dict)
 
 
 @dataclass

@@ -79,6 +79,17 @@ def default_policy(policy: NetworkClusterPolicy) -> NetworkClusterPolicy:
                 p.failure_threshold = t.DEFAULT_PROBE_FAILURE_THRESHOLD
             if not p.recovery_threshold:
                 p.recovery_threshold = t.DEFAULT_PROBE_RECOVERY_THRESHOLD
+        if so.telemetry.enabled:
+            # same contract pinning for the counter-telemetry knobs
+            tl = so.telemetry
+            if not tl.window:
+                tl.window = t.DEFAULT_TELEMETRY_WINDOW
+            if not tl.error_ratio:
+                tl.error_ratio = t.DEFAULT_TELEMETRY_ERROR_RATIO
+            if not tl.drop_rate:
+                tl.drop_rate = t.DEFAULT_TELEMETRY_DROP_RATE
+            if not tl.stall_ticks:
+                tl.stall_ticks = t.DEFAULT_TELEMETRY_STALL_TICKS
     return policy
 
 
@@ -165,6 +176,48 @@ def validate_probe_spec(p: t.ProbeSpec) -> None:
             )
 
 
+def validate_telemetry_spec(tl: t.TelemetrySpec) -> None:
+    """Dataplane counter-telemetry knobs.  Zero means "agent default"
+    (the mutating webhook fills them when telemetry stays enabled), so
+    only explicit out-of-range values are rejected."""
+    if tl.window < 0 or tl.window > 100:
+        raise AdmissionError(
+            "tpuScaleOut.telemetry: window must be 0-100"
+        )
+    if tl.window == 1:
+        # a 1-sample window holds no delta — anomaly detection would be
+        # silently disabled while the operator believes it is active
+        raise AdmissionError(
+            "tpuScaleOut.telemetry: window must be 0 (default) or >= 2 "
+            "— a single sample has no delta to judge"
+        )
+    if tl.error_ratio < 0 or tl.error_ratio > 1:
+        raise AdmissionError(
+            "tpuScaleOut.telemetry: errorRatio must be within 0-1"
+        )
+    if tl.drop_rate < 0:
+        raise AdmissionError(
+            "tpuScaleOut.telemetry: dropRate must be >= 0"
+        )
+    if tl.stall_ticks < 0 or tl.stall_ticks > 100:
+        raise AdmissionError(
+            "tpuScaleOut.telemetry: stallTicks must be 0-100"
+        )
+    # cross-field: the window deque can never hold stallTicks samples
+    # when stallTicks > window, so the stall verdict could never fire —
+    # detection silently disabled while the operator believes it is
+    # active (the same rationale as rejecting window=1).  Compare the
+    # values as they will resolve in the agent (0 = default).
+    effective_window = tl.window or t.DEFAULT_TELEMETRY_WINDOW
+    effective_stall = tl.stall_ticks or t.DEFAULT_TELEMETRY_STALL_TICKS
+    if effective_stall > effective_window:
+        raise AdmissionError(
+            f"tpuScaleOut.telemetry: stallTicks ({effective_stall}) "
+            f"exceeds window ({effective_window}) — counter-stall "
+            f"detection could never fire"
+        )
+
+
 def validate_tpu_so_spec(s: t.TpuScaleOutSpec) -> None:
     _validate_common_so(s.layer, s.mtu, s.pull_policy, "tpuScaleOut")
     if s.topology_source not in TOPOLOGY_SOURCES:
@@ -189,6 +242,7 @@ def validate_tpu_so_spec(s: t.TpuScaleOutSpec) -> None:
             "tpuScaleOut: drainTimeoutSeconds must be 0-600"
         )
     validate_probe_spec(s.probe)
+    validate_telemetry_spec(s.telemetry)
 
 
 def validate_spec(spec: NetworkClusterPolicySpec) -> List[str]:

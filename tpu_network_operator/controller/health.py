@@ -51,7 +51,26 @@ METRIC_HELP: Dict[str, str] = {
     "tpunet_events_emitted_total": "Kubernetes Events written, by reason.",
     "tpunet_events_suppressed_total":
         "Events dropped by the per-object rate limiter, by reason.",
+    "tpunet_build_info":
+        "Always 1; the version label carries the operator build.",
+    "tpunet_iface_rx_bytes_total":
+        "Cumulative received bytes per node interface, from agent "
+        "telemetry reports.",
+    "tpunet_iface_errors_total":
+        "Cumulative rx+tx errors per node interface, from agent "
+        "telemetry reports.",
+    "tpunet_iface_error_ratio":
+        "Window error ratio (errors/(errors+packets)) per node interface.",
 }
+
+
+def set_build_info(metrics: "Metrics") -> None:
+    """Export ``tpunet_build_info{version}`` — the standard Prometheus
+    idiom for joining any series to the running build (fleet version
+    skew shows up as two build_info series across operator replicas)."""
+    from .. import __version__
+
+    metrics.set_gauge("tpunet_build_info", 1.0, {"version": __version__})
 
 
 class Metrics:

@@ -109,6 +109,12 @@ class Manager:
         self.client = client
         self.namespace = namespace
         self.metrics = metrics
+        if metrics is not None:
+            # version visibility from the first scrape: every
+            # manager-backed registry carries the build_info series
+            from .health import set_build_info
+
+            set_build_info(metrics)
         self.tracer = tracer
         self.resync_interval = resync_interval
         self.concurrent_reconciles = max(1, int(concurrent_reconciles))

@@ -80,6 +80,20 @@ PROBE_GAUGES = (
     "tpunet_probe_peers_reachable",
 )
 
+# per-interface telemetry families ({policy, node, interface} labels),
+# same retraction contract as PROBE_GAUGES.  Cardinality is bounded
+# below (MAX_TELEMETRY_IFACES): interface names come from the cluster
+# and must not mint unbounded series.
+TELEMETRY_GAUGES = (
+    "tpunet_iface_rx_bytes_total",
+    "tpunet_iface_errors_total",
+    "tpunet_iface_error_ratio",
+)
+MAX_TELEMETRY_IFACES = 8
+# anomaly strings surfaced into status.telemetry.anomalies (triage
+# entry point, not a dump)
+MAX_TELEMETRY_ANOMALIES = 20
+
 # dataplane quarantine: consecutive degraded status passes before a
 # node is marked Quarantined in the connectivity matrix, and the
 # bounded-exponential re-probe requeue that replaces label-flap-speed
@@ -275,6 +289,23 @@ def update_tpu_scale_out_daemonset(
             "--probe-recovery-threshold="
             f"{so.probe.recovery_threshold or t.DEFAULT_PROBE_RECOVERY_THRESHOLD}",
         ]
+    tl = so.telemetry
+    if tl.enabled:
+        # counter telemetry is agent-default-on; still project every
+        # knob (`or default` form, like probe) so the contract is fully
+        # pinned by the operator, never by agent-side defaults
+        args += [
+            "--telemetry-window="
+            f"{tl.window or t.DEFAULT_TELEMETRY_WINDOW}",
+            "--telemetry-error-ratio="
+            f"{tl.error_ratio or t.DEFAULT_TELEMETRY_ERROR_RATIO:g}",
+            "--telemetry-drop-rate="
+            f"{tl.drop_rate or t.DEFAULT_TELEMETRY_DROP_RATE:g}",
+            "--telemetry-stall-ticks="
+            f"{tl.stall_ticks or t.DEFAULT_TELEMETRY_STALL_TICKS}",
+        ]
+    else:
+        args.append("--telemetry=false")
     if so.dcn_interfaces:
         # explicit DCN NIC override; absent = agent auto-discovery
         # (ref --interfaces projection analog, controller :176-203)
@@ -948,6 +979,145 @@ class NetworkClusterPolicyReconciler:
                     f"quarantine lifted",
                 )
 
+    # -- dataplane counter telemetry ------------------------------------------
+
+    @staticmethod
+    def _telemetry_enabled(policy: NetworkClusterPolicy) -> bool:
+        return (
+            policy.spec.configuration_type == t.CONFIG_TYPE_TPU_SO
+            and policy.spec.tpu_scale_out.telemetry.enabled
+        )
+
+    def _aggregate_telemetry(
+        self, policy: NetworkClusterPolicy, reports: List[Any]
+    ):
+        """Fold per-node counter samples (report ``telemetry`` payloads)
+        into the policy's fleet rollup.  Returns ``(TelemetryStatus |
+        None, metric rows)`` — None while no agent has reported a sample
+        yet, so ``status.telemetry`` stays absent instead of advertising
+        an all-zero fleet."""
+        rows: List[Any] = []   # (node, iface, {rx_bytes, errors, ratio})
+        anomalies: List[str] = []
+        anomalous: List[str] = []
+        worst_node, worst_ratio = "", -1.0
+        total_errs = total_pkts = 0
+        nodes_reporting = 0
+        for rep in sorted(reports, key=lambda r: r.node):
+            payload = getattr(rep, "telemetry", None)
+            ifaces = (
+                payload.get("interfaces")
+                if isinstance(payload, dict) else None
+            )
+            if not isinstance(ifaces, dict) or not ifaces:
+                continue
+            nodes_reporting += 1
+            node_anoms: List[str] = []
+            node_worst = 0.0
+            # the anomaly/worst/aggregate scan covers EVERY reported
+            # interface — only the metric rows are capped: interface
+            # names come from the cluster (any agent version, maybe
+            # malicious) and each metric row mints a label value, but
+            # an anomaly on the 9th interface must still flip the
+            # condition the agent's own label verdict already reflects
+            for idx, name in enumerate(
+                sorted(str(n) for n in ifaces)
+            ):
+                d = ifaces.get(name)
+                if not isinstance(d, dict):
+                    continue
+                ratio = _as_float(d.get("errorRatio"))
+                errs = _as_int(d.get("rxErrors")) + _as_int(d.get("txErrors"))
+                pkts = (
+                    _as_int(d.get("rxPackets")) + _as_int(d.get("txPackets"))
+                )
+                total_errs += errs
+                total_pkts += pkts
+                node_worst = max(node_worst, ratio)
+                kinds = d.get("anomalies")
+                if isinstance(kinds, list):
+                    node_anoms += [
+                        f"{rep.node}/{name}: {k}"
+                        for k in kinds[:4] if isinstance(k, str)
+                    ]
+                if idx < MAX_TELEMETRY_IFACES:
+                    rows.append((str(rep.node), name, {
+                        "rx_bytes": _as_int(d.get("rxBytes")),
+                        "errors": errs,
+                        "ratio": ratio,
+                    }))
+            if node_anoms:
+                anomalous.append(rep.node)
+                anomalies += node_anoms
+            if node_worst > worst_ratio:
+                worst_node, worst_ratio = rep.node, node_worst
+        if nodes_reporting == 0:
+            return None, rows
+        return t.TelemetryStatus(
+            nodes_reporting=nodes_reporting,
+            anomalous_nodes=sorted(anomalous),
+            anomalies=sorted(anomalies)[:MAX_TELEMETRY_ANOMALIES],
+            worst_node=worst_node,
+            worst_error_ratio=round(max(worst_ratio, 0.0), 6),
+            aggregate_error_ratio=round(
+                total_errs / max(total_errs + total_pkts, 1), 6
+            ),
+        ), rows
+
+    def _export_telemetry_metrics(
+        self, policy_name: str, rows: List[Any]
+    ) -> None:
+        if not self.metrics:
+            return
+        # retract-then-set, like the probe gauges: a departed node's
+        # interface series must not linger as healthy phantoms
+        for gauge in TELEMETRY_GAUGES:
+            self.metrics.remove_matching(gauge, {"policy": policy_name})
+        for node, iface, vals in rows:
+            labels = {
+                "policy": policy_name, "node": node, "interface": iface,
+            }
+            self.metrics.set_gauge(
+                "tpunet_iface_rx_bytes_total", vals["rx_bytes"], labels
+            )
+            self.metrics.set_gauge(
+                "tpunet_iface_errors_total", vals["errors"], labels
+            )
+            self.metrics.set_gauge(
+                "tpunet_iface_error_ratio", vals["ratio"], labels
+            )
+
+    def _emit_telemetry_transitions(
+        self,
+        policy: NetworkClusterPolicy,
+        old_conditions: List[Dict[str, Any]],
+        tstat: t.TelemetryStatus,
+    ) -> None:
+        """Events on DataplaneTelemetryDegraded condition flips only —
+        a steady anomalous (or steady nominal) pass emits nothing; the
+        recorder's dedup is the backstop, not the first line."""
+        old = next(
+            (
+                c.get("status") for c in old_conditions or []
+                if c.get("type") == t.CONDITION_TELEMETRY_DEGRADED
+            ),
+            None,
+        )
+        if tstat.anomalous_nodes and old != "True":
+            self._emit(
+                policy, obs_events.TYPE_WARNING,
+                "DataplaneTelemetryDegraded",
+                f"{len(tstat.anomalous_nodes)}/{tstat.nodes_reporting} "
+                "nodes report interface counter anomalies: "
+                + ", ".join(tstat.anomalous_nodes),
+            )
+        elif not tstat.anomalous_nodes and old == "True":
+            self._emit(
+                policy, obs_events.TYPE_NORMAL,
+                "DataplaneTelemetryRecovered",
+                "interface counters nominal on all "
+                f"{tstat.nodes_reporting} reporting nodes",
+            )
+
     def _emit_state_transition(
         self, policy: NetworkClusterPolicy, old_state: str, state: str,
         errors: List[str],
@@ -1046,6 +1216,8 @@ class NetworkClusterPolicyReconciler:
         # zero-extra-request.
         old_probe_status = am.to_dict(policy.status.probe_nodes)
         old_conditions = am.to_dict(policy.status.conditions)
+        old_telemetry = am.to_dict(policy.status.telemetry)
+        old_versions = dict(policy.status.agent_versions)
         probe_requeue = 0.0
         if self._probe_enabled(policy):
             self._sync_probe_peers(policy, reports)
@@ -1113,6 +1285,69 @@ class NetworkClusterPolicyReconciler:
                 if c.type != t.CONDITION_DATAPLANE_DEGRADED
             ]
 
+        # dataplane counter telemetry: fleet rollup + condition +
+        # per-interface metric families from the report payloads
+        if self._telemetry_enabled(policy):
+            tstat, telem_rows = self._aggregate_telemetry(policy, reports)
+            policy.status.telemetry = tstat
+            if tstat is None:
+                # no samples yet (or the reporting nodes left): no
+                # rollup to stand behind — drop the condition rather
+                # than keep asserting stale evidence
+                policy.status.conditions = [
+                    c for c in policy.status.conditions
+                    if c.type != t.CONDITION_TELEMETRY_DEGRADED
+                ]
+            elif tstat.anomalous_nodes:
+                self._set_condition(
+                    policy.status, t.CONDITION_TELEMETRY_DEGRADED,
+                    "True", "CounterAnomalies",
+                    f"{len(tstat.anomalous_nodes)}/"
+                    f"{tstat.nodes_reporting} nodes report interface "
+                    "counter anomalies: "
+                    + ", ".join(tstat.anomalous_nodes),
+                )
+            else:
+                self._set_condition(
+                    policy.status, t.CONDITION_TELEMETRY_DEGRADED,
+                    "False", "CountersNominal",
+                    "interface counters nominal on all "
+                    f"{tstat.nodes_reporting} reporting nodes",
+                )
+            self._export_telemetry_metrics(policy.metadata.name, telem_rows)
+            if tstat is not None:
+                self._emit_telemetry_transitions(
+                    policy, old_conditions, tstat
+                )
+        else:
+            # telemetry switched off: same one-time cleanup contract as
+            # the probe path — stale rollups/conditions/series must not
+            # outlive the feature
+            if policy.status.telemetry is not None or any(
+                c.type == t.CONDITION_TELEMETRY_DEGRADED
+                for c in policy.status.conditions
+            ):
+                if self.metrics:
+                    for gauge in TELEMETRY_GAUGES:
+                        self.metrics.remove_matching(
+                            gauge, {"policy": policy.metadata.name}
+                        )
+            policy.status.telemetry = None
+            policy.status.conditions = [
+                c for c in policy.status.conditions
+                if c.type != t.CONDITION_TELEMETRY_DEGRADED
+            ]
+
+        # fleet version skew: agent package version -> node count (from
+        # whatever version stamp each report carries; "" = pre-field
+        # agents, not counted)
+        versions: Dict[str, int] = {}
+        for rep in reports:
+            ver = getattr(rep, "agent_version", "")
+            if isinstance(ver, str) and ver:
+                versions[ver] = versions.get(ver, 0) + 1
+        policy.status.agent_versions = dict(sorted(versions.items()))
+
         if self.metrics:
             labels = {"policy": policy.metadata.name}
             values = {
@@ -1132,6 +1367,8 @@ class NetworkClusterPolicyReconciler:
             or policy.status.errors != errors
             or am.to_dict(policy.status.probe_nodes) != old_probe_status
             or am.to_dict(policy.status.conditions) != old_conditions
+            or am.to_dict(policy.status.telemetry) != old_telemetry
+            or policy.status.agent_versions != old_versions
         )
         policy.status.targets = targets
         policy.status.ready_nodes = ready
@@ -1165,6 +1402,8 @@ class NetworkClusterPolicyReconciler:
             if self.metrics:
                 for gauge in POLICY_GAUGES:
                     self.metrics.remove_gauge(gauge, {"policy": name})
+                for gauge in TELEMETRY_GAUGES:
+                    self.metrics.remove_matching(gauge, {"policy": name})
             self._prune_probe_state(name)
             return Result()
         policy = NetworkClusterPolicy.from_dict(raw)
