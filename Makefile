@@ -43,6 +43,11 @@ test-e2e: ## End-to-end: operator + fake cluster + agent against fake host
 fuzz: ## Randomized CR fuzz against the admission+reconcile pipeline
 	$(PYTHON) -m pytest tests/fuzz -x -q -m "not slow"
 
+.PHONY: chaos
+chaos: ## Fault-injection resilience: marked scenarios + the 4-scenario bench
+	$(PYTHON) -m pytest tests/ -x -q -m "chaos and not slow"
+	$(PYTHON) tools/chaos_bench.py --out BENCH_chaos.json
+
 .PHONY: test-cluster
 test-cluster: ## kind-cluster e2e + live fuzz (needs kind/docker/kubectl; skips cleanly without — ref test/e2e + test/fuzz)
 	$(PYTHON) -m pytest tests/cluster -x -q

@@ -330,6 +330,62 @@ class TestTelemetryBench:
         assert ramp["recovered_events"] == 1
 
 
+class TestChaosBench:
+    @pytest.mark.chaos
+    def test_four_scenario_artifact(self, tmp_path):
+        """The chaos bench phase (tools/chaos_bench.py, perf_session
+        phase 12) at reduced scale: all four scenarios must hold their
+        invariants and the BENCH_chaos.json artifact must carry the
+        driver contract keys."""
+        out = tmp_path / "BENCH_chaos.json"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "chaos_bench.py"),
+             "--nodes", "6", "--out", str(out)],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert row == json.loads(out.read_text())
+        # the driver's contract keys
+        assert set(row) >= {"metric", "value", "unit", "vs_baseline"}
+        assert row["unit"] == "drain passes"
+        assert row["scenarios_ok"] is True
+        # scenario 1: bounded convergence under sustained 10% faults,
+        # with every injected retryable fault accounted on /metrics
+        s = row["sustained"]
+        assert 0 < s["converged_passes"] <= s["budget_passes"]
+        assert row["value"] == s["converged_passes"]
+        assert row["vs_baseline"] < 1.0
+        assert s["churn_rounds_failed"] == 0
+        assert s["faults_accounted"] is True
+        assert s["client_retries"] + s["client_gave_up"] \
+            == s["injected_retryable"]
+        assert s["retries_metric_exported"] is True
+        # scenario 2: a control-plane outage alone causes ZERO label
+        # transitions; reports held, then caught up on reconnect
+        o = row["outage"]
+        assert o["label_transitions"] == 0
+        assert o["labels_held_through_outage"] is True
+        assert o["reports_held_not_retracted"] is True
+        assert o["renew_frozen_during_outage"] is True
+        assert o["min_publish_failures"] >= o["outage_ticks"]
+        assert o["republished_on_reconnect"] == row["nodes"]
+        assert o["reconnect_events"] == row["nodes"]
+        # scenario 3: watch drops never stick or lose a reconcile
+        w = row["watch_drops"]
+        assert w["stuck_rounds"] == 0 and w["lost_reconciles"] == 0
+        assert w["informer_restarts"] > 0
+        assert w["restart_metric_exported"] is True
+        # scenario 4: exactly one handover, never two leaders, no
+        # reconcile from a deposed leader
+        lf = row["leader_flap"]
+        assert lf["handovers"] == 1
+        assert lf["both_leader_observations"] == 0
+        assert lf["deposed_leader_reconciles"] == 0
+        assert lf["no_premature_takeover"] is True
+
+
 class TestControllerBench:
     def test_reports_cached_vs_uncached_artifact(self, tmp_path):
         """The controller bench phase (tools/controller_bench.py) at toy

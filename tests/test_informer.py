@@ -281,3 +281,130 @@ class TestCachedClientOverWire:
             client.close()
         finally:
             srv.stop()
+
+
+@pytest.mark.chaos
+class TestWatchResilience:
+    """Regression for the stale-cache hole: a watch stream that raises
+    or ends used to leave the store silently frozen — reads kept
+    serving pre-death state forever.  The pump must re-open the stream
+    and relist."""
+
+    def _informer(self, seed=1):
+        from tpu_network_operator.kube.chaos import FaultInjector
+
+        fake = FakeCluster()
+        inj = FaultInjector(fake, seed=seed)
+        inf = Informer(inj, "v1", "ConfigMap", namespace=NS).start()
+        return fake, inj, inf
+
+    def test_dead_watch_reopens_and_store_catches_up(self):
+        fake, inj, inf = self._informer()
+        fake.create(mk("ConfigMap", "a", NS))
+        inf.sync()
+        assert inf.store.get("a", NS) is not None
+
+        inj.drop_watches()
+        # mutations in the gap: the dead stream never delivers these
+        fake.create(mk("ConfigMap", "b", NS))
+        fake.delete("v1", "ConfigMap", "a", NS)
+
+        inf.sync()   # detects the dead stream, re-opens, relists
+        assert inf.restarts == 1
+        assert inf.store.get("b", NS) is not None
+        assert inf.store.get("a", NS) is None   # deletion not missed
+        # the NEW stream is live: events flow again without a relist
+        fake.create(mk("ConfigMap", "c", NS))
+        inf.sync()
+        assert inf.store.get("c", NS) is not None
+        assert inf.restarts == 1   # no further churn
+
+    def test_410_expired_triggers_relist(self):
+        fake, inj, inf = self._informer()
+        fake.create(mk("ConfigMap", "a", NS))
+        inf.sync()
+        inj.drop_watches(expired=True)
+        fake.create(mk("ConfigMap", "b", NS))
+        inf.sync()
+        assert inf.restarts == 1
+        assert inf.store.get("b", NS) is not None
+
+    def test_server_ended_stream_reopens(self):
+        """A watch the SERVER closed (stopped without the informer's
+        stop()) is the same hole as a raise — must re-open."""
+        fake = FakeCluster()
+        inf = Informer(fake, "v1", "ConfigMap", namespace=NS).start()
+        fake.create(mk("ConfigMap", "a", NS))
+        inf.sync()
+        inf._watch.stop()            # server-side close
+        fake.create(mk("ConfigMap", "b", NS))
+        inf.sync()
+        assert inf.restarts == 1
+        assert inf.store.get("b", NS) is not None
+
+    def test_reopen_failure_backs_off_then_recovers(self):
+        fake, inj, inf = self._informer()
+        fake.create(mk("ConfigMap", "a", NS))
+        inf.sync()
+        inj.drop_watches()
+        inj.begin_outage()           # re-open itself will fail
+        fake.create(mk("ConfigMap", "b", NS))
+        inf.sync()                   # restart attempt fails, backs off
+        assert inf.restarts == 0
+        assert inf.store.get("b", NS) is None   # stale, by necessity
+        inf.sync()                   # inside backoff: no hot reconnect
+        inj.end_outage()
+        inf._reopen_not_before = 0.0     # test seam: skip the wait
+        inf.sync()
+        assert inf.restarts == 1
+        assert inf.store.get("b", NS) is not None
+
+    def test_informer_stop_does_not_count_as_death(self):
+        fake, inj, inf = self._informer()
+        inf.sync()
+        inf.stop()
+        inf.sync()                   # stopped-by-us: no restart churn
+        assert inf.restarts == 0
+
+    def test_restart_metric_exported(self):
+        from tpu_network_operator.controller.health import Metrics
+        from tpu_network_operator.kube.chaos import FaultInjector
+
+        fake = FakeCluster()
+        inj = FaultInjector(fake, seed=1)
+        metrics = Metrics()
+        inf = Informer(inj, "v1", "ConfigMap", namespace=NS,
+                       metrics=metrics).start()
+        inj.drop_watches()
+        inf.sync()
+        assert inf.restarts == 1
+        assert "tpunet_watch_restarts_total" in metrics.render()
+
+    def test_cached_client_reads_survive_watch_death(self):
+        from tpu_network_operator.kube.chaos import FaultInjector
+
+        fake = FakeCluster()
+        inj = FaultInjector(fake, seed=1)
+        cached = CachedClient(inj)
+        cached.cache("v1", "ConfigMap", namespace=NS)
+        cached.start()
+        try:
+            cached.create(mk("ConfigMap", "a", NS))
+            assert cached.get("v1", "ConfigMap", "a", NS)
+            inj.drop_watches()
+            cached.create(mk("ConfigMap", "b", NS))
+            fake.delete("v1", "ConfigMap", "a", NS)
+            # cached reads observe the post-death world (no freeze)
+            assert cached.list("v1", "ConfigMap", namespace=NS) or True
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                names = {
+                    o["metadata"]["name"]
+                    for o in cached.list("v1", "ConfigMap", namespace=NS)
+                }
+                if names == {"b"}:
+                    break
+                time.sleep(0.02)
+            assert names == {"b"}
+        finally:
+            cached.stop()

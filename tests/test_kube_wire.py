@@ -214,18 +214,41 @@ class TestWatchOverWire:
         assert status["code"] == 410 and status["reason"] == "Expired"
         assert "injected" not in status["message"]
 
-    def test_watch_410_gone_triggers_relist(self, srv, client):
+    def test_watch_410_gone_ends_stream_for_consumer_relist(
+        self, srv, client
+    ):
+        """410 Expired on resume ENDS the stream (w.stopped) instead of
+        silently resuming "from now": continuity is unprovable, and the
+        gap's events — deletions included — can only be recovered by
+        the consumer's relist (the informer's watch-restart machinery).
+        The old transparent resume looked alive while permanently
+        missing whatever the compaction window swallowed."""
         w = client.watch("tpunet.dev/v1alpha1", "NetworkClusterPolicy")
         time.sleep(0.3)
         srv.cluster.create(make_policy("g1"))
         assert self._collect(w, 1)   # client now has a resourceVersion
         srv.inject_gone_once()       # next reconnect with rv gets ERROR 410
         srv.drop_watch_once()        # force that reconnect
-        time.sleep(1.5)
+        deadline = time.time() + 10
+        while time.time() < deadline and not w.stopped:
+            time.sleep(0.05)
+        assert w.stopped, "410 must end the stream, not resume silently"
+        # mutations in the gap; a FRESH stream + relist recover them —
+        # exactly what Informer._restart_watch does on a dead stream
         srv.cluster.create(make_policy("g2"))
-        evs = self._collect(w, 2, timeout=10, until_name="g2")
-        assert any(o["metadata"]["name"] == "g2" for _, o in evs)
-        w.stop()
+        w2 = client.watch("tpunet.dev/v1alpha1", "NetworkClusterPolicy")
+        time.sleep(0.3)
+        names = {
+            o["metadata"]["name"]
+            for o in client.list(
+                "tpunet.dev/v1alpha1", "NetworkClusterPolicy"
+            )
+        }
+        assert names == {"g1", "g2"}
+        srv.cluster.create(make_policy("g3"))   # live events flow again
+        evs = self._collect(w2, 5, timeout=10, until_name="g3")
+        assert any(o["metadata"]["name"] == "g3" for _, o in evs)
+        w2.stop()
 
 
 class TestAuthAndTls:

@@ -218,6 +218,143 @@ class TestLogFstrings:
         assert "G004" not in self.codes_at(self.CONTROLLER, src)
 
 
+class TestRetryLoops:
+    """R001: ad-hoc retry loops catching the base ApiError must not
+    exist outside kube/retry.py — retry policy stays centralized in
+    RetryingClient (backoff, jitter, Retry-After, budgets, metrics)."""
+
+    PKG = "tpu_network_operator/controller/x.py"
+    RETRY = "tpu_network_operator/kube/retry.py"
+
+    def codes_at(self, path, src):
+        tree = ast.parse(src)
+        return {
+            f.code for f in lint.Checker(path, tree, src).run()
+        }
+
+    LOOP = (
+        "def f(client):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return client.get()\n"
+        "        except ApiError:\n"
+        "            continue\n"
+    )
+
+    def test_while_retry_loop_flagged(self):
+        assert "R001" in self.codes_at(self.PKG, self.LOOP)
+
+    def test_attribute_form_flagged(self):
+        src = self.LOOP.replace("except ApiError", "except kerr.ApiError")
+        assert "R001" in self.codes_at(self.PKG, src)
+
+    def test_tuple_catch_flagged(self):
+        src = self.LOOP.replace(
+            "except ApiError", "except (ValueError, ApiError)"
+        )
+        assert "R001" in self.codes_at(self.PKG, src)
+
+    def test_for_loop_flagged(self):
+        src = (
+            "def f(client):\n"
+            "    for _ in range(5):\n"
+            "        try:\n"
+            "            return client.get()\n"
+            "        except ApiError:\n"
+            "            pass\n"
+        )
+        assert "R001" in self.codes_at(self.PKG, src)
+
+    def test_kube_retry_module_exempt(self):
+        assert "R001" not in self.codes_at(self.RETRY, self.LOOP)
+
+    def test_outside_package_not_flagged(self):
+        assert "R001" not in self.codes_at("tests/test_x.py", self.LOOP)
+
+    def test_subclass_catch_not_flagged(self):
+        src = self.LOOP.replace("except ApiError",
+                                "except NotFoundError")
+        assert "R001" not in self.codes_at(self.PKG, src)
+
+    def test_collection_fanout_not_flagged(self):
+        # per-item best-effort over a COLLECTION never re-attempts the
+        # same request — not retry policy
+        src = (
+            "def f(client, batch):\n"
+            "    for item in batch:\n"
+            "        try:\n"
+            "            client.apply(item)\n"
+            "        except ApiError:\n"
+            "            continue\n"
+        )
+        assert "R001" not in self.codes_at(self.PKG, src)
+
+    def test_fanout_nested_in_retry_loop_still_flagged(self):
+        src = (
+            "def f(client, batch):\n"
+            "    while True:\n"
+            "        for item in batch:\n"
+            "            try:\n"
+            "                client.apply(item)\n"
+            "            except ApiError:\n"
+            "                continue\n"
+        )
+        assert "R001" in self.codes_at(self.PKG, src)
+
+    def test_break_handler_not_flagged(self):
+        # giving up on API error (the opposite of retrying) is allowed
+        src = (
+            "def f(client, batch):\n"
+            "    for item in batch:\n"
+            "        try:\n"
+            "            client.get(item)\n"
+            "        except ApiError:\n"
+            "            break\n"
+        )
+        assert "R001" not in self.codes_at(self.PKG, src)
+
+    def test_return_handler_not_flagged(self):
+        src = self.LOOP.replace("continue", "return None")
+        assert "R001" not in self.codes_at(self.PKG, src)
+
+    def test_reraising_handler_not_flagged(self):
+        src = (
+            "def f(client):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            return client.get()\n"
+            "        except ApiError as e:\n"
+            "            if fatal(e):\n"
+            "                raise\n"
+            "            continue\n"
+        )
+        assert "R001" not in self.codes_at(self.PKG, src)
+
+    def test_handler_outside_loop_not_flagged(self):
+        src = (
+            "def f(client):\n"
+            "    try:\n"
+            "        return client.get()\n"
+            "    except ApiError:\n"
+            "        return None\n"
+        )
+        assert "R001" not in self.codes_at(self.PKG, src)
+
+    def test_function_defined_in_loop_resets_context(self):
+        src = (
+            "def f(client):\n"
+            "    while True:\n"
+            "        def g():\n"
+            "            try:\n"
+            "                return client.get()\n"
+            "            except ApiError:\n"
+            "                return None\n"
+            "        g()\n"
+            "        break\n"
+        )
+        assert "R001" not in self.codes_at(self.PKG, src)
+
+
 def test_repo_is_lint_clean():
     """The gate itself: the whole repo must stay at zero findings."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -20,6 +20,17 @@ AST checker covering the highest-signal subset:
         must stay structured (%-style lazy args) so the JSON formatter
         and log aggregation keep a stable message template; also skips
         interpolation cost on disabled levels
+  R001  ad-hoc retry loop catching the base `ApiError` (a swallowing
+        `except ApiError` handler inside a retry-shaped loop: `while`
+        or `for _ in range(n)`) anywhere in the package outside
+        kube/retry.py — retry policy (backoff, jitter, Retry-After,
+        budgets, metrics) is centralized in kube.retry.RetryingClient;
+        scattered blind-retry loops hide outages, hammer a throttling
+        apiserver, and dodge the tpunet_client_* accounting.  Handlers
+        that give up instead of re-attempting (raise / break / return),
+        handlers catching specific subclasses (NotFoundError,
+        ConflictError, ...), and per-item fan-out over a collection
+        (`for item in batch`) are NOT retry policy and stay allowed.
 
 Zero third-party dependencies; exits 1 on any finding.  Run as
 `python tools/lint.py [paths...]` (defaults to the package, tests, tools
@@ -226,6 +237,12 @@ class Checker:
         self.check_log_fstrings = any(
             d in norm for d in STRUCTURED_LOG_DIRS
         )
+        # R001 scope: the whole operator package except the one module
+        # that IS the retry policy
+        self.check_retry_loops = (
+            "tpu_network_operator" in norm
+            and not norm.endswith("kube/retry.py")
+        )
 
     def report(self, node, code, message):
         self.findings.append(
@@ -248,6 +265,7 @@ class Checker:
         }
         for node in ast.walk(self.tree):
             self._check_misc(node)
+        self._check_retry_loops()
         return self.findings
 
     def _scope_of(self, kind: str, body, extra: Optional[Set[str]] = None):
@@ -412,6 +430,77 @@ class Checker:
         for name, node in sorted(imported.items()):
             if name not in used:
                 self.report(node, "F401", f"'{name}' imported but unused")
+
+    # -- ad-hoc ApiError retry loops (R001) ------------------------------------
+
+    @staticmethod
+    def _catches_base_api_error(handler: ast.ExceptHandler) -> bool:
+        def is_base(n) -> bool:
+            return (
+                (isinstance(n, ast.Name) and n.id == "ApiError")
+                or (isinstance(n, ast.Attribute) and n.attr == "ApiError")
+            )
+
+        tp = handler.type
+        if tp is None:
+            return False   # bare except is E722's finding
+        if isinstance(tp, ast.Tuple):
+            return any(is_base(e) for e in tp.elts)
+        return is_base(tp)
+
+    def _check_retry_loops(self):
+        if not self.check_retry_loops:
+            return
+
+        def swallows(handler: ast.ExceptHandler) -> bool:
+            # only handlers that let the loop RE-ATTEMPT the call are
+            # retry policy: any raise (propagates), break, or return
+            # (loop over) anywhere in the handler means it gives up on
+            # the API error rather than retrying — allowed
+            return not any(
+                isinstance(n, (ast.Raise, ast.Break, ast.Return))
+                for n in ast.walk(handler)
+            )
+
+        def is_retry_shaped(loop) -> bool:
+            # retry loops are `while ...` or `for _ in range(n)`; a
+            # `for` over a COLLECTION is per-item fan-out — swallowing
+            # an ApiError there moves on to the NEXT item, it never
+            # re-attempts the same request
+            if isinstance(loop, ast.While):
+                return True
+            it = loop.iter
+            return (
+                isinstance(it, ast.Call)
+                and isinstance(it.func, ast.Name)
+                and it.func.id == "range"
+            )
+
+        def walk(node, in_loop: bool):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    # a function defined inside a loop body runs later,
+                    # not per-iteration — its handlers start loop-free
+                    walk(child, False)
+                    continue
+                if isinstance(child, (ast.While, ast.For, ast.AsyncFor)):
+                    walk(child, in_loop or is_retry_shaped(child))
+                    continue
+                if (
+                    in_loop
+                    and isinstance(child, ast.ExceptHandler)
+                    and self._catches_base_api_error(child)
+                    and swallows(child)
+                ):
+                    self.report(
+                        child, "R001",
+                        "retry loop catching base ApiError; centralize "
+                        "retry policy in kube.retry.RetryingClient",
+                    )
+                walk(child, in_loop)
+
+        walk(self.tree, False)
 
     # -- misc single-node checks ----------------------------------------------
 

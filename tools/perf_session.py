@@ -158,6 +158,13 @@ def main() -> int:
                   [py, "tools/telemetry_bench.py", "--nodes", "20",
                    "--interfaces", "4", "--out", "BENCH_telemetry.json"],
                   timeout=600)
+        # 12. control-plane chaos: convergence under sustained 10%
+        # fault injection, a full apiserver outage with zero label
+        # flaps, watch-drop recovery, and a leader-election lease flap
+        # (no TPU, deterministic seeded injector)
+        maybe_run_phase(out, "chaos-bench",
+                  [py, "tools/chaos_bench.py", "--nodes", "20",
+                   "--out", "BENCH_chaos.json"], timeout=600)
     print(f"done -> {args.out}")
     return 0
 

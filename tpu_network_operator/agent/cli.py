@@ -202,8 +202,15 @@ def _kube_client():
     non-standard deployments) or in-cluster SA config; None when neither
     is available (reporting silently off — the NFD label remains the
     node-local signal).  Cached per target so the 60s heartbeat does not
-    rebuild TLS contexts / re-read SA tokens every tick."""
+    rebuild TLS contexts / re-read SA tokens every tick.
+
+    Wrapped in a SHORT-budget RetryingClient: a publish must absorb an
+    apiserver blip (429/503/reset), but a full outage must fail the
+    publish within a fraction of the monitor cadence — the tick then
+    enters held-state degraded mode (see ``_monitor_tick``) instead of
+    hanging the monitor thread on retries."""
     from ..kube.client import ApiClient
+    from ..kube.retry import RetryingClient
 
     url = os.environ.get("TPUNET_KUBE_URL", "")
     key = url or os.environ.get("KUBERNETES_SERVICE_HOST", "")
@@ -221,6 +228,7 @@ def _kube_client():
             # mounted yet; the next publish/heartbeat retries
             log.warning("no cluster access for reporting (will retry): %s", e)
             return None
+    client = RetryingClient(client, max_attempts=3, budget=5.0)
     _CLIENT_CACHE[key] = client
     return client
 
@@ -329,15 +337,16 @@ def _publish_failure_report(
     )
 
 
-def _renew_report(config: CmdConfig) -> None:
-    """Heartbeat the report Lease's renewTime (healthy idle pass)."""
+def _renew_report(config: CmdConfig) -> bool:
+    """Heartbeat the report Lease's renewTime (healthy idle pass).
+    True when it landed (or reporting is off: nothing to keep fresh)."""
     ctx = _report_ctx(config)
     if ctx is None:
-        return
+        return not config.report_namespace
     node, client = ctx
     from . import report as rpt
 
-    rpt.renew_report(client, config.report_namespace, node)
+    return rpt.renew_report(client, config.report_namespace, node)
 
 
 def _retract_report(config: CmdConfig) -> None:
@@ -935,6 +944,61 @@ class _MonitorState:
     # provisioning attempt and keeps it here.  Tests/bench pre-seed it
     # with a manual-clock instance.
     telemetry: Optional[telem.TelemetryMonitor] = None
+    # control-plane degradation (outage-safe degraded mode): consecutive
+    # failed publish/renew attempts.  Apiserver unreachability is NOT a
+    # dataplane problem — while this is nonzero the agent holds its
+    # last-known state (label untouched, mesh/config kept, report
+    # stale-but-held) and keeps retrying; the first successful publish
+    # after an outage is the catch-up that re-syncs the cluster view.
+    publish_failures: int = 0
+
+
+def _note_publish(config: CmdConfig, state: _MonitorState, ok: bool) -> bool:
+    """Track control-plane reachability across ticks (outage-safe
+    degraded mode).  A publish failure is CONTROL-plane degradation:
+    log it once on entry (then every few ticks, not every tick), hold
+    everything node-local exactly as it is, and on the first successful
+    publish after an outage log + Event the reconnect — that publish
+    carried the full current report, so the cluster view is caught up
+    in one shot."""
+    if ok:
+        if state.publish_failures:
+            log.info(
+                "control plane reachable again after %d failed publish "
+                "attempt(s); report re-synced", state.publish_failures,
+            )
+            _emit_node_event(
+                config, "Normal", "ControlPlaneReconnected",
+                f"apiserver reachable again after "
+                f"{state.publish_failures} failed publish attempt(s); "
+                "held readiness state re-synced",
+            )
+        state.publish_failures = 0
+    else:
+        state.publish_failures += 1
+        if state.publish_failures == 1 or state.publish_failures % 10 == 0:
+            if _report_ctx(config) is None:
+                # NOT an outage: reporting is configured but cannot even
+                # be attempted (NODE_NAME unset, or no cluster client
+                # could be built).  Naming the real cause here keeps a
+                # deployment misconfig from being triaged as an
+                # apiserver outage for the pod's lifetime.
+                log.warning(
+                    "cluster reporting unavailable (%d consecutive "
+                    "ticks): NODE_NAME unset or no cluster access — "
+                    "fix the agent deployment; readiness label is "
+                    "unaffected",
+                    state.publish_failures,
+                )
+            else:
+                log.warning(
+                    "control-plane publish failed (%d consecutive); "
+                    "holding last-known readiness state — label "
+                    "untouched, report stale-but-held, retrying next "
+                    "tick",
+                    state.publish_failures,
+                )
+    return ok
 
 
 def _monitor_tick(
@@ -980,21 +1044,21 @@ def _monitor_tick(
                 "data plane degraded: %s — retracting readiness", bad,
             )
             nfd.remove_readiness_label(root=config.nfd_root)
-            state.report_synced = _publish_failure_report(
+            state.report_synced = _note_publish(config, state, _publish_failure_report(
                 config, _degradation_error(bad),
                 probe_runner=probe_runner, configs=configs,
                 telemetry=state.telemetry,
-            )
+            ))
             _emit_node_event(
                 config, "Warning", "ReadinessRetracted",
                 _degradation_error(bad) + "; readiness label retracted",
             )
         else:
             log.info("data plane recovered — restoring readiness")
-            state.report_synced = _publish_report(
+            state.report_synced = _note_publish(config, state, _publish_report(
                 config, configs, coordinator, probe_runner=probe_runner,
                 telemetry=state.telemetry,
-            )
+            ))
             if probe_runner is None or probe_runner.ready():
                 # same TOCTOU guard as the steady branch: the gate may
                 # have flipped down during the publish round-trip, and
@@ -1020,7 +1084,7 @@ def _monitor_tick(
         # connectivity matrix, the tpunet_probe_* gauges, and the
         # counter rollups at their last-transition snapshot, worst
         # exactly while an operator is triaging a worsening outage.
-        state.report_synced = (
+        state.report_synced = _note_publish(config, state, (
             _publish_report(
                 config, configs, coordinator, probe_runner=probe_runner,
                 telemetry=state.telemetry,
@@ -1031,7 +1095,7 @@ def _monitor_tick(
                 probe_runner=probe_runner, configs=configs,
                 telemetry=state.telemetry,
             )
-        )
+        ))
         if (
             probe_runner is not None and not bad
             and probe_runner.ready()
@@ -1043,7 +1107,13 @@ def _monitor_tick(
             # partition would undo the hook's retraction
             nfd.write_readiness_label(ready_label, root=config.nfd_root)
     elif not bad:
-        _renew_report(config)
+        # a failed heartbeat flips report_synced off: the cluster-side
+        # report is aging toward the reconciler's staleness TTL, so the
+        # next tick must attempt a FULL republish (the catch-up), not
+        # another renew of a Lease the apiserver may not even hold
+        state.report_synced = _note_publish(
+            config, state, _renew_report(config)
+        )
     state.last_bad = bad
 
 

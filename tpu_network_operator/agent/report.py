@@ -225,9 +225,12 @@ def lease_for(report: ProvisioningReport, namespace: str) -> Dict:
     }
 
 
-def renew_report(client, namespace: str, node: str) -> None:
+def renew_report(client, namespace: str, node: str) -> bool:
     """Heartbeat: bump the report Lease's renewTime without touching the
-    report body (the agent's healthy idle pass).
+    report body (the agent's healthy idle pass).  Returns whether the
+    heartbeat landed — a failed renew means the cluster-side report is
+    going stale and the monitor must fall back to full republish
+    attempts until the control plane answers again.
 
     DISTINCT field manager from :func:`write_report`: under real
     server-side-apply semantics, re-applying with the same manager but
@@ -242,8 +245,10 @@ def renew_report(client, namespace: str, node: str) -> None:
             "metadata": {"name": lease_name(node), "namespace": namespace},
             "spec": {"renewTime": _now_micro()},
         }, field_manager="tpunet-agent-heartbeat")
+        return True
     except Exception as e:   # noqa: BLE001 — heartbeat is advisory
         log.debug("report renew failed: %s", e)
+        return False
 
 
 def write_report(client, namespace: str, report: ProvisioningReport) -> bool:

@@ -74,6 +74,12 @@ DEFAULT_TELEMETRY_STALL_TICKS = _telemetry_defaults.DEFAULT_STALL_TICKS
 
 CONDITION_TELEMETRY_DEGRADED = "DataplaneTelemetryDegraded"
 
+# control-plane degradation: the manager classified a reconcile failure
+# as permanent (same answer every retry — bad spec, denied write, a
+# bug) and parked the policy on ceiling-backoff rechecks instead of a
+# hot requeue loop; cleared by the next successful reconcile pass
+CONDITION_RECONCILE_DEGRADED = "ReconcileDegraded"
+
 
 @dataclass
 class ProbeSpec:

@@ -34,6 +34,15 @@ METRIC_HELP: Dict[str, str] = {
     "tpunet_workqueue_depth": "Keys waiting in the reconcile workqueue.",
     "tpunet_apiserver_requests_total":
         "Kubernetes API round-trips by verb and kind.",
+    "tpunet_client_retries_total":
+        "Retried API requests by verb, kind and failure reason.",
+    "tpunet_client_gave_up_total":
+        "API requests abandoned after exhausting the retry budget.",
+    "tpunet_watch_restarts_total":
+        "Dead watch streams re-established (with relist) per kind.",
+    "tpunet_reconcile_permanent_errors_total":
+        "Reconcile failures classified permanent (no blind requeue "
+        "churn; surfaced as Events + the ReconcileDegraded condition).",
     "tpunet_cache_objects": "Objects held per informer cache store.",
     "tpunet_policy_targets":
         "Nodes the policy's DaemonSet wants scheduled.",

@@ -170,7 +170,15 @@ class LeaderElector:
         or timeout (0 = forever)."""
         deadline = time.time() + timeout if timeout else None
         while not self._stop.is_set():
-            if self.try_acquire_or_renew():
+            try:
+                got = self.try_acquire_or_renew()
+            except Exception as e:   # noqa: BLE001 — same contract as
+                # _loop: a transient apiserver failure during the
+                # blocking acquire must not kill the acquire thread (the
+                # operator would then never start controllers at all)
+                log.warning("leader acquire round failed: %s", e)
+                got = False
+            if got:
                 self.is_leader = True
                 if self.on_started_leading:
                     self.on_started_leading()

@@ -26,6 +26,7 @@ from ..agent.report import LEASE_API
 from ..api.v1alpha1.types import API_VERSION, NetworkClusterPolicy
 from ..kube.client import ApiClient, is_openshift
 from ..kube.informer import CachedClient
+from ..kube.retry import RetryingClient
 from ..obs import EventRecorder, Tracer
 from ..obs import logging as obs_logging
 from .health import DEFAULT as METRICS, CachedTokenAuthenticator, HealthServer
@@ -131,12 +132,25 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
     if hasattr(client, "metrics"):
         client.metrics = METRICS
 
+    # retry layer between the raw wire and everything above it: 429/503/
+    # transport blips are absorbed here (full-jitter backoff, Retry-After
+    # honored, bounded budget) instead of failing reconciles, seed lists
+    # and informer relists outright.  kube/retry.py is the ONE place this
+    # policy lives (lint rule R001 keeps it that way).  The budget is
+    # deliberately TIGHT: informer watch-restart relists run under the
+    # pump lock that every cached read takes, so a long retry here would
+    # stall all workers on the zero-round-trip hot path — failures past
+    # ~2s surface instead, and the manager's rate-limited requeue (the
+    # layer designed to wait) absorbs them.
+    retrying = RetryingClient(client, max_attempts=3, budget=2.0,
+                              metrics=METRICS)
+
     # informer cache over every kind the reconcile loop reads
     # (controller-runtime's cache-backed manager client): steady-state
     # reconciles then cost zero GET/LIST round-trips — the watch streams
     # carry all updates.  Leader election and TokenReview stay on the raw
     # client below: election correctness must never ride a cached read.
-    cached = CachedClient(client, metrics=METRICS,
+    cached = CachedClient(retrying, metrics=METRICS,
                           resync_interval=args.cache_resync_seconds)
     cached.cache(API_VERSION, NetworkClusterPolicy.KIND)
     cached.cache("apps/v1", "DaemonSet", namespace=args.namespace)
@@ -229,8 +243,14 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
 
     elector = None
     if args.leader_elect:
+        # short-budget retry wrapper: a renew round must absorb an
+        # apiserver blip, but never outlast its own retry period — a
+        # renew still in flight when the NEXT round is due is worse
+        # than a failed one (the elector treats failure correctly)
         elector = LeaderElector(
-            client, args.namespace,
+            RetryingClient(client, max_attempts=3, budget=1.5,
+                           metrics=METRICS),
+            args.namespace,
             on_started_leading=start_controllers,
             # losing the lease must stop reconcile work immediately:
             # controller-runtime exits the process and lets the pod restart

@@ -21,6 +21,7 @@ from collections import deque
 from typing import Optional
 
 from ..api.v1alpha1.types import API_VERSION, NetworkClusterPolicy
+from ..kube import errors as kerr
 from ..kube.informer import LIST_PAGE_SIZE
 from .reconciler import NetworkClusterPolicyReconciler, controller_of
 
@@ -140,6 +141,9 @@ class Manager:
         # manager creation and start()/drain() (informer semantics)
         self._w_policies = client.watch(API_VERSION, NetworkClusterPolicy.KIND)
         self._w_daemonsets = client.watch("apps/v1", "DaemonSet")
+        # per-watch re-open backoff deadlines (monotonic); see
+        # _restart_trigger_watch
+        self._watch_reopen_not_before: dict = {}
 
     # -- workqueue (see WorkQueue for the dedup/processing contract) ----------
 
@@ -173,18 +177,101 @@ class Manager:
         ):
             self.enqueue(owner["name"])
 
+    # trigger-watch GVKs by attribute, for dead-stream re-establishment
+    _WATCH_GVKS = {
+        "_w_policies": (API_VERSION, NetworkClusterPolicy.KIND),
+        "_w_daemonsets": ("apps/v1", "DaemonSet"),
+    }
+    # a failed trigger-watch re-open waits this long before the next
+    # attempt (an apiserver outage must not spin the watch thread hot)
+    WATCH_REOPEN_BACKOFF = 1.0
+
+    def _next_trigger(self, attr: str, handler, timeout: float) -> None:
+        """One read from a trigger watch; a raising or server-ended
+        stream is re-established and the policy set re-enqueued (a
+        relist is the only way to replay triggers lost in the gap)."""
+        w = getattr(self, attr)
+        try:
+            ev = w.next(timeout=timeout)
+        except Exception as e:   # noqa: BLE001 — dead stream
+            if not self._restart_trigger_watch(attr, e):
+                # re-open gated/failed and the dead stream raises
+                # instantly: pace the loop like a normal empty poll
+                self._stop.wait(timeout)
+            return
+        if ev is not None:
+            handler(ev)
+        elif w.stopped and not self._stop.is_set():
+            # server-ended stream: Watch.next() reports it by returning
+            # None forever, never raising — the same silent hole the
+            # informer plugs via its stopped-check
+            self._restart_trigger_watch(attr, None)
+
+    def _restart_trigger_watch(
+        self, attr: str, err: Optional[Exception]
+    ) -> bool:
+        """Returns whether a fresh stream is in place (False while the
+        re-open is backed off or failing).  Non-blocking backoff gate
+        (the informer's _reopen_not_before pattern): a failed re-open
+        during an outage must defer the next attempt, not sleep the
+        caller — _pump_events runs on the synchronous drain() path and
+        the watch threads share their cadence with shutdown
+        responsiveness."""
+        now = time.monotonic()
+        if now < self._watch_reopen_not_before.get(attr, 0.0):
+            return False
+        av, kind = self._WATCH_GVKS[attr]
+        if err is not None:
+            log.warning(
+                "trigger watch %s died (%s: %s); re-establishing",
+                kind, type(err).__name__, err,
+            )
+        else:
+            log.info("trigger watch %s ended; re-establishing", kind)
+        try:
+            getattr(self, attr).stop()
+        except Exception:   # noqa: BLE001 — already-dead stream
+            pass
+        try:
+            setattr(self, attr, self.client.watch(av, kind))
+        except Exception as e:   # noqa: BLE001 — apiserver still down
+            log.warning(
+                "trigger watch %s re-open failed (retry in %.1fs): %s",
+                kind, self.WATCH_REOPEN_BACKOFF, e,
+            )
+            self._watch_reopen_not_before[attr] = (
+                now + self.WATCH_REOPEN_BACKOFF
+            )
+            return False
+        self._watch_reopen_not_before.pop(attr, None)
+        if self.metrics:
+            self.metrics.inc(
+                "tpunet_watch_restarts_total", {"kind": kind}
+            )
+        # catch-up: events (and their reconciles) lost while the stream
+        # was dead are replayed by re-enqueueing every policy — the
+        # workqueue dedups, so this is cheap when nothing changed
+        try:
+            for obj in self.client.list(
+                API_VERSION, NetworkClusterPolicy.KIND, limit=LIST_PAGE_SIZE
+            ):
+                self.enqueue(obj["metadata"]["name"])
+        except Exception as e:   # noqa: BLE001 — resync loop will cover
+            log.warning("post-restart policy relist failed: %s", e)
+        return True
+
     def _watch_policies(self) -> None:
         while not self._stop.is_set():
-            ev = self._w_policies.next(timeout=0.2)
-            if ev is not None:
-                self._handle_policy_event(ev)
+            self._next_trigger(
+                "_w_policies", self._handle_policy_event, 0.2
+            )
         self._w_policies.stop()
 
     def _watch_daemonsets(self) -> None:
         while not self._stop.is_set():
-            ev = self._w_daemonsets.next(timeout=0.2)
-            if ev is not None:
-                self._handle_daemonset_event(ev)
+            self._next_trigger(
+                "_w_daemonsets", self._handle_daemonset_event, 0.2
+            )
         self._w_daemonsets.stop()
 
     # -- run ------------------------------------------------------------------
@@ -258,13 +345,38 @@ class Manager:
                     self._schedule_requeue(name, result.requeue_after)
                 else:
                     self.enqueue(name)
-        except Exception:
-            log.exception("reconcile failed for %s; requeueing with backoff", name)
+        except Exception as e:   # noqa: BLE001 — classified below
             if span is not None:
                 span.set_status("error").set_attribute("result", "error")
             if self.metrics:
                 self.metrics.inc("tpunet_reconcile_total", {"result": "error"})
-            self._requeue_after_failure(name)
+            if kerr.is_transient(e):
+                # transient (throttle/outage/conflict): rate-limited
+                # requeue — the failure clears on its own, keep trying
+                log.warning(
+                    "reconcile of %s failed transiently (%s: %s); "
+                    "requeueing with backoff", name, type(e).__name__, e,
+                )
+                self._requeue_after_failure(name)
+            else:
+                # permanent (bad spec, denied write, a bug): an
+                # exponential hot-loop from 5ms would burn a worker and
+                # the apiserver reproducing the same answer — surface
+                # it (Event + Degraded condition) and recheck at the
+                # backoff CEILING in case the world changes
+                log.exception(
+                    "reconcile of %s failed permanently; surfacing and "
+                    "requeueing at max backoff", name,
+                )
+                if self.metrics:
+                    self.metrics.inc(
+                        "tpunet_reconcile_permanent_errors_total",
+                        {"reason": type(e).__name__},
+                    )
+                self.reconciler.record_permanent_failure(
+                    name, f"{type(e).__name__}: {e}"
+                )
+                self._schedule_requeue(name, self._backoff_max)
         finally:
             if span is not None:
                 span.__exit__(None, None, None)
@@ -321,17 +433,25 @@ class Manager:
     # -- synchronous drive for tests ------------------------------------------
 
     def _pump_events(self) -> None:
-        """Move all immediately-available watch events into the workqueue."""
-        while True:
-            ev = self._w_policies.next(timeout=0)
-            if ev is None:
-                break
-            self._handle_policy_event(ev)
-        while True:
-            ev = self._w_daemonsets.next(timeout=0)
-            if ev is None:
-                break
-            self._handle_daemonset_event(ev)
+        """Move all immediately-available watch events into the workqueue.
+        Same dead-stream contract as the background loops: a raising
+        watch is re-established instead of wedging the drain."""
+        for attr, handler in (
+            ("_w_policies", self._handle_policy_event),
+            ("_w_daemonsets", self._handle_daemonset_event),
+        ):
+            while True:
+                w = getattr(self, attr)
+                try:
+                    ev = w.next(timeout=0)
+                except Exception as e:   # noqa: BLE001 — dead stream
+                    self._restart_trigger_watch(attr, e)
+                    break
+                if ev is None:
+                    if w.stopped and not self._stop.is_set():
+                        self._restart_trigger_watch(attr, None)
+                    break
+                handler(ev)
 
     def drain(self, max_iters: int = 100) -> int:
         """Pump watch events + process queued work synchronously until quiet.

@@ -487,6 +487,37 @@ class NetworkClusterPolicyReconciler:
                 self._policy_ref(policy), event_type, reason, message
             )
 
+    def record_permanent_failure(self, name: str, message: str) -> None:
+        """The manager's permanent-failure surface: a Warning Event plus
+        the ReconcileDegraded=True condition on the CR, best-effort (the
+        failure may BE apiserver-side, in which case logs still carry
+        it).  Cleared by the next successful reconcile in
+        :meth:`_update_status`."""
+        try:
+            raw = self.client.get(
+                t.API_VERSION, NetworkClusterPolicy.KIND, name
+            )
+            policy = NetworkClusterPolicy.from_dict(raw)
+        except Exception as e:   # noqa: BLE001 — best-effort surface
+            log.debug("permanent-failure surface: CR read failed: %s", e)
+            return
+        self._emit(
+            policy, obs_events.TYPE_WARNING, "ReconcileFailed",
+            f"reconcile failed permanently (will recheck on ceiling "
+            f"backoff): {message}",
+        )
+        before = am.to_dict(policy.status.conditions)
+        self._set_condition(
+            policy.status, t.CONDITION_RECONCILE_DEGRADED,
+            "True", "PermanentError", message[:512],
+        )
+        if am.to_dict(policy.status.conditions) == before:
+            return   # identical condition already set: no status churn
+        try:
+            self.client.update_status(policy.to_dict())
+        except Exception as e:   # noqa: BLE001 — best-effort surface
+            log.debug("permanent-failure surface: status write failed: %s", e)
+
     @staticmethod
     def _stamp_trace(obj: Dict[str, Any]) -> None:
         """Stamp the active trace ID onto an object this reconcile is
@@ -1218,6 +1249,21 @@ class NetworkClusterPolicyReconciler:
         old_conditions = am.to_dict(policy.status.conditions)
         old_telemetry = am.to_dict(policy.status.telemetry)
         old_versions = dict(policy.status.agent_versions)
+        # reaching a status pass IS a successful reconcile: clear any
+        # ReconcileDegraded condition a past permanent failure parked
+        # here (the conditions diff below flushes the change)
+        if any(
+            c.type == t.CONDITION_RECONCILE_DEGRADED
+            for c in policy.status.conditions
+        ):
+            policy.status.conditions = [
+                c for c in policy.status.conditions
+                if c.type != t.CONDITION_RECONCILE_DEGRADED
+            ]
+            self._emit(
+                policy, obs_events.TYPE_NORMAL, "ReconcileRecovered",
+                "reconcile succeeding again; ReconcileDegraded cleared",
+            )
         probe_requeue = 0.0
         if self._probe_enabled(policy):
             self._sync_probe_peers(policy, reports)

@@ -3,7 +3,8 @@
 The reference leans on controller-runtime + envtest; neither exists here, so
 this package provides the same seams from scratch:
 
-* :mod:`.errors`  — typed API errors (NotFound/Conflict/AlreadyExists/...).
+* :mod:`.errors`  — typed API errors (NotFound/Conflict/AlreadyExists/...)
+  with the retryable/transient classification the retry layer rides.
 * :mod:`.fake`    — in-memory apiserver with watches, admission hooks,
   owner-reference GC, field indexers and a DaemonSet/node simulator; the
   test-time integration surface (envtest analog, SURVEY.md §4.2).
@@ -13,6 +14,10 @@ this package provides the same seams from scratch:
   :class:`~.informer.CachedClient` (reads from cache, writes through),
   the controller-runtime cache layer that flattens steady-state
   apiserver traffic to the watch streams alone.
+* :mod:`.retry`   — :class:`~.retry.RetryingClient`, the ONE place retry
+  policy lives (client-go's rest retry / workqueue backoff analog).
+* :mod:`.chaos`   — :class:`~.chaos.FaultInjector`, the deterministic
+  fault-injection seam every resilience behavior is proven against.
 """
 
 from .errors import (  # noqa: F401
@@ -21,7 +26,14 @@ from .errors import (  # noqa: F401
     AlreadyExistsError,
     ConflictError,
     AdmissionDeniedError,
+    ServiceUnavailableError,
+    TooManyRequestsError,
+    TransportError,
     ignore_not_found,
+    is_retryable,
+    is_transient,
 )
 from .fake import FakeCluster  # noqa: F401
 from .informer import CachedClient, Informer, Store  # noqa: F401
+from .chaos import FaultInjector  # noqa: F401
+from .retry import RetryingClient  # noqa: F401

@@ -1,0 +1,272 @@
+"""Deterministic fault injection for the kube client seam.
+
+Every resilience behavior in this operator — client retries, informer
+watch re-establishment, the manager's transient/permanent requeue split,
+the agent's outage-safe degraded mode, leader-election handover — must be
+provable WITHOUT a real misbehaving apiserver.  :class:`FaultInjector`
+wraps anything speaking the client interface (:class:`..kube.fake.
+FakeCluster`, :class:`..kube.client.ApiClient`, or a
+:class:`..kube.informer.CachedClient`'s inner client) and injects typed
+faults on the request path:
+
+* 429 TooManyRequests (with a Retry-After hint),
+* 500 InternalError / 503 ServiceUnavailable,
+* connection timeouts and refused connections (:class:`~.errors.
+  TransportError`),
+* added per-request latency,
+* watch-stream drops (the stream raises mid-flight) and 410 Expired on
+  watch (re-)establishment,
+* full-outage windows (every verb fails until the window closes).
+
+Determinism: one seeded ``random.Random`` drives every rate roll, so a
+given (seed, request sequence) always injects the same faults — the
+chaos bench and the regression tests are reproducible.  Scheduling is
+explicit (rules added/removed, outages begun/ended by the driver), not
+wall-clock-based, so tests control the timeline.
+
+The injector also counts what it injected (``injected`` Counter keyed by
+``(fault, verb, kind)``) so tests can assert "the retries the metrics
+report are exactly the faults I injected".
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import Counter
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from . import errors as kerr
+
+# fault kinds a rule may inject on the request path
+FAULT_429 = "429"
+FAULT_500 = "500"
+FAULT_503 = "503"
+FAULT_TIMEOUT = "timeout"       # TransportError (socket timeout shape)
+FAULT_CONFLICT = "conflict"     # optimistic-concurrency loss (409)
+FAULT_LATENCY = "latency"       # no error; per-request added latency
+REQUEST_FAULTS = (FAULT_429, FAULT_500, FAULT_503, FAULT_TIMEOUT,
+                  FAULT_CONFLICT, FAULT_LATENCY)
+
+
+def _make_error(fault: str, retry_after: Optional[float]) -> Exception:
+    if fault == FAULT_429:
+        return kerr.TooManyRequestsError(
+            "injected: too many requests", retry_after=retry_after
+        )
+    if fault == FAULT_503:
+        return kerr.ServiceUnavailableError(
+            "injected: service unavailable", retry_after=retry_after
+        )
+    if fault == FAULT_500:
+        return kerr.ApiError("injected: internal error")
+    if fault == FAULT_TIMEOUT:
+        return kerr.TransportError("injected: connection timed out")
+    if fault == FAULT_CONFLICT:
+        return kerr.ConflictError("injected: resourceVersion conflict")
+    raise ValueError(f"unknown fault kind {fault!r}")
+
+
+@dataclass
+class FaultRule:
+    """One injection rule.  ``verb``/``kind`` match per request (``"*"``
+    = any); ``rate`` is the per-request injection probability; ``count``
+    bounds total injections (None = unlimited); ``latency`` adds seconds
+    of delay whether or not an error fires (the error-free latency rule
+    is ``fault=FAULT_LATENCY``)."""
+
+    fault: str
+    verb: str = "*"
+    kind: str = "*"
+    rate: float = 1.0
+    count: Optional[int] = None
+    retry_after: Optional[float] = None
+    latency: float = 0.0
+
+    def matches(self, verb: str, kind: str) -> bool:
+        return (
+            self.verb in ("*", verb)
+            and self.kind in ("*", kind)
+            and (self.count is None or self.count > 0)
+        )
+
+
+class ChaosWatch:
+    """A watch stream under the injector: proxies the inner Watch until
+    the injector drops it, after which every ``next()`` raises the drop
+    error (a dead TCP stream fails every read) until the consumer
+    ``stop()``s it and re-establishes through the client."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self._fault: Optional[Exception] = None
+
+    @property
+    def stopped(self) -> bool:
+        return self.inner.stopped
+
+    def drop(self, err: Exception) -> None:
+        self._fault = err
+
+    def push(self, ev_type, obj) -> None:
+        self.inner.push(ev_type, obj)
+
+    def next(self, timeout: Optional[float] = None):
+        if self._fault is not None:
+            raise self._fault
+        return self.inner.next(timeout=timeout)
+
+    def stop(self) -> None:
+        self.inner.stop()
+
+
+class FaultInjector:
+    """Client wrapper injecting per-verb/per-kind faults on a schedule.
+
+    Drop-in for the wrapped client: the reconcile stack (manager,
+    reconciler, informers, leader elector, agent reporting) runs
+    unmodified above it.  Everything not part of the verb seam
+    (``add_node``, ``events()``, ``dump()``, ``request_counts``, ...)
+    passes through via ``__getattr__``.
+    """
+
+    def __init__(self, inner, seed: int = 0,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.inner = inner
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._rules: List[FaultRule] = []
+        self._outage = False
+        self._watches: List[ChaosWatch] = []
+        # what actually fired: (fault, verb, kind) -> count
+        self.injected: Counter = Counter()
+
+    # -- schedule -------------------------------------------------------------
+
+    def add_rule(self, rule: FaultRule) -> FaultRule:
+        if rule.fault not in REQUEST_FAULTS:
+            raise ValueError(f"unknown fault kind {rule.fault!r}")
+        with self._lock:
+            self._rules.append(rule)
+        return rule
+
+    def inject(self, fault: str, verb: str = "*", kind: str = "*",
+               rate: float = 1.0, count: Optional[int] = None,
+               retry_after: Optional[float] = None,
+               latency: float = 0.0) -> FaultRule:
+        """Convenience: build + add one rule."""
+        return self.add_rule(FaultRule(
+            fault=fault, verb=verb, kind=kind, rate=rate, count=count,
+            retry_after=retry_after, latency=latency,
+        ))
+
+    def clear_rules(self) -> None:
+        with self._lock:
+            self._rules.clear()
+
+    def begin_outage(self) -> None:
+        """Full apiserver outage: every verb (and every live watch
+        stream) fails with TransportError until :meth:`end_outage`."""
+        with self._lock:
+            self._outage = True
+            watches = list(self._watches)
+        for w in watches:
+            w.drop(kerr.TransportError("injected: apiserver outage"))
+
+    def end_outage(self) -> None:
+        with self._lock:
+            self._outage = False
+
+    @property
+    def in_outage(self) -> bool:
+        return self._outage
+
+    def drop_watches(self, expired: bool = False) -> int:
+        """Kill every live watch stream: the next read raises — a
+        TransportError (stream reset) or, with ``expired=True``, the 410
+        Expired that forces a relist.  Returns how many were dropped."""
+        err: Exception = (
+            kerr.ExpiredError("injected: too old resource version")
+            if expired
+            else kerr.TransportError("injected: watch stream reset")
+        )
+        with self._lock:
+            watches = [w for w in self._watches if not w.stopped]
+        for w in watches:
+            w.drop(err)
+            self.injected[("watch-drop", "watch", "*")] += 1
+        return len(watches)
+
+    # -- request path ---------------------------------------------------------
+
+    def _maybe_fault(self, verb: str, kind: str) -> None:
+        if self._outage:
+            self.injected[("outage", verb, kind)] += 1
+            raise kerr.TransportError("injected: apiserver outage")
+        with self._lock:
+            rules = [r for r in self._rules if r.matches(verb, kind)]
+        for rule in rules:
+            if rule.rate < 1.0 and self._rng.random() >= rule.rate:
+                continue
+            with self._lock:
+                if rule.count is not None:
+                    if rule.count <= 0:
+                        continue
+                    rule.count -= 1
+            if rule.latency > 0:
+                self._sleep(rule.latency)
+            self.injected[(rule.fault, verb, kind)] += 1
+            if rule.fault != FAULT_LATENCY:
+                raise _make_error(rule.fault, rule.retry_after)
+
+    # -- client interface -----------------------------------------------------
+
+    def get(self, api_version: str, kind: str, name: str, namespace: str = ""):
+        self._maybe_fault("get", kind)
+        return self.inner.get(api_version, kind, name, namespace)
+
+    def list(self, api_version: str, kind: str, *args, **kwargs):
+        self._maybe_fault("list", kind)
+        return self.inner.list(api_version, kind, *args, **kwargs)
+
+    def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        self._maybe_fault("create", obj.get("kind", ""))
+        return self.inner.create(obj)
+
+    def update(self, obj: Dict[str, Any], **kwargs) -> Dict[str, Any]:
+        self._maybe_fault("update", obj.get("kind", ""))
+        return self.inner.update(obj, **kwargs)
+
+    def update_status(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        self._maybe_fault("update", obj.get("kind", ""))
+        return self.inner.update_status(obj)
+
+    def apply(self, obj: Dict[str, Any], **kwargs) -> Any:
+        self._maybe_fault("patch", obj.get("kind", ""))
+        return self.inner.apply(obj, **kwargs)
+
+    def delete(self, api_version: str, kind: str, name: str, namespace: str = ""):
+        self._maybe_fault("delete", kind)
+        return self.inner.delete(api_version, kind, name, namespace)
+
+    def watch(self, api_version: str, kind: str, **kwargs):
+        self._maybe_fault("watch", kind)
+        w = ChaosWatch(self.inner.watch(api_version, kind, **kwargs))
+        with self._lock:
+            # prune streams the consumer already stopped so a chaos run
+            # that drops/re-opens for hours cannot grow this unbounded
+            self._watches = [x for x in self._watches if not x.stopped]
+            self._watches.append(w)
+        return w
+
+    def register_index(self, api_version: str, kind: str, name: str,
+                       fn: Callable) -> None:
+        self.inner.register_index(api_version, kind, name, fn)
+
+    def __getattr__(self, name: str):
+        # everything outside the verb seam (test conveniences,
+        # request_counts, metrics, close, ...) passes through
+        return getattr(self.inner, name)

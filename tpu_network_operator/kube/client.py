@@ -16,6 +16,7 @@ contract at the same cost as controller-runtime's cache index.
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import ssl
@@ -45,6 +46,54 @@ _PLURALS = {
 }
 
 CLUSTER_SCOPED_KINDS = {"NetworkClusterPolicy", "Node", "Namespace"}
+
+
+def _retry_after_seconds(headers) -> Optional[float]:
+    """Parse a Retry-After response header into seconds (delta form
+    only — the HTTP-date form is vanishingly rare from kube-apiserver,
+    which emits integers); None when absent or unparseable."""
+    try:
+        raw = headers.get("Retry-After") if headers is not None else None
+    except Exception:   # noqa: BLE001 — headers shape varies by stack
+        return None
+    if raw is None:
+        return None
+    try:
+        val = float(str(raw).strip())
+    except ValueError:
+        return None
+    return val if val >= 0 else None
+
+
+def _map_http_error(e: "urllib.error.HTTPError", detail: str) -> Exception:
+    """HTTPError -> typed ApiError for the status codes shared by every
+    request path (the resource paths add their own 404/409/422 mapping
+    first).  429/503 carry the Retry-After hint for the retry layer."""
+    if e.code == 429:
+        return kerr.TooManyRequestsError(
+            detail, retry_after=_retry_after_seconds(e.headers)
+        )
+    if e.code == 503:
+        return kerr.ServiceUnavailableError(
+            detail, retry_after=_retry_after_seconds(e.headers)
+        )
+    err = kerr.ApiError(f"{e.code}: {detail}")
+    # stamp the REAL status code over the class default (500): an
+    # unmapped 4xx (401 expired token, 403, 405, ...) must classify as
+    # a permanent answer, not a retryable server fault — otherwise an
+    # auth failure burns the whole retry budget on every request
+    err.code = e.code
+    return err
+
+
+def _map_transport_error(e: Exception) -> kerr.TransportError:
+    """Connection-level failure -> TransportError.  Raw URLError/socket
+    exceptions must never leak to callers: the retry layer (and every
+    ``except ApiError`` site above it) classifies on the typed
+    hierarchy, and an unmapped OSError would read as a bug instead of a
+    dead wire."""
+    reason = getattr(e, "reason", None)
+    return kerr.TransportError(f"{type(e).__name__}: {reason or e}")
 
 
 def plural(kind: str) -> str:
@@ -242,7 +291,15 @@ class ApiClient:
                 # CRD structural-schema rejection (real apiserver only —
                 # the wire server has no OpenAPI validator)
                 raise kerr.InvalidError(detail) from None
-            raise kerr.ApiError(f"{e.code}: {detail}") from None
+            raise _map_http_error(e, detail) from None
+        except (urllib.error.URLError, TimeoutError, OSError,
+                http.client.HTTPException, json.JSONDecodeError) as e:
+            # no usable HTTP answer: refused/reset/DNS/timeout, a
+            # connection dying mid-response (IncompleteRead/
+            # BadStatusLine are HTTPException, NOT OSError), or a
+            # truncated body that no longer parses — all the same dead
+            # wire to the retry layer
+            raise _map_transport_error(e) from None
 
     # -- FakeCluster-compatible interface -------------------------------------
 
@@ -362,7 +419,10 @@ class ApiClient:
             detail = e.read().decode(errors="replace")[:512]
             if e.code == 409:
                 raise kerr.ConflictError(detail) from None
-            raise kerr.ApiError(f"{e.code}: {detail}") from None
+            raise _map_http_error(e, detail) from None
+        except (urllib.error.URLError, TimeoutError, OSError,
+                http.client.HTTPException, json.JSONDecodeError) as e:
+            raise _map_transport_error(e) from None
 
     def delete(self, api_version: str, kind: str, name: str, namespace: str = ""):
         return self._request(
@@ -417,8 +477,20 @@ class ApiClient:
                         obj = ev.get("object", {})
                         rv = obj.get("metadata", {}).get("resourceVersion", rv)
                         if ev.get("type") == "ERROR":
-                            rv = ""   # 410 Gone: relist from now
-                            break
+                            # 410 Gone: the resume window is compacted —
+                            # continuity is UNPROVABLE.  Die loudly
+                            # (stop the stream) so the consumer
+                            # (informer/manager) re-establishes WITH a
+                            # relist; the old silent resume-"from now"
+                            # dropped the gap's events — deletions
+                            # included — on the floor forever.
+                            log.warning(
+                                "watch %s/%s got 410 Expired; ending "
+                                "stream for consumer relist",
+                                api_version, kind,
+                            )
+                            w.stop()
+                            return
                         w.push(ev.get("type", "MODIFIED"), obj)
             except Exception as e:   # noqa: BLE001 — reconnect on any error
                 if w.stopped or self._stopping.is_set():

@@ -47,6 +47,41 @@ class AdmissionDeniedError(ApiError):
     reason = "AdmissionDenied"
 
 
+class TooManyRequestsError(ApiError):
+    """apiserver throttling (429).  ``retry_after`` carries the server's
+    Retry-After hint in seconds when the response named one — the retry
+    layer honors it over its own backoff schedule."""
+
+    code = 429
+    reason = "TooManyRequests"
+
+    def __init__(self, message: str = "", retry_after: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
+class ServiceUnavailableError(ApiError):
+    """apiserver temporarily down/overloaded (503) — e.g. mid
+    etcd-leader election or behind a restarting load balancer.  Like
+    429, may carry a Retry-After hint."""
+
+    code = 503
+    reason = "ServiceUnavailable"
+
+    def __init__(self, message: str = "", retry_after: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
+class TransportError(ApiError):
+    """The request never produced an HTTP answer: connection refused,
+    reset, DNS failure, or a socket timeout.  code 0 — there is no
+    status code when the wire itself failed."""
+
+    code = 0
+    reason = "Transport"
+
+
 class InvalidError(ApiError):
     """The apiserver's structural (CRD OpenAPI) schema rejected the
     object — kube's 422 Unprocessable Entity / reason Invalid.  A
@@ -55,6 +90,48 @@ class InvalidError(ApiError):
 
     code = 422
     reason = "Invalid"
+
+
+def is_retryable(err: Exception) -> bool:
+    """Whether blindly re-issuing the SAME request can succeed — the
+    client-retry classification (client-go's IsTooManyRequests /
+    IsServiceUnavailable / IsInternalError / net.IsConnectionReset
+    family).  429/503/transport failures and generic 5xx qualify;
+    NotFound/Conflict/AlreadyExists/AdmissionDenied/Invalid/Expired do
+    not: they are answers about the request's content, and retrying the
+    identical request reproduces the identical answer."""
+    if isinstance(err, (TooManyRequestsError, ServiceUnavailableError,
+                        TransportError)):
+        return True
+    if isinstance(err, (NotFoundError, AlreadyExistsError, ConflictError,
+                        AdmissionDeniedError, InvalidError, ExpiredError)):
+        return False
+    # base ApiError (or an unknown subclass): retryable iff a server
+    # fault (5xx).  ApiError("...") defaults to code 500 — the wire
+    # client raises exactly that for unmapped 5xx bodies.
+    if isinstance(err, ApiError):
+        return err.code >= 500
+    return False
+
+
+def is_transient(err: Exception) -> bool:
+    """Whether the FAILURE (not the request) is expected to clear on its
+    own — the requeue classification the manager uses.  Everything
+    retryable is transient; so are Conflict (a concurrent writer won —
+    re-read and try again) and Expired (relist and resume).  What is
+    left — NotFound, AlreadyExists, AdmissionDenied, Invalid, and
+    non-API exceptions (bugs) — will fail identically every pass until
+    something else changes, i.e. permanent for backoff purposes."""
+    return is_retryable(err) or isinstance(err, (ConflictError, ExpiredError))
+
+
+def retry_after_of(err: Exception) -> Optional[float]:
+    """The server's Retry-After hint in seconds, when the error carries
+    a usable one (None otherwise)."""
+    ra = getattr(err, "retry_after", None)
+    if isinstance(ra, (int, float)) and not isinstance(ra, bool) and ra >= 0:
+        return float(ra)
+    return None
 
 
 def is_not_found(err: Exception) -> bool:

@@ -186,6 +186,15 @@ class Informer:
         self.store = Store()
         self._watch = None
         self._synced = False
+        self._closed = False
+        # watch re-establishment bookkeeping: a dead stream (raise, 410
+        # Expired, or server-side end) is re-opened and followed by a
+        # relist; a failed re-open backs off REOPEN_BACKOFF so an
+        # apiserver outage cannot hot-loop the pump.  ``restarts`` is
+        # the test/bench-visible count.
+        self._reopen_not_before = 0.0
+        self._needs_resync = False
+        self.restarts = 0
         # RLock: an event handler may read back through the informer
         self._pump_lock = threading.RLock()
         # set while a resync LIST is in flight; _apply records the keys
@@ -196,22 +205,31 @@ class Informer:
 
     # -- lifecycle -------------------------------------------------------------
 
+    # a failed watch re-open (apiserver outage) is not retried for this
+    # many seconds — the pump polls every 50ms and must not burn a
+    # connection attempt per poll against a dead apiserver
+    REOPEN_BACKOFF = 1.0
+
+    def _open_watch(self):
+        try:
+            return self.client.watch(
+                self.api_version, self.kind, namespace=self.namespace
+            )
+        except TypeError:
+            # FakeCluster.watch is GVK-wide (no namespace parameter);
+            # _apply filters by namespace instead
+            return self.client.watch(self.api_version, self.kind)
+
     def start(self) -> "Informer":
         """Open the watch, then seed the store with one chunked LIST."""
         if self._watch is None:
-            try:
-                self._watch = self.client.watch(
-                    self.api_version, self.kind, namespace=self.namespace
-                )
-            except TypeError:
-                # FakeCluster.watch is GVK-wide (no namespace parameter);
-                # _apply filters by namespace instead
-                self._watch = self.client.watch(self.api_version, self.kind)
+            self._watch = self._open_watch()
         self.resync()
         self._synced = True
         return self
 
     def stop(self) -> None:
+        self._closed = True
         if self._watch is not None:
             self._watch.stop()
 
@@ -273,17 +291,103 @@ class Informer:
     def sync(self) -> int:
         """Drain every immediately-available watch event into the store
         (non-blocking).  Called before each cached read, so a read always
-        observes everything the apiserver has already streamed."""
+        observes everything the apiserver has already streamed.
+
+        A watch stream that raises (reset, injected fault, 410 Expired)
+        or ends without us stopping it is DEAD — the old behavior of
+        logging and returning left the store silently frozen while
+        reads kept serving it as fresh.  Here the stream is re-opened
+        and a relist catches the store up (watch-gap events, including
+        deletions, cannot be replayed any other way); re-open failures
+        back off so an apiserver outage does not hot-loop the pump."""
         if self._watch is None:
             return 0
         n = 0
         with self._pump_lock:
+            if self._needs_resync:
+                # a previous restart could not complete its relist
+                # (apiserver still down) — the store may hold stale
+                # state; retry before serving more reads
+                self._try_resync()
             while True:
-                ev = self._watch.next(timeout=0)
+                try:
+                    ev = self._watch.next(timeout=0)
+                except Exception as e:   # noqa: BLE001 — dead stream
+                    self._restart_watch(e)
+                    return n
                 if ev is None:
+                    if self._watch.stopped and not self._closed:
+                        # server ended the stream (watch timeout /
+                        # apiserver restart); not an error, same hole
+                        self._restart_watch(None)
                     return n
                 self._apply(*ev)
                 n += 1
+
+    def _restart_watch(self, err: Optional[Exception]) -> None:
+        """Re-establish a dead watch + relist (caller holds _pump_lock).
+        410 Expired is the designed path (resume window compacted →
+        relist); anything else is a transport death with the same
+        remedy."""
+        import time as time_mod
+
+        now = time_mod.monotonic()
+        if now < self._reopen_not_before:
+            return
+        if err is not None:
+            log.warning(
+                "watch %s/%s died (%s: %s); re-establishing with relist",
+                self.api_version, self.kind, type(err).__name__, err,
+            )
+        else:
+            log.info(
+                "watch %s/%s ended; re-establishing with relist",
+                self.api_version, self.kind,
+            )
+        try:
+            self._watch.stop()
+        except Exception:   # noqa: BLE001 — already-dead stream
+            pass
+        try:
+            self._watch = self._open_watch()
+        except Exception as e:   # noqa: BLE001 — apiserver still down
+            log.warning(
+                "watch %s/%s re-open failed (retry in %.1fs): %s",
+                self.api_version, self.kind, self.REOPEN_BACKOFF, e,
+            )
+            self._reopen_not_before = now + self.REOPEN_BACKOFF
+            self._needs_resync = True
+            return
+        self.restarts += 1
+        if self.metrics:
+            self.metrics.inc(
+                "tpunet_watch_restarts_total", {"kind": self.kind}
+            )
+        # relist AFTER the new watch opens (same no-gap ordering as
+        # start()): everything missed while dead — including deletions —
+        # is reconciled into the store
+        self._needs_resync = True
+        self._try_resync()
+
+    def _try_resync(self) -> None:
+        """One relist attempt for a pending watch-restart catch-up;
+        failure keeps the flag so the next sync retries."""
+        import time as time_mod
+
+        if time_mod.monotonic() < self._reopen_not_before:
+            return
+        try:
+            self.resync()
+        except Exception as e:   # noqa: BLE001 — apiserver still down
+            log.warning(
+                "post-restart relist of %s failed (will retry): %s",
+                self.kind, e,
+            )
+            self._reopen_not_before = (
+                time_mod.monotonic() + self.REOPEN_BACKOFF
+            )
+            return
+        self._needs_resync = False
 
     def resync(self) -> None:
         """Full relist: upsert everything live, prune everything gone.

@@ -1,0 +1,191 @@
+"""Centralized client retry policy (client-go rest retry analog).
+
+Every component that talks to the apiserver — manager seed lists, the
+reconciler's read/write path, leader election, agent report publishing —
+rides :class:`RetryingClient` instead of hand-rolling retry loops.  The
+policy in one place:
+
+* retry only what :func:`..kube.errors.is_retryable` says can succeed on
+  a blind re-issue (429/503/5xx/transport); content answers (NotFound,
+  Conflict, AdmissionDenied, Invalid, ...) surface immediately — their
+  handling is the CALLER's semantic (requeue, re-read, give up);
+* exponential backoff with FULL jitter (``uniform(0, min(cap, base*2^n))``
+  — the AWS-architecture-blog schedule client-go's workqueue also
+  approximates), so a thundering herd of retriers decorrelates;
+* a server Retry-After hint overrides the computed backoff (the server
+  knows its own recovery horizon better than our schedule does);
+* a per-request attempt AND elapsed-time budget: a caller with its own
+  deadline (a lease renew, a monitor tick) must never hang on an outage;
+* ``tpunet_client_retries_total{verb,kind,reason}`` and
+  ``tpunet_client_gave_up_total{verb,kind}`` metrics, so every retry
+  burst and every exhausted budget is visible on /metrics.
+
+``watch`` retries only the stream ESTABLISHMENT — a live stream's death
+is the informer's re-establishment job, not a request retry.
+
+The lint gate (tools/lint.py R001) rejects ``except ApiError`` retry
+loops anywhere else in the package, so this stays the one copy.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+from typing import Any, Callable, Dict, Optional
+
+from . import errors as kerr
+
+log = logging.getLogger("tpunet.kube.retry")
+
+DEFAULT_MAX_ATTEMPTS = 5
+DEFAULT_BACKOFF_BASE = 0.1      # seconds; doubles per attempt
+DEFAULT_BACKOFF_CAP = 5.0       # per-sleep ceiling
+DEFAULT_BUDGET = 15.0           # max elapsed seconds incl. sleeps
+
+
+class RetryingClient:
+    """Client wrapper: same interface as the wrapped client, with the
+    retry policy above applied to every verb.
+
+    Seams for tests/bench: ``sleep``/``clock`` (manual time) and ``rng``
+    (deterministic jitter).  ``metrics`` is any object with
+    ``inc(name, labels)`` (:class:`...controller.health.Metrics`).
+    """
+
+    def __init__(
+        self,
+        inner,
+        max_attempts: int = DEFAULT_MAX_ATTEMPTS,
+        backoff_base: float = DEFAULT_BACKOFF_BASE,
+        backoff_cap: float = DEFAULT_BACKOFF_CAP,
+        budget: float = DEFAULT_BUDGET,
+        metrics=None,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+        rng: Optional[random.Random] = None,
+    ):
+        self.inner = inner
+        self.max_attempts = max(1, int(max_attempts))
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.budget = budget
+        self.metrics = metrics
+        self._sleep = sleep
+        self._clock = clock
+        self._rng = rng or random.Random()
+
+    # -- policy core ----------------------------------------------------------
+
+    def _backoff(self, attempt: int, err: Exception) -> float:
+        """Sleep before attempt ``attempt+1`` (0-based failed attempt):
+        the server's Retry-After when given, else full jitter."""
+        hinted = kerr.retry_after_of(err)
+        if hinted is not None:
+            return min(hinted, self.backoff_cap)
+        ceiling = min(self.backoff_cap, self.backoff_base * (2 ** attempt))
+        return self._rng.uniform(0.0, ceiling)
+
+    def _call(self, verb: str, kind: str, fn: Callable[[], Any]) -> Any:
+        start = self._clock()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:   # noqa: BLE001 — classified below
+                if not kerr.is_retryable(e):
+                    raise
+                reason = getattr(e, "reason", "") or type(e).__name__
+                attempt += 1
+                delay = self._backoff(attempt - 1, e)
+                elapsed = self._clock() - start
+                if (
+                    attempt >= self.max_attempts
+                    or elapsed + delay > self.budget
+                ):
+                    if self.metrics:
+                        self.metrics.inc(
+                            "tpunet_client_gave_up_total",
+                            {"verb": verb, "kind": kind},
+                        )
+                    log.warning(
+                        "%s %s gave up after %d attempt(s) / %.1fs: %s",
+                        verb, kind, attempt, elapsed, e,
+                    )
+                    raise
+                if self.metrics:
+                    self.metrics.inc(
+                        "tpunet_client_retries_total",
+                        {"verb": verb, "kind": kind, "reason": reason},
+                    )
+                log.debug(
+                    "%s %s attempt %d failed (%s); retrying in %.3fs",
+                    verb, kind, attempt, reason, delay,
+                )
+                if delay > 0:
+                    self._sleep(delay)
+
+    # -- client interface -----------------------------------------------------
+
+    def get(self, api_version: str, kind: str, name: str, namespace: str = ""):
+        return self._call(
+            "get", kind,
+            lambda: self.inner.get(api_version, kind, name, namespace),
+        )
+
+    def list(self, api_version: str, kind: str, *args, **kwargs):
+        return self._call(
+            "list", kind,
+            lambda: self.inner.list(api_version, kind, *args, **kwargs),
+        )
+
+    def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        # NB: a create whose FIRST send actually landed (answer lost on
+        # the wire) surfaces AlreadyExists on the retry — that is not
+        # retryable and propagates; every create caller in this repo
+        # already treats AlreadyExists as success-by-another-writer.
+        return self._call(
+            "create", obj.get("kind", ""), lambda: self.inner.create(obj)
+        )
+
+    def update(self, obj: Dict[str, Any], **kwargs) -> Dict[str, Any]:
+        return self._call(
+            "update", obj.get("kind", ""),
+            lambda: self.inner.update(obj, **kwargs),
+        )
+
+    def update_status(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self._call(
+            "update", obj.get("kind", ""),
+            lambda: self.inner.update_status(obj),
+        )
+
+    def apply(self, obj: Dict[str, Any], **kwargs) -> Any:
+        return self._call(
+            "patch", obj.get("kind", ""),
+            lambda: self.inner.apply(obj, **kwargs),
+        )
+
+    def delete(self, api_version: str, kind: str, name: str,
+               namespace: str = ""):
+        return self._call(
+            "delete", kind,
+            lambda: self.inner.delete(api_version, kind, name, namespace),
+        )
+
+    def watch(self, api_version: str, kind: str, **kwargs):
+        # retry stream ESTABLISHMENT only; the returned stream is the
+        # caller's to babysit (informer re-establishment)
+        return self._call(
+            "watch", kind,
+            lambda: self.inner.watch(api_version, kind, **kwargs),
+        )
+
+    def register_index(self, api_version: str, kind: str, name: str,
+                       fn: Callable) -> None:
+        self.inner.register_index(api_version, kind, name, fn)
+
+    def __getattr__(self, name: str):
+        # non-verb surface (test conveniences, request_counts, close,
+        # metrics attachment on the wrapped client, ...) passes through
+        return getattr(self.inner, name)
