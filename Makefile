@@ -48,6 +48,11 @@ chaos: ## Fault-injection resilience: marked scenarios + the 4-scenario bench
 	$(PYTHON) -m pytest tests/ -x -q -m "chaos and not slow"
 	$(PYTHON) tools/chaos_bench.py --out BENCH_chaos.json
 
+.PHONY: scale-bench
+scale-bench: ## Thousands-of-nodes control-plane proof: marked tests + the 100/2k/10k sweep
+	$(PYTHON) -m pytest tests/ -x -q -m "scale and not slow"
+	$(PYTHON) tools/scale_bench.py --out BENCH_scale.json
+
 .PHONY: test-cluster
 test-cluster: ## kind-cluster e2e + live fuzz (needs kind/docker/kubectl; skips cleanly without — ref test/e2e + test/fuzz)
 	$(PYTHON) -m pytest tests/cluster -x -q

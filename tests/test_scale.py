@@ -1,0 +1,747 @@
+"""Scale contract: sampled probe topology, sharded peer distribution,
+bounded summary status, and the batched/diff-gated write paths.
+
+The unit half pins the deterministic topology math (probe/topology.py)
+and the quorum semantics under sampling; the integration half drives
+the reconciler over a FakeCluster fleet and asserts the CR, ConfigMap
+and apiserver-write invariants the 10k-node design rests on
+(tools/scale_bench.py proves the same at full size).
+"""
+
+import json
+
+import pytest
+
+from tpu_network_operator.probe import topology as topo
+
+NAMESPACE = "tpunet-system"
+
+pytestmark = pytest.mark.scale
+
+
+# -- topology math -----------------------------------------------------------
+
+
+def endpoints(n):
+    return {f"n{i:04d}": f"10.0.{i // 256}.{i % 256}:8477"
+            for i in range(n)}
+
+
+def racks_of(n, size=4):
+    return {f"n{i:04d}": f"rack-{i // size}" for i in range(n)}
+
+
+class TestAssignPeers:
+    def test_deterministic_across_calls_and_processes(self):
+        """Same seed + node set ⇒ identical assignment — the property
+        that keeps a reconciler restart (or leader failover) from
+        rolling the whole mesh and resetting every peer window.
+        stable_hash is sha1-based, so this also holds across processes
+        (PYTHONHASHSEED randomizes builtin str hashing)."""
+        eps, racks = endpoints(40), racks_of(40)
+        a = topo.assign_peers(eps, 8, "pol-a", racks)
+        b = topo.assign_peers(dict(reversed(list(eps.items()))), 8,
+                              "pol-a", dict(racks))
+        assert a == b
+        # a different seed (policy) produces a different graph — two
+        # policies sharing nodes must not correlate their blind spots
+        c = topo.assign_peers(eps, 8, "pol-b", racks)
+        assert a != c
+
+    def test_k_regular_in_and_out(self):
+        """Out-degree k by construction; in-degree k because the picks
+        are ring successors — every node is watched by exactly k
+        probers, so no node can be silently unobserved."""
+        a = topo.assign_peers(endpoints(50), 8, "pol", racks_of(50))
+        assert all(len(row) == 8 for row in a.values())
+        in_deg = {}
+        for row in a.values():
+            for p in row:
+                in_deg[p] = in_deg.get(p, 0) + 1
+        assert set(in_deg.values()) == {8}
+
+    def test_cross_rack_edge_guaranteed(self):
+        """Every node probes at least one other-rack peer whenever more
+        than one rack exists — a whole-rack partition must be
+        observable from outside the rack.  Skewed rack sizes (one rack
+        holding most of the fleet) exercise the swap pass."""
+        eps = endpoints(30)
+        racks = {n: ("big" if i < 26 else f"r{i}")
+                 for i, n in enumerate(sorted(eps))}
+        a = topo.assign_peers(eps, 4, "pol", racks)
+        for node, row in a.items():
+            assert any(racks[p] != racks[node] for p in row), node
+
+    def test_small_mesh_falls_back_to_full(self):
+        """n <= degree+1: sampling would be the full mesh anyway, so it
+        IS the full mesh (identical to the pre-sampling contract)."""
+        eps = endpoints(5)
+        a = topo.assign_peers(eps, 8, "pol", {})
+        assert all(set(row) == set(eps) - {n} for n, row in a.items())
+
+    def test_degree_zero_is_full_mesh(self):
+        a = topo.assign_peers(endpoints(12), 0, "pol", {})
+        assert all(len(row) == 11 for row in a.values())
+
+
+class TestShardMath:
+    def test_shard_of_stable_and_bounded(self):
+        assert topo.shard_of("node-1", 1) == 0
+        for n in ("a", "node-00042", "x" * 64):
+            s = topo.shard_of(n, 7)
+            assert 0 <= s < 7
+            assert s == topo.shard_of(n, 7)   # agent & controller agree
+
+    def test_shard_count(self):
+        assert topo.shard_count(0) == 1
+        assert topo.shard_count(256) == 1
+        assert topo.shard_count(257) == 2
+        assert topo.shard_count(10_000) == 40
+
+    def test_split_for_budget_splits_until_fit(self):
+        a = topo.assign_peers(endpoints(64), 4, "pol", {})
+        one = topo.peer_shard_payloads(a, 1)[0]
+        budget = len(one.encode()) // 3
+        n, payloads, overflowed = topo.split_for_budget(a, budget, 1)
+        assert overflowed and n >= 4
+        assert all(len(p.encode()) <= budget for p in payloads)
+        # rows survive the split intact, each in its hash shard
+        merged = {}
+        for p in payloads:
+            merged.update(json.loads(p))
+        assert merged == {k: dict(v) for k, v in a.items()}
+
+    def test_split_reports_unsatisfiable_budget(self):
+        """A budget smaller than a single row can never fit: the caller
+        gets overflowed=True and must refuse, not truncate."""
+        a = topo.assign_peers(endpoints(12), 4, "pol", {})
+        n, payloads, overflowed = topo.split_for_budget(a, 10, 1)
+        assert overflowed
+        assert any(len(p.encode()) > 10 for p in payloads)
+
+    def test_meta_round_trip_and_skew_degrades_to_legacy(self):
+        assert topo.parse_meta(topo.index_meta(8, 4, 1000)) == (8, 4)
+        assert topo.parse_meta("") == (1, 0)
+        assert topo.parse_meta("not json") == (1, 0)
+
+
+class TestSampledQuorum:
+    def test_required_peers_capped_by_degree(self):
+        from tpu_network_operator.probe.prober import required_peers
+
+        # pre-sampling semantics unchanged (degree=0)
+        assert required_peers(0, 0, 10) == 10
+        assert required_peers(0, 16, 8) == 16
+        # sampled: expectedPeers pinned at fleet size must not demand
+        # more than the k peers the node actually probes
+        assert required_peers(0, 2000, 8, degree=8) == 8
+        assert required_peers(5, 2000, 8, degree=8) == 5
+        assert required_peers(0, 0, 8, degree=8) == 8
+
+    def test_gate_ready_with_fleet_scale_expected_peers(self):
+        from tpu_network_operator.probe.prober import (
+            ProbeSnapshot,
+            ReadinessGate,
+        )
+
+        gate = ReadinessGate(expected_peers=2000, degree=8,
+                             fail_threshold=1)
+        assert gate.observe(
+            ProbeSnapshot(peers_total=8, peers_reachable=8)
+        ) is False   # no flip: stays ready
+        assert gate.ready
+        # losing assigned peers still degrades
+        gate.observe(ProbeSnapshot(peers_total=8, peers_reachable=3))
+        assert not gate.ready
+
+
+# -- webhook -----------------------------------------------------------------
+
+
+class TestScaleWebhook:
+    def make(self, **probe_kw):
+        from tpu_network_operator.api.v1alpha1 import NetworkClusterPolicy
+
+        p = NetworkClusterPolicy()
+        p.metadata.name = "scale"
+        p.spec.configuration_type = "tpu-so"
+        p.spec.node_selector = {"tpunet.dev/tpu": "true"}
+        p.spec.tpu_scale_out.probe.enabled = True
+        for k, v in probe_kw.items():
+            setattr(p.spec.tpu_scale_out.probe, k, v)
+        return p
+
+    def test_large_expected_peers_defaults_degree_and_summary(self):
+        from tpu_network_operator.api.v1alpha1 import default_policy
+        from tpu_network_operator.api.v1alpha1 import types as t
+
+        p = default_policy(self.make(expected_peers=2000))
+        assert p.spec.tpu_scale_out.probe.degree == t.DEFAULT_PROBE_DEGREE
+        assert p.spec.status_detail == t.STATUS_DETAIL_SUMMARY
+
+    def test_small_fleet_keeps_full_mesh_default(self):
+        from tpu_network_operator.api.v1alpha1 import default_policy
+
+        p = default_policy(self.make(expected_peers=20))
+        assert p.spec.tpu_scale_out.probe.degree == 0
+        assert p.spec.status_detail == ""
+
+    def test_explicit_knobs_not_overridden(self):
+        from tpu_network_operator.api.v1alpha1 import default_policy
+
+        p = self.make(expected_peers=2000, degree=4)
+        p.spec.status_detail = "full"
+        p = default_policy(p)
+        assert p.spec.tpu_scale_out.probe.degree == 4
+        assert p.spec.status_detail == "full"
+
+    def test_quorum_over_degree_rejected(self):
+        from tpu_network_operator.api.v1alpha1 import validate_create
+        from tpu_network_operator.api.v1alpha1.webhook import AdmissionError
+
+        with pytest.raises(AdmissionError, match="degree"):
+            validate_create(self.make(degree=8, quorum=9))
+        validate_create(self.make(degree=8, quorum=8))   # satisfiable
+
+    def test_status_detail_validated(self):
+        from tpu_network_operator.api.v1alpha1 import validate_create
+        from tpu_network_operator.api.v1alpha1.webhook import AdmissionError
+
+        p = self.make()
+        p.spec.status_detail = "compact"
+        with pytest.raises(AdmissionError, match="statusDetail"):
+            validate_create(p)
+        for ok in ("", "full", "summary"):
+            p.spec.status_detail = ok
+            validate_create(p)
+
+    def test_degree_range_validated(self):
+        from tpu_network_operator.api.v1alpha1 import validate_create
+        from tpu_network_operator.api.v1alpha1.webhook import AdmissionError
+
+        with pytest.raises(AdmissionError, match="degree"):
+            validate_create(self.make(degree=-1))
+        with pytest.raises(AdmissionError, match="degree"):
+            validate_create(self.make(degree=2000))
+
+    def test_default_never_rejects_explicit_quorum(self):
+        """Defaulting must not invalidate a previously-valid spec: a
+        pre-scale CR with quorum=50 and a fleet-sized expectedPeers
+        gets degree raised to its quorum (not pinned below it, which
+        validation would then reject on every update)."""
+        from tpu_network_operator.api.v1alpha1 import (
+            default_policy,
+            validate_create,
+        )
+
+        p = default_policy(self.make(expected_peers=300, quorum=50))
+        assert p.spec.tpu_scale_out.probe.degree == 50
+        validate_create(p)
+
+    def test_default_leaves_huge_quorum_on_full_mesh(self):
+        """A quorum past MAX_PROBE_DEGREE cannot be satisfied by any
+        admissible sampled degree — defaulting leaves degree=0 (full
+        mesh) instead of minting a spec that fails validation."""
+        from tpu_network_operator.api.v1alpha1 import (
+            default_policy,
+            validate_create,
+        )
+
+        p = default_policy(self.make(expected_peers=4096, quorum=2000))
+        assert p.spec.tpu_scale_out.probe.degree == 0
+        validate_create(p)
+
+
+# -- reconciler: sharded distribution + bounded status -----------------------
+
+
+class ScaleEnv:
+    """Reconciler + FakeCluster fleet helpers (test_probe.py pattern)."""
+
+    def env(self, events=False):
+        from tests.test_controller import make_cluster
+        from tpu_network_operator.controller.health import Metrics
+        from tpu_network_operator.controller.manager import Manager
+        from tpu_network_operator.obs import EventRecorder
+
+        fake = make_cluster()
+        metrics = Metrics()
+        rec = EventRecorder(fake, NAMESPACE) if events else None
+        mgr = Manager(fake, NAMESPACE, metrics=metrics, events=rec)
+        return fake, mgr, metrics
+
+    def cr(self, nodes, degree=0, status_detail="", name="scale",
+           expected_peers=0):
+        from tpu_network_operator.api.v1alpha1 import (
+            NetworkClusterPolicy,
+            default_policy,
+        )
+
+        p = NetworkClusterPolicy()
+        p.metadata.name = name
+        p.spec.configuration_type = "tpu-so"
+        p.spec.node_selector = {"tpunet.dev/pool": name}
+        p.spec.tpu_scale_out.layer = "L2"
+        p.spec.tpu_scale_out.probe.enabled = True
+        p.spec.tpu_scale_out.probe.degree = degree
+        p.spec.tpu_scale_out.probe.expected_peers = expected_peers
+        p.spec.status_detail = status_detail
+        return default_policy(p).to_dict()
+
+    def seed(self, fake, mgr, nodes, degree=0, status_detail="",
+             rack_size=8):
+        fake.create(self.cr(nodes, degree, status_detail))
+        for i in range(nodes):
+            fake.add_node(f"node-{i:04d}", {
+                "tpunet.dev/pool": "scale",
+                "tpunet.dev/rack": f"rack-{i // rack_size}",
+            })
+        self.reconcile(fake, mgr)
+        fake.simulate_daemonset_controller()
+        for i in range(nodes):
+            self.report(fake, i)
+        self.reconcile(fake, mgr)
+
+    def report(self, fake, i, ok=True, state="Healthy", reachable=8,
+               peers_total=8):
+        from tpu_network_operator.agent import report as rpt
+
+        probe = {
+            "peersTotal": peers_total, "peersReachable": reachable,
+            "unreachable": [], "rttP50Ms": 0.5, "rttP99Ms": 1.0,
+            "lossRatio": 0.0,
+        }
+        if state is not None:   # None = version-skewed agent, no gate
+            probe["state"] = state
+        fake.apply(rpt.lease_for(rpt.ProvisioningReport(
+            node=f"node-{i:04d}", policy="scale", ok=ok,
+            error="" if ok else "link down",
+            probe_endpoint=f"10.0.{i // 256}.{i % 256}:8477",
+            probe=probe,
+        ), NAMESPACE))
+
+    def reconcile(self, fake, mgr, name="scale"):
+        mgr.enqueue(name)
+        mgr.drain(max_iters=300)
+
+
+class TestShardedPeerDistribution(ScaleEnv):
+    def test_small_mesh_keeps_legacy_single_configmap(self):
+        """Below the shard/sampling thresholds the distribution is the
+        pre-scale layout — a flat peers map one old agent can read."""
+        fake, mgr, _ = self.env()
+        self.seed(fake, mgr, nodes=5)
+        cm = fake.get("v1", "ConfigMap", "tpunet-peers-scale", NAMESPACE)
+        peers = json.loads(cm["data"]["peers"])
+        assert len(peers) == 5
+        assert topo.parse_meta(cm["data"]["meta"]) == (1, 0)
+
+    def test_sampled_assignments_in_single_shard(self):
+        fake, mgr, _ = self.env()
+        self.seed(fake, mgr, nodes=20, degree=4)
+        cm = fake.get("v1", "ConfigMap", "tpunet-peers-scale", NAMESPACE)
+        assignments = json.loads(cm["data"]["assignments"])
+        assert len(assignments) == 20
+        assert all(len(row) == 4 for row in assignments.values())
+        # constant-keyed data: the unused legacy key is explicitly
+        # blanked (apply merges — it must overwrite, not linger)
+        assert cm["data"]["peers"] == ""
+
+    def test_sharded_distribution_and_agent_side_lookup(self, monkeypatch):
+        """Past the shard size the distribution splits into per-bucket
+        ConfigMaps; the agent finds its row by fetching the index meta
+        + exactly its own shard (2 GETs, never the O(n) whole)."""
+        fake, mgr, _ = self.env()
+        monkeypatch.setattr(topo, "SHARD_TARGET_NODES", 10)
+        self.seed(fake, mgr, nodes=30, degree=4)
+        cm = fake.get("v1", "ConfigMap", "tpunet-peers-scale", NAMESPACE)
+        n_shards, degree = topo.parse_meta(cm["data"]["meta"])
+        assert n_shards == 3 and degree == 4
+        assert cm["data"]["assignments"] == ""
+        merged = {}
+        for i in range(n_shards):
+            shard = fake.get(
+                "v1", "ConfigMap", f"tpunet-peers-scale-{i}", NAMESPACE
+            )
+            rows = json.loads(shard["data"]["assignments"])
+            for node in rows:
+                assert topo.shard_of(node, n_shards) == i
+            merged.update(rows)
+        assert len(merged) == 30
+
+        # agent half: _probe_peers resolves its own row via its shard
+        from tpu_network_operator.agent import cli as agent_cli
+
+        monkeypatch.setattr(agent_cli, "_kube_client", lambda: fake)
+        monkeypatch.setenv("NODE_NAME", "node-0007")
+        config = agent_cli.CmdConfig(
+            report_namespace=NAMESPACE, policy_name="scale",
+        )
+        got = agent_cli._probe_peers(config, "node-0007")
+        assert got == merged["node-0007"]
+        assert len(got) == 4
+
+    def test_steady_mesh_costs_zero_configmap_writes(self):
+        fake, mgr, _ = self.env()
+        self.seed(fake, mgr, nodes=20, degree=4)
+        before = dict(fake.request_counts)
+        for _ in range(3):
+            self.reconcile(fake, mgr)
+        delta = {
+            k: fake.request_counts[k] - before.get(k, 0)
+            for k in fake.request_counts
+            if k[1] == "ConfigMap" and k[0] != "get"
+        }
+        assert all(v == 0 for v in delta.values()), delta
+
+    def test_overflow_splits_and_emits_event(self, monkeypatch):
+        """A payload over the byte budget splits further and surfaces
+        a PeerShardOverflow Warning — never a truncated shard."""
+        from tpu_network_operator.controller.reconciler import (
+            NetworkClusterPolicyReconciler,
+        )
+
+        monkeypatch.setattr(
+            NetworkClusterPolicyReconciler, "PEER_SHARD_BYTE_BUDGET",
+            700,
+        )
+        fake, mgr, _ = self.env(events=True)
+        self.seed(fake, mgr, nodes=20, degree=4)
+        evs = fake.events(involved_name="scale",
+                          reason="PeerShardOverflow")
+        assert evs and evs[0]["type"] == "Warning"
+        # every applied shard honors the budget
+        for cm in fake.list("v1", "ConfigMap", namespace=NAMESPACE):
+            for key, val in (cm.get("data") or {}).items():
+                if key != "meta":
+                    assert len(val.encode()) <= 700, cm["metadata"]["name"]
+        # edge-gated: the mesh stays over budget every pass, but the
+        # Warning fires only on the False->True flip — steady passes
+        # must not re-emit (an Event patch is an apiserver write and
+        # would break the 0-writes/steady-pass contract)
+        count_before = sum(e.get("count", 1) for e in evs)
+        self.reconcile(fake, mgr)
+        self.reconcile(fake, mgr)
+        evs = fake.events(involved_name="scale",
+                          reason="PeerShardOverflow")
+        assert sum(e.get("count", 1) for e in evs) == count_before
+
+    def test_unsatisfiable_budget_refuses_to_apply(self, monkeypatch):
+        from tpu_network_operator.controller.reconciler import (
+            NetworkClusterPolicyReconciler,
+        )
+
+        monkeypatch.setattr(
+            NetworkClusterPolicyReconciler, "PEER_SHARD_BYTE_BUDGET", 20,
+        )
+        fake, mgr, _ = self.env(events=True)
+        self.seed(fake, mgr, nodes=12, degree=4)
+        for cm in fake.list("v1", "ConfigMap", namespace=NAMESPACE):
+            data = cm.get("data") or {}
+            assert "assignments" not in data or \
+                len(data["assignments"].encode()) <= 20
+
+    def test_probe_disable_cleans_up_all_shards(self, monkeypatch):
+        from tpu_network_operator.api.v1alpha1.types import API_VERSION
+
+        fake, mgr, _ = self.env()
+        monkeypatch.setattr(topo, "SHARD_TARGET_NODES", 10)
+        self.seed(fake, mgr, nodes=30, degree=4)
+        assert len([
+            n for n in fake.dump("ConfigMap/*")
+            if "tpunet-peers" in n
+        ]) == 4   # index + 3 shards
+        cr = fake.get(API_VERSION, "NetworkClusterPolicy", "scale")
+        cr["spec"]["tpuScaleOut"]["probe"]["enabled"] = False
+        fake.update(cr)
+        self.reconcile(fake, mgr)
+        assert not [
+            n for n in fake.dump("ConfigMap/*") if "tpunet-peers" in n
+        ]
+
+    def test_full_mesh_over_budget_shards_flat_map(self, monkeypatch):
+        """A full mesh (degree=0) whose flat map exceeds the byte
+        budget shards the O(n) membership itself — it must NEVER be
+        expanded into per-node assignment rows (O(n²) bytes).  The
+        agent merges every shard's flat rows back into the whole
+        mesh."""
+        from tpu_network_operator.agent import cli as agent_cli
+        from tpu_network_operator.controller.reconciler import (
+            NetworkClusterPolicyReconciler,
+        )
+
+        monkeypatch.setattr(
+            NetworkClusterPolicyReconciler, "PEER_SHARD_BYTE_BUDGET",
+            600,
+        )
+        fake, mgr, _ = self.env(events=True)
+        self.seed(fake, mgr, nodes=30, degree=0)
+        idx = fake.get("v1", "ConfigMap", "tpunet-peers-scale", NAMESPACE)
+        n_shards, degree = topo.parse_meta(idx["data"]["meta"])
+        assert n_shards > 1 and degree == 0
+        assert idx["data"]["peers"] == "" and \
+            idx["data"]["assignments"] == ""
+        merged = {}
+        total_bytes = 0
+        for i in range(n_shards):
+            shard = fake.get(
+                "v1", "ConfigMap", f"tpunet-peers-scale-{i}", NAMESPACE
+            )
+            payload = shard["data"]["peers"]
+            assert len(payload.encode()) <= 600
+            assert shard["data"]["assignments"] == ""
+            total_bytes += len(payload.encode())
+            merged.update(json.loads(payload))
+        assert len(merged) == 30
+        # O(n), not O(n²): the sharded total stays within JSON overhead
+        # of the single flat map
+        flat_bytes = len(json.dumps(merged).encode())
+        assert total_bytes < 2 * flat_bytes
+        assert fake.events(involved_name="scale",
+                           reason="PeerShardOverflow")
+
+        monkeypatch.setattr(agent_cli, "_kube_client", lambda: fake)
+        monkeypatch.setenv("NODE_NAME", "node-0007")
+        config = agent_cli.CmdConfig(
+            report_namespace=NAMESPACE, policy_name="scale",
+        )
+        got = agent_cli._probe_peers(config, "node-0007")
+        assert len(got) == 29 and "node-0007" not in got
+
+    def test_externally_deleted_shard_repaired(self, monkeypatch):
+        """The diff gate compares against an in-memory last-applied
+        copy; the periodic anti-entropy read-back must notice an
+        externally deleted (or kubectl-edited) shard and re-apply it
+        even though the desired payload never changed."""
+        from tpu_network_operator.controller.reconciler import (
+            NetworkClusterPolicyReconciler,
+        )
+
+        fake, mgr, _ = self.env()
+        monkeypatch.setattr(topo, "SHARD_TARGET_NODES", 10)
+        self.seed(fake, mgr, nodes=30, degree=4)
+        fake.delete("v1", "ConfigMap", "tpunet-peers-scale-1", NAMESPACE)
+        self.reconcile(fake, mgr)   # inside the verify window: gated
+        with pytest.raises(Exception):
+            fake.get("v1", "ConfigMap", "tpunet-peers-scale-1", NAMESPACE)
+        monkeypatch.setattr(
+            NetworkClusterPolicyReconciler, "PEER_CM_VERIFY_SECONDS",
+            0.0,
+        )
+        self.reconcile(fake, mgr)   # window elapsed: read-back repairs
+        shard = fake.get(
+            "v1", "ConfigMap", "tpunet-peers-scale-1", NAMESPACE
+        )
+        assert json.loads(shard["data"]["assignments"])
+
+
+class TestBoundedStatus(ScaleEnv):
+    def test_summary_mode_bounds_probe_rows_and_errors(self):
+        from tpu_network_operator.api.v1alpha1.types import (
+            API_VERSION,
+            STATUS_WORST_K,
+        )
+
+        fake, mgr, _ = self.env()
+        self.seed(fake, mgr, nodes=60, degree=4,
+                  status_detail="summary")
+        # churn: half the fleet degrades, and more than worst-K nodes
+        # fail provisioning (the errors list must cap with a tail)
+        for i in range(30):
+            self.report(fake, i, state="Degraded", reachable=0)
+        for i in range(30, 55):
+            self.report(fake, i, ok=False)
+        self.reconcile(fake, mgr)
+        cr = fake.get(API_VERSION, "NetworkClusterPolicy", "scale")
+        st = cr["status"]
+        rows = st.get("probeNodes", [])
+        assert len(rows) == STATUS_WORST_K
+        # worst-K means DEGRADED rows, not an alphabetical prefix
+        assert all(r["state"] == "Degraded" for r in rows)
+        errors = st.get("errors", [])
+        assert len(errors) == STATUS_WORST_K + 1
+        assert "more nodes" in errors[-1]
+        summary = st["summary"]
+        assert summary["detail"] == "summary"
+        assert summary["nodesTotal"] == 60
+        assert summary["nodesDegraded"] == 30
+        # the shard rollup carries the full picture the lists elide
+        # (omit-empty serialization: absent field = 0)
+        assert sum(s.get("degraded", 0) for s in summary["shards"]) == 30
+        assert sum(s.get("nodes", 0) for s in summary["shards"]) == 60
+        # rack labels became shard keys
+        assert any(s["shard"].startswith("rack-")
+                   for s in summary["shards"])
+
+    def test_worst_k_stable_under_churn(self):
+        """Two passes over identical input pick identical worst-K rows
+        (deterministic tie-breaks) — status must not churn writes when
+        nothing changed."""
+        fake, mgr, _ = self.env()
+        self.seed(fake, mgr, nodes=40, degree=4,
+                  status_detail="summary")
+        for i in range(0, 40, 2):
+            self.report(fake, i, state="Degraded", reachable=1)
+        self.reconcile(fake, mgr)
+        from tpu_network_operator.api.v1alpha1.types import API_VERSION
+
+        first = fake.get(
+            API_VERSION, "NetworkClusterPolicy", "scale"
+        )["status"]["probeNodes"]
+        before = dict(fake.request_counts)
+        self.reconcile(fake, mgr)
+        again = fake.get(
+            API_VERSION, "NetworkClusterPolicy", "scale"
+        )["status"]["probeNodes"]
+        assert first == again
+        writes = sum(
+            fake.request_counts[k] - before.get(k, 0)
+            for k in fake.request_counts
+            if k[0] in ("create", "update", "delete")
+            and k[1] == "NetworkClusterPolicy"
+        )
+        assert writes == 0
+
+    def test_full_mode_keeps_complete_matrix(self):
+        from tpu_network_operator.api.v1alpha1.types import API_VERSION
+
+        fake, mgr, _ = self.env()
+        self.seed(fake, mgr, nodes=30, degree=4, status_detail="full")
+        cr = fake.get(API_VERSION, "NetworkClusterPolicy", "scale")
+        assert len(cr["status"]["probeNodes"]) == 30
+        assert cr["status"]["summary"]["detail"] == "full"
+
+    def test_auto_mode_stays_full_below_threshold(self):
+        from tpu_network_operator.api.v1alpha1.types import API_VERSION
+
+        fake, mgr, _ = self.env()
+        self.seed(fake, mgr, nodes=10)
+        cr = fake.get(API_VERSION, "NetworkClusterPolicy", "scale")
+        assert cr["status"]["summary"]["detail"] == "full"
+        assert len(cr["status"]["probeNodes"]) == 10
+
+    def test_summary_mode_exports_shard_gauges_not_per_node(self):
+        fake, mgr, metrics = self.env()
+        self.seed(fake, mgr, nodes=24, degree=4,
+                  status_detail="summary")
+        text = metrics.render()
+        assert "tpunet_shard_nodes{" in text
+        assert 'tpunet_probe_peers_reachable{' not in text
+        assert "tpunet_peer_shards{" in text
+
+    def test_full_mode_keeps_per_node_gauges(self):
+        fake, mgr, metrics = self.env()
+        self.seed(fake, mgr, nodes=6)
+        text = metrics.render()
+        assert 'tpunet_probe_peers_reachable{' in text
+
+
+class TestLeaseParseMemo(ScaleEnv):
+    def test_unchanged_leases_parse_once(self, monkeypatch):
+        """The rollup's shard-merge read path: pass 2 over an unchanged
+        fleet JSON-parses zero report payloads."""
+        from tpu_network_operator.agent import report as rpt
+
+        fake, mgr, _ = self.env()
+        self.seed(fake, mgr, nodes=12, degree=4)
+        calls = {"n": 0}
+        orig = rpt.ProvisioningReport.from_json
+
+        def counting(raw):
+            calls["n"] += 1
+            return orig(raw)
+
+        monkeypatch.setattr(
+            rpt.ProvisioningReport, "from_json", staticmethod(counting)
+        )
+        self.reconcile(fake, mgr)
+        assert calls["n"] == 0
+        # one lease changes: exactly one re-parse
+        self.report(fake, 3, ok=False)
+        self.reconcile(fake, mgr)
+        assert calls["n"] == 1
+
+
+class TestRackMapFreshness(ScaleEnv):
+    def test_nodes_joining_within_ttl_get_rack_keys(self):
+        """Fleet growth inside one topology-cache TTL window must
+        refresh the node->rack map: an early reconcile over an empty
+        fleet caches an empty map, and nodes joining right after still
+        carry topology labels — they must land in labeled shards (and a
+        rack-aware ring), not silently fall back to hash buckets until
+        the TTL expires."""
+        from tpu_network_operator.api.v1alpha1.types import API_VERSION
+
+        fake, mgr, _ = self.env()
+        fake.create(self.cr(0, degree=4, status_detail="summary"))
+        self.reconcile(fake, mgr)   # rack map cached while fleet empty
+        for i in range(32):
+            fake.add_node(f"node-{i:04d}", {
+                "tpunet.dev/pool": "scale",
+                "tpunet.dev/rack": f"rack-{i // 8}",
+            })
+        fake.simulate_daemonset_controller()
+        for i in range(32):
+            self.report(fake, i)
+        self.reconcile(fake, mgr)
+        cr = fake.get(API_VERSION, "NetworkClusterPolicy", "scale")
+        shards = [s["shard"] for s in cr["status"]["summary"]["shards"]]
+        assert shards and all(s.startswith("rack-") for s in shards), shards
+
+    def test_absent_node_does_not_relist_every_pass(self):
+        """A report Lease outliving its Node object (or a node the
+        apiserver simply doesn't know) forces at most ONE extra Node
+        list — the remembered missing-set keeps later passes on the
+        cached map until the wanted set changes or the TTL expires."""
+        fake, mgr, _ = self.env()
+        self.seed(fake, mgr, nodes=8, degree=4, status_detail="summary")
+        self.report(fake, 99)   # lease with no matching Node object
+        self.reconcile(fake, mgr)
+        before = fake.request_counts.get(("list", "Node"), 0)
+        for _ in range(3):
+            self.reconcile(fake, mgr)
+        after = fake.request_counts.get(("list", "Node"), 0)
+        assert after == before, (before, after)
+
+    def test_distinct_absent_nodes_accumulate_not_thrash(self):
+        """The missing-set memo accumulates across callers: two
+        policies each dragging their own departed node must not
+        alternate-bust the TTL into one Node list per pass."""
+        fake, mgr, _ = self.env()
+        self.seed(fake, mgr, nodes=4, degree=0)
+        rec = mgr.reconciler
+        rec._rack_map(wanted={"node-0000", "ghost-a"})
+        rec._rack_map(wanted={"node-0001", "ghost-b"})
+        settled = fake.request_counts.get(("list", "Node"), 0)
+        for _ in range(4):
+            rec._rack_map(wanted={"node-0000", "ghost-a"})
+            rec._rack_map(wanted={"node-0001", "ghost-b"})
+        assert fake.request_counts.get(("list", "Node"), 0) == settled
+
+
+class TestAggregationQuorumDrift(ScaleEnv):
+    def test_version_skew_fallback_respects_degree(self):
+        """A report without a gate state (version-skewed agent) falls
+        back to the raw reachable-vs-required check — which must apply
+        the SAME degree cap as the agent gate, or a sampled node
+        probing its full k assigned peers gets marked Degraded (and
+        eventually quarantined) for missing a fleet-sized
+        expectedPeers it was never assigned."""
+        from tpu_network_operator.api.v1alpha1.types import API_VERSION
+
+        fake, mgr, _ = self.env()
+        fake.create(self.cr(12, degree=4, status_detail="full",
+                            expected_peers=300))
+        for i in range(12):
+            fake.add_node(f"node-{i:04d}", {"tpunet.dev/pool": "scale"})
+        self.reconcile(fake, mgr)
+        fake.simulate_daemonset_controller()
+        for i in range(12):
+            self.report(fake, i, state=None, reachable=4, peers_total=4)
+        self.reconcile(fake, mgr)
+        cr = fake.get(API_VERSION, "NetworkClusterPolicy", "scale")
+        states = {r["node"]: r["state"]
+                  for r in cr["status"].get("probeNodes", [])}
+        assert states and all(
+            s == "Reachable" for s in states.values()
+        ), states

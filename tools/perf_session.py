@@ -165,6 +165,14 @@ def main() -> int:
         maybe_run_phase(out, "chaos-bench",
                   [py, "tools/chaos_bench.py", "--nodes", "20",
                    "--out", "BENCH_chaos.json"], timeout=600)
+        # 13. control-plane scale: 100 → 2,000 → 10,000-node sweeps —
+        # apiserver writes/pass O(shards) not O(nodes), probe
+        # datagrams O(k·n) not O(n²), CR status bounded, partition
+        # still detected in 3 intervals on the sampled topology
+        # (no TPU, in-process FakeCluster + FakeFabric)
+        maybe_run_phase(out, "scale-bench",
+                  [py, "tools/scale_bench.py",
+                   "--out", "BENCH_scale.json"], timeout=900)
     print(f"done -> {args.out}")
     return 0
 

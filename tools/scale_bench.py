@@ -1,0 +1,401 @@
+#!/usr/bin/env python3
+"""Control-plane scale benchmark — prints ONE JSON line (BENCH-style).
+
+Proves the operator's scale contract on fleets far past anything the
+other benches touch (they run at 20-25 nodes): a sweep of FakeCluster
+fleets (default 100 → 2,000 → 10,000 nodes, one tpu-so policy with the
+sampled probe mesh at degree k=8) measures, per size:
+
+* **reconcile p50/p95** over warm passes (informer-cached reads, lease
+  parse memo, diff-gated flushes);
+* **apiserver writes per steady pass** — must be 0 (O(shards) on
+  change, never O(nodes));
+* **writes per churn event** (one node's report flips / one endpoint
+  changes) — must be O(1 + touched shards);
+* **serialized CR status bytes** — bounded by the summary rollup
+  (worst-K lists + per-shard counts) regardless of fleet size;
+* **probe datagrams per round** — read off the distributed peer-shard
+  ConfigMaps: must be ≤ k·n, not n·(n-1);
+* **peer ConfigMap count + max payload** — every shard under the byte
+  budget (1 MiB etcd limit never decides membership).
+
+A separate FakeFabric scenario then partitions one node of the
+2,000-node sampled topology and measures detection latency — the gate
+must flip within 3 probe intervals, and the node's k in-probers must
+all see it unreachable (a partition is observable from outside).
+
+Usage: python tools/scale_bench.py [--nodes-list 100,2000,10000]
+       [--rounds 5] [--partition-nodes 2000] [--out BENCH_scale.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+NAMESPACE = "tpunet-system"
+POLICY = "scale"
+DEGREE = 8
+RACK_SIZE = 16
+PROBE_INTERVAL = 5
+
+# the acceptance budgets the artifact is judged against
+MAX_STATUS_BYTES = 256 * 1024
+PARTITION_BUDGET_INTERVALS = 3
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def pctile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def make_policy():
+    from tpu_network_operator.api.v1alpha1 import (
+        NetworkClusterPolicy,
+        default_policy,
+    )
+
+    p = NetworkClusterPolicy()
+    p.metadata.name = POLICY
+    p.spec.configuration_type = "tpu-so"
+    p.spec.node_selector = {"tpunet.dev/pool": POLICY}
+    p.spec.tpu_scale_out.probe.enabled = True
+    p.spec.tpu_scale_out.probe.interval_seconds = PROBE_INTERVAL
+    p.spec.tpu_scale_out.probe.degree = DEGREE
+    # statusDetail left "" — the auto flip to summary above the
+    # threshold is part of what this bench proves
+    return default_policy(p).to_dict()
+
+
+def endpoint_of(i: int) -> str:
+    return f"10.{i // 65536}.{(i // 256) % 256}.{i % 256}:8477"
+
+
+def rack_labels(i: int):
+    return {
+        "tpunet.dev/pool": POLICY,
+        "tpunet.dev/rack": f"rack-{i // RACK_SIZE:04d}",
+    }
+
+
+def healthy_report(node: str, i: int):
+    from tpu_network_operator.agent import report as rpt
+
+    return rpt.ProvisioningReport(
+        node=node, policy=POLICY, ok=True, backend="tpu", mode="L2",
+        interfaces_configured=4, interfaces_total=4,
+        probe_endpoint=endpoint_of(i),
+        probe={
+            "peersTotal": DEGREE, "peersReachable": DEGREE,
+            "unreachable": [], "rttP50Ms": 0.4, "rttP99Ms": 1.1,
+            "lossRatio": 0.0, "state": "Healthy",
+        },
+    )
+
+
+def write_counts(client):
+    return {
+        k: v for k, v in client.request_counts.items()
+        if k[0] in ("create", "update", "patch", "delete", "apply")
+    }
+
+
+def delta_writes(before, after):
+    return sum(after.get(k, 0) - before.get(k, 0) for k in after)
+
+
+def peer_cm_stats(fake):
+    """(cm_count, max_payload_bytes, datagrams_per_round) from the
+    distributed peer ConfigMaps — what the agents will actually probe."""
+    from tpu_network_operator.probe import topology as topo
+
+    cms = [
+        cm for cm in fake.list("v1", "ConfigMap", namespace=NAMESPACE)
+        if cm["metadata"]["name"].startswith("tpunet-peers-")
+    ]
+    max_bytes = 0
+    edges = 0
+    for cm in cms:
+        data = cm.get("data", {}) or {}
+        payload = max(
+            (len(v.encode()) for v in data.values()), default=0
+        )
+        max_bytes = max(max_bytes, payload)
+        if data.get(topo.ASSIGNMENTS_KEY):
+            rows = json.loads(data[topo.ASSIGNMENTS_KEY])
+            edges += sum(len(r) for r in rows.values())
+        elif data.get(topo.PEERS_KEY):
+            peers = json.loads(data[topo.PEERS_KEY])
+            # legacy flat map = full mesh: n*(n-1) directed probes
+            edges += len(peers) * max(len(peers) - 1, 0)
+    return len(cms), max_bytes, edges
+
+
+def run_sweep(n_nodes: int, rounds: int):
+    from tpu_network_operator.agent import report as rpt
+    from tpu_network_operator.api.v1alpha1.types import API_VERSION
+    from tpu_network_operator.controller.health import Metrics
+    from tpu_network_operator.controller.reconciler import (
+        NetworkClusterPolicyReconciler,
+    )
+    from tpu_network_operator.kube.fake import FakeCluster
+    from tpu_network_operator.kube.informer import CachedClient
+
+    log(f"== sweep: {n_nodes} nodes")
+    fake = FakeCluster()
+    fake.create(make_policy())
+    t0 = time.perf_counter()
+    for i in range(n_nodes):
+        node = f"node-{i:05d}"
+        fake.add_node(node, rack_labels(i))
+        fake.apply(rpt.lease_for(healthy_report(node, i), NAMESPACE))
+    log(f"   seeded in {time.perf_counter() - t0:.1f}s")
+
+    split = CachedClient(fake)
+    split.cache(API_VERSION, "NetworkClusterPolicy")
+    split.cache("apps/v1", "DaemonSet", namespace=NAMESPACE)
+    split.cache("v1", "Pod", namespace=NAMESPACE)
+    split.cache(rpt.LEASE_API, "Lease", namespace=NAMESPACE)
+    split.cache("v1", "Node")
+    split.start()
+    rec = NetworkClusterPolicyReconciler(
+        split, NAMESPACE, metrics=Metrics()
+    )
+    rec.REPORT_CACHE_SECONDS = 0.0   # exact visibility per pass
+    rec.setup()
+
+    # cold passes: DS create → pods scheduled → status converges
+    rec.reconcile(POLICY)
+    fake.simulate_daemonset_controller()
+    for _ in range(5):
+        before = write_counts(fake)
+        rec.reconcile(POLICY)
+        if delta_writes(before, write_counts(fake)) == 0:
+            break
+
+    # steady state: timed warm passes, write accounting
+    latencies = []
+    before = write_counts(fake)
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        rec.reconcile(POLICY)
+        latencies.append(time.perf_counter() - t0)
+    steady_writes = delta_writes(before, write_counts(fake)) / rounds
+
+    # churn 1: one node's report flips to failed (fabric trouble)
+    degraded = healthy_report("node-00000", 0)
+    degraded.ok = False
+    degraded.error = "link eth1 down"
+    degraded.probe["peersReachable"] = 0
+    degraded.probe["state"] = "Degraded"
+    fake.apply(rpt.lease_for(degraded, NAMESPACE))
+    before = write_counts(fake)
+    rec.reconcile(POLICY)
+    churn_report_writes = delta_writes(before, write_counts(fake))
+
+    # churn 2: one node's probe endpoint moves (re-provisioned) — must
+    # touch only the shards holding rows that reference it
+    moved = healthy_report("node-00001", n_nodes + 7)
+    fake.apply(rpt.lease_for(moved, NAMESPACE))
+    before = write_counts(fake)
+    rec.reconcile(POLICY)
+    churn_endpoint_writes = delta_writes(before, write_counts(fake))
+
+    cr = fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+    status_bytes = len(json.dumps(cr.get("status", {})))
+    detail = (
+        (cr.get("status", {}).get("summary") or {}).get("detail", "")
+    )
+    probe_rows = len(cr.get("status", {}).get("probeNodes", []) or [])
+    shard_rows = len(
+        (cr.get("status", {}).get("summary") or {}).get("shards", [])
+        or []
+    )
+    cm_count, max_cm_bytes, datagrams = peer_cm_stats(fake)
+    split.stop()
+    lat_sorted = sorted(latencies)
+    row = {
+        "nodes": n_nodes,
+        "reconcile_p50_ms": round(pctile(lat_sorted, 0.5) * 1e3, 2),
+        "reconcile_p95_ms": round(pctile(lat_sorted, 0.95) * 1e3, 2),
+        "steady_writes_per_pass": round(steady_writes, 3),
+        "churn_report_writes": churn_report_writes,
+        "churn_endpoint_writes": churn_endpoint_writes,
+        "status_bytes": status_bytes,
+        "status_detail": detail,
+        "probe_rows_embedded": probe_rows,
+        "summary_shard_rows": shard_rows,
+        "peer_configmaps": cm_count,
+        "max_peer_cm_bytes": max_cm_bytes,
+        "datagrams_per_round": datagrams,
+        "datagram_bound_k_n": DEGREE * n_nodes,
+        "full_mesh_datagrams": n_nodes * max(n_nodes - 1, 0),
+    }
+    log(f"   -> p50 {row['reconcile_p50_ms']}ms, "
+        f"{row['steady_writes_per_pass']} writes/pass, "
+        f"status {status_bytes}B ({detail}), "
+        f"{datagrams} datagrams/round ({cm_count} CMs)")
+    return row
+
+
+def run_partition(n_nodes: int):
+    """Partition one node of the sampled 2,000-node topology on the
+    FakeFabric and measure gate-flip latency in probe intervals, plus
+    in-prober observability (every node probing the victim must see it
+    unreachable)."""
+    from tpu_network_operator.probe import FakeFabric, ProbeRunner
+    from tpu_network_operator.probe import topology as topo
+    from tpu_network_operator.probe.prober import Responder
+
+    log(f"== partition scenario: {n_nodes} nodes, degree {DEGREE}")
+    endpoints = {
+        f"node-{i:05d}": endpoint_of(i) for i in range(n_nodes)
+    }
+    racks = {
+        f"node-{i:05d}": f"rack-{i // RACK_SIZE:04d}"
+        for i in range(n_nodes)
+    }
+    assignments = topo.assign_peers(endpoints, DEGREE, POLICY, racks)
+    victim = f"node-{n_nodes // 2:05d}"
+    in_probers = sorted(
+        n for n, row in assignments.items() if victim in row
+    )
+    fabric = FakeFabric(seed=42, latency=0.0005, jitter=0.0002)
+
+    # live runners: the victim + everyone assigned to probe it; plain
+    # responders for every other referenced endpoint so no runner sees
+    # a phantom-dead peer
+    runners = {}
+    for name in [victim] + in_probers:
+        runners[name] = ProbeRunner(
+            fabric, endpoints[name], name,
+            (lambda n=name: dict(assignments[n])),
+            interval=PROBE_INTERVAL, degree=DEGREE,
+        )
+        runners[name].responder.start()
+    needed = set()
+    for name in runners:
+        needed.update(assignments[name])
+    for peer in needed - set(runners):
+        Responder(fabric.open(endpoints[peer])).start()
+
+    def tick():
+        for r in runners.values():
+            r.step()
+        fabric.advance(PROBE_INTERVAL)
+
+    for _ in range(5):
+        tick()
+    assert all(r.ready() for r in runners.values()), \
+        "sampled mesh never converged ready"
+
+    fabric.partition(endpoints[victim].rpartition(":")[0])
+    detect_intervals = -1
+    for i in range(12):
+        tick()
+        if not runners[victim].ready():
+            detect_intervals = i + 1
+            break
+    observers = sum(
+        1 for name in in_probers
+        if victim in (runners[name].last_snapshot.unreachable or [])
+    )
+    row = {
+        "nodes": n_nodes,
+        "degree": DEGREE,
+        "in_probers": len(in_probers),
+        "detect_intervals": detect_intervals,
+        "budget_intervals": PARTITION_BUDGET_INTERVALS,
+        "in_probers_observing": observers,
+    }
+    log(f"   -> detected in {detect_intervals} intervals "
+        f"({observers}/{len(in_probers)} in-probers observing)")
+    return row
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes-list", default="100,2000,10000")
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--partition-nodes", type=int, default=2000)
+    ap.add_argument("--out", default="",
+                    help="also write the JSON artifact to this path")
+    args = ap.parse_args()
+    sizes = [int(s) for s in args.nodes_list.split(",") if s.strip()]
+
+    sweeps = [run_sweep(n, args.rounds) for n in sizes]
+    partition = run_partition(args.partition_nodes)
+
+    failures = []
+    for row in sweeps:
+        if row["steady_writes_per_pass"] > 0:
+            failures.append(
+                f"{row['nodes']} nodes: {row['steady_writes_per_pass']} "
+                "steady writes/pass (want 0)"
+            )
+        if row["datagrams_per_round"] > row["datagram_bound_k_n"]:
+            failures.append(
+                f"{row['nodes']} nodes: datagrams/round over k*n"
+            )
+        if row["status_bytes"] > MAX_STATUS_BYTES:
+            failures.append(
+                f"{row['nodes']} nodes: status {row['status_bytes']}B "
+                f"over the {MAX_STATUS_BYTES}B budget"
+            )
+        if row["churn_report_writes"] > 4:
+            failures.append(
+                f"{row['nodes']} nodes: {row['churn_report_writes']} "
+                "writes for one report churn event"
+            )
+    if not (
+        0 < partition["detect_intervals"]
+        <= PARTITION_BUDGET_INTERVALS
+    ):
+        failures.append(
+            f"partition detected in {partition['detect_intervals']} "
+            f"intervals (budget {PARTITION_BUDGET_INTERVALS})"
+        )
+
+    biggest = sweeps[-1]
+    result = {
+        "metric": "probe datagrams per node per round at scale",
+        "value": round(
+            biggest["datagrams_per_round"] / max(biggest["nodes"], 1), 2
+        ),
+        "unit": "datagrams/node/round",
+        # the scale win: full-mesh datagram cost over the sampled cost
+        # at the largest sweep
+        "vs_baseline": round(
+            biggest["full_mesh_datagrams"]
+            / max(biggest["datagrams_per_round"], 1), 1
+        ),
+        "degree": DEGREE,
+        "sweeps": sweeps,
+        "partition": partition,
+        "ok": not failures,
+        "failures": failures,
+    }
+    line = json.dumps(result)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(line)
+    if failures:
+        log("FAILED: " + "; ".join(failures))
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

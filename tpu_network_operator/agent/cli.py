@@ -82,6 +82,10 @@ class CmdConfig:
     probe_expected_peers: int = 0   # pinned quorum base; 0 = live peers
     probe_fail_threshold: int = probe_defaults.DEFAULT_FAIL_THRESHOLD
     probe_recovery_threshold: int = probe_defaults.DEFAULT_RECOVERY_THRESHOLD
+    # sampled probe topology out-degree (0 = full mesh): caps the
+    # gate's quorum base — a node only probes its assigned k peers, so
+    # no verdict may demand more than k reachable
+    probe_degree: int = 0
     # transport seam: tests/bench inject a probe.FakeFabric; None =
     # real UDP sockets
     probe_transport: Optional[object] = None
@@ -468,13 +472,65 @@ def _probe_peers(config: CmdConfig, node: str):
 
     from ..kube import errors as kerr
 
+    from ..probe import topology as topo
+
+    index_name = rpt.peer_configmap_name(config.policy_name)
     try:
         cm = client.get(
-            "v1", "ConfigMap",
-            rpt.peer_configmap_name(config.policy_name),
-            config.report_namespace,
+            "v1", "ConfigMap", index_name, config.report_namespace,
         )
-        peers = json_mod.loads((cm.get("data", {}) or {}).get("peers", "{}"))
+        data = cm.get("data", {}) or {}
+        n_shards, mesh_degree = topo.parse_meta(
+            data.get(topo.META_KEY, "")
+        )
+        if data.get(topo.ASSIGNMENTS_KEY):
+            # sampled topology, single shard: this node's own row IS
+            # its peer list (the controller computed the k-regular
+            # assignment; probing anything else would skew in-degrees)
+            assignments = json_mod.loads(data[topo.ASSIGNMENTS_KEY])
+        elif n_shards > 1 and mesh_degree == 0:
+            # sharded FULL mesh (flat map too big for one object):
+            # full mesh means probing everyone, so merge every shard's
+            # flat peers rows — O(n) bytes total, same as the legacy
+            # single map, just bounded per object
+            peers: Dict[str, str] = {}
+            for i in range(n_shards):
+                shard_cm = client.get(
+                    "v1", "ConfigMap", f"{index_name}-{i}",
+                    config.report_namespace,
+                )
+                peers.update(json_mod.loads(
+                    (shard_cm.get("data", {}) or {}).get(
+                        topo.PEERS_KEY
+                    ) or "{}"
+                ))
+            return {
+                str(n): str(a) for n, a in peers.items()
+                if n != node and isinstance(a, str) and a
+            }
+        elif n_shards > 1:
+            # sampled + sharded: fetch ONLY this node's shard — the
+            # whole point is that no agent ever reads the full O(n)
+            # distribution
+            shard_cm = client.get(
+                "v1", "ConfigMap",
+                f"{index_name}-{topo.shard_of(node, n_shards)}",
+                config.report_namespace,
+            )
+            assignments = json_mod.loads(
+                (shard_cm.get("data", {}) or {}).get(
+                    topo.ASSIGNMENTS_KEY
+                ) or "{}"
+            )
+        else:
+            # legacy flat map: probe every listed peer (full mesh)
+            peers = json_mod.loads(data.get(topo.PEERS_KEY) or "{}")
+            if not isinstance(peers, dict):
+                return None
+            return {
+                str(n): str(a) for n, a in peers.items()
+                if n != node and isinstance(a, str) and a
+            }
     except kerr.NotFoundError:
         # expected bootstrap race: the controller has not distributed
         # the peer list yet — not an RBAC problem, don't warn
@@ -490,10 +546,16 @@ def _probe_peers(config: CmdConfig, node: str):
                 "mesh; check agent configmaps RBAC): %s", e,
             )
         return None
-    if not isinstance(peers, dict):
+    if not isinstance(assignments, dict):
+        return None
+    row = assignments.get(node)
+    if not isinstance(row, dict):
+        # the controller has not folded this node's report into the
+        # assignment yet (bootstrap race): keep the last known mesh
+        log.debug("no peer assignment row for %s yet", node)
         return None
     return {
-        str(n): str(a) for n, a in peers.items()
+        str(n): str(a) for n, a in row.items()
         if n != node and isinstance(a, str) and a
     }
 
@@ -595,6 +657,7 @@ def _start_probe_runner(
             expected_peers=config.probe_expected_peers,
             fail_threshold=config.probe_fail_threshold,
             recovery_threshold=config.probe_recovery_threshold,
+            degree=config.probe_degree,
         )
     except OSError as e:
         # a squatted probe port degrades to no probing, not a dead agent
@@ -1218,6 +1281,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--probe-expected-peers", type=int, default=0,
                    help="pinned quorum base: a shrunken peer list counts "
                         "missing peers as unreachable (0 = live peers)")
+    p.add_argument("--probe-degree", type=int, default=0,
+                   help="sampled probe topology out-degree: probe only "
+                        "the assigned k peers, capping the quorum base "
+                        "(0 = full mesh)")
     p.add_argument("--probe-fail-threshold", type=int,
                    default=probe_defaults.DEFAULT_FAIL_THRESHOLD,
                    help="consecutive below-quorum rounds before the "
@@ -1323,6 +1390,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         probe_window=args.probe_window,
         probe_quorum=args.probe_quorum,
         probe_expected_peers=args.probe_expected_peers,
+        probe_degree=args.probe_degree,
         probe_fail_threshold=args.probe_fail_threshold,
         probe_recovery_threshold=args.probe_recovery_threshold,
         telemetry_enabled=args.telemetry_enabled,

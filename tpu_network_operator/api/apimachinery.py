@@ -46,6 +46,13 @@ def to_dict(obj: Any, *, omit_empty: bool = True) -> Any:
         for f in dataclasses.fields(obj):
             name = f.metadata.get("json", f.name)
             val = to_dict(getattr(obj, f.name), omit_empty=omit_empty)
+            if val is None:
+                # Go has no JSON null for value fields, and a nil
+                # pointer is dropped even without omitempty here: None
+                # means "unset", never a wire value.  This is what lets
+                # a required pointer-analog field (e.g. probe.degree)
+                # distinguish explicit 0 from absent.
+                continue
             if omit_empty and _is_empty(val) and not f.metadata.get("required"):
                 continue
             out[name] = val

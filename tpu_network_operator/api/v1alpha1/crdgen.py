@@ -79,6 +79,21 @@ def openapi_schema() -> Dict[str, Any]:
                         "minimum": t.LOG_LEVEL_MIN,
                         "maximum": t.LOG_LEVEL_MAX,
                     },
+                    "statusDetail": {
+                        "type": "string",
+                        "enum": [t.STATUS_DETAIL_FULL,
+                                 t.STATUS_DETAIL_SUMMARY],
+                        "description": (
+                            "Status rollup detail: full embeds the "
+                            "per-node connectivity matrix; summary "
+                            "bounds per-node lists to worst-K and "
+                            "rolls the fleet up per rack/slice shard "
+                            "in status.summary (absent = auto, "
+                            "summary above "
+                            f"{t.STATUS_SUMMARY_NODE_THRESHOLD} "
+                            "targets)."
+                        ),
+                    },
                     "gaudiScaleOut": {
                         "type": "object",
                         "properties": _so_common_props(
@@ -201,6 +216,23 @@ def openapi_schema() -> Dict[str, Any]:
                                             "Consecutive healthy rounds "
                                             "before it is restored "
                                             "(0 = 2)."
+                                        ),
+                                    },
+                                    "degree": {
+                                        "type": "integer",
+                                        "minimum": 0,
+                                        "maximum": t.MAX_PROBE_DEGREE,
+                                        "description": (
+                                            "Sampled probe topology: "
+                                            "each node probes at most "
+                                            "this many assigned peers "
+                                            "(deterministic rack-aware "
+                                            "k-regular graph) instead "
+                                            "of the full mesh "
+                                            "(0 = full mesh; defaulted "
+                                            "to "
+                                            f"{t.DEFAULT_PROBE_DEGREE} "
+                                            "for large expectedPeers)."
                                         ),
                                     },
                                 },
@@ -344,6 +376,42 @@ def openapi_schema() -> Dict[str, Any]:
                             "the report Leases (version-skew "
                             "visibility)."
                         ),
+                    },
+                    "summary": {
+                        "type": "object",
+                        "description": (
+                            "Bounded per-shard fleet rollup — O(shards) "
+                            "rows at any node count; the primary "
+                            "status surface in summary detail mode."
+                        ),
+                        "properties": {
+                            "detail": {
+                                "type": "string",
+                                "enum": [t.STATUS_DETAIL_FULL,
+                                         t.STATUS_DETAIL_SUMMARY],
+                            },
+                            "nodesTotal": {"type": "integer"},
+                            "nodesReady": {"type": "integer"},
+                            "nodesDegraded": {"type": "integer"},
+                            "nodesQuarantined": {"type": "integer"},
+                            "nodesAnomalous": {"type": "integer"},
+                            "shards": {
+                                "type": "array",
+                                "items": {
+                                    "type": "object",
+                                    "properties": {
+                                        "shard": {"type": "string"},
+                                        "nodes": {"type": "integer"},
+                                        "ready": {"type": "integer"},
+                                        "degraded": {"type": "integer"},
+                                        "quarantined": {
+                                            "type": "integer",
+                                        },
+                                        "anomalous": {"type": "integer"},
+                                    },
+                                },
+                            },
+                        },
                     },
                 },
             },

@@ -63,6 +63,34 @@ PROBE_STATE_DEGRADED = "Degraded"
 PROBE_STATE_QUARANTINED = "Quarantined"
 CONDITION_DATAPLANE_DEGRADED = "DataplaneDegraded"
 
+# sampled probe topology: default out-degree and the shard math live in
+# probe/topology.py (one copy for reconciler AND agent); aliased here
+# for the CRD/webhook layer like the other probe defaults
+from ...probe import topology as _topology  # noqa: E402
+
+DEFAULT_PROBE_DEGREE = _topology.DEFAULT_DEGREE
+# ceiling for probe.degree (CRD schema maximum + webhook validation);
+# a quorum above it could never be satisfied under sampling, so the
+# webhook's scale defaulting leaves such specs on full mesh
+MAX_PROBE_DEGREE = 1024
+
+# status rollup detail modes (spec.statusDetail): "full" embeds the
+# complete per-node connectivity matrix in status.probeNodes (the
+# pre-scale behavior, fine to ~hundreds of nodes); "summary" bounds
+# probeNodes/errors/anomalies to worst-K lists plus the per-shard
+# status.summary rollup, keeping the CR object size flat at any fleet
+# size.  "" = auto: the webhook flips it to "summary" when
+# probe.expectedPeers advertises a fleet above the threshold, and the
+# reconciler flips at rollup time when the LIVE target count crosses it
+STATUS_DETAIL_FULL = "full"
+STATUS_DETAIL_SUMMARY = "summary"
+STATUS_DETAIL_MODES = ("", STATUS_DETAIL_FULL, STATUS_DETAIL_SUMMARY)
+STATUS_SUMMARY_NODE_THRESHOLD = 200
+# worst-K bound applied to status.probeNodes / status.errors in
+# summary mode (triage entry points, not dumps — the full data is one
+# `kubectl get lease -l tpunet.dev/agent` away)
+STATUS_WORST_K = 20
+
 # dataplane telemetry defaults: aliased from the agent sampler (one
 # copy of the contract, like the probe defaults above)
 from ...agent import telemetry as _telemetry_defaults  # noqa: E402
@@ -115,6 +143,17 @@ class ProbeSpec:
     # consecutive healthy rounds before it is restored — label flap
     # damping (0 = DEFAULT_PROBE_RECOVERY_THRESHOLD)
     recovery_threshold: int = j("recoveryThreshold", 0)
+    # sampled probe topology: each node probes at most ``degree``
+    # assigned peers (deterministic seeded k-regular rack-aware
+    # assignment computed by the reconciler) instead of the full mesh —
+    # O(degree x nodes) datagrams per round instead of O(nodes²).
+    # 0 = full mesh.  Pointer-analog (None = unset, like a Go *int32):
+    # the webhook defaults unset to DEFAULT_PROBE_DEGREE when
+    # expectedPeers advertises a fleet past the summary threshold, but
+    # an EXPLICIT 0 means full mesh and must survive defaulting —
+    # ``required=True`` keeps the 0 on the wire (omitempty would drop
+    # it and the next update would re-default it away).
+    degree: Optional[int] = j("degree", None, required=True)
 
 
 @dataclass
@@ -217,6 +256,11 @@ class NetworkClusterPolicySpec:
     tpu_scale_out: TpuScaleOutSpec = j("tpuScaleOut", factory=TpuScaleOutSpec)
     # Agent log verbosity (propagated as --v=N, ref controller :182-184).
     log_level: int = j("logLevel", 0)
+    # Status rollup detail: "full" | "summary" | "" (auto — summary
+    # above STATUS_SUMMARY_NODE_THRESHOLD live targets).  Summary mode
+    # bounds status.probeNodes/errors to worst-K and rolls the fleet up
+    # per rack/slice shard into status.summary instead.
+    status_detail: str = j("statusDetail", "")
 
 
 @dataclass
@@ -258,6 +302,39 @@ class TelemetryStatus:
 
 
 @dataclass
+class ShardSummary:
+    """One rack/slice shard's aggregate — a bounded row of the fleet
+    rollup (O(shards) rows regardless of node count)."""
+
+    # rack/slice label value, or "bucket-<i>" for unlabeled nodes
+    shard: str = j("shard", "")
+    nodes: int = j("nodes", 0)
+    ready: int = j("ready", 0)
+    # probe-mesh verdicts (0 when probing is off for the policy)
+    degraded: int = j("degraded", 0)
+    quarantined: int = j("quarantined", 0)
+    # nodes with at least one active telemetry anomaly
+    anomalous: int = j("anomalous", 0)
+
+
+@dataclass
+class StatusSummary:
+    """Fleet-level rollup that stays O(shards) at any node count — the
+    scale-mode replacement for embedding per-node rows in the CR.
+    Always computed for tpu-so policies; in summary mode it is the
+    primary status surface and the per-node lists are worst-K capped."""
+
+    # which detail mode produced this pass ("full" | "summary")
+    detail: str = j("detail", "")
+    nodes_total: int = j("nodesTotal", 0)
+    nodes_ready: int = j("nodesReady", 0)
+    nodes_degraded: int = j("nodesDegraded", 0)
+    nodes_quarantined: int = j("nodesQuarantined", 0)
+    nodes_anomalous: int = j("nodesAnomalous", 0)
+    shards: List[ShardSummary] = j("shards", factory=list)
+
+
+@dataclass
 class PolicyCondition:
     """metav1.Condition subset (the DataplaneDegraded carrier)."""
 
@@ -287,6 +364,9 @@ class NetworkClusterPolicyStatus:
     # fleet version skew: agent package version -> node count, from the
     # report Leases (omit-empty)
     agent_versions: Dict[str, int] = j("agentVersions", factory=dict)
+    # bounded per-shard fleet rollup (omit-empty: absent for non-tpu
+    # policies); in summary mode this is the primary status surface
+    summary: Optional[StatusSummary] = j("summary", None)
 
 
 @dataclass

@@ -79,6 +79,37 @@ def default_policy(policy: NetworkClusterPolicy) -> NetworkClusterPolicy:
                 p.failure_threshold = t.DEFAULT_PROBE_FAILURE_THRESHOLD
             if not p.recovery_threshold:
                 p.recovery_threshold = t.DEFAULT_PROBE_RECOVERY_THRESHOLD
+            # scale defaults: an expectedPeers advertising a fleet past
+            # the summary threshold flips the policy to sampled probing
+            # (full mesh would be O(n²) datagrams) and to the bounded
+            # summary status (a full per-node matrix would blow the CR
+            # toward the 1.5 MiB object limit)
+            if (
+                p.degree is None
+                and p.expected_peers > t.STATUS_SUMMARY_NODE_THRESHOLD
+                and p.quorum <= t.MAX_PROBE_DEGREE
+            ):
+                # only UNSET degree is defaulted — an explicit 0 means
+                # the user chose full mesh and must survive (the flat
+                # map is sharded past the byte budget, so full mesh on
+                # a big fleet is expressible).  And never default a
+                # spec into rejection: an explicit quorum above the
+                # default degree raises the sampled degree to match
+                # (validation rejects quorum > degree, and a pre-scale
+                # CR with quorum=50 must keep round-tripping after
+                # this default appeared); a quorum past
+                # MAX_PROBE_DEGREE stays on full mesh
+                p.degree = max(t.DEFAULT_PROBE_DEGREE, p.quorum)
+            if p.degree is None:
+                # pin the contract like the other probe knobs: once
+                # admitted, the stored object always carries an
+                # explicit degree
+                p.degree = 0
+            if (
+                not spec.status_detail
+                and p.expected_peers > t.STATUS_SUMMARY_NODE_THRESHOLD
+            ):
+                spec.status_detail = t.STATUS_DETAIL_SUMMARY
         if so.telemetry.enabled:
             # same contract pinning for the counter-telemetry knobs
             tl = so.telemetry
@@ -174,6 +205,19 @@ def validate_probe_spec(p: t.ProbeSpec) -> None:
             raise AdmissionError(
                 f"tpuScaleOut.probe: {name} must be 0-100"
             )
+    if p.degree is not None and (
+        p.degree < 0 or p.degree > t.MAX_PROBE_DEGREE
+    ):
+        raise AdmissionError(
+            f"tpuScaleOut.probe: degree must be 0-{t.MAX_PROBE_DEGREE}"
+        )
+    if p.degree and p.quorum > p.degree:
+        # a node only probes `degree` assigned peers — demanding more
+        # reachable than probed could never be satisfied
+        raise AdmissionError(
+            f"tpuScaleOut.probe: quorum ({p.quorum}) exceeds sampled "
+            f"degree ({p.degree}) — unsatisfiable"
+        )
 
 
 def validate_telemetry_spec(tl: t.TelemetrySpec) -> None:
@@ -252,6 +296,11 @@ def validate_spec(spec: NetworkClusterPolicySpec) -> List[str]:
     if not (t.LOG_LEVEL_MIN <= spec.log_level <= t.LOG_LEVEL_MAX):
         raise AdmissionError(
             f"logLevel must be within {t.LOG_LEVEL_MIN}-{t.LOG_LEVEL_MAX}"
+        )
+    if spec.status_detail not in t.STATUS_DETAIL_MODES:
+        raise AdmissionError(
+            "statusDetail must be \"\" (auto), "
+            f"{t.STATUS_DETAIL_FULL!r} or {t.STATUS_DETAIL_SUMMARY!r}"
         )
     if spec.configuration_type == t.CONFIG_TYPE_GAUDI_SO:
         validate_gaudi_so_spec(spec.gaudi_scale_out)

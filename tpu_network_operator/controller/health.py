@@ -70,6 +70,23 @@ METRIC_HELP: Dict[str, str] = {
         "telemetry reports.",
     "tpunet_iface_error_ratio":
         "Window error ratio (errors/(errors+packets)) per node interface.",
+    "tpunet_shard_nodes":
+        "Nodes per rack/slice shard in the policy's fleet rollup.",
+    "tpunet_shard_ready_nodes":
+        "Nodes per shard whose agent reported a successful pass.",
+    "tpunet_shard_degraded_nodes":
+        "Nodes per shard currently below probe quorum.",
+    "tpunet_shard_quarantined_nodes":
+        "Nodes per shard quarantined by the dataplane probe mesh.",
+    "tpunet_shard_anomalous_nodes":
+        "Nodes per shard with active interface counter anomalies.",
+    "tpunet_peer_shards":
+        "Peer-distribution ConfigMaps (index + shards) per policy.",
+    "tpunet_peer_shard_overflow_total":
+        "Peer shard payloads that exceeded the byte budget and were "
+        "split further.",
+    "tpunet_status_bytes":
+        "Serialized CR status size in bytes at the last status write.",
 }
 
 

@@ -97,6 +97,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="informer cache relist interval — the backstop "
                         "that prunes objects deleted while a watch was "
                         "down (0 = watch-only, never relist)")
+    p.add_argument("--peer-shard-byte-budget", type=int,
+                   default=0,
+                   help="max bytes per probe peer-shard ConfigMap "
+                        "payload; over-budget shards are split, never "
+                        "truncated (0 = default, 512 KiB)")
     return p
 
 
@@ -156,6 +161,10 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
     cached.cache("apps/v1", "DaemonSet", namespace=args.namespace)
     cached.cache("v1", "Pod", namespace=args.namespace)
     cached.cache(LEASE_API, "Lease", namespace=args.namespace)
+    # Nodes feed the rack/slice shard keys (topology labels) for the
+    # sampled probe assignment and the per-shard status rollup — cached
+    # so the reconciler's TTL'd rack-map refresh costs zero wire lists
+    cached.cache("v1", "Node")
     # probe peer-list ConfigMaps are deliberately NOT cached: caching
     # "v1 ConfigMap" would store/watch every CM in the namespace (CA
     # bundles, co-located app configs, up to 1MiB each) to serve one
@@ -175,6 +184,8 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
                   concurrent_reconciles=args.concurrent_reconciles,
                   tracer=tracer, events=recorder)
     mgr.reconciler.REPORT_CACHE_SECONDS = args.report_cache_seconds
+    if args.peer_shard_byte_budget > 0:
+        mgr.reconciler.PEER_SHARD_BYTE_BUDGET = args.peer_shard_byte_budget
 
     servers = []
     health = None

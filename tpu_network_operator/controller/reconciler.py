@@ -18,7 +18,7 @@ import logging
 import os.path
 import threading
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, FrozenSet, Iterable, List, Optional
 
 from ..api import apimachinery as am
 from ..api.v1alpha1 import types as t
@@ -27,6 +27,7 @@ from ..kube import errors as kerr
 from ..kube.informer import LIST_PAGE_SIZE   # noqa: F401 — re-exported
 from ..obs import events as obs_events
 from ..obs.trace import TRACE_ANNOTATION, current_trace_id
+from ..probe import topology
 from ..probe.prober import required_peers
 from ..probe.transport import valid_endpoint
 from . import templates
@@ -101,6 +102,17 @@ MAX_TELEMETRY_ANOMALIES = 20
 PROBE_QUARANTINE_PASSES = 3
 PROBE_REPROBE_BASE_SECONDS = 5.0
 PROBE_REPROBE_MAX_SECONDS = 60.0
+
+# per-shard fleet rollup gauges ({policy, shard} labels) exported in
+# summary detail mode instead of the per-node PROBE/TELEMETRY families
+# — O(shards) series at any fleet size; same retraction contract
+SHARD_GAUGES = (
+    "tpunet_shard_nodes",
+    "tpunet_shard_ready_nodes",
+    "tpunet_shard_degraded_nodes",
+    "tpunet_shard_quarantined_nodes",
+    "tpunet_shard_anomalous_nodes",
+)
 
 
 @dataclass
@@ -289,6 +301,11 @@ def update_tpu_scale_out_daemonset(
             "--probe-recovery-threshold="
             f"{so.probe.recovery_threshold or t.DEFAULT_PROBE_RECOVERY_THRESHOLD}",
         ]
+        if so.probe.degree:
+            # sampled topology: the gate must cap its quorum base at the
+            # assigned out-degree (an expectedPeers pinned at fleet size
+            # would otherwise mark every sampled node below quorum)
+            args.append(f"--probe-degree={so.probe.degree}")
     tl = so.telemetry
     if tl.enabled:
         # counter telemetry is agent-default-on; still project every
@@ -369,6 +386,33 @@ class NetworkClusterPolicyReconciler:
         # monotonic: an NTP step must not fast-forward (or freeze) the
         # once-per-interval streak advance
         self._probe_clock = _time.monotonic
+        # scale state (all guarded by _reports_lock — same cross-policy
+        # mutable-state rationale as the bucket cache):
+        # per-lease parse memo {lease name: (rv, report, renewed_ts)} —
+        # a 10k-node rollup re-parses only the leases whose
+        # resourceVersion moved, merging cached shard state for the rest
+        self._lease_memo: Dict[str, Any] = {}
+        # last-applied peer distribution per policy:
+        # {policy: {"count": n_shards, "payloads": {cm_name: payload}}}
+        # — the diff gate that makes a steady mesh cost ZERO ConfigMap
+        # requests per pass (no read-back, no re-apply)
+        self._peer_applied: Dict[str, Dict[str, Any]] = {}
+        # per-policy fingerprint of the last exported metric rows: an
+        # unchanged fleet skips the retract-then-set sweep entirely
+        # (remove_matching scans every series of a family per call)
+        self._metric_fp: Dict[Any, int] = {}
+        # node -> rack/slice shard key, from node topology labels
+        # (chunked Node list, TTL-cached; served by the informer cache
+        # when the operator entrypoint caches Nodes).  _node_racks_seen
+        # holds EVERY node name from the last list (labeled or not) so
+        # a caller asking about a node the cache has never seen forces
+        # a refresh instead of riding the TTL; _node_racks_missing
+        # remembers wanted-but-absent names so a lease that outlives
+        # its Node can't turn every pass into a LIST.
+        self._node_racks: Dict[str, str] = {}
+        self._node_racks_seen: FrozenSet[str] = frozenset()
+        self._node_racks_missing: FrozenSet[str] = frozenset()
+        self._node_racks_at = -1e9
 
     # -- setup ----------------------------------------------------------------
 
@@ -657,6 +701,23 @@ class NetworkClusterPolicyReconciler:
     # delays report visibility by at most the window.  Always small vs
     # REPORT_TTL_SECONDS, so staleness aging is unaffected.
     REPORT_CACHE_SECONDS = 0.0
+    # hard byte ceiling per peer-shard ConfigMap payload: a shard over
+    # this is split further (PeerShardOverflow Event), and one that
+    # cannot be split under it is refused, never truncated.  Settable
+    # via --peer-shard-byte-budget on the operator entrypoint.
+    PEER_SHARD_BYTE_BUDGET = topology.DEFAULT_SHARD_BYTE_BUDGET
+    # node topology labels (rack/slice shard keys) refresh cadence:
+    # rack membership changes at provisioning speed, one chunked Node
+    # list per window covers every policy (served by the informer cache
+    # in the operator entrypoint, so the steady-state wire cost is 0)
+    NODE_TOPOLOGY_REFRESH_SECONDS = 300.0
+    # anti-entropy cadence for the peer-ConfigMap diff gate: the gate
+    # compares against an IN-MEMORY last-applied copy, so an externally
+    # deleted or edited ConfigMap would otherwise never be repaired
+    # while the desired payload stays unchanged.  Every window the gate
+    # re-seeds itself by reading each ConfigMap back (O(shards) GETs,
+    # zero writes when nothing drifted) and re-applies any that differ.
+    PEER_CM_VERIFY_SECONDS = 300.0
 
     def _agent_reports(self, policy_name: str) -> List[Any]:
         """Per-node provisioning reports (Leases the agents apply,
@@ -686,7 +747,13 @@ class NetworkClusterPolicyReconciler:
             ):
                 return self._reports_cache
         try:
-            leases = self.client.list(
+            # read-only cached list when the split client offers it
+            # (kube/informer.py): the store hands back SHARED objects
+            # instead of deep-copying a fleet's worth of Leases per
+            # pass — this path only reads, never mutates
+            list_fn = getattr(self.client, "list_readonly", None) \
+                or self.client.list
+            leases = list_fn(
                 rpt.LEASE_API,
                 "Lease",
                 namespace=self.namespace,
@@ -704,29 +771,52 @@ class NetworkClusterPolicyReconciler:
             self._reports_cached_at = now
         return buckets
 
+    def _parse_one(self, lease: Dict[str, Any], rpt):
+        """``(report, renewed_ts)`` for one lease, memoized by
+        resourceVersion: a 10k-node fleet's rollup pass JSON-parses only
+        the leases that actually changed since the last pass and merges
+        the cached result for the rest — the sharded-rollup read path.
+        The memo holds the PRISTINE parse; staleness aging (a function
+        of the current clock, not of the lease) is applied per pass by
+        the caller."""
+        name = lease.get("metadata", {}).get("name", "")
+        rv = str(
+            lease.get("metadata", {}).get("resourceVersion", "") or ""
+        )
+        with self._reports_lock:
+            hit = self._lease_memo.get(name)
+            if hit is not None and rv and hit[0] == rv:
+                return hit[1], hit[2]
+        node = lease.get("spec", {}).get("holderIdentity", "?")
+        raw = (
+            lease.get("metadata", {}).get("annotations", {}) or {}
+        ).get(rpt.REPORT_ANNOTATION, "")
+        try:
+            rep = rpt.ProvisioningReport.from_json(raw)
+        except Exception:   # noqa: BLE001 — malformed = not ready
+            rep = rpt.ProvisioningReport(
+                node=node, ok=False, error="unparseable report"
+            )
+        renewed = rpt.parse_micro_time(
+            str(lease.get("spec", {}).get("renewTime", "") or "")
+        )
+        if rv:
+            with self._reports_lock:
+                self._lease_memo[name] = (rv, rep, renewed)
+        return rep, renewed
+
     def _parse_buckets(
         self, leases: List[Dict[str, Any]], now: float, rpt
     ) -> Dict[str, List[Any]]:
         buckets: Dict[str, List[Any]] = {}
+        seen = set()
         for lease in leases:
             policy_name = (
                 lease.get("metadata", {}).get("labels", {}) or {}
             ).get(rpt.POLICY_LABEL, "")
             out = buckets.setdefault(policy_name, [])
-            node = lease.get("spec", {}).get("holderIdentity", "?")
-            raw = (
-                lease.get("metadata", {}).get("annotations", {}) or {}
-            ).get(rpt.REPORT_ANNOTATION, "")
-            try:
-                rep = rpt.ProvisioningReport.from_json(raw)
-            except Exception:   # noqa: BLE001 — malformed = not ready
-                out.append(rpt.ProvisioningReport(
-                    node=node, ok=False, error="unparseable report"
-                ))
-                continue
-            renewed = rpt.parse_micro_time(
-                str(lease.get("spec", {}).get("renewTime", "") or "")
-            )
+            seen.add(lease.get("metadata", {}).get("name", ""))
+            rep, renewed = self._parse_one(lease, rpt)
             if (
                 rep.ok
                 and renewed is not None
@@ -741,6 +831,10 @@ class NetworkClusterPolicyReconciler:
                 ))
                 continue
             out.append(rep)
+        with self._reports_lock:
+            # departed leases must not pin their parse forever
+            for name in [k for k in self._lease_memo if k not in seen]:
+                del self._lease_memo[name]
         return buckets
 
     def _target_nodes(self, ds: Dict[str, Any]) -> set:
@@ -749,7 +843,12 @@ class NetworkClusterPolicyReconciler:
         materialized (e.g. envtest-style runs), in which case report
         filtering degrades to trusting the Lease set."""
         try:
-            pods = self.client.list(
+            # read-only list (kube/informer.py): this path only plucks
+            # nodeName — deep-copying a fleet's worth of Pods per pass
+            # would dominate the 10k-node status rollup
+            list_fn = getattr(self.client, "list_readonly", None) \
+                or self.client.list
+            pods = list_fn(
                 "v1",
                 "Pod",
                 namespace=self.namespace,
@@ -766,6 +865,107 @@ class NetworkClusterPolicyReconciler:
             for p in pods
         } - {""}
 
+    # -- scale: shard keys + detail mode --------------------------------------
+
+    def _rack_map(
+        self, wanted: Optional[Iterable[str]] = None
+    ) -> Dict[str, str]:
+        """node -> rack/slice shard key from node topology labels
+        (probe.topology.RACK_LABELS), TTL-cached one chunked Node list
+        per NODE_TOPOLOGY_REFRESH_SECONDS.  Only consulted on the scale
+        paths (sampled assignment, summary rollup) — small-fleet
+        full-detail passes never pay the list.  ``wanted`` is the node
+        set the caller is about to shard: a wanted node the last list
+        never saw means the fleet grew since the cache was built, so
+        the TTL is bypassed and the map refreshed — otherwise nodes
+        joining inside one TTL window would silently land in hash
+        buckets despite carrying topology labels.  The refresh is
+        bounded: wanted-but-absent names are remembered, so a report
+        Lease outliving its Node re-lists once, not every pass.  A
+        list failure keeps the last known map (hash buckets cover
+        unknown nodes)."""
+        import time as time_mod
+
+        now = time_mod.monotonic()
+        wanted_set = frozenset(wanted) if wanted is not None else None
+        with self._reports_lock:
+            fresh = (
+                now - self._node_racks_at
+                < self.NODE_TOPOLOGY_REFRESH_SECONDS
+            )
+            if fresh:
+                missing = (
+                    wanted_set - self._node_racks_seen
+                    if wanted_set is not None else frozenset()
+                )
+                # subset, not equality: the memo accumulates absences
+                # across policies, so two policies each dragging their
+                # own departed node can't alternate-bust the TTL and
+                # re-list every pass
+                if missing <= self._node_racks_missing:
+                    return self._node_racks
+        try:
+            list_fn = getattr(self.client, "list_readonly", None) \
+                or self.client.list
+            nodes = list_fn("v1", "Node", limit=LIST_PAGE_SIZE)
+        except Exception as e:   # noqa: BLE001 — hash buckets cover it
+            log.debug("node topology list failed: %s", e)
+            with self._reports_lock:
+                self._node_racks_at = now
+                if wanted_set is not None:
+                    self._node_racks_missing |= (
+                        wanted_set - self._node_racks_seen
+                    )
+            return self._node_racks
+        racks = {}
+        seen = set()
+        for node in nodes:
+            meta = node.get("metadata", {}) or {}
+            name = str(meta.get("name", ""))
+            seen.add(name)
+            rack = topology.rack_of(meta.get("labels"))
+            if rack:
+                racks[name] = rack
+        with self._reports_lock:
+            self._node_racks = racks
+            self._node_racks_seen = frozenset(seen)
+            # union with the prior memo, pruned by this fresh listing:
+            # other policies' known-absent nodes stay remembered, while
+            # anything that has since appeared drops out
+            self._node_racks_missing = (
+                (self._node_racks_missing | wanted_set)
+                - self._node_racks_seen
+                if wanted_set is not None
+                else self._node_racks_missing - self._node_racks_seen
+            )
+            self._node_racks_at = now
+        return racks
+
+    def _detail_mode(
+        self, policy: NetworkClusterPolicy, n_nodes: int
+    ) -> str:
+        """Resolve spec.statusDetail: explicit wins; auto flips to
+        summary once the live fleet crosses the threshold — the CR
+        object must stay bounded even when nobody set the knob."""
+        if policy.spec.status_detail in (
+            t.STATUS_DETAIL_FULL, t.STATUS_DETAIL_SUMMARY
+        ):
+            return policy.spec.status_detail
+        return (
+            t.STATUS_DETAIL_SUMMARY
+            if n_nodes > t.STATUS_SUMMARY_NODE_THRESHOLD
+            else t.STATUS_DETAIL_FULL
+        )
+
+    @staticmethod
+    def _shard_key_of(
+        node: str, racks: Dict[str, str], n_buckets: int
+    ) -> str:
+        rack = racks.get(node, "")
+        if rack:
+            return rack
+        return f"bucket-{topology.shard_of(node, n_buckets):03d}"
+
     # -- dataplane probe mesh -------------------------------------------------
 
     @staticmethod
@@ -775,18 +975,116 @@ class NetworkClusterPolicyReconciler:
             and policy.spec.tpu_scale_out.probe.enabled
         )
 
-    def _sync_probe_peers(
-        self, policy: NetworkClusterPolicy, reports: List[Any]
-    ) -> None:
-        """Distribute the mesh membership: one owned ConfigMap per
-        policy mapping node → probe endpoint, derived from the agents'
-        own reports (a node joins the mesh by reporting where it
-        answers).  Apply only on change, so a steady mesh costs zero
-        writes per pass."""
+    def _desired_peer_cms(
+        self, policy: NetworkClusterPolicy, desired: Dict[str, str]
+    ):
+        """``(data_by_cm_name, n_shards, overflowed)`` — the complete
+        desired peer distribution for one policy.
+
+        Small full-mesh fleets keep the pre-scale layout byte-for-byte
+        (one ``tpunet-peers-<policy>`` ConfigMap, ``peers`` = flat
+        endpoint map) so existing agents keep working.  Sampled or
+        large meshes switch to the ``assignments`` schema — each node's
+        k-peer row, bucketed into ``tpunet-peers-<policy>-<i>`` shard
+        ConfigMaps by :func:`topology.shard_of` — and every payload is
+        held under PEER_SHARD_BYTE_BUDGET by splitting further (the
+        1 MiB etcd object limit must never decide mesh membership)."""
         import json
 
         from ..agent import report as rpt
 
+        pname = policy.metadata.name
+        index_name = rpt.peer_configmap_name(pname)
+        degree = policy.spec.tpu_scale_out.probe.degree or 0
+        sampled = topology.sampling_active(len(desired), degree)
+        flat = json.dumps(desired, sort_keys=True)
+        budget = self.PEER_SHARD_BYTE_BUDGET
+        # the index CM always carries ALL THREE keys ("" = unused):
+        # server-side apply here rides a merge (both the fake and the
+        # wire PATCH handler deep-merge data), so a layout change must
+        # overwrite the previous layout's key, not leave it stale
+        if not sampled and len(flat.encode()) <= budget:
+            # legacy layout (+ meta, which old agents ignore)
+            return {
+                index_name: {
+                    topology.PEERS_KEY: flat,
+                    topology.ASSIGNMENTS_KEY: "",
+                    topology.META_KEY: topology.index_meta(
+                        1, 0, len(desired)
+                    ),
+                },
+            }, 1, False
+        if not sampled:
+            # full mesh whose flat map no longer fits one object:
+            # shard the O(n) membership itself (peers rows bucketed by
+            # shard_of; agents merge all shards).  NEVER expand a full
+            # mesh into per-node assignment rows — that duplicates the
+            # whole endpoint map n times, O(n²) bytes built and
+            # applied per pass.
+            n_shards, payloads, overflowed = (
+                topology.split_flat_for_budget(desired, budget)
+            )
+            cms = {
+                index_name: {
+                    topology.PEERS_KEY: "",
+                    topology.ASSIGNMENTS_KEY: "",
+                    topology.META_KEY: topology.index_meta(
+                        n_shards, 0, len(desired)
+                    ),
+                },
+            }
+            for i, payload in enumerate(payloads):
+                cms[f"{index_name}-{i}"] = {
+                    topology.PEERS_KEY: payload,
+                    topology.ASSIGNMENTS_KEY: "",
+                }
+            return cms, n_shards, overflowed
+        assignments = topology.assign_peers(
+            desired, degree, seed=pname,
+            racks=self._rack_map(wanted=desired),
+        )
+        n_shards, payloads, overflowed = topology.split_for_budget(
+            assignments, budget, topology.shard_count(len(desired)),
+        )
+        meta = topology.index_meta(n_shards, degree, len(desired))
+        if n_shards == 1:
+            return {
+                index_name: {
+                    topology.PEERS_KEY: "",
+                    topology.ASSIGNMENTS_KEY: payloads[0],
+                    topology.META_KEY: meta,
+                },
+            }, 1, overflowed
+        cms = {
+            index_name: {
+                topology.PEERS_KEY: "",
+                topology.ASSIGNMENTS_KEY: "",
+                topology.META_KEY: meta,
+            },
+        }
+        for i, payload in enumerate(payloads):
+            cms[f"{index_name}-{i}"] = {
+                topology.ASSIGNMENTS_KEY: payload,
+                # constant-keyed: a layout flip (full-mesh sharded ->
+                # sampled) rides a merge-apply, so the other layout's
+                # key must be overwritten, not left stale
+                topology.PEERS_KEY: "",
+            }
+        return cms, n_shards, overflowed
+
+    def _sync_probe_peers(
+        self, policy: NetworkClusterPolicy, reports: List[Any]
+    ) -> None:
+        """Distribute the mesh membership + sampled probe topology:
+        owned ConfigMap(s) per policy derived from the agents' own
+        reports (a node joins the mesh by reporting where it answers).
+        The whole distribution is one diff-gated batched flush per
+        pass — only shards whose payload actually changed are applied
+        (against the in-memory last-applied copy; one read-back per
+        ConfigMap after a restart), so a steady mesh costs ZERO
+        requests and a membership change costs O(changed shards), not
+        O(nodes)."""
+        pname = policy.metadata.name
         # drop malformed endpoints HERE: one bad "host" (no port) from a
         # skewed/buggy agent would otherwise crash every peer's probe
         # round at send() and silently freeze mesh validation fleet-wide
@@ -795,29 +1093,142 @@ class NetworkClusterPolicyReconciler:
             for r in reports
             if r.probe_endpoint and valid_endpoint(r.probe_endpoint)
         }
-        name = rpt.peer_configmap_name(policy.metadata.name)
-        payload = json.dumps(desired, sort_keys=True)
-        try:
-            cur = self.client.get("v1", "ConfigMap", name, self.namespace)
-            if (cur.get("data", {}) or {}).get("peers") == payload:
-                return
-        except kerr.NotFoundError:
-            pass
-        except Exception as e:   # noqa: BLE001 — apply below self-heals
-            log.debug("peer ConfigMap read failed: %s", e)
-        cm = {
-            "apiVersion": "v1",
-            "kind": "ConfigMap",
-            "metadata": {"name": name, "namespace": self.namespace},
-            "data": {"peers": payload},
-        }
-        self._own(policy, cm)
-        try:
-            self.client.apply(cm, field_manager="tpunet-operator-probe")
-            log.info("probe peer list updated: %s (%d peers)",
-                     name, len(desired))
-        except Exception as e:   # noqa: BLE001 — next pass retries
-            log.warning("peer ConfigMap apply failed: %s", e)
+        cms, n_shards, overflowed = self._desired_peer_cms(
+            policy, desired
+        )
+        from ..agent import report as rpt_mod
+
+        index_name = rpt_mod.peer_configmap_name(pname)
+        budget = self.PEER_SHARD_BYTE_BUDGET
+        now = self._probe_clock()
+        with self._reports_lock:
+            state = self._peer_applied.get(pname)
+            applied = dict(state["payloads"]) if state else None
+            old_count = state["count"] if state else 0
+            verified_at = (
+                state.get("verified_at", -1e9) if state else -1e9
+            )
+            was_overflowed = bool(state and state.get("overflowed"))
+        if overflowed and not was_overflowed:
+            # edge-gated like the condition flips: `overflowed` is a
+            # deterministic property of the recomputed layout, so a
+            # steady over-budget mesh would otherwise bump the counter
+            # and patch the Event's count every single pass
+            if self.metrics:
+                self.metrics.inc(
+                    "tpunet_peer_shard_overflow_total",
+                    {"policy": pname},
+                )
+            self._emit(
+                policy, obs_events.TYPE_WARNING, "PeerShardOverflow",
+                f"peer shard payload exceeded the "
+                f"{self.PEER_SHARD_BYTE_BUDGET}-byte budget; split "
+                f"into {n_shards} shards (consider a smaller "
+                f"probe.degree or shorter node names)",
+            )
+        if state is None:
+            # restart with no in-memory flush state: the previous
+            # shard count must come from the index ConfigMap's own
+            # meta (one GET), or a fleet that shrank/resharded across
+            # the restart leaves its tail shards orphaned in etcd
+            # forever (GC below only walks [new_count, old_count))
+            try:
+                cur = self.client.get(
+                    "v1", "ConfigMap", index_name, self.namespace,
+                )
+                old_count, _ = topology.parse_meta(
+                    (cur.get("data", {}) or {}).get(
+                        topology.META_KEY, ""
+                    )
+                )
+                if old_count == 1:
+                    old_count = 0   # single-CM layout: no suffixes
+            except Exception as e:   # noqa: BLE001 — nothing to GC yet
+                log.debug("peer index read-back: %s", e)
+        if (
+            applied is not None
+            and now - verified_at >= self.PEER_CM_VERIFY_SECONDS
+        ):
+            # anti-entropy: drop the in-memory gate so every ConfigMap
+            # is read back once this pass — an externally deleted or
+            # kubectl-edited shard gets re-applied even though the
+            # desired payload never changed
+            applied = None
+        verified = applied is None
+        flushed = 0
+        new_payloads: Dict[str, Any] = {}
+        for name, data in cms.items():
+            oversize = [
+                k for k, v in data.items()
+                if k != topology.META_KEY and len(v.encode()) > budget
+            ]
+            if oversize:
+                # refuse, never truncate: an incomplete peer row would
+                # silently blind part of the mesh
+                log.error(
+                    "peer shard %s payload over budget even at max "
+                    "split; refusing to apply", name,
+                )
+                continue
+            if applied is not None and applied.get(name) == data:
+                new_payloads[name] = data
+                continue
+            if applied is None:
+                # restart (or first pass): read back once to re-seed
+                # the diff gate instead of blind-applying every shard
+                try:
+                    cur = self.client.get(
+                        "v1", "ConfigMap", name, self.namespace
+                    )
+                    if (cur.get("data", {}) or {}) == data:
+                        new_payloads[name] = data
+                        continue
+                except kerr.NotFoundError:
+                    pass
+                except Exception as e:   # noqa: BLE001 — apply heals
+                    log.debug("peer ConfigMap read failed: %s", e)
+            cm = {
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                "metadata": {"name": name, "namespace": self.namespace},
+                "data": data,
+            }
+            self._own(policy, cm)
+            try:
+                self.client.apply(
+                    cm, field_manager="tpunet-operator-probe"
+                )
+                new_payloads[name] = data
+                flushed += 1
+            except Exception as e:   # noqa: BLE001 — next pass retries
+                log.warning("peer ConfigMap apply failed: %s", e)
+        # GC shards beyond the current count (mesh shrank / resharded)
+        for i in range(n_shards if n_shards > 1 else 0, old_count):
+            try:
+                self.client.delete(
+                    "v1", "ConfigMap", f"{index_name}-{i}",
+                    self.namespace,
+                )
+            except Exception as e:   # noqa: BLE001 — already gone is fine
+                log.debug("peer shard GC: %s", e)
+        with self._reports_lock:
+            self._peer_applied[pname] = {
+                "count": n_shards if n_shards > 1 else 0,
+                "payloads": new_payloads,
+                "verified_at": now if verified else verified_at,
+                "overflowed": overflowed,
+            }
+        if self.metrics:
+            self.metrics.set_gauge(
+                "tpunet_peer_shards", float(len(cms)),
+                {"policy": pname},
+            )
+        if flushed:
+            log.info(
+                "probe peer distribution updated: %s (%d nodes, %d "
+                "shard(s), %d ConfigMap(s) flushed)",
+                index_name, len(desired), n_shards, flushed,
+            )
 
     def _aggregate_probe(
         self, policy: NetworkClusterPolicy, reports: List[Any]
@@ -844,7 +1255,8 @@ class NetworkClusterPolicyReconciler:
             peers_total = _as_int(probe.get("peersTotal"))
             reachable = _as_int(probe.get("peersReachable"))
             required = required_peers(
-                spec.quorum, spec.expected_peers, peers_total
+                spec.quorum, spec.expected_peers, peers_total,
+                spec.degree or 0,
             )
             # the Degraded verdict DEFERS to the agent gate (it damps
             # single-round blips with its fail/recovery thresholds and
@@ -918,21 +1330,104 @@ class NetworkClusterPolicyReconciler:
         return rows, degraded, requeue_after
 
     def _prune_probe_state(self, policy_name: str) -> None:
-        """Deleted policy: drop its quarantine streaks and gauge series
-        (same phantom-retraction contract as POLICY_GAUGES)."""
+        """Deleted policy: drop its quarantine streaks, peer-flush diff
+        state and gauge series (same phantom-retraction contract as
+        POLICY_GAUGES)."""
         with self._probe_lock:
             for key in [
                 k for k in self._probe_failing if k[0] == policy_name
             ]:
                 del self._probe_failing[key]
+        with self._reports_lock:
+            self._peer_applied.pop(policy_name, None)
+            for key in [
+                k for k in self._metric_fp if k[0] == policy_name
+            ]:
+                del self._metric_fp[key]
         if self.metrics:
-            for gauge in PROBE_GAUGES:
+            for gauge in PROBE_GAUGES + SHARD_GAUGES:
                 self.metrics.remove_matching(gauge, {"policy": policy_name})
+            self.metrics.remove_gauge(
+                "tpunet_peer_shards", {"policy": policy_name}
+            )
+
+    def _delete_peer_cms(self, policy_name: str) -> None:
+        """Probe switched off (CR still live): delete the whole
+        distributed peer set — index AND shard ConfigMaps.  The shard
+        count comes from the in-memory flush state, falling back to the
+        index ConfigMap's own meta after a restart."""
+        from ..agent import report as rpt_mod
+
+        index_name = rpt_mod.peer_configmap_name(policy_name)
+        with self._reports_lock:
+            state = self._peer_applied.get(policy_name)
+            count = state["count"] if state else -1
+        if count < 0:
+            try:
+                cur = self.client.get(
+                    "v1", "ConfigMap", index_name, self.namespace
+                )
+                count, _ = topology.parse_meta(
+                    (cur.get("data", {}) or {}).get(
+                        topology.META_KEY, ""
+                    )
+                )
+                if count == 1:
+                    count = 0   # single-CM layout: no shard suffixes
+            except Exception as e:   # noqa: BLE001 — already gone is fine
+                log.debug("peer index read on disable: %s", e)
+                count = 0
+        for i in range(count):
+            try:
+                self.client.delete(
+                    "v1", "ConfigMap", f"{index_name}-{i}",
+                    self.namespace,
+                )
+            except Exception as e:   # noqa: BLE001 — already gone is fine
+                log.debug("peer shard delete: %s", e)
+        try:
+            self.client.delete(
+                "v1", "ConfigMap", index_name, self.namespace
+            )
+        except Exception as e:   # noqa: BLE001 — already gone is fine
+            log.debug("peer ConfigMap delete: %s", e)
+
+    def _fp_gate(self, policy_name: str, kind: str, fp: int) -> bool:
+        """Batched metric flush gate: True when this export's
+        fingerprint differs from the last flushed one.  remove_matching
+        scans every series of a family per call — an unchanged fleet
+        must not pay the retract-then-set sweep every pass."""
+        key = (policy_name, kind)
+        with self._reports_lock:
+            if self._metric_fp.get(key) == fp:
+                return False
+            self._metric_fp[key] = fp
+            return True
 
     def _export_probe_metrics(
-        self, policy_name: str, rows: List[t.NodeProbeStatus]
+        self, policy_name: str, rows: List[t.NodeProbeStatus],
+        detail: str = t.STATUS_DETAIL_FULL,
     ) -> None:
         if not self.metrics:
+            return
+        if detail == t.STATUS_DETAIL_SUMMARY:
+            # summary mode: per-node families would mint O(nodes)
+            # series per policy — the per-shard rollup (see
+            # _export_shard_metrics) is the bounded replacement.
+            # One retraction sweep on the mode flip, then nothing.
+            if self._fp_gate(policy_name, "probe", hash("summary")):
+                for gauge in PROBE_GAUGES:
+                    self.metrics.remove_matching(
+                        gauge, {"policy": policy_name}
+                    )
+            return
+        fp = hash(tuple(
+            (r.node, r.peers_total, r.peers_reachable,
+             tuple(r.unreachable), r.rtt_p50_ms, r.rtt_p99_ms,
+             r.loss_ratio, r.state)
+            for r in rows
+        ))
+        if not self._fp_gate(policy_name, "probe", fp):
             return
         # retract-then-set: a departed node's series must not linger as
         # a healthy phantom between passes
@@ -952,6 +1447,38 @@ class NetworkClusterPolicyReconciler:
                     "tpunet_probe_rtt_seconds", ms / 1e3,
                     {**labels, "quantile": quantile},
                 )
+
+    def _export_shard_metrics(
+        self, policy_name: str, summary: Optional[t.StatusSummary]
+    ) -> None:
+        """Per-shard fleet gauges — O(shards) series regardless of node
+        count; diff-gated like the per-node families."""
+        if not self.metrics or summary is None:
+            return
+        fp = hash(tuple(
+            (s.shard, s.nodes, s.ready, s.degraded, s.quarantined,
+             s.anomalous)
+            for s in summary.shards
+        ))
+        if not self._fp_gate(policy_name, "shard", fp):
+            return
+        for gauge in SHARD_GAUGES:
+            self.metrics.remove_matching(gauge, {"policy": policy_name})
+        for s in summary.shards:
+            labels = {"policy": policy_name, "shard": s.shard}
+            self.metrics.set_gauge("tpunet_shard_nodes", s.nodes, labels)
+            self.metrics.set_gauge(
+                "tpunet_shard_ready_nodes", s.ready, labels
+            )
+            self.metrics.set_gauge(
+                "tpunet_shard_degraded_nodes", s.degraded, labels
+            )
+            self.metrics.set_gauge(
+                "tpunet_shard_quarantined_nodes", s.quarantined, labels
+            )
+            self.metrics.set_gauge(
+                "tpunet_shard_anomalous_nodes", s.anomalous, labels
+            )
 
     def _emit_probe_transitions(
         self,
@@ -977,7 +1504,7 @@ class NetworkClusterPolicyReconciler:
             self._emit(
                 policy, obs_events.TYPE_WARNING, "DataplaneDegraded",
                 f"{len(degraded)}/{len(rows)} nodes below probe quorum: "
-                + ", ".join(sorted(degraded)),
+                + self._name_list(degraded),
             )
         elif not degraded and old_dp == "True":
             self._emit(
@@ -1095,9 +1622,25 @@ class NetworkClusterPolicyReconciler:
         ), rows
 
     def _export_telemetry_metrics(
-        self, policy_name: str, rows: List[Any]
+        self, policy_name: str, rows: List[Any],
+        detail: str = t.STATUS_DETAIL_FULL,
     ) -> None:
         if not self.metrics:
+            return
+        if detail == t.STATUS_DETAIL_SUMMARY:
+            # per-interface families are O(nodes x ifaces) series; in
+            # summary mode the shard rollup carries the fleet signal
+            if self._fp_gate(policy_name, "telemetry", hash("summary")):
+                for gauge in TELEMETRY_GAUGES:
+                    self.metrics.remove_matching(
+                        gauge, {"policy": policy_name}
+                    )
+            return
+        fp = hash(tuple(
+            (node, iface, tuple(sorted(vals.items())))
+            for node, iface, vals in rows
+        ))
+        if not self._fp_gate(policy_name, "telemetry", fp):
             return
         # retract-then-set, like the probe gauges: a departed node's
         # interface series must not linger as healthy phantoms
@@ -1139,7 +1682,7 @@ class NetworkClusterPolicyReconciler:
                 "DataplaneTelemetryDegraded",
                 f"{len(tstat.anomalous_nodes)}/{tstat.nodes_reporting} "
                 "nodes report interface counter anomalies: "
-                + ", ".join(tstat.anomalous_nodes),
+                + self._name_list(tstat.anomalous_nodes),
             )
         elif not tstat.anomalous_nodes and old == "True":
             self._emit(
@@ -1148,6 +1691,115 @@ class NetworkClusterPolicyReconciler:
                 "interface counters nominal on all "
                 f"{tstat.nodes_reporting} reporting nodes",
             )
+
+    # -- scale: bounded status + per-shard summary ----------------------------
+
+    # cap on status.summary.shards rows: fine-grained racks (10k nodes
+    # in 16-node racks = 625 racks) must not recreate the unbounded
+    # list the summary exists to replace; the busiest shards surface,
+    # the tail folds into one aggregate row
+    MAX_SUMMARY_SHARDS = 64
+
+    @staticmethod
+    def _name_list(names: List[str], cap: int = 10) -> str:
+        """Bounded human list for condition/Event messages — a 10k-node
+        outage must not write a megabyte message into the CR."""
+        names = sorted(names)
+        if len(names) <= cap:
+            return ", ".join(names)
+        return (
+            ", ".join(names[:cap])
+            + f" (+{len(names) - cap} more)"
+        )
+
+    def _build_summary(
+        self,
+        detail: str,
+        reports: List[Any],
+        probe_rows: Optional[List[t.NodeProbeStatus]],
+        anomalous_nodes: List[str],
+    ) -> t.StatusSummary:
+        """Fold the fleet into O(shards) rows keyed by rack/slice label
+        (hash buckets for unlabeled nodes).  This — not the per-node
+        lists — is the status surface that stays bounded at 10k nodes."""
+        nodes = sorted({str(r.node) for r in reports})
+        ok = {str(r.node) for r in reports if r.ok}
+        state_of = {
+            r.node: r.state for r in (probe_rows or [])
+        }
+        anom = set(anomalous_nodes)
+        # racks only fetched in summary mode (the scale path); full-mode
+        # small fleets stay zero-extra-request on hash buckets
+        racks = (
+            self._rack_map(wanted=nodes)
+            if detail == t.STATUS_DETAIL_SUMMARY else {}
+        )
+        n_buckets = topology.shard_count(len(nodes))
+        by_shard: Dict[str, t.ShardSummary] = {}
+        totals = t.StatusSummary(detail=detail, nodes_total=len(nodes))
+        for node in nodes:
+            key = self._shard_key_of(node, racks, n_buckets)
+            row = by_shard.get(key)
+            if row is None:
+                row = by_shard[key] = t.ShardSummary(shard=key)
+            row.nodes += 1
+            if node in ok:
+                row.ready += 1
+                totals.nodes_ready += 1
+            state = state_of.get(node, "")
+            if state == t.PROBE_STATE_QUARANTINED:
+                row.quarantined += 1
+                totals.nodes_quarantined += 1
+            elif state == t.PROBE_STATE_DEGRADED:
+                row.degraded += 1
+                totals.nodes_degraded += 1
+            if node in anom:
+                row.anomalous += 1
+                totals.nodes_anomalous += 1
+        shards = sorted(
+            by_shard.values(),
+            key=lambda s: (
+                -(s.quarantined + s.degraded + s.anomalous),
+                -(s.nodes - s.ready),
+                s.shard,
+            ),
+        )
+        if len(shards) > self.MAX_SUMMARY_SHARDS:
+            head = shards[:self.MAX_SUMMARY_SHARDS]
+            tail = shards[self.MAX_SUMMARY_SHARDS:]
+            folded = t.ShardSummary(
+                shard=f"(+{len(tail)} more shards)"
+            )
+            for s in tail:
+                folded.nodes += s.nodes
+                folded.ready += s.ready
+                folded.degraded += s.degraded
+                folded.quarantined += s.quarantined
+                folded.anomalous += s.anomalous
+            shards = head + [folded]
+        totals.shards = shards
+        return totals
+
+    @staticmethod
+    def _worst_probe_rows(
+        rows: List[t.NodeProbeStatus], k: int
+    ) -> List[t.NodeProbeStatus]:
+        """Worst-K triage slice of the connectivity matrix: quarantined
+        first, then degraded, then lossiest — deterministic under
+        churn (ties broken by node name)."""
+        import heapq
+
+        priority = {
+            t.PROBE_STATE_QUARANTINED: 0,
+            t.PROBE_STATE_DEGRADED: 1,
+        }
+        return heapq.nsmallest(
+            k, rows,
+            key=lambda r: (
+                priority.get(r.state, 2), -r.loss_ratio,
+                r.peers_reachable - r.peers_total, r.node,
+            ),
+        )
 
     def _emit_state_transition(
         self, policy: NetworkClusterPolicy, old_state: str, state: str,
@@ -1232,6 +1884,16 @@ class NetworkClusterPolicyReconciler:
             if not r.ok
         )
         ready = len(ok_nodes)
+        # detail mode for this pass: explicit spec.statusDetail, else
+        # auto — flip to the bounded summary once the live fleet
+        # crosses the threshold (the CR must stay small even when
+        # nobody set the knob)
+        detail = self._detail_mode(policy, max(targets, len(reports)))
+        if detail == t.STATUS_DETAIL_SUMMARY and len(errors) > t.STATUS_WORST_K:
+            errors = errors[:t.STATUS_WORST_K] + [
+                f"... and {len(errors) - t.STATUS_WORST_K} more nodes "
+                "not ready (statusDetail: summary)"
+            ]
 
         if targets == 0:
             state = STATE_NO_TARGETS
@@ -1249,6 +1911,7 @@ class NetworkClusterPolicyReconciler:
         old_conditions = am.to_dict(policy.status.conditions)
         old_telemetry = am.to_dict(policy.status.telemetry)
         old_versions = dict(policy.status.agent_versions)
+        old_summary = am.to_dict(policy.status.summary)
         # reaching a status pass IS a successful reconcile: clear any
         # ReconcileDegraded condition a past permanent failure parked
         # here (the conditions diff below flushes the change)
@@ -1265,12 +1928,19 @@ class NetworkClusterPolicyReconciler:
                 "reconcile succeeding again; ReconcileDegraded cleared",
             )
         probe_requeue = 0.0
+        rows: Optional[List[t.NodeProbeStatus]] = None
         if self._probe_enabled(policy):
             self._sync_probe_peers(policy, reports)
             rows, degraded, probe_requeue = self._aggregate_probe(
                 policy, reports
             )
-            policy.status.probe_nodes = rows
+            # bounded status: summary mode embeds only the worst-K
+            # triage rows — the full matrix would be O(n) (O(n²) with
+            # per-row unreachable lists) inside one etcd object
+            policy.status.probe_nodes = (
+                rows if detail == t.STATUS_DETAIL_FULL
+                else self._worst_probe_rows(rows, t.STATUS_WORST_K)
+            )
             quarantined = sorted(
                 r.node for r in rows
                 if r.state == t.PROBE_STATE_QUARANTINED
@@ -1278,10 +1948,10 @@ class NetworkClusterPolicyReconciler:
             if degraded:
                 message = (
                     f"{len(degraded)}/{len(rows)} nodes below probe "
-                    f"quorum: " + ", ".join(
+                    f"quorum: " + self._name_list([
                         n + (" (quarantined)" if n in quarantined else "")
-                        for n in sorted(degraded)
-                    )
+                        for n in degraded
+                    ])
                 )
                 self._set_condition(
                     policy.status, t.CONDITION_DATAPLANE_DEGRADED,
@@ -1295,7 +1965,9 @@ class NetworkClusterPolicyReconciler:
                     "False", "QuorumReached",
                     f"all {len(rows)} probed nodes reach quorum",
                 )
-            self._export_probe_metrics(policy.metadata.name, rows)
+            self._export_probe_metrics(
+                policy.metadata.name, rows, detail
+            )
             self._emit_probe_transitions(
                 policy, old_conditions, old_probe_status, rows, degraded
             )
@@ -1314,16 +1986,7 @@ class NetworkClusterPolicyReconciler:
                 for c in policy.status.conditions
             )
             if was_probing:
-                from ..agent import report as rpt_mod
-
-                try:
-                    self.client.delete(
-                        "v1", "ConfigMap",
-                        rpt_mod.peer_configmap_name(policy.metadata.name),
-                        self.namespace,
-                    )
-                except Exception as e:   # noqa: BLE001 — already gone is fine
-                    log.debug("peer ConfigMap delete: %s", e)
+                self._delete_peer_cms(policy.metadata.name)
                 self._prune_probe_state(policy.metadata.name)
             policy.status.probe_nodes = []
             policy.status.conditions = [
@@ -1333,6 +1996,7 @@ class NetworkClusterPolicyReconciler:
 
         # dataplane counter telemetry: fleet rollup + condition +
         # per-interface metric families from the report payloads
+        anomalous_nodes: List[str] = []
         if self._telemetry_enabled(policy):
             tstat, telem_rows = self._aggregate_telemetry(policy, reports)
             policy.status.telemetry = tstat
@@ -1351,7 +2015,7 @@ class NetworkClusterPolicyReconciler:
                     f"{len(tstat.anomalous_nodes)}/"
                     f"{tstat.nodes_reporting} nodes report interface "
                     "counter anomalies: "
-                    + ", ".join(tstat.anomalous_nodes),
+                    + self._name_list(tstat.anomalous_nodes),
                 )
             else:
                 self._set_condition(
@@ -1360,11 +2024,24 @@ class NetworkClusterPolicyReconciler:
                     "interface counters nominal on all "
                     f"{tstat.nodes_reporting} reporting nodes",
                 )
-            self._export_telemetry_metrics(policy.metadata.name, telem_rows)
+            self._export_telemetry_metrics(
+                policy.metadata.name, telem_rows, detail
+            )
             if tstat is not None:
                 self._emit_telemetry_transitions(
                     policy, old_conditions, tstat
                 )
+                anomalous_nodes = list(tstat.anomalous_nodes)
+                if (
+                    detail == t.STATUS_DETAIL_SUMMARY
+                    and len(tstat.anomalous_nodes) > t.STATUS_WORST_K
+                ):
+                    # the summary rollup carries the true counts; the
+                    # embedded list stays a bounded triage slice
+                    tstat.anomalous_nodes = (
+                        tstat.anomalous_nodes[:t.STATUS_WORST_K]
+                        + [f"(+{len(tstat.anomalous_nodes) - t.STATUS_WORST_K} more)"]
+                    )
         else:
             # telemetry switched off: same one-time cleanup contract as
             # the probe path — stale rollups/conditions/series must not
@@ -1394,6 +2071,19 @@ class NetworkClusterPolicyReconciler:
                 versions[ver] = versions.get(ver, 0) + 1
         policy.status.agent_versions = dict(sorted(versions.items()))
 
+        # per-shard fleet rollup — the O(shards) surface the bounded
+        # lists point at; always computed for tpu-so policies (cheap at
+        # small n, load-bearing in summary mode)
+        if policy.spec.configuration_type == t.CONFIG_TYPE_TPU_SO:
+            policy.status.summary = self._build_summary(
+                detail, reports, rows, anomalous_nodes
+            )
+            self._export_shard_metrics(
+                policy.metadata.name, policy.status.summary
+            )
+        else:
+            policy.status.summary = None
+
         if self.metrics:
             labels = {"policy": policy.metadata.name}
             values = {
@@ -1415,6 +2105,7 @@ class NetworkClusterPolicyReconciler:
             or am.to_dict(policy.status.conditions) != old_conditions
             or am.to_dict(policy.status.telemetry) != old_telemetry
             or policy.status.agent_versions != old_versions
+            or am.to_dict(policy.status.summary) != old_summary
         )
         policy.status.targets = targets
         policy.status.ready_nodes = ready
@@ -1423,6 +2114,18 @@ class NetworkClusterPolicyReconciler:
         self._emit_state_transition(policy, old_state, state, errors)
 
         if updated:
+            if self.metrics:
+                # CR status footprint visibility: the number the
+                # 256 KiB-at-10k-nodes budget is judged against
+                import json as json_mod
+
+                self.metrics.set_gauge(
+                    "tpunet_status_bytes",
+                    float(len(json_mod.dumps(
+                        am.to_dict(policy.status)
+                    ))),
+                    {"policy": policy.metadata.name},
+                )
             try:
                 self.client.update_status(policy.to_dict())
             except kerr.ConflictError:
@@ -1448,6 +2151,8 @@ class NetworkClusterPolicyReconciler:
             if self.metrics:
                 for gauge in POLICY_GAUGES:
                     self.metrics.remove_gauge(gauge, {"policy": name})
+                for gauge in ("tpunet_status_bytes",):
+                    self.metrics.remove_gauge(gauge, {"policy": name})
                 for gauge in TELEMETRY_GAUGES:
                     self.metrics.remove_matching(gauge, {"policy": name})
             self._prune_probe_state(name)
@@ -1459,6 +2164,8 @@ class NetworkClusterPolicyReconciler:
             "DaemonSet",
             namespace=self.namespace,
             field_index={OWNER_KEY: name},
+            # chunked like every other wire list in the control plane
+            limit=LIST_PAGE_SIZE,
         )
         if not owned:
             return self._create_daemonset(policy)

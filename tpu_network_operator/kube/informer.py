@@ -134,7 +134,12 @@ class Store:
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
         field_index: Optional[Dict[str, str]] = None,
+        copy_objects: bool = True,
     ) -> List[Dict[str, Any]]:
+        """``copy_objects=False`` returns the STORED objects themselves
+        (client-go's actual informer-lister contract: shared, read-only)
+        — a 10k-object fleet list then costs zero deep copies.  Callers
+        of the shared form MUST NOT mutate the results."""
         with self._lock:
             if field_index:
                 keys: Optional[Set[Key]] = None
@@ -158,7 +163,7 @@ class Store:
                     meta.get("labels", {}) or {}, label_selector
                 ):
                     continue
-                out.append(copy.deepcopy(obj))
+                out.append(copy.deepcopy(obj) if copy_objects else obj)
             return out
 
 
@@ -595,6 +600,33 @@ class CachedClient:
         return inf.store.list(
             namespace=namespace, label_selector=label_selector,
             field_index=field_index,
+        )
+
+    def list_readonly(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        field_index: Optional[Dict[str, str]] = None,
+        limit: int = 0,
+    ) -> List[Dict[str, Any]]:
+        """Cache list WITHOUT per-object deep copies (client-go's real
+        lister contract: results are shared with the store and must not
+        be mutated).  The reconciler's fleet-sized read paths (10k
+        report Leases per rollup) use this; anything un-cached falls
+        through to a normal (owned-objects) list."""
+        inf = self._serving(api_version, kind, namespace)
+        if inf is None:
+            return self.inner.list(
+                api_version, kind, namespace=namespace,
+                label_selector=label_selector, field_index=field_index,
+                limit=limit,
+            )
+        inf.sync()
+        return inf.store.list(
+            namespace=namespace, label_selector=label_selector,
+            field_index=field_index, copy_objects=False,
         )
 
     # -- writes + everything else: pass through --------------------------------

@@ -31,3 +31,4 @@ from .prober import (  # noqa: F401
     Responder,
 )
 from .runner import ProbeRunner  # noqa: F401
+from . import topology  # noqa: F401

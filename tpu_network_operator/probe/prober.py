@@ -148,13 +148,24 @@ class PeerWindow:
         return [r for r in self.outcomes if r is not None]
 
 
-def required_peers(quorum: int, expected_peers: int, peers_total: int) -> int:
+def required_peers(
+    quorum: int, expected_peers: int, peers_total: int, degree: int = 0
+) -> int:
     """THE quorum rule, shared by the agent's :class:`ReadinessGate` and
     the controller's status aggregation so their verdicts cannot drift:
     the base is the live peer count unless ``expected_peers`` pins it
     (a silently shrunken mesh must not lower the bar); ``quorum=0``
-    demands the whole base, a positive quorum is clamped to it."""
+    demands the whole base, a positive quorum is clamped to it.
+
+    ``degree`` is the sampled-topology cap (probe.degree on the CR): a
+    node probes at most ``degree`` assigned peers, so no verdict may
+    demand more than ``degree`` reachable — without the cap, an
+    ``expected_peers`` pinned at fleet size (its pre-sampling meaning)
+    would mark every sampled node permanently below quorum.  0 = full
+    mesh, no cap (the pre-sampling behavior, unchanged)."""
     base = (expected_peers if expected_peers > 0 else peers_total)
+    if degree > 0:
+        base = min(base, degree)
     if quorum <= 0:
         return base
     return min(quorum, base)
@@ -288,9 +299,13 @@ class ReadinessGate:
                  fail_threshold: int = DEFAULT_FAIL_THRESHOLD,
                  recovery_threshold: int = DEFAULT_RECOVERY_THRESHOLD,
                  backoff_factor: float = 2.0, backoff_max: float = 8.0,
-                 expected_peers: int = 0):
+                 expected_peers: int = 0, degree: int = 0):
         self.quorum = max(quorum, 0)
         self.expected_peers = max(expected_peers, 0)
+        # sampled-topology out-degree (0 = full mesh): caps the quorum
+        # base, see required_peers — a node assigned k peers must never
+        # be asked to reach more than k
+        self.degree = max(degree, 0)
         self.fail_threshold = max(fail_threshold, 1)
         self.recovery_threshold = max(recovery_threshold, 1)
         self.backoff_factor = backoff_factor
@@ -301,7 +316,9 @@ class ReadinessGate:
         self.transitions = 0
 
     def required(self, peers_total: int) -> int:
-        return required_peers(self.quorum, self.expected_peers, peers_total)
+        return required_peers(
+            self.quorum, self.expected_peers, peers_total, self.degree
+        )
 
     def observe(self, snap: ProbeSnapshot) -> bool:
         """Fold one round in; returns True when readiness flipped."""

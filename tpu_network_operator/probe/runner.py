@@ -55,6 +55,7 @@ class ProbeRunner:
         fail_threshold: int = DEFAULT_FAIL_THRESHOLD,
         recovery_threshold: int = DEFAULT_RECOVERY_THRESHOLD,
         probe_timeout: float = DEFAULT_PROBE_TIMEOUT,
+        degree: int = 0,
     ):
         self.node = node
         self.interval = max(interval, 0.1)
@@ -81,6 +82,7 @@ class ProbeRunner:
             expected_peers=expected_peers,
             fail_threshold=fail_threshold,
             recovery_threshold=recovery_threshold,
+            degree=degree,
         )
         self.last_snapshot: Optional[ProbeSnapshot] = None
         # whether the supplier has EVER returned a peer list — the gate

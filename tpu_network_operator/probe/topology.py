@@ -1,0 +1,337 @@
+"""Sampled probe topology + shard math — the scale contract.
+
+Full-mesh probing is O(n²) datagrams per round and a single
+``tpunet-peers-<policy>`` ConfigMap is O(n) bytes fanned out to every
+agent; both die well before production fleet sizes.  This module holds
+the ONE copy of the replacement contract, imported by BOTH sides:
+
+* the reconciler computes a deterministic, seeded, k-regular,
+  rack-aware peer assignment (:func:`assign_peers`) and distributes it
+  sharded into ``tpunet-peers-<policy>-<shard>`` ConfigMaps
+  (:func:`shard_count`/:func:`shard_of`/:func:`peer_shard_payloads`);
+* the agent locates its own shard with the same :func:`shard_of` and
+  reads only its own assignment row — membership AND topology ride one
+  channel, so controller and agents can never disagree on either.
+
+Determinism matters twice: the same seed + node set must produce the
+same assignment across reconciler restarts (otherwise every leader
+failover rolls the whole mesh and resets every peer window), and across
+controller replicas (a deposed leader's last distribution stays valid).
+Everything here is pure and seeded — no RNG state, no wall clock.
+
+Rack-awareness: the ring underlying the assignment interleaves racks,
+so a node's probe targets naturally span racks, and a post-pass
+guarantees at least one cross-rack edge per node whenever more than one
+rack exists — a whole-rack partition is always observable from outside
+the rack ("Throughput-Optimized Networks at Scale": rack/slice-aware
+aggregation, PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+from typing import Dict, List, Mapping, Optional, Tuple
+
+# default sampled out-degree (k): each node probes ~k peers per round,
+# so a fleet costs O(k·n) datagrams per round instead of O(n²).  k=8
+# keeps partition detection sharp (a partitioned node loses all k of
+# its targets within one round) while a 10k-node fleet sends 80k
+# datagrams per interval instead of 100M.
+DEFAULT_DEGREE = 8
+
+# sampling only makes sense past this mesh size: with n <= degree + 1
+# the "sample" would be the full mesh anyway, so full mesh it is
+# (identical behavior AND identical payload schema to the pre-sampling
+# contract, which keeps small fleets and old agents working unchanged)
+def sampling_active(n_nodes: int, degree: int) -> bool:
+    return degree > 0 and n_nodes > degree + 1
+
+
+# nodes per peer-shard ConfigMap.  One assignment row is roughly
+# k x (node name + "host:port") ~ 300-400 bytes at k=8; 256 rows keeps
+# a shard around 100 KiB — an order of magnitude under the 1 MiB etcd
+# object limit even with hostile-length node names, before the byte
+# budget below kicks in as the hard guard.
+SHARD_TARGET_NODES = 256
+
+# hard byte budget per shard payload: refuse to apply anything larger
+# (split further instead).  Half the 1 MiB etcd limit leaves headroom
+# for metadata, managedFields and the JSON envelope.
+DEFAULT_SHARD_BYTE_BUDGET = 512 * 1024
+
+# absolute shard-count ceiling — a runaway split (pathological node
+# names) must not mint unbounded ConfigMaps
+MAX_SHARDS = 4096
+
+# node labels consulted for the rack/slice shard key, most specific
+# first.  ``tpunet.dev/rack`` is this operator's own override;
+# the GKE TPU labels group nodes of one ICI slice; the kube topology
+# zone is the generic fallback.  Nodes with none of these fall back to
+# hash buckets (shard key "", bucketed by :func:`shard_of`).
+RACK_LABELS = (
+    "tpunet.dev/rack",
+    "cloud.google.com/gke-tpu-topology",
+    "topology.gke.io/tpu-slice",
+    "topology.kubernetes.io/zone",
+)
+
+
+def stable_hash(s: str) -> int:
+    """Deterministic 64-bit hash (sha1-based).  NOT ``hash()``:
+    PYTHONHASHSEED randomizes str hashing per process, and the whole
+    point is agreement across reconciler restarts and agent processes."""
+    return int.from_bytes(
+        hashlib.sha1(s.encode("utf-8", "surrogatepass")).digest()[:8], "big"
+    )
+
+
+def rack_of(labels: Optional[Mapping[str, str]]) -> str:
+    """The node's rack/slice shard key from its topology labels
+    ("" = unknown; hash buckets take over)."""
+    if not labels:
+        return ""
+    for key in RACK_LABELS:
+        val = labels.get(key)
+        if isinstance(val, str) and val:
+            return val
+    return ""
+
+
+def shard_count(n_nodes: int, target: Optional[int] = None) -> int:
+    """How many peer-shard ConfigMaps a mesh of ``n_nodes`` needs.
+    ``target`` resolves against the module constant at CALL time (not
+    def time) so tests can shrink SHARD_TARGET_NODES."""
+    if target is None:
+        target = SHARD_TARGET_NODES
+    if n_nodes <= 0:
+        return 1
+    return min(MAX_SHARDS, max(1, -(-n_nodes // max(target, 1))))
+
+
+def shard_of(node: str, n_shards: int) -> int:
+    """Which shard a node's assignment row lives in.  Pure function of
+    (node name, shard count) — the agent computes this locally from the
+    shard count published in the index ConfigMap."""
+    if n_shards <= 1:
+        return 0
+    return stable_hash(node) % n_shards
+
+
+def _ring(nodes: List[str], racks: Mapping[str, str], seed: str) -> List[str]:
+    """Deterministic rack-interleaved ring: racks round-robin so
+    consecutive ring positions land in different racks wherever the
+    rack sizes allow; within a rack, nodes are ordered by seeded hash
+    (a deterministic shuffle — lexicographic order would make ring
+    neighbors correlate with naming, i.e. usually with racks)."""
+    by_rack: Dict[str, List[str]] = {}
+    for node in nodes:
+        by_rack.setdefault(racks.get(node, ""), []).append(node)
+    for members in by_rack.values():
+        members.sort(key=lambda n: (stable_hash(seed + "|" + n), n))
+    order = sorted(by_rack, key=lambda r: (stable_hash(seed + "#" + r), r))
+    ring: List[str] = []
+    # deque, not list.pop(0): the hash-bucket fallback puts a whole
+    # unlabeled fleet in ONE rack queue, and this runs every reconcile
+    # pass — front-popping a list would be O(n²) element shifts there
+    queues = [collections.deque(by_rack[r]) for r in order]
+    while queues:
+        for q in queues:
+            ring.append(q.popleft())
+        queues = [q for q in queues if q]
+    return ring
+
+
+def assign_peers(
+    endpoints: Mapping[str, str],
+    degree: int,
+    seed: str,
+    racks: Optional[Mapping[str, str]] = None,
+) -> Dict[str, Dict[str, str]]:
+    """The peer assignment: ``{node: {peer: endpoint}}``.
+
+    * ``degree <= 0`` or a mesh no bigger than ``degree + 1``: full
+      mesh (every node probes every other) — today's behavior.
+    * otherwise: each node probes its ``degree`` successors on the
+      rack-interleaved ring, giving a connected k-out-regular digraph
+      (the step-1 edge closes a Hamiltonian cycle) with in-degree k
+      when rack sizes allow interleaving — every node is watched by ~k
+      probers, so a partitioned node is seen missing by k peers, not
+      n.  When more than one rack exists, a node whose successors all
+      landed in its own rack swaps its last pick for a cross-rack node
+      (rotated round-robin across the whole cross-rack population so
+      heavy rack skew spreads, not concentrates, the extra in-probes),
+      guaranteeing every node at least one cross-rack edge; in-degree
+      then stays k ± the unavoidable skew share.
+    """
+    nodes = sorted(endpoints)
+    racks = racks or {}
+    if not sampling_active(len(nodes), degree):
+        return {
+            node: {p: endpoints[p] for p in nodes if p != node}
+            for node in nodes
+        }
+    ring = _ring(nodes, racks, seed)
+    n = len(ring)
+    pos = {node: i for i, node in enumerate(ring)}
+    multi_rack = len({racks.get(nd, "") for nd in nodes}) > 1
+    out: Dict[str, Dict[str, str]] = {}
+    # cross-rack swap targets rotate round-robin over ALL nodes outside
+    # the swapping node's rack (seeded start), NOT "the nearest
+    # cross-rack node on the ring": under skewed rack sizes every node
+    # in a long same-rack run would otherwise swap to the SAME nearest
+    # target, concentrating O(run) extra in-probes on one node — the
+    # hot spot sampling exists to prevent.  Rotation spreads the extra
+    # in-degree evenly (within 1) across the cross-rack population.
+    cross_of_rack: Dict[str, List[str]] = {}
+    swap_idx: Dict[str, int] = {}
+    for node in nodes:
+        i = pos[node]
+        picks = [ring[(i + step) % n] for step in range(1, degree + 1)]
+        if multi_rack and all(
+            racks.get(p, "") == racks.get(node, "") for p in picks
+        ):
+            rack = racks.get(node, "")
+            cands = cross_of_rack.get(rack)
+            if cands is None:
+                cands = cross_of_rack[rack] = [
+                    nd for nd in ring if racks.get(nd, "") != rack
+                ]
+                swap_idx[rack] = stable_hash(seed + "^" + rack) \
+                    % len(cands)
+            j = swap_idx[rack]
+            picks[-1] = cands[j % len(cands)]
+            swap_idx[rack] = j + 1
+        out[node] = {p: endpoints[p] for p in picks}
+    return out
+
+
+# -- ConfigMap payload schema -------------------------------------------------
+#
+# Index ConfigMap `tpunet-peers-<policy>` data keys:
+#   meta        JSON {"shards": N, "degree": k, "nodes": n}  (always)
+#   peers       JSON {node: endpoint}         (full mesh, single shard
+#                                              — the pre-sampling
+#                                              schema, kept for agent
+#                                              version skew)
+#   assignments JSON {node: {peer: endpoint}} (sampled, single shard)
+# Shard ConfigMaps `tpunet-peers-<policy>-<i>` (only when N > 1):
+#   assignments JSON — rows for the nodes with shard_of(node, N) == i
+#               (sampled: degree > 0 in meta; an agent reads ONLY its
+#               own shard)
+#   peers       JSON — flat endpoint rows bucketed the same way
+#               (full mesh: degree == 0 in meta with N > 1 — a flat
+#               map too big for one object is sharded as-is, O(n)
+#               total bytes, NEVER expanded into per-node full-mesh
+#               rows, which would be O(n²); every agent merges all N
+#               shards since full mesh means probing everyone)
+
+META_KEY = "meta"
+PEERS_KEY = "peers"
+ASSIGNMENTS_KEY = "assignments"
+
+
+def index_meta(n_shards: int, degree: int, n_nodes: int) -> str:
+    return json.dumps(
+        {"shards": n_shards, "degree": degree, "nodes": n_nodes},
+        sort_keys=True,
+    )
+
+
+def parse_meta(raw: str) -> Tuple[int, int]:
+    """``(shards, degree)`` from an index ConfigMap's meta payload;
+    (1, 0) on anything unparseable (treat as the legacy single-CM
+    full-mesh layout rather than failing the fetch)."""
+    try:
+        d = json.loads(raw)
+        shards = int(d.get("shards", 1))
+        degree = int(d.get("degree", 0))
+        return (max(shards, 1), max(degree, 0))
+    except Exception:   # noqa: BLE001 — schema skew degrades to legacy
+        return (1, 0)
+
+
+def peer_shard_payloads(
+    assignments: Mapping[str, Mapping[str, str]],
+    n_shards: int,
+) -> List[str]:
+    """Serialize the assignment into ``n_shards`` payloads (JSON, one
+    per shard, ``assignments`` schema), node rows bucketed by
+    :func:`shard_of`.  Shards can be empty (valid — the agent finds no
+    row and keeps its last known mesh until the controller sees its
+    report)."""
+    buckets: List[Dict[str, Dict[str, str]]] = [
+        {} for _ in range(max(n_shards, 1))
+    ]
+    for node, row in assignments.items():
+        buckets[shard_of(node, n_shards)][node] = dict(row)
+    return [json.dumps(b, sort_keys=True) for b in buckets]
+
+
+def flat_shard_payloads(
+    endpoints: Mapping[str, str],
+    n_shards: int,
+) -> List[str]:
+    """Serialize a full-mesh flat endpoint map into ``n_shards``
+    payloads (JSON, ``peers`` schema), rows bucketed by
+    :func:`shard_of` — the same bucketing as the sampled layout, so
+    one shard-count rule covers both."""
+    buckets: List[Dict[str, str]] = [{} for _ in range(max(n_shards, 1))]
+    for node, ep in endpoints.items():
+        buckets[shard_of(node, n_shards)][node] = ep
+    return [json.dumps(b, sort_keys=True) for b in buckets]
+
+
+def _fit_by_doubling(make, byte_budget: int, start_shards: int):
+    """Shared budget-split loop: the smallest shard count (doubling
+    from ``start_shards``) whose largest ``make(n)`` payload fits the
+    byte budget; ``(n_shards, payloads, overflowed)``."""
+    n = max(start_shards, 1)
+    payloads = make(n)
+    overflowed = False
+    while (
+        any(len(p.encode()) > byte_budget for p in payloads)
+        and n < MAX_SHARDS
+    ):
+        overflowed = True
+        n = min(n * 2, MAX_SHARDS)
+        payloads = make(n)
+    if any(len(p.encode()) > byte_budget for p in payloads):
+        overflowed = True
+    return n, payloads, overflowed
+
+
+def split_for_budget(
+    assignments: Mapping[str, Mapping[str, str]],
+    byte_budget: int,
+    start_shards: int,
+) -> Tuple[int, List[str], bool]:
+    """``(n_shards, payloads, overflowed)``: the smallest shard count
+    (doubling from ``start_shards``) whose largest payload fits the
+    byte budget.  ``overflowed`` reports that splitting past the
+    initial count was needed (the caller emits the PeerShardOverflow
+    Event) — and if even MAX_SHARDS cannot fit the budget (hostile
+    node/endpoint lengths), the oversized payloads are returned anyway
+    with ``overflowed`` set; the caller refuses to apply those shards
+    rather than silently truncating."""
+    return _fit_by_doubling(
+        lambda n: peer_shard_payloads(assignments, n),
+        byte_budget, start_shards,
+    )
+
+
+def split_flat_for_budget(
+    endpoints: Mapping[str, str],
+    byte_budget: int,
+) -> Tuple[int, List[str], bool]:
+    """:func:`split_for_budget` for the full-mesh flat map: the whole
+    membership is O(n) bytes and stays O(n) — sharding it only bounds
+    the per-object size (each agent still merges every shard; full
+    mesh means probing everyone).  Called when the single-object flat
+    payload is already over budget, so the result is always > 1 shard
+    (or ``overflowed`` at MAX_SHARDS)."""
+    return _fit_by_doubling(
+        lambda n: flat_shard_payloads(endpoints, n),
+        byte_budget, 1,
+    )
