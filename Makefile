@@ -53,6 +53,11 @@ scale-bench: ## Thousands-of-nodes control-plane proof: marked tests + the 100/2
 	$(PYTHON) -m pytest tests/ -x -q -m "scale and not slow"
 	$(PYTHON) tools/scale_bench.py --out BENCH_scale.json
 
+.PHONY: planner-bench
+planner-bench: ## Topology-planner proof: marked tests + the planned-vs-naive ring bench
+	$(PYTHON) -m pytest tests/ -x -q -m "planner and not slow"
+	$(PYTHON) tools/planner_bench.py --out BENCH_planner.json
+
 .PHONY: test-cluster
 test-cluster: ## kind-cluster e2e + live fuzz (needs kind/docker/kubectl; skips cleanly without — ref test/e2e + test/fuzz)
 	$(PYTHON) -m pytest tests/cluster -x -q

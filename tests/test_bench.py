@@ -451,6 +451,69 @@ class TestCpuFallback:
             bench.cpu_fallback_reexec(RuntimeError("tunnel down"))
 
 
+@pytest.mark.planner
+class TestPlannerBench:
+    def test_artifact_schema_and_invariants(self, tmp_path):
+        """The topology-planner bench (tools/planner_bench.py,
+        perf_session phase 14) at toy scale: BENCH-style JSON artifact
+        whose numbers carry the acceptance criteria — planned ring
+        ≥ 20% better than naive name-order on modeled all-reduce
+        latency, degraded link excluded within one reconcile, zero
+        label churn across jitter-only rounds."""
+        out = tmp_path / "BENCH_planner.json"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "planner_bench.py"),
+             "--nodes-list", "20,40", "--out", str(out)],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert row == json.loads(out.read_text())
+        # the driver's contract keys
+        assert set(row) >= {"metric", "value", "unit", "vs_baseline"}
+        assert row["unit"] == "percent"
+        assert row["ok"] is True and row["failures"] == []
+        # acceptance: every sweep beats naive by >= 20% (value is the
+        # worst sweep) and the ratio reflects the win
+        assert row["value"] >= row["improvement_budget_pct"] == 20.0
+        assert row["vs_baseline"] < 0.8
+        for q in row["quality"]:
+            assert q["improvement_pct"] >= 20.0
+            assert q["deterministic"] is True
+            assert q["planned_allreduce_ms"] < q["naive_allreduce_ms"]
+        s = row["scenarios"]
+        # degraded link planned around within ONE reconcile of the
+        # gate flip, label stripped, and re-admission on recovery
+        assert s["degraded_excluded_in_passes"] == 1
+        assert s["victim_label_stripped"] is True
+        assert s["victim_readmitted"] is True
+        # hysteresis: 10 jitter-only rounds, zero churn anywhere
+        assert s["jitter_rounds"] == 10
+        assert s["jitter_plan_versions"] == 1
+        assert s["jitter_node_label_writes"] == 0
+        assert s["jitter_plan_cm_writes"] == 0
+        assert s["ring_nodes_labeled"] == s["nodes"]
+
+    def test_deterministic_across_runs(self, tmp_path):
+        """Same seed → identical plan + identical artifact (the seeded
+        heuristic's whole point: restart/failover stability)."""
+        runs = []
+        for _ in range(2):
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                              "planner_bench.py"),
+                 "--nodes-list", "16", "--seed", "77"],
+                capture_output=True, text=True, timeout=300,
+            )
+            assert proc.returncode == 0, proc.stderr[-800:]
+            row = json.loads(proc.stdout.strip().splitlines()[-1])
+            for q in row["quality"]:
+                q.pop("plan_seconds")
+            runs.append(row)
+        assert runs[0] == runs[1]
+
+
 @pytest.mark.scale
 class TestScaleBench:
     def test_sweep_artifact_schema_and_invariants(self, tmp_path):

@@ -1,0 +1,1182 @@
+"""Topology planner (planner/): ring heuristic, hysteresis, label
+gating, plan distribution, bootstrap adoption, and the JAX mesh/
+collective consumption end of the contract."""
+
+import json
+
+import pytest
+
+from tpu_network_operator.agent import report as rpt
+from tpu_network_operator.api.v1alpha1 import (
+    NetworkClusterPolicy,
+    default_policy,
+    types as t,
+    webhook,
+)
+from tpu_network_operator.api.v1alpha1.types import API_VERSION
+from tpu_network_operator.controller.health import Metrics
+from tpu_network_operator.controller.reconciler import (
+    NetworkClusterPolicyReconciler,
+    update_tpu_scale_out_daemonset,
+)
+from tpu_network_operator.controller import templates
+from tpu_network_operator.kube.fake import FakeCluster
+from tpu_network_operator.planner import PlanTracker
+from tpu_network_operator.planner import plan as pp
+from tpu_network_operator.planner.tracker import significant_rtt_drift
+
+pytestmark = pytest.mark.planner
+
+NAMESPACE = "tpunet-system"
+POLICY = "plan-pol"
+
+
+# -- fixtures -----------------------------------------------------------------
+
+
+def structured_inputs(n=12, racks_n=3, intra=0.2, inter=2.0, jitter=0.0,
+                      seed=7, excluded=(), spread=1.0):
+    """Rack-structured symmetric matrix with racks INTERLEAVED against
+    the name order (i % racks_n), the naive ring's worst case."""
+    import random
+
+    rng = random.Random(seed)
+    nodes = [f"n{i:03d}" for i in range(n)]
+    racks = {node: f"rack-{i % racks_n}" for i, node in enumerate(nodes)}
+    obs = {}
+    for a in nodes:
+        row = {}
+        for b in nodes:
+            if a == b:
+                continue
+            base = intra if racks[a] == racks[b] else inter
+            row[b] = base + (jitter * rng.random() if jitter else 0.0)
+        obs[a] = row
+    return pp.PlanInputs(
+        nodes=nodes, rtt=pp.build_matrix(obs), groups=racks,
+        excluded=frozenset(excluded), seed=POLICY,
+        spread_threshold_ms=spread,
+    )
+
+
+# -- plan.py core -------------------------------------------------------------
+
+
+class TestMatrix:
+    def test_build_matrix_averages_directions(self):
+        m = pp.build_matrix({"a": {"b": 1.0}, "b": {"a": 3.0}})
+        assert m[("a", "b")] == 2.0
+
+    def test_build_matrix_rejects_garbage(self):
+        # 0.0 and None are "no samples", not measurements: admitting
+        # either would hand the heuristic a free edge
+        m = pp.build_matrix({
+            "a": {"b": "fast", "c": True, "d": -1.0, "a": 5.0, "e": 2.0,
+                  "f": 0.0, "g": None},
+        })
+        assert m == {("a", "e"): 2.0}
+
+    def test_edge_rtt_default_for_unmeasured(self):
+        assert pp.edge_rtt({}, "a", "b") == pp.DEFAULT_RTT_MS
+
+
+class TestRing:
+    def test_planned_beats_naive_on_structured_matrix(self):
+        inputs = structured_inputs(n=18, racks_n=3)
+        plan = pp.compute_plan(inputs)
+        naive = sorted(inputs.nodes)
+        assert (
+            pp.modeled_allreduce_ms(plan.ring, inputs.rtt)
+            < 0.5 * pp.modeled_allreduce_ms(naive, inputs.rtt)
+        )
+
+    def test_ring_covers_eligible_nodes_exactly_once(self):
+        inputs = structured_inputs(n=12, excluded=("n003",))
+        plan = pp.compute_plan(inputs)
+        assert sorted(plan.ring) == [
+            n for n in inputs.nodes if n != "n003"
+        ]
+        assert plan.excluded == ["n003"]
+
+    def test_groups_stay_contiguous_on_the_ring(self):
+        inputs = structured_inputs(n=12, racks_n=3)
+        plan = pp.compute_plan(inputs)
+        # walking the ring, each rack appears as ONE contiguous run
+        # (low-RTT nodes adjacent — the planning objective)
+        seen_runs = []
+        for node in plan.ring:
+            rack = inputs.groups[node]
+            if not seen_runs or seen_runs[-1] != rack:
+                seen_runs.append(rack)
+        assert len(seen_runs) == 3
+
+    def test_deterministic_and_restart_stable(self):
+        a = pp.compute_plan(structured_inputs())
+        b = pp.compute_plan(structured_inputs())
+        assert a.ring == b.ring and a.version == b.version
+
+    def test_two_opt_improves_a_bad_ring(self):
+        # a square: good edges (a-b, c-d, a-c, b-d), bad diagonals; the
+        # identity order a,b,c,d wires b-c and the d-a wrap (one bad
+        # diagonal pair); 2-opt must find an optimal traversal
+        rtt = {
+            ("a", "b"): 1.0, ("c", "d"): 1.0,
+            ("a", "c"): 1.0, ("b", "d"): 1.0,
+            ("a", "d"): 10.0, ("b", "c"): 10.0,
+        }
+        ring = pp._two_opt(["a", "b", "c", "d"], rtt)
+        assert pp.ring_cost_ms(ring, rtt) == 4.0
+
+    def test_version_ignores_rtt_jitter(self):
+        a = pp.compute_plan(structured_inputs(jitter=0.0))
+        b = pp.compute_plan(structured_inputs(jitter=0.05))
+        # tiny jitter may not reorder anything: same decisions -> same
+        # version even though the raw matrices differ
+        if a.ring == b.ring:
+            assert a.version == b.version
+
+
+class TestCollectiveHint:
+    def test_hierarchical_when_spread_wide(self):
+        plan = pp.compute_plan(
+            structured_inputs(intra=0.2, inter=3.0, spread=1.0)
+        )
+        assert plan.collective == pp.COLLECTIVE_HIERARCHICAL
+        assert plan.inter_group_rtt_ms > plan.intra_group_rtt_ms
+
+    def test_ring_when_spread_narrow(self):
+        plan = pp.compute_plan(
+            structured_inputs(intra=0.2, inter=0.5, spread=1.0)
+        )
+        assert plan.collective == pp.COLLECTIVE_RING
+
+    def test_ring_when_intra_unmeasured(self):
+        # sampled probing can leave ZERO same-group measurements; the
+        # empty intra median reads 0.0 and must not manufacture the
+        # whole inter_ms as "spread" — no intra evidence, no
+        # hierarchical hint
+        nodes = ["a0", "a1", "b0", "b1"]
+        groups = {"a0": "g-a", "a1": "g-a", "b0": "g-b", "b1": "g-b"}
+        obs = {
+            "a0": {"b0": 2.5, "b1": 2.5},
+            "a1": {"b0": 2.5, "b1": 2.5},
+        }
+        plan = pp.compute_plan(pp.PlanInputs(
+            nodes=nodes, rtt=pp.build_matrix(obs), groups=groups,
+            excluded=frozenset(), seed=POLICY,
+            spread_threshold_ms=2.0,
+        ))
+        assert plan.collective == pp.COLLECTIVE_RING
+        assert plan.intra_group_rtt_ms == 0.0
+
+    def test_ring_for_single_group(self):
+        inputs = structured_inputs(racks_n=1, intra=0.2, inter=0.2)
+        assert pp.compute_plan(inputs).collective == pp.COLLECTIVE_RING
+
+
+class TestAxisOrderHint:
+    def test_multi_group_keeps_data_outermost(self):
+        plan = pp.compute_plan(structured_inputs(racks_n=3))
+        assert plan.mesh_axis_order == list(pp.MESH_AXES)
+        assert plan.mesh_axis_order[0] == "data"
+
+    def test_single_group_promotes_fsdp(self):
+        # a flat single-group DCN has no slow tier: the plan gives the
+        # process-major slot to the dominant fsdp traffic instead
+        plan = pp.compute_plan(
+            structured_inputs(racks_n=1, intra=0.2, inter=0.2)
+        )
+        assert plan.mesh_axis_order[:2] == ["fsdp", "data"]
+        assert sorted(plan.mesh_axis_order) == sorted(pp.MESH_AXES)
+
+    def test_order_feeds_the_version_fingerprint(self):
+        multi = pp.compute_plan(structured_inputs(racks_n=3))
+        single = pp.compute_plan(
+            structured_inputs(racks_n=1, intra=0.2, inter=0.2)
+        )
+        assert multi.mesh_axis_order != single.mesh_axis_order
+
+
+class TestPayload:
+    def test_round_trip(self):
+        plan = pp.compute_plan(structured_inputs())
+        back = pp.TopologyPlan.from_payload(
+            json.loads(json.dumps(plan.to_payload()))
+        )
+        assert back.ring == plan.ring
+        assert back.version == plan.version
+        assert back.collective == plan.collective
+        assert back.mesh_axis_order == list(pp.MESH_AXES)
+
+    def test_from_payload_rejects_broken_ring(self):
+        with pytest.raises(ValueError):
+            pp.TopologyPlan.from_payload({"ring": "not-a-list"})
+        with pytest.raises(ValueError):
+            pp.TopologyPlan.from_payload([1, 2])
+
+    def test_from_payload_degrades_unknown_collective(self):
+        plan = pp.TopologyPlan.from_payload(
+            {"ring": ["a"], "collective": "tree"}
+        )
+        assert plan.collective == pp.COLLECTIVE_RING
+
+
+# -- tracker hysteresis -------------------------------------------------------
+
+
+class ManualClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+class TestTracker:
+    def test_jitter_under_hysteresis_keeps_plan(self):
+        clock = ManualClock()
+        tracker = PlanTracker(clock=clock)
+        base = structured_inputs(jitter=0.0)
+        plan0, recomputed = tracker.update(POLICY, base)
+        assert recomputed
+        for i in range(10):
+            clock.now += 120.0   # hold expired: hysteresis is the gate
+            jittered = structured_inputs(jitter=0.3, seed=100 + i)
+            plan, recomputed = tracker.update(
+                POLICY, jittered, rtt_hysteresis_ms=1.0
+            )
+            assert not recomputed
+            assert plan.version == plan0.version
+
+    def test_drift_waits_for_hold_window(self):
+        clock = ManualClock()
+        tracker = PlanTracker(clock=clock)
+        base = structured_inputs(intra=0.2)
+        tracker.update(POLICY, base, hold_seconds=60)
+        drifted = structured_inputs(intra=5.0)   # way past hysteresis
+        clock.now = 30.0
+        _, recomputed = tracker.update(POLICY, drifted, hold_seconds=60)
+        assert not recomputed   # inside the hold window
+        clock.now = 61.0
+        _, recomputed = tracker.update(POLICY, drifted, hold_seconds=60)
+        assert recomputed
+
+    def test_exclusion_change_bypasses_hold(self):
+        clock = ManualClock()
+        tracker = PlanTracker(clock=clock)
+        base = structured_inputs()
+        tracker.update(POLICY, base, hold_seconds=3600)
+        clock.now = 1.0   # deep inside the hold window
+        quarantined = structured_inputs(excluded=("n005",))
+        plan, recomputed = tracker.update(
+            POLICY, quarantined, hold_seconds=3600
+        )
+        assert recomputed
+        assert "n005" not in plan.ring
+
+    def test_membership_change_bypasses_hold(self):
+        clock = ManualClock()
+        tracker = PlanTracker(clock=clock)
+        tracker.update(POLICY, structured_inputs(n=12), hold_seconds=3600)
+        clock.now = 1.0
+        _, recomputed = tracker.update(
+            POLICY, structured_inputs(n=13), hold_seconds=3600
+        )
+        assert recomputed
+
+    def test_forget(self):
+        tracker = PlanTracker(clock=ManualClock())
+        tracker.update(POLICY, structured_inputs())
+        assert tracker.current(POLICY) is not None
+        tracker.forget(POLICY)
+        assert tracker.current(POLICY) is None
+
+    def test_drift_predicate(self):
+        assert not significant_rtt_drift(
+            {("a", "b"): 1.0}, {("a", "b"): 1.5}, 1.0
+        )
+        assert significant_rtt_drift(
+            {("a", "b"): 1.0}, {("a", "b"): 2.5}, 1.0
+        )
+        # edge appearing/vanishing is a real change
+        assert significant_rtt_drift({}, {("a", "b"): 1.0}, 1.0)
+        assert significant_rtt_drift({("a", "b"): 1.0}, {}, 1.0)
+
+
+# -- webhook + projection -----------------------------------------------------
+
+
+def tpu_policy(planner=True, probe=True):
+    p = NetworkClusterPolicy()
+    p.metadata.name = POLICY
+    p.spec.configuration_type = "tpu-so"
+    p.spec.node_selector = {"tpunet.dev/pool": POLICY}
+    p.spec.tpu_scale_out.probe.enabled = probe
+    p.spec.tpu_scale_out.planner.enabled = planner
+    return p
+
+
+class TestWebhook:
+    def test_defaults_pinned_on_enable(self):
+        p = default_policy(tpu_policy())
+        pl = p.spec.tpu_scale_out.planner
+        assert pl.rtt_hysteresis_ms == t.DEFAULT_PLAN_RTT_HYSTERESIS_MS
+        assert pl.hold_seconds == t.DEFAULT_PLAN_HOLD_SECONDS
+        assert pl.spread_threshold_ms == t.DEFAULT_PLAN_SPREAD_THRESHOLD_MS
+
+    def test_disabled_planner_left_untouched(self):
+        p = default_policy(tpu_policy(planner=False))
+        pl = p.spec.tpu_scale_out.planner
+        assert pl.rtt_hysteresis_ms == 0.0 and pl.hold_seconds == 0
+
+    def test_planner_without_probe_rejected(self):
+        p = tpu_policy(probe=False)
+        with pytest.raises(webhook.AdmissionError, match="probe"):
+            webhook.validate_create(default_policy(p))
+
+    def test_range_validation(self):
+        for field, bad in (("rtt_hysteresis_ms", -1.0),
+                           ("rtt_hysteresis_ms", 1001.0),
+                           ("hold_seconds", -1),
+                           ("hold_seconds", 3601),
+                           ("spread_threshold_ms", 1001.0)):
+            p = default_policy(tpu_policy())
+            setattr(p.spec.tpu_scale_out.planner, field, bad)
+            with pytest.raises(webhook.AdmissionError, match="planner"):
+                webhook.validate_create(p)
+
+    def test_valid_policy_admits(self):
+        assert webhook.validate_create(default_policy(tpu_policy())) == []
+
+    def test_spec_round_trips(self):
+        p = default_policy(tpu_policy())
+        back = NetworkClusterPolicy.from_dict(p.to_dict())
+        assert back.spec.tpu_scale_out.planner.enabled is True
+        assert (
+            back.spec.tpu_scale_out.planner.hold_seconds
+            == t.DEFAULT_PLAN_HOLD_SECONDS
+        )
+
+
+class TestProjection:
+    def _args(self, policy):
+        ds = templates.tpu_discovery_daemonset()
+        update_tpu_scale_out_daemonset(ds, policy, NAMESPACE)
+        return ds["spec"]["template"]["spec"]["containers"][0]["args"]
+
+    def test_planner_flag_projected(self):
+        args = self._args(default_policy(tpu_policy()))
+        assert "--planner=true" in args
+
+    def test_no_flag_when_disabled(self):
+        args = self._args(default_policy(tpu_policy(planner=False)))
+        assert not any(a.startswith("--planner") for a in args)
+
+
+# -- report lease fields ------------------------------------------------------
+
+
+class TestReportFields:
+    def test_ici_topology_and_plan_version_round_trip(self):
+        rep = rpt.ProvisioningReport(
+            node="n1", ok=True,
+            ici_topology={"numSlices": 2, "sliceId": 1},
+            plan_version="abc123",
+        )
+        back = rpt.ProvisioningReport.from_json(rep.to_json())
+        assert back.ici_topology == {"numSlices": 2, "sliceId": 1}
+        assert back.plan_version == "abc123"
+
+    def test_absent_fields_default(self):
+        back = rpt.ProvisioningReport.from_json(
+            json.dumps({"node": "n1"})
+        )
+        assert back.ici_topology is None and back.plan_version == ""
+
+    def test_non_object_ici_topology_rejected(self):
+        with pytest.raises(ValueError, match="ici_topology"):
+            rpt.ProvisioningReport.from_json(
+                json.dumps({"node": "n1", "ici_topology": [1, 2]})
+            )
+
+    def test_non_string_plan_version_rejected(self):
+        with pytest.raises(ValueError, match="plan_version"):
+            rpt.ProvisioningReport.from_json(
+                json.dumps({"node": "n1", "plan_version": 7})
+            )
+
+    def test_tpu_topology_to_report_keys(self):
+        from tpu_network_operator.agent.tpu.topology import TpuTopology
+
+        topo = TpuTopology(
+            accelerator_type="v5p-64", topology="2x4x4",
+            num_chips=32, num_hosts=8, num_slices=2, slice_id=1,
+            worker_id=3,
+        )
+        d = topo.to_report()
+        assert d == {
+            "acceleratorType": "v5p-64", "topology": "2x4x4",
+            "numChips": 32, "numHosts": 8, "numSlices": 2,
+            "sliceId": 1, "workerId": 3,
+        }
+
+
+# -- per-peer probe stats (the planner's matrix source) -----------------------
+
+
+class TestPerPeerStats:
+    def test_snapshot_carries_per_peer_rtt(self):
+        from tpu_network_operator.probe.prober import Prober, Responder
+        from tpu_network_operator.probe.transport import FakeFabric
+
+        fabric = FakeFabric(seed=1)
+        fabric.set_link_latency("10.0.0.1", "10.0.0.2", 0.001)
+        Responder(fabric.open("10.0.0.2:8477")).start()
+        prober = Prober(fabric.open("10.0.0.1:9"), fabric.clock)
+        prober.set_peers({"peer-b": "10.0.0.2:8477"})
+        snap = prober.run_round()
+        assert snap.peers["peer-b"]["reachable"] is True
+        assert snap.peers["peer-b"]["rttMs"] == pytest.approx(2.0, rel=0.2)
+        wire = snap.to_report()
+        assert wire["peers"]["peer-b"]["rttMs"] == snap.peers["peer-b"]["rttMs"]
+
+    def test_unsampled_peer_reports_no_rtt_not_zero(self):
+        # one lost probe: fail_streak 1 keeps the peer "reachable" but
+        # the window holds no samples — rttMs must be None, never 0.0
+        # (a 0 ms edge would be the cheapest in the fleet and the ring
+        # heuristic would route straight through the lossy link)
+        from tpu_network_operator.probe.prober import Prober
+        from tpu_network_operator.probe.transport import FakeFabric
+
+        fabric = FakeFabric(seed=1)
+        # no responder: every probe to peer-b is lost
+        prober = Prober(fabric.open("10.0.0.1:9"), fabric.clock)
+        prober.set_peers({"peer-b": "10.0.0.2:8477"})
+        snap = prober.run_round()
+        assert snap.peers["peer-b"]["reachable"] is True
+        assert snap.peers["peer-b"]["rttMs"] is None
+
+
+# -- reconciler integration ---------------------------------------------------
+
+
+def host_of(i):
+    return f"10.0.{i // 256}.{i % 256}"
+
+
+def probe_payload(node, peers_ms, degraded=False):
+    return {
+        "peersTotal": len(peers_ms),
+        "peersReachable": 0 if degraded else len(peers_ms),
+        "unreachable": sorted(peers_ms) if degraded else [],
+        "rttP50Ms": 0.4, "rttP99Ms": 1.1,
+        "lossRatio": 1.0 if degraded else 0.0,
+        "state": "Degraded" if degraded else "Healthy",
+        "peers": {} if degraded else {
+            p: {"rttMs": ms, "lossRatio": 0.0, "reachable": True}
+            for p, ms in peers_ms.items()
+        },
+    }
+
+
+def agent_report(node, i, peers_ms, degraded=False, ici=None):
+    return rpt.ProvisioningReport(
+        node=node, policy=POLICY, ok=True, backend="tpu", mode="L2",
+        interfaces_configured=2, interfaces_total=2,
+        probe_endpoint=f"{host_of(i)}:8477",
+        probe=probe_payload(node, peers_ms, degraded),
+        ici_topology=ici,
+    )
+
+
+class PlannedCluster:
+    """FakeCluster + reconciler with N planned nodes (rack-structured
+    matrix, racks interleaved against name order)."""
+
+    def __init__(self, n=8, racks_n=2, events=False, rack_labels=True):
+        from tpu_network_operator.obs import EventRecorder
+
+        self.n = n
+        self.fake = FakeCluster()
+        self.fake.create(default_policy(tpu_policy()).to_dict())
+        self.racks = {
+            self.node(i): f"rack-{i % racks_n}" for i in range(n)
+        }
+        for i in range(n):
+            labels = {"tpunet.dev/pool": POLICY}
+            if rack_labels:
+                labels["tpunet.dev/rack"] = self.racks[self.node(i)]
+            self.fake.add_node(self.node(i), labels)
+        self.apply_reports()
+        self.metrics = Metrics()
+        self.rec = NetworkClusterPolicyReconciler(
+            self.fake, NAMESPACE, metrics=self.metrics,
+            events=EventRecorder(self.fake, NAMESPACE) if events
+            else None,
+        )
+        self.rec.setup()
+        self.rec.reconcile(POLICY)
+        self.fake.simulate_daemonset_controller()
+        for _ in range(2):
+            self.rec.reconcile(POLICY)
+
+    def node(self, i):
+        return f"node-{i:03d}"
+
+    def peers_ms(self, i, jitter=0.0, seed=0):
+        import random
+
+        rng = random.Random(seed * 1000 + i)
+        node = self.node(i)
+        out = {}
+        for j in range(self.n):
+            if j == i:
+                continue
+            peer = self.node(j)
+            base = 0.2 if self.racks[node] == self.racks[peer] else 2.0
+            out[peer] = base + (jitter * rng.random() if jitter else 0.0)
+        return out
+
+    def apply_reports(self, degraded=(), jitter=0.0, seed=0):
+        for i in range(self.n):
+            node = self.node(i)
+            self.fake.apply(rpt.lease_for(agent_report(
+                node, i, self.peers_ms(i, jitter, seed),
+                degraded=node in degraded,
+            ), NAMESPACE))
+
+    def plan_cm(self):
+        cm = self.fake.get(
+            "v1", "ConfigMap", rpt.plan_configmap_name(POLICY), NAMESPACE
+        )
+        return json.loads(cm["data"][rpt.PLAN_KEY])
+
+    def node_labels(self, i):
+        obj = self.fake.get("v1", "Node", self.node(i))
+        labels = obj["metadata"].get("labels", {}) or {}
+        # merge-patch removal shows as explicit None in the fake store
+        return {k: v for k, v in labels.items() if v is not None}
+
+    def status(self):
+        cr = self.fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+        return cr.get("status", {}) or {}
+
+    def writes(self, kind):
+        return sum(
+            v for (verb, k), v in self.fake.request_counts.items()
+            if k == kind and verb in ("create", "update", "patch",
+                                      "delete")
+        )
+
+
+class TestReconcilerIntegration:
+    def test_plan_distributed_and_owned(self):
+        env = PlannedCluster()
+        plan = env.plan_cm()
+        assert sorted(plan["ring"]) == [env.node(i) for i in range(env.n)]
+        assert plan["version"]
+        cm = env.fake.get(
+            "v1", "ConfigMap", rpt.plan_configmap_name(POLICY), NAMESPACE
+        )
+        owners = cm["metadata"]["ownerReferences"]
+        assert owners and owners[0]["name"] == POLICY
+
+    def test_ring_labels_match_the_plan(self):
+        env = PlannedCluster()
+        plan = env.plan_cm()
+        for idx, node in enumerate(plan["ring"]):
+            i = int(node.rsplit("-", 1)[1])
+            labels = env.node_labels(i)
+            assert labels[t.LABEL_DCN_RING_INDEX] == str(idx)
+            assert labels[t.LABEL_DCN_GROUP] == env.racks[node]
+
+    def test_status_plan_rollup(self):
+        env = PlannedCluster()
+        sp = env.status().get("plan")
+        assert sp["nodes"] == env.n
+        assert sp["groups"] == 2
+        assert sp["version"] == env.plan_cm()["version"]
+        assert sp["collective"] in ("ring", "hierarchical")
+
+    def test_steady_pass_writes_nothing(self):
+        env = PlannedCluster()
+        before_nodes = env.writes("Node")
+        before_cms = env.writes("ConfigMap")
+        for _ in range(3):
+            env.rec.reconcile(POLICY)
+        assert env.writes("Node") == before_nodes
+        assert env.writes("ConfigMap") == before_cms
+
+    def test_restart_reseeds_gates_without_writes(self):
+        env = PlannedCluster()
+        before_nodes = env.writes("Node")
+        before_cms = env.writes("ConfigMap")
+        fresh = NetworkClusterPolicyReconciler(
+            env.fake, NAMESPACE, metrics=Metrics()
+        )
+        fresh.setup()
+        fresh.reconcile(POLICY)
+        # deterministic planner: the restarted reconciler reproduces
+        # the stored plan exactly and the read-back gates swallow it
+        assert env.writes("Node") == before_nodes
+        assert env.writes("ConfigMap") == before_cms
+
+    def test_degraded_node_routed_around_in_one_pass(self):
+        env = PlannedCluster(events=True)
+        victim = env.node(3)
+        env.apply_reports(degraded={victim})
+        env.rec.reconcile(POLICY)
+        plan = env.plan_cm()
+        assert victim not in plan["ring"]
+        assert victim in plan["excluded"]
+        assert t.LABEL_DCN_RING_INDEX not in env.node_labels(3)
+        assert victim in env.status()["plan"]["excluded"]
+        assert env.fake.events(involved_name=POLICY,
+                               reason="TopologyPlanUpdated")
+
+    def test_recovered_node_readmitted(self):
+        env = PlannedCluster()
+        victim = env.node(3)
+        env.apply_reports(degraded={victim})
+        env.rec.reconcile(POLICY)
+        env.apply_reports()
+        env.rec.reconcile(POLICY)
+        assert victim in env.plan_cm()["ring"]
+        assert t.LABEL_DCN_RING_INDEX in env.node_labels(3)
+
+    def test_anomalous_node_excluded(self):
+        env = PlannedCluster()
+        victim = env.node(2)
+        # telemetry anomaly joins the exclusion set exactly like a
+        # probe-degraded verdict
+        rep = agent_report(victim, 2, env.peers_ms(2))
+        rep.telemetry = {"interfaces": {"eth1": {
+            "rxBytes": 1, "rxPackets": 10, "rxErrors": 9,
+            "errorRatio": 0.47, "anomalies": ["error-ratio"],
+        }}}
+        env.fake.apply(rpt.lease_for(rep, NAMESPACE))
+        env.rec.reconcile(POLICY)
+        assert victim in env.plan_cm()["excluded"]
+
+    def test_ici_slice_groups_when_racks_unlabeled(self):
+        env = PlannedCluster(rack_labels=False)
+        for i in range(env.n):
+            node = env.node(i)
+            env.fake.apply(rpt.lease_for(agent_report(
+                node, i, env.peers_ms(i),
+                ici={"numSlices": 2, "sliceId": i % 2,
+                     "numHosts": env.n // 2},
+            ), NAMESPACE))
+        env.rec.reconcile(POLICY)
+        plan = env.plan_cm()
+        assert set(plan["groups"].values()) == {"slice-0", "slice-1"}
+        labels = env.node_labels(1)
+        assert labels[t.LABEL_DCN_GROUP] == "slice-1"
+
+    def test_disable_edge_strips_labels_and_cm(self):
+        from tpu_network_operator.kube import errors as kerr
+
+        env = PlannedCluster()
+        raw = env.fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+        policy = NetworkClusterPolicy.from_dict(raw)
+        policy.spec.tpu_scale_out.planner.enabled = False
+        env.fake.update(policy.to_dict())
+        env.rec.reconcile(POLICY)
+        assert env.status().get("plan") is None
+        with pytest.raises(kerr.NotFoundError):
+            env.fake.get(
+                "v1", "ConfigMap", rpt.plan_configmap_name(POLICY),
+                NAMESPACE,
+            )
+        for i in range(env.n):
+            assert t.LABEL_DCN_RING_INDEX not in env.node_labels(i)
+            assert t.LABEL_DCN_GROUP not in env.node_labels(i)
+
+    def test_disable_after_membership_blackout_still_cleans_up(self):
+        # every report Lease expires (agents crash-looping) BEFORE the
+        # operator disables the planner: the blackout pass nulls
+        # status.plan, and a cleanup gate keyed on status alone would
+        # stay disarmed forever — labels and the plan ConfigMap must
+        # still be stripped on the disable edge from in-memory state
+        from tpu_network_operator.kube import errors as kerr
+
+        env = PlannedCluster()
+        for i in range(env.n):
+            env.fake.delete(
+                "coordination.k8s.io/v1", "Lease",
+                rpt.lease_name(env.node(i)), NAMESPACE,
+            )
+        env.rec.reconcile(POLICY)
+        assert env.status().get("plan") is None   # blackout nulled it
+        raw = env.fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+        policy = NetworkClusterPolicy.from_dict(raw)
+        policy.spec.tpu_scale_out.planner.enabled = False
+        env.fake.update(policy.to_dict())
+        env.rec.reconcile(POLICY)
+        with pytest.raises(kerr.NotFoundError):
+            env.fake.get(
+                "v1", "ConfigMap", rpt.plan_configmap_name(POLICY),
+                NAMESPACE,
+            )
+        for i in range(env.n):
+            assert t.LABEL_DCN_RING_INDEX not in env.node_labels(i)
+            assert t.LABEL_DCN_GROUP not in env.node_labels(i)
+
+    def test_cr_delete_strips_labels(self):
+        env = PlannedCluster()
+        env.fake.delete(API_VERSION, "NetworkClusterPolicy", POLICY)
+        env.rec.reconcile(POLICY)
+        for i in range(env.n):
+            assert t.LABEL_DCN_RING_INDEX not in env.node_labels(i)
+
+    def test_restart_never_strips_foreign_policy_labels(self):
+        # a node OUTSIDE this policy's mesh carrying ring labels (some
+        # other policy's plan) must survive a restarted reconciler's
+        # gate re-seeding — cross-policy label clobber would silently
+        # unschedule another fleet
+        env = PlannedCluster()
+        env.fake.add_node("foreign-node", {
+            t.LABEL_DCN_RING_INDEX: "0",
+            t.LABEL_DCN_GROUP: "other-rack",
+        })
+        fresh = NetworkClusterPolicyReconciler(
+            env.fake, NAMESPACE, metrics=Metrics()
+        )
+        fresh.setup()
+        fresh.reconcile(POLICY)
+        labels = env.fake.get(
+            "v1", "Node", "foreign-node"
+        )["metadata"]["labels"]
+        assert labels[t.LABEL_DCN_RING_INDEX] == "0"
+
+    def test_plan_metrics_exported(self):
+        env = PlannedCluster()
+        text = env.metrics.render()
+        assert f'tpunet_plan_nodes{{policy="{POLICY}"}} {env.n}' in text
+        assert "tpunet_plan_recomputes_total" in text
+
+    def test_excluded_node_steady_state_writes_nothing(self):
+        # the strip of an excluded member must be REMEMBERED by the
+        # diff gate: re-reconciling the same degraded fleet must not
+        # re-issue the strip patch every pass
+        env = PlannedCluster()
+        victim = env.node(3)
+        env.apply_reports(degraded={victim})
+        env.rec.reconcile(POLICY)
+        assert t.LABEL_DCN_RING_INDEX not in env.node_labels(3)
+        before_nodes = env.writes("Node")
+        before_cms = env.writes("ConfigMap")
+        for _ in range(3):
+            env.rec.reconcile(POLICY)
+        assert env.writes("Node") == before_nodes
+        assert env.writes("ConfigMap") == before_cms
+
+    def test_cr_delete_after_restart_strips_labels(self):
+        # a restarted controller has an empty applied-labels map; the
+        # delete path must recover membership from the report Leases
+        # (agent-owned, they outlive the CR) to find the labeled nodes
+        env = PlannedCluster()
+        env.fake.delete(API_VERSION, "NetworkClusterPolicy", POLICY)
+        fresh = NetworkClusterPolicyReconciler(
+            env.fake, NAMESPACE, metrics=Metrics()
+        )
+        fresh.setup()
+        fresh.reconcile(POLICY)
+        for i in range(env.n):
+            assert t.LABEL_DCN_RING_INDEX not in env.node_labels(i)
+
+    def test_jitter_rounds_are_write_free(self):
+        env = PlannedCluster()
+        before_nodes = env.writes("Node")
+        before_cms = env.writes("ConfigMap")
+        version = env.plan_cm()["version"]
+        for r in range(5):
+            env.apply_reports(jitter=0.3, seed=r + 1)
+            env.rec.reconcile(POLICY)
+        assert env.plan_cm()["version"] == version
+        assert env.writes("Node") == before_nodes
+        assert env.writes("ConfigMap") == before_cms
+
+
+class TestPlanInputsFilter:
+    def test_zero_rtt_peer_stat_is_unmeasured_not_free(self):
+        # an agent predating the None-when-empty snapshot reports
+        # rttMs 0.0 with reachable=true for a peer whose probes all
+        # dropped; the controller must treat that edge as unmeasured
+        # (DEFAULT_RTT_MS), not as the cheapest link in the fleet
+        from tpu_network_operator.controller.reconciler import (
+            NetworkClusterPolicyReconciler as R,
+        )
+
+        reports = []
+        for i, peers in enumerate((
+            {"node-001": 0.0, "node-002": 1.5},
+            {"node-000": 0.0, "node-002": 1.5},
+            {"node-000": 1.5, "node-001": 1.5},
+        )):
+            reports.append(rpt.ProvisioningReport(
+                node=f"node-{i:03d}", policy=POLICY, ok=True,
+                backend="tpu", mode="L2", interfaces_configured=2,
+                interfaces_total=2, probe_endpoint=f"10.0.0.{i}:8477",
+                probe={"peers": {
+                    p: {"rttMs": ms, "lossRatio": 0.0, "reachable": True}
+                    for p, ms in peers.items()
+                }},
+            ))
+        nodes = sorted(r.node for r in reports)
+        inputs = R._plan_inputs(
+            default_policy(tpu_policy()), nodes, reports, [], [], {},
+        )
+        assert ("node-000", "node-001") not in inputs.rtt
+        assert inputs.rtt[("node-000", "node-002")] == 1.5
+        assert pp.edge_rtt(
+            inputs.rtt, "node-000", "node-001"
+        ) == pp.DEFAULT_RTT_MS
+
+
+@pytest.mark.scale
+class TestPlannerAtScale:
+    def test_two_thousand_nodes_zero_steady_writes(self):
+        """The scale marker: planning enabled on a 2k-node fleet, the
+        label applies diff-gated and batched — steady-state passes
+        write ZERO Node patches and ZERO ConfigMap updates."""
+        n = 2000
+        fake = FakeCluster()
+        policy = default_policy(tpu_policy())
+        policy.spec.tpu_scale_out.probe.degree = 8
+        fake.create(policy.to_dict())
+        rack_of = {}
+        for i in range(n):
+            node = f"node-{i:05d}"
+            rack_of[node] = f"rack-{i // 16:04d}"
+            fake.add_node(node, {
+                "tpunet.dev/pool": POLICY,
+                "tpunet.dev/rack": rack_of[node],
+            })
+        # degree-8 sampled probing: each node reports RTTs for its 8
+        # ring successors only (the sparse matrix the planner sees)
+        for i in range(n):
+            node = f"node-{i:05d}"
+            peers = {}
+            for step in range(1, 9):
+                peer = f"node-{(i + step) % n:05d}"
+                peers[peer] = (
+                    0.2 if rack_of[node] == rack_of[peer] else 2.0
+                )
+            fake.apply(rpt.lease_for(
+                agent_report(node, i, peers), NAMESPACE
+            ))
+        rec = NetworkClusterPolicyReconciler(
+            fake, NAMESPACE, metrics=Metrics()
+        )
+        rec.setup()
+        rec.reconcile(POLICY)
+        fake.simulate_daemonset_controller()
+        for _ in range(2):
+            rec.reconcile(POLICY)
+
+        def writes():
+            return sum(
+                v for (verb, k), v in fake.request_counts.items()
+                if k in ("Node", "ConfigMap")
+                and verb in ("create", "update", "patch", "delete")
+            )
+
+        # every node labeled once
+        labeled = sum(
+            1 for i in range(0, n, 97)
+            if (fake.get("v1", "Node", f"node-{i:05d}")["metadata"]
+                .get("labels", {}) or {}).get(t.LABEL_DCN_RING_INDEX)
+        )
+        assert labeled == len(range(0, n, 97))
+        before = writes()
+        for _ in range(3):
+            rec.reconcile(POLICY)
+        assert writes() == before
+
+
+# -- bootstrap adoption (agent side) ------------------------------------------
+
+
+class TestBootstrapAdoption:
+    def _bootstrap(self, tmp_path):
+        from tpu_network_operator.agent.tpu import bootstrap as bs
+        from tpu_network_operator.agent.tpu.topology import TpuTopology
+
+        path = str(tmp_path / "jax-coordinator.json")
+        cfg = bs.BootstrapConfig(
+            coordinator_address="10.0.0.1:8476", num_processes=2,
+            process_id=0,
+            topology=TpuTopology(num_chips=8, num_hosts=2, num_slices=1),
+        )
+        bs.write_bootstrap(cfg, path)
+        return bs, path
+
+    def test_apply_plan_writes_block_and_ring_index(self, tmp_path):
+        bs, path = self._bootstrap(tmp_path)
+        plan = pp.compute_plan(structured_inputs(n=4)).to_payload()
+        node = plan["ring"][2]
+        assert bs.apply_plan(path, plan, node=node) is True
+        cfg = bs.read_bootstrap(path)
+        assert cfg.plan["version"] == plan["version"]
+        assert cfg.plan["ringIndex"] == 2
+        # idempotent: the same plan is a no-op rewrite
+        assert bs.apply_plan(path, plan, node=node) is False
+
+    def test_apply_plan_unknown_node_gets_minus_one(self, tmp_path):
+        bs, path = self._bootstrap(tmp_path)
+        plan = pp.compute_plan(structured_inputs(n=4)).to_payload()
+        bs.apply_plan(path, plan, node="stranger")
+        assert bs.read_bootstrap(path).plan["ringIndex"] == -1
+
+    def test_apply_plan_none_strips_block(self, tmp_path):
+        bs, path = self._bootstrap(tmp_path)
+        plan = pp.compute_plan(structured_inputs(n=4)).to_payload()
+        bs.apply_plan(path, plan, node=plan["ring"][0])
+        assert bs.apply_plan(path, None) is True
+        cfg = bs.read_bootstrap(path)
+        assert cfg.plan is None
+        # plan-less file is byte-compatible with the pre-planner schema
+        raw = json.load(open(path))
+        assert "plan" not in raw
+
+    def test_apply_plan_missing_file_returns_none(self, tmp_path):
+        # None (not False): "couldn't read" must be distinguishable
+        # from "already adopted" or the agent would record a plan as
+        # adopted that never landed in any file
+        from tpu_network_operator.agent.tpu import bootstrap as bs
+
+        assert bs.apply_plan(
+            str(tmp_path / "absent.json"), {"version": "x"}
+        ) is None
+
+    def test_old_bootstrap_without_plan_parses(self, tmp_path):
+        bs, path = self._bootstrap(tmp_path)
+        assert bs.read_bootstrap(path).plan is None
+
+
+class TestAgentPlanSync:
+    def test_monitor_sync_adopts_plan_and_stamps_version(
+        self, tmp_path, monkeypatch
+    ):
+        from tpu_network_operator.agent import cli
+
+        bs, path = TestBootstrapAdoption()._bootstrap(tmp_path)
+        plan = pp.compute_plan(structured_inputs(n=4))
+        fake = FakeCluster()
+        fake.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {
+                "name": rpt.plan_configmap_name(POLICY),
+                "namespace": NAMESPACE,
+            },
+            "data": {rpt.PLAN_KEY: json.dumps(plan.to_payload())},
+        })
+        node = plan.ring[1]
+        monkeypatch.setenv("NODE_NAME", node)
+        monkeypatch.setenv("TPUNET_KUBE_URL", "fake://")
+        monkeypatch.setitem(cli._CLIENT_CACHE, "fake://", fake)
+        config = cli.CmdConfig(
+            backend="tpu", bootstrap=path, planner_enabled=True,
+            report_namespace=NAMESPACE, policy_name=POLICY,
+        )
+        state = cli._MonitorState()
+        cli._sync_plan(config, state)
+        assert config.plan_version == plan.version
+        assert bs.read_bootstrap(path).plan["ringIndex"] == 1
+        # TTL: an immediate second sync does not refetch
+        reads = dict(fake.request_counts)
+        cli._sync_plan(config, state)
+        assert dict(fake.request_counts) == reads
+
+    def test_unreadable_bootstrap_does_not_record_adoption(
+        self, tmp_path, monkeypatch
+    ):
+        # bootstrap not written yet: plan_version must stay "" so the
+        # plan is folded in once the file appears (recording it now
+        # would skip adoption forever via the version-match gate)
+        from tpu_network_operator.agent import cli
+        from tpu_network_operator.agent.tpu import bootstrap as bs
+
+        plan = pp.compute_plan(structured_inputs(n=4))
+        fake = FakeCluster()
+        fake.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {
+                "name": rpt.plan_configmap_name(POLICY),
+                "namespace": NAMESPACE,
+            },
+            "data": {rpt.PLAN_KEY: json.dumps(plan.to_payload())},
+        })
+        node = plan.ring[0]
+        monkeypatch.setenv("NODE_NAME", node)
+        monkeypatch.setenv("TPUNET_KUBE_URL", "fake://")
+        monkeypatch.setitem(cli._CLIENT_CACHE, "fake://", fake)
+        path = str(tmp_path / "jax-coordinator.json")
+        config = cli.CmdConfig(
+            backend="tpu", bootstrap=path, planner_enabled=True,
+            report_namespace=NAMESPACE, policy_name=POLICY,
+        )
+        state = cli._MonitorState()
+        cli._sync_plan(config, state)
+        assert config.plan_version == ""
+        # the bootstrap appears (provisioning retry); the next refresh
+        # window adopts the same plan version
+        TestBootstrapAdoption()._bootstrap(tmp_path)
+        state.plan_fetched_at = -1e9
+        cli._sync_plan(config, state)
+        assert config.plan_version == plan.version
+        assert bs.read_bootstrap(path).plan["version"] == plan.version
+
+    def test_mangled_payload_rejected_before_bootstrap(
+        self, tmp_path, monkeypatch
+    ):
+        # a broken distributed payload (ring not a list) must never
+        # land in the bootstrap — the agent keeps its last-known state
+        from tpu_network_operator.agent import cli
+
+        bs, path = TestBootstrapAdoption()._bootstrap(tmp_path)
+        fake = FakeCluster()
+        fake.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {
+                "name": rpt.plan_configmap_name(POLICY),
+                "namespace": NAMESPACE,
+            },
+            "data": {rpt.PLAN_KEY: json.dumps(
+                {"version": "bad", "ring": "not-a-list"}
+            )},
+        })
+        monkeypatch.setenv("NODE_NAME", "n000")
+        monkeypatch.setenv("TPUNET_KUBE_URL", "fake://")
+        monkeypatch.setitem(cli._CLIENT_CACHE, "fake://", fake)
+        config = cli.CmdConfig(
+            backend="tpu", bootstrap=path, planner_enabled=True,
+            report_namespace=NAMESPACE, policy_name=POLICY,
+        )
+        cli._sync_plan(config, cli._MonitorState())
+        assert config.plan_version == ""
+        assert bs.read_bootstrap(path).plan is None
+
+    def test_sync_disabled_is_noop(self, tmp_path):
+        from tpu_network_operator.agent import cli
+
+        config = cli.CmdConfig(backend="tpu", planner_enabled=False)
+        cli._sync_plan(config, cli._MonitorState())
+        assert config.plan_version == ""
+
+
+# -- parallel/mesh.py + collectives consumption -------------------------------
+
+
+class TestMeshConsumption:
+    def _cfg(self, plan=None, num_slices=2):
+        from tpu_network_operator.agent.tpu.bootstrap import BootstrapConfig
+        from tpu_network_operator.agent.tpu.topology import TpuTopology
+
+        return BootstrapConfig(
+            coordinator_address="10.0.0.1:8476",
+            num_processes=2, process_id=0,
+            topology=TpuTopology(
+                ici_mesh=(2, 2), num_chips=4, num_hosts=1,
+                num_slices=num_slices,
+            ),
+            plan=plan,
+        )
+
+    def test_axis_hint_orders_the_mesh(self):
+        from tpu_network_operator.parallel import mesh_from_bootstrap
+
+        order = ["data", "fsdp", "tensor", "pipe", "expert", "seq"]
+        mesh = mesh_from_bootstrap(
+            self._cfg(plan={"meshAxisOrder": order}), tensor=2,
+        )
+        assert list(mesh.axis_names) == order
+
+    def test_absent_plan_keeps_default_order(self):
+        from tpu_network_operator.parallel import mesh_from_bootstrap
+        from tpu_network_operator.parallel.mesh import AXES
+
+        mesh = mesh_from_bootstrap(self._cfg(plan=None), tensor=2)
+        assert tuple(mesh.axis_names) == AXES
+
+    def test_malformed_axis_hint_falls_back(self):
+        from tpu_network_operator.parallel.mesh import (
+            AXES,
+            planned_axis_order,
+        )
+
+        assert planned_axis_order(
+            self._cfg(plan={"meshAxisOrder": ["data", "data"]})
+        ) == AXES
+        assert planned_axis_order(
+            self._cfg(plan={"meshAxisOrder": "bogus"})
+        ) == AXES
+
+    def test_collective_choice(self):
+        from tpu_network_operator.parallel import dcn_collective
+
+        assert dcn_collective(
+            self._cfg(plan={"collective": "hierarchical"})
+        ) == "hierarchical"
+        assert dcn_collective(
+            self._cfg(plan={"collective": "ring"})
+        ) == "ring"
+        # fallback: no plan block (old agent / planner off) = ring
+        assert dcn_collective(self._cfg(plan=None)) == "ring"
+        assert dcn_collective(
+            self._cfg(plan={"collective": "tree"})
+        ) == "ring"
+
+    def test_ring_index_helper(self):
+        from tpu_network_operator.parallel import planned_ring_index
+
+        assert planned_ring_index(
+            self._cfg(plan={"ringIndex": 5})
+        ) == 5
+        assert planned_ring_index(self._cfg(plan=None)) == -1
+        assert planned_ring_index(
+            self._cfg(plan={"ringIndex": "3"})
+        ) == -1
+
+    def test_invalid_axis_order_raises_directly(self):
+        from tpu_network_operator.parallel import plan_axes
+
+        with pytest.raises(ValueError, match="permutation"):
+            plan_axes(8, axis_order=["data", "fsdp"])
+
+
+class TestDcnAllReduce:
+    def test_hierarchical_matches_ring(self):
+        import jax
+        import numpy as np
+
+        from tpu_network_operator.parallel import make_mesh, plan_axes
+        from tpu_network_operator.parallel.collectives import (
+            make_dcn_all_reduce,
+        )
+
+        mesh = make_mesh(plan_axes(8, fsdp=4))   # data=2, fsdp=4
+        x = np.arange(32.0, dtype=np.float32)
+        ring = make_dcn_all_reduce(mesh, strategy="ring")
+        hier = make_dcn_all_reduce(mesh, strategy="hierarchical")
+        out_ring = np.asarray(jax.device_get(ring(x)))
+        out_hier = np.asarray(jax.device_get(hier(x)))
+        # both strategies compute the same global gradient sum
+        np.testing.assert_allclose(out_ring, out_hier)
+        expected = np.tile(x.reshape(8, 4).sum(axis=0), 8)
+        np.testing.assert_allclose(out_ring, expected)
+
+    def test_degenerate_ici_axis_falls_back(self):
+        import jax
+        import numpy as np
+
+        from tpu_network_operator.parallel import make_mesh, plan_axes
+        from tpu_network_operator.parallel.collectives import (
+            make_dcn_all_reduce,
+        )
+
+        mesh = make_mesh(plan_axes(8, fsdp=1, data=8))
+        fn = make_dcn_all_reduce(mesh, strategy="hierarchical")
+        out = np.asarray(jax.device_get(fn(np.ones(8, np.float32))))
+        np.testing.assert_allclose(out, np.full(8, 8.0))

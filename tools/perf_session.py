@@ -173,6 +173,15 @@ def main() -> int:
         maybe_run_phase(out, "scale-bench",
                   [py, "tools/scale_bench.py",
                    "--out", "BENCH_scale.json"], timeout=900)
+        # 14. topology planner: planned DCN ring vs naive name-order
+        # ring on seeded rack-structured RTT matrices (modeled
+        # all-reduce latency, ≥20% budget), degraded-link exclusion
+        # within one reconcile, and jitter-proof hysteresis (0 label
+        # transitions across 10 jitter-only rounds; no TPU,
+        # in-process FakeCluster)
+        maybe_run_phase(out, "planner-bench",
+                  [py, "tools/planner_bench.py",
+                   "--out", "BENCH_planner.json"], timeout=600)
     print(f"done -> {args.out}")
     return 0
 

@@ -1,0 +1,422 @@
+#!/usr/bin/env python3
+"""Topology-planner benchmark — prints ONE JSON line (BENCH-style).
+
+Proves the planner's three contract points on deterministic seeded
+fabrics (no TPU, no sockets):
+
+1. **Ring quality** — on rack-structured FakeFabric fleets (fast
+   intra-rack links, slow inter-rack links, racks interleaved with the
+   naming order) at 20 and 200 nodes, the RTT matrix is MEASURED by
+   real probe rounds over the fabric and fed to the planner; the
+   planned ring must beat the naive name-order ring by ≥ 20% on
+   modeled pipelined-ring all-reduce latency (ring perimeter — see
+   planner/plan.py).
+2. **Degraded-link exclusion** — through the real reconciler on a
+   FakeCluster: a node whose probe gate reports Degraded must be
+   routed around (dropped from the ring, ring-index label stripped)
+   within ONE reconcile pass, and re-admitted on recovery.
+3. **Hysteresis** — 10 probe rounds of pure RTT jitter (within the
+   rttHysteresisMs dead-band) must produce 0 plan recomputes, 0 node
+   label writes, and 0 plan-ConfigMap writes.
+
+Usage: python tools/planner_bench.py [--seed 42] [--out BENCH_planner.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+NAMESPACE = "tpunet-system"
+POLICY = "planner"
+IMPROVEMENT_BUDGET_PCT = 20.0
+JITTER_ROUNDS = 10
+
+# the structured fabric: one-way link latencies (seconds)
+INTRA_RACK_S = 0.0001      # 100 µs
+INTER_RACK_S = 0.001       # 1 ms
+LINK_SPREAD = 0.3          # ± seeded per-pair spread fraction
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def node_name(i: int) -> str:
+    return f"node-{i:03d}"
+
+
+def host_of(i: int) -> str:
+    return f"10.{i // 65536}.{(i // 256) % 256}.{i % 256}"
+
+
+def rack_plan(n: int):
+    """Rack per node, INTERLEAVED with the naming order (i % n_racks):
+    the naive name-order ring then crosses racks on almost every hop —
+    exactly the placement a planner that only sorts names gets wrong."""
+    n_racks = max(2, n // 10)
+    return {node_name(i): f"rack-{i % n_racks:02d}" for i in range(n)}
+
+
+def link_latencies(n: int, seed: int):
+    """Seeded per-pair one-way latencies of the structured fabric."""
+    rng = random.Random(seed)
+    racks = rack_plan(n)
+    lat = {}
+    for i in range(n):
+        for jj in range(i + 1, n):
+            a, b = node_name(i), node_name(jj)
+            base = (
+                INTRA_RACK_S if racks[a] == racks[b] else INTER_RACK_S
+            )
+            lat[(a, b)] = base * (1.0 + LINK_SPREAD * rng.random())
+    return racks, lat
+
+
+# -- scenario 1: measured matrix → planned vs naive ring ----------------------
+
+
+def measure_matrix(n: int, seed: int, rounds: int = 3):
+    """Probe the structured FakeFabric full-mesh and return the
+    measured per-node observations ({node: {peer: rttMs}})."""
+    from tpu_network_operator.probe.prober import Prober, Responder
+    from tpu_network_operator.probe.transport import FakeFabric
+
+    racks, lat = link_latencies(n, seed)
+    fabric = FakeFabric(seed=seed, jitter=0.00001)
+    for (a, b), seconds in lat.items():
+        fabric.set_link_latency(
+            host_of(int(a[-3:])), host_of(int(b[-3:])), seconds
+        )
+    endpoints = {node_name(i): f"{host_of(i)}:8477" for i in range(n)}
+    for name, ep in endpoints.items():
+        Responder(fabric.open(ep)).start()
+    probers = {}
+    for i in range(n):
+        name = node_name(i)
+        probers[name] = Prober(
+            fabric.open(f"{host_of(i)}:9"), fabric.clock, window=rounds,
+        )
+        probers[name].set_peers({
+            p: a for p, a in endpoints.items() if p != name
+        })
+    for _ in range(rounds):
+        for p in probers.values():
+            p.run_round()
+        fabric.advance(5.0)
+    obs = {}
+    for name, p in probers.items():
+        snap = p.snapshot()
+        obs[name] = {
+            peer: stats["rttMs"]
+            for peer, stats in snap.peers.items()
+            if stats["reachable"]
+        }
+    return racks, obs
+
+
+def run_ring_quality(n: int, seed: int):
+    from tpu_network_operator.planner import plan as pp
+
+    log(f"== ring quality: {n} nodes")
+    t0 = time.perf_counter()
+    racks, obs = measure_matrix(n, seed)
+    rtt = pp.build_matrix(obs)
+    inputs = pp.PlanInputs(
+        nodes=sorted(obs), rtt=rtt, groups=racks,
+        excluded=frozenset(), seed=POLICY,
+    )
+    plan = pp.compute_plan(inputs)
+    again = pp.compute_plan(inputs)
+    naive = sorted(obs)
+    planned_ms = pp.modeled_allreduce_ms(plan.ring, rtt)
+    naive_ms = pp.modeled_allreduce_ms(naive, rtt)
+    improvement = 100.0 * (1.0 - planned_ms / max(naive_ms, 1e-9))
+    row = {
+        "nodes": n,
+        "racks": len(set(racks.values())),
+        "measured_edges": len(rtt),
+        "planned_allreduce_ms": round(planned_ms, 3),
+        "naive_allreduce_ms": round(naive_ms, 3),
+        "improvement_pct": round(improvement, 1),
+        "collective": plan.collective,
+        "plan_version": plan.version,
+        "deterministic": again.version == plan.version
+        and again.ring == plan.ring,
+        "plan_seconds": round(time.perf_counter() - t0, 2),
+    }
+    log(f"   -> planned {row['planned_allreduce_ms']}ms vs naive "
+        f"{row['naive_allreduce_ms']}ms ({row['improvement_pct']}% "
+        f"better), {row['collective']} collectives")
+    return row
+
+
+# -- scenarios 2+3: the real reconciler on a FakeCluster ----------------------
+
+
+def make_policy():
+    from tpu_network_operator.api.v1alpha1 import (
+        NetworkClusterPolicy,
+        default_policy,
+    )
+
+    p = NetworkClusterPolicy()
+    p.metadata.name = POLICY
+    p.spec.configuration_type = "tpu-so"
+    p.spec.node_selector = {"tpunet.dev/pool": POLICY}
+    p.spec.tpu_scale_out.probe.enabled = True
+    p.spec.tpu_scale_out.probe.interval_seconds = 5
+    p.spec.tpu_scale_out.planner.enabled = True
+    return default_policy(p).to_dict()
+
+
+def probe_payload(node: str, peers_ms, degraded: bool = False):
+    reachable = {} if degraded else dict(peers_ms)
+    return {
+        "peersTotal": len(peers_ms),
+        "peersReachable": len(reachable),
+        "unreachable": (
+            sorted(peers_ms) if degraded else []
+        ),
+        "rttP50Ms": 0.4,
+        "rttP99Ms": 1.1,
+        "lossRatio": 1.0 if degraded else 0.0,
+        "state": "Degraded" if degraded else "Healthy",
+        "peers": {
+            p: {"rttMs": round(ms, 3), "lossRatio": 0.0,
+                "reachable": True}
+            for p, ms in reachable.items()
+        },
+    }
+
+
+def report_for(node: str, i: int, peers_ms, degraded: bool = False):
+    from tpu_network_operator.agent import report as rpt
+
+    return rpt.ProvisioningReport(
+        node=node, policy=POLICY, ok=True, backend="tpu", mode="L2",
+        interfaces_configured=4, interfaces_total=4,
+        probe_endpoint=f"{host_of(i)}:8477",
+        probe=probe_payload(node, peers_ms, degraded),
+    )
+
+
+def node_writes(fake):
+    return sum(
+        v for (verb, kind), v in fake.request_counts.items()
+        if kind == "Node" and verb in ("create", "update", "patch",
+                                       "delete")
+    )
+
+
+def cm_writes(fake):
+    return sum(
+        v for (verb, kind), v in fake.request_counts.items()
+        if kind == "ConfigMap" and verb in ("create", "update", "patch",
+                                            "delete")
+    )
+
+
+def run_reconciler_scenarios(seed: int, n: int = 20):
+    from tpu_network_operator.agent import report as rpt
+    from tpu_network_operator.api.v1alpha1.types import API_VERSION
+    from tpu_network_operator.controller.health import Metrics
+    from tpu_network_operator.controller.reconciler import (
+        NetworkClusterPolicyReconciler,
+    )
+    from tpu_network_operator.kube.fake import FakeCluster
+    from tpu_network_operator.planner import plan as pp
+
+    log(f"== reconciler scenarios: {n} nodes")
+    rng = random.Random(seed + 1)
+    racks, lat = link_latencies(n, seed)
+    base_ms = {
+        node_name(i): {
+            node_name(j): 2e3 * lat[tuple(sorted(
+                (node_name(i), node_name(j))
+            ))]
+            for j in range(n) if j != i
+        }
+        for i in range(n)
+    }
+
+    fake = FakeCluster()
+    fake.create(make_policy())
+    for i in range(n):
+        node = node_name(i)
+        fake.add_node(node, {
+            "tpunet.dev/pool": POLICY, "tpunet.dev/rack": racks[node],
+        })
+        fake.apply(rpt.lease_for(
+            report_for(node, i, base_ms[node]), NAMESPACE
+        ))
+    rec = NetworkClusterPolicyReconciler(fake, NAMESPACE, metrics=Metrics())
+    rec.setup()
+    rec.reconcile(POLICY)
+    fake.simulate_daemonset_controller()
+    for _ in range(3):
+        rec.reconcile(POLICY)
+
+    def current_plan():
+        cm = fake.get(
+            "v1", "ConfigMap", rpt.plan_configmap_name(POLICY), NAMESPACE
+        )
+        return json.loads(cm["data"][rpt.PLAN_KEY])
+
+    def ring_label(node):
+        obj = fake.get("v1", "Node", node)
+        return (obj["metadata"].get("labels", {}) or {}).get(
+            pp.LABEL_DCN_RING_INDEX
+        )
+
+    plan0 = current_plan()
+    victim = node_name(n // 2)
+    assert victim in plan0["ring"], "victim not planned while healthy"
+    labeled = sum(
+        1 for i in range(n)
+        if isinstance(ring_label(node_name(i)), str)
+    )
+
+    # scenario 3 first (jitter must not be disturbed by the exclusion):
+    # 10 probe rounds of pure jitter inside the 1.0 ms dead-band
+    nw0, cw0 = node_writes(fake), cm_writes(fake)
+    versions = set()
+    for _ in range(JITTER_ROUNDS):
+        for i in range(n):
+            node = node_name(i)
+            jittered = {
+                p: ms + 0.3 * rng.random()
+                for p, ms in base_ms[node].items()
+            }
+            fake.apply(rpt.lease_for(
+                report_for(node, i, jittered), NAMESPACE
+            ))
+        rec.reconcile(POLICY)
+        versions.add(current_plan()["version"])
+    jitter_node_writes = node_writes(fake) - nw0
+    jitter_cm_writes = cm_writes(fake) - cw0
+    jitter_versions = len(versions)
+
+    # scenario 2: the victim's gate flips Degraded — ONE reconcile must
+    # route around it (ring, ConfigMap, labels)
+    fake.apply(rpt.lease_for(
+        report_for(victim, n // 2, base_ms[victim], degraded=True),
+        NAMESPACE,
+    ))
+    rec.reconcile(POLICY)
+    plan_degraded = current_plan()
+    excluded_in_one = (
+        victim not in plan_degraded["ring"]
+        and victim in plan_degraded["excluded"]
+    )
+    victim_label_stripped = not isinstance(ring_label(victim), str)
+
+    # recovery: healthy report → back in the ring next pass
+    fake.apply(rpt.lease_for(
+        report_for(victim, n // 2, base_ms[victim]), NAMESPACE
+    ))
+    rec.reconcile(POLICY)
+    readmitted = victim in current_plan()["ring"]
+
+    cr = fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+    status_plan = (cr.get("status", {}) or {}).get("plan") or {}
+    row = {
+        "nodes": n,
+        "ring_nodes_labeled": labeled,
+        "jitter_rounds": JITTER_ROUNDS,
+        "jitter_plan_versions": jitter_versions,
+        "jitter_node_label_writes": jitter_node_writes,
+        "jitter_plan_cm_writes": jitter_cm_writes,
+        "degraded_excluded_in_passes": 1 if excluded_in_one else -1,
+        "victim_label_stripped": victim_label_stripped,
+        "victim_readmitted": readmitted,
+        "status_plan_version": status_plan.get("version", ""),
+        "status_plan_collective": status_plan.get("collective", ""),
+    }
+    log(f"   -> jitter: {jitter_versions} version(s), "
+        f"{jitter_node_writes} label writes, "
+        f"{jitter_cm_writes} CM writes; degraded excluded in "
+        f"{row['degraded_excluded_in_passes']} pass(es)")
+    return row
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--nodes-list", default="20,200",
+                    help="ring-quality sweep sizes")
+    ap.add_argument("--out", default="",
+                    help="also write the JSON artifact to this path")
+    args = ap.parse_args()
+    sizes = [int(s) for s in args.nodes_list.split(",") if s.strip()]
+
+    quality = [run_ring_quality(n, args.seed) for n in sizes]
+    scenarios = run_reconciler_scenarios(args.seed)
+
+    failures = []
+    for row in quality:
+        if row["improvement_pct"] < IMPROVEMENT_BUDGET_PCT:
+            failures.append(
+                f"{row['nodes']} nodes: {row['improvement_pct']}% "
+                f"improvement under the {IMPROVEMENT_BUDGET_PCT}% budget"
+            )
+        if not row["deterministic"]:
+            failures.append(f"{row['nodes']} nodes: plan not deterministic")
+    if scenarios["degraded_excluded_in_passes"] != 1:
+        failures.append("degraded node not excluded within 1 reconcile")
+    if not scenarios["victim_label_stripped"]:
+        failures.append("excluded node kept its ring-index label")
+    if not scenarios["victim_readmitted"]:
+        failures.append("recovered node not re-admitted to the ring")
+    if scenarios["jitter_plan_versions"] != 1:
+        failures.append(
+            f"{scenarios['jitter_plan_versions']} plan versions across "
+            "jitter-only rounds (want 1)"
+        )
+    if scenarios["jitter_node_label_writes"] != 0:
+        failures.append(
+            f"{scenarios['jitter_node_label_writes']} node label writes "
+            "across jitter-only rounds (want 0)"
+        )
+    if scenarios["jitter_plan_cm_writes"] != 0:
+        failures.append(
+            f"{scenarios['jitter_plan_cm_writes']} plan ConfigMap "
+            "writes across jitter-only rounds (want 0)"
+        )
+
+    worst = min(q["improvement_pct"] for q in quality)
+    result = {
+        "metric": "planned vs naive DCN ring modeled all-reduce latency",
+        "value": round(worst, 1),
+        "unit": "percent",
+        # planned/naive latency ratio at the largest sweep (<1 = win)
+        "vs_baseline": round(
+            quality[-1]["planned_allreduce_ms"]
+            / max(quality[-1]["naive_allreduce_ms"], 1e-9), 3,
+        ),
+        "improvement_budget_pct": IMPROVEMENT_BUDGET_PCT,
+        "seed": args.seed,
+        "quality": quality,
+        "scenarios": scenarios,
+        "ok": not failures,
+        "failures": failures,
+    }
+    line = json.dumps(result)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(line)
+    if failures:
+        log("FAILED: " + "; ".join(failures))
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -99,6 +99,16 @@ class CmdConfig:
     telemetry_error_ratio: float = 0.0
     telemetry_drop_rate: float = 0.0
     telemetry_stall_ticks: int = 0
+    # topology planner (planner/ subsystem): poll the controller-
+    # distributed tpunet-plan-<policy> ConfigMap and fold the plan
+    # block (DCN ring order, axis hint, collective hint) into the
+    # bootstrap file; the adopted plan version rides the report Lease
+    planner_enabled: bool = False
+    plan_version: str = ""
+    # this node's discovered ICI slice shape in report wire form
+    # (TpuTopology.to_report()), set once per provisioning attempt so
+    # every report carries the slice boundaries the planner groups on
+    ici_report: Optional[Dict] = None
     # tracing (obs/): the provisioning attempt's trace ID — projected by
     # the operator (tpunet.dev/trace-id stamp → downward API →
     # TPUNET_TRACE_ID) so the agent's phase spans join the reconcile
@@ -293,6 +303,8 @@ def _publish_report(
         trace_id=trace_id,
         spans=spans,
         telemetry=telemetry.export() if telemetry else None,
+        ici_topology=config.ici_report,
+        plan_version=config.plan_version,
     )
     return rpt.write_report(client, config.report_namespace, rep)
 
@@ -336,6 +348,8 @@ def _publish_failure_report(
             # counters are exactly the evidence a triager needs next
             # (is the link down, or up-and-corrupting?)
             telemetry=telemetry.export() if telemetry else None,
+            ici_topology=config.ici_report,
+            plan_version=config.plan_version,
             agent_version=rpt.agent_version_string(),
         ),
     )
@@ -594,6 +608,98 @@ def _on_probe_transition(
         config, "Warning", "ReadinessRetracted",
         error + "; readiness label retracted",
     )
+
+
+# -- topology plan adoption (planner/ subsystem) ------------------------------
+
+# plan refresh cadence: plans change at replan speed (hysteresis-gated
+# controller-side), so one ConfigMap GET per window per node is plenty
+PLAN_REFRESH_SECONDS = 60.0
+
+
+def _fetch_plan(config: CmdConfig) -> Optional[Dict]:
+    """The controller-distributed topology plan payload for this
+    policy — validated and normalized through
+    ``TopologyPlan.from_payload`` (payloads come from the cluster: any
+    operator version, possibly mangled; a broken ring must never land
+    in a job's bootstrap) — or None when absent/unreachable/
+    unparseable (keep the last adopted plan: a control-plane blip must
+    not strip a live job's plan block)."""
+    import json as json_mod
+
+    ctx = _report_ctx(config)
+    if ctx is None:
+        return None
+    _, client = ctx
+    from ..kube import errors as kerr
+    from ..planner.plan import TopologyPlan
+    from . import report as rpt
+
+    try:
+        cm = client.get(
+            "v1", "ConfigMap",
+            rpt.plan_configmap_name(config.policy_name),
+            config.report_namespace,
+        )
+        raw = (cm.get("data", {}) or {}).get(rpt.PLAN_KEY, "")
+        if not raw:
+            return None
+        return TopologyPlan.from_payload(json_mod.loads(raw)).to_payload()
+    except kerr.NotFoundError:
+        log.debug("topology plan not distributed yet")
+        return None
+    except Exception as e:   # noqa: BLE001 — keep the last adopted plan
+        log.debug("topology plan fetch failed: %s", e)
+        return None
+
+
+def _sync_plan(config: CmdConfig, state: "_MonitorState") -> None:
+    """One plan-adoption step, run from the monitor tick: fetch the
+    distributed plan (TTL-memoized) and fold a version change into the
+    bootstrap file.  The adopted version rides the next report publish
+    (every planning tick republishes — probing is a planner
+    prerequisite), so the controller sees rollout progress."""
+    import time
+
+    if (
+        not config.planner_enabled
+        or config.backend != "tpu"
+        or not config.bootstrap
+    ):
+        return
+    now = time.monotonic()
+    if now - state.plan_fetched_at < PLAN_REFRESH_SECONDS:
+        return
+    state.plan_fetched_at = now
+    plan = _fetch_plan(config)
+    if plan is None:
+        return
+    version = str(plan.get("version", ""))
+    if version and version == config.plan_version:
+        return
+    node = os.environ.get("NODE_NAME", "") or "local"
+    try:
+        changed = tpu_bootstrap.apply_plan(
+            config.bootstrap, plan, node=node
+        )
+    except Exception as e:   # noqa: BLE001 — the plan decorates, never fails
+        log.warning("bootstrap plan adoption failed: %s", e)
+        return
+    if changed is None:
+        # bootstrap unreadable (not written yet / mid-retry): the plan
+        # was NOT folded in — advancing plan_version here would report
+        # it adopted and the version-match early-return above would
+        # then skip it forever once the file appears
+        log.debug("bootstrap not readable yet; plan %s not adopted",
+                  version)
+        return
+    config.plan_version = version
+    if changed:
+        log.info(
+            "adopted topology plan %s into %s (%s collectives)",
+            version, config.bootstrap,
+            plan.get("collective", "ring"),
+        )
 
 
 # peer-list refresh cadence, deliberately much slower than the probe
@@ -884,6 +990,10 @@ def cmd_run(config: CmdConfig, wait_signal: bool = True) -> int:
             with phase("agent.discovery", source=config.topology_source):
                 topo = _tpu_discovery(config, metadata_client)
                 worker_net_config = metadata_client.worker_network_config()
+                # slice boundaries ride every report from here on —
+                # the topology planner's grouping input (no second
+                # discovery path)
+                config.ici_report = topo.to_report()
 
         coordinator = ""
         names = _resolve_interfaces(config, metadata_client)
@@ -1007,6 +1117,9 @@ class _MonitorState:
     # provisioning attempt and keeps it here.  Tests/bench pre-seed it
     # with a manual-clock instance.
     telemetry: Optional[telem.TelemetryMonitor] = None
+    # topology plan fetch TTL clock (see _sync_plan): plans change at
+    # hysteresis-gated replan speed, one GET per window is plenty
+    plan_fetched_at: float = -1e9
     # control-plane degradation (outage-safe degraded mode): consecutive
     # failed publish/renew attempts.  Apiserver unreachability is NOT a
     # dataplane problem — while this is nonzero the agent holds its
@@ -1076,6 +1189,9 @@ def _monitor_tick(
     L3 addressing, counter telemetry, probe-mesh quorum), retract the
     NFD label + publish an ok=False report on degradation, restore both
     on recovery, and heartbeat the report Lease on healthy passes."""
+    # adopt any new topology plan FIRST so the publishes below carry
+    # the just-adopted plan_version (one tick, not two, to converge)
+    _sync_plan(config, state)
     bad = net.verify_configured(configs, config.ops, config.mode == L3)
     if config.telemetry_enabled and configs:
         # counter telemetry: sample every provisioned interface, and
@@ -1292,6 +1408,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--probe-recovery-threshold", type=int,
                    default=probe_defaults.DEFAULT_RECOVERY_THRESHOLD,
                    help="consecutive healthy rounds before it is restored")
+    p.add_argument("--planner", dest="planner_enabled", default=False,
+                   type=_parse_strict_bool,
+                   help="adopt the controller-distributed topology plan "
+                        "into the bootstrap file (DCN ring order + "
+                        "collective hint; requires --probe)")
     p.add_argument("--telemetry", dest="telemetry_enabled", default=True,
                    type=_parse_strict_bool,
                    help="sample per-interface counters each recheck and "
@@ -1393,6 +1514,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         probe_degree=args.probe_degree,
         probe_fail_threshold=args.probe_fail_threshold,
         probe_recovery_threshold=args.probe_recovery_threshold,
+        planner_enabled=args.planner_enabled,
         telemetry_enabled=args.telemetry_enabled,
         telemetry_window=args.telemetry_window,
         telemetry_error_ratio=args.telemetry_error_ratio,

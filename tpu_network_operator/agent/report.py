@@ -72,6 +72,16 @@ class ProvisioningReport:
     # reporting agent's package version, for fleet-wide skew visibility
     # (status.agentVersions); "" from agents predating the field
     agent_version: str = ""
+    # ICI slice shape this agent discovered (agent/tpu/topology.py,
+    # TpuTopology.to_report()): slice boundaries for the topology
+    # planner's grouping — carried here so the planner never needs a
+    # second discovery path.  None from non-tpu/older agents.
+    ici_topology: Optional[Dict] = None
+    # version of the distributed topology plan this agent last folded
+    # into its bootstrap file (planner/ subsystem); "" = no plan
+    # adopted yet — the reconciler reads it to see plan rollout
+    # progress across the fleet
+    plan_version: str = ""
 
     def to_json(self) -> str:
         # a shallow field dict, not dataclasses.asdict: asdict deep-
@@ -102,7 +112,7 @@ class ProvisioningReport:
         })
         for field_name in ("node", "policy", "backend", "mode",
                            "coordinator", "error", "probe_endpoint",
-                           "trace_id", "agent_version"):
+                           "trace_id", "agent_version", "plan_version"):
             if not isinstance(getattr(rep, field_name), str):
                 raise ValueError(f"report field {field_name!r} not a string")
         for field_name in ("interfaces_configured", "interfaces_total"):
@@ -116,6 +126,10 @@ class ProvisioningReport:
             raise ValueError("report field 'probe' not an object")
         if rep.telemetry is not None and not isinstance(rep.telemetry, dict):
             raise ValueError("report field 'telemetry' not an object")
+        if rep.ici_topology is not None and not isinstance(
+            rep.ici_topology, dict
+        ):
+            raise ValueError("report field 'ici_topology' not an object")
         if rep.spans is not None and (
             not isinstance(rep.spans, list)
             or not all(isinstance(s, dict) for s in rep.spans)
@@ -177,6 +191,17 @@ PEER_CONFIGMAP_PREFIX = "tpunet-peers-"
 
 def peer_configmap_name(policy: str) -> str:
     return PEER_CONFIGMAP_PREFIX + policy
+
+
+# controller-distributed topology plan (planner/ subsystem): one
+# ConfigMap per policy, data.plan = TopologyPlan.to_payload() JSON.
+# Agents poll it and fold the plan block into the bootstrap file.
+PLAN_CONFIGMAP_PREFIX = "tpunet-plan-"
+PLAN_KEY = "plan"
+
+
+def plan_configmap_name(policy: str) -> str:
+    return PLAN_CONFIGMAP_PREFIX + policy
 
 
 def _now_micro() -> str:
@@ -288,6 +313,8 @@ def report_from_result(
     trace_id: str = "",
     spans: Optional[List[Dict]] = None,
     telemetry: Optional[Dict] = None,
+    ici_topology: Optional[Dict] = None,
+    plan_version: str = "",
 ) -> ProvisioningReport:
     """Assemble the report from the agent's post-pass state.
 
@@ -330,5 +357,7 @@ def report_from_result(
         trace_id=trace_id,
         spans=spans,
         telemetry=telemetry,
+        ici_topology=ici_topology,
+        plan_version=plan_version,
         agent_version=agent_version_string(),
     )

@@ -45,9 +45,16 @@ class BootstrapConfig:
     topology: Optional[TpuTopology] = None
     workers: List[WorkerEndpoint] = field(default_factory=list)
     dcn_interfaces: List[str] = field(default_factory=list)
+    # operator-distributed topology plan block (planner/plan.py
+    # TopologyPlan.to_payload() + this node's "ringIndex"): DCN ring
+    # order, mesh axis ordering and the ring-vs-hierarchical collective
+    # hint parallel/mesh.py consumes.  Optional and additive — a
+    # bootstrap without it (planner off, or an older agent) behaves
+    # exactly as before, which is the version-skew contract.
+    plan: Optional[Dict] = None
 
     def to_dict(self) -> Dict:
-        return {
+        out = {
             "version": SCHEMA_VERSION,
             "coordinator_address": self.coordinator_address,
             "num_processes": self.num_processes,
@@ -59,6 +66,11 @@ class BootstrapConfig:
             ],
             "dcn_interfaces": list(self.dcn_interfaces),
         }
+        if self.plan:
+            # only when present: a plan-less bootstrap stays
+            # byte-identical to the pre-planner schema
+            out["plan"] = dict(self.plan)
+        return out
 
     @classmethod
     def from_dict(cls, d: Dict) -> "BootstrapConfig":
@@ -66,6 +78,7 @@ class BootstrapConfig:
             raise BootstrapError(
                 f"unsupported bootstrap schema version {d.get('version')!r}"
             )
+        plan = d.get("plan")
         return cls(
             coordinator_address=d.get("coordinator_address", ""),
             num_processes=d.get("num_processes", 0),
@@ -76,6 +89,7 @@ class BootstrapConfig:
                 for w in d.get("workers", [])
             ],
             dcn_interfaces=list(d.get("dcn_interfaces", [])),
+            plan=dict(plan) if isinstance(plan, dict) else None,
         )
 
 
@@ -147,6 +161,40 @@ def write_bootstrap(cfg: BootstrapConfig, path: str) -> None:
 def read_bootstrap(path: str) -> BootstrapConfig:
     with open(path) as f:
         return BootstrapConfig.from_dict(json.load(f))
+
+
+def apply_plan(
+    path: str, plan: Optional[Dict], node: str = ""
+) -> Optional[bool]:
+    """Fold the operator-distributed topology plan into the on-disk
+    bootstrap (the agent's plan-adoption step).  Returns True when the
+    file changed, False when it already carried exactly this plan, and
+    **None when the bootstrap could not be read** (missing/corrupt) —
+    a no-op, since the plan decorates provisioning and must never fail
+    it, but one the caller must NOT record as adopted (the bootstrap
+    may appear later, e.g. after a provisioning retry, and still needs
+    this plan folded in).  ``node`` stamps this host's own position in
+    the ring as ``ringIndex`` (-1 when excluded/unknown) so the
+    consuming job never searches the ring itself.  ``plan=None``
+    strips a previously adopted block (planner disabled)."""
+    try:
+        cfg = read_bootstrap(path)
+    except (OSError, ValueError, BootstrapError):
+        return None
+    desired: Optional[Dict] = None
+    if plan is not None:
+        desired = dict(plan)
+        if node:
+            ring = desired.get("ring")
+            desired["ringIndex"] = (
+                ring.index(node) if isinstance(ring, list)
+                and node in ring else -1
+            )
+    if cfg.plan == desired:
+        return False
+    cfg.plan = desired
+    write_bootstrap(cfg, path)
+    return True
 
 
 def delete_bootstrap(path: str) -> None:

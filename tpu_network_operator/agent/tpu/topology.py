@@ -156,6 +156,21 @@ class TpuTopology:
             "source": self.source,
         }
 
+    def to_report(self) -> Dict:
+        """Compact wire form for the report Lease's ``ici_topology``
+        field (camelCase, the report convention): just the slice-
+        boundary facts the topology planner groups on — not the full
+        discovery dump, which would bloat every heartbeat."""
+        return {
+            "acceleratorType": self.accelerator_type,
+            "topology": self.topology,
+            "numChips": self.num_chips,
+            "numHosts": self.num_hosts,
+            "numSlices": self.num_slices,
+            "sliceId": self.slice_id,
+            "workerId": self.worker_id,
+        }
+
     @classmethod
     def from_dict(cls, d: Dict) -> "TpuTopology":
         return cls(

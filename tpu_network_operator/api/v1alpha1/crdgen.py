@@ -237,6 +237,57 @@ def openapi_schema() -> Dict[str, Any]:
                                     },
                                 },
                             },
+                            "planner": {
+                                "type": "object",
+                                "description": (
+                                    "Topology planner: turns the probe "
+                                    "mesh's measured RTT matrix + rack/"
+                                    "slice topology into a DCN ring "
+                                    "ordering (node labels tpunet.dev/"
+                                    "dcn-ring-index and dcn-group) and "
+                                    "a bootstrap plan block the JAX "
+                                    "mesh consumes; requires probe."
+                                ),
+                                "properties": {
+                                    "enabled": {"type": "boolean"},
+                                    "rttHysteresisMs": {
+                                        "type": "number",
+                                        "minimum": 0,
+                                        "maximum": 1000,
+                                        "description": (
+                                            "Min RTT movement (ms) on "
+                                            "an edge before a replan "
+                                            "is considered — probe "
+                                            "jitter never churns "
+                                            "labels (0 = 1.0)."
+                                        ),
+                                    },
+                                    "holdSeconds": {
+                                        "type": "integer",
+                                        "minimum": 0,
+                                        "maximum": 3600,
+                                        "description": (
+                                            "Min seconds between RTT-"
+                                            "driven replans; "
+                                            "structural changes "
+                                            "(membership, exclusions) "
+                                            "bypass the hold (0 = 60)."
+                                        ),
+                                    },
+                                    "spreadThresholdMs": {
+                                        "type": "number",
+                                        "minimum": 0,
+                                        "maximum": 1000,
+                                        "description": (
+                                            "Inter-group minus intra-"
+                                            "group median RTT (ms) "
+                                            "past which the plan "
+                                            "hints hierarchical DCN "
+                                            "collectives (0 = 2.0)."
+                                        ),
+                                    },
+                                },
+                            },
                             "telemetry": {
                                 "type": "object",
                                 "description": (
@@ -376,6 +427,32 @@ def openapi_schema() -> Dict[str, Any]:
                             "the report Leases (version-skew "
                             "visibility)."
                         ),
+                    },
+                    "plan": {
+                        "type": "object",
+                        "description": (
+                            "Active topology plan rollup: decision "
+                            "fingerprint, ring size, collective hint "
+                            "and the nodes routed around (the ring "
+                            "itself lives in the tpunet-plan-<policy> "
+                            "ConfigMap)."
+                        ),
+                        "properties": {
+                            "version": {"type": "string"},
+                            "nodes": {"type": "integer"},
+                            "groups": {"type": "integer"},
+                            "excluded": {
+                                "type": "array",
+                                "items": {"type": "string"},
+                            },
+                            "collective": {
+                                "type": "string",
+                                "enum": list(t.PLAN_COLLECTIVES),
+                            },
+                            "intraGroupRttMs": {"type": "number"},
+                            "interGroupRttMs": {"type": "number"},
+                            "modeledAllreduceMs": {"type": "number"},
+                        },
                     },
                     "summary": {
                         "type": "object",

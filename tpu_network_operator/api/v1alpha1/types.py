@@ -102,6 +102,26 @@ DEFAULT_TELEMETRY_STALL_TICKS = _telemetry_defaults.DEFAULT_STALL_TICKS
 
 CONDITION_TELEMETRY_DEGRADED = "DataplaneTelemetryDegraded"
 
+# topology planner defaults + emitted node labels: aliased from the
+# planner package (one copy of the contract, like the probe/telemetry
+# defaults above).  The planner turns the measured probe RTT matrix +
+# rack/slice topology into a DCN ring ordering, node labels, and a
+# bootstrap plan block the JAX mesh consumes.
+from ...planner import plan as _planner_defaults  # noqa: E402
+
+DEFAULT_PLAN_RTT_HYSTERESIS_MS = _planner_defaults.DEFAULT_RTT_HYSTERESIS_MS
+DEFAULT_PLAN_HOLD_SECONDS = _planner_defaults.DEFAULT_PLAN_HOLD_SECONDS
+DEFAULT_PLAN_SPREAD_THRESHOLD_MS = _planner_defaults.DEFAULT_SPREAD_THRESHOLD_MS
+LABEL_DCN_RING_INDEX = _planner_defaults.LABEL_DCN_RING_INDEX
+LABEL_DCN_GROUP = _planner_defaults.LABEL_DCN_GROUP
+PLAN_COLLECTIVES = (
+    _planner_defaults.COLLECTIVE_RING,
+    _planner_defaults.COLLECTIVE_HIERARCHICAL,
+)
+# bound on the excluded-node list embedded in status.plan (triage entry
+# point, same rationale as STATUS_WORST_K)
+PLAN_STATUS_EXCLUDED_K = 20
+
 # control-plane degradation: the manager classified a reconcile failure
 # as permanent (same answer every retry — bad spec, denied write, a
 # bug) and parked the policy on ceiling-backoff rechecks instead of a
@@ -154,6 +174,33 @@ class ProbeSpec:
     # ``required=True`` keeps the 0 on the wire (omitempty would drop
     # it and the next update would re-default it away).
     degree: Optional[int] = j("degree", None, required=True)
+
+
+@dataclass
+class PlannerSpec:
+    """Topology planner knobs (``planner:`` under ``tpuScaleOut``).
+    When enabled (requires the probe mesh — the planner's input IS the
+    measured RTT matrix), the reconciler computes a DCN ring ordering
+    that groups low-RTT nodes adjacently and routes around degraded/
+    quarantined/anomalous nodes, emits it as node labels
+    (``tpunet.dev/dcn-ring-index``, ``tpunet.dev/dcn-group``) plus a
+    ``tpunet-plan-<policy>`` ConfigMap the agents fold into the
+    jax.distributed bootstrap, and rolls the decision up into
+    ``status.plan``.  All zeroes mean "planner default" (the mutating
+    webhook pins them on enable, the probe/telemetry contract)."""
+
+    enabled: bool = j("enabled", False)
+    # min RTT movement (ms) on some edge vs the matrix the current plan
+    # was computed from before a replan is considered — probe jitter
+    # must never churn labels (0 = 1.0)
+    rtt_hysteresis_ms: float = j("rttHysteresisMs", 0.0)
+    # min seconds between RTT-driven replans; structural changes
+    # (membership, exclusions) bypass the hold (0 = 60)
+    hold_seconds: int = j("holdSeconds", 0)
+    # inter-group minus intra-group median RTT (ms) past which the plan
+    # hints hierarchical DCN collectives instead of one flat ring
+    # (0 = 2.0)
+    spread_threshold_ms: float = j("spreadThresholdMs", 0.0)
 
 
 @dataclass
@@ -240,6 +287,9 @@ class TpuScaleOutSpec:
     # Dataplane counter telemetry: passive NIC-counter sampling +
     # anomaly gating (agent/telemetry.py); on by default.
     telemetry: TelemetrySpec = j("telemetry", factory=TelemetrySpec)
+    # Topology planner: measured RTT matrix -> DCN ring ordering, node
+    # labels + bootstrap plan block (planner/ subsystem; needs probe).
+    planner: PlannerSpec = j("planner", factory=PlannerSpec)
 
 
 @dataclass
@@ -335,6 +385,29 @@ class StatusSummary:
 
 
 @dataclass
+class PlanStatus:
+    """The active topology plan's rollup — what the planner decided and
+    why, at a glance (the ring itself lives in the distributed plan
+    ConfigMap; the status stays O(1) regardless of fleet size)."""
+
+    # decision fingerprint (stable across jitter; see planner/plan.py)
+    version: str = j("version", "")
+    # nodes in the planned ring
+    nodes: int = j("nodes", 0)
+    # distinct rack/slice groups the ring spans
+    groups: int = j("groups", 0)
+    # nodes routed around (degraded/quarantined/anomalous), bounded to
+    # PLAN_STATUS_EXCLUDED_K
+    excluded: List[str] = j("excluded", factory=list)
+    # "ring" | "hierarchical" — the DCN collective hint
+    collective: str = j("collective", "")
+    intra_group_rtt_ms: float = j("intraGroupRttMs", 0.0)
+    inter_group_rtt_ms: float = j("interGroupRttMs", 0.0)
+    # modeled pipelined-ring all-reduce latency over the planned ring
+    modeled_allreduce_ms: float = j("modeledAllreduceMs", 0.0)
+
+
+@dataclass
 class PolicyCondition:
     """metav1.Condition subset (the DataplaneDegraded carrier)."""
 
@@ -367,6 +440,9 @@ class NetworkClusterPolicyStatus:
     # bounded per-shard fleet rollup (omit-empty: absent for non-tpu
     # policies); in summary mode this is the primary status surface
     summary: Optional[StatusSummary] = j("summary", None)
+    # active topology plan rollup (omit-empty: absent unless the
+    # planner is enabled and has produced a plan)
+    plan: Optional[PlanStatus] = j("plan", None)
 
 
 @dataclass

@@ -110,6 +110,15 @@ def default_policy(policy: NetworkClusterPolicy) -> NetworkClusterPolicy:
                 and p.expected_peers > t.STATUS_SUMMARY_NODE_THRESHOLD
             ):
                 spec.status_detail = t.STATUS_DETAIL_SUMMARY
+        if so.planner.enabled:
+            # same contract pinning for the topology-planner knobs
+            pl = so.planner
+            if not pl.rtt_hysteresis_ms:
+                pl.rtt_hysteresis_ms = t.DEFAULT_PLAN_RTT_HYSTERESIS_MS
+            if not pl.hold_seconds:
+                pl.hold_seconds = t.DEFAULT_PLAN_HOLD_SECONDS
+            if not pl.spread_threshold_ms:
+                pl.spread_threshold_ms = t.DEFAULT_PLAN_SPREAD_THRESHOLD_MS
         if so.telemetry.enabled:
             # same contract pinning for the counter-telemetry knobs
             tl = so.telemetry
@@ -262,6 +271,32 @@ def validate_telemetry_spec(tl: t.TelemetrySpec) -> None:
         )
 
 
+def validate_planner_spec(pl: t.PlannerSpec, probe: t.ProbeSpec) -> None:
+    """Topology-planner knobs.  Zero means "planner default" (the
+    mutating webhook fills them on enable), so only explicit
+    out-of-range values are rejected — plus the structural requirement:
+    the planner's input IS the probe mesh's RTT matrix, so enabling it
+    without probing would silently plan from nothing while the operator
+    believes topology-aware placement is active."""
+    if pl.enabled and not probe.enabled:
+        raise AdmissionError(
+            "tpuScaleOut.planner: requires tpuScaleOut.probe.enabled — "
+            "the planner consumes the probe mesh's RTT matrix"
+        )
+    if pl.rtt_hysteresis_ms < 0 or pl.rtt_hysteresis_ms > 1000:
+        raise AdmissionError(
+            "tpuScaleOut.planner: rttHysteresisMs must be 0-1000"
+        )
+    if pl.hold_seconds < 0 or pl.hold_seconds > 3600:
+        raise AdmissionError(
+            "tpuScaleOut.planner: holdSeconds must be 0-3600"
+        )
+    if pl.spread_threshold_ms < 0 or pl.spread_threshold_ms > 1000:
+        raise AdmissionError(
+            "tpuScaleOut.planner: spreadThresholdMs must be 0-1000"
+        )
+
+
 def validate_tpu_so_spec(s: t.TpuScaleOutSpec) -> None:
     _validate_common_so(s.layer, s.mtu, s.pull_policy, "tpuScaleOut")
     if s.topology_source not in TOPOLOGY_SOURCES:
@@ -287,6 +322,7 @@ def validate_tpu_so_spec(s: t.TpuScaleOutSpec) -> None:
         )
     validate_probe_spec(s.probe)
     validate_telemetry_spec(s.telemetry)
+    validate_planner_spec(s.planner, s.probe)
 
 
 def validate_spec(spec: NetworkClusterPolicySpec) -> List[str]:

@@ -87,6 +87,21 @@ METRIC_HELP: Dict[str, str] = {
         "split further.",
     "tpunet_status_bytes":
         "Serialized CR status size in bytes at the last status write.",
+    "tpunet_plan_nodes":
+        "Nodes in the policy's planned DCN ring.",
+    "tpunet_plan_groups":
+        "Distinct rack/slice groups the planned ring spans.",
+    "tpunet_plan_excluded_nodes":
+        "Nodes the topology plan routes around "
+        "(degraded/quarantined/anomalous).",
+    "tpunet_plan_modeled_allreduce_ms":
+        "Modeled pipelined-ring all-reduce latency over the planned "
+        "DCN ring (perimeter RTT).",
+    "tpunet_plan_recomputes_total":
+        "Topology plan recomputations per policy (hysteresis-gated).",
+    "tpunet_plan_label_writes_total":
+        "Node label patches written by the topology planner "
+        "(diff-gated: steady fleets write zero).",
 }
 
 

@@ -27,6 +27,8 @@ from ..kube import errors as kerr
 from ..kube.informer import LIST_PAGE_SIZE   # noqa: F401 — re-exported
 from ..obs import events as obs_events
 from ..obs.trace import TRACE_ANNOTATION, current_trace_id
+from ..planner import PlanTracker
+from ..planner import plan as planner_plan
 from ..probe import topology
 from ..probe.prober import required_peers
 from ..probe.transport import valid_endpoint
@@ -102,6 +104,19 @@ MAX_TELEMETRY_ANOMALIES = 20
 PROBE_QUARANTINE_PASSES = 3
 PROBE_REPROBE_BASE_SECONDS = 5.0
 PROBE_REPROBE_MAX_SECONDS = 60.0
+
+# topology-planner gauges ({policy} labels) — O(1) series per policy;
+# same retraction contract as POLICY_GAUGES
+PLAN_GAUGES = (
+    "tpunet_plan_nodes",
+    "tpunet_plan_groups",
+    "tpunet_plan_excluded_nodes",
+    "tpunet_plan_modeled_allreduce_ms",
+)
+# field manager for the planner's writes (plan ConfigMap + node label
+# patches) — distinct from the probe distribution's manager so the two
+# subsystems' server-side-apply ownership never collides
+PLAN_FIELD_MANAGER = "tpunet-operator-planner"
 
 # per-shard fleet rollup gauges ({policy, shard} labels) exported in
 # summary detail mode instead of the per-node PROBE/TELEMETRY families
@@ -306,6 +321,12 @@ def update_tpu_scale_out_daemonset(
             # assigned out-degree (an expectedPeers pinned at fleet size
             # would otherwise mark every sampled node below quorum)
             args.append(f"--probe-degree={so.probe.degree}")
+        if so.planner.enabled:
+            # topology planner: the agent polls the per-policy plan
+            # ConfigMap and folds the plan block into the bootstrap
+            # (all planning knobs are controller-side — the agent only
+            # needs to know to adopt)
+            args.append("--planner=true")
     tl = so.telemetry
     if tl.enabled:
         # counter telemetry is agent-default-on; still project every
@@ -413,6 +434,16 @@ class NetworkClusterPolicyReconciler:
         self._node_racks_seen: FrozenSet[str] = frozenset()
         self._node_racks_missing: FrozenSet[str] = frozenset()
         self._node_racks_at = -1e9
+        # topology planner (planner/): hysteretic plan cache per policy
+        # (shares the probe clock seam so tests/bench drive the hold
+        # window), plus the diff gates that make a steady plan cost
+        # ZERO writes per pass — the last-applied plan-ConfigMap
+        # payload and the last-applied node labels
+        # {policy: {node: (ring_index|None, group|None)}}, both under
+        # _reports_lock like the peer-flush state
+        self._plan_tracker = PlanTracker(clock=self._probe_clock)
+        self._plan_cm_applied: Dict[str, str] = {}
+        self._plan_labels: Dict[str, Dict[str, Any]] = {}
 
     # -- setup ----------------------------------------------------------------
 
@@ -1692,6 +1723,415 @@ class NetworkClusterPolicyReconciler:
                 f"{tstat.nodes_reporting} reporting nodes",
             )
 
+    # -- topology planner (planner/) ------------------------------------------
+
+    @staticmethod
+    def _planner_enabled(policy: NetworkClusterPolicy) -> bool:
+        so = policy.spec.tpu_scale_out
+        return (
+            policy.spec.configuration_type == t.CONFIG_TYPE_TPU_SO
+            and so.planner.enabled
+            # structurally required (the webhook rejects the combo, but
+            # a CR written past it must not plan from an empty matrix)
+            and so.probe.enabled
+        )
+
+    @staticmethod
+    def _plan_inputs(
+        policy: NetworkClusterPolicy,
+        nodes: List[str],
+        reports: List[Any],
+        rows: List[t.NodeProbeStatus],
+        anomalous_nodes: List[str],
+        racks: Dict[str, str],
+    ) -> planner_plan.PlanInputs:
+        """Fold the pass's signals into the planner's canonical input:
+        mesh membership (``nodes``, computed once by the caller), the
+        per-edge RTT matrix from the reports' per-peer probe stats,
+        groups (rack label, else ICI slice from the report's
+        ``ici_topology``), and the exclusion set (probe-degraded or
+        quarantined or telemetry-anomalous — the links to route
+        around)."""
+        node_set = set(nodes)
+        observations: Dict[str, Dict[str, float]] = {}
+        ici_groups: Dict[str, str] = {}
+        for rep in reports:
+            probe = rep.probe if isinstance(rep.probe, dict) else None
+            if probe is not None:
+                peers = probe.get("peers")
+                row: Dict[str, float] = {}
+                if isinstance(peers, dict):
+                    for peer, stats in peers.items():
+                        if not isinstance(stats, dict) \
+                                or not stats.get("reachable"):
+                            continue
+                        ms = stats.get("rttMs")
+                        # strictly positive: 0 is not a physical RTT,
+                        # it is the shape of "no samples" from an agent
+                        # predating the None-when-empty snapshot — and
+                        # a 0 ms edge would beat every real measurement
+                        # in the ring heuristic
+                        if (
+                            isinstance(ms, (int, float))
+                            and not isinstance(ms, bool)
+                            and ms > 0
+                        ):
+                            row[str(peer)] = float(ms)
+                if row:
+                    observations[str(rep.node)] = row
+            ici = getattr(rep, "ici_topology", None)
+            if isinstance(ici, dict):
+                n_slices = ici.get("numSlices")
+                slice_id = ici.get("sliceId")
+                if (
+                    isinstance(n_slices, int) and n_slices > 1
+                    and isinstance(slice_id, int)
+                ):
+                    ici_groups[str(rep.node)] = f"slice-{slice_id}"
+        groups = {}
+        for node in nodes:
+            group = racks.get(node) or ici_groups.get(node, "")
+            if group:
+                groups[node] = group
+        spec = policy.spec.tpu_scale_out.planner
+        excluded = (
+            {r.node for r in rows if r.state in (
+                t.PROBE_STATE_DEGRADED, t.PROBE_STATE_QUARANTINED
+            )}
+            | set(anomalous_nodes)
+        ) & node_set
+        return planner_plan.PlanInputs(
+            nodes=nodes,
+            rtt=planner_plan.build_matrix(observations),
+            groups=groups,
+            excluded=frozenset(excluded),
+            seed=policy.metadata.name,
+            spread_threshold_ms=(
+                spec.spread_threshold_ms
+                or t.DEFAULT_PLAN_SPREAD_THRESHOLD_MS
+            ),
+        )
+
+    def _distribute_plan(
+        self, policy: NetworkClusterPolicy, plan: planner_plan.TopologyPlan
+    ) -> None:
+        """Apply the ``tpunet-plan-<policy>`` ConfigMap, diff-gated
+        against the in-memory last-applied payload (read-back once
+        after a restart) — a steady plan costs zero requests."""
+        import json as json_mod
+
+        from ..agent import report as rpt_mod
+
+        pname = policy.metadata.name
+        cm_name = rpt_mod.plan_configmap_name(pname)
+        payload = json_mod.dumps(plan.to_payload(), sort_keys=True)
+        with self._reports_lock:
+            applied = self._plan_cm_applied.get(pname)
+        if applied == payload:
+            return
+        if applied is None:
+            # restart: re-seed the gate from the cluster instead of
+            # blind-applying (the plan is deterministic, so an
+            # unchanged fleet reproduces the stored payload exactly)
+            try:
+                cur = self.client.get(
+                    "v1", "ConfigMap", cm_name, self.namespace
+                )
+                if (cur.get("data", {}) or {}).get(
+                    rpt_mod.PLAN_KEY
+                ) == payload:
+                    with self._reports_lock:
+                        self._plan_cm_applied[pname] = payload
+                    return
+            except kerr.NotFoundError:
+                pass
+            except Exception as e:   # noqa: BLE001 — apply heals
+                log.debug("plan ConfigMap read failed: %s", e)
+        cm = {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": cm_name, "namespace": self.namespace},
+            "data": {rpt_mod.PLAN_KEY: payload},
+        }
+        self._own(policy, cm)
+        try:
+            self.client.apply(cm, field_manager=PLAN_FIELD_MANAGER)
+            with self._reports_lock:
+                self._plan_cm_applied[pname] = payload
+            log.info(
+                "topology plan distributed: %s (version %s, %d nodes, "
+                "%s collectives)", cm_name, plan.version,
+                len(plan.ring), plan.collective,
+            )
+        except Exception as e:   # noqa: BLE001 — next pass retries
+            log.warning("plan ConfigMap apply failed: %s", e)
+
+    def _current_plan_labels(
+        self, wanted: set
+    ) -> Dict[str, Any]:
+        """Seed the label diff gate from the cluster (informer-served
+        list): {node: (ring_index, group)} for the nodes of interest,
+        values None when the label is absent."""
+        try:
+            list_fn = getattr(self.client, "list_readonly", None) \
+                or self.client.list
+            node_objs = list_fn("v1", "Node", limit=LIST_PAGE_SIZE)
+        except Exception as e:   # noqa: BLE001 — blind apply heals
+            log.debug("node list for plan labels failed: %s", e)
+            return {}
+        current: Dict[str, Any] = {}
+        for obj in node_objs:
+            meta = obj.get("metadata", {}) or {}
+            name = str(meta.get("name", ""))
+            labels = meta.get("labels", {}) or {}
+            ring = labels.get(planner_plan.LABEL_DCN_RING_INDEX)
+            group = labels.get(planner_plan.LABEL_DCN_GROUP)
+            if name in wanted or ring is not None or group is not None:
+                current[name] = (
+                    ring if isinstance(ring, str) else None,
+                    group if isinstance(group, str) else None,
+                )
+        return current
+
+    def _apply_plan_labels(
+        self, policy: NetworkClusterPolicy,
+        plan: planner_plan.TopologyPlan, members: set,
+    ) -> None:
+        """Project the plan onto node labels
+        (``tpunet.dev/dcn-ring-index``, ``tpunet.dev/dcn-group``) —
+        diff-gated against the in-memory last-applied map (seeded from
+        the informer cache after a restart) and batched into one pass,
+        so a steady plan writes ZERO node patches and a replan touches
+        only the nodes whose position actually moved.  Excluded and
+        departed nodes get their labels stripped (None = merge-patch
+        delete) — a quarantined node must stop advertising a ring slot
+        schedulers could pack against."""
+        pname = policy.metadata.name
+        desired: Dict[str, Any] = {
+            node: (str(i), plan.groups.get(node) or None)
+            for i, node in enumerate(plan.ring)
+        }
+        for node in members - set(plan.ring):
+            desired[node] = (None, None)
+        with self._reports_lock:
+            applied = self._plan_labels.get(pname)
+        if applied is None:
+            # restart: re-seed the diff gate from the informer-served
+            # Node list — RESTRICTED to this policy's membership.  A
+            # node outside it carrying plan labels may belong to
+            # another policy's ring; stripping it here would clobber
+            # that policy's plan (the cost: a node that departed THIS
+            # policy across a restart keeps stale labels until a node
+            # or mesh event touches it — safe, the plan ConfigMap is
+            # the authoritative ring).
+            applied = {
+                node: state
+                for node, state in self._current_plan_labels(
+                    set(desired)
+                ).items()
+                if node in desired
+            }
+        # departed nodes this reconciler labeled must be stripped too
+        for node in set(applied) - set(desired):
+            desired[node] = (None, None)
+        writes = 0
+        new_state: Dict[str, Any] = {}
+
+        def remember(node, state):
+            # a MEMBER'S state is always recorded — including a
+            # successful (None, None) strip of an excluded node, or
+            # the gate would forget it and re-issue the strip patch
+            # every pass (breaking the zero-steady-write contract).
+            # Departed non-members drop out once stripped.
+            if node in members or state != (None, None):
+                new_state[node] = state
+
+        for node, want in desired.items():
+            have = applied.get(node)
+            if have == want:
+                remember(node, want)
+                continue
+            patch = {
+                "apiVersion": "v1",
+                "kind": "Node",
+                "metadata": {
+                    "name": node,
+                    "labels": {
+                        planner_plan.LABEL_DCN_RING_INDEX: want[0],
+                        planner_plan.LABEL_DCN_GROUP: want[1],
+                    },
+                },
+            }
+            try:
+                self.client.apply(
+                    patch, field_manager=PLAN_FIELD_MANAGER
+                )
+                writes += 1
+                remember(node, want)
+            except Exception as e:   # noqa: BLE001 — next pass retries
+                log.warning(
+                    "plan label apply failed for node %s: %s", node, e
+                )
+                # keep the previous record (if any) so the next pass
+                # retries exactly this node
+                if have is not None:
+                    remember(node, have)
+        with self._reports_lock:
+            self._plan_labels[pname] = new_state
+        if writes and self.metrics:
+            self.metrics.inc(
+                "tpunet_plan_label_writes_total",
+                {"policy": pname}, writes,
+            )
+        if writes:
+            log.info(
+                "plan labels updated: %d node(s) patched for %s",
+                writes, pname,
+            )
+
+    def _sync_plan(
+        self,
+        policy: NetworkClusterPolicy,
+        reports: List[Any],
+        rows: List[t.NodeProbeStatus],
+        anomalous_nodes: List[str],
+    ) -> Optional[t.PlanStatus]:
+        """One planner pass: fold the measured signals into PlanInputs,
+        let the hysteretic tracker decide whether to replan, and
+        project the decision (ConfigMap + node labels + status rollup +
+        metrics/Events).  Every projection is diff-gated, so a steady
+        plan costs zero writes."""
+        pname = policy.metadata.name
+        nodes = sorted({
+            str(r.node) for r in reports
+            if getattr(r, "probe_endpoint", "")
+        })
+        if not nodes:
+            return None   # no mesh members yet: nothing to plan
+        spec = policy.spec.tpu_scale_out.planner
+        inputs = self._plan_inputs(
+            policy, nodes, reports, rows, anomalous_nodes,
+            self._rack_map(wanted=nodes),
+        )
+        old_version = (
+            policy.status.plan.version if policy.status.plan else ""
+        )
+        plan, recomputed = self._plan_tracker.update(
+            pname, inputs,
+            hold_seconds=(
+                spec.hold_seconds or t.DEFAULT_PLAN_HOLD_SECONDS
+            ),
+            rtt_hysteresis_ms=(
+                spec.rtt_hysteresis_ms
+                or t.DEFAULT_PLAN_RTT_HYSTERESIS_MS
+            ),
+        )
+        self._distribute_plan(policy, plan)
+        self._apply_plan_labels(policy, plan, set(nodes))
+        if self.metrics:
+            if recomputed:
+                self.metrics.inc(
+                    "tpunet_plan_recomputes_total", {"policy": pname}
+                )
+            labels = {"policy": pname}
+            self.metrics.set_gauge(
+                "tpunet_plan_nodes", float(len(plan.ring)), labels
+            )
+            self.metrics.set_gauge(
+                "tpunet_plan_groups",
+                float(len(set(plan.groups.values()))), labels,
+            )
+            self.metrics.set_gauge(
+                "tpunet_plan_excluded_nodes",
+                float(len(plan.excluded)), labels,
+            )
+            self.metrics.set_gauge(
+                "tpunet_plan_modeled_allreduce_ms",
+                plan.modeled_allreduce_ms, labels,
+            )
+        if plan.version != old_version and old_version != "":
+            # edge-gated like every other Event: version flips only on
+            # an actual replan that changed the decisions
+            self._emit(
+                policy, obs_events.TYPE_NORMAL, "TopologyPlanUpdated",
+                f"topology plan {plan.version}: {len(plan.ring)} nodes "
+                f"in the DCN ring, {plan.collective} collectives"
+                + (
+                    f", routing around {len(plan.excluded)} node(s): "
+                    + self._name_list(plan.excluded)
+                    if plan.excluded else ""
+                ),
+            )
+        excluded = plan.excluded
+        if len(excluded) > t.PLAN_STATUS_EXCLUDED_K:
+            excluded = excluded[:t.PLAN_STATUS_EXCLUDED_K] + [
+                f"(+{len(excluded) - t.PLAN_STATUS_EXCLUDED_K} more)"
+            ]
+        return t.PlanStatus(
+            version=plan.version,
+            nodes=len(plan.ring),
+            groups=len(set(plan.groups.values())),
+            excluded=excluded,
+            collective=plan.collective,
+            intra_group_rtt_ms=round(plan.intra_group_rtt_ms, 3),
+            inter_group_rtt_ms=round(plan.inter_group_rtt_ms, 3),
+            modeled_allreduce_ms=round(plan.modeled_allreduce_ms, 3),
+        )
+
+    def _cleanup_plan(
+        self, policy_name: str, members: Optional[set] = None
+    ) -> None:
+        """Planner switched off or CR deleted: strip the plan labels,
+        delete the plan ConfigMap, and drop the tracker/diff state +
+        gauge series (the probe path's one-time-cleanup contract).
+
+        Stripping is scoped to nodes THIS policy labeled: the in-memory
+        applied map, plus — when the caller still knows the policy's
+        membership (the disable edge) — a scan of those members for
+        labels a restarted predecessor left behind.  Never a cluster-
+        wide label sweep: another live policy's ring labels must
+        survive this policy's teardown."""
+        from ..agent import report as rpt_mod
+
+        with self._reports_lock:
+            known = dict(self._plan_labels.pop(policy_name, {}) or {})
+            self._plan_cm_applied.pop(policy_name, None)
+        self._plan_tracker.forget(policy_name)
+        labeled = set(known)
+        if members:
+            for node, state in self._current_plan_labels(
+                set(members)
+            ).items():
+                if node in members and state != (None, None):
+                    labeled.add(node)
+        for node in sorted(labeled):
+            try:
+                self.client.apply({
+                    "apiVersion": "v1",
+                    "kind": "Node",
+                    "metadata": {
+                        "name": node,
+                        "labels": {
+                            planner_plan.LABEL_DCN_RING_INDEX: None,
+                            planner_plan.LABEL_DCN_GROUP: None,
+                        },
+                    },
+                }, field_manager=PLAN_FIELD_MANAGER)
+            except Exception as e:   # noqa: BLE001 — already gone is fine
+                log.debug("plan label strip: %s", e)
+        try:
+            self.client.delete(
+                "v1", "ConfigMap",
+                rpt_mod.plan_configmap_name(policy_name), self.namespace,
+            )
+        except Exception as e:   # noqa: BLE001 — already gone is fine
+            log.debug("plan ConfigMap delete: %s", e)
+        if self.metrics:
+            for gauge in PLAN_GAUGES:
+                self.metrics.remove_gauge(
+                    gauge, {"policy": policy_name}
+                )
+
     # -- scale: bounded status + per-shard summary ----------------------------
 
     # cap on status.summary.shards rows: fine-grained racks (10k nodes
@@ -1912,6 +2352,7 @@ class NetworkClusterPolicyReconciler:
         old_telemetry = am.to_dict(policy.status.telemetry)
         old_versions = dict(policy.status.agent_versions)
         old_summary = am.to_dict(policy.status.summary)
+        old_plan = am.to_dict(policy.status.plan)
         # reaching a status pass IS a successful reconcile: clear any
         # ReconcileDegraded condition a past permanent failure parked
         # here (the conditions diff below flushes the change)
@@ -2061,6 +2502,36 @@ class NetworkClusterPolicyReconciler:
                 if c.type != t.CONDITION_TELEMETRY_DEGRADED
             ]
 
+        # topology planner: measured matrix -> ring + labels + plan
+        # ConfigMap + status rollup.  Entirely skipped when the policy
+        # does not plan; the disable edge strips labels/ConfigMap once
+        # (the probe path's cleanup contract).
+        if self._planner_enabled(policy) and rows is not None:
+            policy.status.plan = self._sync_plan(
+                policy, reports, rows, anomalous_nodes
+            )
+        else:
+            # the edge gate must also see IN-MEMORY planner state: a
+            # membership blackout (every report Lease expired) nulls
+            # status.plan while labels/ConfigMap/tracker state live on,
+            # and status alone would disarm this cleanup forever
+            pname = policy.metadata.name
+            with self._reports_lock:
+                planned = bool(
+                    self._plan_labels.get(pname)
+                    or self._plan_cm_applied.get(pname)
+                )
+            if (
+                policy.status.plan is not None
+                or planned
+                or self._plan_tracker.current(pname) is not None
+            ):
+                self._cleanup_plan(
+                    pname,
+                    members={str(r.node) for r in reports},
+                )
+            policy.status.plan = None
+
         # fleet version skew: agent package version -> node count (from
         # whatever version stamp each report carries; "" = pre-field
         # agents, not counted)
@@ -2106,6 +2577,7 @@ class NetworkClusterPolicyReconciler:
             or am.to_dict(policy.status.telemetry) != old_telemetry
             or policy.status.agent_versions != old_versions
             or am.to_dict(policy.status.summary) != old_summary
+            or am.to_dict(policy.status.plan) != old_plan
         )
         policy.status.targets = targets
         policy.status.ready_nodes = ready
@@ -2156,6 +2628,16 @@ class NetworkClusterPolicyReconciler:
                 for gauge in TELEMETRY_GAUGES:
                     self.metrics.remove_matching(gauge, {"policy": name})
             self._prune_probe_state(name)
+            # the plan ConfigMap is owner-GC'd with the CR, but the
+            # node labels outlive it unless stripped here.  Membership
+            # comes from the policy's report Leases (agent-owned, so
+            # they linger past the CR delete) — after a controller
+            # restart the in-memory applied map is empty and the
+            # member scan is the only way to find the labeled nodes.
+            self._cleanup_plan(
+                name,
+                members={str(r.node) for r in self._agent_reports(name)},
+            )
             return Result()
         policy = NetworkClusterPolicy.from_dict(raw)
 

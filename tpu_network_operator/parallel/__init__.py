@@ -13,10 +13,13 @@ the (outermost) inter-slice axis.
 
 from .mesh import (  # noqa: F401
     MeshPlan,
+    dcn_collective,
     distributed_init_from_bootstrap,
     make_mesh,
     mesh_from_bootstrap,
     plan_axes,
+    planned_axis_order,
+    planned_ring_index,
 )
 from .pipeline import (  # noqa: F401
     make_moe_pipeline_train_step,

@@ -148,3 +148,55 @@ def sweep(
 
 def peak_busbw(results: List[CollectiveResult]) -> float:
     return max((r.busbw_gbps for r in results), default=0.0)
+
+
+# -- DCN collective strategy (operator topology-plan consumption) -------------
+#
+# The operator's planner hints ring vs hierarchical for the gradient
+# all-reduce that spans DCN (parallel/mesh.py dcn_collective reads the
+# hint off the bootstrap's plan block).  The operation is the data-
+# parallel gradient sync: sum every replica's contribution across BOTH
+# the intra-group axis (ICI-local replicas) and the cross-group DCN
+# axis.  ``ring`` is one fused psum over both axes (XLA's flat rings —
+# the pre-planner behavior); ``hierarchical`` decomposes it as
+# reduce-scatter over ICI → all-reduce of the 1/k shard over DCN →
+# all-gather back over ICI, so every slow cross-group hop moves 1/k of
+# the payload instead of all of it — which wins exactly when the
+# measured inter-group RTT sits far above intra-group (the spread the
+# planner keys the hint on).  Both forms compute the same sum; the
+# hint only picks the decomposition.
+
+def dcn_all_reduce(x, dcn_axis: str, ici_axis: Optional[str] = None,
+                   strategy: str = "ring"):
+    """Gradient-sync all-reduce inside a shard_map body, decomposed per
+    the plan's strategy (see above).  Without an ``ici_axis`` there is
+    nothing to decompose over and both strategies are the flat psum."""
+    if not ici_axis:
+        return jax.lax.psum(x, dcn_axis)
+    if strategy == "hierarchical":
+        x = jax.lax.psum_scatter(x, ici_axis, tiled=True)
+        x = jax.lax.psum(x, dcn_axis)
+        return jax.lax.all_gather(x, ici_axis, tiled=True)
+    return jax.lax.psum(x, (ici_axis, dcn_axis))
+
+
+def make_dcn_all_reduce(mesh: Mesh, dcn_axis: str = "data",
+                        ici_axis: str = "fsdp", strategy: str = "ring"):
+    """JIT-compiled whole-array gradient all-reduce over ``mesh`` using
+    the planned strategy — workloads call it with
+    ``strategy=dcn_collective(bootstrap_cfg)`` (parallel/mesh.py).
+    Input is sharded over (dcn, ici) — each device contributes its own
+    block — and the output carries the elementwise total in every
+    block, so both strategies produce identical global arrays."""
+    if strategy == "hierarchical" and mesh.shape.get(ici_axis, 1) <= 1:
+        # nothing to scatter over: the decomposition degenerates to the
+        # flat form — never emit a 1-way scatter/gather pair
+        strategy = "ring"
+
+    def body(x):
+        return dcn_all_reduce(x, dcn_axis, ici_axis, strategy)
+
+    spec = P((dcn_axis, ici_axis))
+    return jax.jit(shard_map(
+        body, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False,
+    ))

@@ -69,6 +69,7 @@ def plan_axes(
     fsdp: Optional[int] = None,
     data: Optional[int] = None,
     dcn_slices: int = 1,
+    axis_order: Optional[Sequence[str]] = None,
 ) -> MeshPlan:
     """Fill unset axes so the product covers all devices.
 
@@ -76,6 +77,10 @@ def plan_axes(
     given (model-imposed); ``fsdp`` defaults to the remaining intra-slice
     factor; ``data`` absorbs whatever is left (including the DCN slice
     axis).
+
+    ``axis_order`` reorders the mesh axes (a permutation of ``AXES``) —
+    the operator's topology-plan hint; default is the bandwidth-
+    hierarchy order of the module docstring.
     """
     fixed = tensor * seq * expert * pipe
     if n_devices % fixed != 0:
@@ -105,10 +110,23 @@ def plan_axes(
         raise ValueError(
             f"data axis {data} not divisible by dcn_slices {dcn_slices}"
         )
-    return MeshPlan({
+    sizes = {
         "data": data, "fsdp": fsdp, "pipe": pipe, "expert": expert,
         "seq": seq, "tensor": tensor,
-    })
+    }
+    order = validate_axis_order(axis_order) if axis_order else AXES
+    return MeshPlan({name: sizes[name] for name in order})
+
+
+def validate_axis_order(order: Sequence[str]) -> Tuple[str, ...]:
+    """An axis order must be a permutation of ``AXES`` — anything else
+    (operator version skew, a mangled plan payload) is an error here,
+    not a silently misshaped mesh."""
+    if sorted(order) != sorted(AXES):
+        raise ValueError(
+            f"axis order {list(order)!r} is not a permutation of {AXES}"
+        )
+    return tuple(order)
 
 
 def make_mesh(
@@ -132,6 +150,58 @@ def make_mesh(
     return Mesh(arr, plan.names)
 
 
+# -- operator topology-plan consumption ---------------------------------------
+#
+# The operator's planner (tpu_network_operator/planner/) distributes a
+# plan block the agent folds into the bootstrap file: DCN ring order,
+# a suggested mesh axis ordering, and a ring-vs-hierarchical DCN
+# collective hint keyed on the measured inter-group RTT spread.  These
+# helpers are the consuming end; every one of them degrades to the
+# pre-planner behavior when the block is absent (planner disabled, or
+# an older agent wrote the bootstrap — the version-skew contract).
+
+COLLECTIVE_RING = "ring"
+COLLECTIVE_HIERARCHICAL = "hierarchical"
+
+
+def plan_block(cfg: BootstrapConfig) -> Dict:
+    """The bootstrap's plan block, ``{}`` when absent/malformed."""
+    plan = getattr(cfg, "plan", None)
+    return plan if isinstance(plan, dict) else {}
+
+
+def planned_axis_order(cfg: BootstrapConfig) -> Tuple[str, ...]:
+    """The plan's suggested mesh axis ordering, validated; the default
+    bandwidth-hierarchy order when the block is absent or the hint is
+    not a permutation of ``AXES`` (never let a mangled payload misshape
+    the mesh)."""
+    order = plan_block(cfg).get("meshAxisOrder")
+    if not isinstance(order, (list, tuple)):
+        return AXES
+    try:
+        return validate_axis_order([str(a) for a in order])
+    except ValueError:
+        return AXES
+
+
+def dcn_collective(cfg: BootstrapConfig) -> str:
+    """The plan's DCN collective strategy hint: ``hierarchical`` when
+    the operator measured the inter-group RTT spread past the policy's
+    threshold, else ``ring`` (also the no-plan fallback)."""
+    hint = plan_block(cfg).get("collective")
+    return (
+        COLLECTIVE_HIERARCHICAL
+        if hint == COLLECTIVE_HIERARCHICAL else COLLECTIVE_RING
+    )
+
+
+def planned_ring_index(cfg: BootstrapConfig) -> int:
+    """This host's position in the planned DCN ring (stamped by the
+    agent when it adopted the plan); -1 when unplanned/excluded."""
+    idx = plan_block(cfg).get("ringIndex", -1)
+    return idx if isinstance(idx, int) and not isinstance(idx, bool) else -1
+
+
 def mesh_from_bootstrap(
     cfg: BootstrapConfig,
     *,
@@ -144,13 +214,17 @@ def mesh_from_bootstrap(
     """Build the job mesh from the operator-emitted bootstrap config.
 
     Multislice: the DCN (slice) factor folds into the leading ``data`` axis,
-    keeping every inner axis intra-slice (pure ICI).
+    keeping every inner axis intra-slice (pure ICI).  When the operator
+    distributed a topology plan, its suggested axis ordering is honored
+    (see :func:`planned_axis_order`); absent a plan the default
+    bandwidth-hierarchy order applies unchanged.
     """
     topo = cfg.topology
     have_topo = topo is not None and topo.num_chips > 0
     n = (topo.num_chips * topo.num_slices) if have_topo else len(jax.devices())
     plan = plan_axes(n, tensor=tensor, seq=seq, expert=expert, pipe=pipe,
-                     dcn_slices=topo.num_slices if have_topo else 1)
+                     dcn_slices=topo.num_slices if have_topo else 1,
+                     axis_order=planned_axis_order(cfg))
     return make_mesh(plan, devices)
 
 

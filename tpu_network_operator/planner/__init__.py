@@ -1,0 +1,37 @@
+"""Topology planner: measured network → placement + collective hints.
+
+The operator owns data nobody else in the cluster has — the probe
+mesh's per-edge RTT/loss matrix (probe/), the ICI slice shape each
+agent discovers (agent/tpu/topology.py), rack assignments
+(probe/topology.py) and the telemetry anomaly state (agent/telemetry).
+This package closes the loop: it turns those signals into
+
+* a DCN ring ordering (low-RTT nodes adjacent, degraded/quarantined
+  nodes routed around) via a deterministic seeded heuristic;
+* scheduler-consumable node labels (``tpunet.dev/dcn-ring-index``,
+  ``tpunet.dev/dcn-group``);
+* an enriched ``jax.distributed`` bootstrap plan block (ring order,
+  suggested mesh axis ordering, ring-vs-hierarchical collective hint)
+  that ``agent/tpu/bootstrap.py`` writes and ``parallel/mesh.py``
+  consumes.
+
+Grounding: TopoOpt (arXiv 2202.00433 — co-optimizing the network
+topology with the parallelization strategy) and DELTA's logical-
+topology optimization (PAPERS.md).
+"""
+
+from .plan import (  # noqa: F401
+    COLLECTIVE_HIERARCHICAL,
+    COLLECTIVE_RING,
+    DEFAULT_PLAN_HOLD_SECONDS,
+    DEFAULT_RTT_HYSTERESIS_MS,
+    DEFAULT_SPREAD_THRESHOLD_MS,
+    LABEL_DCN_GROUP,
+    LABEL_DCN_RING_INDEX,
+    PlanInputs,
+    TopologyPlan,
+    compute_plan,
+    modeled_allreduce_ms,
+    ring_cost_ms,
+)
+from .tracker import PlanTracker  # noqa: F401

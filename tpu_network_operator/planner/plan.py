@@ -1,0 +1,431 @@
+"""Pure planning core: RTT matrix + groups + exclusions → TopologyPlan.
+
+Everything here is deterministic and seeded (the probe/topology.py
+contract): the same inputs must produce the same plan across reconciler
+restarts and leader failovers, or every failover would roll the DCN
+ring, churn the node labels, and invalidate every job's bootstrap plan
+block at once.  No RNG state, no wall clock.
+
+The ring heuristic is greedy nearest-neighbor + bounded 2-opt
+refinement over the measured RTT matrix:
+
+1. nodes are bucketed by group (rack / ICI slice); groups are chained
+   greedily by their cheapest measured inter-group edge;
+2. within each group, nodes chain greedily from a seeded start by
+   lowest measured RTT (missing edges cost ``DEFAULT_RTT_MS`` — the
+   planner prefers edges it has actually measured);
+3. the concatenated ring gets 2-opt passes (segment reversal whenever
+   it shortens the ring) while the fleet is small enough for O(n²)
+   refinement to be worth the cycles (``TWO_OPT_MAX_NODES``).
+
+The modeled objective is the latency term of a pipelined ring
+all-reduce: every chunk traverses each ring hop once per phase
+(reduce-scatter + all-gather), so completion time scales with the ring
+perimeter — the sum of per-hop RTTs.  Minimizing the perimeter is
+what "group low-RTT nodes adjacently" means, made precise.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Mapping, Tuple
+
+from ..probe.prober import quantile
+from ..probe.topology import stable_hash
+
+# node labels the reconciler applies from the plan — the scheduler-
+# consumable surface (gang schedulers / device plugins can pack jobs by
+# ring adjacency without talking to the operator)
+LABEL_DCN_RING_INDEX = "tpunet.dev/dcn-ring-index"
+LABEL_DCN_GROUP = "tpunet.dev/dcn-group"
+
+# DCN collective strategies the plan can hint (parallel/mesh.py picks
+# the matching decomposition in parallel/collectives.py)
+COLLECTIVE_RING = "ring"
+COLLECTIVE_HIERARCHICAL = "hierarchical"
+
+# RTT assumed for an unmeasured edge (ms).  Deliberately far above any
+# realistic DCN RTT so the heuristic prefers measured edges — under the
+# sampled probe topology most pairs are unmeasured, and the ring should
+# follow the edges the mesh actually validated.
+DEFAULT_RTT_MS = 50.0
+
+# 2-opt refinement bound: O(n²) per pass is worth it for the fleets
+# where ring order matters most (tens to a few hundred nodes); past
+# this the grouped greedy chain alone carries the structure and a 4M-
+# comparison pass per recompute would dominate the reconcile.
+TWO_OPT_MAX_NODES = 512
+TWO_OPT_MAX_PASSES = 6
+
+# greedy nearest-neighbor bound per group: a single unlabeled
+# multi-thousand-node "group" falls back to seeded-hash order instead
+# of an O(n²) scan (the 2-opt bound's rationale, one level down)
+GREEDY_MAX_GROUP = 2048
+
+# hysteresis defaults (spec knobs `tpuScaleOut.planner.*`; the webhook
+# pins them on enable, the tracker enforces them):
+# an RTT edge must move at least this far from the matrix snapshot the
+# current plan was computed from before a replan is even considered —
+# per-round probe jitter must never churn labels
+DEFAULT_RTT_HYSTERESIS_MS = 1.0
+# minimum seconds between RTT-driven replans (structural changes —
+# membership, exclusions, groups — bypass the hold: a quarantined node
+# must be planned around within one reconcile)
+DEFAULT_PLAN_HOLD_SECONDS = 60
+# inter-group minus intra-group median RTT (ms) past which the plan
+# hints hierarchical DCN collectives instead of one flat ring
+DEFAULT_SPREAD_THRESHOLD_MS = 2.0
+
+# the canonical mesh axis order (parallel/mesh.py AXES) the plan
+# suggests; kept as a literal here so the operator/agent side never
+# imports jax
+MESH_AXES = ("data", "fsdp", "pipe", "expert", "seq", "tensor")
+
+Edge = Tuple[str, str]
+
+
+def edge_key(a: str, b: str) -> Edge:
+    """Canonical undirected edge key (the matrix is stored symmetric)."""
+    return (a, b) if a <= b else (b, a)
+
+
+def build_matrix(
+    observations: Mapping[str, Mapping[str, float]]
+) -> Dict[Edge, float]:
+    """Fold per-node per-peer RTT observations (``{node: {peer: ms}}``)
+    into the canonical symmetric matrix, averaging the two directions
+    when both probed each other."""
+    sums: Dict[Edge, float] = {}
+    counts: Dict[Edge, int] = {}
+    for node, row in observations.items():
+        for peer, ms in row.items():
+            if node == peer or not isinstance(ms, (int, float)) \
+                    or isinstance(ms, bool) or ms <= 0:
+                # 0 is "no samples yet", not a measurement — admitting
+                # it would make the unprobed edge the cheapest in the
+                # fleet instead of costing DEFAULT_RTT_MS
+                continue
+            key = edge_key(str(node), str(peer))
+            sums[key] = sums.get(key, 0.0) + float(ms)
+            counts[key] = counts.get(key, 0) + 1
+    return {k: sums[k] / counts[k] for k in sums}
+
+
+def edge_rtt(rtt: Mapping[Edge, float], a: str, b: str) -> float:
+    return rtt.get(edge_key(a, b), DEFAULT_RTT_MS)
+
+
+@dataclass
+class PlanInputs:
+    """Everything the planner consumes, in canonical form."""
+
+    nodes: List[str]                       # mesh membership (sorted)
+    rtt: Dict[Edge, float] = field(default_factory=dict)
+    groups: Dict[str, str] = field(default_factory=dict)
+    excluded: FrozenSet[str] = frozenset()  # degraded/quarantined/anomalous
+    seed: str = ""                          # policy name (restart-stable)
+    spread_threshold_ms: float = DEFAULT_SPREAD_THRESHOLD_MS
+
+
+@dataclass
+class TopologyPlan:
+    """The planner's output — one self-contained, versioned artifact.
+
+    ``version`` fingerprints the *decisions* (ring order, groups,
+    exclusions, collective, axis order), not the raw RTTs, so a jitter-
+    driven recompute that lands on the same ring keeps the same version
+    and nothing downstream churns."""
+
+    version: str = ""
+    ring: List[str] = field(default_factory=list)
+    groups: Dict[str, str] = field(default_factory=dict)
+    excluded: List[str] = field(default_factory=list)
+    collective: str = COLLECTIVE_RING
+    mesh_axis_order: List[str] = field(default_factory=lambda: list(MESH_AXES))
+    intra_group_rtt_ms: float = 0.0
+    inter_group_rtt_ms: float = 0.0
+    modeled_allreduce_ms: float = 0.0
+
+    def ring_index(self, node: str) -> int:
+        try:
+            return self.ring.index(node)
+        except ValueError:
+            return -1
+
+    def to_payload(self) -> Dict:
+        """Wire form (camelCase, the CRD convention) — the ONE schema
+        carried by both the ``tpunet-plan-<policy>`` ConfigMap and the
+        bootstrap file's ``plan`` block."""
+        return {
+            "version": self.version,
+            "ring": list(self.ring),
+            "groups": dict(self.groups),
+            "excluded": list(self.excluded),
+            "collective": self.collective,
+            "meshAxisOrder": list(self.mesh_axis_order),
+            "intraGroupRttMs": round(self.intra_group_rtt_ms, 3),
+            "interGroupRttMs": round(self.inter_group_rtt_ms, 3),
+            "modeledAllreduceMs": round(self.modeled_allreduce_ms, 3),
+        }
+
+    @classmethod
+    def from_payload(cls, d: Mapping) -> "TopologyPlan":
+        """Tolerant parse (payloads come from the cluster: any operator
+        version, possibly mangled).  Raises ValueError on a payload too
+        broken to act on — callers keep their last known plan."""
+        if not isinstance(d, Mapping):
+            raise ValueError("plan payload must be an object")
+        ring = d.get("ring", [])
+        if not isinstance(ring, list) or not all(
+            isinstance(n, str) for n in ring
+        ):
+            raise ValueError("plan ring must be a string list")
+        groups = d.get("groups", {})
+        if not isinstance(groups, Mapping):
+            raise ValueError("plan groups must be an object")
+        order = d.get("meshAxisOrder", list(MESH_AXES))
+        if not isinstance(order, list):
+            order = list(MESH_AXES)
+        collective = d.get("collective", COLLECTIVE_RING)
+        if collective not in (COLLECTIVE_RING, COLLECTIVE_HIERARCHICAL):
+            collective = COLLECTIVE_RING
+        excluded = d.get("excluded", [])
+        if not isinstance(excluded, list):
+            excluded = []
+
+        def num(key):
+            v = d.get(key, 0.0)
+            return float(v) if isinstance(v, (int, float)) \
+                and not isinstance(v, bool) else 0.0
+
+        return cls(
+            version=str(d.get("version", "")),
+            ring=[str(n) for n in ring],
+            groups={str(k): str(v) for k, v in groups.items()},
+            excluded=[str(n) for n in excluded if isinstance(n, str)],
+            collective=collective,
+            mesh_axis_order=[str(a) for a in order],
+            intra_group_rtt_ms=num("intraGroupRttMs"),
+            inter_group_rtt_ms=num("interGroupRttMs"),
+            modeled_allreduce_ms=num("modeledAllreduceMs"),
+        )
+
+
+# -- ring construction --------------------------------------------------------
+
+
+def _greedy_chain(
+    members: List[str], rtt: Mapping[Edge, float], seed: str
+) -> List[str]:
+    """Greedy nearest-neighbor chain within one group, from a seeded
+    start node.  Falls back to seeded-hash order past GREEDY_MAX_GROUP
+    (see the constant's rationale)."""
+    if len(members) <= 2:
+        return sorted(members, key=lambda n: (stable_hash(seed + "|" + n), n))
+    if len(members) > GREEDY_MAX_GROUP:
+        return sorted(members, key=lambda n: (stable_hash(seed + "|" + n), n))
+    start = min(members, key=lambda n: (stable_hash(seed + "|" + n), n))
+    chain = [start]
+    remaining = set(members) - {start}
+    while remaining:
+        cur = chain[-1]
+        nxt = min(remaining, key=lambda n: (edge_rtt(rtt, cur, n), n))
+        chain.append(nxt)
+        remaining.discard(nxt)
+    return chain
+
+
+def _order_groups(
+    chains: Dict[str, List[str]], rtt: Mapping[Edge, float], seed: str
+) -> List[str]:
+    """Chain the groups themselves greedily: next group = the one whose
+    cheapest measured edge to the current chain tail is lowest, so the
+    ring crosses groups over the best links the probes found."""
+    names = sorted(chains)
+    if len(names) <= 1:
+        return names
+    start = min(names, key=lambda g: (stable_hash(seed + "#" + g), g))
+    order = [start]
+    remaining = set(names) - {start}
+    while remaining:
+        tail = chains[order[-1]][-1]
+        nxt = min(
+            remaining,
+            key=lambda g: (
+                min(edge_rtt(rtt, tail, m) for m in chains[g]), g
+            ),
+        )
+        order.append(nxt)
+        remaining.discard(nxt)
+    return order
+
+
+def ring_cost_ms(ring: List[str], rtt: Mapping[Edge, float]) -> float:
+    """Ring perimeter: sum of consecutive-pair RTTs including the wrap."""
+    n = len(ring)
+    if n < 2:
+        return 0.0
+    return sum(edge_rtt(rtt, ring[i], ring[(i + 1) % n]) for i in range(n))
+
+
+def modeled_allreduce_ms(ring: List[str], rtt: Mapping[Edge, float]) -> float:
+    """Latency term of a pipelined ring all-reduce over the DCN ring:
+    each chunk crosses every hop once per phase (reduce-scatter +
+    all-gather), i.e. 2 × Σ(one-way hop latency) = Σ(hop RTT) — the
+    ring perimeter.  A bandwidth term would add a constant independent
+    of ordering, so the perimeter is the part planning can move."""
+    return ring_cost_ms(ring, rtt)
+
+
+def _two_opt(
+    ring: List[str], rtt: Mapping[Edge, float]
+) -> List[str]:
+    """Bounded deterministic 2-opt: reverse any segment whose endpoints
+    swap shortens the ring; repeat until a full pass finds nothing (or
+    the pass budget runs out).  First-improvement in fixed scan order —
+    no RNG, so restarts agree."""
+    n = len(ring)
+    if n < 4 or n > TWO_OPT_MAX_NODES:
+        return ring
+    ring = list(ring)
+    for _ in range(TWO_OPT_MAX_PASSES):
+        improved = False
+        for i in range(n - 1):
+            a, b = ring[i], ring[i + 1]
+            d_ab = edge_rtt(rtt, a, b)
+            for j in range(i + 2, n):
+                c, d = ring[j], ring[(j + 1) % n]
+                if a == d:
+                    continue   # wrap edge adjacent to (a,b)
+                delta = (
+                    edge_rtt(rtt, a, c) + edge_rtt(rtt, b, d)
+                    - d_ab - edge_rtt(rtt, c, d)
+                )
+                if delta < -1e-9:
+                    ring[i + 1:j + 1] = reversed(ring[i + 1:j + 1])
+                    improved = True
+                    a, b = ring[i], ring[i + 1]
+                    d_ab = edge_rtt(rtt, a, b)
+        if not improved:
+            break
+    return ring
+
+
+def _collective_hint(
+    ring: List[str],
+    groups: Mapping[str, str],
+    rtt: Mapping[Edge, float],
+    spread_threshold_ms: float,
+) -> Tuple[str, float, float]:
+    """(collective, intra_ms, inter_ms): hierarchical when the measured
+    inter-group RTT sits far enough above intra-group — a flat DCN ring
+    then serializes slow cross-group hops into every chunk's path,
+    while reduce-scatter-inside / all-reduce-across pays them once on
+    1/k of the data."""
+    intra: List[float] = []
+    inter: List[float] = []
+    in_ring = set(ring)
+    for (a, b), ms in rtt.items():
+        if a not in in_ring or b not in in_ring:
+            continue
+        ga, gb = groups.get(a, ""), groups.get(b, "")
+        if ga and ga == gb:
+            intra.append(ms)
+        elif ga != gb and ga and gb:
+            inter.append(ms)
+
+    intra_ms = quantile(sorted(intra), 0.5)
+    inter_ms = quantile(sorted(inter), 0.5)
+    n_groups = len({groups.get(n, "") for n in ring if groups.get(n, "")})
+    # both medians need evidence: an empty intra sample (possible under
+    # sampled probing when no same-group pair probes each other) reads
+    # as 0.0 and would manufacture the full inter_ms as "spread"
+    hierarchical = (
+        n_groups > 1
+        and bool(inter)
+        and bool(intra)
+        and inter_ms - intra_ms >= spread_threshold_ms
+    )
+    return (
+        COLLECTIVE_HIERARCHICAL if hierarchical else COLLECTIVE_RING,
+        intra_ms,
+        inter_ms,
+    )
+
+
+def suggest_axis_order(groups: Mapping[str, str]) -> List[str]:
+    """The mesh-axis ordering the measured topology supports — the one
+    ordering decision the DCN matrix can actually inform is which axis
+    sits outermost (slowest-varying = process-major = the axis whose
+    collectives cross DCN):
+
+    * **multi-group** fabrics (racks / ICI slices with a slow tier
+      between them) keep ``data`` outermost with ``fsdp`` adjacent —
+      exactly the (dcn, ici) axis pair the hierarchical all-reduce
+      decomposition scatters/gathers over;
+    * a **single-group** fabric has no slow tier — the measured DCN is
+      flat — so the plan promotes ``fsdp`` outermost: parameter
+      all-gather/reduce-scatter is the dominant cross-host traffic in
+      that regime and deserves the process-major placement, while the
+      adjacent ``data`` axis still carries the (smaller) gradient
+      psum.
+    """
+    n_groups = len(set(groups.values()))
+    if n_groups <= 1:
+        return ["fsdp", "data", "pipe", "expert", "seq", "tensor"]
+    return list(MESH_AXES)
+
+
+def plan_version(
+    ring: List[str],
+    groups: Mapping[str, str],
+    excluded: List[str],
+    collective: str,
+    mesh_axis_order: List[str],
+) -> str:
+    """Fingerprint of the plan's decisions (NOT the raw RTTs — see
+    TopologyPlan.version)."""
+    blob = json.dumps(
+        [list(ring), dict(groups), sorted(excluded), collective,
+         list(mesh_axis_order)],
+        sort_keys=True,
+    )
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
+def compute_plan(inputs: PlanInputs) -> TopologyPlan:
+    """The planner: deterministic ring + labels + collective hint."""
+    eligible = sorted(n for n in inputs.nodes if n not in inputs.excluded)
+    excluded = sorted(
+        n for n in inputs.nodes if n in inputs.excluded
+    )
+    groups = {
+        n: inputs.groups[n] for n in eligible if inputs.groups.get(n)
+    }
+    chains = {}
+    by_group: Dict[str, List[str]] = {}
+    for node in eligible:
+        by_group.setdefault(groups.get(node, ""), []).append(node)
+    for gname, members in by_group.items():
+        chains[gname] = _greedy_chain(members, inputs.rtt, inputs.seed)
+    ring: List[str] = []
+    for gname in _order_groups(chains, inputs.rtt, inputs.seed):
+        ring.extend(chains[gname])
+    ring = _two_opt(ring, inputs.rtt)
+    collective, intra_ms, inter_ms = _collective_hint(
+        ring, groups, inputs.rtt, inputs.spread_threshold_ms
+    )
+    order = suggest_axis_order(groups)
+    return TopologyPlan(
+        version=plan_version(ring, groups, excluded, collective, order),
+        ring=ring,
+        groups=groups,
+        excluded=excluded,
+        collective=collective,
+        mesh_axis_order=order,
+        intra_group_rtt_ms=intra_ms,
+        inter_group_rtt_ms=inter_ms,
+        modeled_allreduce_ms=modeled_allreduce_ms(ring, inputs.rtt),
+    )

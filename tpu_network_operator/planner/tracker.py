@@ -1,0 +1,121 @@
+"""PlanTracker: the hysteresis between measurement jitter and replans.
+
+The probe mesh re-measures every edge every round; feeding raw RTTs
+straight into the ring heuristic would recompute (and potentially
+re-label the fleet) on every reconcile.  The tracker holds, per policy,
+the matrix snapshot the current plan was computed FROM and replans only
+when the change is worth acting on:
+
+* **structural** changes — membership, group assignment, the exclusion
+  set (a node went degraded/quarantined/anomalous, or recovered) —
+  replan immediately: routing around a dead link is the whole point
+  and must land within one reconcile of quarantine;
+* **RTT drift** replans only when some edge moved beyond the
+  hysteresis threshold vs the snapshot AND the hold window since the
+  last replan has expired — pure jitter (every edge within the
+  threshold) never replans, and even a real drift replans at most once
+  per hold window.
+
+State is in-memory only: after a restart the first update() computes a
+plan from scratch, and because the heuristic is deterministic and
+seeded, an unchanged fleet reproduces the SAME plan (same version) —
+restart costs zero label churn.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from .plan import (
+    DEFAULT_PLAN_HOLD_SECONDS,
+    DEFAULT_RTT_HYSTERESIS_MS,
+    PlanInputs,
+    TopologyPlan,
+    compute_plan,
+)
+
+
+@dataclass
+class _PolicyState:
+    plan: TopologyPlan
+    inputs: PlanInputs          # the snapshot the plan was computed from
+    computed_at: float
+
+
+def significant_rtt_drift(
+    old: Dict, new: Dict, hysteresis_ms: float
+) -> bool:
+    """True when any edge (union of both matrices) moved more than
+    ``hysteresis_ms`` between the snapshots.  A missing edge compares
+    against the other side's value at the full delta — an edge
+    appearing or vanishing IS a real change, while jitter on a stable
+    edge set stays under the threshold."""
+    for key in old.keys() | new.keys():
+        a, b = old.get(key), new.get(key)
+        if a is None or b is None:
+            return True
+        if abs(a - b) > hysteresis_ms:
+            return True
+    return False
+
+
+class PlanTracker:
+    """Per-policy hysteretic plan cache.  Thread-safe: concurrent
+    reconcile workers never run ONE policy concurrently (workqueue
+    contract) but the dict spans policies — same locking rationale as
+    the reconciler's probe bookkeeping.  ``clock`` is a test seam
+    (monotonic: an NTP step must not open or freeze the hold window)."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state: Dict[str, _PolicyState] = {}
+
+    def current(self, policy: str) -> Optional[TopologyPlan]:
+        with self._lock:
+            st = self._state.get(policy)
+            return st.plan if st else None
+
+    def forget(self, policy: str) -> None:
+        with self._lock:
+            self._state.pop(policy, None)
+
+    def update(
+        self,
+        policy: str,
+        inputs: PlanInputs,
+        hold_seconds: float = DEFAULT_PLAN_HOLD_SECONDS,
+        rtt_hysteresis_ms: float = DEFAULT_RTT_HYSTERESIS_MS,
+    ) -> Tuple[TopologyPlan, bool]:
+        """``(plan, recomputed)``: the plan to act on this pass and
+        whether it was recomputed (callers gate Events/metrics on it;
+        note a recompute can still land on the same version)."""
+        now = self._clock()
+        with self._lock:
+            st = self._state.get(policy)
+        if st is not None:
+            prev = st.inputs
+            structural = (
+                prev.nodes != inputs.nodes
+                or prev.groups != inputs.groups
+                or prev.excluded != inputs.excluded
+                or prev.seed != inputs.seed
+                or prev.spread_threshold_ms != inputs.spread_threshold_ms
+            )
+            if not structural:
+                if (
+                    now - st.computed_at < hold_seconds
+                    or not significant_rtt_drift(
+                        prev.rtt, inputs.rtt, rtt_hysteresis_ms
+                    )
+                ):
+                    return st.plan, False
+        plan = compute_plan(inputs)
+        with self._lock:
+            self._state[policy] = _PolicyState(
+                plan=plan, inputs=inputs, computed_at=now
+            )
+        return plan, True

@@ -189,6 +189,11 @@ class ProbeSnapshot:
     rtt_p50_ms: float = 0.0
     rtt_p99_ms: float = 0.0
     loss_ratio: float = 0.0
+    # per-peer window stats ({name: {"rttMs", "lossRatio", "reachable"}})
+    # — the edge-level matrix the topology planner consumes; bounded by
+    # the peer list (at most degree under sampling), so carrying it in
+    # every report costs O(k) per node
+    peers: Dict[str, Dict] = field(default_factory=dict)
 
     def to_report(self) -> Dict:
         """Wire form for ``ProvisioningReport.probe`` (camelCase, same
@@ -200,6 +205,9 @@ class ProbeSnapshot:
             "rttP50Ms": round(self.rtt_p50_ms, 3),
             "rttP99Ms": round(self.rtt_p99_ms, 3),
             "lossRatio": round(self.loss_ratio, 4),
+            "peers": {
+                name: dict(stats) for name, stats in self.peers.items()
+            },
         }
 
 
@@ -273,6 +281,20 @@ class Prober:
             rtt for w in self.windows.values() for rtt in w.rtts()
         )
         losses = [w.loss_ratio() for w in self.windows.values()]
+        per_peer: Dict[str, Dict] = {}
+        for name, w in sorted(self.windows.items()):
+            rtts = sorted(w.rtts())
+            per_peer[name] = {
+                # no samples in the window → no measurement (None), not
+                # 0.0: a zero would read as the cheapest edge in the
+                # fleet and steer the planner's ring onto exactly the
+                # link that is dropping probes
+                "rttMs": (
+                    round(quantile(rtts, 0.50) * 1e3, 3) if rtts else None
+                ),
+                "lossRatio": round(w.loss_ratio(), 4),
+                "reachable": w.reachable,
+            }
         return ProbeSnapshot(
             peers_total=len(self.peers),
             peers_reachable=len(self.peers) - len(unreachable),
@@ -280,6 +302,7 @@ class Prober:
             rtt_p50_ms=quantile(all_rtts, 0.50) * 1e3,
             rtt_p99_ms=quantile(all_rtts, 0.99) * 1e3,
             loss_ratio=sum(losses) / len(losses) if losses else 0.0,
+            peers=per_peer,
         )
 
 

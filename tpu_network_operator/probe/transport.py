@@ -164,6 +164,11 @@ class FakeFabric:
         self.loss: Dict[str, float] = {}
         self.partitioned: set = set()
         self.cuts: set = set()
+        # per-link one-way latency overrides (host or host:port pair
+        # keys) — lets a scenario model a structured fabric (fast
+        # intra-rack, slow inter-rack) that probing then measures;
+        # pairs without an override keep the fabric default
+        self.link_latency: Dict[frozenset, float] = {}
         self.delivered = 0
         self.dropped = 0
 
@@ -202,6 +207,12 @@ class FakeFabric:
     def uncut(self, a: str, b: str) -> None:
         self.cuts.discard(frozenset((a, b)))
 
+    def set_link_latency(self, a: str, b: str, seconds: float) -> None:
+        """One-way latency override for the (a, b) link (host or
+        host:port keys, symmetric) — the structured-fabric seam the
+        topology-planner bench measures against."""
+        self.link_latency[frozenset((a, b))] = seconds
+
     def _hosts(self, addr: str) -> Tuple[str, str]:
         return addr, addr.rpartition(":")[0]
 
@@ -221,6 +232,14 @@ class FakeFabric:
             default=0.0,
         )
 
+    def _link_latency(self, src: str, dst: str) -> float:
+        for a in self._hosts(src):
+            for b in self._hosts(dst):
+                override = self.link_latency.get(frozenset((a, b)))
+                if override is not None:
+                    return override
+        return self.latency
+
     # -- delivery -------------------------------------------------------------
 
     def deliver(self, src: str, dst: str, payload: bytes, at: float) -> None:
@@ -231,7 +250,7 @@ class FakeFabric:
         if self.rng.random() < self._loss_ratio(src, dst):
             self.dropped += 1
             return
-        arrival = at + self.latency
+        arrival = at + self._link_latency(src, dst)
         if self.jitter:
             arrival += self.jitter * self.rng.random()
         self.delivered += 1
