@@ -58,6 +58,11 @@ planner-bench: ## Topology-planner proof: marked tests + the planned-vs-naive ri
 	$(PYTHON) -m pytest tests/ -x -q -m "planner and not slow"
 	$(PYTHON) tools/planner_bench.py --out BENCH_planner.json
 
+.PHONY: remediation-bench
+remediation-bench: ## Self-healing proof: marked tests + the flap/escalation/storm scenarios
+	$(PYTHON) -m pytest tests/ -x -q -m "remediation and not slow"
+	$(PYTHON) tools/remediation_bench.py --out BENCH_remediation.json
+
 .PHONY: test-cluster
 test-cluster: ## kind-cluster e2e + live fuzz (needs kind/docker/kubectl; skips cleanly without — ref test/e2e + test/fuzz)
 	$(PYTHON) -m pytest tests/cluster -x -q

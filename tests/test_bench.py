@@ -574,3 +574,68 @@ class TestScaleBench:
         assert sweep["steady_writes_per_pass"] == 0
         assert sweep["datagrams_per_round"] <= 8 * 10000
         assert sweep["status_bytes"] < 256 * 1024
+
+
+@pytest.mark.remediation
+class TestRemediationBench:
+    def test_artifact_schema_and_invariants(self, tmp_path):
+        """The self-healing bench (tools/remediation_bench.py,
+        perf_session phase 15): BENCH-style JSON artifact whose
+        numbers carry the acceptance criteria — a flapping link
+        converges with <= 2 label transitions (never more than
+        detection-only), a persistent-loss link escalates to route
+        re-derivation and leaves the topology plan within one replan,
+        and an anomaly storm never exceeds maxNodesPerWindow
+        concurrent remediations with budget denials counted exactly."""
+        out = tmp_path / "BENCH_remediation.json"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "remediation_bench.py"),
+             "--out", str(out)],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert row == json.loads(out.read_text())
+        # the driver's contract keys
+        assert set(row) >= {"metric", "value", "unit", "vs_baseline"}
+        assert row["ok"] is True and row["failures"] == []
+        # flap: converged, and remediation never increases flaps
+        flap = row["flap"]
+        assert flap["remediation_label_transitions"] <= 2
+        assert (
+            flap["remediation_label_transitions"]
+            <= flap["detection_only_label_transitions"]
+        )
+        assert flap["bounces"] >= 1
+        assert row["vs_baseline"] <= 1.0
+        # escalation: ladder reached reroute, planner excluded the
+        # node in one replan, recovery readmitted it
+        esc = row["escalation"]
+        assert esc["escalated_to_reroute"] is True
+        assert esc["excluded_from_plan_in_one_replan"] is True
+        assert esc["readmitted_after_recovery"] is True
+        assert esc["healed_event"] is True
+        # storm: exactly K the first wave, never above the budget,
+        # denials counted exactly
+        storm = row["storm"]
+        assert storm["held_to_budget"] is True
+        assert storm["max_concurrent_remediations"] == storm["budget_k"]
+        assert storm["budget_denials"] == \
+            storm["budget_denials_expected"]
+        assert storm["budget_event"] is True
+
+    def test_deterministic_across_runs(self):
+        """The scenarios are seeded/deterministic: two runs must
+        produce identical artifacts (the chaos-bench reproducibility
+        contract)."""
+        runs = []
+        for _ in range(2):
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                              "remediation_bench.py")],
+                capture_output=True, text=True, timeout=300,
+            )
+            assert proc.returncode == 0, proc.stderr[-800:]
+            runs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+        assert runs[0] == runs[1]

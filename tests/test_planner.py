@@ -837,12 +837,17 @@ class TestPlanInputsFilter:
 @pytest.mark.scale
 class TestPlannerAtScale:
     def test_two_thousand_nodes_zero_steady_writes(self):
-        """The scale marker: planning enabled on a 2k-node fleet, the
-        label applies diff-gated and batched — steady-state passes
-        write ZERO Node patches and ZERO ConfigMap updates."""
+        """The scale marker: planning AND remediation enabled on a
+        2k-node fleet, the label applies diff-gated and batched —
+        steady-state passes write ZERO Node patches and ZERO ConfigMap
+        updates (the remediation ledger/directive ConfigMaps are
+        diff-gated like the plan CM, so the PR 6 contract holds with
+        self-healing on)."""
         n = 2000
         fake = FakeCluster()
-        policy = default_policy(tpu_policy())
+        policy = tpu_policy()
+        policy.spec.tpu_scale_out.remediation.enabled = True
+        policy = default_policy(policy)
         policy.spec.tpu_scale_out.probe.degree = 8
         fake.create(policy.to_dict())
         rack_of = {}

@@ -1,0 +1,1172 @@
+"""Self-healing remediation: the budgeted detect→act loop.
+
+Covers the pure policy core (ladder, cooldown, escalation, budget,
+quorum floor), the ledger's persistence contract (a restarted
+controller resumes cooldowns), the reconciler's `_sync_remediation`
+pass (directives, Events, metrics, status rollup, zero-steady-write),
+the agent's directive execution through LinkOps (including the stale/
+missing-interface/outage edge cases), the FakeFabric per-directional
+link faults, and the diag bundle's new ConfigMap sections.
+"""
+
+import json
+import os
+
+import pytest
+
+from tests.fake_ops import FakeLinkOps
+from tpu_network_operator.agent import cli as agent_cli
+from tpu_network_operator.agent import network as net
+from tpu_network_operator.agent import report as rpt
+from tpu_network_operator.api.v1alpha1 import (
+    NetworkClusterPolicy,
+    default_policy,
+    webhook,
+)
+from tpu_network_operator.api.v1alpha1 import types as t
+from tpu_network_operator.api.v1alpha1.types import API_VERSION
+from tpu_network_operator.controller.health import Metrics
+from tpu_network_operator.controller.reconciler import (
+    NetworkClusterPolicyReconciler,
+    update_tpu_scale_out_daemonset,
+)
+from tpu_network_operator.controller import templates
+from tpu_network_operator.kube import errors as kerr
+from tpu_network_operator.kube.chaos import FabricChaos
+from tpu_network_operator.kube.fake import FakeCluster
+from tpu_network_operator.obs import EventRecorder
+from tpu_network_operator.probe.transport import FakeFabric
+from tpu_network_operator.remediation import (
+    ACTION_BOUNCE,
+    ACTION_PEER_SHIFT,
+    ACTION_REPROBE,
+    ACTION_REROUTE,
+    ACTION_RESTART,
+    ACTIONS,
+    CLASS_PROBE,
+    CLASS_TELEMETRY,
+    Anomaly,
+    Knobs,
+    Ledger,
+    allowed_ladder,
+    decide,
+    primary_anomaly,
+)
+
+pytestmark = pytest.mark.remediation
+
+NAMESPACE = "tpunet-system"
+POLICY = "heal"
+
+
+def knobs(**kw):
+    defaults = dict(
+        max_nodes_per_window=3, window_seconds=300.0,
+        cooldown_seconds=60.0, escalate_after=2,
+        allowed_actions=frozenset(ACTIONS), min_healthy=0,
+    )
+    defaults.update(kw)
+    return Knobs(**defaults)
+
+
+# -- pure policy core ---------------------------------------------------------
+
+
+class TestPolicyCore:
+    def test_telemetry_ladder_starts_at_bounce(self):
+        ledger = Ledger()
+        d = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "ens9")],
+                   ledger, 100.0, healthy_nodes=5)
+        assert [x.action for x in d.started] == [ACTION_BOUNCE]
+        assert d.started[0].iface == "ens9"
+
+    def test_probe_ladder_starts_at_reprobe(self):
+        ledger = Ledger()
+        d = decide(knobs(), [Anomaly("n1", CLASS_PROBE)],
+                   ledger, 100.0, healthy_nodes=5)
+        assert [x.action for x in d.started] == [ACTION_REPROBE]
+
+    def test_cooldown_blocks_next_attempt(self):
+        ledger = Ledger()
+        d1 = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "ens9")],
+                    ledger, 100.0, 5)
+        ledger.record_outcome(d1.started[0].id, True)
+        d2 = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "ens9")],
+                    ledger, 130.0, 5)   # 30s < 60s cooldown
+        assert d2.started == [] and d2.directives == {}
+
+    def test_pending_directive_redistributed_inside_cooldown(self):
+        ledger = Ledger()
+        d1 = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "ens9")],
+                    ledger, 100.0, 5)
+        d2 = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "ens9")],
+                    ledger, 130.0, 5)   # no ack yet
+        assert d2.started == []
+        assert d2.directives["n1"].id == d1.started[0].id
+
+    def test_unacked_directive_expires_as_failed_attempt(self):
+        from tpu_network_operator.remediation.policy import (
+            PENDING_GRACE_SECONDS,
+        )
+
+        ledger = Ledger()
+        decide(knobs(escalate_after=1),
+               [Anomaly("n1", CLASS_TELEMETRY, "ens9")], ledger, 100.0, 5)
+        # inside cooldown + pickup grace the directive is presumed
+        # in flight (agent pickup-to-ack can take a couple of monitor
+        # ticks) and is redistributed, never expired — expiring at the
+        # bare cooldown would double-execute disruptive actions
+        mid = 100.0 + 60.0 + PENDING_GRACE_SECONDS - 1.0
+        d_mid = decide(knobs(escalate_after=1),
+                       [Anomaly("n1", CLASS_TELEMETRY, "ens9")],
+                       ledger, mid, 5)
+        assert d_mid.started == [] and "n1" in d_mid.directives
+        # past the full horizon the attempt counts as failed and
+        # (escalate_after=1) the pass escalates
+        d = decide(knobs(escalate_after=1),
+                   [Anomaly("n1", CLASS_TELEMETRY, "ens9")],
+                   ledger, mid + 2.0, 5)
+        assert d.escalated == [
+            ("n1", CLASS_TELEMETRY, ACTION_BOUNCE, ACTION_REROUTE)
+        ]
+        assert [x.action for x in d.started] == [ACTION_REROUTE]
+
+    def test_escalates_after_n_failed_attempts(self):
+        ledger = Ledger()
+        now = 100.0
+        for _ in range(2):   # escalate_after=2 bounce attempts
+            d = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "e")],
+                       ledger, now, 5)
+            ledger.record_outcome(d.started[0].id, False, "still broken")
+            now += 100.0
+        d = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "e")],
+                   ledger, now, 5)
+        assert [x.action for x in d.started] == [ACTION_REROUTE]
+
+    def test_ladder_exhaustion_is_a_one_time_edge(self):
+        ladder = allowed_ladder(CLASS_TELEMETRY, frozenset(ACTIONS))
+        ledger = Ledger()
+        now = 100.0
+        exhausted_edges = []
+        for _ in range(len(ladder) * 2 + 2):
+            d = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "e")],
+                       ledger, now, 5)
+            exhausted_edges += d.exhausted
+            for directive in d.started:
+                ledger.record_outcome(directive.id, False, "nope")
+            now += 100.0
+        assert exhausted_edges == [("n1", CLASS_TELEMETRY)]
+        # exhausted: no further actions, ever
+        d = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "e")],
+                   ledger, now + 1000, 5)
+        assert d.started == []
+
+    def test_recovery_clears_entry_and_reports_healed(self):
+        ledger = Ledger()
+        d = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "e")],
+                   ledger, 100.0, 5)
+        ledger.record_outcome(d.started[0].id, True)
+        d2 = decide(knobs(), [], ledger, 200.0, 5)
+        assert d2.healed == ["n1"]
+        assert ledger.entries == {}
+        # a recurrence starts back at rung zero
+        d3 = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "e")],
+                    ledger, 300.0, 5)
+        assert [x.action for x in d3.started] == [ACTION_BOUNCE]
+
+    def test_exhausted_or_failed_recovery_is_not_credited(self):
+        """A node whose ladder exhausted (or whose last action failed)
+        and THEN recovered healed despite remediation, not because of
+        it — no RemediationSucceeded credit in the audit trail."""
+        ledger = Ledger()
+        now = 100.0
+        anoms = [Anomaly("n1", CLASS_TELEMETRY, "e")]
+        while True:   # walk the ladder to exhaustion, every action fails
+            d = decide(knobs(), anoms, ledger, now, 5)
+            for directive in d.started:
+                ledger.record_outcome(directive.id, False, "nope")
+            now += 300.0
+            if d.exhausted:
+                break
+        d = decide(knobs(), [], ledger, now + 1000.0, 5)
+        assert d.healed == []
+        assert ledger.entries == {}   # still cleared, just not credited
+
+    def test_recovery_without_actions_is_not_healed(self):
+        ledger = Ledger()
+        # budget-denied node never got an action; its recovery is not
+        # a remediation success
+        k = knobs(max_nodes_per_window=1)
+        anoms = [Anomaly("n1", CLASS_TELEMETRY, "e"),
+                 Anomaly("n2", CLASS_TELEMETRY, "e")]
+        d = decide(k, anoms, ledger, 100.0, 5)
+        assert d.budget_denied == ["n2"]
+        d2 = decide(k, [anoms[0]], ledger, 110.0, 5)
+        assert d2.healed == []
+
+    def test_budget_caps_distinct_nodes_per_window(self):
+        ledger = Ledger()
+        anoms = [Anomaly(f"n{i}", CLASS_TELEMETRY, "e") for i in range(6)]
+        d = decide(knobs(max_nodes_per_window=3), anoms, ledger,
+                   100.0, 20)
+        assert sorted(x.node for x in d.started) == ["n0", "n1", "n2"]
+        assert d.budget_denied == ["n3", "n4", "n5"]
+
+    def test_in_window_node_continues_ladder_without_new_slot(self):
+        k = knobs(max_nodes_per_window=1, cooldown_seconds=10.0)
+        ledger = Ledger()
+        d = decide(k, [Anomaly("n1", CLASS_TELEMETRY, "e")],
+                   ledger, 100.0, 5)
+        ledger.record_outcome(d.started[0].id, False, "x")
+        # n1 already holds the window's only slot: its retry proceeds,
+        # a NEW node is denied
+        d2 = decide(k, [Anomaly("n1", CLASS_TELEMETRY, "e"),
+                        Anomaly("n2", CLASS_TELEMETRY, "e")],
+                    ledger, 120.0, 5)
+        assert [x.node for x in d2.started] == ["n1"]
+        assert d2.budget_denied == ["n2"]
+
+    def test_window_expiry_frees_budget(self):
+        k = knobs(max_nodes_per_window=1, window_seconds=100.0,
+                  cooldown_seconds=10.0)
+        ledger = Ledger()
+        d = decide(k, [Anomaly("n1", CLASS_TELEMETRY, "e")],
+                   ledger, 100.0, 5)
+        ledger.record_outcome(d.started[0].id, True)
+        d2 = decide(k, [Anomaly("n2", CLASS_TELEMETRY, "e")],
+                    ledger, 150.0, 5)
+        assert d2.budget_denied == ["n2"]
+        d3 = decide(k, [Anomaly("n2", CLASS_TELEMETRY, "e")],
+                    ledger, 250.0, 5)   # window slid past n1's charge
+        assert [x.node for x in d3.started] == ["n2"]
+
+    def test_quorum_floor_withholds_disruptive_actions(self):
+        ledger = Ledger()
+        d = decide(knobs(min_healthy=5),
+                   [Anomaly("n1", CLASS_TELEMETRY, "e")],
+                   ledger, 100.0, healthy_nodes=5)
+        assert d.started == [] and d.quorum_held == ["n1"]
+        # non-disruptive rungs stay available at the same floor
+        d2 = decide(knobs(min_healthy=5), [Anomaly("n2", CLASS_PROBE)],
+                    ledger, 100.0, healthy_nodes=5)
+        assert [x.action for x in d2.started] == [ACTION_REPROBE]
+
+    def test_allowed_actions_filters_ladder_rungs(self):
+        k = knobs(allowed_actions=frozenset({ACTION_REROUTE}))
+        ledger = Ledger()
+        d = decide(k, [Anomaly("n1", CLASS_TELEMETRY, "e")],
+                   ledger, 100.0, 5)
+        # bounce disabled: the ladder starts at reroute
+        assert [x.action for x in d.started] == [ACTION_REROUTE]
+
+    def test_empty_allowed_ladder_is_detection_only(self):
+        k = knobs(allowed_actions=frozenset({ACTION_REPROBE}))
+        ledger = Ledger()
+        d = decide(k, [Anomaly("n1", CLASS_TELEMETRY, "e")],
+                   ledger, 100.0, 5)
+        assert d.started == [] and d.directives == {}
+
+    def test_escalation_edge_fires_once_when_gate_denies_the_rung(self):
+        """The rung advance persists even when a gate (here: the
+        quorum floor) denies the escalated action — otherwise every
+        pass would recompute (and re-report) the identical escalation
+        until the gate opens."""
+        # probe ladder: re-probe -> peer-shift (both non-disruptive)
+        # -> restart-agent (disruptive, quorum-blocked at this floor)
+        k = knobs(cooldown_seconds=10.0, escalate_after=1,
+                  min_healthy=10)
+        ledger = Ledger()
+        anoms = [Anomaly("n1", CLASS_PROBE)]
+        d = decide(k, anoms, ledger, 100.0, healthy_nodes=5)
+        assert [x.action for x in d.started] == [ACTION_REPROBE]
+        ledger.record_outcome(d.started[0].id, False, "x")
+        d = decide(k, anoms, ledger, 120.0, 5)
+        assert d.escalated == [
+            ("n1", CLASS_PROBE, ACTION_REPROBE, ACTION_PEER_SHIFT)
+        ]
+        ledger.record_outcome(d.started[0].id, False, "x")
+        # the restart escalation computes but the quorum floor denies
+        # the action: the advance must persist, the edge fire ONCE
+        escalations, held = [], 0
+        for now in (140.0, 160.0, 180.0):
+            d = decide(k, anoms, ledger, now, 5)
+            escalations += d.escalated
+            held += len(d.quorum_held)
+            assert d.started == []
+        assert escalations == [
+            ("n1", CLASS_PROBE, ACTION_PEER_SHIFT, ACTION_RESTART)
+        ]
+        assert held == 3   # the hold itself is reported every pass
+
+    def test_flap_inside_cooldown_resumes_ladder(self):
+        """An anomaly absent one pass and back the next must NOT reset
+        the ladder: the entry (rung, attempts, cooldown clock) is kept
+        until the cooldown has fully elapsed, so remediation can never
+        flap the dataplane at reconcile cadence.  The heal is also
+        only credited because the outcome was ok — see
+        test_exhausted_or_failed_recovery_is_not_credited."""
+        ledger = Ledger()
+        d = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "e")],
+                   ledger, 100.0, 5)
+        ledger.record_outcome(d.started[0].id, True)
+        # anomaly gone for one pass INSIDE the 60s cooldown: no heal,
+        # entry kept
+        d2 = decide(knobs(), [], ledger, 120.0, 5)
+        assert d2.healed == []
+        assert ledger.peek("n1", CLASS_TELEMETRY) is not None
+        # anomaly back, still inside cooldown: no immediate re-bounce
+        d3 = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "e")],
+                    ledger, 130.0, 5)
+        assert d3.started == []
+        # once the cooldown elapses cleanly, the heal edge fires
+        d4 = decide(knobs(), [], ledger, 200.0, 5)
+        assert d4.healed == ["n1"]
+        assert ledger.entries == {}
+
+    def test_primary_anomaly_prefers_telemetry(self):
+        anoms = [Anomaly("n1", CLASS_PROBE),
+                 Anomaly("n1", CLASS_TELEMETRY, "ens9")]
+        assert primary_anomaly(anoms).cls == CLASS_TELEMETRY
+        assert primary_anomaly([]) is None
+
+
+# -- ledger persistence -------------------------------------------------------
+
+
+class TestLedger:
+    def test_json_roundtrip(self):
+        ledger = Ledger()
+        d = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "ens9")],
+                   ledger, 100.0, 5)
+        ledger.record_outcome(d.started[0].id, False, "boom")
+        restored = Ledger.from_json(ledger.to_json())
+        assert restored.to_json() == ledger.to_json()
+        assert restored.seq == ledger.seq
+        entry = restored.peek("n1", CLASS_TELEMETRY)
+        assert entry.outcome == "failed"
+        assert entry.outcome_error == "boom"
+
+    def test_restored_ledger_resumes_cooldown(self):
+        ledger = Ledger()
+        d = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "e")],
+                   ledger, 100.0, 5)
+        ledger.record_outcome(d.started[0].id, True)
+        restored = Ledger.from_json(ledger.to_json())
+        d2 = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "e")],
+                    restored, 130.0, 5)   # inside the 60s cooldown
+        assert d2.started == []
+
+    def test_window_nodes_reads_do_not_mutate(self):
+        ledger = Ledger()
+        decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "e")],
+               ledger, 100.0, 5)
+        before = ledger.to_json()
+        ledger.window_nodes(10_000.0, 300.0)
+        assert ledger.to_json() == before
+
+    def test_record_outcome_unknown_and_repeat(self):
+        ledger = Ledger()
+        assert ledger.record_outcome("nope", True) is None
+        d = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "e")],
+                   ledger, 100.0, 5)
+        assert ledger.record_outcome(d.started[0].id, True) == \
+            ("n1", CLASS_TELEMETRY)
+        # a republished Lease re-reports the same outcome: idempotent
+        assert ledger.record_outcome(d.started[0].id, False) is None
+        assert ledger.peek("n1", CLASS_TELEMETRY).outcome == "ok"
+
+    def test_from_json_tolerates_garbage(self):
+        assert Ledger.from_json("not json").entries == {}
+        assert Ledger.from_json('{"entries": 7, "window": "x"}') \
+            .entries == {}
+        led = Ledger.from_json(json.dumps({
+            "v": 3,
+            "entries": {"n|telemetry": {"rung": "bad"}, 5: {}},
+            "window": [["n", 1.0], ["bad"], "x"],
+        }))
+        assert led.seq == 3
+        assert led.peek("n", "telemetry").rung == 0
+        assert led.window == [("n", 1.0)]
+
+    def test_pending_directive_reconstruction(self):
+        ledger = Ledger()
+        d = decide(knobs(), [Anomaly("n1", CLASS_TELEMETRY, "ens9")],
+                   ledger, 100.0, 5)
+        restored = Ledger.from_json(ledger.to_json())
+        pend = restored.pending_directive("n1", CLASS_TELEMETRY)
+        assert pend.id == d.started[0].id
+        assert pend.action == ACTION_BOUNCE and pend.iface == "ens9"
+        restored.record_outcome(pend.id, True)
+        assert restored.pending_directive("n1", CLASS_TELEMETRY) is None
+
+
+# -- webhook: defaults + validation -------------------------------------------
+
+
+def tpu_policy(remediation=True, probe=True):
+    p = NetworkClusterPolicy()
+    p.metadata.name = POLICY
+    p.spec.configuration_type = "tpu-so"
+    p.spec.node_selector = {"tpunet.dev/pool": POLICY}
+    p.spec.tpu_scale_out.probe.enabled = probe
+    p.spec.tpu_scale_out.remediation.enabled = remediation
+    return p
+
+
+class TestWebhook:
+    def test_defaults_pinned_on_enable(self):
+        p = default_policy(tpu_policy())
+        r = p.spec.tpu_scale_out.remediation
+        assert r.max_nodes_per_window == \
+            t.DEFAULT_REMEDIATION_MAX_NODES_PER_WINDOW
+        assert r.window_seconds == t.DEFAULT_REMEDIATION_WINDOW_SECONDS
+        assert r.cooldown_seconds == \
+            t.DEFAULT_REMEDIATION_COOLDOWN_SECONDS
+        assert r.escalate_after == t.DEFAULT_REMEDIATION_ESCALATE_AFTER
+        assert r.allowed_actions == list(t.REMEDIATION_ACTIONS)
+        webhook.validate_create(p)
+
+    def test_disabled_spec_left_untouched(self):
+        p = default_policy(tpu_policy(remediation=False))
+        r = p.spec.tpu_scale_out.remediation
+        assert r.max_nodes_per_window == 0
+        assert r.allowed_actions == []
+
+    def test_explicit_values_survive_defaulting(self):
+        p = tpu_policy()
+        p.spec.tpu_scale_out.remediation.max_nodes_per_window = 7
+        p.spec.tpu_scale_out.remediation.allowed_actions = [
+            ACTION_REPROBE
+        ]
+        p = default_policy(p)
+        assert p.spec.tpu_scale_out.remediation.max_nodes_per_window == 7
+        assert p.spec.tpu_scale_out.remediation.allowed_actions == [
+            ACTION_REPROBE
+        ]
+
+    def test_rejects_remediation_without_probe(self):
+        p = tpu_policy(probe=False)
+        with pytest.raises(webhook.AdmissionError, match="probe"):
+            webhook.validate_create(p)
+
+    def test_range_validation(self):
+        for field, bad in (
+            ("max_nodes_per_window", 1001),
+            ("window_seconds", 86401),
+            ("cooldown_seconds", 3601),
+            ("escalate_after", 101),
+            ("max_nodes_per_window", -1),
+        ):
+            p = default_policy(tpu_policy())
+            setattr(p.spec.tpu_scale_out.remediation, field, bad)
+            with pytest.raises(webhook.AdmissionError):
+                webhook.validate_create(p)
+
+    def test_rejects_unknown_and_duplicate_actions(self):
+        p = default_policy(tpu_policy())
+        p.spec.tpu_scale_out.remediation.allowed_actions = ["reboot"]
+        with pytest.raises(webhook.AdmissionError, match="unknown"):
+            webhook.validate_create(p)
+        p.spec.tpu_scale_out.remediation.allowed_actions = [
+            ACTION_REPROBE, ACTION_REPROBE
+        ]
+        with pytest.raises(webhook.AdmissionError, match="duplicate"):
+            webhook.validate_create(p)
+
+    def test_quarantine_passes_defaulted_and_validated(self):
+        p = default_policy(tpu_policy())
+        assert p.spec.tpu_scale_out.probe.quarantine_passes == \
+            t.DEFAULT_PROBE_QUARANTINE_PASSES
+        p.spec.tpu_scale_out.probe.quarantine_passes = 101
+        with pytest.raises(webhook.AdmissionError,
+                           match="quarantinePasses"):
+            webhook.validate_create(p)
+        p.spec.tpu_scale_out.probe.quarantine_passes = -1
+        with pytest.raises(webhook.AdmissionError,
+                           match="quarantinePasses"):
+            webhook.validate_create(p)
+
+    def test_explicit_quarantine_passes_survives(self):
+        p = tpu_policy()
+        p.spec.tpu_scale_out.probe.quarantine_passes = 5
+        p = default_policy(p)
+        assert p.spec.tpu_scale_out.probe.quarantine_passes == 5
+
+    def test_roundtrip_through_wire_form(self):
+        p = default_policy(tpu_policy())
+        again = NetworkClusterPolicy.from_dict(p.to_dict())
+        assert again.to_dict() == p.to_dict()
+        assert again.spec.tpu_scale_out.remediation.enabled
+
+
+class TestProjection:
+    def _args(self, policy):
+        ds = templates.tpu_discovery_daemonset()
+        update_tpu_scale_out_daemonset(ds, policy, NAMESPACE)
+        return ds["spec"]["template"]["spec"]["containers"][0]["args"]
+
+    def test_remediation_flag_projected(self):
+        assert "--remediation=true" in self._args(
+            default_policy(tpu_policy())
+        )
+
+    def test_absent_when_disabled(self):
+        args = self._args(default_policy(tpu_policy(remediation=False)))
+        assert not any(a.startswith("--remediation") for a in args)
+
+
+# -- reconciler integration ---------------------------------------------------
+
+
+def probe_payload(n, degraded=False):
+    return {
+        "peersTotal": n - 1,
+        "peersReachable": 0 if degraded else n - 1,
+        "unreachable": [],
+        "rttP50Ms": 0.4, "rttP99Ms": 1.1,
+        "lossRatio": 1.0 if degraded else 0.0,
+        "state": "Degraded" if degraded else "Healthy",
+    }
+
+
+def agent_report(node, i, n, telem_anom=False, probe_degraded=False,
+                 outcome=None):
+    telemetry = {"interfaces": {"ens9": {
+        "rxBytes": 1 << 20, "rxPackets": 10_000,
+        "rxErrors": 5000 if telem_anom else 0,
+        "errorRatio": 0.33 if telem_anom else 0.0,
+        "anomalies": ["error-ratio"] if telem_anom else [],
+    }}}
+    return rpt.ProvisioningReport(
+        node=node, policy=POLICY, ok=True, backend="tpu", mode="L2",
+        interfaces_configured=2, interfaces_total=2,
+        probe_endpoint=f"10.0.0.{i % 250 + 1}:8477",
+        probe=probe_payload(n, probe_degraded),
+        telemetry=telemetry, remediation=outcome,
+    )
+
+
+class HealCluster:
+    """Real reconciler on a FakeCluster with remediation enabled and a
+    manual remediation clock."""
+
+    def __init__(self, n=6, **spec_kw):
+        self.n = n
+        self.fake = FakeCluster()
+        p = tpu_policy()
+        r = p.spec.tpu_scale_out.remediation
+        for key, val in spec_kw.items():
+            setattr(r, key, val)
+        self.fake.create(default_policy(p).to_dict())
+        for i in range(n):
+            self.fake.add_node(self.node(i), {"tpunet.dev/pool": POLICY})
+            self.fake.apply(rpt.lease_for(
+                agent_report(self.node(i), i, n), NAMESPACE
+            ))
+        self.metrics = Metrics()
+        self.rec = NetworkClusterPolicyReconciler(
+            self.fake, NAMESPACE, metrics=self.metrics,
+            events=EventRecorder(self.fake, NAMESPACE),
+        )
+        self.clock = [10_000.0]
+        self.rec._rem_clock = lambda: self.clock[0]
+        self.rec.setup()
+        self.rec.reconcile(POLICY)
+        self.fake.simulate_daemonset_controller()
+        self.rec.reconcile(POLICY)
+
+    @staticmethod
+    def node(i):
+        return f"node-{i:03d}"
+
+    def report(self, i, **kw):
+        self.fake.apply(rpt.lease_for(
+            agent_report(self.node(i), i, self.n, **kw), NAMESPACE
+        ))
+
+    def directives(self):
+        cm = self.fake.get(
+            "v1", "ConfigMap", rpt.directive_configmap_name(POLICY),
+            NAMESPACE,
+        )
+        return json.loads(cm["data"][rpt.DIRECTIVES_KEY])
+
+    def ledger(self):
+        cm = self.fake.get(
+            "v1", "ConfigMap", rpt.remediation_configmap_name(POLICY),
+            NAMESPACE,
+        )
+        return json.loads(cm["data"][rpt.LEDGER_KEY])
+
+    def status(self):
+        cr = self.fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+        return cr.get("status", {}) or {}
+
+    def writes(self, kind):
+        return sum(
+            v for (verb, k), v in self.fake.request_counts.items()
+            if k == kind and verb in ("create", "update", "patch",
+                                      "delete")
+        )
+
+    def reasons(self):
+        return [
+            e["reason"] for e in self.fake.events(involved_name=POLICY)
+        ]
+
+
+class TestReconcilerIntegration:
+    def test_telemetry_anomaly_issues_bounce_directive(self):
+        env = HealCluster()
+        env.report(2, telem_anom=True)
+        env.rec.reconcile(POLICY)
+        payload = env.directives()
+        row = payload["directives"][env.node(2)]
+        assert row["action"] == ACTION_BOUNCE
+        assert row["iface"] == "ens9"
+        assert row["ledgerVersion"] == payload["version"]
+        cm = env.fake.get(
+            "v1", "ConfigMap", rpt.directive_configmap_name(POLICY),
+            NAMESPACE,
+        )
+        owners = cm["metadata"]["ownerReferences"]
+        assert owners and owners[0]["name"] == POLICY
+        assert "RemediationStarted" in env.reasons()
+
+    def test_probe_degraded_issues_reprobe(self):
+        env = HealCluster()
+        env.report(1, probe_degraded=True)
+        env.rec.reconcile(POLICY)
+        row = env.directives()["directives"][env.node(1)]
+        assert row["action"] == ACTION_REPROBE
+
+    def test_outcome_recorded_and_heal_clears_entry(self):
+        env = HealCluster()
+        env.report(2, telem_anom=True)
+        env.rec.reconcile(POLICY)
+        row = env.directives()["directives"][env.node(2)]
+        env.report(2, telem_anom=True, outcome={
+            "directiveId": row["id"], "action": row["action"],
+            "ok": True, "error": "",
+        })
+        env.rec.reconcile(POLICY)
+        entry = env.ledger()["entries"][f"{env.node(2)}|telemetry"]
+        assert entry["outcome"] == "ok"
+        env.report(2)   # anomaly cleared
+        # past the cooldown (flap protection holds entries within it)
+        env.clock[0] += 120.0
+        env.rec.reconcile(POLICY)
+        assert env.ledger()["entries"] == {}
+        assert "RemediationSucceeded" in env.reasons()
+        assert env.directives()["directives"] == {}
+
+    def test_steady_pass_writes_nothing(self):
+        env = HealCluster()
+        before = env.writes("ConfigMap") + env.writes("Node")
+        for _ in range(3):
+            env.rec.reconcile(POLICY)
+        assert env.writes("ConfigMap") + env.writes("Node") == before
+
+    def test_steady_anomalous_pass_writes_nothing_inside_cooldown(self):
+        env = HealCluster()
+        env.report(2, telem_anom=True)
+        env.rec.reconcile(POLICY)
+        before = env.writes("ConfigMap")
+        env.clock[0] += 5.0
+        env.rec.reconcile(POLICY)
+        env.clock[0] += 5.0
+        env.rec.reconcile(POLICY)
+        assert env.writes("ConfigMap") == before
+
+    def test_restart_resumes_cooldowns_without_refiring(self):
+        env = HealCluster()
+        env.report(2, telem_anom=True)
+        env.rec.reconcile(POLICY)
+        issued = env.directives()
+        cm_writes = env.writes("ConfigMap")
+        # a fresh controller instance (restart): same fake cluster,
+        # empty in-memory state, clock just past the issue
+        fresh = NetworkClusterPolicyReconciler(
+            env.fake, NAMESPACE, metrics=Metrics(),
+        )
+        fresh._rem_clock = lambda: env.clock[0] + 10.0
+        fresh.setup()
+        fresh.reconcile(POLICY)
+        # the ledger ConfigMap restored the pending directive: no
+        # re-fire (same id, same version), and the read-back diff
+        # gates swallowed both ConfigMaps — zero writes
+        assert env.directives() == issued
+        assert env.writes("ConfigMap") == cm_writes
+
+    def test_restart_agent_rung_deletes_pod(self):
+        env = HealCluster(allowed_actions=[ACTION_RESTART])
+        pods_before = {
+            p["metadata"]["name"]
+            for p in env.fake.list("v1", "Pod", namespace=NAMESPACE)
+            if p.get("spec", {}).get("nodeName") == env.node(2)
+        }
+        assert pods_before
+        env.report(2, telem_anom=True)
+        env.rec.reconcile(POLICY)
+        pods_after = {
+            p["metadata"]["name"]
+            for p in env.fake.list("v1", "Pod", namespace=NAMESPACE)
+            if p.get("spec", {}).get("nodeName") == env.node(2)
+        }
+        assert pods_after == set()
+        # executed controller-side: never distributed to the agent,
+        # outcome already recorded in the ledger
+        assert env.directives()["directives"] == {}
+        entry = env.ledger()["entries"][f"{env.node(2)}|telemetry"]
+        assert entry["outcome"] == "ok"
+        assert entry["lastAction"] == ACTION_RESTART
+
+    def test_budget_storm_held_to_k(self):
+        env = HealCluster(n=10, max_nodes_per_window=2)
+        for i in range(4):
+            env.report(i, telem_anom=True)
+        env.rec.reconcile(POLICY)
+        payload = env.directives()["directives"]
+        assert len(payload) == 2
+        assert sorted(payload) == [env.node(0), env.node(1)]
+        status = env.status()["remediation"]
+        assert status["windowUsed"] == 2
+        assert status["windowMax"] == 2
+        assert len(status["budgetDenied"]) == 2
+        assert "RemediationBudgetExhausted" in env.reasons()
+        # steady storm: the event is edge-gated, denials keep counting
+        n_events = env.reasons().count("RemediationBudgetExhausted")
+        env.clock[0] += 1.0
+        env.rec.reconcile(POLICY)
+        assert env.reasons().count("RemediationBudgetExhausted") \
+            == n_events
+
+    def test_quorum_floor_holds_disruptive_actions(self):
+        # the floor is a fleet MAJORITY (6 members -> 3): with 3
+        # anomalous, healthy (3) <= floor (3) — the disruptive bounce
+        # must wait.  Deliberately independent of probe.quorum, which
+        # is a per-node PEER count, not a fleet size.
+        env = HealCluster()
+        for i in range(3):
+            env.report(i, telem_anom=True)
+        env.rec.reconcile(POLICY)
+        assert env.directives()["directives"] == {}
+        # the hold is SURFACED: one edge-gated Event + a status list
+        # (an invisible gate would read as remediation silently broken)
+        assert "RemediationQuorumHeld" in env.reasons()
+        status = env.status()["remediation"]
+        assert len(status["quorumHeld"]) == 3
+        n_events = env.reasons().count("RemediationQuorumHeld")
+        env.clock[0] += 1.0
+        env.rec.reconcile(POLICY)
+        assert env.reasons().count("RemediationQuorumHeld") == n_events
+
+    def test_status_rollup_fields(self):
+        env = HealCluster()
+        env.report(2, telem_anom=True)
+        env.rec.reconcile(POLICY)
+        status = env.status()["remediation"]
+        assert status["active"] == 1
+        assert status["pending"] == [
+            f"{env.node(2)}: {ACTION_BOUNCE}"
+        ]
+        assert status["actionsTotal"] == 1
+
+    def test_disable_edge_deletes_configmaps(self):
+        env = HealCluster()
+        env.report(2, telem_anom=True)
+        env.rec.reconcile(POLICY)
+        raw = env.fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+        policy = NetworkClusterPolicy.from_dict(raw)
+        policy.spec.tpu_scale_out.remediation.enabled = False
+        env.fake.update(policy.to_dict())
+        env.rec.reconcile(POLICY)
+        assert env.status().get("remediation") is None
+        for name in (rpt.remediation_configmap_name(POLICY),
+                     rpt.directive_configmap_name(POLICY)):
+            with pytest.raises(kerr.NotFoundError):
+                env.fake.get("v1", "ConfigMap", name, NAMESPACE)
+
+    def test_cr_delete_drops_state(self):
+        env = HealCluster()
+        env.report(2, telem_anom=True)
+        env.rec.reconcile(POLICY)
+        assert env.rec._rem_ledgers.get(POLICY) is not None
+        env.fake.delete(API_VERSION, "NetworkClusterPolicy", POLICY,
+                        "")
+        env.rec.reconcile(POLICY)
+        assert env.rec._rem_ledgers.get(POLICY) is None
+        assert env.rec._rem_applied.get(POLICY) is None
+
+    def test_quarantine_passes_spec_honored(self):
+        env = HealCluster()
+        raw = env.fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+        policy = NetworkClusterPolicy.from_dict(raw)
+        policy.spec.tpu_scale_out.probe.quarantine_passes = 1
+        env.fake.update(policy.to_dict())
+        env.report(1, probe_degraded=True)
+        env.rec.reconcile(POLICY)
+        rows = {
+            r["node"]: r["state"]
+            for r in env.status().get("probeNodes", [])
+        }
+        # one degraded pass suffices at quarantinePasses=1 (default 3)
+        assert rows[env.node(1)] == t.PROBE_STATE_QUARANTINED
+
+    def test_remediation_metrics(self):
+        env = HealCluster()
+        env.report(2, telem_anom=True)
+        env.rec.reconcile(POLICY)
+        counters = {
+            (name, dict(labels).get("action"))
+            for (name, labels), v in env.metrics._counters.items()
+            if v and name.startswith("tpunet_remediation")
+        }
+        assert ("tpunet_remediation_actions_total", ACTION_BOUNCE) \
+            in counters
+        gauge = env.metrics._gauges.get((
+            "tpunet_remediation_pending",
+            (("policy", POLICY),),
+        ))
+        assert gauge == 1.0
+
+
+# -- agent directive handling -------------------------------------------------
+
+
+class FakeRunner:
+    def __init__(self):
+        self.steps = 0
+        self.refreshes = 0
+
+    def step(self):
+        self.steps += 1
+
+    def refresh_peers(self):
+        self.refreshes += 1
+
+    def ready(self):
+        return True
+
+
+def agent_rig(monkeypatch, fake, mode="L2", remediation=True):
+    monkeypatch.setattr(agent_cli, "_kube_client", lambda: fake)
+    monkeypatch.setenv("NODE_NAME", "node-000")
+    ops = FakeLinkOps()
+    configs = {}
+    for idx, iface in enumerate(("ens9", "ens10")):
+        link = ops.add_fake_link(
+            iface, idx + 2, f"02:00:00:00:00:{idx:02x}", up=True
+        )
+        configs[iface] = net.NetworkConfiguration(
+            link=link, orig_flags=link.flags
+        )
+        if mode == "L3":
+            configs[iface].local_addr = f"10.1.{idx}.2"
+            configs[iface].lldp_peer = f"10.1.{idx}.1"
+    config = agent_cli.CmdConfig(
+        backend="tpu", mode=mode, ops=ops,
+        report_namespace=NAMESPACE, policy_name=POLICY,
+        remediation_enabled=remediation, telemetry_enabled=False,
+    )
+    return ops, configs, config, agent_cli._MonitorState()
+
+
+def distribute(fake, row, version="1"):
+    payload = {"version": version, "directives": {"node-000": row}}
+    fake.apply({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {
+            "name": rpt.directive_configmap_name(POLICY),
+            "namespace": NAMESPACE,
+        },
+        "data": {rpt.DIRECTIVES_KEY: json.dumps(payload)},
+    })
+
+
+def row_for(action, iface="", did="d1", version="1"):
+    return {"id": did, "node": "node-000", "class": "telemetry",
+            "action": action, "iface": iface, "issuedAt": 1.0,
+            "ledgerVersion": version}
+
+
+class TestAgentDirectives:
+    def test_bounce_executes_and_rederives_routes(self, monkeypatch):
+        fake = FakeCluster()
+        ops, configs, config, state = agent_rig(monkeypatch, fake,
+                                                mode="L3")
+        distribute(fake, row_for(ACTION_BOUNCE, iface="ens9"))
+        agent_cli._sync_remediation(config, state, configs)
+        assert state.remediation_outcome["ok"] is True
+        assert ops.downs == ["ens9"] and ops.ups == ["ens9"]
+        # the /16 route re-derived through the network.py path
+        assert any(
+            r["dst"].endswith("/16") and r["gateway"] == "10.1.0.1"
+            for r in ops.route_list()
+        )
+        assert state.report_synced is False
+
+    def test_missing_interface_reports_failure_not_raise(
+        self, monkeypatch
+    ):
+        fake = FakeCluster()
+        ops, configs, config, state = agent_rig(monkeypatch, fake)
+        distribute(fake, row_for(ACTION_BOUNCE, iface="gone0"))
+        agent_cli._sync_remediation(config, state, configs)
+        out = state.remediation_outcome
+        assert out["ok"] is False
+        assert "gone0" in out["error"]
+        assert ops.downs == []
+
+    def test_netlink_error_becomes_failure_outcome(self, monkeypatch):
+        fake = FakeCluster()
+        ops, configs, config, state = agent_rig(monkeypatch, fake)
+        ops.fail_link_set_up = "ens9"
+        distribute(fake, row_for(ACTION_BOUNCE, iface="ens9"))
+        agent_cli._sync_remediation(config, state, configs)
+        out = state.remediation_outcome
+        assert out["ok"] is False and "netlink" in out["error"]
+
+    def test_stale_ledger_version_ignored(self, monkeypatch):
+        fake = FakeCluster()
+        _, configs, config, state = agent_rig(monkeypatch, fake)
+        distribute(fake, row_for(ACTION_BOUNCE, iface="ens9",
+                                 version="1"), version="2")
+        agent_cli._sync_remediation(config, state, configs)
+        assert state.remediation_outcome is None
+        assert state.executed_directives == []
+
+    def test_executed_directive_never_refires(self, monkeypatch):
+        fake = FakeCluster()
+        ops, configs, config, state = agent_rig(monkeypatch, fake)
+        distribute(fake, row_for(ACTION_BOUNCE, iface="ens9"))
+        agent_cli._sync_remediation(config, state, configs)
+        assert ops.downs == ["ens9"]
+        # redistribution of the same id (controller still waiting on
+        # the Lease to carry the outcome): no second bounce
+        state.remediation_fetched_at = -1e9
+        agent_cli._sync_remediation(config, state, configs)
+        assert ops.downs == ["ens9"]
+
+    def test_outage_defers_and_resumes_on_reconnect(self, monkeypatch):
+        fake = FakeCluster()
+        ops, configs, config, state = agent_rig(monkeypatch, fake)
+        state.publish_failures = 3   # PR 5 outage mode
+        distribute(fake, row_for(ACTION_BOUNCE, iface="ens9"))
+        gets = fake.request_counts.get(("get", "ConfigMap"), 0)
+        agent_cli._sync_remediation(config, state, configs)
+        assert state.remediation_outcome is None
+        assert state.remediation_deferred is True
+        assert ops.downs == []
+        # no fetch either: the apiserver is what we cannot reach
+        assert fake.request_counts.get(("get", "ConfigMap"), 0) == gets
+        agent_cli._sync_remediation(config, state, configs)
+        assert ops.downs == []
+        # reconnect: the CURRENT directive set is re-fetched (TTL
+        # bypassed) and executed on the first post-outage tick
+        state.publish_failures = 0
+        agent_cli._sync_remediation(config, state, configs)
+        assert ops.downs == ["ens9"]
+        assert state.remediation_deferred is False
+
+    def test_directive_withdrawn_during_outage_never_fires(
+        self, monkeypatch
+    ):
+        """The reconnect path must act on the CONTROLLER'S current
+        directive set, not a pre-outage copy: a directive withdrawn
+        (or escalated past) while the agent was deaf must not fire."""
+        fake = FakeCluster()
+        ops, configs, config, state = agent_rig(monkeypatch, fake)
+        # the agent saw the directive once BEFORE the outage but had
+        # already executed nothing (fetched, then outage hit mid-tick)
+        distribute(fake, row_for(ACTION_BOUNCE, iface="ens9"))
+        state.publish_failures = 1
+        agent_cli._sync_remediation(config, state, configs)
+        assert ops.downs == []
+        # the controller withdraws the directive during the outage
+        fake.delete("v1", "ConfigMap",
+                    rpt.directive_configmap_name(POLICY), NAMESPACE)
+        state.publish_failures = 0
+        agent_cli._sync_remediation(config, state, configs)
+        assert ops.downs == []
+        assert state.remediation_outcome is None
+
+    def test_reprobe_and_peer_shift_drive_runner(self, monkeypatch):
+        fake = FakeCluster()
+        _, configs, config, state = agent_rig(monkeypatch, fake)
+        runner = FakeRunner()
+        distribute(fake, row_for(ACTION_REPROBE, did="p1"))
+        state.remediation_fetched_at = -1e9
+        agent_cli._sync_remediation(config, state, configs,
+                                    probe_runner=runner)
+        assert runner.steps == 1
+        distribute(fake, row_for(ACTION_PEER_SHIFT, did="p2"))
+        state.remediation_fetched_at = -1e9
+        agent_cli._sync_remediation(config, state, configs,
+                                    probe_runner=runner)
+        assert runner.refreshes == 1
+        assert state.remediation_outcome["ok"] is True
+
+    def test_reprobe_without_runner_fails(self, monkeypatch):
+        fake = FakeCluster()
+        _, configs, config, state = agent_rig(monkeypatch, fake)
+        distribute(fake, row_for(ACTION_REPROBE))
+        agent_cli._sync_remediation(config, state, configs)
+        assert state.remediation_outcome["ok"] is False
+
+    def test_reroute_l2_is_noop_success(self, monkeypatch):
+        fake = FakeCluster()
+        _, configs, config, state = agent_rig(monkeypatch, fake)
+        distribute(fake, row_for(ACTION_REROUTE, iface="ens9"))
+        agent_cli._sync_remediation(config, state, configs)
+        assert state.remediation_outcome["ok"] is True
+
+    def test_reroute_l3_reconfigures_healthy_interfaces(
+        self, monkeypatch
+    ):
+        fake = FakeCluster()
+        ops, configs, config, state = agent_rig(monkeypatch, fake,
+                                                mode="L3")
+        distribute(fake, row_for(ACTION_REROUTE, iface="ens9"))
+        agent_cli._sync_remediation(config, state, configs)
+        assert state.remediation_outcome["ok"] is True
+        # only the healthy interface's routes re-derived
+        gateways = {r["gateway"] for r in ops.route_list()}
+        assert "10.1.1.1" in gateways and "10.1.0.1" not in gateways
+
+    def test_unknown_action_fails_forward_compatibly(self, monkeypatch):
+        fake = FakeCluster()
+        _, configs, config, state = agent_rig(monkeypatch, fake)
+        distribute(fake, row_for("quantum-entangle"))
+        agent_cli._sync_remediation(config, state, configs)
+        out = state.remediation_outcome
+        assert out["ok"] is False and "unsupported" in out["error"]
+
+    def test_disabled_never_fetches(self, monkeypatch):
+        fake = FakeCluster()
+        _, configs, config, state = agent_rig(monkeypatch, fake,
+                                              remediation=False)
+        distribute(fake, row_for(ACTION_BOUNCE, iface="ens9"))
+        before = fake.request_counts.get(("get", "ConfigMap"), 0)
+        agent_cli._sync_remediation(config, state, configs)
+        assert state.remediation_outcome is None
+        assert fake.request_counts.get(("get", "ConfigMap"), 0) == before
+
+    def test_outcome_rides_the_report_lease(self, monkeypatch):
+        fake = FakeCluster()
+        _, configs, config, state = agent_rig(monkeypatch, fake)
+        distribute(fake, row_for(ACTION_BOUNCE, iface="ens9"))
+        agent_cli._monitor_tick(config, configs, "", "x", state)
+        lease = fake.get(
+            rpt.LEASE_API, "Lease", rpt.lease_name("node-000"),
+            NAMESPACE,
+        )
+        rep = rpt.ProvisioningReport.from_json(
+            lease["metadata"]["annotations"][rpt.REPORT_ANNOTATION]
+        )
+        assert rep.remediation["directiveId"] == "d1"
+        assert rep.remediation["ok"] is True
+
+
+# -- FakeFabric per-directional link faults + chaos helper --------------------
+
+
+class TestFakeFabricLinks:
+    def _pair(self):
+        fabric = FakeFabric(seed=1, latency=0.0)
+        a = fabric.open("10.0.0.1:9")
+        b = fabric.open("10.0.0.2:9")
+        return fabric, a, b
+
+    def test_directional_down_blocks_one_way_only(self):
+        fabric, a, b = self._pair()
+        fabric.set_link_down("10.0.0.1", "10.0.0.2",
+                             bidirectional=False)
+        a.send("10.0.0.2:9", b"x")
+        assert fabric.dropped == 1 and b.inbox == []
+        b.send("10.0.0.1:9", b"y")
+        assert fabric.delivered == 1 and len(a.inbox) == 1
+
+    def test_bidirectional_down_and_heal(self):
+        fabric, a, b = self._pair()
+        fabric.set_link_down("10.0.0.1", "10.0.0.2")
+        a.send("10.0.0.2:9", b"x")
+        b.send("10.0.0.1:9", b"y")
+        assert fabric.dropped == 2
+        fabric.heal_link("10.0.0.2", "10.0.0.1")   # order-insensitive
+        a.send("10.0.0.2:9", b"x")
+        assert fabric.delivered == 1
+
+    def test_fabric_chaos_helper_counts_and_heals_all(self):
+        fabric, a, b = self._pair()
+        chaos = FabricChaos(fabric)
+        chaos.link_down("10.0.0.1", "10.0.0.2")
+        chaos.set_loss("10.0.0.2", 0.5)
+        assert chaos.injected[("link-down", "10.0.0.1", "10.0.0.2")] == 1
+        a.send("10.0.0.2:9", b"x")
+        assert fabric.dropped == 1
+        assert chaos.heal_all() == 1
+        assert chaos.downed == set()
+        a.send("10.0.0.2:9", b"x")
+        a.send("10.0.0.2:9", b"x")
+        # loss dial still applies (healing links is not healing loss)
+        assert fabric.delivered + fabric.dropped == 3
+
+
+# -- diag bundle --------------------------------------------------------------
+
+
+class TestDiagBundle:
+    def test_bundle_collects_plan_and_remediation_configmaps(self):
+        import sys
+
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(__file__), "..", "tools")
+        )
+        import diag
+
+        env = HealCluster()
+        env.report(2, telem_anom=True)
+        env.rec.reconcile(POLICY)
+        # a plan CM rides along (prefix coverage, not planner logic)
+        env.fake.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {
+                "name": rpt.plan_configmap_name(POLICY),
+                "namespace": NAMESPACE,
+            },
+            "data": {rpt.PLAN_KEY: "{}",
+                     "secretToken": "hunter2"},
+        })
+        files = diag.collect_files(env.fake, NAMESPACE)
+        names = set(files)
+        assert f"configmaps/{rpt.remediation_configmap_name(POLICY)}" \
+            ".json" in names
+        assert f"configmaps/{rpt.directive_configmap_name(POLICY)}" \
+            ".json" in names
+        assert f"configmaps/{rpt.plan_configmap_name(POLICY)}.json" \
+            in names
+        # redaction rules apply to the new sections too
+        plan_dump = files[
+            f"configmaps/{rpt.plan_configmap_name(POLICY)}.json"
+        ]
+        assert "hunter2" not in plan_dump
+        assert "**REDACTED**" in plan_dump
+
+    def test_unrelated_configmaps_excluded(self):
+        import sys
+
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(__file__), "..", "tools")
+        )
+        import diag
+
+        env = HealCluster()
+        env.fake.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "app-config",
+                         "namespace": NAMESPACE},
+            "data": {"anything": "private"},
+        })
+        files = diag.collect_files(env.fake, NAMESPACE)
+        assert not any("app-config" in name for name in files)

@@ -124,11 +124,20 @@ def collect_files(
         files["events.json"] = _jdump(redact(items))
 
     def peer_configmaps():
+        # every operator-owned distribution surface rides ConfigMaps:
+        # probe peer lists, the topology plan, and the remediation
+        # ledger + directive pair.  ONLY these prefixes are collected —
+        # never co-located app config (could hold anything)
+        prefixes = (
+            rpt.PEER_CONFIGMAP_PREFIX,
+            rpt.PLAN_CONFIGMAP_PREFIX,
+            rpt.REMEDIATION_CONFIGMAP_PREFIX,
+            rpt.DIRECTIVE_CONFIGMAP_PREFIX,
+        )
         for cm in client.list("v1", "ConfigMap", namespace=namespace):
             name = cm.get("metadata", {}).get("name", "")
-            if not name.startswith(rpt.PEER_CONFIGMAP_PREFIX):
-                continue   # only the operator's own peer lists; never
-                # co-located app config (could hold anything)
+            if not name.startswith(prefixes):
+                continue
             files[f"configmaps/{_safe_name(name)}.json"] = _jdump(
                 redact(cm)
             )

@@ -182,6 +182,16 @@ def main() -> int:
         maybe_run_phase(out, "planner-bench",
                   [py, "tools/planner_bench.py",
                    "--out", "BENCH_planner.json"], timeout=600)
+        # 15. self-healing remediation: a flapping link converges to
+        # bounce-then-heal without label flapping (vs detection-only),
+        # a persistent-loss link escalates to route re-derivation and
+        # is routed around by the planner in one replan, and a
+        # 30%-of-fleet anomaly storm is held to exactly the
+        # maxNodesPerWindow budget (no TPU, in-process FakeCluster +
+        # FakeFabric)
+        maybe_run_phase(out, "remediation-bench",
+                  [py, "tools/remediation_bench.py",
+                   "--out", "BENCH_remediation.json"], timeout=600)
     print(f"done -> {args.out}")
     return 0
 

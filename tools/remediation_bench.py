@@ -1,0 +1,551 @@
+#!/usr/bin/env python3
+"""Self-healing remediation benchmark — prints ONE JSON line (BENCH-style).
+
+Proves the remediation subsystem's contract points on deterministic
+FakeFabric/FakeLinkOps + FakeCluster scenarios (no TPU, no sockets),
+each through the REAL reconciler `_sync_remediation` pass and (where an
+agent acts) the REAL agent monitor tick:
+
+1. **Flapping link converges** — a stuck NIC that bursts rx-errors
+   every few ticks flaps the readiness label under detection alone.
+   With remediation on, the anomaly draws a bounce-interface directive,
+   the agent executes it through LinkOps (which clears the stuck
+   queue), and the node converges: ≤ 2 label transitions
+   (retract → restore), never more than the detection-only run — the
+   headline "remediation never increases flaps" comparison.
+
+2. **Persistent degradation escalates** — a link whose anomaly
+   survives `escalateAfter` bounces escalates to route re-derivation,
+   and the topology planner routes around the node within ONE replan
+   of the anomaly appearing (the remediation and planner loops
+   compose: act on the node, plan around it meanwhile).
+
+3. **Anomaly storm held to budget** — 30% of a 20-node fleet goes
+   anomalous at once; at most `maxNodesPerWindow` distinct nodes are
+   ever remediated per sliding window (exactly K, the rest stay
+   quarantined), and budget denials are counted exactly.
+
+Usage: python tools/remediation_bench.py [--out BENCH_remediation.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+NAMESPACE = "tpunet-system"
+POLICY = "heal-bench"
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_policy(max_per_window=3, window=300, cooldown=180,
+                escalate_after=2, planner=False, remediation=True,
+                quorum=0):
+    from tpu_network_operator.api.v1alpha1 import (
+        NetworkClusterPolicy,
+        default_policy,
+    )
+
+    p = NetworkClusterPolicy()
+    p.metadata.name = POLICY
+    p.spec.configuration_type = "tpu-so"
+    p.spec.node_selector = {"tpunet.dev/pool": POLICY}
+    so = p.spec.tpu_scale_out
+    so.probe.enabled = True
+    so.probe.interval_seconds = 5
+    so.probe.quorum = quorum
+    so.planner.enabled = planner
+    r = so.remediation
+    r.enabled = remediation
+    r.max_nodes_per_window = max_per_window
+    r.window_seconds = window
+    r.cooldown_seconds = cooldown
+    r.escalate_after = escalate_after
+    return default_policy(p)
+
+
+def synthetic_report(node, i, n, telem_anom=False, outcome=None,
+                     peers_ms=None):
+    """A healthy synthetic fleet member's report Lease payload (the
+    real-agent node publishes its own through _monitor_tick)."""
+    from tpu_network_operator.agent import report as rpt
+
+    peers = peers_ms or {}
+    probe = {
+        "peersTotal": n - 1,
+        "peersReachable": n - 1,
+        "unreachable": [],
+        "rttP50Ms": 0.4,
+        "rttP99Ms": 1.1,
+        "lossRatio": 0.0,
+        "state": "Healthy",
+        "peers": {
+            p: {"rttMs": round(ms, 3), "lossRatio": 0.0,
+                "reachable": True}
+            for p, ms in peers.items()
+        },
+    }
+    telemetry = {"interfaces": {"ens9": {
+        "rxBytes": 1 << 20, "rxPackets": 10_000,
+        "rxErrors": 5000 if telem_anom else 0,
+        "errorRatio": 0.33 if telem_anom else 0.0,
+        "anomalies": ["error-ratio"] if telem_anom else [],
+    }}}
+    return rpt.ProvisioningReport(
+        node=node, policy=POLICY, ok=True, backend="tpu", mode="L2",
+        interfaces_configured=2, interfaces_total=2,
+        probe_endpoint=f"10.0.0.{i % 250 + 1}:8477",
+        probe=probe, telemetry=telemetry, remediation=outcome,
+    )
+
+
+def make_cluster(policy, nodes):
+    from tpu_network_operator.agent import report as rpt
+    from tpu_network_operator.controller.health import Metrics
+    from tpu_network_operator.controller.reconciler import (
+        NetworkClusterPolicyReconciler,
+    )
+    from tpu_network_operator.kube.fake import FakeCluster
+    from tpu_network_operator.obs import EventRecorder
+
+    fake = FakeCluster()
+    fake.create(policy.to_dict())
+    n = len(nodes)
+    for i, node in enumerate(nodes):
+        fake.add_node(node, {"tpunet.dev/pool": POLICY})
+        fake.apply(rpt.lease_for(
+            synthetic_report(node, i, n), NAMESPACE
+        ))
+    metrics = Metrics()
+    rec = NetworkClusterPolicyReconciler(
+        fake, NAMESPACE, metrics=metrics,
+        events=EventRecorder(fake, NAMESPACE),
+    )
+    clock = [10_000.0]
+    rec._rem_clock = lambda: clock[0]
+    rec.setup()
+    rec.reconcile(POLICY)
+    fake.simulate_daemonset_controller()
+    rec.reconcile(POLICY)
+    return fake, rec, metrics, clock
+
+
+def counter_value(metrics, name, **labels):
+    total = 0.0
+    for (metric, lbls), val in metrics._counters.items():
+        if metric == name and all(
+            dict(lbls).get(k) == v for k, v in labels.items()
+        ):
+            total += val
+    return total
+
+
+# -- scenario 1: flapping link — bounce-then-heal vs detection-only -----------
+
+
+def run_flap(remediation: bool, ticks: int = 20, seed: int = 7):
+    """Drive the REAL agent monitor tick (fake LinkOps, manual
+    telemetry clock) against the REAL reconciler: a stuck NIC bursts
+    rx-errors every 4th tick until bounced; with remediation the
+    controller's bounce directive clears it, detection-only flaps
+    forever.  Returns (label_transitions, bounces, events)."""
+    from tests.fake_ops import FakeLinkOps
+    from tpu_network_operator import nfd
+    from tpu_network_operator.agent import cli as agent_cli
+    from tpu_network_operator.agent import network as net
+    from tpu_network_operator.agent import telemetry as telem
+
+    del seed   # fully deterministic scenario; kept for CLI symmetry
+    n_pad = 6  # synthetic healthy fleet members (quorum floor head-room)
+    pad_nodes = [f"pad-{i:02d}" for i in range(n_pad)]
+    agent_node = "node-agent"
+    policy = make_policy(remediation=remediation)
+    fake, rec, metrics, clock = make_cluster(
+        policy, pad_nodes + [agent_node]
+    )
+    agent_cli._kube_client = lambda: fake
+    os.environ["NODE_NAME"] = agent_node
+
+    ops = FakeLinkOps()
+    configs = {}
+    for idx, iface in enumerate(("ens9", "ens10")):
+        link = ops.add_fake_link(
+            iface, idx + 2, f"02:00:00:00:00:{idx:02x}", up=True
+        )
+        ops.bump_counters(iface, rx_packets=10_000, tx_packets=10_000)
+        configs[iface] = net.NetworkConfiguration(
+            link=link, orig_flags=link.flags
+        )
+    transitions = 0
+    bounces = 0
+    with tempfile.TemporaryDirectory() as nfd_root:
+        os.makedirs(os.path.join(
+            nfd_root, "etc/kubernetes/node-feature-discovery/features.d"
+        ))
+        config = agent_cli.CmdConfig(
+            backend="tpu", mode="L2", ops=ops,
+            report_namespace=NAMESPACE, policy_name=POLICY,
+            telemetry_enabled=True, remediation_enabled=remediation,
+            nfd_root=nfd_root,
+        )
+        state = agent_cli._MonitorState()
+        tclock = [0.0]
+        state.telemetry = telem.TelemetryMonitor(
+            window=3, clock=lambda: tclock[0]
+        )
+        label_file = os.path.join(
+            nfd.labels.features_dir(nfd_root), nfd.labels.NFD_FILE_NAME
+        )
+        nfd.write_readiness_label("x", root=nfd_root)
+        stuck = True
+        last_label = True
+        prev_downs = 0
+        for tick in range(ticks):
+            tclock[0] += 60.0
+            clock[0] += 60.0
+            for iface in configs:
+                ops.bump_counters(
+                    iface, rx_packets=1000, tx_packets=1000
+                )
+            if stuck and tick % 4 == 0:
+                # the stuck queue corrupts a burst of frames
+                ops.bump_counters("ens9", rx_errors=5000)
+            # the bench compresses a 60s tick into microseconds: allow
+            # the directive poll every tick instead of the 30s TTL
+            state.remediation_fetched_at = -1e9
+            agent_cli._monitor_tick(config, configs, "", "x", state)
+            if len(ops.downs) > prev_downs:
+                # a bounce directive executed — model the bounce
+                # clearing the wedged NIC queue
+                prev_downs = len(ops.downs)
+                bounces += 1
+                stuck = False
+            rec.reconcile(POLICY)
+            label = os.path.exists(label_file)
+            if label != last_label:
+                transitions += 1
+                last_label = label
+    events = [
+        e["reason"] for e in fake.events(involved_name=POLICY)
+        if e["reason"].startswith("Remediation")
+    ]
+    return transitions, bounces, events
+
+
+def scenario_flap():
+    log("== flapping link: remediation vs detection-only")
+    healed_transitions, bounces, events = run_flap(remediation=True)
+    detection_transitions, _, _ = run_flap(remediation=False)
+    row = {
+        "ticks": 20,
+        "remediation_label_transitions": healed_transitions,
+        "detection_only_label_transitions": detection_transitions,
+        "bounces": bounces,
+        "events": sorted(set(events)),
+        "converged": healed_transitions <= 2,
+        "no_worse_than_detection":
+            healed_transitions <= detection_transitions,
+    }
+    log(f"   -> {healed_transitions} transitions with remediation "
+        f"({bounces} bounce(s)) vs {detection_transitions} "
+        "detection-only")
+    return row
+
+
+# -- scenario 2: persistent loss — escalation + planner exclusion -------------
+
+
+def scenario_escalation(n: int = 12):
+    """A victim whose anomaly survives every bounce: the ladder must
+    escalate to route re-derivation, and the planner must route around
+    the node in ONE replan of the anomaly appearing."""
+    import tests.fake_ops as fake_ops
+    from tpu_network_operator.agent import cli as agent_cli
+    from tpu_network_operator.agent import network as net
+    from tpu_network_operator.agent import report as rpt
+    from tpu_network_operator.api.v1alpha1.types import API_VERSION
+
+    log("== persistent-loss link: escalation + plan exclusion")
+    nodes = [f"node-{i:03d}" for i in range(n)]
+    peers_ms = {
+        a: {b: 0.5 for b in nodes if b != a} for a in nodes
+    }
+    policy = make_policy(planner=True, cooldown=60, escalate_after=2)
+    from tpu_network_operator.controller.health import Metrics
+    from tpu_network_operator.controller.reconciler import (
+        NetworkClusterPolicyReconciler,
+    )
+    from tpu_network_operator.kube.fake import FakeCluster
+    from tpu_network_operator.obs import EventRecorder
+
+    fake = FakeCluster()
+    fake.create(policy.to_dict())
+    for i, node in enumerate(nodes):
+        fake.add_node(node, {"tpunet.dev/pool": POLICY})
+        fake.apply(rpt.lease_for(synthetic_report(
+            node, i, n, peers_ms=peers_ms[node]
+        ), NAMESPACE))
+    metrics = Metrics()
+    rec = NetworkClusterPolicyReconciler(
+        fake, NAMESPACE, metrics=metrics,
+        events=EventRecorder(fake, NAMESPACE),
+    )
+    clock = [50_000.0]
+    rec._rem_clock = lambda: clock[0]
+    rec.setup()
+    rec.reconcile(POLICY)
+    fake.simulate_daemonset_controller()
+    rec.reconcile(POLICY)
+
+    victim, vi = nodes[n // 2], n // 2
+
+    def directive_for(node):
+        cm = fake.get(
+            "v1", "ConfigMap", rpt.directive_configmap_name(POLICY),
+            NAMESPACE,
+        )
+        payload = json.loads(cm["data"][rpt.DIRECTIVES_KEY])
+        return payload["directives"].get(node)
+
+    def plan():
+        cm = fake.get(
+            "v1", "ConfigMap", rpt.plan_configmap_name(POLICY),
+            NAMESPACE,
+        )
+        return json.loads(cm["data"][rpt.PLAN_KEY])
+
+    # the victim's agent rig: directives execute through the REAL
+    # handler against fake LinkOps (L3: addressed links + routes)
+    ops = fake_ops.FakeLinkOps()
+    configs = {}
+    for idx, iface in enumerate(("ens9", "ens10")):
+        link = ops.add_fake_link(
+            iface, idx + 2, f"02:00:00:00:01:{idx:02x}", up=True
+        )
+        configs[iface] = net.NetworkConfiguration(
+            link=link, orig_flags=link.flags
+        )
+        configs[iface].local_addr = f"10.1.{idx}.2"
+        configs[iface].lldp_peer = f"10.1.{idx}.1"
+    config = agent_cli.CmdConfig(backend="tpu", mode="L3", ops=ops)
+
+    # anomaly appears: ONE reconcile must both issue the first rung
+    # and exclude the victim from the plan (planner exclusions already
+    # cover telemetry-anomalous nodes — remediation rides alongside)
+    fake.apply(rpt.lease_for(synthetic_report(
+        victim, vi, n, telem_anom=True, peers_ms=peers_ms[victim]
+    ), NAMESPACE))
+    rec.reconcile(POLICY)
+    excluded_in_one = victim in plan().get("excluded", [])
+    actions = []
+    for _ in range(3):
+        d = directive_for(victim)
+        if d is None:
+            break
+        actions.append(d["action"])
+        outcome = agent_cli._execute_directive(config, configs, d)
+        fake.apply(rpt.lease_for(synthetic_report(
+            victim, vi, n, telem_anom=True, outcome=outcome,
+            peers_ms=peers_ms[victim],
+        ), NAMESPACE))
+        clock[0] += 90.0   # past the 60s cooldown
+        rec.reconcile(POLICY)
+    escalated = counter_value(
+        metrics, "tpunet_remediation_escalations_total", policy=POLICY
+    )
+    # recovery: the reroute steered traffic off the bad link — anomaly
+    # clears, and once the cooldown elapses (flap protection holds the
+    # ledger entry inside it) the heal edge fires and the node is
+    # readmitted to the plan
+    fake.apply(rpt.lease_for(synthetic_report(
+        victim, vi, n, peers_ms=peers_ms[victim]
+    ), NAMESPACE))
+    clock[0] += 120.0
+    rec.reconcile(POLICY)
+    readmitted = victim in plan().get("ring", [])
+    cr = fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+    events = sorted({
+        e["reason"] for e in fake.events(involved_name=POLICY)
+        if e["reason"].startswith("Remediation")
+    })
+    row = {
+        "nodes": n,
+        "actions": actions,
+        "escalated_to_reroute": "reroute" in actions,
+        "escalations": escalated,
+        "excluded_from_plan_in_one_replan": excluded_in_one,
+        "readmitted_after_recovery": readmitted,
+        "healed_event": "RemediationSucceeded" in events,
+        "events": events,
+        "status_remediation": (
+            (cr.get("status", {}) or {}).get("remediation") or {}
+        ),
+    }
+    log(f"   -> ladder walked {actions}, excluded in one replan: "
+        f"{excluded_in_one}, readmitted: {readmitted}")
+    return row
+
+
+# -- scenario 3: anomaly storm held to the budget -----------------------------
+
+
+def scenario_storm(n: int = 20, k: int = 3, anomalous_frac: float = 0.3):
+    from tpu_network_operator.agent import report as rpt
+
+    log(f"== anomaly storm: {int(anomalous_frac * 100)}% of {n} nodes, "
+        f"budget {k}/window")
+    nodes = [f"node-{i:03d}" for i in range(n)]
+    policy = make_policy(
+        max_per_window=k, window=300, cooldown=60, quorum=0
+    )
+    fake, rec, metrics, clock = make_cluster(policy, nodes)
+    n_anom = int(n * anomalous_frac)
+    storm = nodes[:n_anom]
+    for i, node in enumerate(storm):
+        fake.apply(rpt.lease_for(synthetic_report(
+            node, i, n, telem_anom=True
+        ), NAMESPACE))
+
+    def directives():
+        cm = fake.get(
+            "v1", "ConfigMap", rpt.directive_configmap_name(POLICY),
+            NAMESPACE,
+        )
+        return json.loads(cm["data"][rpt.DIRECTIVES_KEY])["directives"]
+
+    max_window_used = 0
+    denials_expected = 0
+    # pass 1 (t0): exactly k admitted, the rest denied
+    rec.reconcile(POLICY)
+    first_wave = sorted(directives())
+    max_window_used = max(max_window_used, len(first_wave))
+    denials_expected += n_anom - k
+    # pass 2 (t0+30, inside cooldown): no new actions, same denials
+    clock[0] += 30.0
+    rec.reconcile(POLICY)
+    second = sorted(directives())
+    max_window_used = max(max_window_used, len(second))
+    denials_expected += n_anom - k
+    no_new_mid_cooldown = second == first_wave
+    # pass 3 (t0+400: window + cooldown expired): the SAME k nodes
+    # retry rung attempts first (still anomalous, sorted order), the
+    # rest stay denied
+    clock[0] += 370.0
+    rec.reconcile(POLICY)
+    third = sorted(directives())
+    max_window_used = max(max_window_used, len(third))
+    denials_expected += n_anom - k
+    denials = counter_value(
+        metrics, "tpunet_remediation_budget_denials_total",
+        policy=POLICY,
+    )
+    actions = counter_value(
+        metrics, "tpunet_remediation_actions_total", policy=POLICY
+    )
+    events = {
+        e["reason"] for e in fake.events(involved_name=POLICY)
+    }
+    row = {
+        "nodes": n,
+        "anomalous": n_anom,
+        "budget_k": k,
+        "first_wave": first_wave,
+        "max_concurrent_remediations": max_window_used,
+        "held_to_budget": max_window_used <= k
+        and len(first_wave) == k,
+        "no_new_actions_mid_cooldown": no_new_mid_cooldown,
+        "budget_denials": denials,
+        "budget_denials_expected": denials_expected,
+        "actions_issued": actions,
+        "budget_event": "RemediationBudgetExhausted" in events,
+    }
+    log(f"   -> {len(first_wave)}/{n_anom} remediated first wave, "
+        f"max concurrent {max_window_used}, denials {denials} "
+        f"(expected {denials_expected})")
+    return row
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default="",
+                    help="also write the JSON artifact to this path")
+    args = ap.parse_args()
+
+    flap = scenario_flap()
+    escalation = scenario_escalation()
+    storm = scenario_storm()
+
+    failures = []
+    if not flap["converged"]:
+        failures.append(
+            f"flap: {flap['remediation_label_transitions']} label "
+            "transitions with remediation (want <= 2)"
+        )
+    if not flap["no_worse_than_detection"]:
+        failures.append("flap: remediation increased label flaps")
+    if flap["bounces"] < 1:
+        failures.append("flap: no bounce executed")
+    if not escalation["escalated_to_reroute"]:
+        failures.append(
+            f"escalation: ladder walked {escalation['actions']} "
+            "without reaching reroute"
+        )
+    if not escalation["excluded_from_plan_in_one_replan"]:
+        failures.append(
+            "escalation: victim not excluded from the plan within "
+            "one replan"
+        )
+    if not escalation["readmitted_after_recovery"]:
+        failures.append("escalation: victim not readmitted on recovery")
+    if not storm["held_to_budget"]:
+        failures.append(
+            f"storm: {storm['max_concurrent_remediations']} concurrent "
+            f"remediations (budget {storm['budget_k']})"
+        )
+    if storm["budget_denials"] != storm["budget_denials_expected"]:
+        failures.append(
+            f"storm: {storm['budget_denials']} budget denials counted "
+            f"(expected exactly {storm['budget_denials_expected']})"
+        )
+    if not storm["budget_event"]:
+        failures.append("storm: no RemediationBudgetExhausted event")
+
+    result = {
+        "metric": "flapping-link label transitions, remediation vs "
+                  "detection-only",
+        "value": flap["remediation_label_transitions"],
+        "unit": "label transitions",
+        # remediated/detection-only transition ratio (<1 = win)
+        "vs_baseline": round(
+            flap["remediation_label_transitions"]
+            / max(flap["detection_only_label_transitions"], 1), 3
+        ),
+        "seed": args.seed,
+        "flap": flap,
+        "escalation": escalation,
+        "storm": storm,
+        "ok": not failures,
+        "failures": failures,
+    }
+    line = json.dumps(result)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(line)
+    if failures:
+        log("FAILED: " + "; ".join(failures))
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -105,6 +105,11 @@ class CmdConfig:
     # bootstrap file; the adopted plan version rides the report Lease
     planner_enabled: bool = False
     plan_version: str = ""
+    # self-healing remediation (remediation/ subsystem): poll the
+    # controller-distributed tpunet-remediate-<policy> ConfigMap each
+    # monitor tick and execute this node's directive through LinkOps;
+    # the outcome rides the report Lease back to the controller
+    remediation_enabled: bool = False
     # this node's discovered ICI slice shape in report wire form
     # (TpuTopology.to_report()), set once per provisioning attempt so
     # every report carries the slice boundaries the planner groups on
@@ -280,6 +285,7 @@ def _publish_report(
     coordinator: str,
     probe_runner=None,
     telemetry=None,
+    remediation=None,
 ) -> bool:
     """Write the per-node provisioning report Lease (VERDICT r3 #3).
     True when it landed (or reporting is off: nothing to sync)."""
@@ -305,6 +311,7 @@ def _publish_report(
         telemetry=telemetry.export() if telemetry else None,
         ici_topology=config.ici_report,
         plan_version=config.plan_version,
+        remediation=remediation,
     )
     return rpt.write_report(client, config.report_namespace, rep)
 
@@ -313,6 +320,7 @@ def _publish_failure_report(
     config: CmdConfig, error: str, probe_runner=None,
     configs: Optional[Dict[str, net.NetworkConfiguration]] = None,
     telemetry=None,
+    remediation=None,
 ) -> bool:
     """ok=False report on a hard provisioning failure: the reconciler
     shows the node's error in status.errors instead of an opaque
@@ -350,6 +358,7 @@ def _publish_failure_report(
             telemetry=telemetry.export() if telemetry else None,
             ici_topology=config.ici_report,
             plan_version=config.plan_version,
+            remediation=remediation,
             agent_version=rpt.agent_version_string(),
         ),
     )
@@ -600,6 +609,9 @@ def _on_probe_transition(
     _publish_failure_report(
         config, error, probe_runner=runner, configs=configs,
         telemetry=monitor_state.telemetry if monitor_state else None,
+        remediation=(
+            monitor_state.remediation_outcome if monitor_state else None
+        ),
     )
     # SAME message construction as the monitor tick's emit: when the
     # tick re-detects this degradation it produces an identical Event
@@ -702,6 +714,232 @@ def _sync_plan(config: CmdConfig, state: "_MonitorState") -> None:
         )
 
 
+# -- self-healing remediation (remediation/ subsystem) -------------------------
+
+# directive poll TTL: the fetch runs at most once per monitor tick
+# (this is a tick step), so the EFFECTIVE pickup cadence is
+# max(recheck_interval, this) — one 60s tick by default.  The
+# controller's unacked-directive expiry budgets for that full chain
+# (cooldown + PENDING_GRACE_SECONDS, remediation/policy.py), so an
+# in-flight directive is never expired out from under the agent.
+REMEDIATION_REFRESH_SECONDS = 30.0
+# already-executed directive ids remembered (a redistributed directive
+# must not re-fire); directives arrive one per node at a time, so a
+# small bound covers any realistic redistribution horizon
+_EXECUTED_DIRECTIVE_MEMORY = 32
+
+
+def _fetch_directives(config: CmdConfig) -> Optional[Dict]:
+    """The controller-distributed remediation directive payload for
+    this policy ({"version": ..., "directives": {node: row}}), or None
+    when absent/unreachable/unparseable — no directive means nothing
+    to execute, never an error."""
+    import json as json_mod
+
+    ctx = _report_ctx(config)
+    if ctx is None:
+        return None
+    _, client = ctx
+    from ..kube import errors as kerr
+    from . import report as rpt
+
+    try:
+        cm = client.get(
+            "v1", "ConfigMap",
+            rpt.directive_configmap_name(config.policy_name),
+            config.report_namespace,
+        )
+        raw = (cm.get("data", {}) or {}).get(rpt.DIRECTIVES_KEY, "")
+        if not raw:
+            return None
+        payload = json_mod.loads(raw)
+        return payload if isinstance(payload, dict) else None
+    except kerr.NotFoundError:
+        log.debug("remediation directives not distributed yet")
+        return None
+    except Exception as e:   # noqa: BLE001 — poll again next window
+        log.debug("remediation directive fetch failed: %s", e)
+        return None
+
+
+def _execute_directive(
+    config: CmdConfig,
+    configs: Dict[str, net.NetworkConfiguration],
+    directive: Dict,
+    probe_runner=None,
+) -> Dict:
+    """Execute one remediation directive through the LinkOps seam and
+    return the outcome payload that rides the report Lease.  EVERY
+    failure mode is an outcome, never a raise — a directive naming an
+    interface that no longer exists must report failure (the controller
+    escalates), not kill the monitor tick."""
+    from ..remediation import policy as rem
+
+    action = str(directive.get("action", ""))
+    iface = str(directive.get("iface", "") or "")
+    outcome = {
+        "directiveId": str(directive.get("id", "")),
+        "action": action,
+        "ok": False,
+        "error": "",
+    }
+    try:
+        if action == rem.ACTION_REPROBE:
+            if probe_runner is None:
+                outcome["error"] = "probe mesh not running"
+            else:
+                probe_runner.step()
+                outcome["ok"] = True
+        elif action == rem.ACTION_PEER_SHIFT:
+            if probe_runner is None:
+                outcome["error"] = "probe mesh not running"
+            else:
+                # drop the cached peer list and probe the refreshed
+                # assignment immediately — the controller may have
+                # shifted this node's peers away from a suspect set
+                probe_runner.refresh_peers()
+                outcome["ok"] = True
+        elif action == rem.ACTION_BOUNCE:
+            cfg = configs.get(iface)
+            if cfg is None:
+                outcome["error"] = (
+                    f"interface {iface!r} not provisioned on this node"
+                )
+            else:
+                config.ops.link_set_down(cfg.link)
+                config.ops.link_set_up(cfg.link)
+                cfg.link = config.ops.link_by_name(iface)
+                if config.mode == L3 and cfg.local_addr is not None:
+                    # route re-derive through the existing network.py
+                    # path: re-ensure the /30 address + /30 and /16
+                    # routes the bounce may have flushed (EEXIST is
+                    # tolerated there, so this is idempotent)
+                    net.configure_interfaces({iface: cfg}, config.ops)
+                log.info("remediation: bounced interface %s", iface)
+                outcome["ok"] = True
+        elif action == rem.ACTION_REROUTE:
+            if config.mode != L3:
+                # L2 carries no derived routes: nothing to re-derive,
+                # and reporting failure would burn a ladder rung on a
+                # structural no-op
+                outcome["ok"] = True
+            else:
+                healthy = {
+                    name: cfg for name, cfg in configs.items()
+                    if name != iface and cfg.local_addr is not None
+                }
+                if not healthy:
+                    outcome["error"] = (
+                        "no healthy addressed interfaces to route "
+                        "through"
+                    )
+                else:
+                    net.configure_interfaces(healthy, config.ops)
+                    log.info(
+                        "remediation: re-derived routes around %s via "
+                        "%s", iface or "<none>", sorted(healthy),
+                    )
+                    outcome["ok"] = True
+        else:
+            # restart-agent executes controller-side (pod roll); an
+            # unknown action here means controller/agent version skew
+            outcome["error"] = f"unsupported action {action!r}"
+    except nl.NetlinkError as e:
+        outcome["error"] = f"netlink: {e}"
+    except Exception as e:   # noqa: BLE001 — outcomes, never raises
+        outcome["error"] = f"{type(e).__name__}: {e}"
+    return outcome
+
+
+def _sync_remediation(
+    config: CmdConfig,
+    state: "_MonitorState",
+    configs: Dict[str, net.NetworkConfiguration],
+    probe_runner=None,
+) -> None:
+    """One remediation step, run from the monitor tick: fetch this
+    node's directive (TTL-memoized), validate it (stale ledger
+    generation ignored, already-executed ids ignored), execute through
+    LinkOps, and queue the outcome for the next report publish.
+
+    Outage mode (control plane unreachable) DEFERS execution entirely:
+    the controller may have withdrawn or escalated past any directive
+    we saw before (or during) the outage, and acting on a stale copy
+    would race the ledger — so nothing fetched earlier is held for
+    replay.  On reconnect the TTL is reset and the CURRENT directive
+    set is re-fetched and executed on that first post-outage tick."""
+    import time
+
+    if not config.remediation_enabled or config.backend != "tpu":
+        return
+    if state.publish_failures > 0:
+        # outage mode: no point fetching (the apiserver is what we
+        # cannot reach) and no execution from memory
+        if not state.remediation_deferred:
+            log.warning(
+                "control plane unreachable; deferring remediation "
+                "directive execution until reconnect",
+            )
+        state.remediation_deferred = True
+        return
+    if state.remediation_deferred:
+        # reconnect: whatever was distributed while we were deaf is
+        # the only directive worth executing — refetch NOW instead of
+        # riding the TTL (or worse, replaying a pre-outage copy)
+        state.remediation_deferred = False
+        state.remediation_fetched_at = -1e9
+    node = os.environ.get("NODE_NAME", "") or "local"
+    now = time.monotonic()
+    if now - state.remediation_fetched_at \
+            < REMEDIATION_REFRESH_SECONDS:
+        return
+    state.remediation_fetched_at = now
+    payload = _fetch_directives(config)
+    if payload is None:
+        return
+    version = str(payload.get("version", ""))
+    directives = payload.get("directives")
+    row = (
+        directives.get(node)
+        if isinstance(directives, dict) else None
+    )
+    if not isinstance(row, dict):
+        return
+    if str(row.get("ledgerVersion", "")) != version:
+        # stale row: issued under an older ledger generation than
+        # the payload advertises (partial merge leftovers, a
+        # mid-update read) — never execute what the controller no
+        # longer stands behind
+        log.debug(
+            "ignoring stale remediation directive %s "
+            "(ledger %s != %s)", row.get("id"),
+            row.get("ledgerVersion"), version,
+        )
+        return
+    directive_id = row.get("id")
+    if not isinstance(directive_id, str) or not directive_id \
+            or directive_id in state.executed_directives:
+        return
+    outcome = _execute_directive(
+        config, configs, row, probe_runner=probe_runner
+    )
+    state.remediation_outcome = outcome
+    state.executed_directives.append(str(row.get("id", "")))
+    del state.executed_directives[:-_EXECUTED_DIRECTIVE_MEMORY]
+    # the outcome must reach the controller promptly (its ledger is
+    # waiting on the ack): force a full republish this tick
+    state.report_synced = False
+    _emit_node_event(
+        config,
+        "Normal" if outcome["ok"] else "Warning",
+        "RemediationActionSucceeded" if outcome["ok"]
+        else "RemediationActionFailed",
+        f"remediation {outcome['action']}"
+        + (f" on {row.get('iface')}" if row.get("iface") else "")
+        + (": ok" if outcome["ok"] else f" failed: {outcome['error']}"),
+    )
+
+
 # peer-list refresh cadence, deliberately much slower than the probe
 # round: membership changes at provisioning speed, not probing speed —
 # fetching the ConfigMap every 10s round per node would reintroduce
@@ -725,6 +963,12 @@ def _make_peer_supplier(config: CmdConfig, node: str):
             cache["peers"] = _probe_peers(config, node)
         return cache["peers"]
 
+    def invalidate():
+        # peer-shift remediation hook (ProbeRunner.refresh_peers):
+        # the next supplier call refetches instead of riding the TTL
+        cache["at"] = -1e9
+
+    supplier.invalidate = invalidate
     return supplier
 
 
@@ -1120,6 +1364,16 @@ class _MonitorState:
     # topology plan fetch TTL clock (see _sync_plan): plans change at
     # hysteresis-gated replan speed, one GET per window is plenty
     plan_fetched_at: float = -1e9
+    # self-healing remediation (see _sync_remediation): directive fetch
+    # TTL clock, the latest executed-action outcome (riding every
+    # report until superseded), the bounded already-executed id memory
+    # (a redistributed directive must not re-fire), and the outage
+    # deferral marker (execution paused; a FRESH fetch resumes it on
+    # reconnect — anything seen pre-outage may have been withdrawn)
+    remediation_fetched_at: float = -1e9
+    remediation_outcome: Optional[Dict] = None
+    executed_directives: List[str] = field(default_factory=list)
+    remediation_deferred: bool = False
     # control-plane degradation (outage-safe degraded mode): consecutive
     # failed publish/renew attempts.  Apiserver unreachability is NOT a
     # dataplane problem — while this is nonzero the agent holds its
@@ -1192,6 +1446,10 @@ def _monitor_tick(
     # adopt any new topology plan FIRST so the publishes below carry
     # the just-adopted plan_version (one tick, not two, to converge)
     _sync_plan(config, state)
+    # then execute any remediation directive BEFORE the verification
+    # pass below: a just-bounced link is re-verified (and the outcome
+    # published) in the same tick, one cycle instead of two
+    _sync_remediation(config, state, configs, probe_runner=probe_runner)
     bad = net.verify_configured(configs, config.ops, config.mode == L3)
     if config.telemetry_enabled and configs:
         # counter telemetry: sample every provisioned interface, and
@@ -1227,6 +1485,7 @@ def _monitor_tick(
                 config, _degradation_error(bad),
                 probe_runner=probe_runner, configs=configs,
                 telemetry=state.telemetry,
+                remediation=state.remediation_outcome,
             ))
             _emit_node_event(
                 config, "Warning", "ReadinessRetracted",
@@ -1237,6 +1496,7 @@ def _monitor_tick(
             state.report_synced = _note_publish(config, state, _publish_report(
                 config, configs, coordinator, probe_runner=probe_runner,
                 telemetry=state.telemetry,
+                remediation=state.remediation_outcome,
             ))
             if probe_runner is None or probe_runner.ready():
                 # same TOCTOU guard as the steady branch: the gate may
@@ -1267,12 +1527,14 @@ def _monitor_tick(
             _publish_report(
                 config, configs, coordinator, probe_runner=probe_runner,
                 telemetry=state.telemetry,
+                remediation=state.remediation_outcome,
             )
             if not bad
             else _publish_failure_report(
                 config, _degradation_error(bad),
                 probe_runner=probe_runner, configs=configs,
                 telemetry=state.telemetry,
+                remediation=state.remediation_outcome,
             )
         ))
         if (
@@ -1413,6 +1675,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="adopt the controller-distributed topology plan "
                         "into the bootstrap file (DCN ring order + "
                         "collective hint; requires --probe)")
+    p.add_argument("--remediation", dest="remediation_enabled",
+                   default=False, type=_parse_strict_bool,
+                   help="execute controller-issued remediation "
+                        "directives (interface bounce, route "
+                        "re-derivation, probe refresh) each recheck "
+                        "tick; requires --probe")
     p.add_argument("--telemetry", dest="telemetry_enabled", default=True,
                    type=_parse_strict_bool,
                    help="sample per-interface counters each recheck and "
@@ -1515,6 +1783,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         probe_fail_threshold=args.probe_fail_threshold,
         probe_recovery_threshold=args.probe_recovery_threshold,
         planner_enabled=args.planner_enabled,
+        remediation_enabled=args.remediation_enabled,
         telemetry_enabled=args.telemetry_enabled,
         telemetry_window=args.telemetry_window,
         telemetry_error_ratio=args.telemetry_error_ratio,

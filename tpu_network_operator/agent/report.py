@@ -82,6 +82,12 @@ class ProvisioningReport:
     # adopted yet — the reconciler reads it to see plan rollout
     # progress across the fleet
     plan_version: str = ""
+    # outcome of the last remediation directive this agent executed
+    # ({"directiveId", "action", "ok", "error"}; remediation/
+    # subsystem) — the reconciler folds it into the execution ledger
+    # so the policy core sees whether its action landed.  None from
+    # agents that never executed one (or predate the field).
+    remediation: Optional[Dict] = None
 
     def to_json(self) -> str:
         # a shallow field dict, not dataclasses.asdict: asdict deep-
@@ -126,6 +132,10 @@ class ProvisioningReport:
             raise ValueError("report field 'probe' not an object")
         if rep.telemetry is not None and not isinstance(rep.telemetry, dict):
             raise ValueError("report field 'telemetry' not an object")
+        if rep.remediation is not None and not isinstance(
+            rep.remediation, dict
+        ):
+            raise ValueError("report field 'remediation' not an object")
         if rep.ici_topology is not None and not isinstance(
             rep.ici_topology, dict
         ):
@@ -202,6 +212,25 @@ PLAN_KEY = "plan"
 
 def plan_configmap_name(policy: str) -> str:
     return PLAN_CONFIGMAP_PREFIX + policy
+
+
+# self-healing remediation (remediation/ subsystem): the execution
+# ledger the controller persists (cooldowns/rungs survive restarts)
+# and the per-node action directives the agents poll on their monitor
+# tick and execute through LinkOps, reporting outcomes back in the
+# report Lease's `remediation` field.
+REMEDIATION_CONFIGMAP_PREFIX = "tpunet-remediation-"
+DIRECTIVE_CONFIGMAP_PREFIX = "tpunet-remediate-"
+LEDGER_KEY = "ledger"
+DIRECTIVES_KEY = "directives"
+
+
+def remediation_configmap_name(policy: str) -> str:
+    return REMEDIATION_CONFIGMAP_PREFIX + policy
+
+
+def directive_configmap_name(policy: str) -> str:
+    return DIRECTIVE_CONFIGMAP_PREFIX + policy
 
 
 def _now_micro() -> str:
@@ -315,6 +344,7 @@ def report_from_result(
     telemetry: Optional[Dict] = None,
     ici_topology: Optional[Dict] = None,
     plan_version: str = "",
+    remediation: Optional[Dict] = None,
 ) -> ProvisioningReport:
     """Assemble the report from the agent's post-pass state.
 
@@ -359,5 +389,6 @@ def report_from_result(
         telemetry=telemetry,
         ici_topology=ici_topology,
         plan_version=plan_version,
+        remediation=remediation,
         agent_version=agent_version_string(),
     )

@@ -235,6 +235,105 @@ def openapi_schema() -> Dict[str, Any]:
                                             "for large expectedPeers)."
                                         ),
                                     },
+                                    "quarantinePasses": {
+                                        "type": "integer",
+                                        "minimum": 0,
+                                        "maximum":
+                                            t.MAX_PROBE_QUARANTINE_PASSES,
+                                        "description": (
+                                            "Consecutive degraded "
+                                            "status passes before a "
+                                            "node is marked "
+                                            "Quarantined in the "
+                                            "connectivity matrix "
+                                            "(0 = "
+                                            f"{t.DEFAULT_PROBE_QUARANTINE_PASSES}"
+                                            ")."
+                                        ),
+                                    },
+                                },
+                            },
+                            "remediation": {
+                                "type": "object",
+                                "description": (
+                                    "Self-healing remediation: maps "
+                                    "detected anomalies (probe "
+                                    "quorum loss, counter anomalies) "
+                                    "onto a budgeted, rate-limited "
+                                    "action ladder (re-probe, "
+                                    "interface bounce, route "
+                                    "re-derivation, peer shift, "
+                                    "agent restart) the agents "
+                                    "execute; requires probe."
+                                ),
+                                "properties": {
+                                    "enabled": {"type": "boolean"},
+                                    "maxNodesPerWindow": {
+                                        "type": "integer",
+                                        "minimum": 0,
+                                        "maximum": 1000,
+                                        "description": (
+                                            "Fleet budget: max "
+                                            "distinct nodes "
+                                            "remediated per sliding "
+                                            "window (0 = "
+                                            f"{t.DEFAULT_REMEDIATION_MAX_NODES_PER_WINDOW}"
+                                            ")."
+                                        ),
+                                    },
+                                    "windowSeconds": {
+                                        "type": "integer",
+                                        "minimum": 0,
+                                        "maximum": 86400,
+                                        "description": (
+                                            "The sliding budget "
+                                            "window (0 = "
+                                            f"{t.DEFAULT_REMEDIATION_WINDOW_SECONDS}"
+                                            ")."
+                                        ),
+                                    },
+                                    "cooldownSeconds": {
+                                        "type": "integer",
+                                        "minimum": 0,
+                                        "maximum": 3600,
+                                        "description": (
+                                            "Per-node wait after any "
+                                            "action before the next "
+                                            "attempt or escalation "
+                                            "(0 = "
+                                            f"{t.DEFAULT_REMEDIATION_COOLDOWN_SECONDS}"
+                                            ")."
+                                        ),
+                                    },
+                                    "escalateAfter": {
+                                        "type": "integer",
+                                        "minimum": 0,
+                                        "maximum": 100,
+                                        "description": (
+                                            "Failed attempts at a "
+                                            "ladder rung before "
+                                            "escalating (0 = "
+                                            f"{t.DEFAULT_REMEDIATION_ESCALATE_AFTER}"
+                                            ")."
+                                        ),
+                                    },
+                                    "allowedActions": {
+                                        "type": "array",
+                                        "items": {
+                                            "type": "string",
+                                            "enum": list(
+                                                t.REMEDIATION_ACTIONS
+                                            ),
+                                        },
+                                        "description": (
+                                            "Actions the operator "
+                                            "may take; empty = the "
+                                            "full ladder (pinned by "
+                                            "the webhook on enable). "
+                                            "Removing an action "
+                                            "disables that rung."
+                                        ),
+                                    },
                                 },
                             },
                             "planner": {
@@ -452,6 +551,38 @@ def openapi_schema() -> Dict[str, Any]:
                             "intraGroupRttMs": {"type": "number"},
                             "interGroupRttMs": {"type": "number"},
                             "modeledAllreduceMs": {"type": "number"},
+                        },
+                    },
+                    "remediation": {
+                        "type": "object",
+                        "description": (
+                            "Self-healing rollup: outstanding action "
+                            "directives, budget consumption and "
+                            "exhausted ladders (the full record lives "
+                            "in the tpunet-remediation-<policy> "
+                            "ledger ConfigMap)."
+                        ),
+                        "properties": {
+                            "active": {"type": "integer"},
+                            "pending": {
+                                "type": "array",
+                                "items": {"type": "string"},
+                            },
+                            "windowUsed": {"type": "integer"},
+                            "windowMax": {"type": "integer"},
+                            "budgetDenied": {
+                                "type": "array",
+                                "items": {"type": "string"},
+                            },
+                            "quorumHeld": {
+                                "type": "array",
+                                "items": {"type": "string"},
+                            },
+                            "exhausted": {
+                                "type": "array",
+                                "items": {"type": "string"},
+                            },
+                            "actionsTotal": {"type": "integer"},
                         },
                     },
                     "summary": {

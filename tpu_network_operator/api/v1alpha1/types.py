@@ -63,6 +63,12 @@ PROBE_STATE_DEGRADED = "Degraded"
 PROBE_STATE_QUARANTINED = "Quarantined"
 CONDITION_DATAPLANE_DEGRADED = "DataplaneDegraded"
 
+# consecutive degraded status passes before a node is marked
+# Quarantined in the connectivity matrix (probe.quarantinePasses; the
+# webhook pins this default on enable, the projection contract)
+DEFAULT_PROBE_QUARANTINE_PASSES = 3
+MAX_PROBE_QUARANTINE_PASSES = 100
+
 # sampled probe topology: default out-degree and the shard math live in
 # probe/topology.py (one copy for reconciler AND agent); aliased here
 # for the CRD/webhook layer like the other probe defaults
@@ -128,6 +134,30 @@ PLAN_STATUS_EXCLUDED_K = 20
 # hot requeue loop; cleared by the next successful reconcile pass
 CONDITION_RECONCILE_DEGRADED = "ReconcileDegraded"
 
+# self-healing remediation defaults + action names: aliased from the
+# remediation package (one copy of the contract, like the probe/
+# telemetry/planner defaults above).  The remediation controller maps
+# the anomaly classes the operator already detects onto a budgeted,
+# rate-limited action ladder the agents execute.
+from ...remediation import policy as _remediation_defaults  # noqa: E402
+
+DEFAULT_REMEDIATION_MAX_NODES_PER_WINDOW = (
+    _remediation_defaults.DEFAULT_MAX_NODES_PER_WINDOW
+)
+DEFAULT_REMEDIATION_WINDOW_SECONDS = (
+    _remediation_defaults.DEFAULT_WINDOW_SECONDS
+)
+DEFAULT_REMEDIATION_COOLDOWN_SECONDS = (
+    _remediation_defaults.DEFAULT_COOLDOWN_SECONDS
+)
+DEFAULT_REMEDIATION_ESCALATE_AFTER = (
+    _remediation_defaults.DEFAULT_ESCALATE_AFTER
+)
+REMEDIATION_ACTIONS = _remediation_defaults.ACTIONS
+# bound on the node lists embedded in status.remediation (triage entry
+# points, same rationale as STATUS_WORST_K)
+REMEDIATION_STATUS_K = 20
+
 
 @dataclass
 class ProbeSpec:
@@ -174,6 +204,10 @@ class ProbeSpec:
     # ``required=True`` keeps the 0 on the wire (omitempty would drop
     # it and the next update would re-default it away).
     degree: Optional[int] = j("degree", None, required=True)
+    # consecutive degraded status passes before the reconciler marks a
+    # node Quarantined in the connectivity matrix
+    # (0 = DEFAULT_PROBE_QUARANTINE_PASSES)
+    quarantine_passes: int = j("quarantinePasses", 0)
 
 
 @dataclass
@@ -201,6 +235,39 @@ class PlannerSpec:
     # hints hierarchical DCN collectives instead of one flat ring
     # (0 = 2.0)
     spread_threshold_ms: float = j("spreadThresholdMs", 0.0)
+
+
+@dataclass
+class RemediationSpec:
+    """Self-healing remediation knobs (``remediation:`` under
+    ``tpuScaleOut``).  When enabled (requires the probe mesh — the
+    remediation controller acts on the probe/telemetry verdicts), the
+    reconciler maps detected anomalies onto a budgeted action ladder
+    (re-probe → interface bounce → route re-derivation → peer shift →
+    agent restart), distributes per-node action directives the agents
+    execute through LinkOps, and persists the execution ledger in an
+    owned ``tpunet-remediation-<policy>`` ConfigMap so a restarted
+    controller resumes cooldowns instead of re-firing.  All zeroes
+    mean "remediation default" (the mutating webhook pins them on
+    enable, the probe/telemetry/planner contract)."""
+
+    enabled: bool = j("enabled", False)
+    # fleet budget: at most this many DISTINCT nodes remediated inside
+    # one sliding window (0 = 3) — an anomaly storm is held to a
+    # bounded blast radius, the rest stay quarantined
+    max_nodes_per_window: int = j("maxNodesPerWindow", 0)
+    # the sliding budget window, seconds (0 = 300)
+    window_seconds: int = j("windowSeconds", 0)
+    # per-(node, anomaly-class) wait after any action before the next
+    # attempt/escalation is considered (0 = 60)
+    cooldown_seconds: int = j("cooldownSeconds", 0)
+    # failed attempts at a ladder rung before escalating to the next
+    # (0 = 2)
+    escalate_after: int = j("escalateAfter", 0)
+    # actions the operator may take; empty = webhook pins the full
+    # ladder on enable.  Removing an action disables that rung
+    # (e.g. drop restart-agent to forbid pod rolls).
+    allowed_actions: List[str] = j("allowedActions", factory=list)
 
 
 @dataclass
@@ -290,6 +357,9 @@ class TpuScaleOutSpec:
     # Topology planner: measured RTT matrix -> DCN ring ordering, node
     # labels + bootstrap plan block (planner/ subsystem; needs probe).
     planner: PlannerSpec = j("planner", factory=PlannerSpec)
+    # Self-healing remediation: budgeted action ladder driven by the
+    # probe/telemetry verdicts (remediation/ subsystem; needs probe).
+    remediation: RemediationSpec = j("remediation", factory=RemediationSpec)
 
 
 @dataclass
@@ -408,6 +478,31 @@ class PlanStatus:
 
 
 @dataclass
+class RemediationStatus:
+    """The remediation controller's rollup — what self-healing is doing
+    right now and how much budget it has burned (O(1)-bounded lists;
+    the full record lives in the tpunet-remediation-<policy> ledger
+    ConfigMap)."""
+
+    # nodes with an outstanding (issued, not yet acknowledged) directive
+    active: int = j("active", 0)
+    # bounded "node: action" triage list of the outstanding directives
+    pending: List[str] = j("pending", factory=list)
+    # distinct nodes remediated inside the current sliding window
+    window_used: int = j("windowUsed", 0)
+    window_max: int = j("windowMax", 0)
+    # nodes currently denied by the fleet budget (bounded)
+    budget_denied: List[str] = j("budgetDenied", factory=list)
+    # nodes whose disruptive action waits on the quorum floor — the
+    # healthy fleet is too thin to risk taking anything down (bounded)
+    quorum_held: List[str] = j("quorumHeld", factory=list)
+    # nodes whose ladder ran out — they stay quarantined (bounded)
+    exhausted: List[str] = j("exhausted", factory=list)
+    # cumulative actions issued over the ledger's lifetime
+    actions_total: int = j("actionsTotal", 0)
+
+
+@dataclass
 class PolicyCondition:
     """metav1.Condition subset (the DataplaneDegraded carrier)."""
 
@@ -443,6 +538,9 @@ class NetworkClusterPolicyStatus:
     # active topology plan rollup (omit-empty: absent unless the
     # planner is enabled and has produced a plan)
     plan: Optional[PlanStatus] = j("plan", None)
+    # self-healing remediation rollup (omit-empty: absent unless
+    # remediation is enabled)
+    remediation: Optional[RemediationStatus] = j("remediation", None)
 
 
 @dataclass

@@ -79,6 +79,8 @@ def default_policy(policy: NetworkClusterPolicy) -> NetworkClusterPolicy:
                 p.failure_threshold = t.DEFAULT_PROBE_FAILURE_THRESHOLD
             if not p.recovery_threshold:
                 p.recovery_threshold = t.DEFAULT_PROBE_RECOVERY_THRESHOLD
+            if not p.quarantine_passes:
+                p.quarantine_passes = t.DEFAULT_PROBE_QUARANTINE_PASSES
             # scale defaults: an expectedPeers advertising a fleet past
             # the summary threshold flips the policy to sampled probing
             # (full mesh would be O(n²) datagrams) and to the bounded
@@ -119,6 +121,23 @@ def default_policy(policy: NetworkClusterPolicy) -> NetworkClusterPolicy:
                 pl.hold_seconds = t.DEFAULT_PLAN_HOLD_SECONDS
             if not pl.spread_threshold_ms:
                 pl.spread_threshold_ms = t.DEFAULT_PLAN_SPREAD_THRESHOLD_MS
+        if so.remediation.enabled:
+            # same contract pinning for the self-healing knobs; the
+            # full action ladder is pinned explicitly so disabling an
+            # action later is an edit, never a guess about defaults
+            r = so.remediation
+            if not r.max_nodes_per_window:
+                r.max_nodes_per_window = (
+                    t.DEFAULT_REMEDIATION_MAX_NODES_PER_WINDOW
+                )
+            if not r.window_seconds:
+                r.window_seconds = t.DEFAULT_REMEDIATION_WINDOW_SECONDS
+            if not r.cooldown_seconds:
+                r.cooldown_seconds = t.DEFAULT_REMEDIATION_COOLDOWN_SECONDS
+            if not r.escalate_after:
+                r.escalate_after = t.DEFAULT_REMEDIATION_ESCALATE_AFTER
+            if not r.allowed_actions:
+                r.allowed_actions = list(t.REMEDIATION_ACTIONS)
         if so.telemetry.enabled:
             # same contract pinning for the counter-telemetry knobs
             tl = so.telemetry
@@ -227,6 +246,56 @@ def validate_probe_spec(p: t.ProbeSpec) -> None:
             f"tpuScaleOut.probe: quorum ({p.quorum}) exceeds sampled "
             f"degree ({p.degree}) — unsatisfiable"
         )
+    if p.quarantine_passes < 0 or \
+            p.quarantine_passes > t.MAX_PROBE_QUARANTINE_PASSES:
+        raise AdmissionError(
+            f"tpuScaleOut.probe: quarantinePasses must be "
+            f"0-{t.MAX_PROBE_QUARANTINE_PASSES}"
+        )
+
+
+def validate_remediation_spec(
+    r: t.RemediationSpec, probe: t.ProbeSpec
+) -> None:
+    """Self-healing remediation knobs.  Zero means "remediation
+    default" (the mutating webhook fills them on enable); the
+    structural requirement mirrors the planner's: remediation acts on
+    the probe/telemetry verdicts, so enabling it without the probe mesh
+    would silently act on nothing while the operator believes
+    self-healing is active."""
+    if r.enabled and not probe.enabled:
+        raise AdmissionError(
+            "tpuScaleOut.remediation: requires tpuScaleOut.probe."
+            "enabled — remediation acts on the probe mesh's verdicts"
+        )
+    if r.max_nodes_per_window < 0 or r.max_nodes_per_window > 1000:
+        raise AdmissionError(
+            "tpuScaleOut.remediation: maxNodesPerWindow must be 0-1000"
+        )
+    if r.window_seconds < 0 or r.window_seconds > 86400:
+        raise AdmissionError(
+            "tpuScaleOut.remediation: windowSeconds must be 0-86400"
+        )
+    if r.cooldown_seconds < 0 or r.cooldown_seconds > 3600:
+        raise AdmissionError(
+            "tpuScaleOut.remediation: cooldownSeconds must be 0-3600"
+        )
+    if r.escalate_after < 0 or r.escalate_after > 100:
+        raise AdmissionError(
+            "tpuScaleOut.remediation: escalateAfter must be 0-100"
+        )
+    seen = set()
+    for action in r.allowed_actions:
+        if action not in t.REMEDIATION_ACTIONS:
+            raise AdmissionError(
+                f"tpuScaleOut.remediation: unknown action {action!r} "
+                f"(allowed: {', '.join(t.REMEDIATION_ACTIONS)})"
+            )
+        if action in seen:
+            raise AdmissionError(
+                f"tpuScaleOut.remediation: duplicate action {action!r}"
+            )
+        seen.add(action)
 
 
 def validate_telemetry_spec(tl: t.TelemetrySpec) -> None:
@@ -323,6 +392,7 @@ def validate_tpu_so_spec(s: t.TpuScaleOutSpec) -> None:
     validate_probe_spec(s.probe)
     validate_telemetry_spec(s.telemetry)
     validate_planner_spec(s.planner, s.probe)
+    validate_remediation_spec(s.remediation, s.probe)
 
 
 def validate_spec(spec: NetworkClusterPolicySpec) -> List[str]:

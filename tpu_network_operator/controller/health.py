@@ -102,6 +102,19 @@ METRIC_HELP: Dict[str, str] = {
     "tpunet_plan_label_writes_total":
         "Node label patches written by the topology planner "
         "(diff-gated: steady fleets write zero).",
+    "tpunet_remediation_actions_total":
+        "Self-healing actions issued, by policy and action "
+        "(re-probe, bounce-interface, reroute, peer-shift, "
+        "restart-agent).",
+    "tpunet_remediation_escalations_total":
+        "Remediation ladder escalations (a rung failed to clear the "
+        "anomaly after its attempt budget).",
+    "tpunet_remediation_budget_denials_total":
+        "Remediation actions withheld by the fleet budget "
+        "(maxNodesPerWindow); denied nodes stay quarantined.",
+    "tpunet_remediation_pending":
+        "Outstanding remediation directives awaiting agent "
+        "acknowledgement, per policy.",
 }
 
 

@@ -270,3 +270,54 @@ class FaultInjector:
         # everything outside the verb seam (test conveniences,
         # request_counts, metrics, close, ...) passes through
         return getattr(self.inner, name)
+
+
+class FabricChaos:
+    """Scenario helper over :class:`..probe.transport.FakeFabric` —
+    the dataplane counterpart of :class:`FaultInjector`: named link
+    faults with the same explicit scheduling and exact ``injected``
+    accounting, so a chaos/remediation scenario can drive apiserver
+    faults and fabric faults through one consistent idiom.
+
+    Wraps the fabric's per-directional ``set_link_down``/``heal_link``
+    (a bounce-repairable stuck link), the symmetric loss dial, and
+    whole-host partitions; ``downed`` tracks live link faults so a
+    scenario can heal exactly what it broke."""
+
+    def __init__(self, fabric):
+        self.fabric = fabric
+        self.injected: Counter = Counter()
+        self.downed: set = set()
+
+    def link_down(self, a: str, b: str,
+                  bidirectional: bool = True) -> None:
+        """Down the a→b link (both directions by default)."""
+        self.fabric.set_link_down(a, b, bidirectional=bidirectional)
+        self.downed.add((a, b))
+        self.injected[("link-down", a, b)] += 1
+
+    def heal_link(self, a: str, b: str) -> None:
+        self.fabric.heal_link(a, b)
+        self.downed.discard((a, b))
+        self.injected[("link-heal", a, b)] += 1
+
+    def heal_all(self) -> int:
+        """Heal every link this helper downed; returns how many."""
+        downed = list(self.downed)
+        for a, b in downed:
+            self.heal_link(a, b)
+        return len(downed)
+
+    def set_loss(self, addr: str, ratio: float) -> None:
+        """Persistent-loss link degradation (the escalation scenario:
+        a bounce won't fix it, the ladder must route around it)."""
+        self.fabric.set_loss(addr, ratio)
+        self.injected[("loss", addr, str(ratio))] += 1
+
+    def partition(self, addr: str) -> None:
+        self.fabric.partition(addr)
+        self.injected[("partition", addr, "")] += 1
+
+    def heal_partition(self, addr: str) -> None:
+        self.fabric.heal(addr)
+        self.injected[("partition-heal", addr, "")] += 1

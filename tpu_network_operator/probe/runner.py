@@ -185,6 +185,16 @@ class ProbeRunner:
     def ready(self) -> bool:
         return self.gate.ready
 
+    def refresh_peers(self) -> ProbeSnapshot:
+        """Drop any cached peer list (suppliers built by the agent
+        carry an ``invalidate`` hook) and run one synchronous round
+        against the refreshed mesh — the peer-shift remediation rung:
+        re-learn who to probe NOW instead of riding the refresh TTL."""
+        invalidate = getattr(self._supplier, "invalidate", None)
+        if callable(invalidate):
+            invalidate()
+        return self.step()
+
     def export(self) -> Optional[Dict]:
         """Latest snapshot in report wire form (+ gate state), or None
         before the first round."""

@@ -164,6 +164,14 @@ class FakeFabric:
         self.loss: Dict[str, float] = {}
         self.partitioned: set = set()
         self.cuts: set = set()
+        # per-DIRECTION downed links: (src_key, dst_key) ordered pairs
+        # (host or host:port keys) — unlike the symmetric `cuts`, a
+        # one-way failure (dead laser, asymmetric routing loop) drops
+        # only src→dst traffic; the reverse direction still delivers.
+        # The link-bounce remediation rung is proven against exactly
+        # this: set_link_down models the stuck link, heal_link the
+        # bounce clearing it.
+        self.downed_links: set = set()
         # per-link one-way latency overrides (host or host:port pair
         # keys) — lets a scenario model a structured fabric (fast
         # intra-rack, slow inter-rack) that probing then measures;
@@ -207,6 +215,23 @@ class FakeFabric:
     def uncut(self, a: str, b: str) -> None:
         self.cuts.discard(frozenset((a, b)))
 
+    def set_link_down(
+        self, a: str, b: str, bidirectional: bool = True
+    ) -> None:
+        """Down the a→b link (and b→a unless ``bidirectional=False``):
+        the per-directional analog of :meth:`cut`, for scenarios where
+        only one direction of a link dies (dead laser, one-way optics
+        degradation) — the failure mode an interface bounce repairs."""
+        self.downed_links.add((a, b))
+        if bidirectional:
+            self.downed_links.add((b, a))
+
+    def heal_link(self, a: str, b: str) -> None:
+        """Restore BOTH directions of the (a, b) link (a bounce resets
+        the whole interface, so healing is never one-way)."""
+        self.downed_links.discard((a, b))
+        self.downed_links.discard((b, a))
+
     def set_link_latency(self, a: str, b: str, seconds: float) -> None:
         """One-way latency override for the (a, b) link (host or
         host:port keys, symmetric) — the structured-fabric seam the
@@ -223,6 +248,8 @@ class FakeFabric:
         for a in self._hosts(src):
             for b in self._hosts(dst):
                 if frozenset((a, b)) in self.cuts:
+                    return True
+                if (a, b) in self.downed_links:
                     return True
         return False
 

@@ -1,0 +1,45 @@
+"""Self-healing dataplane: budgeted remediation of detected anomalies.
+
+``policy`` is the pure decision core (anomaly class → action ladder
+with per-action cooldowns, escalation after N failed attempts, a
+fleet-wide sliding-window budget and a quorum floor); ``ledger`` is the
+execution record persisted in the ``tpunet-remediation-<policy>``
+ConfigMap so a restarted controller resumes cooldowns instead of
+re-firing.  The reconciler's ``_sync_remediation`` pass drives both;
+the agent executes the distributed directives through LinkOps.
+"""
+
+from .ledger import Directive, Entry, Ledger
+from .policy import (
+    ACTION_BOUNCE,
+    ACTION_PEER_SHIFT,
+    ACTION_REPROBE,
+    ACTION_REROUTE,
+    ACTION_RESTART,
+    ACTIONS,
+    ANOMALY_CLASSES,
+    CLASS_PROBE,
+    CLASS_TELEMETRY,
+    DEFAULT_COOLDOWN_SECONDS,
+    DEFAULT_ESCALATE_AFTER,
+    DEFAULT_MAX_NODES_PER_WINDOW,
+    DEFAULT_WINDOW_SECONDS,
+    LADDERS,
+    NON_DISRUPTIVE,
+    Anomaly,
+    Decision,
+    Knobs,
+    allowed_ladder,
+    decide,
+    primary_anomaly,
+)
+
+__all__ = [
+    "ACTIONS", "ACTION_BOUNCE", "ACTION_PEER_SHIFT", "ACTION_REPROBE",
+    "ACTION_REROUTE", "ACTION_RESTART", "ANOMALY_CLASSES", "Anomaly",
+    "CLASS_PROBE", "CLASS_TELEMETRY", "Decision",
+    "DEFAULT_COOLDOWN_SECONDS", "DEFAULT_ESCALATE_AFTER",
+    "DEFAULT_MAX_NODES_PER_WINDOW", "DEFAULT_WINDOW_SECONDS",
+    "Directive", "Entry", "Knobs", "LADDERS", "Ledger", "NON_DISRUPTIVE",
+    "allowed_ladder", "decide", "primary_anomaly",
+]

@@ -1,0 +1,321 @@
+"""Execution ledger: what remediation did, when, and what came of it.
+
+The ledger is the memory that makes the policy core's safety rules hold
+ACROSS controller restarts: per-(node, anomaly-class) rung/attempt/
+cooldown state plus the sliding fleet-budget window, serialized into an
+owned ``tpunet-remediation-<policy>`` ConfigMap by the reconciler.  A
+restarted controller deserializes it and resumes cooldowns instead of
+re-firing every outstanding action from rung zero — without it, a
+crash-looping operator would itself become a dataplane chaos source.
+
+Timestamps are wall-clock epoch seconds (the caller's clock seam):
+monotonic clocks reset across restarts, which is exactly the case the
+persisted ledger exists for.
+
+Pruning discipline: the sliding window is only MUTATED when an action
+is issued (``issue`` prunes as it charges); read paths
+(``window_nodes``) filter by time without mutating, so a steady pass
+re-serializes to a byte-identical payload and the reconciler's diff
+gate keeps the steady-state apiserver write count at zero.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+
+@dataclass
+class Directive:
+    """One issued action — the unit distributed to (or executed for) a
+    node.  ``id`` is unique per attempt; ``ledger_version`` stamps the
+    ledger generation the containing payload was written under (the
+    agent ignores rows whose stamp mismatches the payload's own version
+    — a stale or half-merged directive must never fire)."""
+
+    id: str
+    node: str
+    cls: str
+    action: str
+    iface: str = ""
+    issued_at: float = 0.0
+    ledger_version: str = ""
+
+    def to_payload(self) -> Dict:
+        return {
+            "id": self.id,
+            "node": self.node,
+            "class": self.cls,
+            "action": self.action,
+            "iface": self.iface,
+            "issuedAt": self.issued_at,
+            "ledgerVersion": self.ledger_version,
+        }
+
+    @staticmethod
+    def from_payload(d: Dict) -> Optional["Directive"]:
+        """Validated parse; None on any shape violation (directives come
+        from the cluster — any controller version, possibly mangled)."""
+        if not isinstance(d, dict):
+            return None
+        for key in ("id", "node", "class", "action"):
+            if not isinstance(d.get(key), str) or not d.get(key):
+                return None
+        iface = d.get("iface", "")
+        issued = d.get("issuedAt", 0.0)
+        return Directive(
+            id=d["id"], node=d["node"], cls=d["class"],
+            action=d["action"],
+            iface=iface if isinstance(iface, str) else "",
+            issued_at=float(issued) if isinstance(
+                issued, (int, float)
+            ) and not isinstance(issued, bool) else 0.0,
+            ledger_version=str(d.get("ledgerVersion", "")),
+        )
+
+
+@dataclass
+class Entry:
+    """Per-(node, anomaly-class) ladder state."""
+
+    rung: int = 0
+    # attempts ISSUED at the current rung (escalation counts these)
+    attempts: int = 0
+    last_action: str = ""
+    last_action_at: float = 0.0
+    last_directive_id: str = ""
+    last_iface: str = ""
+    # "" (never acted) | "pending" | "ok" | "failed"
+    outcome: str = ""
+    outcome_error: str = ""
+    exhausted: bool = False
+    total_actions: int = 0
+
+    def to_payload(self) -> Dict:
+        return {
+            "rung": self.rung,
+            "attempts": self.attempts,
+            "lastAction": self.last_action,
+            "lastActionAt": self.last_action_at,
+            "lastDirectiveId": self.last_directive_id,
+            "lastIface": self.last_iface,
+            "outcome": self.outcome,
+            "outcomeError": self.outcome_error,
+            "exhausted": self.exhausted,
+            "totalActions": self.total_actions,
+        }
+
+    @staticmethod
+    def from_payload(d: Dict) -> "Entry":
+        def _num(v) -> float:
+            return float(v) if isinstance(v, (int, float)) \
+                and not isinstance(v, bool) else 0.0
+
+        def _s(v) -> str:
+            return v if isinstance(v, str) else ""
+
+        return Entry(
+            rung=int(_num(d.get("rung"))),
+            attempts=int(_num(d.get("attempts"))),
+            last_action=_s(d.get("lastAction")),
+            last_action_at=_num(d.get("lastActionAt")),
+            last_directive_id=_s(d.get("lastDirectiveId")),
+            last_iface=_s(d.get("lastIface")),
+            outcome=_s(d.get("outcome")),
+            outcome_error=_s(d.get("outcomeError")),
+            exhausted=d.get("exhausted") is True,
+            total_actions=int(_num(d.get("totalActions"))),
+        )
+
+
+def _key(node: str, cls: str) -> str:
+    return f"{node}|{cls}"
+
+
+class Ledger:
+    """The mutable remediation record for one policy."""
+
+    def __init__(self) -> None:
+        self.entries: Dict[str, Entry] = {}
+        # budget window: (node, issued_at) per charged action — pruned
+        # only on issue (see module docstring)
+        self.window: List[Tuple[str, float]] = []
+        # generation counter: bumped per issued directive; the payload
+        # version the agent's staleness check compares against
+        self.seq: int = 0
+
+    # -- identity --------------------------------------------------------------
+
+    @property
+    def version(self) -> str:
+        return str(self.seq)
+
+    # -- lookups ---------------------------------------------------------------
+
+    def entry(self, node: str, cls: str) -> Entry:
+        return self.entries.setdefault(_key(node, cls), Entry())
+
+    def peek(self, node: str, cls: str) -> Optional[Entry]:
+        return self.entries.get(_key(node, cls))
+
+    def stale_entries(
+        self, active: Set[Tuple[str, str]]
+    ) -> List[Tuple[str, str, Entry]]:
+        """Entries whose (node, class) is no longer observed anomalous —
+        the recovery sweep's input, sorted for determinism."""
+        out = []
+        for key in sorted(self.entries):
+            node, _, cls = key.partition("|")
+            if (node, cls) not in active:
+                out.append((node, cls, self.entries[key]))
+        return out
+
+    def clear(self, node: str, cls: str) -> None:
+        self.entries.pop(_key(node, cls), None)
+
+    def pending_directive(self, node: str, cls: str) -> Optional[Directive]:
+        """Reconstruct the outstanding directive for redistribution (the
+        directive ConfigMap always carries the full desired set)."""
+        entry = self.entries.get(_key(node, cls))
+        if entry is None or entry.outcome != "pending" \
+                or not entry.last_directive_id:
+            return None
+        return Directive(
+            id=entry.last_directive_id, node=node, cls=cls,
+            action=entry.last_action, iface=entry.last_iface,
+            issued_at=entry.last_action_at,
+        )
+
+    # -- budget window ---------------------------------------------------------
+
+    def window_nodes(self, now: float, window_seconds: float) -> Set[str]:
+        """Distinct nodes charged inside the sliding window.  Pure read
+        (no pruning) — see module docstring."""
+        cutoff = now - window_seconds
+        return {n for n, at in self.window if at > cutoff}
+
+    # -- mutations -------------------------------------------------------------
+
+    def issue(
+        self, node: str, cls: str, action: str, iface: str,
+        now: float, rung: int, attempts: int,
+    ) -> Directive:
+        """Record + return a new directive: charges the budget window,
+        advances the rung/attempt state, bumps the generation."""
+        self.seq += 1
+        entry = self.entry(node, cls)
+        entry.rung = rung
+        entry.attempts = attempts + 1
+        entry.last_action = action
+        entry.last_action_at = now
+        entry.last_iface = iface
+        entry.outcome = "pending"
+        entry.outcome_error = ""
+        entry.total_actions += 1
+        directive_id = f"{node}/{cls}/r{rung}a{entry.attempts}-{self.seq}"
+        entry.last_directive_id = directive_id
+        self.window.append((node, now))
+        return Directive(
+            id=directive_id, node=node, cls=cls, action=action,
+            iface=iface, issued_at=now,
+        )
+
+    def prune_window(self, now: float, window_seconds: float) -> None:
+        """Drop expired window charges — called on issue passes only so
+        steady passes stay byte-identical."""
+        cutoff = now - window_seconds
+        self.window = [(n, at) for n, at in self.window if at > cutoff]
+
+    def record_outcome(
+        self, directive_id: str, ok: bool, error: str = ""
+    ) -> Optional[Tuple[str, str]]:
+        """Fold an agent-reported action outcome in.  Returns the
+        (node, cls) the outcome matched, or None when the id is unknown
+        or no longer pending (repeat reports are idempotent)."""
+        for key in sorted(self.entries):
+            entry = self.entries[key]
+            if entry.last_directive_id != directive_id:
+                continue
+            if entry.outcome != "pending":
+                return None
+            entry.outcome = "ok" if ok else "failed"
+            entry.outcome_error = "" if ok else error[:256]
+            node, _, cls = key.partition("|")
+            return node, cls
+        return None
+
+    def record_expiry(self, node: str, cls: str) -> None:
+        """A pending directive aged out unacknowledged: the attempt
+        counts as failed (wedged agent / lost report)."""
+        entry = self.entries.get(_key(node, cls))
+        if entry is not None and entry.outcome == "pending":
+            entry.outcome = "failed"
+            entry.outcome_error = "directive expired unacknowledged"
+
+    # -- rollup helpers --------------------------------------------------------
+
+    def exhausted_nodes(self) -> List[str]:
+        return sorted({
+            key.partition("|")[0]
+            for key, entry in self.entries.items()
+            if entry.exhausted
+        })
+
+    def total_actions(self) -> int:
+        # the generation counter bumps exactly once per issued
+        # directive, so it IS the lifetime action count — summing live
+        # entries would forget healed nodes' actions the moment the
+        # recovery sweep clears them
+        return self.seq
+
+    # -- persistence -----------------------------------------------------------
+
+    def to_payload(self) -> Dict:
+        return {
+            "v": self.seq,
+            "entries": {
+                key: entry.to_payload()
+                for key, entry in sorted(self.entries.items())
+            },
+            "window": [[n, at] for n, at in self.window],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_payload(), sort_keys=True)
+
+    @staticmethod
+    def from_payload(d: Dict) -> "Ledger":
+        """Tolerant parse: the payload comes from the cluster (older
+        controller, kubectl edit) — malformed pieces degrade to empty
+        state rather than failing the reconcile."""
+        ledger = Ledger()
+        if not isinstance(d, dict):
+            return ledger
+        v = d.get("v")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            ledger.seq = int(v)
+        entries = d.get("entries")
+        if isinstance(entries, dict):
+            for key, raw in entries.items():
+                if isinstance(key, str) and "|" in key \
+                        and isinstance(raw, dict):
+                    ledger.entries[key] = Entry.from_payload(raw)
+        window = d.get("window")
+        if isinstance(window, list):
+            for item in window:
+                if (
+                    isinstance(item, list) and len(item) == 2
+                    and isinstance(item[0], str)
+                    and isinstance(item[1], (int, float))
+                    and not isinstance(item[1], bool)
+                ):
+                    ledger.window.append((item[0], float(item[1])))
+        return ledger
+
+    @staticmethod
+    def from_json(raw: str) -> "Ledger":
+        try:
+            return Ledger.from_payload(json.loads(raw))
+        except ValueError:
+            return Ledger()

@@ -1,0 +1,300 @@
+"""Pure remediation policy core: anomaly class → budgeted action ladder.
+
+The operator detects plenty — probe-mesh partitions (probe/), NIC
+counter anomalies (agent/telemetry.py), planner exclusions (planner/) —
+but until now its only remediation was retracting the ``tpu-scale-out``
+label and quarantining the node.  This module closes the
+detect→diagnose→act loop (the INSIGHT in-network pipeline; ROADMAP
+"Self-healing dataplane") as a PURE decision core: given the pass's
+anomaly observations, the execution ledger and a clock, it decides
+which concrete actions to issue — no I/O, no Kubernetes, fully
+deterministic, so every safety property (budget, cooldown, escalation,
+quorum floor) is unit-testable without a cluster.
+
+Safety invariants the core enforces:
+
+* **Action ladder** — each anomaly class walks a fixed escalation
+  ladder (least disruptive first); a rung is retried ``escalate_after``
+  times before the next rung is considered, and a node whose ladder is
+  exhausted simply stays quarantined (detection already handled the
+  label) rather than looping.
+* **Cooldown** — after any action (success or failure) the node/class
+  pair waits ``cooldown_seconds`` before the next attempt, so a slow
+  recovery is given time to land and remediation itself can never flap
+  the dataplane faster than detection damps it.
+* **Fleet budget** — at most ``max_nodes_per_window`` DISTINCT nodes
+  may receive actions inside one sliding ``window_seconds`` window; a
+  node already inside the window may continue its own ladder without
+  consuming a second slot.  An anomaly storm (correlated failure, bad
+  rollout, detector bug) is therefore held to a bounded blast radius —
+  the rest stay quarantined, which is exactly the pre-remediation
+  behavior.
+* **Quorum floor** — disruptive actions (anything that can take a link
+  or agent down) are withheld while the healthy fleet is at or below
+  ``min_healthy``: remediation must never finish off a cluster that
+  detection already cut to the bone.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from .ledger import Directive, Entry, Ledger
+
+# -- anomaly classes ----------------------------------------------------------
+
+# probe-mesh verdicts: gate Degraded / controller Quarantined — the
+# node cannot reach its probe quorum
+CLASS_PROBE = "probe"
+# counter telemetry verdicts: an interface is up but corrupting/
+# dropping/stalled — the anomaly names the concrete interface
+CLASS_TELEMETRY = "telemetry"
+ANOMALY_CLASSES = (CLASS_PROBE, CLASS_TELEMETRY)
+
+# -- actions (ladder order: least disruptive first) ---------------------------
+
+ACTION_REPROBE = "re-probe"            # immediate probe round, fresh verdict
+ACTION_BOUNCE = "bounce-interface"     # link down/up + readdress via LinkOps
+ACTION_REROUTE = "reroute"             # re-derive routes around the bad NIC
+ACTION_PEER_SHIFT = "peer-shift"       # refetch peer assignment + re-probe
+ACTION_RESTART = "restart-agent"       # controller deletes the agent pod
+ACTIONS = (
+    ACTION_REPROBE, ACTION_BOUNCE, ACTION_REROUTE, ACTION_PEER_SHIFT,
+    ACTION_RESTART,
+)
+
+# per-class escalation ladders.  Probe anomalies first re-measure (the
+# cheapest possible fix: a stale verdict), then shift the peer
+# assignment (the fault may be the PEERS, not this node), then roll the
+# agent.  Telemetry anomalies name a concrete interface, so they start
+# at the link itself: bounce, then route around it, then roll the agent.
+LADDERS: Dict[str, Tuple[str, ...]] = {
+    CLASS_PROBE: (ACTION_REPROBE, ACTION_PEER_SHIFT, ACTION_RESTART),
+    CLASS_TELEMETRY: (ACTION_BOUNCE, ACTION_REROUTE, ACTION_RESTART),
+}
+
+# actions that cannot take capacity down (safe below the quorum floor)
+NON_DISRUPTIVE: FrozenSet[str] = frozenset({
+    ACTION_REPROBE, ACTION_PEER_SHIFT,
+})
+
+# knob defaults — the single copy the CRD layer (api/v1alpha1/types.py)
+# aliases, like the probe/telemetry/planner defaults
+DEFAULT_MAX_NODES_PER_WINDOW = 3
+DEFAULT_WINDOW_SECONDS = 300
+DEFAULT_COOLDOWN_SECONDS = 60
+DEFAULT_ESCALATE_AFTER = 2
+
+# extra grace ON TOP of the cooldown before an unacknowledged directive
+# is expired as a failed attempt.  The agent's worst-case pickup-to-ack
+# latency is one monitor tick (default 60s) to fetch + execute, plus a
+# publish and the controller's next pass — with expiry at the bare
+# cooldown (also 60s by default) an IN-FLIGHT directive would be
+# expired and re-issued, double-executing a disruptive action.  Two
+# default ticks of slack covers the chain with margin.
+PENDING_GRACE_SECONDS = 120.0
+
+
+@dataclass(frozen=True)
+class Anomaly:
+    """One observed anomaly: a node, its class, and (for telemetry) the
+    degraded interface.  Built by the reconciler from the verdicts the
+    status pass already aggregated — the core never re-detects."""
+
+    node: str
+    cls: str
+    iface: str = ""
+    detail: str = ""
+
+
+@dataclass
+class Knobs:
+    """Resolved policy knobs (zero-sentinels already applied by the
+    caller — the core never guesses defaults)."""
+
+    max_nodes_per_window: int = DEFAULT_MAX_NODES_PER_WINDOW
+    window_seconds: float = float(DEFAULT_WINDOW_SECONDS)
+    cooldown_seconds: float = float(DEFAULT_COOLDOWN_SECONDS)
+    escalate_after: int = DEFAULT_ESCALATE_AFTER
+    # actions the operator allows (CR allowedActions); rungs outside it
+    # are skipped, so "disable restarts" = drop restart-agent here
+    allowed_actions: FrozenSet[str] = frozenset(ACTIONS)
+    # quorum floor: disruptive actions are withheld while the healthy
+    # node count is at or below this
+    min_healthy: int = 0
+
+
+@dataclass
+class Decision:
+    """One decision pass's output: the complete outstanding directive
+    set (distributed as-is, so the directive ConfigMap is always the
+    full desired state) plus the edges the caller turns into Events and
+    metric bumps."""
+
+    # node -> outstanding directive (new this pass OR still pending)
+    directives: Dict[str, Directive] = field(default_factory=dict)
+    started: List[Directive] = field(default_factory=list)
+    # (node, cls, from_action, to_action)
+    escalated: List[Tuple[str, str, str, str]] = field(default_factory=list)
+    budget_denied: List[str] = field(default_factory=list)
+    quorum_held: List[str] = field(default_factory=list)
+    # (node, cls) pairs whose ladder ran out THIS pass (edge, not state)
+    exhausted: List[Tuple[str, str]] = field(default_factory=list)
+    # nodes whose remediation succeeded (anomaly cleared after actions)
+    healed: List[str] = field(default_factory=list)
+
+
+def allowed_ladder(cls: str, allowed: FrozenSet[str]) -> Tuple[str, ...]:
+    """The class ladder filtered to the operator-allowed actions (rung
+    order preserved)."""
+    return tuple(a for a in LADDERS.get(cls, ()) if a in allowed)
+
+
+def primary_anomaly(anomalies: List[Anomaly]) -> Optional[Anomaly]:
+    """At most ONE outstanding directive per node: telemetry anomalies
+    win (they name a concrete interface to act on), then probe; ties
+    broken by interface name for determinism."""
+    if not anomalies:
+        return None
+    return sorted(
+        anomalies,
+        key=lambda a: (0 if a.cls == CLASS_TELEMETRY else 1, a.iface),
+    )[0]
+
+
+def decide(
+    knobs: Knobs,
+    anomalies: List[Anomaly],
+    ledger: Ledger,
+    now: float,
+    healthy_nodes: int,
+) -> Decision:
+    """One pure decision pass.  Mutates ``ledger`` (attempt counters,
+    rungs, window charges, entry clears) — the caller persists it."""
+    decision = Decision()
+    by_node: Dict[str, List[Anomaly]] = {}
+    for anom in anomalies:
+        by_node.setdefault(anom.node, []).append(anom)
+
+    # recovery sweep: a (node, class) the pass no longer observes has
+    # healed — clear its rung/cooldown state so a future recurrence
+    # starts back at the cheapest action.  Entries still inside the
+    # cooldown are KEPT: a flapping anomaly (absent one pass, back the
+    # next) must resume its ladder under the original cooldown, not
+    # restart at rung zero with a fresh clock — or remediation could
+    # flap the dataplane at reconcile cadence, exactly what the
+    # cooldown exists to prevent.  The RemediationSucceeded edge is
+    # credited ONLY when the last action actually landed ok on a
+    # non-exhausted ladder — an exhausted node whose NIC a technician
+    # replaced healed despite remediation, not because of it, and the
+    # audit trail must not claim otherwise.
+    active_keys = {
+        (a.node, a.cls) for a in anomalies
+    }
+    for node, cls, entry in ledger.stale_entries(active_keys):
+        if (
+            entry.last_action_at
+            and now - entry.last_action_at < knobs.cooldown_seconds
+        ):
+            continue
+        if (
+            entry.total_actions > 0
+            # "ok" = acked success; "pending" = the action went out and
+            # the anomaly cleared before the ack round-tripped — both
+            # plausibly remediation's doing.  "failed" and exhausted
+            # ladders are not.
+            and entry.outcome in ("ok", "pending")
+            and not entry.exhausted
+        ):
+            decision.healed.append(node)
+        ledger.clear(node, cls)
+    decision.healed = sorted(set(decision.healed))
+
+    for node in sorted(by_node):
+        anom = primary_anomaly(by_node[node])
+        if anom is None:
+            continue
+        ladder = allowed_ladder(anom.cls, knobs.allowed_actions)
+        if not ladder:
+            continue   # every rung disabled: detection-only for this class
+        entry = ledger.entry(node, anom.cls)
+        if entry.exhausted:
+            continue   # ladder ran out earlier; stays quarantined
+        if entry.outcome == "pending":
+            if now - entry.last_action_at < (
+                knobs.cooldown_seconds + PENDING_GRACE_SECONDS
+            ):
+                # directive outstanding and plausibly still in flight
+                # (agent pickup + execute + ack can take a couple of
+                # monitor ticks): keep distributing it verbatim
+                prev = ledger.pending_directive(node, anom.cls)
+                if prev is not None:
+                    decision.directives[node] = prev
+                continue
+            # never acknowledged past the cooldown PLUS the pickup
+            # grace: the agent is wedged or the report was lost —
+            # count the attempt as failed
+            ledger.record_expiry(node, anom.cls)
+        if (
+            entry.last_action_at
+            and now - entry.last_action_at < knobs.cooldown_seconds
+        ):
+            continue   # cooling down after a completed action
+        rung = entry.rung
+        attempts = entry.attempts
+        if attempts >= knobs.escalate_after:
+            rung += 1
+            attempts = 0
+            # persist the advance IMMEDIATELY: if the budget/quorum
+            # gates below deny this pass, the next pass must see the
+            # already-advanced rung (attempts 0 < escalate_after) —
+            # not recompute the same escalation and re-emit its Event
+            # and counter every reconcile until the gate opens
+            entry.rung = rung
+            entry.attempts = 0
+            if rung >= len(ladder):
+                entry.exhausted = True
+                decision.exhausted.append((node, anom.cls))
+                continue
+            decision.escalated.append(
+                (node, anom.cls, ladder[rung - 1], ladder[rung])
+            )
+        if rung >= len(ladder):
+            entry.rung = rung
+            entry.exhausted = True
+            decision.exhausted.append((node, anom.cls))
+            continue
+        action = ladder[rung]
+        # fleet budget: DISTINCT nodes per sliding window
+        window_nodes = ledger.window_nodes(now, knobs.window_seconds)
+        if (
+            node not in window_nodes
+            and len(window_nodes) >= knobs.max_nodes_per_window
+        ):
+            decision.budget_denied.append(node)
+            continue
+        # quorum floor: never let remediation reduce an already-thin
+        # fleet — disruptive rungs wait until the fleet recovers
+        if action not in NON_DISRUPTIVE and \
+                healthy_nodes <= knobs.min_healthy:
+            decision.quorum_held.append(node)
+            continue
+        directive = ledger.issue(
+            node, anom.cls, action, iface=anom.iface, now=now,
+            rung=rung, attempts=attempts,
+        )
+        decision.started.append(directive)
+        decision.directives[node] = directive
+    return decision
+
+
+__all__ = [
+    "ACTIONS", "ACTION_BOUNCE", "ACTION_PEER_SHIFT", "ACTION_REPROBE",
+    "ACTION_REROUTE", "ACTION_RESTART", "ANOMALY_CLASSES", "Anomaly",
+    "CLASS_PROBE", "CLASS_TELEMETRY", "Decision",
+    "DEFAULT_COOLDOWN_SECONDS", "DEFAULT_ESCALATE_AFTER",
+    "DEFAULT_MAX_NODES_PER_WINDOW", "DEFAULT_WINDOW_SECONDS",
+    "Directive", "Entry", "Knobs", "LADDERS", "Ledger", "NON_DISRUPTIVE",
+    "allowed_ladder", "decide", "primary_anomaly",
+]
