@@ -546,6 +546,15 @@ class TestScaleBench:
             )
             assert sweep["status_bytes"] < 256 * 1024
             assert sweep["max_peer_cm_bytes"] < 1024 * 1024
+            # delta-driven pipeline: steady passes ride the fast path
+            # under the p50 budget, and 1-node churn stays delta-sized
+            assert sweep["steady_pass_p50_ms"] <= 65.0
+            assert sweep["steady_fast_path_passes"] > 0
+            assert sweep["churn_pass_p50_ms"] > 0
+        small, big_sweep = row["sweeps"][0], row["sweeps"][-1]
+        assert big_sweep["churn_pass_p50_ms"] <= 2.0 * max(
+            small["churn_pass_p50_ms"], 1.0
+        )
         # the 300-node sweep crossed the auto threshold: summary mode,
         # bounded embedded rows, sharded peer ConfigMaps
         big = row["sweeps"][-1]
@@ -574,6 +583,8 @@ class TestScaleBench:
         assert sweep["steady_writes_per_pass"] == 0
         assert sweep["datagrams_per_round"] <= 8 * 10000
         assert sweep["status_bytes"] < 256 * 1024
+        # the tentpole budget at full scale: a steady pass is O(1)
+        assert sweep["steady_pass_p50_ms"] <= 65.0
 
 
 @pytest.mark.remediation

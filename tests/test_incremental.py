@@ -1,0 +1,524 @@
+"""Delta-driven status pipeline: equivalence + fast-path suite.
+
+The tentpole contract (controller/derived.py + controller/delta.py):
+
+* a **steady pass** (no deltas, no timer-due work) exits via the fast
+  path after a cheap check — zero apiserver requests, zero derivation;
+* an **incremental pass** re-derives only dirty nodes' contributions
+  and must produce output **byte-identical** to a from-scratch rebuild
+  over the same cluster state, for arbitrary churn.
+
+The equivalence property test drives one seeded random churn sequence
+through two mirrored FakeClusters — one reconciled incrementally, one
+with ``FULL_REBUILD_ALWAYS`` (the from-scratch reference) — and after
+every pass compares the serialized CR status, every ConfigMap (peer
+shards, topology plan, remediation ledger + directives), and every
+node's labels.
+"""
+
+import json
+import random
+import time as time_mod
+
+import pytest
+
+from tpu_network_operator.agent import report as rpt
+from tpu_network_operator.api.v1alpha1 import (
+    NetworkClusterPolicy,
+    default_policy,
+)
+from tpu_network_operator.api.v1alpha1.types import API_VERSION
+from tpu_network_operator.controller.delta import DirtyTracker
+from tpu_network_operator.controller.health import Metrics
+from tpu_network_operator.controller.reconciler import (
+    NetworkClusterPolicyReconciler,
+)
+from tpu_network_operator.kube.fake import FakeCluster
+from tpu_network_operator.kube.informer import CachedClient
+
+NS = "tpunet-system"
+POLICY = "eq"
+BASE = 1_750_000_000.0
+
+_real_gmtime = time_mod.gmtime
+
+
+@pytest.fixture()
+def clock(monkeypatch):
+    """One controllable wall clock for BOTH mirrored worlds: report
+    renew times (lease_for → _now_micro → time.gmtime), staleness
+    aging (time.time) and condition transition stamps all read it, so
+    the two reconcilers can never disagree on 'now'."""
+    state = {"off": 0.0}
+    monkeypatch.setattr(
+        time_mod, "time", lambda: BASE + state["off"]
+    )
+    monkeypatch.setattr(
+        time_mod, "gmtime",
+        lambda *a: _real_gmtime(a[0] if a else BASE + state["off"]),
+    )
+    return state
+
+
+def make_policy(remediation=True):
+    p = NetworkClusterPolicy()
+    p.metadata.name = POLICY
+    p.spec.configuration_type = "tpu-so"
+    p.spec.node_selector = {"tpunet.dev/pool": POLICY}
+    so = p.spec.tpu_scale_out
+    so.probe.enabled = True
+    so.probe.interval_seconds = 5
+    so.planner.enabled = True
+    if remediation:
+        so.remediation.enabled = True
+        # restart-agent rolls pods controller-side; mirrored worlds
+        # exercise the distributed rungs (the pod lifecycles of two
+        # fakes are not part of the status contract)
+        so.remediation.allowed_actions = [
+            "re-probe", "peer-shift", "bounce-interface", "reroute",
+        ]
+    return default_policy(p).to_dict()
+
+
+def healthy_report(node, i, n_nodes, rtts=None, anomalies=(),
+                   degraded=False, ok=True, error=""):
+    peers = {
+        f"node-{j:03d}": {
+            "reachable": True,
+            "rttMs": (rtts or {}).get(f"node-{j:03d}", 1.0 + j * 0.1),
+        }
+        for j in range(n_nodes) if j != i
+    }
+    report = rpt.ProvisioningReport(
+        node=node, policy=POLICY, ok=ok, error=error, backend="tpu",
+        mode="L2", interfaces_configured=2, interfaces_total=2,
+        probe_endpoint=f"10.0.0.{i + 1}:8477",
+        probe={
+            "peersTotal": n_nodes - 1,
+            "peersReachable": 0 if degraded else n_nodes - 1,
+            "unreachable": sorted(peers) if degraded else [],
+            "rttP50Ms": 0.5, "rttP99Ms": 1.0,
+            "lossRatio": 0.9 if degraded else 0.0,
+            "state": "Degraded" if degraded else "Healthy",
+            "peers": peers,
+        },
+        telemetry={
+            "interfaces": {
+                "eth0": {
+                    "rxBytes": 1000 + i, "rxPackets": 900,
+                    "txPackets": 800, "rxErrors": 9 if anomalies else 0,
+                    "txErrors": 0,
+                    "errorRatio": 0.01 if anomalies else 0.0,
+                    "anomalies": list(anomalies),
+                },
+            },
+        },
+    )
+    return report
+
+
+class World:
+    """One FakeCluster + CachedClient + reconciler, with every clock
+    seam injected from the shared fake clocks."""
+
+    def __init__(self, clock, probe_clock, full_rebuild, remediation=True):
+        self.fake = FakeCluster()
+        self.fake.create(make_policy(remediation=remediation))
+        self.split = CachedClient(self.fake)
+        self.split.cache(API_VERSION, "NetworkClusterPolicy")
+        self.split.cache("apps/v1", "DaemonSet", namespace=NS)
+        self.split.cache("v1", "Pod", namespace=NS)
+        self.split.cache(rpt.LEASE_API, "Lease", namespace=NS)
+        self.split.cache("v1", "Node")
+        self.split.start()
+        self.rec = NetworkClusterPolicyReconciler(
+            self.split, NS, metrics=Metrics()
+        )
+        self.rec.FULL_REBUILD_ALWAYS = full_rebuild
+        self.rec._probe_clock = lambda: probe_clock["now"]
+        self.rec._rem_clock = lambda: time_mod.time()
+        self.rec._plan_tracker._clock = lambda: probe_clock["now"]
+        self.rec.setup()
+
+    def bootstrap(self, n_nodes):
+        for i in range(n_nodes):
+            node = f"node-{i:03d}"
+            self.fake.add_node(node, {
+                "tpunet.dev/pool": POLICY,
+                "tpunet.dev/rack": f"rack-{i // 4}",
+            })
+            self.fake.apply(rpt.lease_for(
+                healthy_report(node, i, n_nodes), NS
+            ))
+        self.rec.reconcile(POLICY)
+        self.fake.simulate_daemonset_controller()
+        self.rec.reconcile(POLICY)
+
+    def dump(self):
+        cr = self.fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+        cms = {
+            cm["metadata"]["name"]: cm.get("data", {})
+            for cm in self.fake.list("v1", "ConfigMap", namespace=NS)
+        }
+        nodes = {
+            n["metadata"]["name"]: n["metadata"].get("labels", {}) or {}
+            for n in self.fake.list("v1", "Node")
+        }
+        return json.dumps({
+            "status": cr.get("status", {}),
+            "cms": cms,
+            "nodes": nodes,
+        }, sort_keys=True, default=str)
+
+    def stop(self):
+        self.split.stop()
+
+
+N_NODES = 8
+
+
+class TestIncrementalEquivalence:
+    """The satellite acceptance test: incremental == from-scratch,
+    byte for byte, after every pass of a seeded churn sequence."""
+
+    def _mutate(self, rng, step, worlds, clock, probe_clock):
+        """One churn step applied identically to both worlds."""
+        op = rng.choice([
+            "noop", "noop", "flip_report", "telemetry_anomaly",
+            "probe_degrade", "rtt_drift", "endpoint_move",
+            "membership", "ack_directive", "advance_wall",
+            "advance_probe",
+        ])
+        i = rng.randrange(N_NODES)
+        node = f"node-{i:03d}"
+        if op == "advance_wall":
+            # sometimes far enough to age reports stale (TTL 180s)
+            clock["off"] += rng.choice([30.0, 200.0])
+            return op
+        if op == "advance_probe":
+            probe_clock["now"] += rng.choice([1.0, 6.0, 61.0])
+            return op
+        for w in worlds:
+            if op == "flip_report":
+                bad = step % 2 == 0
+                rep = healthy_report(
+                    node, i, N_NODES, ok=not bad,
+                    error="link eth0 down" if bad else "",
+                    degraded=bad,
+                )
+            elif op == "telemetry_anomaly":
+                rep = healthy_report(
+                    node, i, N_NODES,
+                    anomalies=("error-ratio",) if step % 2 else (),
+                )
+            elif op == "probe_degrade":
+                rep = healthy_report(
+                    node, i, N_NODES, degraded=step % 2 == 0
+                )
+            elif op == "rtt_drift":
+                rep = healthy_report(node, i, N_NODES, rtts={
+                    f"node-{j:03d}": 1.0 + ((step * 7 + j) % 9)
+                    for j in range(N_NODES)
+                })
+            elif op == "endpoint_move":
+                rep = healthy_report(node, i, N_NODES)
+                rep.probe_endpoint = f"10.0.1.{(step % 250) + 1}:8477"
+            elif op == "membership":
+                if step % 2 == 0:
+                    rpt.delete_report(w.fake, NS, node)
+                    continue
+                rep = healthy_report(node, i, N_NODES)
+            elif op == "ack_directive":
+                # echo an outstanding directive's outcome back through
+                # the report Lease, like the agent would
+                try:
+                    cm = w.fake.get(
+                        "v1", "ConfigMap",
+                        rpt.directive_configmap_name(POLICY), NS,
+                    )
+                    payload = json.loads(
+                        (cm.get("data", {}) or {}).get(
+                            rpt.DIRECTIVES_KEY, "{}"
+                        )
+                    )
+                    directives = payload.get(rpt.DIRECTIVES_KEY, {})
+                except Exception:
+                    directives = {}
+                if node not in directives:
+                    continue
+                rep = healthy_report(node, i, N_NODES)
+                rep.remediation = {
+                    "directiveId": directives[node].get("id", ""),
+                    "ok": step % 3 != 0,
+                    "error": "" if step % 3 != 0 else "bounce failed",
+                }
+            else:
+                continue
+            w.fake.apply(rpt.lease_for(rep, NS))
+        return op
+
+    def test_seeded_churn_byte_identical(self, clock):
+        probe_clock = {"now": 1000.0}
+        incremental = World(clock, probe_clock, full_rebuild=False)
+        reference = World(clock, probe_clock, full_rebuild=True)
+        worlds = [incremental, reference]
+        try:
+            for w in worlds:
+                w.bootstrap(N_NODES)
+            assert incremental.dump() == reference.dump()
+            rng = random.Random(20260804)
+            for step in range(80):
+                op = self._mutate(
+                    rng, step, worlds, clock, probe_clock
+                )
+                for w in worlds:
+                    w.rec.reconcile(POLICY)
+                assert incremental.dump() == reference.dump(), (
+                    f"divergence at step {step} (op {op})"
+                )
+            # the fast path must actually have fired on the no-op steps
+            fast = sum(
+                v for (name, _), v in
+                incremental.rec.metrics._counters.items()
+                if name == "tpunet_reconcile_fast_path_total"
+            )
+            assert fast > 0
+        finally:
+            for w in worlds:
+                w.stop()
+
+    def test_spec_change_rebuilds_and_stays_identical(self, clock):
+        """A spec change (generation bump) must flow through both
+        pipelines identically — knob flips change derived semantics."""
+        probe_clock = {"now": 1000.0}
+        incremental = World(clock, probe_clock, full_rebuild=False)
+        reference = World(clock, probe_clock, full_rebuild=True)
+        worlds = [incremental, reference]
+        try:
+            for w in worlds:
+                w.bootstrap(N_NODES)
+            for w in worlds:
+                cr = w.fake.get(
+                    API_VERSION, "NetworkClusterPolicy", POLICY
+                )
+                cr["spec"]["tpuScaleOut"]["telemetry"]["enabled"] = False
+                w.fake.update(cr)
+                w.rec.reconcile(POLICY)
+            assert incremental.dump() == reference.dump()
+        finally:
+            for w in worlds:
+                w.stop()
+
+
+class TestFastPath:
+    def _world(self, clock, probe_clock):
+        w = World(clock, probe_clock, full_rebuild=False,
+                  remediation=False)
+        w.bootstrap(N_NODES)
+        # drain to quiescence
+        for _ in range(3):
+            w.rec.reconcile(POLICY)
+        return w
+
+    def _fast_count(self, w):
+        return sum(
+            v for (name, _), v in w.rec.metrics._counters.items()
+            if name == "tpunet_reconcile_fast_path_total"
+        )
+
+    def test_steady_pass_takes_fast_path_with_zero_requests(
+        self, clock
+    ):
+        probe_clock = {"now": 1000.0}
+        w = self._world(clock, probe_clock)
+        try:
+            before_fast = self._fast_count(w)
+            before_req = sum(w.fake.request_counts.values())
+            for _ in range(5):
+                assert w.rec.reconcile(POLICY).requeue is False
+            assert self._fast_count(w) == before_fast + 5
+            assert sum(w.fake.request_counts.values()) == before_req
+        finally:
+            w.stop()
+
+    def test_report_delta_disables_fast_path_and_lands_in_status(
+        self, clock
+    ):
+        probe_clock = {"now": 1000.0}
+        w = self._world(clock, probe_clock)
+        try:
+            rep = healthy_report(
+                "node-001", 1, N_NODES, ok=False, error="boom"
+            )
+            w.fake.apply(rpt.lease_for(rep, NS))
+            before_fast = self._fast_count(w)
+            w.rec.reconcile(POLICY)
+            assert self._fast_count(w) == before_fast   # tier B, not A
+            cr = w.fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+            assert cr["status"]["errors"] == ["node-001: boom"]
+            assert cr["status"]["state"] == "Working on it.."
+        finally:
+            w.stop()
+
+    def test_staleness_expiry_fires_without_any_delta(self, clock):
+        """Report aging is timer-due work the watch stream never
+        announces — the fast path must wake up for it."""
+        probe_clock = {"now": 1000.0}
+        w = self._world(clock, probe_clock)
+        try:
+            clock["off"] += 10_000.0
+            w.rec.reconcile(POLICY)
+            cr = w.fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+            assert cr["status"]["state"] == "Working on it.."
+            assert any(
+                "report stale" in e for e in cr["status"]["errors"]
+            )
+        finally:
+            w.stop()
+
+    def test_relist_reseeds_dirty_all(self, clock):
+        probe_clock = {"now": 1000.0}
+        w = self._world(clock, probe_clock)
+        try:
+            inf = w.split.informer(rpt.LEASE_API, "Lease")
+            inf.resync()          # fires the resync listener
+            w.rec.reconcile(POLICY)
+            gauge = w.rec.metrics._gauges.get((
+                "tpunet_reconcile_dirty_nodes",
+                (("policy", POLICY),),
+            ))
+            # a rebuild re-derives the whole fleet
+            assert gauge == float(N_NODES)
+        finally:
+            w.stop()
+
+    def test_spec_generation_change_forces_rebuild(self, clock):
+        probe_clock = {"now": 1000.0}
+        w = self._world(clock, probe_clock)
+        try:
+            cr = w.fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+            cr["spec"]["tpuScaleOut"]["mtu"] = 9000
+            w.fake.update(cr)
+            before_fast = self._fast_count(w)
+            w.rec.reconcile(POLICY)   # drift pass (DS update)
+            w.rec.reconcile(POLICY)   # rebuild pass
+            assert self._fast_count(w) == before_fast
+        finally:
+            w.stop()
+
+
+class TestDirtyTracker:
+    def test_unknown_policy_reads_dirty_all_once(self):
+        tr = DirtyTracker()
+        assert tr.peek("p") is True
+        nodes, dirty_all, pods = tr.take("p")
+        assert dirty_all is True and nodes == set() and pods is False
+        assert tr.peek("p") is False
+
+    def test_mark_take_peek(self):
+        tr = DirtyTracker()
+        tr.take("p")
+        tr.mark("p", "n1", "tpunet-agent-n1")
+        assert tr.peek("p") is True
+        nodes, dirty_all, _ = tr.take("p")
+        assert nodes == {("n1", "tpunet-agent-n1")}
+        assert dirty_all is False
+        assert tr.peek("p") is False
+
+    def test_seed_all_dirties_every_policy_once_each(self):
+        tr = DirtyTracker()
+        tr.take("a")
+        tr.take("b")
+        tr.seed_all()
+        assert tr.take("a")[1] is True
+        assert tr.take("b")[1] is True
+        assert tr.take("a")[1] is False
+
+    def test_lease_listener_marks_policy_and_node(self):
+        tr = DirtyTracker()
+        tr.take("p")
+        lease = rpt.lease_for(rpt.ProvisioningReport(
+            node="n7", policy="p", ok=True,
+        ), NS)
+        tr._on_lease("update", NS, lease["metadata"]["name"],
+                     lease, None)
+        nodes, _, _ = tr.take("p")
+        assert nodes == {("n7", lease["metadata"]["name"])}
+
+    def test_pod_listener_marks_owner_policy(self):
+        tr = DirtyTracker()
+        tr.take("p")
+        pod = {
+            "metadata": {
+                "name": "p-agent-x",
+                "ownerReferences": [{
+                    "controller": True, "apiVersion": "apps/v1",
+                    "kind": "DaemonSet", "name": "p",
+                }],
+            },
+            "spec": {"nodeName": "n3"},
+        }
+        tr._on_pod("add", NS, "p-agent-x", pod, None)
+        nodes, _, pods_dirty = tr.take("p")
+        assert pods_dirty is True and nodes == {("n3", None)}
+
+    def test_node_rack_change_reseeds_but_heartbeat_does_not(self):
+        tr = DirtyTracker()
+        tr.take("p")
+        labeled = {"metadata": {"name": "n1", "labels": {
+            "tpunet.dev/rack": "r1",
+        }}}
+        heartbeat = {"metadata": {"name": "n1", "labels": {
+            "tpunet.dev/rack": "r1",
+        }}, "status": {"x": 1}}
+        tr._on_node("update", "", "n1", heartbeat, labeled)
+        assert tr.peek("p") is False
+        moved = {"metadata": {"name": "n1", "labels": {
+            "tpunet.dev/rack": "r2",
+        }}}
+        tr._on_node("update", "", "n1", moved, labeled)
+        assert tr.take("p")[1] is True
+
+    def test_forget_drops_state(self):
+        tr = DirtyTracker()
+        tr.take("p")
+        tr.mark("p", "n1")
+        tr.forget("p")
+        # forgotten = unseen policy again: next take is a rebuild
+        assert tr.take("p") == (set(), True, False)
+
+
+class TestDerivedAggregates:
+    def test_duplicate_lease_removal_keeps_sibling_node_state(self):
+        """Two leases claiming one node (unconventional lease names):
+        removing one must not wipe node-keyed state the survivor still
+        asserts — the exactness contract vs a from-scratch fold."""
+        from tpu_network_operator.api.v1alpha1 import types as t
+        from tpu_network_operator.controller.derived import (
+            NodeContribution,
+            PolicyDerived,
+        )
+
+        def contrib(lease, endpoint, state):
+            return NodeContribution(
+                lease=lease, node="n1", ok=True,
+                endpoint=endpoint, has_endpoint=True,
+                probe_row=t.NodeProbeStatus(node="n1", state=state),
+                plan_obs=(("n2", 1.0),),
+            )
+
+        d = PolicyDerived()
+        d.apply("lease-a", contrib(
+            "lease-a", "10.0.0.1:1", t.PROBE_STATE_REACHABLE
+        ))
+        d.apply("lease-b", contrib(
+            "lease-b", "10.0.0.2:1", t.PROBE_STATE_DEGRADED
+        ))
+        d.apply("lease-a", None)
+        assert "n1" in d.degraded          # survivor still degraded
+        assert d.endpoints["n1"] == "10.0.0.2:1"
+        assert d.plan_members == {"n1"}
+        assert d.plan_obs["n1"] == (("n2", 1.0),)
+        d.apply("lease-b", None)
+        assert d.degraded == set() and d.endpoints == {}
+        assert d.plan_members == set() and d.plan_obs == {}

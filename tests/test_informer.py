@@ -408,3 +408,95 @@ class TestWatchResilience:
             assert names == {"b"}
         finally:
             cached.stop()
+
+
+class TestDeltaHooks:
+    """The Store/Informer delta feed (PR: delta-driven reconcile) —
+    key-level add/update/delete notifications plus the relist signal
+    the dirty tracker reseeds from."""
+
+    def _listener(self):
+        events = []
+
+        def fn(ev, ns, name, new, old):
+            events.append((ev, ns, name,
+                           new is not None, old is not None))
+
+        return events, fn
+
+    def test_store_fires_add_update_delete(self):
+        s = Store()
+        events, fn = self._listener()
+        s.add_delta_listener(fn)
+        s.upsert(mk("Lease", "l1", NS, rv=1))
+        s.upsert(mk("Lease", "l1", NS, rv=2))
+        s.delete(NS, "l1")
+        assert events == [
+            ("add", NS, "l1", True, False),
+            ("update", NS, "l1", True, True),
+            ("delete", NS, "l1", False, True),
+        ]
+
+    def test_delete_of_absent_key_is_silent(self):
+        s = Store()
+        events, fn = self._listener()
+        s.add_delta_listener(fn)
+        s.delete(NS, "ghost")
+        assert events == []
+
+    def test_listener_exception_does_not_break_store(self):
+        s = Store()
+        s.add_delta_listener(lambda *a: 1 / 0)
+        s.upsert(mk("Lease", "l1", NS, rv=1))      # must not raise
+        assert s.get("l1", NS) is not None
+
+    def test_shared_objects_not_copies(self):
+        """Delta listeners get the STORED objects (the whole point:
+        no per-event deepcopy on fleet-churn kinds)."""
+        s = Store()
+        seen = []
+        s.add_delta_listener(
+            lambda ev, ns, name, new, old: seen.append(new)
+        )
+        obj = mk("Lease", "l1", NS, rv=1)
+        s.upsert(obj)
+        assert seen[0] is obj
+
+    def test_informer_feeds_listener_and_skips_stale_events(self):
+        fake = FakeCluster()
+        fake.create(mk("ConfigMap", "a", NS, rv=None))
+        inf = Informer(fake, "v1", "ConfigMap", namespace=NS).start()
+        events, fn = self._listener()
+        inf.add_delta_listener(fn)
+        fake.update(fake.get("v1", "ConfigMap", "a", NS))
+        inf.sync()
+        assert [e[0] for e in events] == ["update"]
+        # a replayed stale event (older rv) must NOT reach listeners
+        stale = mk("ConfigMap", "a", NS, rv=1)
+        inf._apply("MODIFIED", stale)
+        assert [e[0] for e in events] == ["update"]
+        inf.stop()
+
+    def test_resync_fires_relist_listener_not_spurious_updates(self):
+        """A relist announces itself once (the dirty tracker reseeds
+        to dirty-all) — it must NOT also fire per-key update deltas
+        for objects whose resourceVersion did not move."""
+        fake = FakeCluster()
+        fake.create(mk("ConfigMap", "a", NS))
+        fake.create(mk("ConfigMap", "b", NS))
+        inf = Informer(fake, "v1", "ConfigMap", namespace=NS).start()
+        events, fn = self._listener()
+        relists = []
+        inf.add_delta_listener(fn)
+        inf.add_resync_listener(lambda: relists.append(1))
+        inf.resync()
+        assert relists == [1]
+        assert events == []        # same rvs: no per-key noise
+        # a relist that discovers a deletion fires the delete delta
+        fake.delete("v1", "ConfigMap", "b", NS)
+        while inf._watch.next(timeout=0) is not None:
+            pass                   # drop the watch event: relist must see it
+        inf.resync()
+        assert relists == [1, 1]
+        assert ("delete", NS, "b", False, True) in events
+        inf.stop()

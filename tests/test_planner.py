@@ -823,14 +823,21 @@ class TestPlanInputsFilter:
                     for p, ms in peers.items()
                 }},
             ))
-        nodes = sorted(r.node for r in reports)
-        inputs = R._plan_inputs(
-            default_policy(tpu_policy()), nodes, reports, [], [], {},
+        from tpu_network_operator.controller.derived import (
+            NodeContribution,
         )
-        assert ("node-000", "node-001") not in inputs.rtt
-        assert inputs.rtt[("node-000", "node-002")] == 1.5
+
+        obs = {}
+        for rep in reports:
+            c = NodeContribution(lease=rep.node, node=rep.node)
+            R._fold_plan(c, rep, rep.probe)
+            if c.plan_obs is not None:
+                obs[c.node] = dict(c.plan_obs)
+        rtt = pp.build_matrix(obs)
+        assert ("node-000", "node-001") not in rtt
+        assert rtt[("node-000", "node-002")] == 1.5
         assert pp.edge_rtt(
-            inputs.rtt, "node-000", "node-001"
+            rtt, "node-000", "node-001"
         ) == pp.DEFAULT_RTT_MS
 
 

@@ -6,8 +6,15 @@ other benches touch (they run at 20-25 nodes): a sweep of FakeCluster
 fleets (default 100 → 2,000 → 10,000 nodes, one tpu-so policy with the
 sampled probe mesh at degree k=8) measures, per size:
 
-* **reconcile p50/p95** over warm passes (informer-cached reads, lease
-  parse memo, diff-gated flushes);
+* **reconcile p50/p95** over warm FULL-REBUILD passes (informer-cached
+  reads, lease parse memo, diff-gated flushes) — the from-scratch
+  reference the delta pipeline is judged against;
+* **steady-pass p50** — the delta-driven fast path: no deltas, no
+  timer-due work, so a pass must cost O(1) regardless of fleet size
+  (budget ≤ 65 ms at every size, ≥5x under the 10k full pass);
+* **churn-pass p50** — one node's report flips per pass: work must
+  scale with the delta, not the fleet (10k-node churn within 2x of
+  the 100-node churn pass);
 * **apiserver writes per steady pass** — must be 0 (O(shards) on
   change, never O(nodes));
 * **writes per churn event** (one node's report flips / one endpoint
@@ -48,6 +55,13 @@ PROBE_INTERVAL = 5
 # the acceptance budgets the artifact is judged against
 MAX_STATUS_BYTES = 256 * 1024
 PARTITION_BUDGET_INTERVALS = 3
+# steady (fast-path) pass budget — the tentpole: a pass with nothing
+# to do must cost O(1), far under the 10k full-rebuild p50 (~330 ms)
+STEADY_P50_BUDGET_MS = 65.0
+# one-node churn at the largest sweep vs the smallest: work ∝ delta,
+# not fleet (floor keeps sub-ms noise from dominating the ratio)
+CHURN_RATIO_BUDGET = 2.0
+CHURN_FLOOR_MS = 1.0
 
 
 def log(msg):
@@ -143,7 +157,7 @@ def peer_cm_stats(fake):
     return len(cms), max_bytes, edges
 
 
-def run_sweep(n_nodes: int, rounds: int):
+def run_sweep(n_nodes: int, rounds: int, churn_rounds: int = 10):
     from tpu_network_operator.agent import report as rpt
     from tpu_network_operator.api.v1alpha1.types import API_VERSION
     from tpu_network_operator.controller.health import Metrics
@@ -185,14 +199,41 @@ def run_sweep(n_nodes: int, rounds: int):
         if delta_writes(before, write_counts(fake)) == 0:
             break
 
-    # steady state: timed warm passes, write accounting
+    # full-rebuild reference passes: the from-scratch pipeline the
+    # delta path must match byte-for-byte (and beat on latency)
     latencies = []
-    before = write_counts(fake)
+    rec.FULL_REBUILD_ALWAYS = True
     for _ in range(rounds):
         t0 = time.perf_counter()
         rec.reconcile(POLICY)
         latencies.append(time.perf_counter() - t0)
-    steady_writes = delta_writes(before, write_counts(fake)) / rounds
+    rec.FULL_REBUILD_ALWAYS = False
+    rec.reconcile(POLICY)   # fold back into delta mode (one rebuild)
+
+    # steady state: the delta fast path — no deltas, no timer work
+    steady_lat = []
+    before = write_counts(fake)
+    steady_rounds = max(rounds * 4, 20)
+    for _ in range(steady_rounds):
+        t0 = time.perf_counter()
+        rec.reconcile(POLICY)
+        steady_lat.append(time.perf_counter() - t0)
+    steady_writes = delta_writes(before, write_counts(fake)) / steady_rounds
+
+    # churn passes: one node's report flips per pass (degrade/heal
+    # alternating, ending healthy) — work must follow the delta
+    churn_lat = []
+    for j in range(churn_rounds * 2):
+        rep = healthy_report("node-00000", 0)
+        if j % 2 == 0:
+            rep.ok = False
+            rep.error = "link eth1 down"
+            rep.probe["peersReachable"] = 0
+            rep.probe["state"] = "Degraded"
+        fake.apply(rpt.lease_for(rep, NAMESPACE))
+        t0 = time.perf_counter()
+        rec.reconcile(POLICY)
+        churn_lat.append(time.perf_counter() - t0)
 
     # churn 1: one node's report flips to failed (fabric trouble)
     degraded = healthy_report("node-00000", 0)
@@ -224,12 +265,23 @@ def run_sweep(n_nodes: int, rounds: int):
         or []
     )
     cm_count, max_cm_bytes, datagrams = peer_cm_stats(fake)
+    fast_passes = sum(
+        v for (name, _), v in rec.metrics._counters.items()
+        if name == "tpunet_reconcile_fast_path_total"
+    )
     split.stop()
     lat_sorted = sorted(latencies)
     row = {
         "nodes": n_nodes,
         "reconcile_p50_ms": round(pctile(lat_sorted, 0.5) * 1e3, 2),
         "reconcile_p95_ms": round(pctile(lat_sorted, 0.95) * 1e3, 2),
+        "steady_pass_p50_ms": round(
+            pctile(sorted(steady_lat), 0.5) * 1e3, 3
+        ),
+        "churn_pass_p50_ms": round(
+            pctile(sorted(churn_lat), 0.5) * 1e3, 3
+        ),
+        "steady_fast_path_passes": int(fast_passes),
         "steady_writes_per_pass": round(steady_writes, 3),
         "churn_report_writes": churn_report_writes,
         "churn_endpoint_writes": churn_endpoint_writes,
@@ -243,7 +295,9 @@ def run_sweep(n_nodes: int, rounds: int):
         "datagram_bound_k_n": DEGREE * n_nodes,
         "full_mesh_datagrams": n_nodes * max(n_nodes - 1, 0),
     }
-    log(f"   -> p50 {row['reconcile_p50_ms']}ms, "
+    log(f"   -> full p50 {row['reconcile_p50_ms']}ms, "
+        f"steady p50 {row['steady_pass_p50_ms']}ms, "
+        f"churn p50 {row['churn_pass_p50_ms']}ms, "
         f"{row['steady_writes_per_pass']} writes/pass, "
         f"status {status_bytes}B ({detail}), "
         f"{datagrams} datagrams/round ({cm_count} CMs)")
@@ -329,13 +383,16 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes-list", default="100,2000,10000")
     ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--churn-rounds", type=int, default=10)
     ap.add_argument("--partition-nodes", type=int, default=2000)
     ap.add_argument("--out", default="",
                     help="also write the JSON artifact to this path")
     args = ap.parse_args()
     sizes = [int(s) for s in args.nodes_list.split(",") if s.strip()]
 
-    sweeps = [run_sweep(n, args.rounds) for n in sizes]
+    sweeps = [
+        run_sweep(n, args.rounds, args.churn_rounds) for n in sizes
+    ]
     partition = run_partition(args.partition_nodes)
 
     failures = []
@@ -358,6 +415,27 @@ def main() -> None:
             failures.append(
                 f"{row['nodes']} nodes: {row['churn_report_writes']} "
                 "writes for one report churn event"
+            )
+        if row["steady_pass_p50_ms"] > STEADY_P50_BUDGET_MS:
+            failures.append(
+                f"{row['nodes']} nodes: steady pass p50 "
+                f"{row['steady_pass_p50_ms']}ms over the "
+                f"{STEADY_P50_BUDGET_MS}ms budget"
+            )
+        if row["steady_fast_path_passes"] <= 0:
+            failures.append(
+                f"{row['nodes']} nodes: steady passes never took the "
+                "fast path"
+            )
+    if len(sweeps) >= 2:
+        churn_small = sweeps[0]["churn_pass_p50_ms"]
+        churn_big = sweeps[-1]["churn_pass_p50_ms"]
+        if churn_big > CHURN_RATIO_BUDGET * max(churn_small, CHURN_FLOOR_MS):
+            failures.append(
+                f"one-node churn at {sweeps[-1]['nodes']} nodes "
+                f"({churn_big}ms) is more than {CHURN_RATIO_BUDGET}x the "
+                f"{sweeps[0]['nodes']}-node churn pass ({churn_small}ms) "
+                "— work is scaling with the fleet, not the delta"
             )
     if not (
         0 < partition["detect_intervals"]

@@ -1,0 +1,224 @@
+"""Dirty-node tracking: the watch stream already knows what changed.
+
+The status pass used to re-aggregate the whole fleet from scratch every
+reconcile — at 10,000 nodes that is hundreds of milliseconds of pure
+re-derivation of state the watch stream had already said was unchanged.
+This module turns the informer caches' delta feed (``Store``/``Informer``
+``add_delta_listener``, kube/informer.py) into the per-policy **dirty
+sets** the reconciler consumes:
+
+* a **Lease** delta (agent report created/renewed/deleted) marks exactly
+  that (policy, node) dirty — the policy label rides the Lease, so no
+  lookup is needed;
+* a **Pod** delta for an agent DaemonSet marks the owning policy's pod
+  set dirty (the target-node correlation must be recomputed) plus the
+  pod's node;
+* a **Node** delta that changes the rack/slice shard key reseeds every
+  policy to dirty-all (shard keys are cross-policy);
+* every informer **relist** (seed list, watch-restart catch-up, periodic
+  resync) reseeds dirty-all — a relist can change the store without a
+  per-key event trail, so derived state must be rebuilt from scratch.
+
+Consumption contract: :meth:`DirtyTracker.sync` drains the attached
+informers (firing any queued listeners) so a take observes everything
+the apiserver has already streamed — the same read-your-watch freshness
+the cached read path gives; :meth:`take` then pops the policy's state.
+A policy never seen by the tracker reads as dirty-all, so a reconciler
+restart (or a tracker attached mid-flight) starts from a full rebuild.
+
+Thread safety: listeners fire from whichever thread drains an informer
+(the CachedClient pump thread or a reconcile worker mid-read) while
+workers take — everything mutates under one lock, and listeners never
+read back through the client (no lock-order hazard with the informer
+pump lock).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional, Set, Tuple
+
+from ..agent import report as rpt
+from ..probe import topology
+
+log = logging.getLogger("tpunet.controller.delta")
+
+
+def _owner_daemonset(obj) -> str:
+    """Name of the controlling DaemonSet owner, or '' — the agent
+    DaemonSet is named after its policy, so this IS the policy name."""
+    for ref in obj.get("metadata", {}).get("ownerReferences", []) or []:
+        if (
+            ref.get("controller")
+            and ref.get("apiVersion") == "apps/v1"
+            and ref.get("kind") == "DaemonSet"
+        ):
+            return str(ref.get("name", ""))
+    return ""
+
+
+def _lease_key(obj) -> Tuple[str, str]:
+    """(policy, node) a report Lease contributes to — ('', '') when the
+    object is not an agent report."""
+    meta = obj.get("metadata", {}) or {}
+    labels = meta.get("labels", {}) or {}
+    if labels.get(rpt.AGENT_LABEL) != "true":
+        return "", ""
+    policy = str(labels.get(rpt.POLICY_LABEL, "") or "")
+    node = str((obj.get("spec", {}) or {}).get("holderIdentity", "") or "")
+    return policy, node
+
+
+class DirtyTracker:
+    """Per-policy dirty-node sets fed by informer deltas (see module
+    docstring).  ``active`` is False until :meth:`attach` finds a Lease
+    informer to listen on — an inactive tracker reads as dirty-all
+    forever, which is exactly the legacy full-rebuild behavior."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # policy -> {(node, lease_name_or_None)} — the lease name rides
+        # along when the delta saw it (Leases with unconventional names
+        # must still be findable), None for node-only dirt (pods, timers)
+        self._dirty: Dict[str, Set[Tuple[str, Optional[str]]]] = {}
+        self._pods: Set[str] = set()
+        # epoch bumps on every seed_all(); a policy whose last-consumed
+        # epoch lags reads dirty-all.  Policies start at -1 (never
+        # consumed), so the first take after ANY attach is a rebuild.
+        self._epoch = 0
+        self._policy_epoch: Dict[str, int] = {}
+        self._informers = []
+        self.active = False
+
+    # -- wiring ---------------------------------------------------------------
+
+    def attach(self, client) -> bool:
+        """Register listeners on the client's Lease/Pod/Node informers
+        (CachedClient).  Returns whether delta tracking is live (a
+        Lease informer exists — without it there is no report feed and
+        every pass must rebuild).  Safe to call more than once."""
+        informer_of = getattr(client, "informer", None)
+        if informer_of is None or self.active:
+            return self.active
+        lease_inf = informer_of(rpt.LEASE_API, "Lease")
+        if lease_inf is None:
+            return False
+        lease_inf.add_delta_listener(self._on_lease)
+        lease_inf.add_resync_listener(self.seed_all)
+        self._informers.append(lease_inf)
+        pod_inf = informer_of("v1", "Pod")
+        if pod_inf is not None:
+            pod_inf.add_delta_listener(self._on_pod)
+            pod_inf.add_resync_listener(self.seed_all)
+            self._informers.append(pod_inf)
+        node_inf = informer_of("v1", "Node")
+        if node_inf is not None:
+            node_inf.add_delta_listener(self._on_node)
+            node_inf.add_resync_listener(self.seed_all)
+            self._informers.append(node_inf)
+        self.active = True
+        return True
+
+    def sync(self) -> None:
+        """Drain the attached informers' watch queues (non-blocking) so
+        the dirty state observes everything already streamed — called
+        before every fast-path check and every take."""
+        for inf in self._informers:
+            try:
+                inf.sync()
+            except Exception:   # noqa: BLE001 — informer heals itself
+                log.exception("dirty-tracker informer sync failed")
+
+    # -- listeners (fired from informer threads) ------------------------------
+
+    def _on_lease(self, ev, ns, name, new, old) -> None:
+        for obj in (new, old):
+            if obj is None:
+                continue
+            policy, node = _lease_key(obj)
+            if policy and node:
+                self.mark(policy, node, name)
+
+    def _on_pod(self, ev, ns, name, new, old) -> None:
+        for obj in (new, old):
+            if obj is None:
+                continue
+            policy = _owner_daemonset(obj)
+            if not policy:
+                continue
+            node = str(
+                (obj.get("spec", {}) or {}).get("nodeName", "") or ""
+            )
+            with self._lock:
+                if policy not in self._policy_epoch:
+                    # a DaemonSet owner the reconciler has never taken
+                    # is either a foreign DaemonSet in the namespace
+                    # (log collectors etc. — tracking it would grow
+                    # these sets forever with keys nobody consumes) or
+                    # a policy still pending its first take, which
+                    # reads dirty-all anyway
+                    continue
+                self._pods.add(policy)
+                if node:
+                    self._dirty.setdefault(policy, set()).add((node, None))
+
+    def _on_node(self, ev, ns, name, new, old) -> None:
+        """Only rack/slice-label-relevant Node changes reseed: Node
+        heartbeats (status renewals) and the reconciler's own plan-label
+        patches must not turn every steady pass into a full rebuild."""
+        old_rack = topology.rack_of(
+            (old or {}).get("metadata", {}).get("labels")
+        )
+        new_rack = topology.rack_of(
+            (new or {}).get("metadata", {}).get("labels")
+        )
+        if old_rack != new_rack:
+            self.seed_all()
+
+    # -- mutation -------------------------------------------------------------
+
+    def mark(
+        self, policy: str, node: str, lease: Optional[str] = None
+    ) -> None:
+        with self._lock:
+            self._dirty.setdefault(policy, set()).add((node, lease))
+
+    def seed_all(self) -> None:
+        with self._lock:
+            self._epoch += 1
+
+    def forget(self, policy: str) -> None:
+        """Deleted policy: drop its tracking state."""
+        with self._lock:
+            self._dirty.pop(policy, None)
+            self._pods.discard(policy)
+            self._policy_epoch.pop(policy, None)
+
+    # -- consumption ----------------------------------------------------------
+
+    def peek(self, policy: str) -> bool:
+        """True when the policy has ANY pending dirt (nodes, pods, or a
+        reseed it has not consumed) — the fast-path gate.  Does not
+        consume."""
+        with self._lock:
+            return bool(
+                self._dirty.get(policy)
+                or policy in self._pods
+                or self._policy_epoch.get(policy, -1) != self._epoch
+            )
+
+    def take(
+        self, policy: str
+    ) -> Tuple[Set[Tuple[str, Optional[str]]], bool, bool]:
+        """Pop the policy's pending state: ``(dirty_items, dirty_all,
+        pods_dirty)`` with items of ``(node, lease_name_or_None)``.  ``dirty_all`` means derived state must be
+        rebuilt from scratch (reseed since the last take, or a policy
+        the tracker has never handed out)."""
+        with self._lock:
+            nodes = self._dirty.pop(policy, set())
+            pods = policy in self._pods
+            self._pods.discard(policy)
+            dirty_all = self._policy_epoch.get(policy, -1) != self._epoch
+            self._policy_epoch[policy] = self._epoch
+            return nodes, dirty_all, pods

@@ -1,0 +1,551 @@
+"""Per-policy derived state: node contributions + mergeable aggregates.
+
+The status pass derives, per node, a **contribution record** — parsed
+report, probe verdict row, telemetry rollup terms, planner input row,
+remediation anomaly material — and folds all of them into fleet-level
+aggregates (ready counts, per-shard rollups, the worst-K triage index,
+the telemetry fleet rollup, the planner's observation matrix).  Doing
+that from scratch every pass is O(fleet); this module makes every
+aggregate **mergeable**: a changed node's old contribution is
+subtracted and its new one added, so a pass costs O(changed nodes).
+
+Correctness contract: applying contributions one by one must land on
+exactly the state a from-scratch rebuild over the same contributions
+produces — the reconciler enforces it with periodic (and on-relist)
+full rebuilds, and tests/test_incremental.py proves byte-identical
+status output under seeded random churn.  Two details make the
+equality exact rather than approximate:
+
+* counters are integers (subtract/add never drifts);
+* order-sensitive outputs (the worst-K triage rows, the telemetry
+  worst-node champion) are maintained as sorted structures with the
+  same total order the from-scratch code used, ties included.
+
+Section **versions** (peers/plan/remediation/exports/…) bump only when
+a contribution change actually touches that section's inputs, so the
+reconciler can skip whole subsystems on unrelated churn.
+"""
+
+from __future__ import annotations
+
+import bisect
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from ..api.v1alpha1 import types as t
+
+# total order of the worst-K triage index: quarantined first, then
+# degraded, then lossiest, then widest peer deficit, ties by node name
+# — deterministic under churn, and the ONLY definition of the order
+_STATE_PRIORITY = {
+    t.PROBE_STATE_QUARANTINED: 0,
+    t.PROBE_STATE_DEGRADED: 1,
+}
+
+
+def worst_key(row: t.NodeProbeStatus) -> Tuple:
+    return (
+        _STATE_PRIORITY.get(row.state, 2), -row.loss_ratio,
+        row.peers_reachable - row.peers_total, row.node,
+    )
+
+
+@dataclass
+class NodeContribution:
+    """Everything one report Lease contributes to the status pass,
+    derived once per (lease resourceVersion, spec generation, staleness
+    epoch) and held until a delta invalidates it.  ``lease`` is the
+    identity key (one Lease = one contribution); ``node`` is what the
+    report claims and is what every rollup is keyed by."""
+
+    lease: str
+    node: str
+    rv: str = ""
+    report: Any = None                  # effective (staleness-aged) report
+    renewed: Optional[float] = None
+    ok: bool = False
+    error: str = ""                     # formatted errors-list line ("" when ok)
+    version: str = ""                   # agent_version ("" = not counted)
+    # probe mesh
+    endpoint: str = ""                  # validated endpoint ("" = not in mesh)
+    has_endpoint: bool = False          # raw non-empty endpoint (plan member)
+    probe_row: Optional[t.NodeProbeStatus] = None
+    # telemetry
+    t_reporting: bool = False
+    t_errs: int = 0
+    t_pkts: int = 0
+    t_worst: float = 0.0
+    t_anoms: Tuple[str, ...] = ()       # "node/iface: kind" strings
+    t_anom_ifaces: Tuple[Tuple[str, str], ...] = ()   # (iface, detail)
+    t_rows: Tuple = ()                  # bounded per-iface metric rows
+    # planner
+    plan_obs: Optional[Tuple[Tuple[str, float], ...]] = None
+    ici_group: str = ""
+    # remediation
+    outcome: Optional[Tuple[str, bool, str]] = None   # (directiveId, ok, err)
+    # summary shard key (bound to the current shard context by the
+    # aggregate, not computed here)
+    shard_key: str = ""
+
+    # -- section signatures: a change bumps that section's version ------------
+
+    def head_sig(self):
+        return (self.node, self.ok, self.error, self.version)
+
+    def peers_sig(self):
+        return (self.node, self.endpoint)
+
+    def probe_sig(self):
+        return self.probe_row
+
+    def telem_sig(self):
+        return (
+            self.t_reporting, self.t_errs, self.t_pkts, self.t_worst,
+            self.t_anoms, self.t_rows,
+        )
+
+    def plan_sig(self):
+        state = self.probe_row.state if self.probe_row else ""
+        return (
+            self.node, self.has_endpoint, self.plan_obs, self.ici_group,
+            state, bool(self.t_anoms),
+        )
+
+    def rem_sig(self):
+        state = self.probe_row.state if self.probe_row else ""
+        return (self.node, state, self.t_anom_ifaces, self.outcome)
+
+    def summary_sig(self):
+        state = self.probe_row.state if self.probe_row else ""
+        return (
+            self.node, self.ok, state, bool(self.t_anoms), self.shard_key,
+        )
+
+
+_SECTIONS = (
+    "head", "peers", "probe", "telem", "plan", "rem", "summary",
+)
+
+
+@dataclass
+class _Shard:
+    nodes: int = 0
+    ready: int = 0
+    degraded: int = 0
+    quarantined: int = 0
+    anomalous: int = 0
+
+    def empty(self) -> bool:
+        return self.nodes == 0
+
+
+class PolicyDerived:
+    """One policy's contribution store + incrementally maintained
+    aggregates (see module docstring).  Single-writer per policy (the
+    workqueue never runs one policy on two workers), so no locking."""
+
+    def __init__(self):
+        self.contribs: Dict[str, NodeContribution] = {}
+        # head rollup
+        self.ok_count = 0
+        self.errors: Dict[str, str] = {}        # lease -> error line
+        self.versions: Counter = Counter()
+        self.node_leases: Dict[str, Set[str]] = {}   # node -> lease names
+        # probe
+        self.endpoints: Dict[str, str] = {}     # node -> valid endpoint
+        self.plan_members: Set[str] = set()     # nodes w/ raw endpoint
+        self.probe_rows: Dict[str, t.NodeProbeStatus] = {}   # lease -> row
+        self.worst_index: List[Tuple] = []      # sorted (worst_key, lease)
+        self.degraded: Set[str] = set()         # node names
+        self.quarantined: Set[str] = set()
+        # telemetry
+        self.t_reporting = 0
+        self.t_errs = 0
+        self.t_pkts = 0
+        self.t_worst: Dict[str, float] = {}     # lease -> node worst ratio
+        self.champion: Optional[Tuple[float, str, str]] = None  # (ratio, node, lease)
+        self.t_anomalous: Dict[str, Tuple[str, ...]] = {}       # lease -> anoms
+        # planner
+        self.plan_obs: Dict[str, Tuple] = {}    # node -> obs row tuple
+        self.ici_groups: Dict[str, str] = {}
+        # remediation
+        self.outcomes: Dict[str, Tuple[str, bool, str]] = {}    # node -> outcome
+        # summary
+        self.shards: Dict[str, _Shard] = {}
+        self.shard_ctx: Optional[Tuple] = None  # (detail, n_buckets, racks_ver)
+        self._shard_key_fn: Callable[[str], str] = lambda node: ""
+        # section versions (bump = that section's inputs changed)
+        self.vers: Dict[str, int] = {s: 0 for s in _SECTIONS}
+
+    # -- membership -----------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.contribs)
+
+    def nodes(self) -> Set[str]:
+        return set(self.node_leases)
+
+    def sorted_contribs(self) -> List[NodeContribution]:
+        """Report order of the from-scratch path: bucket order (sorted
+        lease names) stably re-sorted by node name."""
+        return [
+            self.contribs[lease]
+            for _, lease in sorted(
+                (c.node, lease) for lease, c in self.contribs.items()
+            )
+        ]
+
+    def reports(self) -> List[Any]:
+        return [c.report for c in self.sorted_contribs()]
+
+    # -- shard context --------------------------------------------------------
+
+    def set_shard_ctx(
+        self, ctx: Tuple, key_fn: Callable[[str], str]
+    ) -> bool:
+        """Bind the (detail mode, bucket count, rack-map version) shard
+        context; a change re-keys every contribution and rebuilds the
+        shard rollup (O(n), only on mode/bucket/rack flips).  Returns
+        whether the rollup changed."""
+        self._shard_key_fn = key_fn
+        if ctx == self.shard_ctx:
+            return False
+        self.shard_ctx = ctx
+        old = {
+            k: (s.nodes, s.ready, s.degraded, s.quarantined, s.anomalous)
+            for k, s in self.shards.items()
+        }
+        self.shards = {}
+        for c in self.contribs.values():
+            c.shard_key = key_fn(c.node)
+            self._shard_add(c)
+        new = {
+            k: (s.nodes, s.ready, s.degraded, s.quarantined, s.anomalous)
+            for k, s in self.shards.items()
+        }
+        if new != old:
+            self.vers["summary"] += 1
+            return True
+        return False
+
+    def _shard_add(self, c: NodeContribution, sign: int = 1) -> None:
+        shard = self.shards.get(c.shard_key)
+        if shard is None:
+            shard = self.shards[c.shard_key] = _Shard()
+        shard.nodes += sign
+        if c.ok:
+            shard.ready += sign
+        state = c.probe_row.state if c.probe_row else ""
+        if state == t.PROBE_STATE_QUARANTINED:
+            shard.quarantined += sign
+        elif state == t.PROBE_STATE_DEGRADED:
+            shard.degraded += sign
+        if c.t_anoms:
+            shard.anomalous += sign
+        if shard.empty():
+            del self.shards[c.shard_key]
+
+    # -- apply ----------------------------------------------------------------
+
+    def apply(
+        self, lease: str, new: Optional[NodeContribution]
+    ) -> Optional[NodeContribution]:
+        """Subtract the lease's old contribution, add the new one (None
+        = the lease departed).  Bumps exactly the section versions whose
+        signatures changed.  Returns the old contribution."""
+        old = self.contribs.get(lease)
+        if old is None and new is None:
+            return None
+        if new is not None:
+            new.shard_key = self._shard_key_fn(new.node)
+        for section in _SECTIONS:
+            sig = section + "_sig"
+            old_sig = getattr(old, sig)() if old is not None else None
+            new_sig = getattr(new, sig)() if new is not None else None
+            if old_sig != new_sig:
+                self.vers[section] += 1
+        if old is not None:
+            self._subtract(lease, old)
+        if new is not None:
+            self._add(lease, new)
+        return old
+
+    def _subtract(self, lease: str, c: NodeContribution) -> None:
+        del self.contribs[lease]
+        leases = self.node_leases.get(c.node)
+        if leases is not None:
+            leases.discard(lease)
+            if not leases:
+                del self.node_leases[c.node]
+        if c.ok:
+            self.ok_count -= 1
+        self.errors.pop(lease, None)
+        if c.version:
+            self.versions[c.version] -= 1
+            if self.versions[c.version] <= 0:
+                del self.versions[c.version]
+        if c.endpoint and self.endpoints.get(c.node) == c.endpoint:
+            del self.endpoints[c.node]
+        if c.has_endpoint:
+            self.plan_members.discard(c.node)
+        if c.probe_row is not None:
+            del self.probe_rows[lease]
+            entry = (worst_key(c.probe_row), lease)
+            i = bisect.bisect_left(self.worst_index, entry)
+            if i < len(self.worst_index) and self.worst_index[i] == entry:
+                del self.worst_index[i]
+            self.degraded.discard(c.node)
+            self.quarantined.discard(c.node)
+        if c.t_reporting:
+            self.t_reporting -= 1
+            self.t_errs -= c.t_errs
+            self.t_pkts -= c.t_pkts
+            del self.t_worst[lease]
+            if self.champion is not None and self.champion[2] == lease:
+                self._recompute_champion()
+        self.t_anomalous.pop(lease, None)
+        if c.plan_obs is not None and self.plan_obs.get(c.node) == c.plan_obs:
+            del self.plan_obs[c.node]
+        if c.ici_group and self.ici_groups.get(c.node) == c.ici_group:
+            del self.ici_groups[c.node]
+        if c.outcome is not None and self.outcomes.get(c.node) == c.outcome:
+            del self.outcomes[c.node]
+        self._shard_add(c, sign=-1)
+        # node-keyed state the removed lease cleared may still be
+        # asserted by a SIBLING lease claiming the same node (one lease
+        # per node is the norm, but unconventional lease names make
+        # duplicates possible) — replay the survivors in lease order so
+        # the dict state matches what a from-scratch fold would build
+        for sibling in sorted(self.node_leases.get(c.node, ())):
+            sc = self.contribs[sibling]
+            if sc.probe_row is not None:
+                if sc.probe_row.state == t.PROBE_STATE_QUARANTINED:
+                    self.quarantined.add(c.node)
+                    self.degraded.add(c.node)
+                elif sc.probe_row.state == t.PROBE_STATE_DEGRADED:
+                    self.degraded.add(c.node)
+            if sc.endpoint:
+                self.endpoints[c.node] = sc.endpoint
+            if sc.has_endpoint:
+                self.plan_members.add(c.node)
+            if sc.plan_obs is not None:
+                self.plan_obs[c.node] = sc.plan_obs
+            if sc.ici_group:
+                self.ici_groups[c.node] = sc.ici_group
+            if sc.outcome is not None:
+                self.outcomes[c.node] = sc.outcome
+
+    def _add(self, lease: str, c: NodeContribution) -> None:
+        self.contribs[lease] = c
+        self.node_leases.setdefault(c.node, set()).add(lease)
+        if c.ok:
+            self.ok_count += 1
+        if c.error:
+            self.errors[lease] = c.error
+        if c.version:
+            self.versions[c.version] += 1
+        if c.endpoint:
+            self.endpoints[c.node] = c.endpoint
+        if c.has_endpoint:
+            self.plan_members.add(c.node)
+        if c.probe_row is not None:
+            self.probe_rows[lease] = c.probe_row
+            bisect.insort(self.worst_index, (worst_key(c.probe_row), lease))
+            if c.probe_row.state == t.PROBE_STATE_QUARANTINED:
+                self.quarantined.add(c.node)
+                self.degraded.add(c.node)
+            elif c.probe_row.state == t.PROBE_STATE_DEGRADED:
+                self.degraded.add(c.node)
+        if c.t_reporting:
+            self.t_reporting += 1
+            self.t_errs += c.t_errs
+            self.t_pkts += c.t_pkts
+            self.t_worst[lease] = c.t_worst
+            self._challenge_champion(c.t_worst, c.node, lease)
+        if c.t_anoms:
+            self.t_anomalous[lease] = c.t_anoms
+        if c.plan_obs is not None:
+            self.plan_obs[c.node] = c.plan_obs
+        if c.ici_group:
+            self.ici_groups[c.node] = c.ici_group
+        if c.outcome is not None:
+            self.outcomes[c.node] = c.outcome
+        self._shard_add(c)
+
+    # -- telemetry champion ----------------------------------------------------
+
+    # The from-scratch loop walked nodes in sorted order and replaced
+    # the champion only on a STRICTLY greater ratio, so the winner is
+    # the smallest (node, lease) among the maxima — the challenge /
+    # recompute below reproduces exactly that total order.
+
+    def _challenge_champion(
+        self, ratio: float, node: str, lease: str
+    ) -> None:
+        ch = self.champion
+        if (
+            ch is None
+            or ratio > ch[0]
+            or (ratio == ch[0] and (node, lease) < (ch[1], ch[2]))
+        ):
+            self.champion = (ratio, node, lease)
+
+    def _recompute_champion(self) -> None:
+        best = None
+        for lease, ratio in self.t_worst.items():
+            node = self.contribs[lease].node
+            if (
+                best is None
+                or ratio > best[0]
+                or (ratio == best[0] and (node, lease) < (best[1], best[2]))
+            ):
+                best = (ratio, node, lease)
+        self.champion = best
+
+    # -- assembly --------------------------------------------------------------
+
+    def sorted_errors(self) -> List[str]:
+        return sorted(self.errors.values())
+
+    def versions_rollup(self) -> Dict[str, int]:
+        return dict(sorted(self.versions.items()))
+
+    def all_probe_rows(self) -> List[t.NodeProbeStatus]:
+        """Every probe row in (node, lease) order — the full-detail
+        status embedding."""
+        return [
+            self.probe_rows[lease]
+            for _, lease in sorted(
+                (row.node, lease) for lease, row in self.probe_rows.items()
+            )
+        ]
+
+    def worst_probe_rows(self, k: int) -> List[t.NodeProbeStatus]:
+        return [self.probe_rows[lease] for _, lease in self.worst_index[:k]]
+
+    def telemetry_status(self) -> Optional[t.TelemetryStatus]:
+        """The fleet telemetry rollup from the maintained terms — None
+        while no node reports samples (same contract as the from-
+        scratch aggregation)."""
+        if self.t_reporting == 0:
+            return None
+        anomalies = sorted(
+            a for anoms in self.t_anomalous.values() for a in anoms
+        )
+        anomalous = sorted({
+            self.contribs[lease].node for lease in self.t_anomalous
+        })
+        worst_ratio = self.champion[0] if self.champion else -1.0
+        worst_node = self.champion[1] if self.champion else ""
+        return t.TelemetryStatus(
+            nodes_reporting=self.t_reporting,
+            anomalous_nodes=anomalous,
+            anomalies=anomalies,
+            worst_node=worst_node,
+            worst_error_ratio=round(max(worst_ratio, 0.0), 6),
+            aggregate_error_ratio=round(
+                self.t_errs / max(self.t_errs + self.t_pkts, 1), 6
+            ),
+        )
+
+    def anomalous_nodes(self) -> List[str]:
+        return sorted({
+            self.contribs[lease].node for lease in self.t_anomalous
+        })
+
+    def build_summary(self, detail: str, max_shards: int) -> t.StatusSummary:
+        """status.summary from the maintained shard rollup — O(shards),
+        identical to the from-scratch fold (sort + tail fold included)."""
+        totals = t.StatusSummary(
+            detail=detail, nodes_total=len(self.node_leases)
+        )
+        rows = []
+        for key, s in self.shards.items():
+            rows.append(t.ShardSummary(
+                shard=key, nodes=s.nodes, ready=s.ready,
+                degraded=s.degraded, quarantined=s.quarantined,
+                anomalous=s.anomalous,
+            ))
+            totals.nodes_ready += s.ready
+            totals.nodes_degraded += s.degraded
+            totals.nodes_quarantined += s.quarantined
+            totals.nodes_anomalous += s.anomalous
+        rows.sort(key=lambda s: (
+            -(s.quarantined + s.degraded + s.anomalous),
+            -(s.nodes - s.ready),
+            s.shard,
+        ))
+        if len(rows) > max_shards:
+            head, tail = rows[:max_shards], rows[max_shards:]
+            folded = t.ShardSummary(shard=f"(+{len(tail)} more shards)")
+            for s in tail:
+                folded.nodes += s.nodes
+                folded.ready += s.ready
+                folded.degraded += s.degraded
+                folded.quarantined += s.quarantined
+                folded.anomalous += s.anomalous
+            rows = head + [folded]
+        totals.shards = rows
+        return totals
+
+
+@dataclass
+class PassState:
+    """Cross-pass bookkeeping the steady-pass fast path judges against
+    (everything a cheap check needs to prove "nothing to do").  Clock
+    domains are explicit: ``*_wall`` deadlines compare against wall
+    time, ``*_probe`` against the reconciler's probe clock."""
+
+    # identity of the world the last clean pass saw
+    generation: Any = None              # CR metadata.generation (spec identity)
+    ds_rv: str = ""                     # owned DaemonSet resourceVersion
+    # last pass's outcome
+    result_requeue: bool = False
+    result_after: float = 0.0
+    clean: bool = True                  # every flush landed (no retries owed)
+    active: bool = False                # remediation/probe work in flight
+    # timer-due deadlines (None = not armed).  Quarantine-streak
+    # advances need no deadline here: a degraded fleet always leaves
+    # the pass with a requeue_after, which already blocks the fast path
+    stale_due_wall: Optional[float] = None
+    verify_due_probe: Optional[float] = None
+    hold_due_probe: Optional[float] = None
+    rebuild_due_probe: Optional[float] = None
+    # section flush bookkeeping (version last synced + cached outputs)
+    peers_synced: int = -1
+    plan_synced: int = -1
+    plan_racks_ver: int = -1
+    rem_synced: int = -1
+    peers_clean: bool = True
+    plan_clean: bool = True
+    rem_clean: bool = True
+    last_plan_status: Optional[t.PlanStatus] = None
+    last_rem_status: Optional[t.RemediationStatus] = None
+    # metric-export gates: (section version, detail mode) last flushed
+    probe_export: Any = None
+    telem_export: Any = None
+    shard_export: Any = None
+    # cached target-node correlation (None = never computed)
+    target_nodes: Optional[Set[str]] = None
+    # stale heap: (due_wall, lease) — lazily invalidated
+    stale_heap: List[Tuple[float, str]] = field(default_factory=list)
+    ever_completed: bool = False
+
+    def quiet(self, now_wall: float, now_probe: float) -> bool:
+        """True when nothing is timer-due and the last pass retired
+        clean — the fast-path half that does not depend on the dirty
+        tracker."""
+        if not self.ever_completed or not self.clean or self.active:
+            return False
+        if self.result_requeue:
+            return False
+        for due, now in (
+            (self.stale_due_wall, now_wall),
+            (self.verify_due_probe, now_probe),
+            (self.hold_due_probe, now_probe),
+            (self.rebuild_due_probe, now_probe),
+        ):
+            if due is not None and now >= due:
+                return False
+        return True

@@ -115,6 +115,15 @@ METRIC_HELP: Dict[str, str] = {
     "tpunet_remediation_pending":
         "Outstanding remediation directives awaiting agent "
         "acknowledgement, per policy.",
+    "tpunet_reconcile_status_phase_seconds":
+        "Status-pass phase breakdown (contributions/aggregate/plan/"
+        "remediation/project) of the delta-driven reconcile pipeline.",
+    "tpunet_reconcile_dirty_nodes":
+        "Nodes whose contribution was re-derived in the last status "
+        "pass (0 on a steady fast-path pass; fleet size on a rebuild).",
+    "tpunet_reconcile_fast_path_total":
+        "Reconcile passes that exited via the steady-pass fast path "
+        "(no deltas, no timer-due work — nothing re-derived).",
 }
 
 
@@ -145,6 +154,13 @@ class Metrics:
         "tpunet_provision_phase_seconds": (
             0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 120.0,
             300.0,
+        ),
+        # status-pass phases run at sub-millisecond scale on steady
+        # and small-churn passes — the default buckets would dump
+        # everything into the first edge with zero resolution
+        "tpunet_reconcile_status_phase_seconds": (
+            0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+            0.25, 0.5, 1.0, 2.5,
         ),
     }
 

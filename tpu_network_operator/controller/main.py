@@ -97,6 +97,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="informer cache relist interval — the backstop "
                         "that prunes objects deleted while a watch was "
                         "down (0 = watch-only, never relist)")
+    p.add_argument("--full-rebuild-seconds", type=float, default=300.0,
+                   help="drift bound of the delta-driven status "
+                        "pipeline: every window (and on every relist) "
+                        "a policy's derived aggregates are rebuilt "
+                        "from scratch instead of incrementally")
     p.add_argument("--peer-shard-byte-budget", type=int,
                    default=0,
                    help="max bytes per probe peer-shard ConfigMap "
@@ -186,6 +191,8 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
     mgr.reconciler.REPORT_CACHE_SECONDS = args.report_cache_seconds
     if args.peer_shard_byte_budget > 0:
         mgr.reconciler.PEER_SHARD_BYTE_BUDGET = args.peer_shard_byte_budget
+    if args.full_rebuild_seconds > 0:
+        mgr.reconciler.FULL_REBUILD_SECONDS = args.full_rebuild_seconds
 
     servers = []
     health = None

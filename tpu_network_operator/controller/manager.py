@@ -10,6 +10,14 @@ workers (controller-runtime's MaxConcurrentReconciles), over a queue with
 the client-go processing-set contract: a key being reconciled is never
 handed to a second worker, and a key re-enqueued mid-reconcile runs again
 after the in-flight pass completes.
+
+The reconciler additionally carries a per-policy **dirty-node set**
+between passes (controller/delta.py, fed by the informer caches' delta
+hooks and attached in ``reconciler.setup()``): most of the enqueues this
+manager produces — resync ticks, our own status-update watch echoes,
+DaemonSet count refreshes — resolve to the steady-pass fast path and
+cost O(1), while a pass with actual deltas re-derives only the dirty
+nodes' contributions.
 """
 
 from __future__ import annotations

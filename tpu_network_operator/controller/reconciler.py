@@ -14,11 +14,12 @@ drift → recompute status {No targets | Working on it.. | All good}.
 from __future__ import annotations
 
 import copy
+import heapq
 import logging
 import os.path
 import threading
 from dataclasses import dataclass
-from typing import Any, Dict, FrozenSet, Iterable, List, Optional
+from typing import Any, Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
 
 from ..api import apimachinery as am
 from ..api.v1alpha1 import types as t
@@ -35,6 +36,14 @@ from ..remediation import policy as rem_policy
 from ..probe.prober import required_peers
 from ..probe.transport import valid_endpoint
 from . import templates
+from .delta import DirtyTracker
+from .derived import NodeContribution, PassState, PolicyDerived
+
+# status-pass phase breakdown histogram labels (satellite of the
+# delta-driven pipeline): where a tier-B pass spends its time
+STATUS_PHASES = (
+    "contributions", "aggregate", "plan", "remediation", "project",
+)
 
 log = logging.getLogger("tpunet.controller")
 
@@ -481,6 +490,23 @@ class NetworkClusterPolicyReconciler:
         self._rem_denied: Dict[str, bool] = {}
         self._rem_quorum_held: Dict[str, bool] = {}
         self._rem_clock = _time.time
+        # delta-driven status pipeline: the per-policy derived state
+        # (node contributions + mergeable aggregates, controller/
+        # derived.py) and the dirty-node tracker fed by the informer
+        # caches' delta hooks (controller/delta.py).  Single-writer per
+        # policy (workqueue contract) — no locking on the derived maps.
+        self.dirty = DirtyTracker()
+        self._derived: Dict[str, PolicyDerived] = {}
+        self._pass_state: Dict[str, PassState] = {}
+        # DS template-drift fingerprint cache: {policy: (ds resource-
+        # Version, CR spec identity)} — a steady pass must not deepcopy
+        # and re-project the full pod template just to prove nothing
+        # drifted; any change to either side invalidates the entry
+        self._ds_checked: Dict[str, Tuple[str, Any]] = {}
+        # rack-map content version: bumped by _rack_map whenever a
+        # refresh actually CHANGED the node->rack mapping, so shard
+        # keys (and plan groups) recompute only when racks moved
+        self._node_racks_version = 0
 
     # -- setup ----------------------------------------------------------------
 
@@ -509,6 +535,12 @@ class NetworkClusterPolicyReconciler:
 
         self.client.register_index("apps/v1", "DaemonSet", OWNER_KEY, index_daemonsets)
         self.client.register_index("v1", "Pod", OWNER_KEY, index_pods)
+        # delta-driven reconcile: listen on the informer caches' change
+        # feed (kube/informer.py delta hooks).  A client without
+        # informers (bare FakeCluster, ad-hoc scripts) leaves the
+        # tracker inactive — every pass then runs the from-scratch
+        # rebuild, the exact pre-delta behavior.
+        self.dirty.attach(self.client)
 
     # -- create path ----------------------------------------------------------
 
@@ -786,11 +818,29 @@ class NetworkClusterPolicyReconciler:
     # re-seeds itself by reading each ConfigMap back (O(shards) GETs,
     # zero writes when nothing drifted) and re-applies any that differ.
     PEER_CM_VERIFY_SECONDS = 300.0
+    # drift bound for the incremental aggregates: every window (and on
+    # every informer relist) the policy's derived state is rebuilt from
+    # scratch, so subtract/add bookkeeping can never diverge for longer
+    # than this.  Also the refresh cadence for anything the delta feed
+    # cannot see (rack-label TTL refresh picks up here).
+    FULL_REBUILD_SECONDS = 300.0
+    # test/bench seam: True forces every pass down the from-scratch
+    # rebuild path — the reference the equivalence suite compares the
+    # incremental pipeline against (and the pre-delta behavior).
+    FULL_REBUILD_ALWAYS = False
 
     def _agent_reports(self, policy_name: str) -> List[Any]:
         """Per-node provisioning reports (Leases the agents apply,
         agent/report.py) for one policy, from the shared bucket cache.
         Parse failures and stale heartbeats count as not-ready reports."""
+        return [
+            rep for _, rep, _ in self._report_buckets().get(policy_name, [])
+        ]
+
+    def _report_entries(self, policy_name: str) -> List[Any]:
+        """``(lease_name, report, renewed_ts)`` triples for one policy
+        — the full-rebuild path's input (the incremental path reads
+        single leases from the informer store instead)."""
         return list(self._report_buckets().get(policy_name, []))
 
     def _report_buckets(self) -> Dict[str, List[Any]]:
@@ -883,7 +933,8 @@ class NetworkClusterPolicyReconciler:
                 lease.get("metadata", {}).get("labels", {}) or {}
             ).get(rpt.POLICY_LABEL, "")
             out = buckets.setdefault(policy_name, [])
-            seen.add(lease.get("metadata", {}).get("name", ""))
+            lease_name = lease.get("metadata", {}).get("name", "")
+            seen.add(lease_name)
             rep, renewed = self._parse_one(lease, rpt)
             if (
                 rep.ok
@@ -893,12 +944,12 @@ class NetworkClusterPolicyReconciler:
                 # flip later leases stale that earlier ones were not
                 and now - renewed > self.REPORT_TTL_SECONDS
             ):
-                out.append(rpt.ProvisioningReport(
+                out.append((lease_name, rpt.ProvisioningReport(
                     node=rep.node, policy=rep.policy, ok=False,
                     error="report stale (agent heartbeat lost)",
-                ))
+                ), renewed))
                 continue
-            out.append(rep)
+            out.append((lease_name, rep, renewed))
         with self._reports_lock:
             # departed leases must not pin their parse forever
             for name in [k for k in self._lease_memo if k not in seen]:
@@ -995,6 +1046,11 @@ class NetworkClusterPolicyReconciler:
             if rack:
                 racks[name] = rack
         with self._reports_lock:
+            if racks != self._node_racks:
+                # content moved: shard keys / plan groups derived from
+                # the old map must recompute (the delta pipeline keys
+                # its shard context on this version)
+                self._node_racks_version += 1
             self._node_racks = racks
             self._node_racks_seen = frozenset(seen)
             # union with the prior memo, pruned by this fresh listing:
@@ -1033,6 +1089,363 @@ class NetworkClusterPolicyReconciler:
         if rack:
             return rack
         return f"bucket-{topology.shard_of(node, n_buckets):03d}"
+
+    # -- delta-driven contributions (controller/derived.py) -------------------
+
+    @staticmethod
+    def _spec_identity(raw: Dict[str, Any]) -> Any:
+        """Cheap spec-change detector: metadata.generation (the
+        apiserver bumps it only on spec changes), falling back to a
+        spec hash for objects without one."""
+        import json as json_mod
+
+        gen = (raw.get("metadata", {}) or {}).get("generation")
+        if gen is not None:
+            return ("generation", gen)
+        return ("spec-hash", hash(json_mod.dumps(
+            raw.get("spec", {}) or {}, sort_keys=True, default=str,
+        )))
+
+    def _lease_store(self):
+        """The Lease informer's store (shared read-only objects), or
+        None when the client has no informer layer — the incremental
+        path requires it (the tracker is only active when it exists)."""
+        informer_of = getattr(self.client, "informer", None)
+        if informer_of is None:
+            return None
+        from ..agent import report as rpt
+
+        inf = informer_of(rpt.LEASE_API, "Lease")
+        if inf is None:
+            return None
+        inf.sync()
+        return inf.store
+
+    def _probe_row(
+        self, pname: str, node: str, probe: Dict[str, Any],
+        spec, qpasses: int, interval: float, now: float,
+    ) -> t.NodeProbeStatus:
+        """One node's probe verdict row — the per-report body of the
+        old fleet-wide aggregation loop, including the once-per-
+        interval quarantine-streak advance."""
+        peers_total = _as_int(probe.get("peersTotal"))
+        reachable = _as_int(probe.get("peersReachable"))
+        required = required_peers(
+            spec.quorum, spec.expected_peers, peers_total,
+            spec.degree or 0,
+        )
+        # the Degraded verdict DEFERS to the agent gate (it damps
+        # single-round blips and owns the label decision); the raw
+        # reachable-vs-required check is only the fallback for
+        # version-skewed reports without a gate state
+        gate_state = probe.get("state")
+        if gate_state in ("Healthy", "Degraded"):
+            is_degraded = gate_state == "Degraded"
+        else:
+            is_degraded = reachable < required
+        key = (pname, node)
+        with self._probe_lock:
+            if is_degraded:
+                streak, last_advance = self._probe_failing.get(
+                    key, (0, 0.0)
+                )
+                # one advance per probe interval of wall time: a burst
+                # of passes re-reading one snapshot must not fast-
+                # forward quarantine
+                if streak == 0 or now - last_advance >= interval:
+                    streak += 1
+                    self._probe_failing[key] = (streak, now)
+            else:
+                self._probe_failing.pop(key, None)
+                streak = 0
+        state = (
+            t.PROBE_STATE_QUARANTINED
+            if streak >= qpasses
+            else t.PROBE_STATE_DEGRADED
+            if is_degraded
+            else t.PROBE_STATE_REACHABLE
+        )
+        unreachable = probe.get("unreachable")
+        return t.NodeProbeStatus(
+            node=node,
+            peers_total=peers_total,
+            peers_reachable=reachable,
+            unreachable=[
+                str(p) for p in unreachable
+            ] if isinstance(unreachable, list) else [],
+            rtt_p50_ms=_as_float(probe.get("rttP50Ms")),
+            rtt_p99_ms=_as_float(probe.get("rttP99Ms")),
+            loss_ratio=_as_float(probe.get("lossRatio")),
+            state=state,
+        )
+
+    def _contribution(
+        self, pname: str, lease_name: str, rv: str, rep, renewed,
+        now_wall: float, now_probe: float, probe_spec, telemetry_on: bool,
+        planner_on: bool, qpasses: int, interval: float, rpt,
+    ) -> NodeContribution:
+        """Derive one lease's contribution record.  ``rep`` may be
+        pristine (incremental path) or already staleness-aged (bucket
+        path) — aging here is idempotent."""
+        if (
+            rep.ok
+            and renewed is not None
+            and now_wall - renewed > self.REPORT_TTL_SECONDS
+        ):
+            rep = rpt.ProvisioningReport(
+                node=rep.node, policy=rep.policy, ok=False,
+                error="report stale (agent heartbeat lost)",
+            )
+        c = NodeContribution(
+            lease=lease_name, node=str(rep.node), rv=rv, report=rep,
+            renewed=renewed, ok=bool(rep.ok),
+        )
+        if not c.ok:
+            c.error = f"{rep.node}: {rep.error or 'provisioning incomplete'}"
+        ver = getattr(rep, "agent_version", "")
+        if isinstance(ver, str):
+            c.version = ver
+        ep = getattr(rep, "probe_endpoint", "") or ""
+        c.has_endpoint = bool(ep)
+        if ep and valid_endpoint(ep):
+            c.endpoint = ep
+        probe = rep.probe if isinstance(rep.probe, dict) else None
+        if probe_spec is not None and probe is not None:
+            c.probe_row = self._probe_row(
+                pname, c.node, probe, probe_spec, qpasses, interval,
+                now_probe,
+            )
+        if telemetry_on:
+            self._fold_telemetry(c, rep)
+        if planner_on:
+            self._fold_plan(c, rep, probe)
+        outcome = getattr(rep, "remediation", None)
+        if isinstance(outcome, dict):
+            did = outcome.get("directiveId")
+            if isinstance(did, str) and did:
+                c.outcome = (
+                    did, outcome.get("ok") is True,
+                    str(outcome.get("error") or ""),
+                )
+        return c
+
+    @staticmethod
+    def _fold_telemetry(c: NodeContribution, rep) -> None:
+        """Per-node telemetry terms (the per-report body of the old
+        fleet aggregation, byte-for-byte: same iface ordering, same
+        metric-row cap, same anomaly-string filters)."""
+        payload = getattr(rep, "telemetry", None)
+        ifaces = (
+            payload.get("interfaces")
+            if isinstance(payload, dict) else None
+        )
+        if not isinstance(ifaces, dict) or not ifaces:
+            return
+        c.t_reporting = True
+        anoms: List[str] = []
+        anom_ifaces: List[Tuple[str, str]] = []
+        rows: List[Any] = []
+        worst = 0.0
+        errs_total = pkts_total = 0
+        for idx, name in enumerate(sorted(str(n) for n in ifaces)):
+            d = ifaces.get(name)
+            if not isinstance(d, dict):
+                continue
+            ratio = _as_float(d.get("errorRatio"))
+            errs = _as_int(d.get("rxErrors")) + _as_int(d.get("txErrors"))
+            pkts = (
+                _as_int(d.get("rxPackets")) + _as_int(d.get("txPackets"))
+            )
+            errs_total += errs
+            pkts_total += pkts
+            worst = max(worst, ratio)
+            kinds = d.get("anomalies")
+            if isinstance(kinds, list):
+                anoms += [
+                    f"{rep.node}/{name}: {k}"
+                    for k in kinds[:4] if isinstance(k, str)
+                ]
+                if kinds:
+                    # the remediation view keeps non-string kinds
+                    # (coerced), exactly like the old anomaly extraction
+                    anom_ifaces.append((
+                        name, ",".join(str(k) for k in kinds[:4]),
+                    ))
+            if idx < MAX_TELEMETRY_IFACES:
+                rows.append((str(rep.node), name, {
+                    "rx_bytes": _as_int(d.get("rxBytes")),
+                    "errors": errs,
+                    "ratio": ratio,
+                }))
+        c.t_errs = errs_total
+        c.t_pkts = pkts_total
+        c.t_worst = worst
+        c.t_anoms = tuple(anoms)
+        c.t_anom_ifaces = tuple(anom_ifaces)
+        c.t_rows = tuple(rows)
+
+    @staticmethod
+    def _fold_plan(c: NodeContribution, rep, probe) -> None:
+        """Planner input terms: the per-peer RTT observation row and
+        the ICI slice group (zero/absent RTTs filtered: 0 is the
+        shape of "no samples", never a measurement)."""
+        if probe is not None:
+            peers = probe.get("peers")
+            row: Dict[str, float] = {}
+            if isinstance(peers, dict):
+                for peer, stats in peers.items():
+                    if not isinstance(stats, dict) \
+                            or not stats.get("reachable"):
+                        continue
+                    ms = stats.get("rttMs")
+                    # strictly positive: 0 is "no samples", not an RTT
+                    if (
+                        isinstance(ms, (int, float))
+                        and not isinstance(ms, bool)
+                        and ms > 0
+                    ):
+                        row[str(peer)] = float(ms)
+            if row:
+                c.plan_obs = tuple(sorted(row.items()))
+        ici = getattr(rep, "ici_topology", None)
+        if isinstance(ici, dict):
+            n_slices = ici.get("numSlices")
+            slice_id = ici.get("sliceId")
+            if (
+                isinstance(n_slices, int) and n_slices > 1
+                and isinstance(slice_id, int)
+            ):
+                c.ici_group = f"slice-{slice_id}"
+
+    def _shard_ctx(
+        self, detail: str, n_nodes: int, wanted,
+    ):
+        """(shard context tuple, key function) for the current pass —
+        the context captures everything a shard key depends on, so the
+        derived state re-keys only when it actually changes."""
+        n_buckets = topology.shard_count(n_nodes)
+        racks = (
+            self._rack_map(wanted=wanted)
+            if detail == t.STATUS_DETAIL_SUMMARY else {}
+        )
+        with self._reports_lock:
+            racks_ver = (
+                self._node_racks_version
+                if detail == t.STATUS_DETAIL_SUMMARY else -1
+            )
+        ctx = (detail, n_buckets, racks_ver)
+        return ctx, (
+            lambda node: self._shard_key_of(node, racks, n_buckets)
+        )
+
+    def _prune_streak(self, pname: str, d: PolicyDerived, node: str) -> None:
+        """Departed node: its quarantine streak must not linger."""
+        if node and node not in d.node_leases:
+            with self._probe_lock:
+                self._probe_failing.pop((pname, node), None)
+
+    def _process_lease(
+        self, pname: str, d: PolicyDerived, ps: PassState, store,
+        lease_name: str, changed_rows: List[Tuple[str, str, str]],
+        ctx_args: Dict[str, Any],
+    ) -> None:
+        """Incremental unit of work: re-derive one lease's contribution
+        from the informer store and fold the delta into the aggregates."""
+        from ..agent import report as rpt
+
+        obj = store.get(lease_name, self.namespace, copy_obj=False)
+        new: Optional[NodeContribution] = None
+        if obj is not None:
+            labels = (obj.get("metadata", {}) or {}).get("labels", {}) or {}
+            if (
+                labels.get(rpt.AGENT_LABEL) == "true"
+                and labels.get(rpt.POLICY_LABEL, "") == pname
+            ):
+                rv = str(
+                    (obj.get("metadata", {}) or {})
+                    .get("resourceVersion", "") or ""
+                )
+                rep, renewed = self._parse_one(obj, rpt)
+                c = self._contribution(
+                    pname, lease_name, rv, rep, renewed,
+                    rpt=rpt, **ctx_args,
+                )
+                if not (ps.target_nodes and c.node not in ps.target_nodes):
+                    new = c
+        old = d.apply(lease_name, new)
+        if old is None and new is None:
+            return
+        was = old.probe_row.state if old and old.probe_row else ""
+        now_state = new.probe_row.state if new and new.probe_row else ""
+        if was != now_state:
+            changed_rows.append((
+                (new or old).node, was, now_state,
+            ))
+        if new is None:
+            with self._reports_lock:
+                self._lease_memo.pop(lease_name, None)
+        else:
+            if new.ok and new.renewed is not None:
+                heapq.heappush(ps.stale_heap, (
+                    new.renewed + self.REPORT_TTL_SECONDS, lease_name,
+                ))
+            self._ingest_report_traces([new.report])
+        if old is not None and (new is None or new.node != old.node):
+            self._prune_streak(pname, d, old.node)
+
+    def _rebuild_derived(
+        self, pname: str, ps: PassState, entries: List[Any],
+        ctx, key_fn, ctx_args: Dict[str, Any],
+        prev_rows: Dict[str, str],
+    ) -> Tuple[PolicyDerived, List[Tuple[str, str, str]]]:
+        """From-scratch rebuild: re-derive every contribution from the
+        (already target-filtered) bucketed report entries, then swap
+        the aggregates wholesale.  Every section version bumps
+        (conservatively — each section's own diff gate still prevents
+        redundant writes).  This is both the legacy full-pass behavior
+        and the drift bound of the incremental path."""
+        from ..agent import report as rpt
+
+        old_d = self._derived.get(pname)
+        ps.stale_heap = []
+        d = PolicyDerived()
+        d.set_shard_ctx(ctx, key_fn)
+        for lease_name, rep, renewed in entries:
+            c = self._contribution(
+                pname, lease_name, "", rep, renewed, rpt=rpt, **ctx_args,
+            )
+            d.apply(lease_name, c)
+            if c.ok and renewed is not None:
+                heapq.heappush(ps.stale_heap, (
+                    renewed + self.REPORT_TTL_SECONDS, lease_name,
+                ))
+        for section in d.vers:
+            d.vers[section] = (
+                (old_d.vers[section] if old_d else 0) + 1
+            )
+        # quarantine-streak bookkeeping for nodes that departed while
+        # the delta feed was down (the relist is the only witness)
+        with self._probe_lock:
+            for key in [
+                k for k in self._probe_failing
+                if k[0] == pname and k[1] not in d.node_leases
+            ]:
+                del self._probe_failing[key]
+        # probe-row transition feed: prior derived rows when this
+        # process has them, else the CR's embedded rows (restart)
+        if old_d is not None:
+            prev_rows = {
+                row.node: row.state
+                for row in old_d.probe_rows.values()
+            }
+        changed = [
+            (row.node, prev_rows.get(row.node, ""), row.state)
+            for row in d.probe_rows.values()
+            if prev_rows.get(row.node, "") != row.state
+        ]
+        self._derived[pname] = d
+        self._ingest_report_traces(d.reports())
+        return d, changed
 
     # -- dataplane probe mesh -------------------------------------------------
 
@@ -1141,26 +1554,23 @@ class NetworkClusterPolicyReconciler:
         return cms, n_shards, overflowed
 
     def _sync_probe_peers(
-        self, policy: NetworkClusterPolicy, reports: List[Any]
-    ) -> None:
+        self, policy: NetworkClusterPolicy, desired: Dict[str, str]
+    ) -> bool:
         """Distribute the mesh membership + sampled probe topology:
         owned ConfigMap(s) per policy derived from the agents' own
-        reports (a node joins the mesh by reporting where it answers).
-        The whole distribution is one diff-gated batched flush per
-        pass — only shards whose payload actually changed are applied
-        (against the in-memory last-applied copy; one read-back per
-        ConfigMap after a restart), so a steady mesh costs ZERO
-        requests and a membership change costs O(changed shards), not
-        O(nodes)."""
+        reports (a node joins the mesh by reporting where it answers —
+        ``desired`` is the maintained node→validated-endpoint map, so
+        malformed endpoints never reach a prober's send()).  The whole
+        distribution is one diff-gated batched flush — only shards
+        whose payload actually changed are applied (against the
+        in-memory last-applied copy; one read-back per ConfigMap after
+        a restart), so a steady mesh costs ZERO requests and a
+        membership change costs O(changed shards), not O(nodes).  The
+        delta pipeline additionally skips the call entirely while the
+        endpoint map is unchanged and the anti-entropy window has not
+        expired.  Returns whether every desired payload is now
+        recorded as applied (False = a flush failed and must retry)."""
         pname = policy.metadata.name
-        # drop malformed endpoints HERE: one bad "host" (no port) from a
-        # skewed/buggy agent would otherwise crash every peer's probe
-        # round at send() and silently freeze mesh validation fleet-wide
-        desired = {
-            r.node: r.probe_endpoint
-            for r in reports
-            if r.probe_endpoint and valid_endpoint(r.probe_endpoint)
-        }
         cms, n_shards, overflowed = self._desired_peer_cms(
             policy, desired
         )
@@ -1297,106 +1707,26 @@ class NetworkClusterPolicyReconciler:
                 "shard(s), %d ConfigMap(s) flushed)",
                 index_name, len(desired), n_shards, flushed,
             )
-
-    def _aggregate_probe(
-        self, policy: NetworkClusterPolicy, reports: List[Any]
-    ):
-        """Fold per-node probe snapshots into the policy's connectivity
-        matrix + quarantine state.  Returns ``(rows, degraded_nodes,
-        requeue_after)`` — a nonzero requeue_after is the bounded
-        re-probe backoff while any node stays degraded."""
-        spec = policy.spec.tpu_scale_out.probe
-        pname = policy.metadata.name
-        rows: List[t.NodeProbeStatus] = []
-        degraded: List[str] = []
-        max_streak = 0
-        seen = set()
-        interval = float(
-            spec.interval_seconds or t.DEFAULT_PROBE_INTERVAL_SECONDS
+        # clean = every desired ConfigMap's payload is recorded as
+        # applied; refused-oversize shards count as clean (retrying
+        # them without an input change would refuse identically)
+        return all(
+            name in new_payloads or any(
+                k != topology.META_KEY
+                and len(v.encode()) > budget
+                for k, v in data.items()
+            )
+            for name, data in cms.items()
         )
-        qpasses = spec.quarantine_passes or PROBE_QUARANTINE_PASSES
-        now = self._probe_clock()
-        for rep in sorted(reports, key=lambda r: r.node):
-            probe = rep.probe if isinstance(rep.probe, dict) else None
-            seen.add(rep.node)
-            if probe is None:
-                continue   # agent has not completed a probe round yet
-            peers_total = _as_int(probe.get("peersTotal"))
-            reachable = _as_int(probe.get("peersReachable"))
-            required = required_peers(
-                spec.quorum, spec.expected_peers, peers_total,
-                spec.degree or 0,
-            )
-            # the Degraded verdict DEFERS to the agent gate (it damps
-            # single-round blips with its fail/recovery thresholds and
-            # owns the label decision — the controller must not declare
-            # an outage the label never reflected); the raw
-            # reachable-vs-required check is only the fallback for
-            # version-skewed reports without a gate state
-            gate_state = probe.get("state")
-            if gate_state in ("Healthy", "Degraded"):
-                is_degraded = gate_state == "Degraded"
-            else:
-                is_degraded = reachable < required
-            key = (pname, rep.node)
-            with self._probe_lock:
-                if is_degraded:
-                    streak, last_advance = self._probe_failing.get(
-                        key, (0, 0.0)
-                    )
-                    # one advance per probe interval of wall time: a
-                    # burst of reconcile passes re-reading one snapshot
-                    # must not fast-forward quarantine.  The agent gate
-                    # already damped sub-threshold blips before ever
-                    # reporting Degraded, so quarantine here means the
-                    # gate-level outage persisted >= 2 more intervals.
-                    if streak == 0 or now - last_advance >= interval:
-                        streak += 1
-                        self._probe_failing[key] = (streak, now)
-                else:
-                    self._probe_failing.pop(key, None)
-                    streak = 0
-            if is_degraded:
-                degraded.append(rep.node)
-                max_streak = max(max_streak, streak)
-            state = (
-                t.PROBE_STATE_QUARANTINED
-                if streak >= qpasses
-                else t.PROBE_STATE_DEGRADED
-                if is_degraded
-                else t.PROBE_STATE_REACHABLE
-            )
-            unreachable = probe.get("unreachable")
-            rows.append(t.NodeProbeStatus(
-                node=rep.node,
-                peers_total=peers_total,
-                peers_reachable=reachable,
-                unreachable=[
-                    str(p) for p in unreachable
-                ] if isinstance(unreachable, list) else [],
-                rtt_p50_ms=_as_float(probe.get("rttP50Ms")),
-                rtt_p99_ms=_as_float(probe.get("rttP99Ms")),
-                loss_ratio=_as_float(probe.get("lossRatio")),
-                state=state,
-            ))
-        # departed nodes must not hold a quarantine streak forever
-        with self._probe_lock:
-            for key in [
-                k for k in self._probe_failing
-                if k[0] == pname and k[1] not in seen
-            ]:
-                del self._probe_failing[key]
-        requeue_after = 0.0
-        if degraded:
-            # exponent clamped BEFORE exponentiating: a node degraded
-            # overnight pushes the streak past 1024, where 2**streak
-            # overflows float and would fail every reconcile of the
-            # policy until restart
-            requeue_after = min(
-                PROBE_REPROBE_BASE_SECONDS * (2 ** min(max_streak - 1, 8)),
-                PROBE_REPROBE_MAX_SECONDS,
-            )
-        return rows, degraded, requeue_after
+
+    def _peer_verify_due(self, policy_name: str) -> Optional[float]:
+        """Probe-clock deadline of the next peer-ConfigMap anti-entropy
+        read-back (None before the first flush)."""
+        with self._reports_lock:
+            state = self._peer_applied.get(policy_name)
+        if not state:
+            return None
+        return state.get("verified_at", -1e9) + self.PEER_CM_VERIFY_SECONDS
 
     def _prune_probe_state(self, policy_name: str) -> None:
         """Deleted policy: drop its quarantine streaks, peer-flush diff
@@ -1553,15 +1883,16 @@ class NetworkClusterPolicyReconciler:
         self,
         policy: NetworkClusterPolicy,
         old_conditions: List[Dict[str, Any]],
-        old_rows: List[Dict[str, Any]],
-        rows: List[t.NodeProbeStatus],
+        changed_rows: List[Tuple[str, str, str]],
+        n_rows: int,
         degraded: List[str],
     ) -> None:
         """Events on dataplane transitions: DataplaneDegraded condition
-        flips and per-node quarantine enter/exit.  Flip detection runs
-        against the PRE-pass status snapshots, so a steady degraded (or
-        steady healthy) pass emits nothing — the recorder's dedup is the
-        backstop, not the first line of defense."""
+        flips (against the PRE-pass condition snapshot) and per-node
+        quarantine enter/exit (from the pass's ``(node, was, now)``
+        row-state change feed — the delta pipeline knows exactly which
+        rows moved, so a steady degraded pass emits nothing without
+        scanning the fleet)."""
         old_dp = next(
             (
                 c.get("status") for c in old_conditions or []
@@ -1572,41 +1903,37 @@ class NetworkClusterPolicyReconciler:
         if degraded and old_dp != "True":
             self._emit(
                 policy, obs_events.TYPE_WARNING, "DataplaneDegraded",
-                f"{len(degraded)}/{len(rows)} nodes below probe quorum: "
+                f"{len(degraded)}/{n_rows} nodes below probe quorum: "
                 + self._name_list(degraded),
             )
         elif not degraded and old_dp == "True":
             self._emit(
                 policy, obs_events.TYPE_NORMAL, "DataplaneRecovered",
-                f"all {len(rows)} probed nodes reach quorum again",
+                f"all {n_rows} probed nodes reach quorum again",
             )
-        old_state = {
-            r.get("node", ""): r.get("state", "")
-            for r in old_rows or []
-        }
         qpasses = (
             policy.spec.tpu_scale_out.probe.quarantine_passes
             or PROBE_QUARANTINE_PASSES
         )
-        for row in rows:
-            was = old_state.get(row.node, "")
+        for node, was, now_state in changed_rows:
             if (
-                row.state == t.PROBE_STATE_QUARANTINED
+                now_state == t.PROBE_STATE_QUARANTINED
                 and was != t.PROBE_STATE_QUARANTINED
             ):
                 self._emit(
                     policy, obs_events.TYPE_WARNING, "NodeQuarantined",
-                    f"node {row.node} degraded "
+                    f"node {node} degraded "
                     f"{qpasses} consecutive passes; "
                     f"quarantined pending fabric recovery",
                 )
             elif (
                 was == t.PROBE_STATE_QUARANTINED
-                and row.state != t.PROBE_STATE_QUARANTINED
+                and now_state
+                and now_state != t.PROBE_STATE_QUARANTINED
             ):
                 self._emit(
                     policy, obs_events.TYPE_NORMAL, "NodeUnquarantined",
-                    f"node {row.node} reaches probe quorum again; "
+                    f"node {node} reaches probe quorum again; "
                     f"quarantine lifted",
                 )
 
@@ -1618,81 +1945,6 @@ class NetworkClusterPolicyReconciler:
             policy.spec.configuration_type == t.CONFIG_TYPE_TPU_SO
             and policy.spec.tpu_scale_out.telemetry.enabled
         )
-
-    def _aggregate_telemetry(
-        self, policy: NetworkClusterPolicy, reports: List[Any]
-    ):
-        """Fold per-node counter samples (report ``telemetry`` payloads)
-        into the policy's fleet rollup.  Returns ``(TelemetryStatus |
-        None, metric rows)`` — None while no agent has reported a sample
-        yet, so ``status.telemetry`` stays absent instead of advertising
-        an all-zero fleet."""
-        rows: List[Any] = []   # (node, iface, {rx_bytes, errors, ratio})
-        anomalies: List[str] = []
-        anomalous: List[str] = []
-        worst_node, worst_ratio = "", -1.0
-        total_errs = total_pkts = 0
-        nodes_reporting = 0
-        for rep in sorted(reports, key=lambda r: r.node):
-            payload = getattr(rep, "telemetry", None)
-            ifaces = (
-                payload.get("interfaces")
-                if isinstance(payload, dict) else None
-            )
-            if not isinstance(ifaces, dict) or not ifaces:
-                continue
-            nodes_reporting += 1
-            node_anoms: List[str] = []
-            node_worst = 0.0
-            # the anomaly/worst/aggregate scan covers EVERY reported
-            # interface — only the metric rows are capped: interface
-            # names come from the cluster (any agent version, maybe
-            # malicious) and each metric row mints a label value, but
-            # an anomaly on the 9th interface must still flip the
-            # condition the agent's own label verdict already reflects
-            for idx, name in enumerate(
-                sorted(str(n) for n in ifaces)
-            ):
-                d = ifaces.get(name)
-                if not isinstance(d, dict):
-                    continue
-                ratio = _as_float(d.get("errorRatio"))
-                errs = _as_int(d.get("rxErrors")) + _as_int(d.get("txErrors"))
-                pkts = (
-                    _as_int(d.get("rxPackets")) + _as_int(d.get("txPackets"))
-                )
-                total_errs += errs
-                total_pkts += pkts
-                node_worst = max(node_worst, ratio)
-                kinds = d.get("anomalies")
-                if isinstance(kinds, list):
-                    node_anoms += [
-                        f"{rep.node}/{name}: {k}"
-                        for k in kinds[:4] if isinstance(k, str)
-                    ]
-                if idx < MAX_TELEMETRY_IFACES:
-                    rows.append((str(rep.node), name, {
-                        "rx_bytes": _as_int(d.get("rxBytes")),
-                        "errors": errs,
-                        "ratio": ratio,
-                    }))
-            if node_anoms:
-                anomalous.append(rep.node)
-                anomalies += node_anoms
-            if node_worst > worst_ratio:
-                worst_node, worst_ratio = rep.node, node_worst
-        if nodes_reporting == 0:
-            return None, rows
-        return t.TelemetryStatus(
-            nodes_reporting=nodes_reporting,
-            anomalous_nodes=sorted(anomalous),
-            anomalies=sorted(anomalies)[:MAX_TELEMETRY_ANOMALIES],
-            worst_node=worst_node,
-            worst_error_ratio=round(max(worst_ratio, 0.0), 6),
-            aggregate_error_ratio=round(
-                total_errs / max(total_errs + total_pkts, 1), 6
-            ),
-        ), rows
 
     def _export_telemetry_metrics(
         self, policy_name: str, rows: List[Any],
@@ -1778,82 +2030,6 @@ class NetworkClusterPolicyReconciler:
             and so.probe.enabled
         )
 
-    @staticmethod
-    def _plan_inputs(
-        policy: NetworkClusterPolicy,
-        nodes: List[str],
-        reports: List[Any],
-        rows: List[t.NodeProbeStatus],
-        anomalous_nodes: List[str],
-        racks: Dict[str, str],
-    ) -> planner_plan.PlanInputs:
-        """Fold the pass's signals into the planner's canonical input:
-        mesh membership (``nodes``, computed once by the caller), the
-        per-edge RTT matrix from the reports' per-peer probe stats,
-        groups (rack label, else ICI slice from the report's
-        ``ici_topology``), and the exclusion set (probe-degraded or
-        quarantined or telemetry-anomalous — the links to route
-        around)."""
-        node_set = set(nodes)
-        observations: Dict[str, Dict[str, float]] = {}
-        ici_groups: Dict[str, str] = {}
-        for rep in reports:
-            probe = rep.probe if isinstance(rep.probe, dict) else None
-            if probe is not None:
-                peers = probe.get("peers")
-                row: Dict[str, float] = {}
-                if isinstance(peers, dict):
-                    for peer, stats in peers.items():
-                        if not isinstance(stats, dict) \
-                                or not stats.get("reachable"):
-                            continue
-                        ms = stats.get("rttMs")
-                        # strictly positive: 0 is not a physical RTT,
-                        # it is the shape of "no samples" from an agent
-                        # predating the None-when-empty snapshot — and
-                        # a 0 ms edge would beat every real measurement
-                        # in the ring heuristic
-                        if (
-                            isinstance(ms, (int, float))
-                            and not isinstance(ms, bool)
-                            and ms > 0
-                        ):
-                            row[str(peer)] = float(ms)
-                if row:
-                    observations[str(rep.node)] = row
-            ici = getattr(rep, "ici_topology", None)
-            if isinstance(ici, dict):
-                n_slices = ici.get("numSlices")
-                slice_id = ici.get("sliceId")
-                if (
-                    isinstance(n_slices, int) and n_slices > 1
-                    and isinstance(slice_id, int)
-                ):
-                    ici_groups[str(rep.node)] = f"slice-{slice_id}"
-        groups = {}
-        for node in nodes:
-            group = racks.get(node) or ici_groups.get(node, "")
-            if group:
-                groups[node] = group
-        spec = policy.spec.tpu_scale_out.planner
-        excluded = (
-            {r.node for r in rows if r.state in (
-                t.PROBE_STATE_DEGRADED, t.PROBE_STATE_QUARANTINED
-            )}
-            | set(anomalous_nodes)
-        ) & node_set
-        return planner_plan.PlanInputs(
-            nodes=nodes,
-            rtt=planner_plan.build_matrix(observations),
-            groups=groups,
-            excluded=frozenset(excluded),
-            seed=policy.metadata.name,
-            spread_threshold_ms=(
-                spec.spread_threshold_ms
-                or t.DEFAULT_PLAN_SPREAD_THRESHOLD_MS
-            ),
-        )
-
     def _distribute_plan(
         self, policy: NetworkClusterPolicy, plan: planner_plan.TopologyPlan
     ) -> None:
@@ -1870,7 +2046,7 @@ class NetworkClusterPolicyReconciler:
         with self._reports_lock:
             applied = self._plan_cm_applied.get(pname)
         if applied == payload:
-            return
+            return True
         if applied is None:
             # restart: re-seed the gate from the cluster instead of
             # blind-applying (the plan is deterministic, so an
@@ -1884,7 +2060,7 @@ class NetworkClusterPolicyReconciler:
                 ) == payload:
                     with self._reports_lock:
                         self._plan_cm_applied[pname] = payload
-                    return
+                    return True
             except kerr.NotFoundError:
                 pass
             except Exception as e:   # noqa: BLE001 — apply heals
@@ -1905,8 +2081,10 @@ class NetworkClusterPolicyReconciler:
                 "%s collectives)", cm_name, plan.version,
                 len(plan.ring), plan.collective,
             )
+            return True
         except Exception as e:   # noqa: BLE001 — next pass retries
             log.warning("plan ConfigMap apply failed: %s", e)
+            return False
 
     def _current_plan_labels(
         self, wanted: set
@@ -1977,6 +2155,7 @@ class NetworkClusterPolicyReconciler:
         for node in set(applied) - set(desired):
             desired[node] = (None, None)
         writes = 0
+        failed = 0
         new_state: Dict[str, Any] = {}
 
         def remember(node, state):
@@ -2014,6 +2193,7 @@ class NetworkClusterPolicyReconciler:
                 log.warning(
                     "plan label apply failed for node %s: %s", node, e
                 )
+                failed += 1
                 # keep the previous record (if any) so the next pass
                 # retries exactly this node
                 if have is not None:
@@ -2030,30 +2210,46 @@ class NetworkClusterPolicyReconciler:
                 "plan labels updated: %d node(s) patched for %s",
                 writes, pname,
             )
+        return failed == 0
 
     def _sync_plan(
-        self,
-        policy: NetworkClusterPolicy,
-        reports: List[Any],
-        rows: List[t.NodeProbeStatus],
-        anomalous_nodes: List[str],
-    ) -> Optional[t.PlanStatus]:
-        """One planner pass: fold the measured signals into PlanInputs,
-        let the hysteretic tracker decide whether to replan, and
-        project the decision (ConfigMap + node labels + status rollup +
-        metrics/Events).  Every projection is diff-gated, so a steady
-        plan costs zero writes."""
+        self, policy: NetworkClusterPolicy, d: PolicyDerived
+    ) -> Tuple[Optional[t.PlanStatus], bool]:
+        """One planner pass: fold the maintained signals (plan members,
+        per-peer RTT observations, ICI groups, exclusion sets) into
+        PlanInputs, let the hysteretic tracker decide whether to
+        replan, and project the decision (ConfigMap + node labels +
+        status rollup + metrics/Events).  Every projection is
+        diff-gated, so a steady plan costs zero writes.  Returns
+        ``(status, clean)`` — clean False when a projection write
+        failed and the pass must retry."""
         pname = policy.metadata.name
-        nodes = sorted({
-            str(r.node) for r in reports
-            if getattr(r, "probe_endpoint", "")
-        })
+        nodes = sorted(d.plan_members)
         if not nodes:
-            return None   # no mesh members yet: nothing to plan
+            return None, True   # no mesh members yet: nothing to plan
         spec = policy.spec.tpu_scale_out.planner
-        inputs = self._plan_inputs(
-            policy, nodes, reports, rows, anomalous_nodes,
-            self._rack_map(wanted=nodes),
+        racks = self._rack_map(wanted=nodes)
+        groups = {}
+        for node in nodes:
+            group = racks.get(node) or d.ici_groups.get(node, "")
+            if group:
+                groups[node] = group
+        # d.degraded already includes quarantined nodes (quarantine is
+        # a persisted degradation) — the same exclusion set the old
+        # fleet-wide row scan produced
+        excluded = (d.degraded | set(d.anomalous_nodes())) & set(nodes)
+        inputs = planner_plan.PlanInputs(
+            nodes=nodes,
+            rtt=planner_plan.build_matrix({
+                n: dict(row) for n, row in d.plan_obs.items()
+            }),
+            groups=groups,
+            excluded=frozenset(excluded),
+            seed=pname,
+            spread_threshold_ms=(
+                spec.spread_threshold_ms
+                or t.DEFAULT_PLAN_SPREAD_THRESHOLD_MS
+            ),
         )
         old_version = (
             policy.status.plan.version if policy.status.plan else ""
@@ -2068,8 +2264,8 @@ class NetworkClusterPolicyReconciler:
                 or t.DEFAULT_PLAN_RTT_HYSTERESIS_MS
             ),
         )
-        self._distribute_plan(policy, plan)
-        self._apply_plan_labels(policy, plan, set(nodes))
+        clean = self._distribute_plan(policy, plan)
+        clean = self._apply_plan_labels(policy, plan, set(nodes)) and clean
         if self.metrics:
             if recomputed:
                 self.metrics.inc(
@@ -2118,7 +2314,7 @@ class NetworkClusterPolicyReconciler:
             intra_group_rtt_ms=round(plan.intra_group_rtt_ms, 3),
             inter_group_rtt_ms=round(plan.inter_group_rtt_ms, 3),
             modeled_allreduce_ms=round(plan.modeled_allreduce_ms, 3),
-        )
+        ), clean
 
     def _cleanup_plan(
         self, policy_name: str, members: Optional[set] = None
@@ -2189,19 +2385,19 @@ class NetworkClusterPolicyReconciler:
         )
 
     def _remediation_anomalies(
-        self,
-        policy: NetworkClusterPolicy,
-        reports: List[Any],
-        rows: List[t.NodeProbeStatus],
+        self, policy: NetworkClusterPolicy, contribs: List[Any]
     ) -> List[Anomaly]:
-        """Fold the pass's existing verdicts into the policy core's
-        anomaly observations — remediation never re-detects: probe rows
-        already carry the gate/quarantine verdicts, and the telemetry
-        payloads name the concrete anomalous interfaces (which is what
-        the bounce/reroute rungs act on)."""
+        """Fold the maintained verdicts into the policy core's anomaly
+        observations — remediation never re-detects: the probe rows
+        already carry the gate/quarantine verdicts, and each
+        contribution names its concrete anomalous interfaces (which is
+        what the bounce/reroute rungs act on).  ``contribs`` is the
+        node-ordered contribution list, so the anomaly order matches
+        the old fleet-wide scan exactly."""
         anomalies: List[Anomaly] = []
-        for row in rows or []:
-            if row.state in (
+        for c in contribs:
+            row = c.probe_row
+            if row is not None and row.state in (
                 t.PROBE_STATE_DEGRADED, t.PROBE_STATE_QUARANTINED
             ):
                 anomalies.append(Anomaly(
@@ -2210,28 +2406,14 @@ class NetworkClusterPolicyReconciler:
                 ))
         if not self._telemetry_enabled(policy):
             return anomalies
-        for rep in reports:
-            payload = getattr(rep, "telemetry", None)
-            ifaces = (
-                payload.get("interfaces")
-                if isinstance(payload, dict) else None
-            )
-            if not isinstance(ifaces, dict):
-                continue
-            for name in sorted(str(n) for n in ifaces):
-                d = ifaces.get(name)
-                if not isinstance(d, dict):
-                    continue
-                kinds = d.get("anomalies")
-                if isinstance(kinds, list) and kinds:
-                    anomalies.append(Anomaly(
-                        node=str(rep.node),
-                        cls=rem_policy.CLASS_TELEMETRY,
-                        iface=name,
-                        detail=",".join(
-                            str(k) for k in kinds[:4]
-                        ),
-                    ))
+        for c in contribs:
+            for iface, detail in c.t_anom_ifaces:
+                anomalies.append(Anomaly(
+                    node=str(c.node),
+                    cls=rem_policy.CLASS_TELEMETRY,
+                    iface=iface,
+                    detail=detail,
+                ))
         return anomalies
 
     def _remediation_ledger(self, policy_name: str) -> Optional[Ledger]:
@@ -2280,7 +2462,7 @@ class NetworkClusterPolicyReconciler:
         with self._reports_lock:
             applied = self._rem_applied.setdefault(pname, {})
             if applied.get(cm_name) == payload:
-                return
+                return True
             known = cm_name in applied
         if not known:
             # restart (or first pass): read back once to re-seed the
@@ -2292,7 +2474,7 @@ class NetworkClusterPolicyReconciler:
                 if (cur.get("data", {}) or {}).get(key) == payload:
                     with self._reports_lock:
                         self._rem_applied[pname][cm_name] = payload
-                    return
+                    return True
             except kerr.NotFoundError:
                 pass
             except Exception as e:   # noqa: BLE001 — apply heals
@@ -2308,8 +2490,10 @@ class NetworkClusterPolicyReconciler:
             self.client.apply(cm, field_manager=REMEDIATION_FIELD_MANAGER)
             with self._reports_lock:
                 self._rem_applied[pname][cm_name] = payload
+            return True
         except Exception as e:   # noqa: BLE001 — next pass retries
             log.warning("remediation ConfigMap apply failed: %s", e)
+            return False
 
     def _restart_agent_pod(self, ds: Dict[str, Any], node: str):
         """The restart-agent rung, executed controller-side: delete the
@@ -2349,16 +2533,19 @@ class NetworkClusterPolicyReconciler:
         self,
         policy: NetworkClusterPolicy,
         ds: Dict[str, Any],
-        reports: List[Any],
-        rows: List[t.NodeProbeStatus],
-    ) -> Optional[t.RemediationStatus]:
+        d: PolicyDerived,
+    ) -> Tuple[Optional[t.RemediationStatus], bool, bool]:
         """One remediation pass: fold agent-reported action outcomes
         into the ledger, let the pure policy core decide the next
         budgeted actions, execute restart rungs controller-side,
         distribute the rest as per-node directives (diff-gated
         ConfigMaps), and surface everything as Events + metrics + the
         ``status.remediation`` rollup.  A steady pass (no anomalies,
-        no outstanding work) costs zero apiserver writes."""
+        no outstanding work) costs zero apiserver writes.  Returns
+        ``(status, active, clean)``: ``active`` means the ladder has
+        live state (entries cooling down / directives outstanding) and
+        the steady-pass fast path must stay disabled; ``clean`` False
+        means a ConfigMap flush failed and the pass must retry."""
         import contextlib
         import json as json_mod
 
@@ -2371,19 +2558,16 @@ class NetworkClusterPolicyReconciler:
             # transient ledger-read failure: keep the previous rollup,
             # decide nothing (deciding from an empty ledger would
             # forget every cooldown)
-            return policy.status.remediation
-        # outcomes FIRST so this pass's decisions see them
-        for rep in reports:
-            outcome = getattr(rep, "remediation", None)
-            if isinstance(outcome, dict):
-                did = outcome.get("directiveId")
-                if isinstance(did, str) and did:
-                    ledger.record_outcome(
-                        did, outcome.get("ok") is True,
-                        str(outcome.get("error") or ""),
-                    )
-        anomalies = self._remediation_anomalies(policy, reports, rows)
-        members = {str(r.node) for r in reports}
+            return policy.status.remediation, True, False
+        # outcomes FIRST so this pass's decisions see them (node order,
+        # like the old report scan; record_outcome is idempotent per
+        # directive id, so re-folding held outcomes is harmless)
+        for node in sorted(d.outcomes):
+            did, out_ok, out_err = d.outcomes[node]
+            ledger.record_outcome(did, out_ok, out_err)
+        contribs = d.sorted_contribs()
+        anomalies = self._remediation_anomalies(policy, contribs)
+        members = d.nodes()
         bad_nodes = {a.node for a in anomalies}
         healthy = len(members - bad_nodes)
         # quorum floor for disruptive rungs: a fleet MAJORITY — "never
@@ -2530,18 +2714,18 @@ class NetworkClusterPolicyReconciler:
         directives_payload = json_mod.dumps({
             "version": ledger.version,
             rpt_mod.DIRECTIVES_KEY: {
-                node: d.to_payload()
-                for node, d in sorted(decision.directives.items())
+                node: dv.to_payload()
+                for node, dv in sorted(decision.directives.items())
             },
         }, sort_keys=True)
-        self._apply_remediation_cm(
+        clean = self._apply_remediation_cm(
             policy, rpt_mod.remediation_configmap_name(pname),
             rpt_mod.LEDGER_KEY, ledger.to_json(),
         )
-        self._apply_remediation_cm(
+        clean = self._apply_remediation_cm(
             policy, rpt_mod.directive_configmap_name(pname),
             rpt_mod.DIRECTIVES_KEY, directives_payload,
-        )
+        ) and clean
         if self.metrics:
             self.metrics.set_gauge(
                 "tpunet_remediation_pending",
@@ -2549,11 +2733,18 @@ class NetworkClusterPolicyReconciler:
             )
         window_nodes = ledger.window_nodes(now, knobs.window_seconds)
         k = t.REMEDIATION_STATUS_K
+        # live ladder state (cooling-down entries, outstanding
+        # directives, an in-window budget) is timer-driven: the fast
+        # path must keep running full passes until it drains
+        active = bool(
+            ledger.entries or decision.directives
+            or window_nodes
+        )
         return t.RemediationStatus(
             active=len(decision.directives),
             pending=[
-                f"{node}: {d.action}"
-                for node, d in sorted(decision.directives.items())
+                f"{node}: {dv.action}"
+                for node, dv in sorted(decision.directives.items())
             ][:k],
             window_used=len(window_nodes),
             window_max=knobs.max_nodes_per_window,
@@ -2561,7 +2752,7 @@ class NetworkClusterPolicyReconciler:
             quorum_held=sorted(decision.quorum_held)[:k],
             exhausted=ledger.exhausted_nodes()[:k],
             actions_total=ledger.total_actions(),
-        )
+        ), active, clean
 
     def _cleanup_remediation(self, policy_name: str) -> None:
         """Remediation switched off or CR deleted: delete the ledger +
@@ -2608,95 +2799,6 @@ class NetworkClusterPolicyReconciler:
         return (
             ", ".join(names[:cap])
             + f" (+{len(names) - cap} more)"
-        )
-
-    def _build_summary(
-        self,
-        detail: str,
-        reports: List[Any],
-        probe_rows: Optional[List[t.NodeProbeStatus]],
-        anomalous_nodes: List[str],
-    ) -> t.StatusSummary:
-        """Fold the fleet into O(shards) rows keyed by rack/slice label
-        (hash buckets for unlabeled nodes).  This — not the per-node
-        lists — is the status surface that stays bounded at 10k nodes."""
-        nodes = sorted({str(r.node) for r in reports})
-        ok = {str(r.node) for r in reports if r.ok}
-        state_of = {
-            r.node: r.state for r in (probe_rows or [])
-        }
-        anom = set(anomalous_nodes)
-        # racks only fetched in summary mode (the scale path); full-mode
-        # small fleets stay zero-extra-request on hash buckets
-        racks = (
-            self._rack_map(wanted=nodes)
-            if detail == t.STATUS_DETAIL_SUMMARY else {}
-        )
-        n_buckets = topology.shard_count(len(nodes))
-        by_shard: Dict[str, t.ShardSummary] = {}
-        totals = t.StatusSummary(detail=detail, nodes_total=len(nodes))
-        for node in nodes:
-            key = self._shard_key_of(node, racks, n_buckets)
-            row = by_shard.get(key)
-            if row is None:
-                row = by_shard[key] = t.ShardSummary(shard=key)
-            row.nodes += 1
-            if node in ok:
-                row.ready += 1
-                totals.nodes_ready += 1
-            state = state_of.get(node, "")
-            if state == t.PROBE_STATE_QUARANTINED:
-                row.quarantined += 1
-                totals.nodes_quarantined += 1
-            elif state == t.PROBE_STATE_DEGRADED:
-                row.degraded += 1
-                totals.nodes_degraded += 1
-            if node in anom:
-                row.anomalous += 1
-                totals.nodes_anomalous += 1
-        shards = sorted(
-            by_shard.values(),
-            key=lambda s: (
-                -(s.quarantined + s.degraded + s.anomalous),
-                -(s.nodes - s.ready),
-                s.shard,
-            ),
-        )
-        if len(shards) > self.MAX_SUMMARY_SHARDS:
-            head = shards[:self.MAX_SUMMARY_SHARDS]
-            tail = shards[self.MAX_SUMMARY_SHARDS:]
-            folded = t.ShardSummary(
-                shard=f"(+{len(tail)} more shards)"
-            )
-            for s in tail:
-                folded.nodes += s.nodes
-                folded.ready += s.ready
-                folded.degraded += s.degraded
-                folded.quarantined += s.quarantined
-                folded.anomalous += s.anomalous
-            shards = head + [folded]
-        totals.shards = shards
-        return totals
-
-    @staticmethod
-    def _worst_probe_rows(
-        rows: List[t.NodeProbeStatus], k: int
-    ) -> List[t.NodeProbeStatus]:
-        """Worst-K triage slice of the connectivity matrix: quarantined
-        first, then degraded, then lossiest — deterministic under
-        churn (ties broken by node name)."""
-        import heapq
-
-        priority = {
-            t.PROBE_STATE_QUARANTINED: 0,
-            t.PROBE_STATE_DEGRADED: 1,
-        }
-        return heapq.nsmallest(
-            k, rows,
-            key=lambda r: (
-                priority.get(r.state, 2), -r.loss_ratio,
-                r.peers_reachable - r.peers_total, r.node,
-            ),
         )
 
     def _emit_state_transition(
@@ -2751,43 +2853,169 @@ class NetworkClusterPolicyReconciler:
         ))
 
     def _update_status(
-        self, policy: NetworkClusterPolicy, ds: Dict[str, Any]
+        self, policy: NetworkClusterPolicy, ds: Dict[str, Any],
+        raw: Optional[Dict[str, Any]] = None,
     ) -> Result:
-        """Status from DaemonSet counts AND per-node agent reports.
+        """Status from DaemonSet counts AND per-node agent reports —
+        delta-driven: node contributions are re-derived only for dirty
+        nodes (controller/derived.py), the fleet aggregates merge the
+        change, and each downstream section (peer distribution,
+        planner, remediation, metric exports) runs only when its
+        inputs' version moved.  A from-scratch rebuild (dirty-all)
+        runs on start, informer relist, spec change, for legacy
+        clients, and every FULL_REBUILD_SECONDS — and lands on
+        byte-identical output by construction (same contribution code,
+        same assembly code; tests/test_incremental.py proves it under
+        seeded churn).
 
         Stronger than ref ``updateStatus()`` :267-307 (pure pod
-        arithmetic): "All good" here requires every target node's agent
-        to have reported a successful provisioning pass — bootstrap
-        written, all interfaces configured, coordinator reachable — i.e.
-        "a JAX job will start" (SURVEY.md §7 hard part 3).  Conflict →
-        requeue, as in the reference."""
+        arithmetic): "All good" requires every target node's agent to
+        have reported a successful provisioning pass — bootstrap
+        written, all interfaces configured, coordinator reachable.
+        Conflict → requeue, as in the reference."""
+        try:
+            return self._update_status_inner(policy, ds, raw)
+        except Exception:
+            # the pass consumed dirty state it could not fold in — a
+            # retry with an empty dirty set would serve stale
+            # aggregates as fresh.  Dropping the derived cache forces
+            # the manager's retried pass down the full-rebuild path.
+            self._derived.pop(policy.metadata.name, None)
+            raise
+
+    def _update_status_inner(
+        self, policy: NetworkClusterPolicy, ds: Dict[str, Any],
+        raw: Optional[Dict[str, Any]] = None,
+    ) -> Result:
+        import time as time_mod
+
+        from ..agent import report as rpt
+
+        pname = policy.metadata.name
+        ps = self._pass_state.setdefault(pname, PassState())
+        now_wall = time_mod.time()
+        now_probe = self._probe_clock()
+        phases = dict.fromkeys(STATUS_PHASES, 0.0)
+        t_phase = time_mod.perf_counter
+
         ds_status = ds.get("status", {}) or {}
         targets = int(ds_status.get("desiredNumberScheduled", 0))
         pods_ready = int(ds_status.get("numberReady", 0))
-
-        reports = self._agent_reports(policy.metadata.name)
-        # correlate with the nodes the DaemonSet actually targets: a
-        # stale Lease from a departed node (crash without retraction)
-        # must not stand in for a live node's missing report
-        target_nodes = self._target_nodes(ds)
-        if target_nodes:
-            reports = [r for r in reports if r.node in target_nodes]
-        # stitch agent provisioning spans into the flight recorder so
-        # /debug/traces shows one trace per provisioning flow
-        self._ingest_report_traces(reports)
-        ok_nodes = sorted(r.node for r in reports if r.ok)
-        errors = sorted(
-            f"{r.node}: {r.error or 'provisioning incomplete'}"
-            for r in reports
-            if not r.ok
+        generation = self._spec_identity(
+            raw if raw is not None else policy.to_dict()
         )
-        ready = len(ok_nodes)
-        # detail mode for this pass: explicit spec.statusDetail, else
-        # auto — flip to the bounded summary once the live fleet
-        # crosses the threshold (the CR must stay small even when
-        # nobody set the knob)
-        detail = self._detail_mode(policy, max(targets, len(reports)))
-        if detail == t.STATUS_DETAIL_SUMMARY and len(errors) > t.STATUS_WORST_K:
+
+        probe_spec = (
+            policy.spec.tpu_scale_out.probe
+            if self._probe_enabled(policy) else None
+        )
+        telemetry_on = self._telemetry_enabled(policy)
+        planner_on = self._planner_enabled(policy)
+        interval = float(
+            (probe_spec.interval_seconds if probe_spec else 0)
+            or t.DEFAULT_PROBE_INTERVAL_SECONDS
+        )
+        qpasses = (
+            (probe_spec.quarantine_passes if probe_spec else 0)
+            or PROBE_QUARANTINE_PASSES
+        )
+        ctx_args = dict(
+            now_wall=now_wall, now_probe=now_probe,
+            probe_spec=probe_spec, telemetry_on=telemetry_on,
+            planner_on=planner_on, qpasses=qpasses, interval=interval,
+        )
+
+        # -- phase: contributions — dirty collection + re-derivation --
+        p0 = t_phase()
+        self.dirty.sync()
+        dirty_items, dirty_all, pods_dirty = self.dirty.take(pname)
+        store = self._lease_store() if self.dirty.active else None
+        if (
+            store is None
+            or self.FULL_REBUILD_ALWAYS
+            or ps.generation != generation
+            or pname not in self._derived
+            or (
+                ps.rebuild_due_probe is not None
+                and now_probe >= ps.rebuild_due_probe
+            )
+        ):
+            dirty_all = True
+        d = self._derived.get(pname)
+        changed_rows: List[Tuple[str, str, str]] = []
+        if dirty_all:
+            entries = self._report_entries(pname)
+            ps.target_nodes = self._target_nodes(ds)
+            if ps.target_nodes:
+                entries = [
+                    e for e in entries if e[1].node in ps.target_nodes
+                ]
+            detail = self._detail_mode(policy, max(targets, len(entries)))
+            nodes = [e[1].node for e in entries]
+            ctx, key_fn = self._shard_ctx(detail, len(set(nodes)), nodes)
+            prev_rows = {
+                row.node: row.state
+                for row in policy.status.probe_nodes or []
+            }
+            d, changed_rows = self._rebuild_derived(
+                pname, ps, entries, ctx, key_fn, ctx_args, prev_rows,
+            )
+            n_dirty = len(d.contribs)
+            ps.rebuild_due_probe = now_probe + self.FULL_REBUILD_SECONDS
+        else:
+            if pods_dirty or ps.target_nodes is None:
+                new_targets = self._target_nodes(ds)
+                if new_targets != ps.target_nodes:
+                    for node in new_targets ^ (ps.target_nodes or set()):
+                        dirty_items.add((node, None))
+                    ps.target_nodes = new_targets
+            # timer-due dirt the watch stream cannot announce: report
+            # staleness expiries and quarantine-streak advances
+            while ps.stale_heap and ps.stale_heap[0][0] <= now_wall:
+                _, lease = heapq.heappop(ps.stale_heap)
+                c = d.contribs.get(lease)
+                if (
+                    c is not None and c.ok and c.renewed is not None
+                    and now_wall - c.renewed > self.REPORT_TTL_SECONDS
+                ):
+                    dirty_items.add((c.node, lease))
+            with self._probe_lock:
+                for node in d.degraded:
+                    streak, last = self._probe_failing.get(
+                        (pname, node), (0, 0.0)
+                    )
+                    if streak and now_probe - last >= interval:
+                        dirty_items.add((node, None))
+            leases: Set[str] = set()
+            for node, lease in dirty_items:
+                if lease:
+                    leases.add(lease)
+                if node:
+                    leases.update(d.node_leases.get(node, ()))
+                    leases.add(rpt.lease_name(node))
+            n_dirty = len(leases)
+            for lease in sorted(leases):
+                self._process_lease(
+                    pname, d, ps, store, lease, changed_rows, ctx_args,
+                )
+            detail = self._detail_mode(
+                policy, max(targets, len(d.contribs))
+            )
+            touched = {node for node, _ in dirty_items if node}
+            ctx, key_fn = self._shard_ctx(
+                detail, len(d.node_leases), touched,
+            )
+            d.set_shard_ctx(ctx, key_fn)
+        phases["contributions"] = t_phase() - p0
+
+        # -- phase: aggregate — assembly from the maintained rollups --
+        p0 = t_phase()
+        ready = d.ok_count
+        errors = d.sorted_errors()
+        if (
+            detail == t.STATUS_DETAIL_SUMMARY
+            and len(errors) > t.STATUS_WORST_K
+        ):
             errors = errors[:t.STATUS_WORST_K] + [
                 f"... and {len(errors) - t.STATUS_WORST_K} more nodes "
                 "not ready (statusDetail: summary)"
@@ -2801,10 +3029,6 @@ class NetworkClusterPolicyReconciler:
             state = STATE_ALL_GOOD
         old_state = policy.status.state
 
-        # dataplane probe mesh: peer distribution + connectivity matrix
-        # + DataplaneDegraded/quarantine.  Entirely skipped when the
-        # policy does not probe, so non-probing reconciles stay
-        # zero-extra-request.
         old_probe_status = am.to_dict(policy.status.probe_nodes)
         old_conditions = am.to_dict(policy.status.conditions)
         old_telemetry = am.to_dict(policy.status.telemetry)
@@ -2827,27 +3051,42 @@ class NetworkClusterPolicyReconciler:
                 policy, obs_events.TYPE_NORMAL, "ReconcileRecovered",
                 "reconcile succeeding again; ReconcileDegraded cleared",
             )
+
         probe_requeue = 0.0
-        rows: Optional[List[t.NodeProbeStatus]] = None
-        if self._probe_enabled(policy):
-            self._sync_probe_peers(policy, reports)
-            rows, degraded, probe_requeue = self._aggregate_probe(
-                policy, reports
+        if probe_spec is not None:
+            # peer distribution: skipped entirely while the endpoint
+            # map is unchanged and the anti-entropy window holds
+            pp = t_phase()
+            verify_due = (
+                ps.verify_due_probe is not None
+                and now_probe >= ps.verify_due_probe
             )
+            if (
+                d.vers["peers"] != ps.peers_synced
+                or not ps.peers_clean
+                or verify_due
+            ):
+                ps.peers_clean = self._sync_probe_peers(
+                    policy, dict(d.endpoints)
+                )
+                if ps.peers_clean:
+                    ps.peers_synced = d.vers["peers"]
+                ps.verify_due_probe = self._peer_verify_due(pname)
+            phases["project"] += t_phase() - pp
+
+            degraded = sorted(d.degraded)
+            n_rows = len(d.probe_rows)
             # bounded status: summary mode embeds only the worst-K
             # triage rows — the full matrix would be O(n) (O(n²) with
             # per-row unreachable lists) inside one etcd object
             policy.status.probe_nodes = (
-                rows if detail == t.STATUS_DETAIL_FULL
-                else self._worst_probe_rows(rows, t.STATUS_WORST_K)
+                d.all_probe_rows() if detail == t.STATUS_DETAIL_FULL
+                else d.worst_probe_rows(t.STATUS_WORST_K)
             )
-            quarantined = sorted(
-                r.node for r in rows
-                if r.state == t.PROBE_STATE_QUARANTINED
-            )
+            quarantined = sorted(d.quarantined)
             if degraded:
                 message = (
-                    f"{len(degraded)}/{len(rows)} nodes below probe "
+                    f"{len(degraded)}/{n_rows} nodes below probe "
                     f"quorum: " + self._name_list([
                         n + (" (quarantined)" if n in quarantined else "")
                         for n in degraded
@@ -2859,46 +3098,69 @@ class NetworkClusterPolicyReconciler:
                     "QuarantinedNodes" if quarantined else "BelowQuorum",
                     message,
                 )
+                with self._probe_lock:
+                    max_streak = max(
+                        (
+                            self._probe_failing.get((pname, n), (1, 0.0))[0]
+                            for n in degraded
+                        ),
+                        default=1,
+                    )
+                # exponent clamped BEFORE exponentiating: a node
+                # degraded overnight pushes the streak past 1024, where
+                # 2**streak overflows float
+                probe_requeue = min(
+                    PROBE_REPROBE_BASE_SECONDS
+                    * (2 ** min(max(max_streak, 1) - 1, 8)),
+                    PROBE_REPROBE_MAX_SECONDS,
+                )
             else:
                 self._set_condition(
                     policy.status, t.CONDITION_DATAPLANE_DEGRADED,
                     "False", "QuorumReached",
-                    f"all {len(rows)} probed nodes reach quorum",
+                    f"all {n_rows} probed nodes reach quorum",
                 )
-            self._export_probe_metrics(
-                policy.metadata.name, rows, detail
-            )
+            export_key = (d.vers["probe"], detail)
+            if ps.probe_export != export_key and self.metrics:
+                # summary mode only retracts the per-node families —
+                # never build the O(n) row list it would ignore
+                self._export_probe_metrics(
+                    pname,
+                    d.all_probe_rows()
+                    if detail == t.STATUS_DETAIL_FULL else [],
+                    detail,
+                )
+                ps.probe_export = export_key
             self._emit_probe_transitions(
-                policy, old_conditions, old_probe_status, rows, degraded
+                policy, old_conditions, changed_rows, n_rows, degraded
             )
         else:
             # probing switched off: clear the matrix + condition so the
             # status never shows stale connectivity.  The one-time
             # cleanup also deletes the distributed peer list — left
             # behind, a re-enable would adopt stale membership — while
-            # steady disabled passes stay zero-request.  Transition
-            # detection keys on the CONDITION, not the matrix rows:
-            # every enabled status pass sets the condition (even before
-            # any agent completes a probe round), so a disable inside
-            # that window still cleans up.
+            # steady disabled passes stay zero-request.
             was_probing = policy.status.probe_nodes or any(
                 c.type == t.CONDITION_DATAPLANE_DEGRADED
                 for c in policy.status.conditions
             )
             if was_probing:
-                self._delete_peer_cms(policy.metadata.name)
-                self._prune_probe_state(policy.metadata.name)
+                self._delete_peer_cms(pname)
+                self._prune_probe_state(pname)
             policy.status.probe_nodes = []
             policy.status.conditions = [
                 c for c in policy.status.conditions
                 if c.type != t.CONDITION_DATAPLANE_DEGRADED
             ]
+            # a leftover anti-entropy deadline from when probing was on
+            # must not keep waking the fast path forever
+            ps.verify_due_probe = None
 
         # dataplane counter telemetry: fleet rollup + condition +
-        # per-interface metric families from the report payloads
+        # per-interface metric families from the maintained terms
         anomalous_nodes: List[str] = []
-        if self._telemetry_enabled(policy):
-            tstat, telem_rows = self._aggregate_telemetry(policy, reports)
+        if telemetry_on:
+            tstat = d.telemetry_status()
             policy.status.telemetry = tstat
             if tstat is None:
                 # no samples yet (or the reporting nodes left): no
@@ -2924,9 +3186,19 @@ class NetworkClusterPolicyReconciler:
                     "interface counters nominal on all "
                     f"{tstat.nodes_reporting} reporting nodes",
                 )
-            self._export_telemetry_metrics(
-                policy.metadata.name, telem_rows, detail
-            )
+            export_key = (d.vers["telem"], detail)
+            if ps.telem_export != export_key and self.metrics:
+                # summary mode only retracts the per-iface families —
+                # never build the O(n) row list it would ignore
+                self._export_telemetry_metrics(
+                    pname,
+                    [
+                        row for c in d.sorted_contribs()
+                        for row in c.t_rows
+                    ] if detail == t.STATUS_DETAIL_FULL else [],
+                    detail,
+                )
+                ps.telem_export = export_key
             if tstat is not None:
                 self._emit_telemetry_transitions(
                     policy, old_conditions, tstat
@@ -2953,28 +3225,39 @@ class NetworkClusterPolicyReconciler:
                 if self.metrics:
                     for gauge in TELEMETRY_GAUGES:
                         self.metrics.remove_matching(
-                            gauge, {"policy": policy.metadata.name}
+                            gauge, {"policy": pname}
                         )
             policy.status.telemetry = None
             policy.status.conditions = [
                 c for c in policy.status.conditions
                 if c.type != t.CONDITION_TELEMETRY_DEGRADED
             ]
+        phases["aggregate"] += t_phase() - p0
 
-        # topology planner: measured matrix -> ring + labels + plan
-        # ConfigMap + status rollup.  Entirely skipped when the policy
-        # does not plan; the disable edge strips labels/ConfigMap once
-        # (the probe path's cleanup contract).
-        if self._planner_enabled(policy) and rows is not None:
-            policy.status.plan = self._sync_plan(
-                policy, reports, rows, anomalous_nodes
-            )
+        # -- phase: plan — topology planner, gated on its input version
+        p0 = t_phase()
+        if planner_on and probe_spec is not None:
+            held = self._plan_tracker.held_until(pname)
+            with self._reports_lock:
+                racks_ver = self._node_racks_version
+            if (
+                d.vers["plan"] != ps.plan_synced
+                or not ps.plan_clean
+                or ps.plan_racks_ver != racks_ver
+                or (held is not None and now_probe >= held)
+            ):
+                plan_status, ps.plan_clean = self._sync_plan(policy, d)
+                ps.plan_synced = d.vers["plan"]
+                with self._reports_lock:
+                    ps.plan_racks_ver = self._node_racks_version
+                ps.last_plan_status = plan_status
+            policy.status.plan = ps.last_plan_status
+            ps.hold_due_probe = self._plan_tracker.held_until(pname)
         else:
             # the edge gate must also see IN-MEMORY planner state: a
             # membership blackout (every report Lease expired) nulls
             # status.plan while labels/ConfigMap/tracker state live on,
             # and status alone would disarm this cleanup forever
-            pname = policy.metadata.name
             with self._reports_lock:
                 planned = bool(
                     self._plan_labels.get(pname)
@@ -2985,23 +3268,29 @@ class NetworkClusterPolicyReconciler:
                 or planned
                 or self._plan_tracker.current(pname) is not None
             ):
-                self._cleanup_plan(
-                    pname,
-                    members={str(r.node) for r in reports},
-                )
+                self._cleanup_plan(pname, members=d.nodes())
             policy.status.plan = None
+            ps.last_plan_status = None
+            ps.hold_due_probe = None
+        phases["plan"] = t_phase() - p0
 
-        # self-healing remediation: verdicts -> budgeted action ladder
-        # -> per-node directives + execution ledger.  Entirely skipped
-        # when the policy does not remediate; the disable edge deletes
-        # the ledger/directive ConfigMaps once (the probe/plan cleanup
-        # contract).
-        if self._remediation_enabled(policy) and rows is not None:
-            policy.status.remediation = self._sync_remediation(
-                policy, ds, reports, rows
-            )
+        # -- phase: remediation — self-healing, gated on its version +
+        # live ladder state (cooldowns/directives are timer-driven)
+        p0 = t_phase()
+        if self._remediation_enabled(policy) and probe_spec is not None:
+            if (
+                d.vers["rem"] != ps.rem_synced
+                or not ps.rem_clean
+                or ps.active
+                or ps.last_rem_status is None
+            ):
+                rem_status, ps.active, ps.rem_clean = (
+                    self._sync_remediation(policy, ds, d)
+                )
+                ps.rem_synced = d.vers["rem"]
+                ps.last_rem_status = rem_status
+            policy.status.remediation = ps.last_rem_status
         else:
-            pname = policy.metadata.name
             with self._reports_lock:
                 had_rem = bool(
                     self._rem_ledgers.get(pname)
@@ -3010,32 +3299,29 @@ class NetworkClusterPolicyReconciler:
             if policy.status.remediation is not None or had_rem:
                 self._cleanup_remediation(pname)
             policy.status.remediation = None
+            ps.last_rem_status = None
+            ps.active = False
+        phases["remediation"] = t_phase() - p0
 
-        # fleet version skew: agent package version -> node count (from
-        # whatever version stamp each report carries; "" = pre-field
-        # agents, not counted)
-        versions: Dict[str, int] = {}
-        for rep in reports:
-            ver = getattr(rep, "agent_version", "")
-            if isinstance(ver, str) and ver:
-                versions[ver] = versions.get(ver, 0) + 1
-        policy.status.agent_versions = dict(sorted(versions.items()))
+        p0 = t_phase()
+        # fleet version skew: agent package version -> node count
+        policy.status.agent_versions = d.versions_rollup()
 
         # per-shard fleet rollup — the O(shards) surface the bounded
-        # lists point at; always computed for tpu-so policies (cheap at
-        # small n, load-bearing in summary mode)
+        # lists point at; always computed for tpu-so policies
         if policy.spec.configuration_type == t.CONFIG_TYPE_TPU_SO:
-            policy.status.summary = self._build_summary(
-                detail, reports, rows, anomalous_nodes
+            policy.status.summary = d.build_summary(
+                detail, self.MAX_SUMMARY_SHARDS
             )
-            self._export_shard_metrics(
-                policy.metadata.name, policy.status.summary
-            )
+            export_key = (d.vers["summary"], detail)
+            if ps.shard_export != export_key and self.metrics:
+                self._export_shard_metrics(pname, policy.status.summary)
+                ps.shard_export = export_key
         else:
             policy.status.summary = None
 
         if self.metrics:
-            labels = {"policy": policy.metadata.name}
+            labels = {"policy": pname}
             values = {
                 "tpunet_policy_targets": targets,
                 "tpunet_policy_ready_nodes": ready,
@@ -3045,7 +3331,10 @@ class NetworkClusterPolicyReconciler:
             assert set(values) == set(POLICY_GAUGES)
             for gauge in POLICY_GAUGES:
                 self.metrics.set_gauge(gauge, values[gauge], labels)
+        phases["aggregate"] += t_phase() - p0
 
+        # -- phase: project — status diff + (maybe) one write ---------
+        p0 = t_phase()
         updated = (
             policy.status.targets != targets
             or policy.status.ready_nodes != ready
@@ -3065,6 +3354,7 @@ class NetworkClusterPolicyReconciler:
         policy.status.state = state
         self._emit_state_transition(policy, old_state, state, errors)
 
+        result = Result()
         if updated:
             if self.metrics:
                 # CR status footprint visibility: the number the
@@ -3076,25 +3366,59 @@ class NetworkClusterPolicyReconciler:
                     float(len(json_mod.dumps(
                         am.to_dict(policy.status)
                     ))),
-                    {"policy": policy.metadata.name},
+                    {"policy": pname},
                 )
             try:
                 self.client.update_status(policy.to_dict())
             except kerr.ConflictError:
-                # over a cached read the CR copy (and its rv) stays stale
-                # until the watch delivers — retry after the delivery
-                # delay, not in a hot PUT/409 loop
-                return Result(requeue=True, requeue_after=0.05)
-        if probe_requeue > 0:
+                # over a cached read the CR copy (and its rv) stays
+                # stale until the watch delivers — retry after the
+                # delivery delay, not in a hot PUT/409 loop
+                result = Result(requeue=True, requeue_after=0.05)
+        if not result.requeue and probe_requeue > 0:
             # degraded fabric: re-probe on the quarantine backoff
             # schedule instead of waiting a full resync period
-            return Result(requeue=True, requeue_after=probe_requeue)
-        return Result()
+            result = Result(requeue=True, requeue_after=probe_requeue)
+        phases["project"] += t_phase() - p0
+
+        # -- fast-path bookkeeping ------------------------------------
+        ps.generation = generation
+        ps.ds_rv = str(
+            (ds.get("metadata", {}) or {}).get("resourceVersion", "") or ""
+        )
+        ps.result_requeue = result.requeue
+        ps.result_after = result.requeue_after
+        ps.clean = (
+            ps.peers_clean and ps.plan_clean and ps.rem_clean
+            and not result.requeue
+        )
+        ps.stale_due_wall = (
+            ps.stale_heap[0][0] if ps.stale_heap else None
+        )
+        ps.ever_completed = True
+        if self.metrics:
+            self.metrics.set_gauge(
+                "tpunet_reconcile_dirty_nodes", float(n_dirty),
+                {"policy": pname},
+            )
+            for phase_name, secs in phases.items():
+                self.metrics.observe(
+                    "tpunet_reconcile_status_phase_seconds", secs,
+                    {"phase": phase_name},
+                )
+        return result
 
     # -- entry point ----------------------------------------------------------
 
     def reconcile(self, name: str) -> Result:
-        """ref ``Reconcile()`` :313-362."""
+        """ref ``Reconcile()`` :313-362 — with a steady-pass fast path:
+        when the dirty tracker reports no pending deltas, the CR spec
+        generation and the owned DaemonSet are unchanged, and no timer
+        work (report staleness, quarantine streaks, anti-entropy
+        windows, plan holds, remediation cooldowns, the periodic full
+        rebuild) is due, the pass exits after this cheap check — the
+        previous pass's outputs are still exactly right, so a steady
+        fleet costs O(1) regardless of size."""
         try:
             raw = self.client.get(t.API_VERSION, NetworkClusterPolicy.KIND, name)
         except kerr.NotFoundError:
@@ -3103,7 +3427,9 @@ class NetworkClusterPolicyReconciler:
             if self.metrics:
                 for gauge in POLICY_GAUGES:
                     self.metrics.remove_gauge(gauge, {"policy": name})
-                for gauge in ("tpunet_status_bytes",):
+                for gauge in (
+                    "tpunet_status_bytes", "tpunet_reconcile_dirty_nodes",
+                ):
                     self.metrics.remove_gauge(gauge, {"policy": name})
                 for gauge in TELEMETRY_GAUGES:
                     self.metrics.remove_matching(gauge, {"policy": name})
@@ -3122,8 +3448,12 @@ class NetworkClusterPolicyReconciler:
             # CR; this drops the in-memory ledger/diff state + metric
             # series (and re-deletes the CMs, tolerated when gone)
             self._cleanup_remediation(name)
+            # delta pipeline state dies with the policy
+            self._derived.pop(name, None)
+            self._pass_state.pop(name, None)
+            self._ds_checked.pop(name, None)
+            self.dirty.forget(name)
             return Result()
-        policy = NetworkClusterPolicy.from_dict(raw)
 
         owned = self.client.list(
             "apps/v1",
@@ -3134,28 +3464,73 @@ class NetworkClusterPolicyReconciler:
             limit=LIST_PAGE_SIZE,
         )
         if not owned:
-            return self._create_daemonset(policy)
+            return self._create_daemonset(NetworkClusterPolicy.from_dict(raw))
 
         ds = owned[0]
-        original_spec = copy.deepcopy(ds["spec"]["template"]["spec"])
-        self._update_daemonset(ds, policy)
-        if ds["spec"]["template"]["spec"] != original_spec:
-            log.info("DS template drift; updating %s", ds["metadata"]["name"])
-            # re-stamp: the drift update starts a new provisioning
-            # attempt (pods roll), so the object carries the reconcile
-            # trace that caused it
-            self._stamp_trace(ds)
-            try:
-                self.client.update(ds)
-            except kerr.ConflictError:
-                # cached DS copy carried a stale rv (watch lag after a
-                # racing update) — a normal self-healing race, not an
-                # error; retry once the cache has the successor
-                return Result(requeue=True, requeue_after=0.05)
-            self._emit(
-                policy, obs_events.TYPE_NORMAL, "DaemonSetUpdated",
-                f"re-projected agent DaemonSet {self.namespace}/"
-                f"{ds['metadata']['name']} after template drift",
-            )
+        ds_rv = str(
+            (ds.get("metadata", {}) or {}).get("resourceVersion", "") or ""
+        )
+        generation = self._spec_identity(raw)
 
-        return self._update_status(policy, ds)
+        # steady-pass fast path: everything below is provably a no-op
+        ps = self._pass_state.get(name)
+        if (
+            ps is not None
+            # a mid-pass exception drops the derived cache (see
+            # _update_status) AFTER dirty state was consumed — the
+            # retried pass must rebuild, not no-op on stale bookkeeping
+            and name in self._derived
+            and self.dirty.active
+            and not self.FULL_REBUILD_ALWAYS
+            and ps.generation == generation
+            and ps.ds_rv == ds_rv
+        ):
+            self.dirty.sync()
+            import time as time_mod
+
+            if not self.dirty.peek(name) and ps.quiet(
+                time_mod.time(), self._probe_clock()
+            ):
+                if self.metrics:
+                    self.metrics.inc("tpunet_reconcile_fast_path_total")
+                    self.metrics.set_gauge(
+                        "tpunet_reconcile_dirty_nodes", 0.0,
+                        {"policy": name},
+                    )
+                return Result()
+
+        policy = NetworkClusterPolicy.from_dict(raw)
+
+        # template-drift check, fingerprint-gated: re-projecting (and
+        # deep-copying) the full pod template every pass was pure waste
+        # while neither the spec nor the DaemonSet had changed
+        if self._ds_checked.get(name) != (ds_rv, generation):
+            original_spec = copy.deepcopy(ds["spec"]["template"]["spec"])
+            self._update_daemonset(ds, policy)
+            if ds["spec"]["template"]["spec"] != original_spec:
+                log.info(
+                    "DS template drift; updating %s", ds["metadata"]["name"]
+                )
+                # re-stamp: the drift update starts a new provisioning
+                # attempt (pods roll), so the object carries the
+                # reconcile trace that caused it
+                self._stamp_trace(ds)
+                try:
+                    self.client.update(ds)
+                except kerr.ConflictError:
+                    # cached DS copy carried a stale rv (watch lag after
+                    # a racing update) — a normal self-healing race;
+                    # retry once the cache has the successor
+                    return Result(requeue=True, requeue_after=0.05)
+                self._emit(
+                    policy, obs_events.TYPE_NORMAL, "DaemonSetUpdated",
+                    f"re-projected agent DaemonSet {self.namespace}/"
+                    f"{ds['metadata']['name']} after template drift",
+                )
+                # deliberately NOT cached: the update bumped the DS rv,
+                # so the next pass re-verifies the written object once
+                # and caches that
+            else:
+                self._ds_checked[name] = (ds_rv, generation)
+
+        return self._update_status(policy, ds, raw=raw)

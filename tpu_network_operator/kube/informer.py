@@ -71,6 +71,27 @@ class Store:
         # index name -> indexed value -> keys (maintained at insert time,
         # so an indexed list never scans the store)
         self._index: Dict[str, Dict[str, Set[Key]]] = {}
+        # delta listeners: fn(ev, namespace, name, new_obj, old_obj)
+        # with ev in {"add", "update", "delete"} — the key-level change
+        # feed the delta-driven reconciler builds its dirty sets from.
+        # Objects are the STORED objects (shared, read-only: the same
+        # contract as list(copy_objects=False)); listeners run OUTSIDE
+        # the store lock so they may read back through the store.
+        self._listeners: List[Callable] = []
+
+    def add_delta_listener(self, fn: Callable) -> None:
+        """Register ``fn(ev, namespace, name, new_obj, old_obj)`` to be
+        called after every store mutation.  A listener exception must
+        not corrupt the store — it is logged and swallowed."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def _fire(self, ev: str, ns: str, name: str, new, old) -> None:
+        for fn in self._listeners:
+            try:
+                fn(ev, ns, name, new, old)
+            except Exception:   # noqa: BLE001 — must not kill the writer
+                log.exception("store delta listener failed")
 
     def register_index(self, name: str, fn: Callable) -> None:
         with self._lock:
@@ -100,6 +121,12 @@ class Store:
             for name, fn in self._indexers.items():
                 for val in fn(obj) or []:
                     self._index[name].setdefault(val, set()).add(key)
+            fire = bool(self._listeners)
+        if fire:
+            self._fire(
+                "update" if old is not None else "add",
+                key[0], key[1], obj, old,
+            )
 
     def delete(self, namespace: str, name: str) -> None:
         key = (namespace, name)
@@ -107,11 +134,22 @@ class Store:
             obj = self._objs.pop(key, None)
             if obj is not None:
                 self._unindex(key, obj)
+            fire = obj is not None and bool(self._listeners)
+        if fire:
+            self._fire("delete", namespace, name, None, obj)
 
-    def get(self, name: str, namespace: str = "") -> Optional[Dict[str, Any]]:
+    def get(
+        self, name: str, namespace: str = "", copy_obj: bool = True
+    ) -> Optional[Dict[str, Any]]:
+        """``copy_obj=False`` returns the STORED object itself (the
+        shared read-only lister contract, like ``list(copy_objects=
+        False)``) — the delta-driven reconciler's per-dirty-node lease
+        reads must not pay a deepcopy per node."""
         with self._lock:
             obj = self._objs.get((namespace, name))
-            return copy.deepcopy(obj) if obj is not None else None
+            if obj is None:
+                return None
+            return copy.deepcopy(obj) if copy_obj else obj
 
     def rv_of(self, name: str, namespace: str = "") -> Optional[int]:
         """Stored resourceVersion as an int (0 if unparseable), None when
@@ -207,6 +245,11 @@ class Informer:
         self._resync_active = False
         self._resync_touched: set = set()
         self._handlers: List[Callable[[str, Dict[str, Any]], None]] = []
+        # fired after every completed relist (seed list included): the
+        # delta-driven reconciler reseeds its dirty sets to "all" here,
+        # because a relist can change the store without a per-key event
+        # trail it can trust (the watch-gap hole)
+        self._resync_listeners: List[Callable[[], None]] = []
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -247,6 +290,20 @@ class Informer:
         """``fn(event_type, obj)`` after each store update (the shared-
         informer handler seam; the store is already current when called)."""
         self._handlers.append(fn)
+
+    def add_delta_listener(self, fn: Callable) -> None:
+        """Key-level change feed (see :meth:`Store.add_delta_listener`):
+        ``fn(ev, namespace, name, new_obj, old_obj)`` with shared
+        read-only objects, fired for watch events AND relist repairs —
+        unlike :meth:`add_event_handler` it never pays a deepcopy per
+        event, so it is safe to register on fleet-churn kinds."""
+        self.store.add_delta_listener(fn)
+
+    def add_resync_listener(self, fn: Callable[[], None]) -> None:
+        """``fn()`` after every completed relist (the seed list and
+        every watch-restart/periodic relist): listeners treat the store
+        as arbitrarily changed and reseed any derived state."""
+        self._resync_listeners.append(fn)
 
     # -- event application -----------------------------------------------------
 
@@ -429,7 +486,12 @@ class Informer:
                     # state postdates the snapshot, never overwrite it
                     continue
                 current_rv = self.store.rv_of(key[1], key[0])
-                if current_rv is not None and _rv(obj) and _rv(obj) < current_rv:
+                # <= (not <, as in the watch path): an EQUAL rv is the
+                # same object — re-upserting it would fire a spurious
+                # "update" delta for every stored object on every
+                # relist, and the relist already announces itself to
+                # the resync listeners below
+                if current_rv is not None and _rv(obj) and _rv(obj) <= current_rv:
                     continue
                 # both client.list implementations return exclusively-
                 # owned objects (the fake deepcopies, the wire client
@@ -441,6 +503,11 @@ class Informer:
                 if key not in live and key not in touched:
                     self.store.delete(*key)
             self._update_gauge()
+        for fn in self._resync_listeners:
+            try:
+                fn()
+            except Exception:   # noqa: BLE001 — must not fail the relist
+                log.exception("informer resync listener failed")
 
 
 class CachedClient:

@@ -73,15 +73,29 @@ class PlanTracker:
         self._clock = clock
         self._lock = threading.Lock()
         self._state: Dict[str, _PolicyState] = {}
+        # policy -> deadline (clock domain) at which a drift-beyond-
+        # hysteresis replan deferred by the hold window becomes due.
+        # The delta-driven reconciler treats this as timer-due work: an
+        # otherwise-unchanged fleet must still wake up to act on the
+        # held drift once the window expires.
+        self._held: Dict[str, float] = {}
 
     def current(self, policy: str) -> Optional[TopologyPlan]:
         with self._lock:
             st = self._state.get(policy)
             return st.plan if st else None
 
+    def held_until(self, policy: str) -> Optional[float]:
+        """Deadline of a hold-deferred replan (clock domain of the
+        tracker's ``clock``), or None when nothing is pending — set and
+        cleared by :meth:`update`."""
+        with self._lock:
+            return self._held.get(policy)
+
     def forget(self, policy: str) -> None:
         with self._lock:
             self._state.pop(policy, None)
+            self._held.pop(policy, None)
 
     def update(
         self,
@@ -106,16 +120,26 @@ class PlanTracker:
                 or prev.spread_threshold_ms != inputs.spread_threshold_ms
             )
             if not structural:
-                if (
-                    now - st.computed_at < hold_seconds
-                    or not significant_rtt_drift(
-                        prev.rtt, inputs.rtt, rtt_hysteresis_ms
-                    )
-                ):
+                drift = significant_rtt_drift(
+                    prev.rtt, inputs.rtt, rtt_hysteresis_ms
+                )
+                if now - st.computed_at < hold_seconds or not drift:
+                    with self._lock:
+                        if drift:
+                            # real drift deferred by the hold window:
+                            # record when it becomes actionable so the
+                            # reconciler's steady-pass fast path knows
+                            # to wake up even with zero watch deltas
+                            self._held[policy] = (
+                                st.computed_at + hold_seconds
+                            )
+                        else:
+                            self._held.pop(policy, None)
                     return st.plan, False
         plan = compute_plan(inputs)
         with self._lock:
             self._state[policy] = _PolicyState(
                 plan=plan, inputs=inputs, computed_at=now
             )
+            self._held.pop(policy, None)
         return plan, True
