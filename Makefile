@@ -63,6 +63,11 @@ remediation-bench: ## Self-healing proof: marked tests + the flap/escalation/sto
 	$(PYTHON) -m pytest tests/ -x -q -m "remediation and not slow"
 	$(PYTHON) tools/remediation_bench.py --out BENCH_remediation.json
 
+.PHONY: timeline-bench
+timeline-bench: ## Flight-recorder proof: marked tests + the 10k scale / chaos-chain / byte-budget-soak bench
+	$(PYTHON) -m pytest tests/ -x -q -m "timeline and not slow"
+	$(PYTHON) tools/timeline_bench.py --out BENCH_timeline.json
+
 .PHONY: test-cluster
 test-cluster: ## kind-cluster e2e + live fuzz (needs kind/docker/kubectl; skips cleanly without — ref test/e2e + test/fuzz)
 	$(PYTHON) -m pytest tests/cluster -x -q

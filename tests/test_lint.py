@@ -355,11 +355,91 @@ class TestRetryLoops:
         assert "R001" not in self.codes_at(self.PKG, src)
 
 
+class TestMetricHelp:
+    """M001: every metric family registered via health.Metrics must
+    have a METRIC_HELP entry — the HELP table is enforced, not
+    maintained by convention."""
+
+    PKG = "tpu_network_operator/controller/x.py"
+    HELP = {"tpunet_known_total"}
+
+    def codes_at(self, path, src, metric_help=HELP):
+        tree = ast.parse(src)
+        return {
+            f.code
+            for f in lint.Checker(
+                path, tree, src, metric_help=metric_help,
+            ).run()
+        }
+
+    def test_unregistered_family_flagged(self):
+        src = (
+            "def f(metrics):\n"
+            "    metrics.inc('tpunet_mystery_total')\n"
+        )
+        assert "M001" in self.codes_at(self.PKG, src)
+
+    def test_known_family_ok(self):
+        for method in ("inc", "set_gauge", "observe", "remove_gauge",
+                       "remove_matching"):
+            src = (
+                "def f(metrics):\n"
+                f"    metrics.{method}('tpunet_known_total', 1.0)\n"
+            )
+            assert "M001" not in self.codes_at(self.PKG, src)
+
+    def test_family_tuple_constants_checked(self):
+        src = (
+            "GAUGES = (\n"
+            "    'tpunet_known_total',\n"
+            "    'tpunet_phantom_gauge',\n"
+            ")\n"
+        )
+        assert "M001" in self.codes_at(self.PKG, src)
+        src_ok = "GAUGES = ('tpunet_known_total',)\n"
+        assert "M001" not in self.codes_at(self.PKG, src_ok)
+
+    def test_mixed_tuples_not_collected(self):
+        # a tuple that mixes metric names with other strings is not a
+        # family list (e.g. label tuples) — stays unflagged
+        src = "STUFF = ('tpunet_x_total', 'policy')\n"
+        assert "M001" not in self.codes_at(self.PKG, src)
+
+    def test_scoped_to_package(self):
+        src = "def f(m):\n    m.inc('tpunet_mystery_total')\n"
+        assert "M001" not in self.codes_at("tests/test_x.py", src)
+        assert "M001" not in self.codes_at("tools/bench_x.py", src)
+
+    def test_rule_off_without_table(self):
+        src = "def f(m):\n    m.inc('tpunet_mystery_total')\n"
+        assert "M001" not in self.codes_at(self.PKG, src,
+                                           metric_help=None)
+
+    def test_load_metric_help_reads_real_table(self):
+        root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        keys = lint.load_metric_help(os.path.join(
+            root, "tpu_network_operator/controller/health.py"
+        ))
+        assert keys is not None
+        assert "tpunet_reconcile_total" in keys
+        assert "tpunet_slo_readiness_ratio" in keys
+        assert lint.load_metric_help("/no/such/file.py") is None
+
+
 def test_repo_is_lint_clean():
-    """The gate itself: the whole repo must stay at zero findings."""
+    """The gate itself: the whole repo must stay at zero findings —
+    M001 included (every registered family has HELP)."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    metric_help = lint.load_metric_help(os.path.join(
+        root, "tpu_network_operator/controller/health.py"
+    ))
+    assert metric_help, "METRIC_HELP table not found"
     findings = []
     for target in lint.DEFAULT_TARGETS:
         for path in lint.iter_py_files([os.path.join(root, target)]):
-            findings.extend(lint.lint_file(path))
+            findings.extend(
+                lint.lint_file(path, metric_help=metric_help)
+            )
     assert findings == [], "\n".join(str(f) for f in findings)

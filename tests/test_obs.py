@@ -447,6 +447,114 @@ class TestDebugTracesEndpoint:
         assert srv._thread is None
 
 
+@pytest.mark.timeline
+class TestDebugTimelineEndpoint:
+    """The fleet timeline journal endpoint — same gate + degrade-to-
+    default query contract as /debug/traces."""
+
+    def _timeline(self):
+        from tpu_network_operator.obs import Timeline
+
+        clock = [1000.0]
+        tl = Timeline(clock=lambda: clock[0])
+        tl.record("pol-a", "probe", node="node-0",
+                  frm="Reachable", to="Degraded", reason="probe")
+        clock[0] = 2000.0
+        tl.record("pol-a", "readiness", node="node-0",
+                  frm="ready", to="not-ready")
+        tl.record("pol-b", "state", frm="Working on it..",
+                  to="All good")
+        return tl
+
+    def test_serves_journal_with_filters(self):
+        tl = self._timeline()
+        srv = HealthServer(port=0, timeline=tl)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            status, body = _get(f"{base}/debug/timeline")
+            assert status == 200
+            data = json.loads(body)
+            assert data["total"] == 3
+            assert data["dropped"] == 0
+            assert data["policies"] == ["pol-a", "pol-b"]
+            assert [r["seq"] for r in data["records"]] == [1, 2, 3]
+            # policy / node / kind filters
+            _, body = _get(f"{base}/debug/timeline?policy=pol-b")
+            assert [r["kind"] for r in json.loads(body)["records"]] \
+                == ["state"]
+            _, body = _get(f"{base}/debug/timeline?node=node-0")
+            assert len(json.loads(body)["records"]) == 2
+            _, body = _get(f"{base}/debug/timeline?kind=probe")
+            records = json.loads(body)["records"]
+            assert [r["to"] for r in records] == ["Degraded"]
+            # since + limit compose
+            _, body = _get(f"{base}/debug/timeline?since=1500")
+            assert [r["seq"] for r in json.loads(body)["records"]] \
+                == [2, 3]
+            _, body = _get(f"{base}/debug/timeline?limit=1")
+            assert [r["seq"] for r in json.loads(body)["records"]] \
+                == [3]
+        finally:
+            srv.stop()
+
+    def test_query_parameter_edge_cases(self):
+        """limit=0/negative/non-numeric mean "no limit", an unknown
+        policy/node yields an empty record list (not a 500), a future
+        ``since`` yields nothing, and a non-numeric ``since`` degrades
+        to 0."""
+        tl = self._timeline()
+        srv = HealthServer(port=0, timeline=tl)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            for q in ("limit=0", "limit=-5", "limit=bogus"):
+                status, body = _get(f"{base}/debug/timeline?{q}")
+                assert status == 200
+                assert len(json.loads(body)["records"]) == 3
+            for q in ("policy=nope", "node=ghost",
+                      "since=9999999999"):
+                status, body = _get(f"{base}/debug/timeline?{q}")
+                assert status == 200
+                data = json.loads(body)
+                assert data["records"] == []
+                assert data["total"] == 3   # the journal itself is fine
+            status, body = _get(f"{base}/debug/timeline?since=bogus")
+            assert status == 200
+            assert len(json.loads(body)["records"]) == 3
+        finally:
+            srv.stop()
+
+    def test_404_without_timeline(self):
+        srv = HealthServer(port=0, tracer=Tracer())
+        srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(f"http://127.0.0.1:{srv.port}/debug/timeline")
+            assert err.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_auth_gate_shared_with_metrics(self):
+        srv = HealthServer(port=0, metrics=Metrics(),
+                           timeline=self._timeline(),
+                           metrics_auth=lambda tok: tok == "s3cr3t")
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(f"{base}/debug/timeline")
+            assert err.value.code == 403
+            req = urllib.request.Request(
+                f"{base}/debug/timeline",
+                headers={"Authorization": "Bearer s3cr3t"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 200
+        finally:
+            srv.stop()
+
+
 class TestExpositionFormat:
     def test_help_lines_accompany_type(self):
         m = Metrics()

@@ -1,0 +1,630 @@
+"""Fleet flight recorder (obs/timeline.py) + SLO engine (obs/slo.py):
+the byte-budgeted transition journal, the reconciler's edge-detection
+recording hooks (steady passes append ZERO records), burn-rate SLO
+folding, the bounded ``status.health`` rollup's zero-steady-write
+contract, the ``tools/why.py`` causal narrative, and the support
+bundle's timeline/SLO members."""
+
+import json
+import os
+import sys
+import tarfile
+
+import pytest
+
+from tpu_network_operator.agent import report as rpt
+from tpu_network_operator.api.v1alpha1 import (
+    NetworkClusterPolicy,
+    default_policy,
+)
+from tpu_network_operator.api.v1alpha1.types import API_VERSION
+from tpu_network_operator.controller.health import METRIC_HELP, Metrics
+from tpu_network_operator.controller.reconciler import (
+    NetworkClusterPolicyReconciler,
+)
+from tpu_network_operator.kube.fake import FakeCluster
+from tpu_network_operator.obs import SloEngine, Timeline
+from tpu_network_operator.obs import slo as slo_mod
+from tpu_network_operator.obs import timeline as tl_mod
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools",
+))
+import why as why_mod   # noqa: E402 — tools/ scripts, not a package
+import diag as diag_mod   # noqa: E402
+
+NAMESPACE = "tpunet-system"
+POLICY = "tl-pol"
+
+pytestmark = pytest.mark.timeline
+
+
+# -- the journal itself --------------------------------------------------------
+
+
+class TestTimeline:
+    def test_record_and_snapshot_filters(self):
+        clock = [100.0]
+        tl = Timeline(clock=lambda: clock[0])
+        tl.record("a", tl_mod.KIND_PROBE, node="n1",
+                  frm="Reachable", to="Degraded",
+                  trace_id="ab" * 16, reason="NodeQuarantined",
+                  directive_id="d-1", detail="why")
+        clock[0] = 200.0
+        tl.record("a", tl_mod.KIND_READINESS, node="n2",
+                  frm="ready", to="not-ready")
+        tl.record("b", tl_mod.KIND_STATE, to="All good")
+        assert len(tl) == 3
+        assert [r["seq"] for r in tl.snapshot()] == [1, 2, 3]
+        rec = tl.snapshot(policy="a", node="n1")[0]
+        assert rec["kind"] == "probe"
+        assert rec["from"] == "Reachable" and rec["to"] == "Degraded"
+        assert rec["cause"] == {
+            "traceId": "ab" * 16, "reason": "NodeQuarantined",
+            "directiveId": "d-1",
+        }
+        assert rec["detail"] == "why"
+        assert [r["node"] for r in tl.snapshot(kind="readiness")] \
+            == ["n2"]
+        assert [r["seq"] for r in tl.snapshot(since=150.0)] == [2, 3]
+        assert [r["seq"] for r in tl.snapshot(limit=2)] == [2, 3]
+        assert tl.policies() == ["a", "b"]
+
+    def test_byte_budget_evicts_oldest_never_exceeds(self):
+        tl = Timeline(policy_byte_budget=4096)
+        for i in range(200):
+            tl.record("a", tl_mod.KIND_READINESS, node=f"node-{i:03d}",
+                      frm="ready", to="not-ready",
+                      detail="x" * 64)
+            assert tl.total_bytes("a") <= 4096
+        assert tl.dropped("a") > 0
+        assert tl.appended("a") == 200
+        survivors = tl.snapshot(policy="a")
+        assert len(survivors) == len(tl)
+        # oldest evicted first: the survivors are the newest suffix
+        seqs = [r["seq"] for r in survivors]
+        assert seqs == sorted(seqs)
+        assert seqs[-1] == 200
+        assert seqs[0] == 200 - len(seqs) + 1
+
+    def test_budget_is_per_policy(self):
+        tl = Timeline(policy_byte_budget=4096)
+        for i in range(100):
+            tl.record("a", tl_mod.KIND_STATE, to=f"s{i}", detail="x" * 80)
+        tl.record("b", tl_mod.KIND_STATE, to="fresh")
+        assert tl.dropped("b") == 0
+        assert tl.snapshot(policy="b")[0]["to"] == "fresh"
+
+    def test_single_oversized_record_survives(self):
+        tl = Timeline(policy_byte_budget=4096)
+        tl.record("a", tl_mod.KIND_STATE, to="big", detail="y" * 5000)
+        assert len(tl.snapshot(policy="a")) == 1
+
+    def test_listener_fed_and_exceptions_swallowed(self):
+        tl = Timeline()
+        seen = []
+
+        def boom(rec):
+            seen.append(rec["seq"])
+            raise RuntimeError("observer bug")
+
+        tl.add_listener(boom)
+        tl.record("a", tl_mod.KIND_STATE, to="x")
+        tl.record("a", tl_mod.KIND_STATE, to="y")
+        assert seen == [1, 2]
+
+    def test_forget_drops_ring_and_series(self):
+        m = Metrics()
+        tl = Timeline(metrics=m)
+        tl.record("a", tl_mod.KIND_STATE, to="x")
+        assert "tpunet_timeline_records_total" in m.render()
+        tl.forget("a")
+        assert len(tl) == 0
+        assert tl.appended("a") == 0
+        assert "tpunet_timeline_bytes" not in m.render()
+
+    def test_metric_help_covers_timeline_families(self):
+        for name in ("tpunet_timeline_records_total",
+                     "tpunet_timeline_bytes"):
+            assert name in METRIC_HELP
+
+
+# -- the SLO engine ------------------------------------------------------------
+
+
+class TestSloEngine:
+    def test_burn_rate_step_integration(self):
+        clock = [0.0]
+        slo = SloEngine(objective=0.99, clock=lambda: clock[0])
+        slo.observe_fleet("a", 100, 100, ts=0.0)
+        slo.observe_fleet("a", 90, 100, ts=150.0)   # ratio 0.9
+        # ACTIVE incident: the 0.9 sample just landed (zero integrable
+        # width), so the burn floors at the instantaneous rate —
+        # (1 - 0.9)/(1 - 0.99) = 10 — instead of reporting 0 until
+        # recovery moves the window past the degraded segment
+        assert slo.burn_rate("a", 300.0) == pytest.approx(10.0)
+        # recovery makes the 0.9 span integrable and clears the floor
+        slo.observe_fleet("a", 100, 100, ts=300.0)
+        # window [0, 300]: 150s @ 1.0, 150s @ 0.9 → mean bad 0.05 →
+        # burn 0.05 / 0.01 = 5; instantaneous now 0
+        assert slo.burn_rate("a", 300.0) == pytest.approx(5.0)
+        # steady: same inputs, same number (deterministic asof)
+        assert slo.burn_rate("a", 300.0) == pytest.approx(5.0)
+
+    def test_burn_rate_decays_after_recovery(self):
+        """A long-recovered incident must stop burning: the decay
+        bucket advances the window past it even with no new samples —
+        anchoring at the newest sample alone would page forever."""
+        clock = [0.0]
+        tl = Timeline(clock=lambda: clock[0])
+        slo = SloEngine(tl, objective=0.99, clock=lambda: clock[0])
+        slo.observe_fleet("a", 100, 100, ts=0.0)
+        slo.observe_fleet("a", 50, 100, ts=1000.0)
+        slo.observe_fleet("a", 100, 100, ts=1120.0)   # 2-min incident
+        clock[0] = 1150.0
+        h = slo.health_status("a")
+        assert h.burn_rate_fast > 1.0   # window still straddles it
+        # hours later, fleet steady: the bucketed window slid past the
+        # incident — burn integrates to 0 with NO new samples/records
+        clock[0] = 1120.0 + 7200.0
+        h2 = slo.health_status("a")
+        assert h2.burn_rate_fast == pytest.approx(0.0)
+        assert h2.burn_rate_slow == pytest.approx(0.0)
+        # and stabilizes: the same bucket serves the identical object
+        assert slo.health_status("a") is h2
+
+    def test_observe_fleet_is_event_sourced(self):
+        slo = SloEngine()
+        slo.observe_fleet("a", 10, 10, ts=1.0)
+        slo.observe_fleet("a", 10, 10, ts=2.0)
+        slo.observe_fleet("a", 10, 10, ts=3.0)
+        assert len(slo._samples["a"]) == 1
+
+    def test_detection_and_convergence_episodes(self):
+        m = Metrics()
+        clock = [0.0]
+        tl = Timeline(clock=lambda: clock[0])
+        slo = SloEngine(tl, metrics=m, clock=lambda: clock[0])
+        clock[0] = 10.0
+        tl.record("a", tl_mod.KIND_PROBE, node="n1",
+                  frm="Reachable", to="Degraded")
+        clock[0] = 14.0
+        tl.record("a", tl_mod.KIND_READINESS, node="n1",
+                  frm="ready", to="not-ready")
+        clock[0] = 15.0
+        tl.record("a", tl_mod.KIND_REMEDIATION, node="n1",
+                  frm="probe", to="re-probe",
+                  reason="RemediationStarted", directive_id="d-1")
+        clock[0] = 40.0
+        tl.record("a", tl_mod.KIND_PROBE, node="n1",
+                  frm="Degraded", to="Reachable")
+        health = slo.health_status("a")
+        # detection: fault open at 10, label retract at 14
+        assert health.fault_detection_p50_seconds == pytest.approx(4.0)
+        # convergence: episode open at 10, recovered at 40, remediated
+        assert health.remediation_convergence_p50_seconds \
+            == pytest.approx(30.0)
+        rendered = m.render()
+        assert "tpunet_slo_fault_detection_seconds_count" in rendered
+        assert "tpunet_slo_remediation_convergence_seconds_count" \
+            in rendered
+
+    def test_unremediated_recovery_is_not_convergence(self):
+        clock = [0.0]
+        tl = Timeline(clock=lambda: clock[0])
+        slo = SloEngine(tl, clock=lambda: clock[0])
+        tl.record("a", tl_mod.KIND_PROBE, node="n1",
+                  frm="Reachable", to="Degraded")
+        clock[0] = 50.0
+        tl.record("a", tl_mod.KIND_PROBE, node="n1",
+                  frm="Degraded", to="Reachable")
+        assert slo.health_status(
+            "a"
+        ).remediation_convergence_p50_seconds == 0.0
+
+    def test_telemetry_episode_open_close_per_interface(self):
+        clock = [0.0]
+        tl = Timeline(clock=lambda: clock[0])
+        slo = SloEngine(tl, clock=lambda: clock[0])
+        tl.record("a", tl_mod.KIND_TELEMETRY, node="n1",
+                  frm="nominal", to="anomalous", detail="ens9: error-ratio")
+        tl.record("a", tl_mod.KIND_TELEMETRY, node="n1",
+                  frm="nominal", to="anomalous", detail="ens10: drop-spike")
+        tl.record("a", tl_mod.KIND_REMEDIATION, node="n1",
+                  frm="error-ratio", to="bounce-interface",
+                  reason="RemediationStarted", directive_id="d-2")
+        clock[0] = 30.0
+        tl.record("a", tl_mod.KIND_TELEMETRY, node="n1",
+                  frm="anomalous", to="nominal", detail="ens9: error-ratio")
+        # ens10 still open: no convergence yet
+        assert slo.health_status(
+            "a"
+        ).remediation_convergence_p50_seconds == 0.0
+        clock[0] = 45.0
+        tl.record("a", tl_mod.KIND_TELEMETRY, node="n1",
+                  frm="anomalous", to="nominal", detail="ens10: drop-spike")
+        assert slo.health_status(
+            "a"
+        ).remediation_convergence_p50_seconds == pytest.approx(45.0)
+
+    def test_health_status_cached_until_version_moves(self):
+        tl = Timeline()
+        slo = SloEngine(tl)
+        slo.observe_fleet("a", 5, 10, ts=1.0)
+        h1 = slo.health_status("a")
+        h2 = slo.health_status("a")
+        assert h1 is h2   # identical object → no status churn
+        tl.record("a", tl_mod.KIND_STATE, to="All good")
+        assert slo.health_status("a") is not h1
+
+    def test_fast_path_ratio_and_no_version_bump(self):
+        tl = Timeline()
+        slo = SloEngine(tl)
+        slo.observe_fleet("a", 10, 10, ts=1.0)
+        h1 = slo.health_status("a")
+        for _ in range(3):
+            slo.note_pass("a", fast=True)
+        slo.note_pass("a", fast=False)
+        # pass counting alone must NOT invalidate the cache (a steady
+        # fast-path pass must not cause a status write)
+        assert slo.health_status("a") is h1
+        tl.record("a", tl_mod.KIND_STATE, to="x")
+        assert slo.health_status("a").fast_path_ratio \
+            == pytest.approx(0.75)
+
+    def test_forget_retracts_series(self):
+        m = Metrics()
+        slo = SloEngine(metrics=m)
+        slo.observe_fleet("a", 1, 2, ts=1.0)
+        slo.health_status("a")
+        assert "tpunet_slo_readiness_ratio" in m.render()
+        slo.forget("a")
+        assert "tpunet_slo_readiness_ratio" not in m.render()
+        assert slo.health_status("a") is None
+
+    def test_metric_help_covers_slo_families(self):
+        for name in slo_mod.SLO_GAUGES + slo_mod.SLO_HISTOGRAMS:
+            assert name in METRIC_HELP
+
+
+# -- reconciler recording hooks ------------------------------------------------
+
+
+def probe_payload(n, bad=False):
+    return {
+        "peersTotal": n - 1,
+        "peersReachable": 0 if bad else n - 1,
+        "unreachable": [],
+        "rttP50Ms": 0.4, "rttP99Ms": 1.1,
+        "lossRatio": 1.0 if bad else 0.0,
+        "state": "Degraded" if bad else "Healthy",
+    }
+
+
+def fleet_report(node, i, n, bad=False, anom=False):
+    return rpt.ProvisioningReport(
+        node=node, policy=POLICY, ok=not bad,
+        error="link eth1 down" if bad else "",
+        backend="tpu", mode="L2",
+        interfaces_configured=2, interfaces_total=2,
+        probe_endpoint=f"10.7.0.{i + 1}:8477",
+        probe=probe_payload(n, bad=bad),
+        telemetry={"interfaces": {"ens9": {
+            "rxBytes": 1 << 20, "rxPackets": 10_000,
+            "rxErrors": 5000 if anom else 0,
+            "errorRatio": 0.33 if anom else 0.0,
+            "anomalies": ["error-ratio"] if anom else [],
+        }}},
+    )
+
+
+def make_env(n=4, remediation=False):
+    p = NetworkClusterPolicy()
+    p.metadata.name = POLICY
+    p.spec.configuration_type = "tpu-so"
+    p.spec.node_selector = {"tpunet.dev/pool": POLICY}
+    p.spec.tpu_scale_out.probe.enabled = True
+    p.spec.tpu_scale_out.remediation.enabled = remediation
+    fake = FakeCluster()
+    fake.create(default_policy(p).to_dict())
+    for i in range(n):
+        node = f"node-{i:03d}"
+        fake.add_node(node, {"tpunet.dev/pool": POLICY})
+        fake.apply(rpt.lease_for(fleet_report(node, i, n), NAMESPACE))
+    m = Metrics()
+    clock = [10_000.0]
+    tl = Timeline(clock=lambda: clock[0], metrics=m)
+    slo = SloEngine(tl, metrics=m, clock=lambda: clock[0])
+    rec = NetworkClusterPolicyReconciler(
+        fake, NAMESPACE, metrics=m, timeline=tl, slo=slo,
+    )
+    rec._rem_clock = lambda: clock[0]
+    rec.setup()
+    rec.reconcile(POLICY)
+    fake.simulate_daemonset_controller()
+    rec.reconcile(POLICY)
+    return fake, rec, tl, slo, clock
+
+
+class TestReconcilerTimeline:
+    def test_steady_passes_append_zero_records(self):
+        fake, rec, tl, slo, clock = make_env()
+        rec.reconcile(POLICY)
+        before = tl.appended()
+        for _ in range(5):
+            rec.reconcile(POLICY)
+        assert tl.appended() == before
+
+    def test_readiness_and_probe_flip_records(self):
+        fake, rec, tl, slo, clock = make_env()
+        n0 = tl.appended()
+        fake.apply(rpt.lease_for(
+            fleet_report("node-000", 0, 4, bad=True), NAMESPACE
+        ))
+        rec.reconcile(POLICY)
+        records = [r for r in tl.snapshot(node="node-000")
+                   if r["seq"] > n0]
+        kinds = [(r["kind"], r["from"], r["to"]) for r in records]
+        assert ("readiness", "ready", "not-ready") in kinds
+        assert ("probe", "Reachable", "Degraded") in kinds
+        # the readiness record names the agent's error
+        ready_rec = next(r for r in records if r["kind"] == "readiness")
+        assert "link eth1 down" in ready_rec["detail"]
+        # condition + state flips journaled at policy scope
+        pol = [
+            (r["kind"], r["detail"] if r["kind"] == "condition"
+             else r["to"])
+            for r in tl.snapshot() if not r["node"] and r["seq"] > n0
+        ]
+        assert ("condition", "DataplaneDegraded") in pol
+        assert ("state", "Working on it..") in pol
+        # recovery flips back — and only the changed node journals
+        fake.apply(rpt.lease_for(
+            fleet_report("node-000", 0, 4), NAMESPACE
+        ))
+        n1 = tl.appended()
+        rec.reconcile(POLICY)
+        fresh = [r for r in tl.snapshot() if r["seq"] > n1]
+        assert all(r["node"] in ("node-000", "") for r in fresh)
+        kinds = [(r["kind"], r["from"], r["to"]) for r in fresh]
+        assert ("readiness", "not-ready", "ready") in kinds
+        assert ("probe", "Degraded", "Reachable") in kinds
+
+    def test_telemetry_open_close_records(self):
+        fake, rec, tl, slo, clock = make_env()
+        fake.apply(rpt.lease_for(
+            fleet_report("node-001", 1, 4, anom=True), NAMESPACE
+        ))
+        rec.reconcile(POLICY)
+        opened = tl.snapshot(node="node-001", kind="telemetry")
+        assert [(r["from"], r["to"]) for r in opened] \
+            == [("nominal", "anomalous")]
+        assert opened[0]["detail"].startswith("ens9:")
+        fake.apply(rpt.lease_for(
+            fleet_report("node-001", 1, 4), NAMESPACE
+        ))
+        rec.reconcile(POLICY)
+        both = tl.snapshot(node="node-001", kind="telemetry")
+        assert [(r["from"], r["to"]) for r in both] == [
+            ("nominal", "anomalous"), ("anomalous", "nominal"),
+        ]
+
+    def test_node_departure_recorded(self):
+        fake, rec, tl, slo, clock = make_env()
+        fake.delete(rpt.LEASE_API, "Lease",
+                    rpt.lease_name("node-002"), NAMESPACE)
+        rec.reconcile(POLICY)
+        assert [(r["from"], r["to"]) for r in tl.snapshot(
+            node="node-002", kind="readiness",
+        )] == [("ready", "departed")]
+
+    def test_remediation_records_with_directive_ids(self):
+        fake, rec, tl, slo, clock = make_env(remediation=True)
+        fake.apply(rpt.lease_for(
+            fleet_report("node-000", 0, 4, anom=True), NAMESPACE
+        ))
+        rec.reconcile(POLICY)
+        fired = tl.snapshot(node="node-000", kind="remediation")
+        assert len(fired) == 1
+        assert fired[0]["from"] == "telemetry"   # the anomaly class
+        assert fired[0]["to"] == "bounce-interface"
+        did = fired[0]["cause"]["directiveId"]
+        assert did
+        # outcome rides the next report; the journal links it by id
+        fake.apply(rpt.lease_for(rpt.ProvisioningReport(
+            node="node-000", policy=POLICY, ok=True, backend="tpu",
+            mode="L2", interfaces_configured=2, interfaces_total=2,
+            probe_endpoint="10.7.0.1:8477", probe=probe_payload(4),
+            telemetry=fleet_report("node-000", 0, 4,
+                                   anom=True).telemetry,
+            remediation={"directiveId": did, "ok": True},
+        ), NAMESPACE))
+        rec.reconcile(POLICY)
+        outcome = [
+            r for r in tl.snapshot(node="node-000", kind="remediation")
+            if r["from"] == "pending"
+        ]
+        assert len(outcome) == 1
+        assert outcome[0]["to"] == "ok"
+        assert outcome[0]["cause"]["directiveId"] == did
+        # ... and the same outcome re-read on later passes journals
+        # nothing (record_outcome's pending→resolved edge is the gate)
+        n0 = tl.appended()
+        rec.reconcile(POLICY)
+        assert not [
+            r for r in tl.snapshot(kind="remediation")
+            if r["seq"] > n0
+        ]
+
+    def test_status_health_zero_steady_write(self):
+        fake, rec, tl, slo, clock = make_env()
+        rec.reconcile(POLICY)
+        rec.reconcile(POLICY)   # absorb trailing journal records
+        cr = fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+        health = cr["status"]["health"]
+        assert health["readinessRatio"] == 1.0
+        assert health["objective"] == 0.99
+        assert health["transitionsTotal"] == tl.appended(POLICY)
+        writes_before = {
+            k: v for k, v in fake.request_counts.items()
+            if k[0] in ("create", "update", "patch", "apply")
+        }
+        for _ in range(4):
+            rec.reconcile(POLICY)
+        writes_after = {
+            k: v for k, v in fake.request_counts.items()
+            if k[0] in ("create", "update", "patch", "apply")
+        }
+        assert writes_before == writes_after
+
+    def test_cr_delete_forgets_journal_and_slo(self):
+        fake, rec, tl, slo, clock = make_env()
+        m = rec.metrics
+        assert tl.appended(POLICY) > 0
+        fake.delete(API_VERSION, "NetworkClusterPolicy", POLICY)
+        rec.reconcile(POLICY)
+        assert tl.snapshot(policy=POLICY) == []
+        assert slo.health_status(POLICY) is None
+        rendered = m.render()
+        assert "tpunet_slo_readiness_ratio" not in rendered
+        assert "tpunet_timeline_bytes" not in rendered
+
+    def test_without_timeline_behavior_unchanged(self):
+        """The seams default to None: a reconciler without the journal
+        runs exactly the pre-flight-recorder code paths."""
+        p = NetworkClusterPolicy()
+        p.metadata.name = POLICY
+        p.spec.configuration_type = "tpu-so"
+        p.spec.node_selector = {"tpunet.dev/pool": POLICY}
+        fake = FakeCluster()
+        fake.create(default_policy(p).to_dict())
+        fake.add_node("node-000", {"tpunet.dev/pool": POLICY})
+        rec = NetworkClusterPolicyReconciler(fake, NAMESPACE)
+        rec.setup()
+        rec.reconcile(POLICY)
+        fake.simulate_daemonset_controller()
+        rec.reconcile(POLICY)
+        cr = fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+        assert "health" not in cr["status"]
+
+
+# -- tools/why.py --------------------------------------------------------------
+
+
+class TestWhy:
+    def _records(self):
+        clock = [1000.0]
+        tl = Timeline(clock=lambda: clock[0])
+        tl.record(POLICY, tl_mod.KIND_READINESS, node="n1", frm="",
+                  to="ready")
+        clock[0] = 1100.0
+        tl.record(POLICY, tl_mod.KIND_READINESS, node="n1",
+                  frm="ready", to="not-ready", detail="link down",
+                  trace_id="ab" * 16)
+        tl.record(POLICY, tl_mod.KIND_PROBE, node="n1",
+                  frm="Reachable", to="Degraded")
+        tl.record(POLICY, tl_mod.KIND_REMEDIATION, node="n1",
+                  frm="probe", to="re-probe",
+                  reason="RemediationStarted",
+                  directive_id="n1/probe/r0a1-1")
+        tl.record(POLICY, tl_mod.KIND_CONDITION,
+                  frm="False", to="True", reason="BelowQuorum",
+                  detail="DataplaneDegraded")
+        return tl
+
+    def test_explain_narrates_chain(self):
+        why = why_mod
+        tl = self._records()
+        out = why.explain("n1", tl.snapshot(), policy=POLICY)
+        assert f"why n1 (policy {POLICY})" in out
+        assert "not-ready" in out
+        assert "probe Degraded" in out
+        assert "ready -> not-ready" in out
+        assert "Reachable -> Degraded" in out
+        assert "probe -> re-probe" in out
+        assert "directive n1/probe/r0a1-1" in out
+        assert "link down" in out
+        # policy-scope context rides along, marked as such
+        assert "[policy]" in out and "DataplaneDegraded" in out
+        # newest first: seq 4 (the remediation fire) is narrated
+        # before seq 1 (the node's first readiness record)
+        assert out.index("[   4]") < out.index("[   1]")
+
+    def test_explain_resolves_trace_and_ledger(self):
+        why = why_mod
+        from tpu_network_operator.remediation import Ledger
+
+        tl = self._records()
+        ledger = Ledger()
+        ledger.issue("n1", "probe", "re-probe", "", 1100.0, 0, 0)
+        spans = [{
+            "traceId": "ab" * 16, "spanId": "cd" * 8, "parentId": "",
+            "name": "controller.reconcile", "durationMs": 3.2,
+        }]
+        out = why.explain("n1", tl.snapshot(), policy=POLICY,
+                          spans=spans, ledger=ledger)
+        assert "ledger[probe]: rung 0, attempt 1, outcome pending" \
+            in out
+        assert "controller.reconcile" in out
+
+    def test_explain_empty_history(self):
+        why = why_mod
+        out = why.explain("ghost", [], policy=POLICY)
+        assert "no journaled transitions" in out
+
+    def test_cli_against_fake_cluster(self, capsys):
+        why = why_mod
+        fake, rec, tl, slo, clock = make_env(remediation=True)
+        fake.apply(rpt.lease_for(
+            fleet_report("node-000", 0, 4, bad=True), NAMESPACE
+        ))
+        rec.reconcile(POLICY)
+        rc = why.main(
+            ["node-000", "--policy", POLICY,
+             "--namespace", NAMESPACE],
+            client=fake, timeline=tl,
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "why node-000" in out
+        assert "ready -> not-ready" in out
+        assert "Reachable -> Degraded" in out
+
+
+# -- support bundle ------------------------------------------------------------
+
+
+class TestDiagBundle:
+    def test_bundle_contains_timeline_and_slo(self, tmp_path):
+        diag = diag_mod
+        fake, rec, tl, slo, clock = make_env()
+        out = tmp_path / "bundle.tar.gz"
+        members = diag.collect_bundle(
+            fake, NAMESPACE, str(out), timeline=tl, slo=slo,
+        )
+        assert "timeline.json" in members
+        assert "slo.json" in members
+        with tarfile.open(out) as tar:
+            timeline = json.load(tar.extractfile("timeline.json"))
+            slo_doc = json.load(tar.extractfile("slo.json"))
+            manifest = json.load(tar.extractfile("manifest.json"))
+        assert timeline["total"] == len(tl)
+        assert timeline["records"]
+        assert POLICY in slo_doc["policies"]
+        assert slo_doc["policies"][POLICY]["readinessRatio"] == 1.0
+        assert "timeline.json" in manifest["files"]
+
+    def test_bundle_redacts_timeline_details(self, tmp_path):
+        diag = diag_mod
+        tl = Timeline()
+        tl.record(POLICY, tl_mod.KIND_READINESS, node="n1",
+                  frm="ready", to="not-ready",
+                  detail="auth failed: Bearer sk-meta-XYZ12345")
+        out = tmp_path / "bundle.tar.gz"
+        diag.collect_bundle(
+            FakeCluster(), NAMESPACE, str(out), timeline=tl,
+        )
+        with tarfile.open(out) as tar:
+            body = tar.extractfile("timeline.json").read().decode()
+        assert "XYZ12345" not in body
+        assert "**REDACTED**" in body

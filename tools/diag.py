@@ -97,6 +97,8 @@ def collect_files(
     namespace: str,
     metrics_text: str = "",
     traces_json: str = "",
+    timeline_json: str = "",
+    slo_json: str = "",
 ) -> Dict[str, str]:
     """Gather every bundle member as {relative path: content}.  Each
     section is best-effort: a forbidden or failing list yields an
@@ -115,9 +117,18 @@ def collect_files(
         except Exception as e:   # noqa: BLE001 — partial bundle > no bundle
             errors[name] = f"{type(e).__name__}: {e}"
 
+    derived_slo: Dict[str, Any] = {}
+
     def policies():
         items = client.list(t.API_VERSION, t.NetworkClusterPolicy.KIND)
         files["policies.json"] = _jdump(redact(items))
+        # the CR status carries the SLO engine's bounded rollup — a
+        # live collection (no in-process engine) still gets slo.json
+        for item in items:
+            name = (item.get("metadata", {}) or {}).get("name", "")
+            health = (item.get("status", {}) or {}).get("health")
+            if name and isinstance(health, dict):
+                derived_slo[name] = health
 
     def events():
         items = client.list("v1", "Event", namespace=namespace)
@@ -187,6 +198,23 @@ def collect_files(
             traces_json = BEARER_RE.sub(r"\1" + REDACTED, traces_json)
         files["traces.json"] = traces_json if traces_json.endswith("\n") \
             else traces_json + "\n"
+    # the fleet timeline journal + SLO summary get the deep-redaction
+    # guarantee too: record details can quote agent error strings,
+    # which can embed anything.  An in-process engine's summary wins;
+    # otherwise the rollups embedded in the CR statuses stand in.
+    if not slo_json and derived_slo:
+        slo_json = json.dumps({
+            "source": "status.health", "policies": derived_slo,
+        })
+    for name, body in (("timeline.json", timeline_json),
+                       ("slo.json", slo_json)):
+        if not body:
+            continue
+        try:
+            body = _jdump(redact(json.loads(body))).rstrip("\n")
+        except ValueError:
+            body = BEARER_RE.sub(r"\1" + REDACTED, body)
+        files[name] = body if body.endswith("\n") else body + "\n"
     if errors:
         files["errors.json"] = _jdump(errors)
 
@@ -224,12 +252,17 @@ def collect_bundle(
     out_path: str,
     metrics=None,
     tracer=None,
+    timeline=None,
+    slo=None,
     metrics_text: str = "",
     traces_json: str = "",
+    timeline_json: str = "",
+    slo_json: str = "",
 ) -> List[str]:
-    """One-call collection: accepts live ``metrics``/``tracer`` objects
-    (in-process use and tests) or pre-fetched endpoint bodies (the CLI).
-    Returns the bundle's member names."""
+    """One-call collection: accepts live ``metrics``/``tracer``/
+    ``timeline``/``slo`` objects (in-process use and tests) or
+    pre-fetched endpoint bodies (the CLI).  Returns the bundle's member
+    names."""
     if metrics is not None and not metrics_text:
         metrics_text = metrics.render()
     if tracer is not None and not traces_json:
@@ -237,9 +270,19 @@ def collect_bundle(
             "spans": tracer.snapshot(),
             "traceIds": tracer.trace_ids(),
         })
+    if timeline is not None and not timeline_json:
+        timeline_json = json.dumps({
+            "records": timeline.snapshot(),
+            "total": len(timeline),
+            "dropped": timeline.dropped(),
+            "policies": timeline.policies(),
+        })
+    if slo is not None and not slo_json:
+        slo_json = json.dumps(slo.summary())
     files = collect_files(
         client, namespace,
         metrics_text=metrics_text, traces_json=traces_json,
+        timeline_json=timeline_json, slo_json=slo_json,
     )
     write_bundle(files, out_path)
     return sorted(files)
@@ -269,6 +312,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="operator /metrics endpoint to snapshot")
     ap.add_argument("--traces-url", default="",
                     help="operator /debug/traces endpoint to snapshot")
+    ap.add_argument("--timeline-url", default="",
+                    help="operator /debug/timeline endpoint to snapshot")
     ap.add_argument("--token-env", default="TPUNET_KUBE_TOKEN",
                     help="env var holding the bearer token for the "
                          "endpoints above (never passed on argv)")
@@ -284,27 +329,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         client = ApiClient.in_cluster()
 
-    metrics_text = traces_json = ""
+    bodies = {"metrics_text": "", "traces_json": "", "timeline_json": ""}
     for url, attr in ((args.metrics_url, "metrics_text"),
-                      (args.traces_url, "traces_json")):
+                      (args.traces_url, "traces_json"),
+                      (args.timeline_url, "timeline_json")):
         if not url:
             continue
         try:
-            body = _http_get(url, token)
+            bodies[attr] = _http_get(url, token)
         except Exception as e:   # noqa: BLE001 — partial bundle > none
             print(f"warning: fetch {url} failed: {e}", file=sys.stderr)
-            continue
-        if attr == "metrics_text":
-            metrics_text = body
-        else:
-            traces_json = body
 
     out = args.out or time.strftime(
         "tpunet-diag-%Y%m%d-%H%M%S.tar.gz", time.gmtime()
     )
     members = collect_bundle(
         client, args.namespace, out,
-        metrics_text=metrics_text, traces_json=traces_json,
+        metrics_text=bodies["metrics_text"],
+        traces_json=bodies["traces_json"],
+        timeline_json=bodies["timeline_json"],
     )
     print(f"wrote {out} ({len(members)} files)")
     for m in members:

@@ -31,6 +31,17 @@ AST checker covering the highest-signal subset:
         handlers catching specific subclasses (NotFoundError,
         ConflictError, ...), and per-item fan-out over a collection
         (`for item in batch`) are NOT retry policy and stay allowed.
+  M001  metric family registered via health.Metrics without a
+        METRIC_HELP entry (controller/health.py).  Scrapers warn on
+        TYPE without HELP and the table was previously maintained by
+        convention only; the rule makes it enforced.  A "registration"
+        is a string literal starting with `tpunet_` passed as the
+        first argument to `.inc()`/`.set_gauge()`/`.observe()`/
+        `.remove_gauge()`/`.remove_matching()`, or an element of a
+        module-level tuple/list whose members are ALL such names (the
+        POLICY_GAUGES-style family lists the retraction sweeps drive).
+        Scoped to the package — tests/tools assert on names the
+        package must already register.
 
 Zero third-party dependencies; exits 1 on any finding.  Run as
 `python tools/lint.py [paths...]` (defaults to the package, tests, tools
@@ -227,7 +238,8 @@ def _arg_names(args: ast.arguments) -> Set[str]:
 
 
 class Checker:
-    def __init__(self, path: str, tree: ast.Module, source: str):
+    def __init__(self, path: str, tree: ast.Module, source: str,
+                 metric_help: Optional[Set[str]] = None):
         self.path = path
         self.tree = tree
         self.source = source
@@ -242,6 +254,13 @@ class Checker:
         self.check_retry_loops = (
             "tpu_network_operator" in norm
             and not norm.endswith("kube/retry.py")
+        )
+        # M001 scope: package files only, and only when the caller
+        # resolved the METRIC_HELP table (None = rule off — ad-hoc
+        # single-file runs outside a repo checkout stay usable)
+        self.metric_help = metric_help
+        self.check_metric_help = (
+            metric_help is not None and "tpu_network_operator" in norm
         )
 
     def report(self, node, code, message):
@@ -266,6 +285,7 @@ class Checker:
         for node in ast.walk(self.tree):
             self._check_misc(node)
         self._check_retry_loops()
+        self._check_metric_families()
         return self.findings
 
     def _scope_of(self, kind: str, body, extra: Optional[Set[str]] = None):
@@ -502,6 +522,59 @@ class Checker:
 
         walk(self.tree, False)
 
+    # -- metric families without HELP (M001) ------------------------------------
+
+    # the Metrics registration surface: a tpunet_* literal in the first
+    # argument of any of these IS a family the registry will export
+    METRIC_METHODS = frozenset({
+        "inc", "set_gauge", "observe", "remove_gauge", "remove_matching",
+    })
+
+    def _check_metric_families(self):
+        if not self.check_metric_help:
+            return
+        seen: Set[str] = set()
+
+        def flag(name: str, node) -> None:
+            if name in self.metric_help or name in seen:
+                return
+            seen.add(name)
+            self.report(
+                node, "M001",
+                f"metric family '{name}' registered without a "
+                f"METRIC_HELP entry (controller/health.py)",
+            )
+
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in self.METRIC_METHODS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("tpunet_")
+            ):
+                flag(node.args[0].value, node)
+        # module-level family lists (POLICY_GAUGES-style): every
+        # element a tpunet_* literal — driven through loops, so the
+        # call-site shape above never sees the names
+        for stmt in self.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            value = stmt.value
+            if not isinstance(value, (ast.Tuple, ast.List)):
+                continue
+            elts = value.elts
+            if elts and all(
+                isinstance(e, ast.Constant)
+                and isinstance(e.value, str)
+                and e.value.startswith("tpunet_")
+                for e in elts
+            ):
+                for e in elts:
+                    flag(e.value, stmt)
+
     # -- misc single-node checks ----------------------------------------------
 
     def _check_misc(self, node):
@@ -552,14 +625,53 @@ class Checker:
             )
 
 
-def lint_file(path: str) -> List[Finding]:
+def load_metric_help(path: str = "") -> Optional[Set[str]]:
+    """The METRIC_HELP table's keys, parsed from health.py's AST (the
+    linter never imports the package).  The default path is anchored
+    to THIS file's repo checkout, not the CWD — `python /repo/tools/
+    lint.py` from anywhere must not silently switch M001 off.  None
+    when the module (or the table) cannot be found."""
+    if not path:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tpu_network_operator", "controller", "health.py",
+        )
+    if not os.path.isfile(path):
+        return None
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except SyntaxError:
+        return None
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.Assign):
+            target = next(
+                (t.id for t in node.targets if isinstance(t, ast.Name)),
+                None,
+            )
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            target = node.target.id
+        if target == "METRIC_HELP" and isinstance(node.value, ast.Dict):
+            return {
+                k.value for k in node.value.keys
+                if isinstance(k, ast.Constant)
+                and isinstance(k.value, str)
+            }
+    return None
+
+
+def lint_file(
+    path: str, metric_help: Optional[Set[str]] = None
+) -> List[Finding]:
     with open(path, encoding="utf-8") as f:
         source = f.read()
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
         return [Finding(path, e.lineno or 0, "E999", f"syntax error: {e.msg}")]
-    return Checker(path, tree, source).run()
+    return Checker(path, tree, source, metric_help=metric_help).run()
 
 
 def iter_py_files(targets):
@@ -577,11 +689,12 @@ def iter_py_files(targets):
 
 def main(argv=None) -> int:
     targets = (argv or sys.argv[1:]) or DEFAULT_TARGETS
+    metric_help = load_metric_help()
     findings: List[Finding] = []
     n = 0
     for path in iter_py_files(targets):
         n += 1
-        findings.extend(lint_file(path))
+        findings.extend(lint_file(path, metric_help=metric_help))
     for f in findings:
         print(f)
     print(f"lint: {n} files, {len(findings)} finding(s)", file=sys.stderr)

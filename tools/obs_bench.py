@@ -12,6 +12,22 @@ Two measurements gate the obs/ layer (perf_session phase 10):
    ALTERNATE between the two managers so clock drift / CPU frequency
    wander cancels instead of biasing one side.
 
+   The measurement is deterministic by construction, not by retry
+   (tests/test_bench.py used to re-run the whole bench up to 5 times
+   when host noise blew the budget — observed 0.4-3.8% spread):
+
+   * the clock is **injectable** and defaults to ``time.thread_time``
+     — per-thread CPU time, blind to scheduler preemption, co-running
+     suites and GC in other processes, the dominant noise sources at
+     this ~10µs-signal-on-~ms-base scale (``--timer wall`` restores
+     the wall clock for cross-checking);
+   * each (mode, policy) pair is measured as its **pinned-iteration
+     minimum** across all rounds — the same policy is reconciled every
+     round, and the min over rounds is the classic timeit estimator of
+     the true cost (noise is strictly additive);
+   * the headline is the median of the per-policy paired differences
+     of those minimums.
+
 2. **Event dedup** — N identical DataplaneDegraded flips through the
    EventRecorder must collapse into ONE aggregated v1 Event whose
    ``count`` is N (client-go correlator semantics): a flapping fabric
@@ -97,17 +113,19 @@ def warm(mgr, fake, names):
         mgr.drain(max_iters=10_000)
 
 
-def measure_round(mgr, names):
-    """One timed round: reconcile every policy once, per-item latency."""
+def measure_round(mgr, names, timer):
+    """One timed round: reconcile every policy once, per-item latency
+    on the injected clock."""
     out = []
     for name in names:
-        t0 = time.perf_counter()
+        t0 = timer()
         mgr._reconcile_one(name)
-        out.append((time.perf_counter() - t0) * 1e3)
+        out.append((timer() - t0) * 1e3)
     return out
 
 
-def bench_overhead(n_policies: int, n_nodes: int, rounds: int):
+def bench_overhead(n_policies: int, n_nodes: int, rounds: int,
+                   timer=time.thread_time, timer_name="thread"):
     names = [f"pol-{i:03d}" for i in range(n_policies)]
     managers = {}
     for instrumented in (False, True):
@@ -119,11 +137,16 @@ def bench_overhead(n_policies: int, n_nodes: int, rounds: int):
         warm(mgr, fake, names)
         managers[instrumented] = (mgr, tracer)
 
-    lat = {False: [], True: []}
-    diffs = []
-    # GC pauses during the deepcopy-heavy reconciles are the dominant
-    # noise source at this measurement scale (~10us true signal on a
-    # ~ms base); keep collection out of the timed region
+    # per-(mode, policy) pinned-iteration minimum across rounds: the
+    # same policy reconciles every round, so the min over rounds is
+    # the noise-free cost estimate (timing noise is strictly additive)
+    best = {
+        False: [float("inf")] * n_policies,
+        True: [float("inf")] * n_policies,
+    }
+    # GC pauses during the deepcopy-heavy reconciles are in-process
+    # noise even on the CPU clock; keep collection out of the timed
+    # region
     import gc
 
     gc.collect()
@@ -132,34 +155,35 @@ def bench_overhead(n_policies: int, n_nodes: int, rounds: int):
         # alternate the order within the pair each round so neither
         # side always runs on a freshly-warmed cache line budget
         order = (False, True) if r % 2 == 0 else (True, False)
-        round_lat = {}
         for instrumented in order:
-            round_lat[instrumented] = measure_round(
-                managers[instrumented][0], names
+            round_lat = measure_round(
+                managers[instrumented][0], names, timer
             )
-            lat[instrumented].extend(round_lat[instrumented])
-        # pair item k of one mode with item k of the other, adjacent in
-        # time within the round: the median of paired differences is
-        # robust to load spikes from the host (a co-running test suite,
-        # a GC pause) that a plain p50-vs-p50 comparison soaks up as
-        # phantom overhead
-        diffs.extend(
-            on - off
-            for on, off in zip(round_lat[True], round_lat[False])
-        )
-
+            best[instrumented] = [
+                min(b, v) for b, v in zip(best[instrumented], round_lat)
+            ]
     gc.enable()
     spans_recorded = len(managers[True][1])
-    p50_off = statistics.median(lat[False])
-    p50_on = statistics.median(lat[True])
-    q_off = statistics.quantiles(lat[False], n=20)
-    q_on = statistics.quantiles(lat[True], n=20)
+    p50_off = statistics.median(best[False])
+    p50_on = statistics.median(best[True])
+
+    def p95(vals):
+        # quantiles() needs >= 2 points; a --policies 1 run degrades
+        # to its single minimum instead of crashing
+        if len(vals) < 2:
+            return vals[0]
+        return statistics.quantiles(vals, n=20)[18]
+    # pair policy k's minimum in one mode with the same policy's in the
+    # other: same spec, same lease population, same code path — the
+    # median paired difference is the overhead
+    diffs = [on - off for on, off in zip(best[True], best[False])]
     return {
-        "reconciles_per_mode": len(lat[True]),
+        "reconciles_per_mode": n_policies * rounds,
+        "timer": timer_name,
         "p50_off_ms": round(p50_off, 4),
         "p50_on_ms": round(p50_on, 4),
-        "p95_off_ms": round(q_off[18], 4),
-        "p95_on_ms": round(q_on[18], 4),
+        "p95_off_ms": round(p95(best[False]), 4),
+        "p95_on_ms": round(p95(best[True]), 4),
         # headline overhead: median paired difference over p50
         "overhead_pct": round(
             statistics.median(diffs) / p50_off * 100.0, 3
@@ -204,14 +228,22 @@ def main() -> int:
     ap.add_argument("--rounds", type=int, default=30)
     ap.add_argument("--flips", type=int, default=50,
                     help="identical condition flips for the dedup proof")
+    ap.add_argument("--timer", default="thread",
+                    choices=("thread", "wall"),
+                    help="latency clock: thread = per-thread CPU time "
+                         "(deterministic under host load, the default), "
+                         "wall = perf_counter")
     ap.add_argument("--out", default="",
                     help="also write the JSON artifact to this path")
     args = ap.parse_args()
 
+    timer = time.thread_time if args.timer == "thread" \
+        else time.perf_counter
     t0 = time.perf_counter()
     log(f"== tracing overhead: {args.policies} policies x {args.nodes} "
-        f"leases, {args.rounds} alternating rounds")
-    overhead = bench_overhead(args.policies, args.nodes, args.rounds)
+        f"leases, {args.rounds} alternating rounds ({args.timer} clock)")
+    overhead = bench_overhead(args.policies, args.nodes, args.rounds,
+                              timer=timer, timer_name=args.timer)
     log(f"   -> p50 {overhead['p50_off_ms']}ms off / "
         f"{overhead['p50_on_ms']}ms on "
         f"({overhead['overhead_pct']}% overhead)")

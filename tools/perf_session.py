@@ -195,6 +195,15 @@ def main() -> int:
         maybe_run_phase(out, "remediation-bench",
                   [py, "tools/remediation_bench.py",
                    "--out", "BENCH_remediation.json"], timeout=600)
+        # 16. fleet flight recorder: the 10k-node steady/churn sweep
+        # with the transition journal + SLO engine wired (steady pass
+        # appends 0 records and stays inside the BENCH_scale gate), a
+        # FakeFabric link-flap whose causal chain tools/why.py
+        # reconstructs exactly, and a byte-budget soak (journal never
+        # exceeds its ring budget; no TPU, in-process)
+        maybe_run_phase(out, "timeline-bench",
+                  [py, "tools/timeline_bench.py",
+                   "--out", "BENCH_timeline.json"], timeout=900)
     print(f"done -> {args.out}")
     return 0
 

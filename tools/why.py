@@ -1,0 +1,365 @@
+#!/usr/bin/env python3
+"""``why <node>`` — one narrative answer to "why is this node in its
+current state, and when did that start?".
+
+Walks the causal chain backwards through the observability surfaces the
+operator already maintains:
+
+1. the **fleet timeline journal** (``/debug/timeline``, obs/timeline.py)
+   — the node's state transitions, newest first, each carrying cause
+   references;
+2. the **stitched trace** (``/debug/traces``) — the reconcile/provision
+   spans a transition's trace ID points at;
+3. the **remediation ledger** (``tpunet-remediation-<policy>``
+   ConfigMap) — rung/attempt/outcome detail behind a directive ID;
+4. the **CR status** — the probe/telemetry verdict the story must end
+   on.
+
+Runs against a live apiserver + operator endpoints (HTTP fetch with a
+bearer token) or fully in-process against a FakeCluster + Timeline —
+which is how tests and ``tools/timeline_bench.py`` verify the
+reconstruction is exact.
+
+Usage:
+    python tools/why.py NODE [--policy P] [--kube-api URL]
+        [--timeline-url http://...:8443/debug/timeline]
+        [--traces-url http://...:8443/debug/traces]
+        [--token-env TPUNET_KUBE_TOKEN] [--max 50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+
+def _ts(ts: float) -> str:
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime(ts))
+
+
+def _cause_bits(rec: Dict[str, Any]) -> List[str]:
+    cause = rec.get("cause", {}) or {}
+    bits = []
+    if cause.get("reason"):
+        bits.append(cause["reason"])
+    if cause.get("directiveId"):
+        bits.append(f"directive {cause['directiveId']}")
+    if cause.get("traceId"):
+        bits.append(f"trace {cause['traceId'][:8]}…")
+    return bits
+
+
+def current_state(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold a node's records (oldest-first) into its current state:
+    the latest readiness/probe verdicts, the telemetry anomalies still
+    open, and the last remediation step."""
+    state: Dict[str, Any] = {
+        "readiness": "", "readiness_since": 0.0,
+        "probe": "", "probe_since": 0.0,
+        "anomalies": {},        # iface -> detail
+        "remediation": "", "remediation_since": 0.0,
+    }
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "readiness":
+            state["readiness"] = rec.get("to", "")
+            state["readiness_since"] = rec.get("ts", 0.0)
+        elif kind == "probe":
+            state["probe"] = rec.get("to", "")
+            state["probe_since"] = rec.get("ts", 0.0)
+        elif kind == "telemetry":
+            iface = str(rec.get("detail", "")).split(":", 1)[0]
+            if rec.get("to") == "anomalous":
+                state["anomalies"][iface] = rec.get("detail", "")
+            else:
+                state["anomalies"].pop(iface, None)
+        elif kind == "remediation":
+            state["remediation"] = (
+                f"{rec.get('from', '')} -> {rec.get('to', '')}"
+            )
+            state["remediation_since"] = rec.get("ts", 0.0)
+    return state
+
+
+def _ledger_line(ledger, directive_id: str) -> str:
+    """Rung/attempt/outcome detail for a directive, from the ledger."""
+    if ledger is None or not directive_id:
+        return ""
+    for key in sorted(ledger.entries):
+        entry = ledger.entries[key]
+        if entry.last_directive_id != directive_id:
+            continue
+        node, _, cls = key.partition("|")
+        return (
+            f"ledger[{cls}]: rung {entry.rung}, attempt "
+            f"{entry.attempts}, outcome {entry.outcome or 'pending'}"
+            + (f" ({entry.outcome_error})" if entry.outcome_error
+               else "")
+            + (", ladder exhausted" if entry.exhausted else "")
+        )
+    return ""
+
+
+def _trace_line(spans_by_trace, trace_id: str) -> str:
+    """One-line summary of the stitched trace behind a transition."""
+    spans = (spans_by_trace or {}).get(trace_id)
+    if not spans:
+        return ""
+    root = next(
+        (s for s in spans if not s.get("parentId")), spans[0]
+    )
+    total = root.get("durationMs")
+    return (
+        f"trace {trace_id[:8]}…: {len(spans)} span(s), root "
+        f"{root.get('name', '?')}"
+        + (f" {total:.1f}ms" if isinstance(total, (int, float)) else "")
+    )
+
+
+def explain(
+    node: str,
+    records: List[Dict[str, Any]],
+    policy: str = "",
+    spans: Optional[List[Dict[str, Any]]] = None,
+    ledger=None,
+    status: Optional[Dict[str, Any]] = None,
+    limit: int = 50,
+) -> str:
+    """Build the narrative: current state, then the node's transition
+    history newest-first with cause references resolved through the
+    ledger and the stitched traces.  ``records`` is a /debug/timeline
+    snapshot (any filtering; node + policy-scope records are used)."""
+    records = sorted(records, key=lambda r: r.get("seq", 0))
+    # an explicit policy scopes the node's OWN records too: a node
+    # moved between pools has history under both policies, and the
+    # live endpoint hands over the unfiltered journal
+    mine = [
+        r for r in records
+        if r.get("node") == node
+        and (not policy or r.get("policy") == policy)
+    ]
+    # the narrated policy: explicit, else inferred from the node's own
+    # records — and the context filter below uses THIS, so a
+    # multi-policy journal never interleaves other policies'
+    # [policy]-scope flips into this node's story
+    pol = policy or (mine[-1]["policy"] if mine else "")
+    # policy-scope context records (condition/state/plan flips) that
+    # frame the node's story
+    context = [
+        r for r in records
+        if not r.get("node")
+        and (not pol or r.get("policy") == pol)
+    ]
+    spans_by_trace: Dict[str, List] = {}
+    for span in spans or []:
+        tid = span.get("traceId", "")
+        if tid:
+            spans_by_trace.setdefault(tid, []).append(span)
+
+    lines: List[str] = []
+    lines.append(f"why {node}" + (f" (policy {pol})" if pol else ""))
+    if not mine:
+        lines.append(
+            "  no journaled transitions for this node — either the "
+            "node is steady since the operator started, or the journal "
+            "evicted its history (check /debug/timeline dropped count)"
+        )
+        return "\n".join(lines)
+
+    st = current_state(mine)
+    verdict = []
+    if st["readiness"]:
+        verdict.append(
+            f"{st['readiness']} since {_ts(st['readiness_since'])}"
+        )
+    if st["probe"]:
+        verdict.append(
+            f"probe {st['probe']} since {_ts(st['probe_since'])}"
+        )
+    if st["anomalies"]:
+        verdict.append(
+            "open anomalies: "
+            + "; ".join(sorted(st["anomalies"].values()))
+        )
+    if st["remediation"]:
+        verdict.append(f"remediation {st['remediation']}")
+    lines.append("  current: " + ("; ".join(verdict) or "steady"))
+    if status:
+        probe_rows = {
+            r.get("node"): r.get("state")
+            for r in status.get("probeNodes", []) or []
+        }
+        if node in probe_rows:
+            lines.append(
+                f"  status.probeNodes verdict: {probe_rows[node]}"
+            )
+
+    lines.append("  causal chain (newest first):")
+    chain = sorted(
+        mine + context, key=lambda r: r.get("seq", 0), reverse=True,
+    )[:max(limit, 1)]
+    for rec in chain:
+        scope = "" if rec.get("node") else " [policy]"
+        frm = rec.get("from", "")
+        arrow = f"{frm} -> {rec.get('to', '')}" if frm \
+            else rec.get("to", "")
+        line = (
+            f"    [{rec.get('seq', 0):>4}] {_ts(rec.get('ts', 0.0))} "
+            f"{rec.get('kind', '?')}{scope}: {arrow}"
+        )
+        if rec.get("detail"):
+            line += f" — {rec['detail']}"
+        bits = _cause_bits(rec)
+        if bits:
+            line += f" ({', '.join(bits)})"
+        lines.append(line)
+        cause = rec.get("cause", {}) or {}
+        ledger_line = _ledger_line(ledger, cause.get("directiveId", ""))
+        if ledger_line:
+            lines.append(f"          {ledger_line}")
+        trace_line = _trace_line(spans_by_trace, cause.get("traceId", ""))
+        if trace_line:
+            lines.append(f"          {trace_line}")
+    return "\n".join(lines)
+
+
+# -- data sources --------------------------------------------------------------
+
+
+# the bearer-authenticated endpoint fetch lives in tools/diag.py (one
+# implementation for every operator-endpoint consumer)
+from diag import _http_get   # noqa: E402
+
+
+def _find_policy(client, namespace: str, node: str) -> str:
+    """Which policy's journal holds the node: the report Lease's policy
+    label is authoritative (the agent stamps it)."""
+    from tpu_network_operator.agent import report as rpt
+
+    try:
+        leases = client.list(
+            rpt.LEASE_API, "Lease", namespace=namespace,
+            label_selector={rpt.AGENT_LABEL: "true"},
+        )
+    except Exception:   # noqa: BLE001 — policy stays unknown
+        return ""
+    for lease in leases:
+        meta = lease.get("metadata", {}) or {}
+        if meta.get("name") == rpt.lease_name(node):
+            return (meta.get("labels", {}) or {}).get(
+                rpt.POLICY_LABEL, ""
+            )
+    return ""
+
+
+def _fetch_ledger(client, namespace: str, policy: str):
+    from tpu_network_operator.agent import report as rpt
+    from tpu_network_operator.remediation import Ledger
+
+    try:
+        cm = client.get(
+            "v1", "ConfigMap",
+            rpt.remediation_configmap_name(policy), namespace,
+        )
+        return Ledger.from_json(
+            (cm.get("data", {}) or {}).get(rpt.LEDGER_KEY, "")
+        )
+    except Exception:   # noqa: BLE001 — chain renders without it
+        return None
+
+
+def main(
+    argv: Optional[List[str]] = None,
+    client=None,
+    timeline=None,
+    tracer=None,
+) -> int:
+    """CLI entry.  ``client``/``timeline``/``tracer`` are in-process
+    seams: tests and benches pass a FakeCluster + live Timeline/Tracer
+    and skip all HTTP."""
+    ap = argparse.ArgumentParser(
+        prog="tpunet-why",
+        description="explain a node's health history causally",
+    )
+    ap.add_argument("node")
+    ap.add_argument("--policy", default="")
+    ap.add_argument("--namespace",
+                    default=os.environ.get("OPERATOR_NAMESPACE",
+                                           "default"))
+    ap.add_argument("--kube-api",
+                    default=os.environ.get("TPUNET_KUBE_URL", ""))
+    ap.add_argument("--timeline-url", default="",
+                    help="operator /debug/timeline endpoint")
+    ap.add_argument("--traces-url", default="",
+                    help="operator /debug/traces endpoint")
+    ap.add_argument("--token-env", default="TPUNET_KUBE_TOKEN")
+    ap.add_argument("--max", type=int, default=50,
+                    help="newest transitions to narrate")
+    args = ap.parse_args(argv)
+    token = os.environ.get(args.token_env, "")
+
+    records: List[Dict[str, Any]] = []
+    spans: List[Dict[str, Any]] = []
+    if timeline is not None:
+        records = timeline.snapshot(policy=args.policy)
+    elif args.timeline_url:
+        try:
+            body = json.loads(_http_get(args.timeline_url, token))
+            records = body.get("records", [])
+        except Exception as e:   # noqa: BLE001 — explain what failed
+            print(f"error: fetch {args.timeline_url} failed: {e}",
+                  file=sys.stderr)
+            return 1
+    if tracer is not None:
+        spans = tracer.snapshot()
+    elif args.traces_url:
+        try:
+            spans = json.loads(
+                _http_get(args.traces_url, token)
+            ).get("spans", [])
+        except Exception as e:   # noqa: BLE001 — chain renders without
+            print(f"warning: fetch {args.traces_url} failed: {e}",
+                  file=sys.stderr)
+
+    ledger = None
+    status = None
+    if client is None and args.kube_api:
+        from tpu_network_operator.kube.client import ApiClient
+
+        client = ApiClient(args.kube_api, token=token or None)
+    if client is not None:
+        policy = args.policy or _find_policy(
+            client, args.namespace, args.node
+        )
+        if policy:
+            args.policy = policy
+            ledger = _fetch_ledger(client, args.namespace, policy)
+            try:
+                from tpu_network_operator.api.v1alpha1.types import (
+                    API_VERSION,
+                )
+
+                cr = client.get(
+                    API_VERSION, "NetworkClusterPolicy", policy
+                )
+                status = cr.get("status", {}) or {}
+            except Exception:   # noqa: BLE001 — chain renders without
+                pass
+
+    print(explain(
+        args.node, records, policy=args.policy, spans=spans,
+        ledger=ledger, status=status, limit=args.max,
+    ))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,7 @@ from .types import (  # noqa: F401
     CONDITION_DATAPLANE_DEGRADED,
     CONDITION_TELEMETRY_DEGRADED,
     GaudiScaleOutSpec,
+    HealthStatus,
     NodeProbeStatus,
     PolicyCondition,
     ProbeSpec,

@@ -585,6 +585,30 @@ def openapi_schema() -> Dict[str, Any]:
                             "actionsTotal": {"type": "integer"},
                         },
                     },
+                    "health": {
+                        "type": "object",
+                        "description": (
+                            "SLO rollup folded from the fleet timeline "
+                            "journal: readiness burn rates, fault-"
+                            "detection and remediation-convergence "
+                            "medians, fast-path hit ratio (the journal "
+                            "itself is served from /debug/timeline)."
+                        ),
+                        "properties": {
+                            "readinessRatio": {"type": "number"},
+                            "objective": {"type": "number"},
+                            "burnRateFast": {"type": "number"},
+                            "burnRateSlow": {"type": "number"},
+                            "faultDetectionP50Seconds": {
+                                "type": "number",
+                            },
+                            "remediationConvergenceP50Seconds": {
+                                "type": "number",
+                            },
+                            "fastPathRatio": {"type": "number"},
+                            "transitionsTotal": {"type": "integer"},
+                        },
+                    },
                     "summary": {
                         "type": "object",
                         "description": (

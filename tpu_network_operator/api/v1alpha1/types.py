@@ -503,6 +503,39 @@ class RemediationStatus:
 
 
 @dataclass
+class HealthStatus:
+    """Bounded SLO rollup folded from the fleet timeline journal
+    (obs/slo.py) — the at-a-glance answer to "is this policy inside its
+    error budget, and how fast do faults get caught and healed".  Every
+    field is derived from journal *edges*, so a steady fleet re-serializes
+    it byte-identically (the zero-steady-write contract holds)."""
+
+    # current ready/targets fraction (1.0 when there are no targets)
+    readiness_ratio: float = j("readinessRatio", 0.0)
+    # the readiness objective the burn rates are judged against
+    objective: float = j("objective", 0.0)
+    # error-budget burn over the fast (5 min) / slow (1 h) windows:
+    # mean(1 - ratio)/(1 - objective); 1.0 = burning exactly at the
+    # sustainable rate, above = an active incident
+    burn_rate_fast: float = j("burnRateFast", 0.0)
+    burn_rate_slow: float = j("burnRateSlow", 0.0)
+    # median seconds from fabric-fault evidence (probe verdict leaving
+    # Reachable) to the node's readiness retract
+    fault_detection_p50_seconds: float = j(
+        "faultDetectionP50Seconds", 0.0
+    )
+    # median seconds from anomaly open to full recovery, for episodes
+    # self-healing acted on
+    remediation_convergence_p50_seconds: float = j(
+        "remediationConvergenceP50Seconds", 0.0
+    )
+    # steady-pass fast-path exits over all reconcile passes
+    fast_path_ratio: float = j("fastPathRatio", 0.0)
+    # lifetime transition records journaled for this policy
+    transitions_total: int = j("transitionsTotal", 0)
+
+
+@dataclass
 class PolicyCondition:
     """metav1.Condition subset (the DataplaneDegraded carrier)."""
 
@@ -541,6 +574,9 @@ class NetworkClusterPolicyStatus:
     # self-healing remediation rollup (omit-empty: absent unless
     # remediation is enabled)
     remediation: Optional[RemediationStatus] = j("remediation", None)
+    # SLO rollup from the fleet timeline journal (omit-empty: absent
+    # unless the operator runs with the SLO engine wired)
+    health: Optional[HealthStatus] = j("health", None)
 
 
 @dataclass

@@ -124,6 +124,28 @@ METRIC_HELP: Dict[str, str] = {
     "tpunet_reconcile_fast_path_total":
         "Reconcile passes that exited via the steady-pass fast path "
         "(no deltas, no timer-due work — nothing re-derived).",
+    "tpunet_timeline_records_total":
+        "Transition records appended to the fleet timeline journal, "
+        "by policy and record kind.",
+    "tpunet_timeline_bytes":
+        "Current fleet-timeline journal size per policy (bounded by "
+        "the per-policy byte budget; oldest records evict first).",
+    "tpunet_slo_readiness_ratio":
+        "Current ready/target node fraction per policy (the readiness "
+        "SLO's service level indicator).",
+    "tpunet_slo_readiness_burn_rate":
+        "Readiness error-budget burn rate per policy and window "
+        "(mean(1-ratio)/(1-objective); 1.0 = burning exactly at the "
+        "sustainable rate).",
+    "tpunet_slo_fast_path_ratio":
+        "Steady-pass fast-path exits over all reconcile passes, per "
+        "policy.",
+    "tpunet_slo_fault_detection_seconds":
+        "Seconds from fabric-fault evidence (probe verdict leaving "
+        "Reachable) to the node's readiness retract, per episode.",
+    "tpunet_slo_remediation_convergence_seconds":
+        "Seconds from anomaly open to full recovery for episodes "
+        "self-healing acted on, per episode.",
 }
 
 
@@ -161,6 +183,16 @@ class Metrics:
         "tpunet_reconcile_status_phase_seconds": (
             0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
             0.25, 0.5, 1.0, 2.5,
+        ),
+        # SLO episode latencies run at probe-interval timescales and
+        # beyond (detection within a round, convergence across
+        # cooldown windows)
+        "tpunet_slo_fault_detection_seconds": (
+            0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+        ),
+        "tpunet_slo_remediation_convergence_seconds": (
+            1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1800.0,
+            3600.0,
         ),
     }
 
@@ -399,6 +431,7 @@ class HealthServer:
         metrics_auth: Optional[Callable[[str], bool]] = None,
         tls_cert_dir: Optional[str] = None,
         tracer=None,
+        timeline=None,
     ):
         """``metrics=None`` means NO /metrics endpoint on this server (the
         probe port must not leak the registry the secure port protects).
@@ -408,11 +441,15 @@ class HealthServer:
         ``tracer`` (an :class:`..obs.Tracer`) additionally serves the
         flight recorder as JSON from ``/debug/traces`` (same
         authenticator gate as /metrics: span attributes carry object
-        names the probe port must not leak)."""
+        names the probe port must not leak).  ``timeline`` (an
+        :class:`..obs.Timeline`) serves the fleet transition journal
+        from ``/debug/timeline`` behind the same gate, with
+        policy/node/kind/since/limit query filters."""
         self.checks: Dict[str, Callable[[], bool]] = {"ping": lambda: True}
         self.ready_checks: Dict[str, Callable[[], bool]] = {"ping": lambda: True}
         self.metrics = metrics
         self.tracer = tracer
+        self.timeline = timeline
         self._metrics_auth = metrics_auth
 
         outer = self
@@ -479,6 +516,41 @@ class HealthServer:
                         json.dumps({
                             "spans": spans,
                             "traceIds": outer.tracer.trace_ids(),
+                        }),
+                        "application/json",
+                    )
+                elif path == "/debug/timeline":
+                    if outer.timeline is None:
+                        self._respond(404, "timeline not served here")
+                        return
+                    if not self._authorized():
+                        self._respond(403, "forbidden")
+                        return
+                    q = urllib.parse.parse_qs(parsed.query)
+
+                    def _num(key, cast, default):
+                        # same degrade-to-default contract as the
+                        # /debug/traces limit: a bad value must not 500
+                        # a triage session
+                        try:
+                            return cast(q.get(key, [default])[0])
+                        except ValueError:
+                            return cast(default)
+
+                    records = outer.timeline.snapshot(
+                        policy=q.get("policy", [""])[0],
+                        node=q.get("node", [""])[0],
+                        kind=q.get("kind", [""])[0],
+                        since=_num("since", float, "0"),
+                        limit=_num("limit", int, "0"),
+                    )
+                    self._respond(
+                        200,
+                        json.dumps({
+                            "records": records,
+                            "total": len(outer.timeline),
+                            "dropped": outer.timeline.dropped(),
+                            "policies": outer.timeline.policies(),
                         }),
                         "application/json",
                     )

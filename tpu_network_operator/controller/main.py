@@ -27,7 +27,7 @@ from ..api.v1alpha1.types import API_VERSION, NetworkClusterPolicy
 from ..kube.client import ApiClient, is_openshift
 from ..kube.informer import CachedClient
 from ..kube.retry import RetryingClient
-from ..obs import EventRecorder, Tracer
+from ..obs import EventRecorder, SloEngine, Timeline, Tracer
 from ..obs import logging as obs_logging
 from .health import DEFAULT as METRICS, CachedTokenAuthenticator, HealthServer
 from .leader import LeaderElector
@@ -86,6 +86,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-buffer", type=int, default=1024,
                    help="flight-recorder capacity (spans) served from "
                         "/debug/traces")
+    p.add_argument("--timeline-buffer-bytes", type=int, default=262144,
+                   help="fleet-timeline journal byte budget PER POLICY "
+                        "(served from /debug/timeline; oldest records "
+                        "evict first; 0 = journal disabled; values "
+                        "1-4095 are raised to the 4096 floor)")
     p.add_argument("--report-cache-seconds", type=float, default=2.0,
                    help="agent-report Lease list cache window: one "
                         "namespace-wide list serves all policies' status "
@@ -183,11 +188,23 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
     recorder = EventRecorder(
         client, args.namespace, source="tpunet-operator", metrics=METRICS
     )
+    # fleet flight recorder + SLO engine: the reconciler journals state
+    # transitions at its existing edge-detection points (steady passes
+    # append nothing) and the engine folds them into tpunet_slo_*
+    # burn-rate metrics and the status.health rollup
+    timeline = slo = None
+    if args.timeline_buffer_bytes > 0:
+        timeline = Timeline(
+            policy_byte_budget=args.timeline_buffer_bytes,
+            metrics=METRICS,
+        )
+        slo = SloEngine(timeline, metrics=METRICS)
 
     mgr = Manager(cached, namespace=args.namespace, is_openshift=openshift,
                   metrics=METRICS,
                   concurrent_reconciles=args.concurrent_reconciles,
-                  tracer=tracer, events=recorder)
+                  tracer=tracer, events=recorder,
+                  timeline=timeline, slo=slo)
     mgr.reconciler.REPORT_CACHE_SECONDS = args.report_cache_seconds
     if args.peer_shard_byte_budget > 0:
         mgr.reconciler.PEER_SHARD_BYTE_BUDGET = args.peer_shard_byte_budget
@@ -221,13 +238,14 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
                     "--metrics-secure: no serving cert in %s; metrics "
                     "served over plain HTTP", args.webhook_cert_dir,
                 )
-        # the metrics listener also serves /debug/traces (same authn
-        # gate): span attributes carry object names the unauthenticated
-        # probe port must not leak
+        # the metrics listener also serves /debug/traces and
+        # /debug/timeline (same authn gate): span attributes and
+        # journal records carry object names the unauthenticated probe
+        # port must not leak
         servers.append(HealthServer(
             port=_port_of(args.metrics_bind_address),
             metrics=METRICS, metrics_auth=auth, tls_cert_dir=tls_dir,
-            tracer=tracer,
+            tracer=tracer, timeline=timeline,
         ))
 
     webhook_server = None

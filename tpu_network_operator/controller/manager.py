@@ -114,6 +114,7 @@ class Manager:
         self, client, namespace: str, is_openshift: bool = False,
         metrics=None, resync_interval: float = 60.0,
         concurrent_reconciles: int = 4, tracer=None, events=None,
+        timeline=None, slo=None,
     ):
         self.client = client
         self.namespace = namespace
@@ -129,7 +130,7 @@ class Manager:
         self.concurrent_reconciles = max(1, int(concurrent_reconciles))
         self.reconciler = NetworkClusterPolicyReconciler(
             client, namespace, is_openshift, metrics=metrics,
-            tracer=tracer, events=events,
+            tracer=tracer, events=events, timeline=timeline, slo=slo,
         )
         self._queue = WorkQueue(metrics=metrics)
         self._stop = threading.Event()

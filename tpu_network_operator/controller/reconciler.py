@@ -27,6 +27,7 @@ from ..api.v1alpha1.types import NetworkClusterPolicy
 from ..kube import errors as kerr
 from ..kube.informer import LIST_PAGE_SIZE   # noqa: F401 — re-exported
 from ..obs import events as obs_events
+from ..obs import timeline as obs_tl
 from ..obs.trace import TRACE_ANNOTATION, current_trace_id
 from ..planner import PlanTracker
 from ..planner import plan as planner_plan
@@ -408,18 +409,24 @@ class NetworkClusterPolicyReconciler:
 
     def __init__(
         self, client, namespace: str, is_openshift: bool = False,
-        metrics=None, tracer=None, events=None,
+        metrics=None, tracer=None, events=None, timeline=None, slo=None,
     ):
         self.client = client
         self.namespace = namespace
         self.is_openshift = is_openshift
         self.metrics = metrics
-        # observability seams (obs/): both optional — a reconciler
+        # observability seams (obs/): all optional — a reconciler
         # without them behaves exactly as before.  ``tracer`` also
         # stitches agent-reported provisioning spans into the flight
-        # recorder; ``events`` emits v1 Events on transitions.
+        # recorder; ``events`` emits v1 Events on transitions;
+        # ``timeline`` journals state transitions at the SAME edge-
+        # detection points the Events fire from (steady passes append
+        # zero records); ``slo`` folds that journal into burn-rate
+        # SLOs and the status.health rollup.
         self.tracer = tracer
         self.events = events
+        self.timeline = timeline
+        self.slo = slo
         self._reports_cache: Optional[Dict[str, List[Any]]] = None
         self._reports_cached_at = 0.0
         # concurrent workers share one reconciler instance; the bucket
@@ -651,10 +658,23 @@ class NetworkClusterPolicyReconciler:
             f"backoff): {message}",
         )
         before = am.to_dict(policy.status.conditions)
+        was_degraded = any(
+            c.get("type") == t.CONDITION_RECONCILE_DEGRADED
+            and c.get("status") == "True"
+            for c in before or []
+        )
         self._set_condition(
-            policy.status, t.CONDITION_RECONCILE_DEGRADED,
+            name, policy.status, t.CONDITION_RECONCILE_DEGRADED,
             "True", "PermanentError", message[:512],
         )
+        if self.timeline is not None and not was_degraded:
+            # the permanent-error OPEN edge (the close edge is the
+            # ReconcileRecovered record in the next good status pass)
+            self.timeline.record(
+                name, obs_tl.KIND_RECONCILE, frm="ok", to="degraded",
+                reason="ReconcileFailed", detail=message[:200],
+                trace_id=current_trace_id(),
+            )
         if am.to_dict(policy.status.conditions) == before:
             return   # identical condition already set: no status churn
         try:
@@ -1344,6 +1364,65 @@ class NetworkClusterPolicyReconciler:
             with self._probe_lock:
                 self._probe_failing.pop((pname, node), None)
 
+    @staticmethod
+    def _readiness_of(c: Optional[NodeContribution]) -> str:
+        return "ready" if c is not None and c.ok else "not-ready"
+
+    def _note_contribution_edges(
+        self, pname: str,
+        old: Optional[NodeContribution],
+        new: Optional[NodeContribution],
+    ) -> None:
+        """Journal the per-node transitions one contribution change
+        carries: readiness flips (report ok edges, including node
+        appear/depart) and per-interface telemetry anomaly open/close.
+        Lives at the delta pipeline's apply site, so a steady pass
+        journals nothing and a churn pass journals O(changed)."""
+        tl = self.timeline
+        if tl is None or (old is None and new is None):
+            return
+        node = (new if new is not None else old).node
+        trace_id = current_trace_id()
+        if new is None:
+            tl.record(
+                pname, obs_tl.KIND_READINESS, node=node,
+                frm=self._readiness_of(old), to="departed",
+                trace_id=trace_id,
+            )
+        elif old is None:
+            tl.record(
+                pname, obs_tl.KIND_READINESS, node=node, frm="",
+                to=self._readiness_of(new), trace_id=trace_id,
+                detail="" if new.ok else new.error,
+            )
+        elif old.ok != new.ok:
+            tl.record(
+                pname, obs_tl.KIND_READINESS, node=node,
+                frm=self._readiness_of(old), to=self._readiness_of(new),
+                trace_id=trace_id,
+                detail="" if new.ok else new.error,
+            )
+        old_ifaces = dict(old.t_anom_ifaces) if old is not None else {}
+        new_ifaces = dict(new.t_anom_ifaces) if new is not None else {}
+        if old_ifaces == new_ifaces:
+            return
+        for iface in sorted(new_ifaces):
+            if iface not in old_ifaces:
+                tl.record(
+                    pname, obs_tl.KIND_TELEMETRY, node=node,
+                    frm="nominal", to="anomalous",
+                    reason="CounterAnomalies", trace_id=trace_id,
+                    detail=f"{iface}: {new_ifaces[iface]}",
+                )
+        for iface in sorted(old_ifaces):
+            if iface not in new_ifaces:
+                tl.record(
+                    pname, obs_tl.KIND_TELEMETRY, node=node,
+                    frm="anomalous", to="nominal",
+                    reason="CountersNominal", trace_id=trace_id,
+                    detail=f"{iface}: {old_ifaces[iface]}",
+                )
+
     def _process_lease(
         self, pname: str, d: PolicyDerived, ps: PassState, store,
         lease_name: str, changed_rows: List[Tuple[str, str, str]],
@@ -1375,6 +1454,7 @@ class NetworkClusterPolicyReconciler:
         old = d.apply(lease_name, new)
         if old is None and new is None:
             return
+        self._note_contribution_edges(pname, old, new)
         was = old.probe_row.state if old and old.probe_row else ""
         now_state = new.probe_row.state if new and new.probe_row else ""
         if was != now_state:
@@ -1415,10 +1495,23 @@ class NetworkClusterPolicyReconciler:
                 pname, lease_name, "", rep, renewed, rpt=rpt, **ctx_args,
             )
             d.apply(lease_name, c)
+            if old_d is not None:
+                # journal per-node edges against the previous derived
+                # state; with no baseline (process start) the rebuild
+                # journals nothing — a restart must not fabricate a
+                # fleet-wide flood of phantom transitions
+                self._note_contribution_edges(
+                    pname, old_d.contribs.get(lease_name), c,
+                )
             if c.ok and renewed is not None:
                 heapq.heappush(ps.stale_heap, (
                     renewed + self.REPORT_TTL_SECONDS, lease_name,
                 ))
+        if old_d is not None:
+            for lease_name in sorted(set(old_d.contribs) - set(d.contribs)):
+                self._note_contribution_edges(
+                    pname, old_d.contribs[lease_name], None,
+                )
         for section in d.vers:
             d.vers[section] = (
                 (old_d.vers[section] if old_d else 0) + 1
@@ -1886,6 +1979,7 @@ class NetworkClusterPolicyReconciler:
         changed_rows: List[Tuple[str, str, str]],
         n_rows: int,
         degraded: List[str],
+        journal_rows: bool = True,
     ) -> None:
         """Events on dataplane transitions: DataplaneDegraded condition
         flips (against the PRE-pass condition snapshot) and per-node
@@ -1916,10 +2010,12 @@ class NetworkClusterPolicyReconciler:
             or PROBE_QUARANTINE_PASSES
         )
         for node, was, now_state in changed_rows:
+            reason = ""
             if (
                 now_state == t.PROBE_STATE_QUARANTINED
                 and was != t.PROBE_STATE_QUARANTINED
             ):
+                reason = "NodeQuarantined"
                 self._emit(
                     policy, obs_events.TYPE_WARNING, "NodeQuarantined",
                     f"node {node} degraded "
@@ -1931,10 +2027,27 @@ class NetworkClusterPolicyReconciler:
                 and now_state
                 and now_state != t.PROBE_STATE_QUARANTINED
             ):
+                reason = "NodeUnquarantined"
                 self._emit(
                     policy, obs_events.TYPE_NORMAL, "NodeUnquarantined",
                     f"node {node} reaches probe quorum again; "
                     f"quarantine lifted",
+                )
+            if self.timeline is not None and journal_rows:
+                # the journal keeps EVERY verdict change, not just the
+                # quarantine edges the Events narrate — detection
+                # latency is measured off the first Degraded record.
+                # journal_rows is False on a no-baseline rebuild
+                # (process start): the CR's bounded worst-K rows would
+                # diff nearly every node as "" -> <state>, flooding the
+                # ring with O(fleet) phantom appear-records — the same
+                # restart guard the readiness path applies.  Events
+                # above still fire (quarantine continuity across
+                # restarts predates the journal).
+                self.timeline.record(
+                    policy.metadata.name, obs_tl.KIND_PROBE, node=node,
+                    frm=was, to=now_state, reason=reason,
+                    trace_id=current_trace_id(),
                 )
 
     # -- dataplane counter telemetry ------------------------------------------
@@ -2254,6 +2367,10 @@ class NetworkClusterPolicyReconciler:
         old_version = (
             policy.status.plan.version if policy.status.plan else ""
         )
+        # the FULL previous plan (status.plan.excluded is truncated at
+        # PLAN_STATUS_EXCLUDED_K, useless for classification) — must
+        # be read BEFORE update() replaces it
+        prev_plan = self._plan_tracker.current(pname)
         plan, recomputed = self._plan_tracker.update(
             pname, inputs,
             hold_seconds=(
@@ -2287,6 +2404,28 @@ class NetworkClusterPolicyReconciler:
                 "tpunet_plan_modeled_allreduce_ms",
                 plan.modeled_allreduce_ms, labels,
             )
+        if plan.version != old_version:
+            # trigger classification for the journal: what kind of
+            # input change forced this replan (membership vs exclusion
+            # vs RTT drift past hysteresis), read off the tracker's
+            # FULL previous plan (never the truncated status lists)
+            if old_version == "" or prev_plan is None:
+                # no prior plan in this process: first plan, or a
+                # restarted controller whose tracker is cold
+                trigger = "initial" if old_version == "" else "drift"
+            elif set(prev_plan.ring) | set(prev_plan.excluded) \
+                    != set(plan.ring) | set(plan.excluded):
+                trigger = "membership"
+            elif sorted(prev_plan.excluded) != sorted(plan.excluded):
+                trigger = "exclusion"
+            else:
+                trigger = "drift"
+            if self.timeline is not None:
+                self.timeline.record(
+                    pname, obs_tl.KIND_PLAN, frm=old_version,
+                    to=plan.version, reason="TopologyPlanUpdated",
+                    detail=trigger, trace_id=current_trace_id(),
+                )
         if plan.version != old_version and old_version != "":
             # edge-gated like every other Event: version flips only on
             # an actual replan that changed the decisions
@@ -2561,10 +2700,19 @@ class NetworkClusterPolicyReconciler:
             return policy.status.remediation, True, False
         # outcomes FIRST so this pass's decisions see them (node order,
         # like the old report scan; record_outcome is idempotent per
-        # directive id, so re-folding held outcomes is harmless)
+        # directive id, so re-folding held outcomes is harmless — it
+        # returns the matched entry only on the pending→resolved edge,
+        # which is exactly when the journal gets its outcome record)
         for node in sorted(d.outcomes):
             did, out_ok, out_err = d.outcomes[node]
-            ledger.record_outcome(did, out_ok, out_err)
+            matched = ledger.record_outcome(did, out_ok, out_err)
+            if matched is not None and self.timeline is not None:
+                self.timeline.record(
+                    pname, obs_tl.KIND_REMEDIATION, node=node,
+                    frm="pending", to="ok" if out_ok else "failed",
+                    reason="RemediationOutcome", directive_id=did,
+                    detail=out_err, trace_id=current_trace_id(),
+                )
         contribs = d.sorted_contribs()
         anomalies = self._remediation_anomalies(policy, contribs)
         members = d.nodes()
@@ -2642,6 +2790,14 @@ class NetworkClusterPolicyReconciler:
                 f"remediating {target}: {directive.action} "
                 f"({directive.cls} anomaly)",
             )
+            if self.timeline is not None:
+                self.timeline.record(
+                    pname, obs_tl.KIND_REMEDIATION, node=directive.node,
+                    frm=directive.cls, to=directive.action,
+                    reason="RemediationStarted",
+                    directive_id=directive.id,
+                    detail=directive.iface, trace_id=current_trace_id(),
+                )
             if self.metrics:
                 self.metrics.inc(
                     "tpunet_remediation_actions_total",
@@ -2654,6 +2810,13 @@ class NetworkClusterPolicyReconciler:
                 f"anomaly after {knobs.escalate_after} attempt(s); "
                 f"escalating to {to_action}",
             )
+            if self.timeline is not None:
+                self.timeline.record(
+                    pname, obs_tl.KIND_REMEDIATION, node=node,
+                    frm=from_action, to=to_action,
+                    reason="RemediationEscalated", detail=cls,
+                    trace_id=current_trace_id(),
+                )
         if decision.escalated and self.metrics:
             self.metrics.inc(
                 "tpunet_remediation_escalations_total",
@@ -2664,12 +2827,26 @@ class NetworkClusterPolicyReconciler:
                 policy, obs_events.TYPE_NORMAL, "RemediationSucceeded",
                 f"node {node}: anomaly cleared after remediation",
             )
+            if self.timeline is not None:
+                self.timeline.record(
+                    pname, obs_tl.KIND_REMEDIATION, node=node,
+                    frm="remediating", to="recovered",
+                    reason="RemediationSucceeded",
+                    trace_id=current_trace_id(),
+                )
         for node, cls in decision.exhausted:
             self._emit(
                 policy, obs_events.TYPE_WARNING, "RemediationExhausted",
                 f"node {node}: {cls} action ladder exhausted; node "
                 "stays quarantined pending manual repair",
             )
+            if self.timeline is not None:
+                self.timeline.record(
+                    pname, obs_tl.KIND_REMEDIATION, node=node,
+                    frm="remediating", to="exhausted",
+                    reason="RemediationExhausted", detail=cls,
+                    trace_id=current_trace_id(),
+                )
         with self._reports_lock:
             was_denied = self._rem_denied.get(pname, False)
         if decision.budget_denied:
@@ -2808,6 +2985,13 @@ class NetworkClusterPolicyReconciler:
         """Events on the policy's headline state machine flips."""
         if state == old_state:
             return
+        if self.timeline is not None:
+            self.timeline.record(
+                policy.metadata.name, obs_tl.KIND_STATE,
+                frm=old_state, to=state,
+                detail=("; ".join(errors[:3]))[:200],
+                trace_id=current_trace_id(),
+            )
         if state == STATE_ALL_GOOD:
             self._emit(
                 policy, obs_events.TYPE_NORMAL, "Ready",
@@ -2828,29 +3012,42 @@ class NetworkClusterPolicyReconciler:
                 "no nodes match the policy's nodeSelector",
             )
 
-    @staticmethod
     def _set_condition(
+        self, policy_name: str,
         status: t.NetworkClusterPolicyStatus, cond_type: str,
         cond_status: str, reason: str, message: str,
     ) -> None:
         """Upsert a status condition, bumping lastTransitionTime only on
         an actual status flip (metav1 condition semantics — otherwise
-        every pass would churn the CR)."""
+        every pass would churn the CR).  The flip edge is also the
+        journal's condition record — same gate, so steady passes
+        journal nothing."""
         import time
 
         now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        old_status = None
+        placed = False
         for cond in status.conditions:
             if cond.type == cond_type:
+                old_status = cond.status
                 if cond.status != cond_status:
                     cond.last_transition_time = now
                 cond.status = cond_status
                 cond.reason = reason
                 cond.message = message
-                return
-        status.conditions.append(t.PolicyCondition(
-            type=cond_type, status=cond_status, reason=reason,
-            message=message, last_transition_time=now,
-        ))
+                placed = True
+                break
+        if not placed:
+            status.conditions.append(t.PolicyCondition(
+                type=cond_type, status=cond_status, reason=reason,
+                message=message, last_transition_time=now,
+            ))
+        if self.timeline is not None and old_status != cond_status:
+            self.timeline.record(
+                policy_name, obs_tl.KIND_CONDITION,
+                frm=old_status or "", to=cond_status, reason=reason,
+                detail=cond_type, trace_id=current_trace_id(),
+            )
 
     def _update_status(
         self, policy: NetworkClusterPolicy, ds: Dict[str, Any],
@@ -2942,6 +3139,11 @@ class NetworkClusterPolicyReconciler:
         ):
             dirty_all = True
         d = self._derived.get(pname)
+        # whether per-node journal records are meaningful this pass: a
+        # rebuild with no in-process baseline (start/restart) diffs
+        # against the CR's bounded rows and must not journal the
+        # resulting fleet-wide phantom "appear" transitions
+        journal_rows = d is not None
         changed_rows: List[Tuple[str, str, str]] = []
         if dirty_all:
             entries = self._report_entries(pname)
@@ -3036,6 +3238,7 @@ class NetworkClusterPolicyReconciler:
         old_summary = am.to_dict(policy.status.summary)
         old_plan = am.to_dict(policy.status.plan)
         old_remediation = am.to_dict(policy.status.remediation)
+        old_health = am.to_dict(policy.status.health)
         # reaching a status pass IS a successful reconcile: clear any
         # ReconcileDegraded condition a past permanent failure parked
         # here (the conditions diff below flushes the change)
@@ -3051,6 +3254,12 @@ class NetworkClusterPolicyReconciler:
                 policy, obs_events.TYPE_NORMAL, "ReconcileRecovered",
                 "reconcile succeeding again; ReconcileDegraded cleared",
             )
+            if self.timeline is not None:
+                self.timeline.record(
+                    pname, obs_tl.KIND_RECONCILE, frm="degraded",
+                    to="recovered", reason="ReconcileRecovered",
+                    trace_id=current_trace_id(),
+                )
 
         probe_requeue = 0.0
         if probe_spec is not None:
@@ -3093,7 +3302,7 @@ class NetworkClusterPolicyReconciler:
                     ])
                 )
                 self._set_condition(
-                    policy.status, t.CONDITION_DATAPLANE_DEGRADED,
+                    pname, policy.status, t.CONDITION_DATAPLANE_DEGRADED,
                     "True",
                     "QuarantinedNodes" if quarantined else "BelowQuorum",
                     message,
@@ -3116,7 +3325,7 @@ class NetworkClusterPolicyReconciler:
                 )
             else:
                 self._set_condition(
-                    policy.status, t.CONDITION_DATAPLANE_DEGRADED,
+                    pname, policy.status, t.CONDITION_DATAPLANE_DEGRADED,
                     "False", "QuorumReached",
                     f"all {n_rows} probed nodes reach quorum",
                 )
@@ -3132,7 +3341,8 @@ class NetworkClusterPolicyReconciler:
                 )
                 ps.probe_export = export_key
             self._emit_probe_transitions(
-                policy, old_conditions, changed_rows, n_rows, degraded
+                policy, old_conditions, changed_rows, n_rows, degraded,
+                journal_rows=journal_rows,
             )
         else:
             # probing switched off: clear the matrix + condition so the
@@ -3172,7 +3382,7 @@ class NetworkClusterPolicyReconciler:
                 ]
             elif tstat.anomalous_nodes:
                 self._set_condition(
-                    policy.status, t.CONDITION_TELEMETRY_DEGRADED,
+                    pname, policy.status, t.CONDITION_TELEMETRY_DEGRADED,
                     "True", "CounterAnomalies",
                     f"{len(tstat.anomalous_nodes)}/"
                     f"{tstat.nodes_reporting} nodes report interface "
@@ -3181,7 +3391,7 @@ class NetworkClusterPolicyReconciler:
                 )
             else:
                 self._set_condition(
-                    policy.status, t.CONDITION_TELEMETRY_DEGRADED,
+                    pname, policy.status, t.CONDITION_TELEMETRY_DEGRADED,
                     "False", "CountersNominal",
                     "interface counters nominal on all "
                     f"{tstat.nodes_reporting} reporting nodes",
@@ -3331,6 +3541,17 @@ class NetworkClusterPolicyReconciler:
             assert set(values) == set(POLICY_GAUGES)
             for gauge in POLICY_GAUGES:
                 self.metrics.set_gauge(gauge, values[gauge], labels)
+
+        # SLO rollup: feed the readiness SLI (event-sourced — only a
+        # ratio CHANGE appends a sample) and embed the bounded health
+        # rollup.  The engine caches per fold-version, so a pass with
+        # no new journal records serves the identical object and the
+        # status diff below sees no change.
+        if self.slo is not None:
+            self.slo.observe_fleet(pname, ready, targets, ts=now_wall)
+            policy.status.health = self.slo.health_status(pname)
+        else:
+            policy.status.health = None
         phases["aggregate"] += t_phase() - p0
 
         # -- phase: project — status diff + (maybe) one write ---------
@@ -3347,6 +3568,7 @@ class NetworkClusterPolicyReconciler:
             or am.to_dict(policy.status.summary) != old_summary
             or am.to_dict(policy.status.plan) != old_plan
             or am.to_dict(policy.status.remediation) != old_remediation
+            or am.to_dict(policy.status.health) != old_health
         )
         policy.status.targets = targets
         policy.status.ready_nodes = ready
@@ -3396,6 +3618,8 @@ class NetworkClusterPolicyReconciler:
             ps.stale_heap[0][0] if ps.stale_heap else None
         )
         ps.ever_completed = True
+        if self.slo is not None:
+            self.slo.note_pass(pname, fast=False)
         if self.metrics:
             self.metrics.set_gauge(
                 "tpunet_reconcile_dirty_nodes", float(n_dirty),
@@ -3453,6 +3677,11 @@ class NetworkClusterPolicyReconciler:
             self._pass_state.pop(name, None)
             self._ds_checked.pop(name, None)
             self.dirty.forget(name)
+            # journal + SLO state die with it too (series retracted)
+            if self.timeline is not None:
+                self.timeline.forget(name)
+            if self.slo is not None:
+                self.slo.forget(name)
             return Result()
 
         owned = self.client.list(
@@ -3491,6 +3720,10 @@ class NetworkClusterPolicyReconciler:
             if not self.dirty.peek(name) and ps.quiet(
                 time_mod.time(), self._probe_clock()
             ):
+                if self.slo is not None:
+                    # counter bump only — a fast-path pass must append
+                    # no journal records and cause no status churn
+                    self.slo.note_pass(name, fast=True)
                 if self.metrics:
                     self.metrics.inc("tpunet_reconcile_fast_path_total")
                     self.metrics.set_gauge(

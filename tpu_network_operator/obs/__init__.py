@@ -19,10 +19,21 @@ traffic at fleet scale):
 * :mod:`.logging` — an opt-in JSON log formatter (``--log-format=json``)
   that injects the active trace context into every record, so the two
   unstructured log streams become one correlatable event stream.
+* :mod:`.timeline` — the fleet flight recorder: a byte-budgeted,
+  per-policy journal of health-state *transitions* (readiness flips,
+  probe verdicts, telemetry anomalies, plan bumps, remediation rungs,
+  condition flips) with causal references, served from
+  ``/debug/timeline`` and walked backwards by ``tools/why.py``.
+* :mod:`.slo` — the SLO engine folding that journal into burn-rate
+  SLOs (fleet readiness, fault-detection latency, remediation
+  convergence, fast-path hit ratio) exported as ``tpunet_slo_*``
+  metrics and the bounded ``status.health`` rollup.
 """
 
 from .events import EventRecorder
 from .logging import JsonFormatter, setup_logging
+from .slo import SloEngine
+from .timeline import Timeline
 from .trace import (
     TRACE_ANNOTATION,
     Span,
@@ -35,7 +46,9 @@ __all__ = [
     "EventRecorder",
     "JsonFormatter",
     "setup_logging",
+    "SloEngine",
     "Span",
+    "Timeline",
     "Tracer",
     "TRACE_ANNOTATION",
     "current_span",

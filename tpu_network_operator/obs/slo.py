@@ -1,0 +1,457 @@
+"""SLO engine: folds the fleet timeline into burn-rate SLOs.
+
+Subscribes to the :class:`.timeline.Timeline` journal (every appended
+transition record flows through :meth:`SloEngine._fold`) and maintains
+four production SLO families:
+
+* **fleet readiness ratio** — ready/target nodes per policy, sampled
+  event-sourced (only when the ratio changes), with classic two-window
+  burn rates: ``burn = mean(1 - ratio) / (1 - objective)`` over a fast
+  (5 min) and slow (1 h) window.  Burn 1.0 = the error budget is being
+  consumed exactly at the sustainable rate; >1.0 = faster.
+* **fault-detection latency** — first fabric-fault evidence (probe
+  verdict leaving Reachable) to readiness retract for the same node,
+  observed once per episode into a histogram.
+* **remediation convergence time** — anomaly open (probe degradation or
+  telemetry anomaly) to full recovery, observed only for episodes in
+  which a remediation action actually fired (fault recovery without
+  self-healing is not self-healing's win).
+* **fast-path hit ratio** — steady-pass fast-path exits over all
+  reconcile passes, per policy.
+
+Everything is derived from journal edges plus the reconciler's
+(ready, targets) feed, so a steady fleet re-computes nothing: the
+``status.health`` rollup is cached per fold-version and a pass with no
+new transitions serves the identical object — the zero-steady-write
+contract holds with the engine wired in.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter, deque
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from ..api.v1alpha1 import types as t
+from . import timeline as tl
+
+# the readiness objective the burn rate is judged against (fraction of
+# target nodes provisioned + dataplane-validated)
+DEFAULT_OBJECTIVE = 0.99
+# classic multiwindow burn-rate pair: fast catches an active incident,
+# slow catches a slow bleed
+WINDOW_FAST_SECONDS = 300.0
+WINDOW_SLOW_SECONDS = 3600.0
+
+# bounded per-policy state: readiness step samples and recent episode
+# durations (medians are computed over these)
+MAX_SAMPLES = 512
+MAX_EPISODES = 256
+
+# every metric family the engine owns — one list for the set sites and
+# the forget-time retraction (the reconciler's phantom-series contract)
+SLO_GAUGES = (
+    "tpunet_slo_readiness_ratio",
+    "tpunet_slo_readiness_burn_rate",
+    "tpunet_slo_fast_path_ratio",
+)
+SLO_HISTOGRAMS = (
+    "tpunet_slo_fault_detection_seconds",
+    "tpunet_slo_remediation_convergence_seconds",
+)
+
+_BAD_PROBE_STATES = (t.PROBE_STATE_DEGRADED, t.PROBE_STATE_QUARANTINED)
+
+
+class _Episode:
+    """One node's open incident: from first bad signal to full
+    recovery.  ``probe_bad``/``ifaces`` track which signals are still
+    asserting; ``remediated`` remembers whether self-healing acted."""
+
+    __slots__ = ("opened", "probe_bad", "ifaces", "remediated")
+
+    def __init__(self, opened: float):
+        self.opened = opened
+        self.probe_bad = False
+        self.ifaces: Set[str] = set()
+        self.remediated = False
+
+    def clear(self) -> bool:
+        return not self.probe_bad and not self.ifaces
+
+
+class SloEngine:
+    """Journal-fed SLO state + the bounded ``status.health`` rollup.
+
+    Thread-safe (reconcile workers fold records and read health, scrape
+    threads read nothing here — gauges live in the shared registry)."""
+
+    def __init__(
+        self,
+        timeline: Optional[tl.Timeline] = None,
+        metrics=None,
+        objective: float = DEFAULT_OBJECTIVE,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.timeline = timeline
+        self.metrics = metrics
+        self.objective = min(max(float(objective), 0.0), 0.9999)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # policy -> [fast-path passes, total passes]
+        self._passes: Dict[str, List[int]] = {}
+        # policy -> deque[(ts, ratio)] readiness step samples
+        self._samples: Dict[str, deque] = {}
+        # (policy, node) -> fault-open ts (probe verdict left Reachable)
+        self._fault_open: Dict[Tuple[str, str], float] = {}
+        # fault episodes whose detection latency was already observed:
+        # a flapping agent-side gate (ready <-> not-ready while the
+        # verdict stays Degraded) must not re-observe flap durations
+        # as fresh "detections"
+        self._detected: Set[Tuple[str, str]] = set()
+        # (policy, node) -> label-retract ts seen before the fault
+        # record landed (readiness records precede probe records inside
+        # one pass — both orders must pair up)
+        self._label_down: Dict[Tuple[str, str], float] = {}
+        # (policy, node) -> open incident episode
+        self._episodes: Dict[Tuple[str, str], _Episode] = {}
+        # recent closed-episode durations per policy (medians)
+        self._detect: Dict[str, deque] = {}
+        self._converge: Dict[str, deque] = {}
+        # fold-version per policy: bumps on every journal record and on
+        # every readiness-ratio change — together with the burn-decay
+        # bucket it forms the health rollup's cache key
+        self._version: Counter = Counter()
+        self._health_cache: Dict[
+            str, Tuple[Tuple[int, int], t.HealthStatus]
+        ] = {}
+        if timeline is not None:
+            timeline.add_listener(self._fold)
+
+    # -- reconciler feeds ------------------------------------------------------
+
+    def note_pass(self, policy: str, fast: bool) -> None:
+        """Count one reconcile pass (fast-path exit or full pass).
+        Deliberately does NOT bump the fold version: the hit ratio
+        refreshes on the next real transition, so counting a steady
+        fast-path pass never causes a status write."""
+        with self._lock:
+            counts = self._passes.setdefault(policy, [0, 0])
+            if fast:
+                counts[0] += 1
+            counts[1] += 1
+
+    def observe_fleet(
+        self, policy: str, ready: int, targets: int,
+        ts: Optional[float] = None,
+    ) -> None:
+        """Feed one status pass's (ready, targets).  Event-sourced: a
+        sample is appended only when the ratio actually changed, so a
+        steady fleet appends nothing and the health cache stays warm."""
+        ratio = 1.0 if targets <= 0 else min(ready / targets, 1.0)
+        with self._lock:
+            samples = self._samples.setdefault(
+                policy, deque(maxlen=MAX_SAMPLES)
+            )
+            if samples and abs(samples[-1][1] - ratio) < 1e-9:
+                return
+            samples.append((
+                self._clock() if ts is None else float(ts), ratio,
+            ))
+            self._version[policy] += 1
+
+    # -- journal fold ----------------------------------------------------------
+
+    def _fold(self, rec: Dict[str, Any]) -> None:
+        policy = rec.get("policy", "")
+        node = rec.get("node", "")
+        kind = rec.get("kind", "")
+        ts = float(rec.get("ts", 0.0) or 0.0)
+        key = (policy, node)
+        with self._lock:
+            self._version[policy] += 1
+            if kind == tl.KIND_PROBE:
+                to = rec.get("to", "")
+                if to in _BAD_PROBE_STATES:
+                    if key not in self._fault_open:
+                        self._fault_open[key] = ts
+                        # the label may already be down (readiness
+                        # records precede probe records in one pass)
+                        down = self._label_down.pop(key, None)
+                        if down is not None:
+                            self._detected.add(key)
+                            self._observe_detection(
+                                policy, max(down - ts, 0.0)
+                            )
+                    ep = self._episodes.get(key)
+                    if ep is None:
+                        ep = self._episodes[key] = _Episode(
+                            min(ts, self._fault_open[key])
+                        )
+                    ep.probe_bad = True
+                elif to == t.PROBE_STATE_REACHABLE:
+                    self._fault_open.pop(key, None)
+                    self._detected.discard(key)
+                    self._label_down.pop(key, None)
+                    ep = self._episodes.get(key)
+                    if ep is not None:
+                        ep.probe_bad = False
+                        self._maybe_close(key, ts)
+            elif kind == tl.KIND_READINESS:
+                to = rec.get("to", "")
+                if to == "not-ready":
+                    opened = self._fault_open.get(key)
+                    if opened is not None:
+                        # once per fault episode: later retracts while
+                        # the SAME verdict stays bad are label flaps,
+                        # not new detections
+                        if key not in self._detected:
+                            self._detected.add(key)
+                            self._observe_detection(
+                                policy, max(ts - opened, 0.0)
+                            )
+                    else:
+                        self._label_down[key] = ts
+                else:   # ready / departed
+                    self._label_down.pop(key, None)
+                    if to == "departed":
+                        # the node (and its open episode) left the fleet
+                        self._fault_open.pop(key, None)
+                        self._detected.discard(key)
+                        self._episodes.pop(key, None)
+            elif kind == tl.KIND_TELEMETRY:
+                iface = str(rec.get("detail", "")).split(":", 1)[0]
+                if rec.get("to") == "anomalous":
+                    ep = self._episodes.get(key)
+                    if ep is None:
+                        ep = self._episodes[key] = _Episode(ts)
+                    ep.ifaces.add(iface)
+                elif rec.get("to") == "nominal":
+                    ep = self._episodes.get(key)
+                    if ep is not None:
+                        ep.ifaces.discard(iface)
+                        self._maybe_close(key, ts)
+            elif kind == tl.KIND_REMEDIATION:
+                if rec.get("cause", {}).get("reason") == \
+                        "RemediationStarted":
+                    ep = self._episodes.get(key)
+                    if ep is None:
+                        ep = self._episodes[key] = _Episode(ts)
+                        # an action without a preceding open signal
+                        # record still opens the episode — the anomaly
+                        # IS open, the journal just started later
+                        ep.ifaces.add("")
+                    ep.remediated = True
+
+    def _observe_detection(self, policy: str, seconds: float) -> None:
+        self._detect.setdefault(
+            policy, deque(maxlen=MAX_EPISODES)
+        ).append(seconds)
+        if self.metrics is not None:
+            self.metrics.observe(
+                "tpunet_slo_fault_detection_seconds", seconds,
+                {"policy": policy},
+            )
+
+    def _maybe_close(self, key: Tuple[str, str], ts: float) -> None:
+        ep = self._episodes.get(key)
+        if ep is None:
+            return
+        # a remediation-opened placeholder iface clears with the rest
+        ep.ifaces.discard("")
+        if not ep.clear():
+            return
+        del self._episodes[key]
+        if not ep.remediated:
+            return   # recovery without self-healing: not convergence
+        seconds = max(ts - ep.opened, 0.0)
+        self._converge.setdefault(
+            key[0], deque(maxlen=MAX_EPISODES)
+        ).append(seconds)
+        if self.metrics is not None:
+            self.metrics.observe(
+                "tpunet_slo_remediation_convergence_seconds", seconds,
+                {"policy": key[0]},
+            )
+
+    # -- SLO math --------------------------------------------------------------
+
+    def burn_rate(
+        self, policy: str, window_seconds: float,
+        asof: Optional[float] = None,
+    ) -> float:
+        """Time-weighted mean of (1 - readiness ratio) over the window,
+        over the error budget (1 - objective).  The samples are a step
+        function (event-sourced), integrated exactly.  ``asof`` defaults
+        to the newest sample's timestamp so a steady fleet's burn rate
+        is deterministic — it changes only when the ratio does."""
+        with self._lock:
+            samples = list(self._samples.get(policy, ()))
+        if not samples:
+            return 0.0
+        end = samples[-1][0] if asof is None else float(asof)
+        start = end - window_seconds
+        # integrate 1-ratio over [start, end]; before the first sample
+        # the fleet is assumed at the first sample's ratio (the journal
+        # started mid-life, not the fleet)
+        bad = 0.0
+        covered = 0.0
+        for i, (ts, ratio) in enumerate(samples):
+            seg_start = max(ts if i > 0 else start, start)
+            seg_end = samples[i + 1][0] if i + 1 < len(samples) else end
+            seg_end = min(seg_end, end)
+            if seg_end <= seg_start:
+                continue
+            span = seg_end - seg_start
+            bad += (1.0 - ratio) * span
+            covered += span
+        integrated = (
+            (bad / covered) / (1.0 - self.objective)
+            if covered > 0.0 else 0.0
+        )
+        # the newest sample's segment is open-ended and integrates to
+        # zero width when ``asof`` sits at its timestamp — which is
+        # exactly an ACTIVE incident's shape (the degraded sample just
+        # landed).  Floor the burn at the instantaneous rate so an
+        # ongoing incident reports its true consumption immediately
+        # instead of only after recovery moves the window past it.
+        # Deterministic: depends only on the current ratio.
+        instantaneous = (
+            (1.0 - samples[-1][1]) / (1.0 - self.objective)
+        )
+        return max(integrated, instantaneous)
+
+    @staticmethod
+    def _median(values: deque) -> float:
+        if not values:
+            return 0.0
+        ordered = sorted(values)
+        return ordered[(len(ordered) - 1) // 2]
+
+    # -- rollup ----------------------------------------------------------------
+
+    def health_status(self, policy: str) -> Optional[t.HealthStatus]:
+        """The bounded ``status.health`` rollup — cached per (fold
+        version, decay bucket), so a pass with no new transitions (and
+        an unchanged readiness ratio) serves the IDENTICAL object and
+        the status diff sees no change.  The decay bucket quantizes
+        the clock to the fast window: anchoring burn rates at the
+        newest sample alone would report a long-recovered incident's
+        burn FOREVER (the window never slides past it) — instead the
+        window advances once per bucket, at most one recompute per
+        5 minutes (the forced full rebuild runs on the same cadence),
+        and a recovered fleet's burn integrates down to 0 and then
+        stabilizes — value unchanged, so no further status writes."""
+        with self._lock:
+            version = self._version.get(policy, 0)
+            samples = self._samples.get(policy)
+            if version == 0 and not samples:
+                return None
+            bucket = int(self._clock() // WINDOW_FAST_SECONDS)
+            key = (version, bucket)
+            cached = self._health_cache.get(policy)
+            if cached is not None and cached[0] == key:
+                return cached[1]
+            asof = max(
+                bucket * WINDOW_FAST_SECONDS,
+                samples[-1][0] if samples else 0.0,
+            )
+            ratio = samples[-1][1] if samples else 0.0
+            counts = self._passes.get(policy, [0, 0])
+            fast_ratio = (
+                counts[0] / counts[1] if counts[1] else 0.0
+            )
+            detect = self._detect.get(policy, deque())
+            converge = self._converge.get(policy, deque())
+            transitions = (
+                self.timeline.appended(policy)
+                if self.timeline is not None else 0
+            )
+        burn_fast = self.burn_rate(policy, WINDOW_FAST_SECONDS, asof)
+        burn_slow = self.burn_rate(policy, WINDOW_SLOW_SECONDS, asof)
+        status = t.HealthStatus(
+            readiness_ratio=round(ratio, 4),
+            objective=round(self.objective, 4),
+            burn_rate_fast=round(burn_fast, 3),
+            burn_rate_slow=round(burn_slow, 3),
+            fault_detection_p50_seconds=round(
+                self._median(detect), 3
+            ),
+            remediation_convergence_p50_seconds=round(
+                self._median(converge), 3
+            ),
+            fast_path_ratio=round(fast_ratio, 4),
+            transitions_total=transitions,
+        )
+        with self._lock:
+            self._health_cache[policy] = (key, status)
+        if self.metrics is not None:
+            labels = {"policy": policy}
+            self.metrics.set_gauge(
+                "tpunet_slo_readiness_ratio",
+                status.readiness_ratio, labels,
+            )
+            self.metrics.set_gauge(
+                "tpunet_slo_readiness_burn_rate", status.burn_rate_fast,
+                {"policy": policy, "window": "5m"},
+            )
+            self.metrics.set_gauge(
+                "tpunet_slo_readiness_burn_rate", status.burn_rate_slow,
+                {"policy": policy, "window": "1h"},
+            )
+            self.metrics.set_gauge(
+                "tpunet_slo_fast_path_ratio",
+                status.fast_path_ratio, labels,
+            )
+        return status
+
+    def summary(self) -> Dict[str, Any]:
+        """One JSON-able snapshot across policies — what the support
+        bundle captures (tools/diag.py) and ``tools/why.py`` prints."""
+        with self._lock:
+            policies = sorted(
+                set(self._samples) | set(self._passes)
+                | set(self._version)
+            )
+        out: Dict[str, Any] = {"objective": self.objective, "policies": {}}
+        for policy in policies:
+            status = self.health_status(policy)
+            if status is None:
+                continue
+            from ..api import apimachinery as am
+
+            out["policies"][policy] = am.to_dict(status)
+        return out
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def forget(self, policy: str) -> None:
+        """Drop a deleted policy's SLO state and retract its series."""
+        with self._lock:
+            self._passes.pop(policy, None)
+            self._samples.pop(policy, None)
+            self._detect.pop(policy, None)
+            self._converge.pop(policy, None)
+            self._version.pop(policy, None)
+            self._health_cache.pop(policy, None)
+            for key in [
+                k for k in self._fault_open if k[0] == policy
+            ]:
+                del self._fault_open[key]
+            self._detected = {
+                k for k in self._detected if k[0] != policy
+            }
+            for key in [
+                k for k in self._label_down if k[0] == policy
+            ]:
+                del self._label_down[key]
+            for key in [
+                k for k in self._episodes if k[0] == policy
+            ]:
+                del self._episodes[key]
+        if self.metrics is not None:
+            for family in SLO_GAUGES + SLO_HISTOGRAMS:
+                self.metrics.remove_matching(
+                    family, {"policy": policy}
+                )

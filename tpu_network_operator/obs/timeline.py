@@ -1,0 +1,249 @@
+"""Fleet flight recorder: an event-sourced health-transition journal.
+
+Everything the controller computes today is *instantaneous* — once a
+status pass completes, the history of how a node got into its current
+state is gone, and "why is node X not scale-out-ready, and when did
+that start?" means hand-correlating Events, the remediation ledger and
+metrics.  This module keeps the missing history: a bounded, per-policy
+ring journal of state **transitions** — readiness flips, probe verdict
+changes (Reachable/Degraded/Quarantined), telemetry anomaly open/close
+per interface, topology-plan version bumps with their trigger,
+remediation rung fire/outcome/escalation, condition flips, policy
+state-machine flips and reconcile permanent-error edges.
+
+Design contract (mirrors the delta pipeline it hooks into):
+
+* recording happens ONLY at the reconciler's existing edge-detection
+  points — a steady pass appends **zero** records and a churn pass
+  appends O(changed), so the journal costs nothing on the fast path;
+* every record carries cause references (trace ID, Event reason,
+  remediation directive ID) so records chain causally: ``tools/why.py``
+  walks the chain backwards into one narrative;
+* memory is bounded by a per-policy **byte budget**, not a record
+  count — a record's cost is its serialized size, and the ring evicts
+  oldest-first until it fits (evictions are counted, never silent).
+
+The journal is served as JSON from ``/debug/timeline`` on
+:class:`..controller.health.HealthServer` (same bearer gate and filter
+conventions as ``/debug/traces``), and :mod:`.slo` folds it into
+burn-rate SLOs by subscribing as a listener.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import Counter, deque
+from typing import Any, Callable, Dict, List, Optional
+
+# record kinds — the transition families the reconciler journals
+KIND_READINESS = "readiness"        # per-node provisioning-report ok flips
+KIND_PROBE = "probe"                # probe verdict row changes
+KIND_TELEMETRY = "telemetry"        # per-interface anomaly open/close
+KIND_PLAN = "plan"                  # topology-plan version bumps (+ trigger)
+KIND_REMEDIATION = "remediation"    # rung fire / outcome / escalation / heal
+KIND_CONDITION = "condition"        # status condition flips
+KIND_STATE = "state"                # policy headline state-machine flips
+KIND_RECONCILE = "reconcile"        # permanent-error open/close edges
+
+KINDS = frozenset({
+    KIND_READINESS, KIND_PROBE, KIND_TELEMETRY, KIND_PLAN,
+    KIND_REMEDIATION, KIND_CONDITION, KIND_STATE, KIND_RECONCILE,
+})
+
+# per-policy ring byte budget: generous for weeks of edge-rate records
+# (transitions are rare by construction), small enough that a 25-policy
+# operator holds a few MiB of journal, never more
+DEFAULT_POLICY_BYTE_BUDGET = 256 * 1024
+# floor: a budget too small to hold even a handful of records would
+# make every append evict its own predecessor
+MIN_POLICY_BYTE_BUDGET = 4096
+
+
+class Timeline:
+    """Per-policy byte-budgeted transition journal (see module doc).
+
+    Thread-safe: the reconciler's workers append from reconcile passes,
+    the HealthServer reads from scrape threads.  Listeners (the SLO
+    engine) are notified OUTSIDE the journal lock with the already-
+    immutable record dict; listener exceptions are swallowed like the
+    informer delta hooks' — observability must never fail a pass."""
+
+    def __init__(
+        self,
+        policy_byte_budget: int = DEFAULT_POLICY_BYTE_BUDGET,
+        clock: Callable[[], float] = time.time,
+        metrics=None,
+    ):
+        self._lock = threading.Lock()
+        self._budget = max(MIN_POLICY_BYTE_BUDGET, int(policy_byte_budget))
+        self._clock = clock
+        self._metrics = metrics
+        self._seq = 0
+        # policy -> deque[(byte cost, record dict)]
+        self._rings: Dict[str, deque] = {}
+        self._bytes: Counter = Counter()
+        self._appended: Counter = Counter()     # lifetime, per policy
+        self._dropped: Counter = Counter()      # evicted, per policy
+        self._listeners: List[Callable[[Dict[str, Any]], None]] = []
+
+    @property
+    def policy_byte_budget(self) -> int:
+        return self._budget
+
+    def add_listener(
+        self, fn: Callable[[Dict[str, Any]], None]
+    ) -> None:
+        """Subscribe to every appended record (the SLO engine's feed)."""
+        self._listeners.append(fn)
+
+    # -- append ----------------------------------------------------------------
+
+    def record(
+        self,
+        policy: str,
+        kind: str,
+        node: str = "",
+        frm: str = "",
+        to: str = "",
+        trace_id: str = "",
+        reason: str = "",
+        directive_id: str = "",
+        detail: str = "",
+        ts: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Append one transition record and return it (the wire form
+        served from ``/debug/timeline``).  Cause references are kept
+        sparse — only the refs that exist ride the record."""
+        cause: Dict[str, str] = {}
+        if trace_id:
+            cause["traceId"] = trace_id
+        if reason:
+            cause["reason"] = reason
+        if directive_id:
+            cause["directiveId"] = directive_id
+        rec: Dict[str, Any] = {
+            "seq": 0,   # assigned under the lock below
+            "ts": round(self._clock() if ts is None else ts, 3),
+            "policy": str(policy),
+            "kind": str(kind),
+            "node": str(node),
+            "from": str(frm),
+            "to": str(to),
+        }
+        if detail:
+            rec["detail"] = str(detail)[:256]
+        if cause:
+            rec["cause"] = cause
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            # the honest byte cost: what this record serializes to
+            cost = len(json.dumps(rec, separators=(",", ":")))
+            ring = self._rings.get(rec["policy"])
+            if ring is None:
+                ring = self._rings[rec["policy"]] = deque()
+            ring.append((cost, rec))
+            self._bytes[rec["policy"]] += cost
+            self._appended[rec["policy"]] += 1
+            # byte-budget eviction: oldest records go first; the newest
+            # record always survives (a single over-budget record would
+            # otherwise evict itself into an empty journal)
+            while self._bytes[rec["policy"]] > self._budget and len(ring) > 1:
+                old_cost, _ = ring.popleft()
+                self._bytes[rec["policy"]] -= old_cost
+                self._dropped[rec["policy"]] += 1
+        if self._metrics is not None:
+            self._metrics.inc(
+                "tpunet_timeline_records_total",
+                {"policy": rec["policy"], "kind": rec["kind"]},
+            )
+            self._metrics.set_gauge(
+                "tpunet_timeline_bytes",
+                float(self._bytes[rec["policy"]]),
+                {"policy": rec["policy"]},
+            )
+        for fn in self._listeners:
+            try:
+                fn(rec)
+            except Exception:   # noqa: BLE001 — observers never fail a pass
+                pass
+        return rec
+
+    # -- reads -----------------------------------------------------------------
+
+    def snapshot(
+        self,
+        policy: str = "",
+        node: str = "",
+        kind: str = "",
+        since: float = 0.0,
+        limit: int = 0,
+    ) -> List[Dict[str, Any]]:
+        """Journal records oldest-first (by append sequence), optionally
+        filtered by policy/node/kind and a ``since`` wall-clock floor;
+        ``limit`` > 0 keeps only the newest N after filtering."""
+        with self._lock:
+            if policy:
+                rings = [self._rings.get(policy, ())]
+            else:
+                rings = list(self._rings.values())
+            out = [
+                dict(rec)
+                for ring in rings
+                for _, rec in ring
+                if (not node or rec["node"] == node)
+                and (not kind or rec["kind"] == kind)
+                and rec["ts"] >= since
+            ]
+        out.sort(key=lambda r: r["seq"])
+        if limit > 0:
+            out = out[-limit:]
+        return out
+
+    def total_bytes(self, policy: str = "") -> int:
+        with self._lock:
+            if policy:
+                return self._bytes.get(policy, 0)
+            return sum(self._bytes.values())
+
+    def appended(self, policy: str = "") -> int:
+        """Lifetime records appended (survivors + evicted)."""
+        with self._lock:
+            if policy:
+                return self._appended.get(policy, 0)
+            return sum(self._appended.values())
+
+    def dropped(self, policy: str = "") -> int:
+        """Records evicted by the byte budget (never silent)."""
+        with self._lock:
+            if policy:
+                return self._dropped.get(policy, 0)
+            return sum(self._dropped.values())
+
+    def policies(self) -> List[str]:
+        with self._lock:
+            return sorted(self._rings)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(ring) for ring in self._rings.values())
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def forget(self, policy: str) -> None:
+        """Drop a deleted policy's journal (the reconciler's one-time
+        cleanup contract; metric series retract with it)."""
+        with self._lock:
+            self._rings.pop(policy, None)
+            self._bytes.pop(policy, None)
+            self._appended.pop(policy, None)
+            self._dropped.pop(policy, None)
+        if self._metrics is not None:
+            self._metrics.remove_matching(
+                "tpunet_timeline_records_total", {"policy": policy}
+            )
+            self._metrics.remove_gauge(
+                "tpunet_timeline_bytes", {"policy": policy}
+            )
