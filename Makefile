@@ -49,8 +49,8 @@ chaos: ## Fault-injection resilience: marked scenarios + the 4-scenario bench
 	$(PYTHON) tools/chaos_bench.py --out BENCH_chaos.json
 
 .PHONY: scale-bench
-scale-bench: ## Thousands-of-nodes control-plane proof: marked tests + the 100/2k/10k sweep
-	$(PYTHON) -m pytest tests/ -x -q -m "scale and not slow"
+scale-bench: ## Control-plane scale proof: marked tests + the 100/2k/10k sweep, 10k shard failover and 100k sharded sweep
+	$(PYTHON) -m pytest tests/ -x -q -m "(scale or sharding) and not slow"
 	$(PYTHON) tools/scale_bench.py --out BENCH_scale.json
 
 .PHONY: planner-bench

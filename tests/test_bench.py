@@ -524,14 +524,20 @@ class TestScaleBench:
             [sys.executable, os.path.join(REPO_ROOT, "tools",
                                           "scale_bench.py"),
              "--nodes-list", "40,300", "--rounds", "2",
-             "--partition-nodes", "60", "--out", str(out)],
+             "--partition-nodes", "60",
+             "--failover-nodes", "200", "--failover-policies", "4",
+             "--failover-churn", "10",
+             "--sharded-nodes", "400", "--sharded-policies", "4",
+             "--sharded-replicas", "2",
+             "--out", str(out)],
             capture_output=True, text=True, timeout=300,
         )
         assert proc.returncode == 0, proc.stderr[-800:]
         row = json.loads(proc.stdout.strip().splitlines()[-1])
         assert row == json.loads(out.read_text())
         for key in ("metric", "value", "unit", "vs_baseline", "degree",
-                    "sweeps", "partition", "ok"):
+                    "sweeps", "partition", "failover", "sharded",
+                    "notes", "ok"):
             assert key in row, key
         assert row["ok"] is True and row["failures"] == []
         assert row["unit"] == "datagrams/node/round"
@@ -549,6 +555,9 @@ class TestScaleBench:
             assert sweep["steady_pass_p50_ms"] <= 65.0
             assert sweep["steady_fast_path_passes"] > 0
             assert sweep["churn_pass_p50_ms"] > 0
+            # PR 11 rebuild tiers are measured per sweep
+            assert sweep["rebuild_parallel_p50_ms"] > 0
+            assert sweep["rebuild_resumed_p50_ms"] > 0
         small, big_sweep = row["sweeps"][0], row["sweeps"][-1]
         assert big_sweep["churn_pass_p50_ms"] <= 2.0 * max(
             small["churn_pass_p50_ms"], 1.0
@@ -562,17 +571,75 @@ class TestScaleBench:
         part = row["partition"]
         assert 0 < part["detect_intervals"] <= part["budget_intervals"]
         assert part["in_probers_observing"] == part["in_probers"]
+        # shard failover: bounded handoff + persisted-cache resume
+        fo = row["failover"]
+        assert fo["takeover_clean"] is True
+        assert fo["overlap_violations"] == 0
+        assert fo["rederived_nodes"] <= fo["churned_nodes"]
+        assert (
+            fo["resumed_nodes"] + fo["rederived_nodes"]
+            == fo["departed_nodes"]
+        )
+        assert fo["cr_status_writes"] <= fo["affected_policies"]
+        assert fo["node_label_writes"] == 0
+        assert fo["duplicate_events"] == 0
+        # multi-replica sweep: steady O(1), zero writes, rebuilds
+        # amortized under the steady budget, caches narrowed
+        sh = row["sharded"]
+        assert sh["steady_writes_total"] == 0
+        assert sh["steady_pass_p50_ms"] <= 65.0
+        assert sh["rebuild_amortized_ms_per_pass"] <= 65.0
+        assert sh["lease_cache_narrowed"] is True
+        assert sh["rebuild_unsharded_sum_ms"] >= (
+            sh["rebuild_per_shard_max_ms"]
+        )
+        # the PR 9 regression ledger rides the notes
+        assert row["notes"]["pr9_rebuild_p50_ms"] == 520.18
+
+    def test_failover_determinism_across_runs(self, tmp_path):
+        """The structural half of the failover + sharded scenarios —
+        partition sizes, resume/re-derive counts, write/event audits —
+        must be byte-identical across runs (seeded hash partition, no
+        wall-clock dependence); only timings may differ."""
+        rows = []
+        for run in range(2):
+            out = tmp_path / f"BENCH_scale_{run}.json"
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                              "scale_bench.py"),
+                 "--nodes-list", "40", "--rounds", "1",
+                 "--partition-nodes", "60",
+                 "--failover-nodes", "120", "--failover-policies", "4",
+                 "--failover-churn", "6",
+                 "--sharded-nodes", "160", "--sharded-policies", "4",
+                 "--sharded-replicas", "2",
+                 "--out", str(out)],
+                capture_output=True, text=True, timeout=300,
+            )
+            assert proc.returncode == 0, proc.stderr[-800:]
+            row = json.loads(out.read_text())
+            fo = dict(row["failover"])
+            fo.pop("takeover_seconds")
+            sh = dict(row["sharded"])
+            for k in list(sh):
+                if k.endswith("_ms") or k.endswith("_ms_per_pass"):
+                    sh.pop(k)
+            rows.append({"failover": fo, "sharded": sh})
+        assert rows[0] == rows[1]
 
     @pytest.mark.slow
     def test_ten_thousand_node_soak(self, tmp_path):
         """The full 10k-node sweep (the committed BENCH_scale.json
-        geometry) — minutes of runtime, so slow-marked out of tier-1."""
+        geometry, minus the 100k sharded sweep — see the test below) —
+        minutes of runtime, so slow-marked out of tier-1."""
         out = tmp_path / "BENCH_scale.json"
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO_ROOT, "tools",
                                           "scale_bench.py"),
              "--nodes-list", "10000", "--rounds", "3",
-             "--partition-nodes", "2000", "--out", str(out)],
+             "--partition-nodes", "2000",
+             "--failover-nodes", "10000", "--sharded-nodes", "0",
+             "--out", str(out)],
             capture_output=True, text=True, timeout=900,
         )
         assert proc.returncode == 0, proc.stderr[-800:]
@@ -583,6 +650,37 @@ class TestScaleBench:
         assert sweep["status_bytes"] < 256 * 1024
         # the tentpole budget at full scale: a steady pass is O(1)
         assert sweep["steady_pass_p50_ms"] <= 65.0
+        # the PR 11 rebuild ledger: both optimized from-scratch and
+        # resumed drift rebuilds beat the 520 ms PR 9 regression (and
+        # the 329 ms pre-regression number)
+        assert sweep["reconcile_p50_ms"] < 329.0
+        assert sweep["rebuild_resumed_p50_ms"] < sweep["reconcile_p50_ms"]
+        # 10k failover: the successor resumes, re-deriving only churn
+        fo = row["failover"]
+        assert fo["takeover_clean"] is True
+        assert fo["rederived_nodes"] <= fo["churned_nodes"]
+        assert fo["duplicate_events"] == 0
+
+    @pytest.mark.slow
+    @pytest.mark.sharding
+    def test_hundred_thousand_node_sharded_sweep(self):
+        """The 100k wall: hash-partitioned replicas each hold one
+        slice, steady passes stay O(1) with zero writes, and drift
+        rebuilds amortize under the 65 ms steady budget because they
+        are paid per-shard, never per-fleet."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "scale_bench",
+            os.path.join(REPO_ROOT, "tools", "scale_bench.py"),
+        )
+        sb = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(sb)
+        row = sb.run_sharded_sweep(100000, 8, 4)
+        assert row["steady_writes_total"] == 0
+        assert row["steady_pass_p50_ms"] <= 65.0
+        assert row["rebuild_amortized_ms_per_pass"] <= 65.0
+        assert row["lease_cache_narrowed"] is True
 
 
 @pytest.mark.remediation

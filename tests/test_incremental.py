@@ -159,6 +159,14 @@ class World:
         cms = {
             cm["metadata"]["name"]: cm.get("data", {})
             for cm in self.fake.list("v1", "ConfigMap", namespace=NS)
+            # the persisted contribution cache is replica-local resume
+            # state keyed by lease resourceVersions — rvs differ
+            # between the mirrored fakes by construction (different
+            # write counts), and the reference world (modeling the
+            # pre-sharding pipeline) writes none at all
+            if not cm["metadata"]["name"].startswith(
+                "tpunet-contribcache-"
+            )
         }
         nodes = {
             n["metadata"]["name"]: n["metadata"].get("labels", {}) or {}

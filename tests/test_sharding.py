@@ -1,0 +1,834 @@
+"""Horizontal control-plane sharding + persisted contribution cache.
+
+The PR 11 contract (controller/sharding.py + controller/contribcache.py):
+
+* policies hash-partition across replicas via rendezvous hashing over
+  per-replica heartbeat Leases; shard ownership rides ``tpunet-shard-<i>``
+  Leases with the leader-election CAS contract, so **two replicas never
+  own one shard** and a membership change re-homes only the affected
+  shards (bounded handoff, never a fleet-wide storm);
+* a sharded Manager enqueues/reconciles only owned policies, narrows
+  the fleet-sized informer caches to its slice, and releases in-memory
+  state on handoff without external writes;
+* derived per-node contributions checkpoint into owned ConfigMaps so a
+  restarted/failed-over replica **resumes** — re-deriving only leases
+  whose resourceVersion moved — and the cache is invalidated on spec-
+  generation change and agent-version-skew flips (a stale signature
+  must never let a replica skip a node whose projection semantics
+  changed).
+"""
+
+import json
+
+import pytest
+
+from tpu_network_operator.agent import report as rpt
+from tpu_network_operator.api.v1alpha1 import (
+    NetworkClusterPolicy,
+    default_policy,
+)
+from tpu_network_operator.api.v1alpha1.types import API_VERSION
+from tpu_network_operator.controller import contribcache
+from tpu_network_operator.controller.health import Metrics
+from tpu_network_operator.controller.manager import Manager
+from tpu_network_operator.controller.reconciler import (
+    NetworkClusterPolicyReconciler,
+)
+from tpu_network_operator.controller.sharding import (
+    SHARD_LEASE_PREFIX,
+    ShardAggregator,
+    ShardCoordinator,
+    preferred_owner,
+    shard_of_policy,
+)
+from tpu_network_operator.kube.chaos import FAULT_503, FaultInjector
+from tpu_network_operator.kube.fake import FakeCluster
+from tpu_network_operator.kube.informer import CachedClient
+from tpu_network_operator.obs import EventRecorder
+
+NS = "tpunet-system"
+
+pytestmark = pytest.mark.sharding
+
+
+# -- pure partition math -----------------------------------------------------
+
+
+class TestPartitionMath:
+    def test_shard_of_policy_stable_and_bounded(self):
+        for name in ("a", "policy-x", "z" * 64):
+            s = shard_of_policy(name, 7)
+            assert 0 <= s < 7
+            assert s == shard_of_policy(name, 7)
+        assert shard_of_policy("anything", 1) == 0
+
+    def test_preferred_owner_deterministic(self):
+        members = [f"replica-{i}" for i in range(5)]
+        for shard in range(16):
+            a = preferred_owner(shard, members)
+            b = preferred_owner(shard, list(reversed(members)))
+            assert a == b
+        assert preferred_owner(3, []) == ""
+
+    def test_hrw_member_removal_moves_only_its_shards(self):
+        """The rendezvous property the bounded handoff rests on: kill
+        one member and ONLY the shards it owned re-home — every other
+        shard keeps its owner."""
+        members = [f"replica-{i}" for i in range(4)]
+        before = {s: preferred_owner(s, members) for s in range(32)}
+        survivors = [m for m in members if m != "replica-2"]
+        after = {s: preferred_owner(s, survivors) for s in range(32)}
+        for shard in range(32):
+            if before[shard] != "replica-2":
+                assert after[shard] == before[shard], shard
+        moved = [s for s in range(32) if before[s] == "replica-2"]
+        assert moved, "degenerate hash: replica-2 owned nothing"
+
+    def test_hrw_join_steals_only_what_it_wins(self):
+        members = [f"replica-{i}" for i in range(3)]
+        before = {s: preferred_owner(s, members) for s in range(32)}
+        grown = members + ["replica-new"]
+        after = {s: preferred_owner(s, grown) for s in range(32)}
+        for shard in range(32):
+            if after[shard] != "replica-new":
+                assert after[shard] == before[shard], shard
+
+    def test_shards_spread_over_members(self):
+        members = [f"replica-{i}" for i in range(4)]
+        owners = {preferred_owner(s, members) for s in range(64)}
+        assert len(owners) == 4
+
+
+# -- coordinator over the fake apiserver -------------------------------------
+
+
+def make_coord(fake, ident, clock, n_shards=4, lease_duration=30.0):
+    return ShardCoordinator(
+        fake, NS, n_shards=n_shards, identity=ident,
+        lease_duration=lease_duration, clock=clock,
+    )
+
+
+class TestShardCoordinator:
+    def test_single_replica_owns_everything(self):
+        fake = FakeCluster()
+        now = [1000.0]
+        a = make_coord(fake, "a", lambda: now[0])
+        gained, lost = a.sync()
+        assert a.owned == {0, 1, 2, 3} and gained == {0, 1, 2, 3}
+        assert not lost
+        assert a.owns("any-policy")
+
+    def test_two_replicas_split_disjoint_and_cover(self):
+        fake = FakeCluster()
+        now = [1000.0]
+        a = make_coord(fake, "a", lambda: now[0])
+        b = make_coord(fake, "b", lambda: now[0])
+        a.sync()
+        b.sync()     # b heartbeats; membership now {a, b}
+        a.sync()     # a releases what b now prefers
+        b.sync()     # b acquires it
+        assert a.owned | b.owned == {0, 1, 2, 3}
+        assert not (a.owned & b.owned)
+
+    def test_two_leaders_never_an_unexpired_lease_is_not_stolen(self):
+        """A replica that believes it should own a shard must still
+        wait for the incumbent's Lease to expire or be released."""
+        fake = FakeCluster()
+        now = [1000.0]
+        a = make_coord(fake, "a", lambda: now[0])
+        a.sync()
+        # b appears and prefers some of a's shards — but a's Leases
+        # are fresh, and a has not yet released: b gets NOTHING of
+        # a's current holdings this round
+        b = make_coord(fake, "b", lambda: now[0])
+        b.sync()
+        assert not (a.owned & b.owned)
+        for shard in a.owned:
+            lease = fake.get(
+                "coordination.k8s.io/v1", "Lease",
+                f"{SHARD_LEASE_PREFIX}{shard}", NS,
+            )
+            assert lease["spec"]["holderIdentity"] == a.identity
+
+    def test_crash_failover_on_lease_expiry(self):
+        fake = FakeCluster()
+        now = [1000.0]
+        a = make_coord(fake, "a", lambda: now[0])
+        b = make_coord(fake, "b", lambda: now[0])
+        for c in (a, b, a, b):
+            c.sync()
+        a_shards = set(a.owned)
+        assert a_shards
+        # a crashes (no release); b cannot take over until expiry
+        b.sync()
+        assert not (b.owned & a_shards)
+        now[0] += 120.0
+        b.sync()
+        assert b.owned == {0, 1, 2, 3}
+
+    def test_clean_stop_releases_for_immediate_handoff(self):
+        fake = FakeCluster()
+        now = [1000.0]
+        a = make_coord(fake, "a", lambda: now[0])
+        b = make_coord(fake, "b", lambda: now[0])
+        for c in (a, b, a, b):
+            c.sync()
+        a.stop()
+        # no expiry wait: released Leases hand off on b's next round
+        b.sync()
+        assert b.owned == {0, 1, 2, 3}
+
+    def test_join_rebalance_is_bounded(self):
+        """A third replica joining moves only the shards it wins —
+        shards it does not win keep their current owner (no fleet-wide
+        reshuffle)."""
+        fake = FakeCluster()
+        now = [1000.0]
+        a = make_coord(fake, "a", lambda: now[0], n_shards=8)
+        b = make_coord(fake, "b", lambda: now[0], n_shards=8)
+        for c in (a, b, a, b):
+            c.sync()
+        before = {}
+        for shard in a.owned:
+            before[shard] = "a"
+        for shard in b.owned:
+            before[shard] = "b"
+        c3 = make_coord(fake, "c", lambda: now[0], n_shards=8)
+        c3.sync()
+        for c in (a, b, c3, a, b, c3):
+            c.sync()
+        members = ["a", "b", "c"]
+        for shard in range(8):
+            want = preferred_owner(shard, members)
+            if want != "c":
+                # unmoved shards kept their original owner
+                assert before[shard] == want
+
+
+# -- sharded manager ---------------------------------------------------------
+
+
+def make_policy(name):
+    p = NetworkClusterPolicy()
+    p.metadata.name = name
+    p.spec.configuration_type = "tpu-so"
+    p.spec.node_selector = {"tpunet.dev/pool": name}
+    p.spec.tpu_scale_out.probe.enabled = True
+    p.spec.tpu_scale_out.probe.interval_seconds = 5
+    return default_policy(p).to_dict()
+
+
+def healthy_report(pname, node, i, version="1.0"):
+    return rpt.ProvisioningReport(
+        node=node, policy=pname, ok=True, backend="tpu", mode="L2",
+        interfaces_configured=2, interfaces_total=2,
+        agent_version=version,
+        probe_endpoint=f"10.1.{i // 256}.{i % 256}:8477",
+        probe={
+            "peersTotal": 3, "peersReachable": 3, "unreachable": [],
+            "rttP50Ms": 0.4, "rttP99Ms": 1.0, "lossRatio": 0.0,
+            "state": "Healthy",
+        },
+        telemetry={"interfaces": {"eth0": {
+            "rxBytes": 1000 + i, "rxErrors": 0, "txErrors": 0,
+            "rxPackets": 900, "txPackets": 800, "errorRatio": 0.0,
+        }}},
+    )
+
+
+class ShardedWorld:
+    """Shared FakeCluster + N sharded replicas (CachedClient + Manager
+    + ShardCoordinator on an injected clock)."""
+
+    # four policy names whose hash shards land on BOTH replicas of the
+    # canonical 2-replica/4-shard split (pol-0..3 degenerately all
+    # hash onto one replica's shards — a legal partition, but the
+    # tests want churn on both sides)
+    POLICY_NAMES = ("pol-5", "pol-6", "pol-12", "pol-13")
+
+    def __init__(self, n_replicas=2, n_shards=4, nodes=6,
+                 inject=False):
+        self.fake = FakeCluster()
+        self.client = (
+            FaultInjector(self.fake, seed=7) if inject else self.fake
+        )
+        self.now = [1000.0]
+        self.policies = list(self.POLICY_NAMES)
+        self.nodes = {}
+        for pname in self.policies:
+            self.fake.create(make_policy(pname))
+            self.nodes[pname] = []
+            for i in range(nodes):
+                node = f"{pname}-n{i}"
+                self.nodes[pname].append(node)
+                self.fake.add_node(node, {"tpunet.dev/pool": pname})
+                self.fake.apply(
+                    rpt.lease_for(healthy_report(pname, node, i), NS)
+                )
+        self.replicas = []
+        for r in range(n_replicas):
+            split = CachedClient(self.client)
+            split.cache(API_VERSION, "NetworkClusterPolicy")
+            split.cache("apps/v1", "DaemonSet", namespace=NS)
+            split.cache("v1", "Pod", namespace=NS)
+            split.cache(rpt.LEASE_API, "Lease", namespace=NS)
+            split.cache("v1", "Node")
+            coord = ShardCoordinator(
+                self.client, NS, n_shards=n_shards,
+                identity=f"replica-{r}", lease_duration=30.0,
+                clock=lambda: self.now[0],
+            )
+            metrics = Metrics()
+            mgr = Manager(
+                split, NS, metrics=metrics,
+                events=EventRecorder(self.client, NS, metrics=metrics),
+                sharding=coord,
+                aggregator=ShardAggregator(self.client, NS,
+                                           metrics=metrics),
+            )
+            mgr.reconciler.REPORT_CACHE_SECONDS = 0.0
+            self.replicas.append((split, coord, mgr, metrics))
+        for _, coord, _, _ in self.replicas:
+            coord.sync()
+        for split, _, mgr, _ in self.replicas:
+            mgr._install_interest()
+            split.start()
+            mgr.reconciler.setup()
+            mgr.shard_sync()
+
+    def converge(self):
+        for _ in range(3):
+            for _, coord, mgr, _ in self.replicas:
+                for pname in self.policies:
+                    if coord.owns(pname):
+                        mgr.enqueue(pname)
+                mgr.drain(max_iters=300)
+            self.fake.simulate_daemonset_controller()
+        for _, coord, mgr, _ in self.replicas:
+            for pname in self.policies:
+                if coord.owns(pname):
+                    mgr.enqueue(pname)
+            mgr.drain(max_iters=300)
+
+    def checkpoint_all(self):
+        """Force one checkpointing rebuild per owned policy."""
+        for _, coord, mgr, _ in self.replicas:
+            for pname in self.policies:
+                if coord.owns(pname) and (
+                    pname in mgr.reconciler._pass_state
+                ):
+                    mgr.reconciler._pass_state[
+                        pname
+                    ].rebuild_due_probe = 0.0
+                    mgr.enqueue(pname)
+            mgr.drain(max_iters=300)
+
+    def writes(self):
+        return {
+            k: v for k, v in self.fake.request_counts.items()
+            if k[0] in ("create", "update", "patch", "delete")
+        }
+
+    def stop(self):
+        for split, _, _, _ in self.replicas:
+            split.stop()
+
+
+class TestShardedManager:
+    def test_partition_covers_policies_and_filters_enqueue(self):
+        w = ShardedWorld()
+        try:
+            (s0, c0, m0, _), (s1, c1, m1, _) = w.replicas
+            owned0 = {p for p in w.policies if c0.owns(p)}
+            owned1 = {p for p in w.policies if c1.owns(p)}
+            assert owned0 | owned1 == set(w.policies)
+            assert not (owned0 & owned1)
+            # the enqueue filter: a non-owned policy never enters the
+            # queue
+            for pname in w.policies:
+                m0.enqueue(pname)
+            assert len(m0._queue) == len(owned0)
+        finally:
+            w.stop()
+
+    def test_converge_then_interest_narrows_lease_cache(self):
+        w = ShardedWorld()
+        try:
+            w.converge()
+            total = sum(len(v) for v in w.nodes.values())
+            for split, coord, _, _ in w.replicas:
+                store = split.informer(rpt.LEASE_API, "Lease").store
+                agent_leases = [
+                    obj for obj in store.list(copy_objects=False)
+                    if (
+                        obj["metadata"].get("labels", {}) or {}
+                    ).get(rpt.AGENT_LABEL) == "true"
+                ]
+                owned_nodes = {
+                    node for p in w.policies if coord.owns(p)
+                    for node in w.nodes[p]
+                }
+                # exactly the owned slice — never another replica's
+                # policies' leases (and in particular never the fleet,
+                # unless this replica legitimately owns every policy)
+                assert len(agent_leases) == len(owned_nodes)
+                assert {
+                    obj["spec"]["holderIdentity"]
+                    for obj in agent_leases
+                } == owned_nodes
+                assert len(owned_nodes) < total
+            # every policy converged to All good via its owner
+            for pname in w.policies:
+                cr = w.fake.get(
+                    API_VERSION, "NetworkClusterPolicy", pname
+                )
+                assert cr["status"]["state"] == "All good", pname
+        finally:
+            w.stop()
+
+    def test_handoff_releases_memory_and_transfers_ownership(self):
+        w = ShardedWorld()
+        try:
+            w.converge()
+            w.checkpoint_all()
+            (s0, c0, m0, _), (s1, c1, m1, met1) = w.replicas
+            victims = {p for p in w.policies if c0.owns(p)}
+            assert victims
+            # replica-0 crashes: expire its leases, replica-1 syncs
+            w.now[0] += 120.0
+            m1.shard_sync()
+            assert c1.owned == {0, 1, 2, 3}
+            m1.drain(max_iters=300)
+            for pname in victims:
+                assert pname in m1.reconciler._derived
+            # the departed replica's in-memory state for a LOST policy
+            # is dropped by release (simulate it re-syncing after
+            # resurrection)
+            w.now[0] += 0.0
+            m0.shard_sync()     # a's HRW now loses to b's held leases
+            for pname in victims:
+                if not c0.owns(pname):
+                    assert pname not in m0.reconciler._derived
+        finally:
+            w.stop()
+
+    def test_aggregator_publishes_rollups_and_fleet_fold(self):
+        w = ShardedWorld()
+        try:
+            w.converge()
+            for _, _, mgr, _ in w.replicas:
+                mgr.shard_sync()
+            cms = [
+                cm for cm in w.fake.list("v1", "ConfigMap", namespace=NS)
+                if cm["metadata"]["name"].startswith(
+                    "tpunet-shard-rollup-"
+                )
+            ]
+            assert cms
+            covered = set()
+            for cm in cms:
+                row = json.loads(cm["data"]["rollup"])
+                covered.update(row["policies"])
+            assert covered == set(w.policies)
+            # shard-0's owner exported the fleet fold
+            fleet = {}
+            for _, coord, _, metrics in w.replicas:
+                if coord.owns_shard(0):
+                    fleet = {
+                        k[0]: v for k, v in metrics._gauges.items()
+                        if k[0].startswith("tpunet_fleet_")
+                    }
+            assert fleet.get("tpunet_fleet_policies") == len(w.policies)
+            total = sum(len(v) for v in w.nodes.values())
+            assert fleet.get("tpunet_fleet_nodes") == total
+            assert fleet.get("tpunet_fleet_ready_nodes") == total
+            # steady: a second sync republishes nothing (diff-gated)
+            before = w.writes()
+            for _, _, mgr, _ in w.replicas:
+                mgr.shard_sync()
+            after = w.writes()
+            non_lease = {
+                k: after.get(k, 0) - before.get(k, 0)
+                for k in after
+                if k[1] != "Lease"
+                and after.get(k, 0) != before.get(k, 0)
+            }
+            assert non_lease == {}
+        finally:
+            w.stop()
+
+
+# -- persisted contribution cache --------------------------------------------
+
+
+def build_reconciler(fake):
+    split = CachedClient(fake)
+    split.cache(API_VERSION, "NetworkClusterPolicy")
+    split.cache("apps/v1", "DaemonSet", namespace=NS)
+    split.cache("v1", "Pod", namespace=NS)
+    split.cache(rpt.LEASE_API, "Lease", namespace=NS)
+    split.cache("v1", "Node")
+    split.start()
+    rec = NetworkClusterPolicyReconciler(split, NS, metrics=Metrics())
+    rec.REPORT_CACHE_SECONDS = 0.0
+    rec.setup()
+    return split, rec
+
+
+def seed_fleet(fake, pname="pol-0", nodes=8, version="1.0"):
+    fake.create(make_policy(pname))
+    for i in range(nodes):
+        node = f"{pname}-n{i}"
+        fake.add_node(node, {"tpunet.dev/pool": pname})
+        fake.apply(rpt.lease_for(
+            healthy_report(pname, node, i, version=version), NS
+        ))
+
+
+def resumed_count(rec, source=None):
+    return sum(
+        v for (name, labels), v in rec.metrics._counters.items()
+        if name == "tpunet_rebuild_resumed_nodes_total"
+        and (source is None or ("source", source) in labels)
+    )
+
+
+class TestContribCache:
+    def test_encode_decode_round_trip_preserves_signatures(self):
+        fake = FakeCluster()
+        seed_fleet(fake, nodes=3)
+        split, rec = build_reconciler(fake)
+        try:
+            rec.reconcile("pol-0")
+            fake.simulate_daemonset_controller()
+            rec.reconcile("pol-0")
+            d = rec._derived["pol-0"]
+            assert d.contribs
+            for lease, c in d.contribs.items():
+                entry = json.loads(json.dumps(
+                    contribcache.encode_entry(c)
+                ))
+                back = contribcache.decode_entry(lease, entry, c.report)
+                # shard_key is bound by the aggregate's key function at
+                # add time (add_fresh re-keys on resume), not persisted
+                back.shard_key = c.shard_key
+                for section in ("head", "peers", "probe", "telem",
+                                "plan", "rem", "summary"):
+                    sig = section + "_sig"
+                    assert getattr(back, sig)() == getattr(c, sig)(), (
+                        lease, section,
+                    )
+                assert back.rv == c.rv and back.renewed == c.renewed
+        finally:
+            split.stop()
+
+    def test_checkpoint_written_once_and_diff_gated(self):
+        fake = FakeCluster()
+        seed_fleet(fake)
+        split, rec = build_reconciler(fake)
+        try:
+            rec.reconcile("pol-0")
+            fake.simulate_daemonset_controller()
+            rec.reconcile("pol-0")
+            cms = [
+                cm for cm in fake.list("v1", "ConfigMap", namespace=NS)
+                if cm["metadata"]["name"].startswith(
+                    "tpunet-contribcache-"
+                )
+            ]
+            assert cms, "no checkpoint written"
+            # owner-ref'd to the CR (GC on delete)
+            assert any(
+                ref.get("controller")
+                for ref in cms[0]["metadata"]["ownerReferences"]
+            )
+            # a second forced rebuild with no churn writes nothing
+            before = dict(fake.request_counts)
+            rec._pass_state["pol-0"].rebuild_due_probe = 0.0
+            rec.reconcile("pol-0")
+            after = dict(fake.request_counts)
+            writes = {
+                k: after.get(k, 0) - before.get(k, 0)
+                for k in after
+                if k[0] in ("create", "update", "patch", "delete")
+                and after.get(k, 0) != before.get(k, 0)
+            }
+            assert writes == {}
+        finally:
+            split.stop()
+
+    def test_restart_resumes_without_rederive_or_writes(self):
+        fake = FakeCluster()
+        seed_fleet(fake, nodes=8)
+        split, rec = build_reconciler(fake)
+        rec.reconcile("pol-0")
+        fake.simulate_daemonset_controller()
+        rec.reconcile("pol-0")
+        split.stop()
+        status_before = fake.get(
+            API_VERSION, "NetworkClusterPolicy", "pol-0"
+        )["status"]
+        before = dict(fake.request_counts)
+        split2, rec2 = build_reconciler(fake)
+        try:
+            rec2.reconcile("pol-0")
+            assert resumed_count(rec2, "persisted") == 8
+            after = dict(fake.request_counts)
+            writes = {
+                k: after.get(k, 0) - before.get(k, 0)
+                for k in after
+                if k[0] in ("create", "update", "patch", "delete")
+                and after.get(k, 0) != before.get(k, 0)
+            }
+            assert writes == {}, writes
+            status_after = fake.get(
+                API_VERSION, "NetworkClusterPolicy", "pol-0"
+            )["status"]
+            assert status_after == status_before
+        finally:
+            split2.stop()
+
+    def test_restart_rederives_only_churned_leases(self):
+        fake = FakeCluster()
+        seed_fleet(fake, nodes=8)
+        split, rec = build_reconciler(fake)
+        rec.reconcile("pol-0")
+        fake.simulate_daemonset_controller()
+        rec.reconcile("pol-0")
+        split.stop()
+        # two nodes churn after the checkpoint
+        for i in (2, 5):
+            rep = healthy_report("pol-0", f"pol-0-n{i}", i)
+            rep.ok = False
+            rep.error = "link down"
+            rep.probe["peersReachable"] = 0
+            rep.probe["state"] = "Degraded"
+            fake.apply(rpt.lease_for(rep, NS))
+        split2, rec2 = build_reconciler(fake)
+        try:
+            rec2.reconcile("pol-0")
+            assert resumed_count(rec2, "persisted") == 6
+            status = fake.get(
+                API_VERSION, "NetworkClusterPolicy", "pol-0"
+            )["status"]
+            assert status["state"] == "Working on it.."
+            assert status["ready"] == 6
+        finally:
+            split2.stop()
+
+    def test_degraded_nodes_never_resume_from_cache(self):
+        """Quarantine streaks are controller-clock state a signature
+        cannot carry: a node checkpointed below quorum must re-derive
+        on resume even with an unchanged lease."""
+        fake = FakeCluster()
+        seed_fleet(fake, nodes=4)
+        rep = healthy_report("pol-0", "pol-0-n0", 0)
+        rep.probe["peersReachable"] = 0
+        rep.probe["state"] = "Degraded"
+        fake.apply(rpt.lease_for(rep, NS))
+        split, rec = build_reconciler(fake)
+        rec.reconcile("pol-0")
+        fake.simulate_daemonset_controller()
+        rec.reconcile("pol-0")
+        split.stop()
+        split2, rec2 = build_reconciler(fake)
+        try:
+            rec2.reconcile("pol-0")
+            assert resumed_count(rec2, "persisted") == 3
+        finally:
+            split2.stop()
+
+    def test_invalidated_on_spec_generation_change(self):
+        """Small-fix satellite, edge 1: a spec change between the
+        checkpoint and the restart discards the whole cache — stale
+        signatures must never satisfy a new projection."""
+        fake = FakeCluster()
+        seed_fleet(fake, nodes=6)
+        split, rec = build_reconciler(fake)
+        rec.reconcile("pol-0")
+        fake.simulate_daemonset_controller()
+        rec.reconcile("pol-0")
+        split.stop()
+        cr = fake.get(API_VERSION, "NetworkClusterPolicy", "pol-0")
+        cr["spec"]["tpuScaleOut"]["mtu"] = 9000
+        fake.update(cr)
+        split2, rec2 = build_reconciler(fake)
+        try:
+            rec2.reconcile("pol-0")
+            assert resumed_count(rec2, "persisted") == 0
+        finally:
+            split2.stop()
+
+    def test_invalidated_on_agent_version_skew_flip(self):
+        """Small-fix satellite, edge 2: the fleet version set moving
+        between checkpoint and resume distrusts every resumed entry —
+        even entries whose own lease never changed."""
+        fake = FakeCluster()
+        seed_fleet(fake, nodes=6, version="1.0")
+        split, rec = build_reconciler(fake)
+        rec.reconcile("pol-0")
+        fake.simulate_daemonset_controller()
+        rec.reconcile("pol-0")
+        split.stop()
+        # one agent upgrades (its lease rv moves — it would re-derive
+        # anyway); the OTHER five must also re-derive, because the
+        # fleet's version set flipped
+        fake.apply(rpt.lease_for(
+            healthy_report("pol-0", "pol-0-n0", 0, version="2.0"), NS
+        ))
+        split2, rec2 = build_reconciler(fake)
+        try:
+            rec2.reconcile("pol-0")
+            assert resumed_count(rec2, "persisted") == 0
+            status = fake.get(
+                API_VERSION, "NetworkClusterPolicy", "pol-0"
+            )["status"]
+            assert status["agentVersions"] == {"1.0": 5, "2.0": 1}
+        finally:
+            split2.stop()
+
+    def test_cache_disabled_by_zero_budget(self):
+        fake = FakeCluster()
+        seed_fleet(fake)
+        split = CachedClient(fake)
+        split.cache(API_VERSION, "NetworkClusterPolicy")
+        split.cache("apps/v1", "DaemonSet", namespace=NS)
+        split.cache("v1", "Pod", namespace=NS)
+        split.cache(rpt.LEASE_API, "Lease", namespace=NS)
+        split.start()
+        rec = NetworkClusterPolicyReconciler(split, NS, metrics=Metrics())
+        rec.CONTRIB_CACHE_BYTES = 0
+        rec.REPORT_CACHE_SECONDS = 0.0
+        rec.setup()
+        try:
+            rec.reconcile("pol-0")
+            fake.simulate_daemonset_controller()
+            rec.reconcile("pol-0")
+            assert not [
+                cm for cm in fake.list("v1", "ConfigMap", namespace=NS)
+                if cm["metadata"]["name"].startswith(
+                    "tpunet-contribcache-"
+                )
+            ]
+        finally:
+            split.stop()
+
+    def test_chunking_respects_byte_budget(self):
+        payloads = contribcache.build_payloads(
+            "pol-0", ("generation", 1), ["1.0"],
+            {
+                f"lease-{i}": contribcache.decode_entry(
+                    f"lease-{i}",
+                    contribcache.encode_entry(
+                        __import__(
+                            "tpu_network_operator.controller.derived",
+                            fromlist=["NodeContribution"],
+                        ).NodeContribution(
+                            lease=f"lease-{i}", node=f"n{i}",
+                            rv=str(i), ok=True,
+                        )
+                    ),
+                    None,
+                )
+                for i in range(64)
+            },
+            byte_budget=600,
+        )
+        assert len(payloads) > 1
+        metas = set()
+        merged = {}
+        for data in payloads.values():
+            assert len(data["entries"].encode()) <= 600
+            metas.add(data["meta"])
+            merged.update(json.loads(data["entries"]))
+        assert len(metas) == 1
+        assert len(merged) == 64
+        assert json.loads(metas.pop())["chunks"] == len(payloads)
+
+
+# -- failover under fault injection (satellite) ------------------------------
+
+
+class TestFailoverUnderFaults:
+    def test_mid_churn_failover_resumes_cleanly(self):
+        """Kill the owner of a shard mid-churn while the apiserver
+        throws intermittent 503s: the successor must acquire exactly
+        the departed shards, resume from the persisted cache
+        (re-deriving only churned leases), write no spurious status,
+        and emit no duplicate Events."""
+        w = ShardedWorld(inject=True)
+        try:
+            w.converge()
+            w.checkpoint_all()
+            (s0, c0, m0, _), (s1, c1, m1, met1) = w.replicas
+            victims = sorted(p for p in w.policies if c0.owns(p))
+            assert victims
+            departed_shards = set(c0.owned)
+            departed_nodes = sum(len(w.nodes[p]) for p in victims)
+            # churn: flip 2 nodes of the first victim policy AFTER the
+            # last checkpoint
+            churn_pol = victims[0]
+            for node in w.nodes[churn_pol][:2]:
+                i = int(node.rsplit("n", 1)[1])
+                rep = healthy_report(churn_pol, node, i)
+                rep.ok = False
+                rep.error = "link down"
+                rep.probe["peersReachable"] = 0
+                rep.probe["state"] = "Degraded"
+                w.fake.apply(rpt.lease_for(rep, NS))
+            events_before = {
+                (
+                    (e.get("involvedObject") or {}).get("name"),
+                    e.get("reason"), e.get("message"),
+                )
+                for e in w.fake.list("v1", "Event", namespace=NS)
+            }
+            writes_before = w.writes()
+            # replica-0 crashes; 503s start; replica-1 takes over
+            w.client.inject(FAULT_503, rate=0.05, count=10)
+            w.now[0] += 120.0
+            for _ in range(3):   # retry rounds absorb injected faults
+                m1.shard_sync()
+            assert departed_shards <= c1.owned
+            assert not (c0.owned & c1.owned) or c0.owned <= c1.owned
+            m1.drain(max_iters=500)
+            resumed = resumed_count(m1.reconciler, "persisted")
+            assert resumed >= departed_nodes - 2
+            # spurious-write audit: only the churned policy's status
+            # moved; nothing touched Nodes
+            writes_after = w.writes()
+            deltas = {
+                k: writes_after.get(k, 0) - writes_before.get(k, 0)
+                for k in writes_after
+                if writes_after.get(k, 0) != writes_before.get(k, 0)
+            }
+            assert deltas.get(("update", "NetworkClusterPolicy"), 0) <= 1
+            assert all(
+                k[1] != "Node" for k in deltas
+                if k[0] in ("update", "patch")
+            )
+            # no duplicate Events: every (obj, reason, message) new
+            # since the checkpoint appears once
+            new_events = [
+                e for e in w.fake.list("v1", "Event", namespace=NS)
+                if (
+                    (e.get("involvedObject") or {}).get("name"),
+                    e.get("reason"), e.get("message"),
+                ) not in events_before
+            ]
+            keys = [
+                (
+                    (e.get("involvedObject") or {}).get("name"),
+                    e.get("reason"), e.get("message"),
+                )
+                for e in new_events
+            ]
+            assert len(keys) == len(set(keys))
+            # the churned nodes are visible in the successor's status
+            cr = w.fake.get(
+                API_VERSION, "NetworkClusterPolicy", churn_pol
+            )
+            assert cr["status"]["state"] == "Working on it.."
+        finally:
+            w.stop()

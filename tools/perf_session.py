@@ -172,10 +172,17 @@ def main() -> int:
         # plus the delta-driven reconcile budgets: steady-pass p50
         # ≤ 65 ms at 10k via the fast path, and 1-node churn at 10k
         # within 2x of the 100-node churn pass (work ∝ delta, not
-        # fleet).  (no TPU, in-process FakeCluster + FakeFabric)
+        # fleet).  PR 11 adds the sharded control plane to the same
+        # phase: a 10k-node shard failover (the successor resumes from
+        # the persisted contribution cache, re-deriving only churned
+        # leases, with zero spurious writes and no duplicate Events)
+        # and the 100k-node hash-partitioned sweep (4 replicas, steady
+        # passes O(1) with 0 writes, drift rebuilds paid per-shard and
+        # amortized under the 65 ms steady budget) — all gated
+        # in-bench.  (no TPU, in-process FakeCluster + FakeFabric)
         maybe_run_phase(out, "scale-bench",
                   [py, "tools/scale_bench.py",
-                   "--out", "BENCH_scale.json"], timeout=900)
+                   "--out", "BENCH_scale.json"], timeout=3600)
         # 14. topology planner: planned DCN ring vs naive name-order
         # ring on seeded rack-structured RTT matrices (modeled
         # all-reduce latency, ≥20% budget), degraded-link exclusion

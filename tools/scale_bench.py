@@ -26,13 +26,34 @@ sampled probe mesh at degree k=8) measures, per size:
 * **peer ConfigMap count + max payload** — every shard under the byte
   budget (1 MiB etcd limit never decides membership).
 
+* **rebuild tiers** (PR 11): from-scratch serial vs parallel-fan-out
+  vs resumed drift rebuild (unchanged leases re-use their in-memory
+  contributions) — the 329→520 ms PR 9 regression ledger lives in the
+  artifact's ``notes``.
+
 A separate FakeFabric scenario then partitions one node of the
 2,000-node sampled topology and measures detection latency — the gate
 must flip within 3 probe intervals, and the node's k in-probers must
 all see it unreachable (a partition is observable from outside).
 
+Two sharded-control-plane scenarios complete the artifact:
+
+* **shard failover**: two replicas hash-partition the policy set via
+  per-shard Leases; the owner of half the shards is killed mid-churn
+  and the successor must take over exactly the departed shards,
+  resume from the persisted contribution cache (re-deriving ONLY the
+  leases that churned across the handoff), write no spurious status/
+  labels, and emit no duplicate Events;
+* **100k sharded sweep** (slow; ``--sharded-nodes 0`` skips): N
+  replicas × M policies at 100,000 total nodes — steady passes stay
+  O(1) with zero writes, informer caches hold only the owned slice,
+  and drift rebuilds are paid per-shard, amortizing under the 65 ms
+  steady budget.
+
 Usage: python tools/scale_bench.py [--nodes-list 100,2000,10000]
-       [--rounds 5] [--partition-nodes 2000] [--out BENCH_scale.json]
+       [--rounds 5] [--partition-nodes 2000]
+       [--failover-nodes 10000] [--sharded-nodes 100000]
+       [--out BENCH_scale.json]
 """
 
 from __future__ import annotations
@@ -200,15 +221,39 @@ def run_sweep(n_nodes: int, rounds: int, churn_rounds: int = 10):
             break
 
     # full-rebuild reference passes: the from-scratch pipeline the
-    # delta path must match byte-for-byte (and beat on latency)
+    # delta path must match byte-for-byte (and beat on latency) —
+    # measured serial AND fanned out across the rebuild worker pool
+    # (PR 11: contributions are independent per node; on a multi-core
+    # box the fan-out overlaps derivation, on one core it degrades to
+    # serial minus epsilon — both are recorded honestly)
     latencies = []
     rec.FULL_REBUILD_ALWAYS = True
+    rec.rebuild_workers = 1
     for _ in range(rounds):
         t0 = time.perf_counter()
         rec.reconcile(POLICY)
         latencies.append(time.perf_counter() - t0)
+    par_lat = []
+    rec.rebuild_workers = 4
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        rec.reconcile(POLICY)
+        par_lat.append(time.perf_counter() - t0)
+    rec.rebuild_workers = 0
     rec.FULL_REBUILD_ALWAYS = False
     rec.reconcile(POLICY)   # fold back into delta mode (one rebuild)
+
+    # drift rebuilds with contribution reuse: the PRODUCTION periodic
+    # rebuild path (every FULL_REBUILD_SECONDS) — unchanged leases
+    # re-use their in-memory contributions, so the pass re-derives
+    # only what churned (here: nothing) while still folding the
+    # aggregates from scratch
+    resumed_lat = []
+    for _ in range(rounds):
+        rec._pass_state[POLICY].rebuild_due_probe = 0.0
+        t0 = time.perf_counter()
+        rec.reconcile(POLICY)
+        resumed_lat.append(time.perf_counter() - t0)
 
     # steady state: the delta fast path — no deltas, no timer work
     steady_lat = []
@@ -275,6 +320,12 @@ def run_sweep(n_nodes: int, rounds: int, churn_rounds: int = 10):
         "nodes": n_nodes,
         "reconcile_p50_ms": round(pctile(lat_sorted, 0.5) * 1e3, 2),
         "reconcile_p95_ms": round(pctile(lat_sorted, 0.95) * 1e3, 2),
+        "rebuild_parallel_p50_ms": round(
+            pctile(sorted(par_lat), 0.5) * 1e3, 2
+        ),
+        "rebuild_resumed_p50_ms": round(
+            pctile(sorted(resumed_lat), 0.5) * 1e3, 2
+        ),
         "steady_pass_p50_ms": round(
             pctile(sorted(steady_lat), 0.5) * 1e3, 3
         ),
@@ -379,12 +430,408 @@ def run_partition(n_nodes: int):
     return row
 
 
+def sharded_policy(name: str, pool: str):
+    from tpu_network_operator.api.v1alpha1 import (
+        NetworkClusterPolicy,
+        default_policy,
+    )
+
+    p = NetworkClusterPolicy()
+    p.metadata.name = name
+    p.spec.configuration_type = "tpu-so"
+    p.spec.node_selector = {"tpunet.dev/pool": pool}
+    p.spec.tpu_scale_out.probe.enabled = True
+    p.spec.tpu_scale_out.probe.interval_seconds = PROBE_INTERVAL
+    p.spec.tpu_scale_out.probe.degree = DEGREE
+    return default_policy(p).to_dict()
+
+
+class Replica:
+    """One sharded controller replica: CachedClient + Manager +
+    ShardCoordinator over a shared FakeCluster, with the coordinator
+    clock injected so the scenario (not wall time) decides lease
+    expiry."""
+
+    def __init__(self, fake, ident, n_shards, clock, lease_duration=30.0):
+        from tpu_network_operator.agent import report as rpt
+        from tpu_network_operator.api.v1alpha1.types import API_VERSION
+        from tpu_network_operator.controller.health import Metrics
+        from tpu_network_operator.controller.manager import Manager
+        from tpu_network_operator.controller.sharding import (
+            ShardAggregator,
+            ShardCoordinator,
+        )
+        from tpu_network_operator.kube.informer import CachedClient
+
+        self.fake = fake
+        self.metrics = Metrics()
+        self.split = CachedClient(fake)
+        self.split.cache(API_VERSION, "NetworkClusterPolicy")
+        self.split.cache("apps/v1", "DaemonSet", namespace=NAMESPACE)
+        self.split.cache(rpt.LEASE_API, "Lease", namespace=NAMESPACE)
+        # Pods/Nodes deliberately uncached in the sharded harness:
+        # pods are not materialized at this scale and the rack map's
+        # TTL'd pass-through list is paid once per run
+        from tpu_network_operator.obs import EventRecorder
+
+        self.coord = ShardCoordinator(
+            fake, NAMESPACE, n_shards=n_shards, identity=ident,
+            lease_duration=lease_duration, clock=clock,
+            metrics=self.metrics,
+        )
+        self.mgr = Manager(
+            self.split, NAMESPACE, metrics=self.metrics,
+            events=EventRecorder(fake, NAMESPACE, metrics=self.metrics),
+            sharding=self.coord,
+            aggregator=ShardAggregator(
+                fake, NAMESPACE, metrics=self.metrics
+            ),
+        )
+        self.rec = self.mgr.reconciler
+        self.rec.REPORT_CACHE_SECONDS = 0.0
+
+    def start(self):
+        # interest BEFORE the informer seed lists, so the Lease store
+        # only ever holds this replica's slice
+        self.mgr._install_interest()
+        self.split.start()
+        self.rec.setup()
+
+    def owned_policies(self, names):
+        return [n for n in names if self.coord.owns(n)]
+
+    def drain(self):
+        self.mgr.drain(max_iters=500)
+
+    def counter(self, name):
+        return sum(
+            v for (n, _), v in self.metrics._counters.items() if n == name
+        )
+
+    def stop(self):
+        self.split.stop()
+
+
+def run_failover(n_nodes: int, n_policies: int = 4, churn: int = 50):
+    """Kill one of two sharded replicas mid-run and prove the handoff
+    contract: the successor acquires exactly the departed shards,
+    resumes from the persisted contribution cache (re-deriving ONLY
+    the leases that churned across the failover, never the fleet),
+    performs zero spurious status/label writes for unchurned policies,
+    emits no duplicate Events, and at no instant do two replicas own
+    one shard (two-leaders-never, per shard)."""
+    from tpu_network_operator.agent import report as rpt
+    from tpu_network_operator.api.v1alpha1.types import API_VERSION
+    from tpu_network_operator.controller.sharding import shard_of_policy
+    from tpu_network_operator.kube.fake import FakeCluster
+
+    log(f"== shard failover: {n_nodes} nodes, {n_policies} policies, "
+        f"2 replicas, {churn}-node churn across the handoff")
+    per = n_nodes // n_policies
+    fake = FakeCluster()
+    policies = [f"shard-pol-{i}" for i in range(n_policies)]
+    node_of = {}
+    for p_idx, pname in enumerate(policies):
+        fake.create(sharded_policy(pname, pname))
+        for i in range(per):
+            node = f"{pname}-n{i:05d}"
+            node_of.setdefault(pname, []).append(node)
+            fake.add_node(node, {
+                "tpunet.dev/pool": pname,
+                "tpunet.dev/rack": f"rack-{p_idx:02d}-{i // RACK_SIZE:04d}",
+            })
+            rep = healthy_report(node, p_idx * per + i)
+            rep.policy = pname
+            rep.node = node
+            fake.apply(rpt.lease_for(rep, NAMESPACE))
+
+    now = [1_000_000.0]
+    clock = lambda: now[0]   # noqa: E731
+    n_shards = 4
+    a = Replica(fake, "replica-a", n_shards, clock)
+    b = Replica(fake, "replica-b", n_shards, clock)
+    # membership settles over two rounds (everyone heartbeats first)
+    a.coord.sync()
+    b.coord.sync()
+    a.start()
+    b.start()
+    overlap_violations = 0
+    for r in (a, b):
+        r.mgr.shard_sync()
+        if a.coord.owned & b.coord.owned:
+            overlap_violations += 1
+    for pname in policies:
+        owner = a if a.coord.owns(pname) else b
+        owner.mgr.enqueue(pname)
+    for _ in range(4):
+        a.drain()
+        b.drain()
+        fake.simulate_daemonset_controller(materialize_pods=False)
+    for r in (a, b):
+        for pname in r.owned_policies(policies):
+            r.mgr.enqueue(pname)
+        r.drain()
+    # force one checkpointing rebuild per policy so the persisted
+    # cache reflects the converged fleet
+    for r in (a, b):
+        for pname in r.owned_policies(policies):
+            if pname in r.rec._pass_state:
+                r.rec._pass_state[pname].rebuild_due_probe = 0.0
+            r.mgr.enqueue(pname)
+        r.drain()
+
+    a_policies = a.owned_policies(policies)
+    departed_shards = sorted(a.coord.owned)
+    departed_nodes = sum(len(node_of[p]) for p in a_policies)
+    assert a_policies, "replica-a owns nothing; rebalance the hash"
+
+    # churn K nodes of replica-a's policies AFTER its last checkpoint:
+    # exactly these must re-derive on the successor
+    churned = 0
+    churned_policies = set()
+    for pname in a_policies:
+        for node in node_of[pname]:
+            if churned >= churn:
+                break
+            i = int(node.rsplit("n", 1)[1])
+            rep = healthy_report(node, i)
+            rep.policy = pname
+            rep.node = node
+            rep.ok = False
+            rep.error = "link eth1 down"
+            rep.probe["peersReachable"] = 0
+            rep.probe["state"] = "Degraded"
+            fake.apply(rpt.lease_for(rep, NAMESPACE))
+            churned += 1
+            churned_policies.add(pname)
+
+    # kill replica-a (no release — a crash, not a drain) and expire
+    # its leases; replica-b's next sync round takes over
+    writes_before = {
+        k: v for k, v in fake.request_counts.items()
+        if k[0] in ("create", "update", "patch", "delete")
+    }
+    events_before = len(fake.list("v1", "Event", namespace=NAMESPACE))
+    resumed_before = b.counter("tpunet_rebuild_resumed_nodes_total")
+    now[0] += 120.0   # > lease_duration: a's heartbeat + shards expire
+    b.mgr.shard_sync()
+    takeover_ok = set(departed_shards) <= b.coord.owned
+    t0 = time.perf_counter()
+    b.drain()
+    takeover_seconds = time.perf_counter() - t0
+    writes_after = {
+        k: v for k, v in fake.request_counts.items()
+        if k[0] in ("create", "update", "patch", "delete")
+    }
+    resumed = (
+        b.counter("tpunet_rebuild_resumed_nodes_total") - resumed_before
+    )
+    rederived = departed_nodes - resumed
+    # spurious-write audit: the only justified non-Lease/non-ConfigMap
+    # writes across the handoff are the CHURNED policies' status
+    # updates — an unchanged policy failing over must write nothing
+    cr_updates = sum(
+        writes_after.get(k, 0) - writes_before.get(k, 0)
+        for k in writes_after if k == ("update", "NetworkClusterPolicy")
+    )
+    node_writes = sum(
+        writes_after.get(k, 0) - writes_before.get(k, 0)
+        for k in writes_after
+        if k[1] == "Node" and k[0] in ("update", "patch")
+    )
+    events = fake.list("v1", "Event", namespace=NAMESPACE)
+    new_events = len(events) - events_before
+    seen_keys = {}
+    for ev in events:
+        key = (
+            (ev.get("involvedObject", {}) or {}).get("name", ""),
+            ev.get("reason", ""), ev.get("message", ""),
+        )
+        seen_keys[key] = seen_keys.get(key, 0) + 1
+    duplicate_events = sum(
+        n - 1 for n in seen_keys.values() if n > 1
+    )
+    a.stop()
+    b.stop()
+    row = {
+        "nodes": n_nodes,
+        "policies": n_policies,
+        "shards": n_shards,
+        "departed_shards": departed_shards,
+        "departed_nodes": departed_nodes,
+        "churned_nodes": churned,
+        "resumed_nodes": resumed,
+        "rederived_nodes": rederived,
+        "takeover_seconds": round(takeover_seconds, 2),
+        "takeover_clean": bool(takeover_ok),
+        "overlap_violations": overlap_violations,
+        "cr_status_writes": cr_updates,
+        "affected_policies": len(churned_policies),
+        "node_label_writes": node_writes,
+        "new_events": new_events,
+        "duplicate_events": duplicate_events,
+    }
+    log(f"   -> departed {departed_nodes} nodes over shards "
+        f"{departed_shards}; resumed {resumed}, re-derived {rederived} "
+        f"(churned {churned}), takeover {row['takeover_seconds']}s, "
+        f"{cr_updates} CR status writes, {duplicate_events} dup events")
+    return row
+
+
+def run_sharded_sweep(
+    total_nodes: int, n_policies: int = 8, n_replicas: int = 4,
+    rounds: int = 3,
+):
+    """The 100k-node proof: the fleet hash-partitions across replicas,
+    every replica's steady pass stays O(1), rebuilds are paid
+    per-shard (one policy's slice) rather than per-fleet, and the
+    whole fleet's steady-state write rate is exactly zero."""
+    from tpu_network_operator.agent import report as rpt
+    from tpu_network_operator.kube.fake import FakeCluster
+
+    log(f"== sharded sweep: {total_nodes} nodes across {n_policies} "
+        f"policies on {n_replicas} replicas")
+    per = total_nodes // n_policies
+    fake = FakeCluster()
+    policies = [f"fleet-pol-{i}" for i in range(n_policies)]
+    t0 = time.perf_counter()
+    for p_idx, pname in enumerate(policies):
+        fake.create(sharded_policy(pname, pname))
+        for i in range(per):
+            node = f"{pname}-n{i:05d}"
+            fake.add_node(node, {
+                "tpunet.dev/pool": pname,
+                "tpunet.dev/rack": f"rack-{p_idx:02d}-{i // RACK_SIZE:04d}",
+            })
+            rep = healthy_report(node, p_idx * per + i)
+            rep.policy = pname
+            rep.node = node
+            fake.apply(rpt.lease_for(rep, NAMESPACE))
+    log(f"   seeded in {time.perf_counter() - t0:.1f}s")
+
+    now = [1_000_000.0]
+    clock = lambda: now[0]   # noqa: E731
+    replicas = [
+        Replica(fake, f"replica-{i}", n_replicas * 2, clock)
+        for i in range(n_replicas)
+    ]
+    for r in replicas:          # round 1: membership
+        r.coord.sync()
+    for r in replicas:          # round 2: stable HRW ownership
+        r.coord.sync()
+    for r in replicas:
+        r.start()
+        r.mgr.shard_sync()
+    t0 = time.perf_counter()
+    for r in replicas:
+        for pname in r.owned_policies(policies):
+            r.mgr.enqueue(pname)
+        r.drain()
+    fake.simulate_daemonset_controller(materialize_pods=False)
+    for _ in range(3):
+        for r in replicas:
+            for pname in r.owned_policies(policies):
+                r.mgr.enqueue(pname)
+            r.drain()
+    log(f"   converged in {time.perf_counter() - t0:.1f}s")
+
+    # steady passes: every replica, every owned policy — all fast-path
+    before = {
+        k: v for k, v in fake.request_counts.items()
+        if k[0] in ("create", "update", "patch", "delete")
+    }
+    steady_lat = []
+    steady_rounds = max(rounds * 3, 9)
+    for _ in range(steady_rounds):
+        for r in replicas:
+            for pname in r.owned_policies(policies):
+                t0 = time.perf_counter()
+                r.rec.reconcile(pname)
+                steady_lat.append(time.perf_counter() - t0)
+    after = {
+        k: v for k, v in fake.request_counts.items()
+        if k[0] in ("create", "update", "patch", "delete")
+    }
+    steady_writes = sum(after.get(k, 0) - before.get(k, 0) for k in after)
+
+    # drift rebuilds, paid per-shard: each policy's periodic rebuild
+    # covers ONE slice of the fleet
+    rebuild_lat = []
+    by_policy: dict = {}
+    for _ in range(rounds):
+        for r in replicas:
+            for pname in r.owned_policies(policies):
+                r.rec._pass_state[pname].rebuild_due_probe = 0.0
+                t0 = time.perf_counter()
+                r.rec.reconcile(pname)
+                dt = time.perf_counter() - t0
+                rebuild_lat.append(dt)
+                by_policy.setdefault(pname, []).append(dt)
+    lease_stores = [
+        len(r.split.informer(
+            "coordination.k8s.io/v1", "Lease").store)
+        for r in replicas
+    ]
+    for r in replicas:
+        r.stop()
+    rebuild_sorted = sorted(rebuild_lat)
+    row = {
+        "nodes": total_nodes,
+        "policies": n_policies,
+        "replicas": n_replicas,
+        "steady_pass_p50_ms": round(
+            pctile(sorted(steady_lat), 0.5) * 1e3, 3
+        ),
+        "steady_writes_total": steady_writes,
+        "rebuild_per_shard_p50_ms": round(
+            pctile(rebuild_sorted, 0.5) * 1e3, 2
+        ),
+        "rebuild_per_shard_max_ms": round(rebuild_sorted[-1] * 1e3, 2),
+        # the amortization the 65 ms budget is judged against: a shard
+        # rebuild lands once per FULL_REBUILD_SECONDS (300 s) while
+        # steady passes land every resync tick (60 s) — 5 passes
+        # absorb one rebuild.  p50 (the structural cost), not max —
+        # the max at 100k is dominated by CPython gc pauses that land
+        # on whichever pass is running, and is reported alongside.
+        "rebuild_amortized_ms_per_pass": round(
+            pctile(rebuild_sorted, 0.5) * 1e3 / 5.0, 2
+        ),
+        # what a single unsharded controller would pay per drift
+        # rebuild: every shard's worth of work in one process — one
+        # MEDIAN sample per policy (a global top-N would count one
+        # slow policy, or a gc pause, multiple times)
+        "rebuild_unsharded_sum_ms": round(
+            sum(
+                pctile(sorted(lats), 0.5) for lats in by_policy.values()
+            ) * 1e3, 2
+        ),
+        "max_lease_cache_objects": max(lease_stores),
+        "lease_cache_narrowed": max(lease_stores) < total_nodes,
+    }
+    log(f"   -> steady p50 {row['steady_pass_p50_ms']}ms, "
+        f"{steady_writes} steady writes, per-shard rebuild p50 "
+        f"{row['rebuild_per_shard_p50_ms']}ms (amortized "
+        f"{row['rebuild_amortized_ms_per_pass']}ms/pass; unsharded sum "
+        f"{row['rebuild_unsharded_sum_ms']}ms), max lease cache "
+        f"{row['max_lease_cache_objects']}")
+    return row
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes-list", default="100,2000,10000")
     ap.add_argument("--rounds", type=int, default=5)
     ap.add_argument("--churn-rounds", type=int, default=10)
     ap.add_argument("--partition-nodes", type=int, default=2000)
+    ap.add_argument("--failover-nodes", type=int, default=10000)
+    ap.add_argument("--failover-policies", type=int, default=4)
+    ap.add_argument("--failover-churn", type=int, default=50)
+    ap.add_argument("--sharded-nodes", type=int, default=100000,
+                    help="total nodes of the hash-partitioned "
+                         "multi-replica sweep (0 = skip; the committed "
+                         "artifact runs the full 100k)")
+    ap.add_argument("--sharded-policies", type=int, default=8)
+    ap.add_argument("--sharded-replicas", type=int, default=4)
     ap.add_argument("--out", default="",
                     help="also write the JSON artifact to this path")
     args = ap.parse_args()
@@ -394,6 +841,17 @@ def main() -> None:
         run_sweep(n, args.rounds, args.churn_rounds) for n in sizes
     ]
     partition = run_partition(args.partition_nodes)
+    failover = run_failover(
+        args.failover_nodes, args.failover_policies,
+        churn=args.failover_churn,
+    )
+    sharded = (
+        run_sharded_sweep(
+            args.sharded_nodes, args.sharded_policies,
+            args.sharded_replicas,
+        )
+        if args.sharded_nodes > 0 else None
+    )
 
     failures = []
     for row in sweeps:
@@ -446,6 +904,63 @@ def main() -> None:
             f"intervals (budget {PARTITION_BUDGET_INTERVALS})"
         )
 
+    # shard-failover gates: bounded handoff, resume-not-rebuild, no
+    # write/Event storms, two-leaders-never
+    if not failover["takeover_clean"]:
+        failures.append("failover: successor did not acquire exactly "
+                        "the departed shards")
+    if failover["overlap_violations"] > 0:
+        failures.append(
+            f"failover: {failover['overlap_violations']} instants with "
+            "one shard owned by two replicas"
+        )
+    if failover["rederived_nodes"] > failover["churned_nodes"]:
+        failures.append(
+            f"failover: {failover['rederived_nodes']} nodes re-derived "
+            f"on takeover (only {failover['churned_nodes']} churned — "
+            "the persisted contribution cache is not resuming)"
+        )
+    if failover["rederived_nodes"] > failover["departed_nodes"]:
+        failures.append("failover: re-derivation exceeded the departed "
+                        "shard's node count (rebuild storm)")
+    if failover["cr_status_writes"] > failover["affected_policies"]:
+        failures.append(
+            f"failover: {failover['cr_status_writes']} CR status writes "
+            f"(only {failover['affected_policies']} policies had churn "
+            "— spurious writes on takeover)"
+        )
+    if failover["node_label_writes"] > 0:
+        failures.append("failover: spurious node label writes")
+    if failover["duplicate_events"] > 0:
+        failures.append(
+            f"failover: {failover['duplicate_events']} duplicate Events"
+        )
+
+    # 100k sharded-sweep gates: steady O(1) + 0 writes, rebuilds paid
+    # per-shard and amortized under the steady budget, caches narrowed
+    if sharded is not None:
+        if sharded["steady_writes_total"] > 0:
+            failures.append(
+                f"sharded {sharded['nodes']}: "
+                f"{sharded['steady_writes_total']} steady writes (want 0)"
+            )
+        if sharded["steady_pass_p50_ms"] > STEADY_P50_BUDGET_MS:
+            failures.append(
+                f"sharded {sharded['nodes']}: steady pass p50 "
+                f"{sharded['steady_pass_p50_ms']}ms over budget"
+            )
+        if sharded["rebuild_amortized_ms_per_pass"] > STEADY_P50_BUDGET_MS:
+            failures.append(
+                f"sharded {sharded['nodes']}: per-shard rebuild "
+                f"amortizes to {sharded['rebuild_amortized_ms_per_pass']}"
+                f"ms/steady pass (budget {STEADY_P50_BUDGET_MS}ms)"
+            )
+        if not sharded["lease_cache_narrowed"]:
+            failures.append(
+                f"sharded {sharded['nodes']}: a replica cached the "
+                "whole fleet's Leases (interest narrowing broken)"
+            )
+
     biggest = sweeps[-1]
     result = {
         "metric": "probe datagrams per node per round at scale",
@@ -462,6 +977,26 @@ def main() -> None:
         "degree": DEGREE,
         "sweeps": sweeps,
         "partition": partition,
+        "failover": failover,
+        "sharded": sharded,
+        "notes": {
+            # the PR 9 regression ledger: 329 ms (pre-delta-pipeline
+            # full pass at 10k) grew to 520 ms when the rebuild gained
+            # the derived-state bookkeeping; PR 11's rebuild work
+            # (add_fresh fold, peer-derivation content gate, parse
+            # fast paths, contribution reuse) is measured against it.
+            "pr9_rebuild_p50_ms": 520.18,
+            "rebuild_from_scratch_p50_ms": biggest["reconcile_p50_ms"],
+            "rebuild_parallel_p50_ms": biggest[
+                "rebuild_parallel_p50_ms"
+            ],
+            "rebuild_resumed_p50_ms": biggest["rebuild_resumed_p50_ms"],
+            "rebuild_workers_note": (
+                "parallel fan-out measured at 4 workers; on a "
+                "single-core host it degrades to ~serial (GIL), the "
+                "resume path is the structural win"
+            ),
+        },
         "ok": not failures,
         "failures": failures,
     }

@@ -1,0 +1,271 @@
+"""Persisted per-(policy, node) contribution cache.
+
+A controller restart (or a shard failing over to another replica)
+starts with no in-process derived state: the first status pass pays a
+from-scratch O(fleet) re-derivation even though almost nothing in the
+fleet changed across the handoff.  This module checkpoints the derived
+contribution terms into owned ConfigMaps so the successor can *resume*:
+relist the report Leases, diff each Lease's resourceVersion against the
+persisted entry, and re-derive only what actually changed.
+
+What is persisted per lease: the **derived terms** (probe verdict row,
+telemetry fold, planner observation row, readiness flags) plus the
+``resourceVersion`` they were derived from.  The parsed report itself
+is NOT persisted — the Lease informer already holds every report, and
+the parse memo prices one pass — so an entry is ~200 bytes, not a
+report copy.  Payloads are hash-bucketed into
+``tpunet-contribcache-<policy>-<i>`` chunks, each held under a byte
+budget by doubling the chunk count (the same split discipline as the
+peer shards; the 1 MiB etcd object limit never truncates an entry).
+
+Safety contract — a stale entry must never be *wrong*, only useless:
+
+* an entry is resumed only when its recorded resourceVersion matches
+  the live Lease (any report change bumps the rv, so a matching entry
+  was derived from byte-identical input);
+* every chunk carries the CR spec identity (metadata.generation) and
+  the fleet agent-version set at checkpoint time; a mismatch on either
+  (spec changed, version skew flipped) discards the cache wholesale —
+  projection semantics may have moved under the signatures;
+* entries recorded while the node was below quorum (Degraded/
+  Quarantined) are never resumed: the quarantine streak is
+  controller-side clock state a signature cannot carry;
+* an entry whose report would have aged stale by now
+  (``renewed + TTL < now``) is re-derived, not resumed.
+
+Staleness bound: the checkpoint is written (diff-gated) only on full
+rebuilds, so it lags the live fleet by at most FULL_REBUILD_SECONDS —
+bounded staleness that costs extra re-derivation on resume, never
+wrong output.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api.v1alpha1 import types as t
+from ..probe.topology import stable_hash
+from .derived import NodeContribution
+
+log = logging.getLogger("tpunet.contribcache")
+
+CM_PREFIX = "tpunet-contribcache-"
+META_KEY = "meta"
+ENTRIES_KEY = "entries"
+FIELD_MANAGER = "tpunet-operator-contribcache"
+DEFAULT_BYTE_BUDGET = 512 * 1024
+MAX_CHUNKS = 256
+
+
+def cm_name(policy: str, chunk: int) -> str:
+    return f"{CM_PREFIX}{policy}-{chunk}"
+
+
+def encode_entry(c: NodeContribution) -> List[Any]:
+    """Compact positional encoding of one contribution's derived terms
+    (sans the report object — see module docstring)."""
+    row = None
+    if c.probe_row is not None:
+        r = c.probe_row
+        row = [
+            r.node, r.peers_total, r.peers_reachable,
+            list(r.unreachable), r.rtt_p50_ms, r.rtt_p99_ms,
+            r.loss_ratio, r.state,
+        ]
+    telem = None
+    if c.t_reporting:
+        telem = [
+            c.t_errs, c.t_pkts, c.t_worst, list(c.t_anoms),
+            [list(p) for p in c.t_anom_ifaces],
+            [[n, i, d] for n, i, d in c.t_rows],
+        ]
+    return [
+        c.rv, c.node, c.renewed, 1 if c.ok else 0, c.error, c.version,
+        c.endpoint, 1 if c.has_endpoint else 0, row, telem,
+        [list(p) for p in c.plan_obs] if c.plan_obs is not None else None,
+        c.ici_group, list(c.outcome) if c.outcome is not None else None,
+    ]
+
+
+def decode_entry(
+    lease: str, e: List[Any], report: Any
+) -> NodeContribution:
+    """Rebuild a NodeContribution from its persisted terms, attaching
+    the live parsed report.  Exact-type reconstruction matters: the
+    section signatures compare tuples against freshly-derived
+    contributions, so every tuple/float shape must round-trip."""
+    c = NodeContribution(
+        lease=lease, node=str(e[1]), rv=str(e[0]), report=report,
+        renewed=e[2], ok=bool(e[3]),
+    )
+    c.error = str(e[4])
+    c.version = str(e[5])
+    c.endpoint = str(e[6])
+    c.has_endpoint = bool(e[7])
+    if e[8] is not None:
+        r = e[8]
+        c.probe_row = t.NodeProbeStatus(
+            node=str(r[0]), peers_total=int(r[1]),
+            peers_reachable=int(r[2]),
+            unreachable=[str(p) for p in r[3]],
+            rtt_p50_ms=float(r[4]), rtt_p99_ms=float(r[5]),
+            loss_ratio=float(r[6]), state=str(r[7]),
+        )
+    if e[9] is not None:
+        telem = e[9]
+        c.t_reporting = True
+        c.t_errs = int(telem[0])
+        c.t_pkts = int(telem[1])
+        c.t_worst = float(telem[2])
+        c.t_anoms = tuple(str(a) for a in telem[3])
+        c.t_anom_ifaces = tuple(
+            (str(i), str(d)) for i, d in telem[4]
+        )
+        c.t_rows = tuple(
+            (str(n), str(i), {
+                "rx_bytes": int(d["rx_bytes"]),
+                "errors": int(d["errors"]),
+                "ratio": float(d["ratio"]),
+            })
+            for n, i, d in telem[5]
+        )
+    if e[10] is not None:
+        c.plan_obs = tuple(
+            (str(p), float(ms)) for p, ms in e[10]
+        )
+    c.ici_group = str(e[11])
+    if e[12] is not None:
+        c.outcome = (str(e[12][0]), bool(e[12][1]), str(e[12][2]))
+    return c
+
+
+def _meta_payload(
+    generation: Any, versions: List[str], n_chunks: int
+) -> str:
+    return json.dumps({
+        # spec identity is ("generation", N) or ("spec-hash", H) —
+        # JSON round-trips the tuple as a list, compare in that shape
+        "generation": list(generation) if isinstance(
+            generation, tuple) else generation,
+        "versions": sorted(versions),
+        "chunks": n_chunks,
+    }, sort_keys=True)
+
+
+def build_payloads(
+    policy: str,
+    generation: Any,
+    versions: List[str],
+    contribs: Dict[str, NodeContribution],
+    byte_budget: int = DEFAULT_BYTE_BUDGET,
+) -> Dict[str, Dict[str, str]]:
+    """The complete desired checkpoint: ``{cm_name: data}``.  Chunk
+    count doubles until every payload fits the budget (or MAX_CHUNKS —
+    a single over-budget entry would mean kilobyte node names; refuse
+    by letting the oversize chunk through for the caller's apply to
+    reject, exactly like the peer-shard discipline)."""
+    encoded = {
+        lease: encode_entry(c) for lease, c in contribs.items()
+    }
+    n_chunks = 1
+    while True:
+        buckets: List[Dict[str, List[Any]]] = [
+            {} for _ in range(n_chunks)
+        ]
+        for lease, entry in encoded.items():
+            buckets[stable_hash(lease) % n_chunks][lease] = entry
+        payloads = [
+            json.dumps(b, sort_keys=True) for b in buckets
+        ]
+        if (
+            all(len(p.encode()) <= byte_budget for p in payloads)
+            or n_chunks >= MAX_CHUNKS
+        ):
+            break
+        n_chunks *= 2
+    meta = _meta_payload(generation, versions, n_chunks)
+    return {
+        cm_name(policy, i): {META_KEY: meta, ENTRIES_KEY: payloads[i]}
+        for i in range(n_chunks)
+    }
+
+
+def fingerprint(
+    generation: Any, lease_rvs, versions,
+) -> Tuple[Any, int, Tuple[str, ...]]:
+    """The cheap has-anything-changed key the checkpoint writer gates
+    on: (spec identity, hash of the sorted (lease, rv) set, version
+    set).  Computed identically from live contributions (save side)
+    and from a loaded checkpoint (resume side), so a failover whose
+    fleet matches the checkpoint exactly skips re-serializing it."""
+    return (
+        generation,
+        hash(tuple(sorted(lease_rvs))),
+        tuple(sorted(versions)),
+    )
+
+
+def load(
+    client, namespace: str, policy: str, generation: Any,
+) -> Tuple[
+    Optional[Dict[str, List[Any]]], List[str],
+    Dict[str, Dict[str, str]],
+]:
+    """Read the persisted checkpoint back: ``(entries_by_lease,
+    checkpoint_versions, chunk_payloads)``, or ``(None, [], {})`` when
+    absent, partial (a failover mid-write leaves mixed metas —
+    discard), or invalidated by a spec-generation change.
+    ``chunk_payloads`` (cm name -> data) seeds the writer's diff gate
+    so an unchanged checkpoint is never re-serialized or re-applied."""
+    want_gen = list(generation) if isinstance(generation, tuple) \
+        else generation
+    try:
+        first = client.get(
+            "v1", "ConfigMap", cm_name(policy, 0), namespace
+        )
+    except Exception:   # noqa: BLE001 — no checkpoint = cold rebuild
+        return None, [], {}
+    try:
+        meta = json.loads(
+            (first.get("data", {}) or {}).get(META_KEY, "{}")
+        )
+        n_chunks = int(meta.get("chunks", 0))
+        if not (0 < n_chunks <= MAX_CHUNKS):
+            return None, [], {}
+        if meta.get("generation") != want_gen:
+            log.info(
+                "contribution cache for %s invalidated: spec "
+                "generation moved (%s -> %s)", policy,
+                meta.get("generation"), want_gen,
+            )
+            return None, [], {}
+        chunks = [first]
+        for i in range(1, n_chunks):
+            chunks.append(client.get(
+                "v1", "ConfigMap", cm_name(policy, i), namespace
+            ))
+        entries: Dict[str, List[Any]] = {}
+        payloads: Dict[str, Dict[str, str]] = {}
+        for i, cm in enumerate(chunks):
+            data = cm.get("data", {}) or {}
+            if data.get(META_KEY) != first["data"][META_KEY]:
+                log.warning(
+                    "contribution cache for %s has mixed chunk metas "
+                    "(interrupted checkpoint); discarding", policy,
+                )
+                return None, [], {}
+            entries.update(json.loads(data.get(ENTRIES_KEY, "{}")))
+            payloads[cm_name(policy, i)] = {
+                META_KEY: data.get(META_KEY, ""),
+                ENTRIES_KEY: data.get(ENTRIES_KEY, ""),
+            }
+        return (
+            entries,
+            [str(v) for v in meta.get("versions", [])],
+            payloads,
+        )
+    except Exception as e:   # noqa: BLE001 — malformed = useless, not fatal
+        log.warning("contribution cache for %s unreadable: %s", policy, e)
+        return None, [], {}

@@ -90,6 +90,21 @@ class DirtyTracker:
         self._policy_epoch: Dict[str, int] = {}
         self._informers = []
         self.active = False
+        # policy interest predicate (None = everything): a sharded
+        # replica drops deltas for policies other replicas own, so the
+        # dirty maps stay bounded to this replica's slice
+        self._interest = None
+
+    def set_interest(self, fn) -> None:
+        """Install (or clear) a ``fn(policy_name) -> bool`` filter on
+        the delta feed.  Already-accumulated dirt for out-of-interest
+        policies is dropped by :meth:`forget` at handoff time."""
+        with self._lock:
+            self._interest = fn
+
+    def _wants(self, policy: str) -> bool:
+        interest = self._interest
+        return interest is None or bool(interest(policy))
 
     # -- wiring ---------------------------------------------------------------
 
@@ -137,7 +152,7 @@ class DirtyTracker:
             if obj is None:
                 continue
             policy, node = _lease_key(obj)
-            if policy and node:
+            if policy and node and self._wants(policy):
                 self.mark(policy, node, name)
 
     def _on_pod(self, ev, ns, name, new, old) -> None:
@@ -145,7 +160,7 @@ class DirtyTracker:
             if obj is None:
                 continue
             policy = _owner_daemonset(obj)
-            if not policy:
+            if not policy or not self._wants(policy):
                 continue
             node = str(
                 (obj.get("spec", {}) or {}).get("nodeName", "") or ""

@@ -146,6 +146,26 @@ METRIC_HELP: Dict[str, str] = {
     "tpunet_slo_remediation_convergence_seconds":
         "Seconds from anomaly open to full recovery for episodes "
         "self-healing acted on, per episode.",
+    "tpunet_shard_owner":
+        "1 for each control-plane shard this replica currently owns "
+        "(holds the tpunet-shard-<i> Lease); absent otherwise.",
+    "tpunet_shard_policies":
+        "Policies assigned to each control-plane shard, from the "
+        "published per-shard rollups (exported by the shard-0 owner).",
+    "tpunet_fleet_policies":
+        "Policies across every control-plane shard (the aggregator's "
+        "fleet fold; shard-0 owner only).",
+    "tpunet_fleet_nodes":
+        "Target nodes across every control-plane shard (the "
+        "aggregator's fleet fold; shard-0 owner only).",
+    "tpunet_fleet_ready_nodes":
+        "Ready nodes across every control-plane shard (the "
+        "aggregator's fleet fold; shard-0 owner only).",
+    "tpunet_rebuild_resumed_nodes_total":
+        "Nodes a full rebuild resumed from a contribution cache "
+        "instead of re-deriving, by source (memory = unchanged lease "
+        "within one process; persisted = the checkpointed "
+        "contribution cache after a restart/failover).",
 }
 
 

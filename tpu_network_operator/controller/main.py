@@ -112,6 +112,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max bytes per probe peer-shard ConfigMap "
                         "payload; over-budget shards are split, never "
                         "truncated (0 = default, 512 KiB)")
+    p.add_argument("--shard-count", type=int, default=0,
+                   help="horizontal sharding: partition policies "
+                        "across this many shard Leases; every replica "
+                        "runs with the same value and reconciles only "
+                        "the shards it wins (0 = sharding off, single "
+                        "controller).  Replaces --leader-elect: the "
+                        "per-shard Leases ARE the election.")
+    p.add_argument("--contrib-cache-bytes", type=int, default=512 * 1024,
+                   help="persisted contribution-cache chunk byte "
+                        "budget: derived per-node contributions are "
+                        "checkpointed into owned ConfigMaps so a "
+                        "restarted/failed-over replica resumes "
+                        "incrementally instead of re-deriving the "
+                        "fleet (0 = disabled)")
     return p
 
 
@@ -200,16 +214,44 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
         )
         slo = SloEngine(timeline, metrics=METRICS)
 
+    # horizontal sharding (controller/sharding.py): per-shard Leases
+    # partition the policy set across replicas.  Like leader election,
+    # the coordinator rides the RAW (retrying) client — ownership
+    # correctness must never lag a cached read.
+    coordinator = aggregator = None
+    if args.shard_count > 0:
+        from .sharding import ShardAggregator, ShardCoordinator
+
+        coordinator = ShardCoordinator(
+            RetryingClient(client, max_attempts=3, budget=1.5,
+                           metrics=METRICS),
+            args.namespace, n_shards=args.shard_count, metrics=METRICS,
+        )
+        aggregator = ShardAggregator(
+            RetryingClient(client, max_attempts=3, budget=1.5,
+                           metrics=METRICS),
+            args.namespace, metrics=METRICS,
+        )
+        if args.leader_elect:
+            log.warning(
+                "--leader-elect ignored: --shard-count partitions "
+                "work via per-shard Leases (every replica runs; each "
+                "reconciles only the shards it wins)"
+            )
+            args.leader_elect = False
+
     mgr = Manager(cached, namespace=args.namespace, is_openshift=openshift,
                   metrics=METRICS,
                   concurrent_reconciles=args.concurrent_reconciles,
                   tracer=tracer, events=recorder,
-                  timeline=timeline, slo=slo)
+                  timeline=timeline, slo=slo,
+                  sharding=coordinator, aggregator=aggregator)
     mgr.reconciler.REPORT_CACHE_SECONDS = args.report_cache_seconds
     if args.peer_shard_byte_budget > 0:
         mgr.reconciler.PEER_SHARD_BYTE_BUDGET = args.peer_shard_byte_budget
     if args.full_rebuild_seconds > 0:
         mgr.reconciler.FULL_REBUILD_SECONDS = args.full_rebuild_seconds
+    mgr.reconciler.CONTRIB_CACHE_BYTES = max(0, args.contrib_cache_bytes)
 
     servers = []
     health = None

@@ -99,6 +99,13 @@ class WorkQueue:
                 self._export_depth()
                 self._cond.notify()
 
+    def is_processing(self, item) -> bool:
+        """Whether a worker currently holds this key — the shard-sync
+        loop must not release a policy's in-memory state out from
+        under an in-flight reconcile."""
+        with self._cond:
+            return item in self._processing
+
     def __len__(self) -> int:
         with self._cond:
             return len(self._queue)
@@ -114,7 +121,7 @@ class Manager:
         self, client, namespace: str, is_openshift: bool = False,
         metrics=None, resync_interval: float = 60.0,
         concurrent_reconciles: int = 4, tracer=None, events=None,
-        timeline=None, slo=None,
+        timeline=None, slo=None, sharding=None, aggregator=None,
     ):
         self.client = client
         self.namespace = namespace
@@ -128,9 +135,30 @@ class Manager:
         self.tracer = tracer
         self.resync_interval = resync_interval
         self.concurrent_reconciles = max(1, int(concurrent_reconciles))
+        # horizontal sharding (controller/sharding.py): when a
+        # ShardCoordinator is attached, this replica reconciles ONLY
+        # the policies whose hash shard it owns — enqueues filter on
+        # ownership, a shard-sync loop renews the shard Leases and
+        # reacts to handoffs, and the informer caches narrow their
+        # interest to the owned slice.  ``aggregator`` (shard-0 owner)
+        # folds per-shard rollups into the fleet gauges.
+        self.sharding = sharding
+        self.aggregator = aggregator
+        self._interest_installed = False
+        # shard-handoff bookkeeping (all touched only from shard_sync
+        # callers): shards whose policies still need releasing/
+        # enqueueing after a round that could not resolve the policy
+        # list, and policies whose release is deferred behind an
+        # in-flight reconcile
+        self._release_pending_shards: set = set()
+        self._gained_pending_shards: set = set()
+        self._release_pending_policies: set = set()
         self.reconciler = NetworkClusterPolicyReconciler(
             client, namespace, is_openshift, metrics=metrics,
             tracer=tracer, events=events, timeline=timeline, slo=slo,
+            # the rebuild fan-out shares the worker budget the operator
+            # was sized for (--concurrent-reconciles)
+            rebuild_workers=self.concurrent_reconciles,
         )
         self._queue = WorkQueue(metrics=metrics)
         self._stop = threading.Event()
@@ -156,8 +184,183 @@ class Manager:
 
     # -- workqueue (see WorkQueue for the dedup/processing contract) ----------
 
+    def _wants(self, name: str) -> bool:
+        """Shard filter: an unsharded manager wants everything; a
+        sharded one only policies in its owned shards."""
+        return self.sharding is None or self.sharding.owns(name)
+
     def enqueue(self, name: str) -> None:
+        if not self._wants(name):
+            return
         self._queue.add(name)
+
+    # -- sharding (controller/sharding.py) ------------------------------------
+
+    def _policy_names(self):
+        """Policy names, or None on a list failure — the caller must
+        distinguish "no policies" from "could not look" (acting on an
+        empty list would skip releases forever and publish empty
+        rollups that zero the fleet gauges)."""
+        try:
+            return [
+                obj["metadata"]["name"]
+                for obj in self.client.list(
+                    API_VERSION, NetworkClusterPolicy.KIND,
+                    limit=LIST_PAGE_SIZE,
+                )
+            ]
+        except Exception as e:   # noqa: BLE001 — next tick retries
+            log.debug("policy list for shard sync failed: %s", e)
+            return None
+
+    def _install_interest(self) -> None:
+        """Narrow the fleet-sized informer caches (report Leases,
+        agent Pods) and the dirty tracker to the owned policy slice —
+        the memory half of breaking the single-process ceiling.  The
+        predicates read live ownership, so a handoff only needs a
+        refilter (relist), not re-registration."""
+        if self.sharding is None:
+            return
+        self._interest_installed = True
+        informer_of = getattr(self.client, "informer", None)
+        if informer_of is None:
+            return
+        from ..agent import report as rpt
+        from .delta import _owner_daemonset
+
+        sc = self.sharding
+        lease_inf = informer_of(rpt.LEASE_API, "Lease")
+        if lease_inf is not None:
+            def lease_interest(obj):
+                labels = (
+                    obj.get("metadata", {}) or {}
+                ).get("labels", {}) or {}
+                if labels.get(rpt.AGENT_LABEL) != "true":
+                    # non-agent Leases (leader election, shard/replica
+                    # leases) stay visible to everyone
+                    return True
+                return sc.owns(
+                    str(labels.get(rpt.POLICY_LABEL, "") or "")
+                )
+
+            lease_inf.set_interest(lease_interest)
+        pod_inf = informer_of("v1", "Pod")
+        if pod_inf is not None:
+            def pod_interest(obj):
+                owner = _owner_daemonset(obj)
+                return not owner or sc.owns(owner)
+
+            pod_inf.set_interest(pod_interest)
+        self.reconciler.dirty.set_interest(sc.owns)
+
+    def _refilter_informers(self) -> None:
+        informer_of = getattr(self.client, "informer", None)
+        if informer_of is None:
+            return
+        from ..agent import report as rpt
+
+        for av, kind in ((rpt.LEASE_API, "Lease"), ("v1", "Pod")):
+            inf = informer_of(av, kind)
+            if inf is not None:
+                try:
+                    inf.refilter()
+                except Exception as e:   # noqa: BLE001 — next resync heals
+                    log.warning("informer refilter failed: %s", e)
+
+    def shard_sync(self) -> None:
+        """One shard-coordination round: renew/acquire/release shard
+        Leases, react to handoffs (release lost policies' in-memory
+        state, re-scope the caches, enqueue gained policies), publish
+        this replica's per-shard rollups, and — on the shard-0 owner —
+        fold the fleet aggregate."""
+        if self.sharding is None:
+            return
+        from .sharding import shard_of_policy
+
+        if not self._interest_installed:
+            # drain()-driven (test) managers reach here without start()
+            self._install_interest()
+        sc = self.sharding
+        gained, lost = sc.sync()
+        if self.aggregator is not None:
+            for shard in lost:
+                # another replica owns these rollups now: the publish
+                # diff gate must not survive into a later re-gain
+                self.aggregator.forget(shard)
+        names = self._policy_names()
+        release_shards = lost | self._release_pending_shards
+        gained_shards = gained | self._gained_pending_shards
+        if names is None:
+            # transient LIST failure: the (gained, lost) delta is
+            # already consumed, so park both sides for the next round
+            # instead of silently dropping them — and publish nothing
+            # (empty rollups would zero the fleet gauges)
+            self._release_pending_shards = release_shards
+            self._gained_pending_shards = gained_shards
+            if gained or lost:
+                self._refilter_informers()
+            return
+        self._release_pending_shards = set()
+        self._gained_pending_shards = set()
+        pending = self._release_pending_policies
+        pending.update(
+            name for name in names
+            if shard_of_policy(name, sc.n_shards) in release_shards
+        )
+        still_pending = set()
+        for name in sorted(pending):
+            if self._queue.is_processing(name):
+                # a worker is mid-reconcile on this policy: releasing
+                # now would yank derived state out from under it (and
+                # the pass would resurrect it at the end) — retry next
+                # round, after the in-flight pass retires
+                still_pending.add(name)
+                continue
+            self.reconciler.release_policy(name)
+            if sc.owns(name):
+                # re-gained while the release was pending: deltas were
+                # dropped during the non-owned window, so the released
+                # (rebuild-from-scratch) path is the correct restart
+                self.enqueue(name)
+        self._release_pending_policies = still_pending
+        if gained or lost:
+            self._refilter_informers()
+        if gained_shards:
+            for name in names:
+                if shard_of_policy(name, sc.n_shards) in gained_shards:
+                    self.enqueue(name)
+        if self.aggregator is not None:
+            rollups: dict = {}
+            for name in names:
+                shard = shard_of_policy(name, sc.n_shards)
+                if not sc.owns_shard(shard):
+                    continue
+                try:
+                    obj = self.client.get(
+                        API_VERSION, NetworkClusterPolicy.KIND, name
+                    )
+                except Exception:   # noqa: BLE001 — deleted mid-tick
+                    continue
+                status = obj.get("status", {}) or {}
+                rollups.setdefault(shard, {})[name] = {
+                    "targets": int(status.get("targets", 0) or 0),
+                    "ready": int(status.get("ready", 0) or 0),
+                }
+            for shard in sorted(sc.owned):
+                self.aggregator.publish(shard, rollups.get(shard, {}))
+            if sc.owns_shard(0):
+                self.aggregator.aggregate()
+
+    def _shard_loop(self) -> None:
+        """Shard Leases must renew faster than they expire — this loop
+        runs at ~2/3 of the lease duration, independent of the (much
+        slower) resync tick."""
+        period = max(self.sharding.lease_duration * 0.6, 1.0)
+        while not self._stop.wait(period):
+            try:
+                self.shard_sync()
+            except Exception:   # noqa: BLE001 — next round retries
+                log.exception("shard sync round failed")
 
     # -- event sources --------------------------------------------------------
 
@@ -320,6 +523,11 @@ class Manager:
         self.enqueue(name)
 
     def _reconcile_one(self, name: str) -> None:
+        if not self._wants(name):
+            # ownership moved between enqueue and pickup (shard
+            # handoff): the new owner reconciles it — touching it here
+            # would race that replica's writes
+            return
         t0 = time.monotonic()
         # one span per workqueue item: the root of the stitched
         # provisioning trace (the reconciler stamps this span's trace ID
@@ -399,6 +607,12 @@ class Manager:
         """Start watches + ``concurrent_reconciles`` workers in the
         background (mgr.Start analog)."""
         self.reconciler.setup()
+        if self.sharding is not None:
+            # acquire our shards and narrow the caches BEFORE the seed
+            # list, so the seed enqueues (and the informer stores) are
+            # already scoped to the owned slice
+            self._install_interest()
+            self.shard_sync()
         # seed: reconcile everything that already exists (informer initial
         # list) — chunked, like every other wire list in the control plane
         for obj in self.client.list(
@@ -407,6 +621,8 @@ class Manager:
             self.enqueue(obj["metadata"]["name"])
         loops = [self._watch_policies, self._watch_daemonsets,
                  self._resync_loop]
+        if self.sharding is not None:
+            loops.append(self._shard_loop)
         loops += [self._worker] * self.concurrent_reconciles
         for fn in loops:
             th = threading.Thread(target=fn, daemon=True)
@@ -438,6 +654,10 @@ class Manager:
             timer.cancel()
         for th in self._threads:
             th.join(timeout=2)
+        if self.sharding is not None:
+            # clean shutdown releases the shard Leases — an immediate
+            # handoff instead of a lease_duration expiry wait
+            self.sharding.stop()
 
     # -- synchronous drive for tests ------------------------------------------
 

@@ -410,6 +410,7 @@ class NetworkClusterPolicyReconciler:
     def __init__(
         self, client, namespace: str, is_openshift: bool = False,
         metrics=None, tracer=None, events=None, timeline=None, slo=None,
+        rebuild_workers: int = 0,
     ):
         self.client = client
         self.namespace = namespace
@@ -514,6 +515,17 @@ class NetworkClusterPolicyReconciler:
         # refresh actually CHANGED the node->rack mapping, so shard
         # keys (and plan groups) recompute only when racks moved
         self._node_racks_version = 0
+        # full-rebuild fan-out width (0 = auto from the CPU count,
+        # capped at the manager's --concurrent-reconciles); 1 = serial
+        self.rebuild_workers = int(rebuild_workers)
+        # persisted contribution cache (controller/contribcache.py):
+        # per-policy last-applied chunk payloads (the diff gate that
+        # keeps steady rebuild passes at zero checkpoint writes) and
+        # the cheap (generation, lease->rv, versions) fingerprint that
+        # skips even SERIALIZING an unchanged checkpoint; both under
+        # _reports_lock like the peer-flush state
+        self._contrib_applied: Dict[str, Dict[str, Dict[str, str]]] = {}
+        self._contrib_fp: Dict[str, Any] = {}
 
     # -- setup ----------------------------------------------------------------
 
@@ -846,21 +858,44 @@ class NetworkClusterPolicyReconciler:
     FULL_REBUILD_SECONDS = 300.0
     # test/bench seam: True forces every pass down the from-scratch
     # rebuild path — the reference the equivalence suite compares the
-    # incremental pipeline against (and the pre-delta behavior).
+    # incremental pipeline against (and the pre-delta behavior).  Also
+    # disables contribution REUSE below, so the reference derives every
+    # contribution from its report, every pass.
     FULL_REBUILD_ALWAYS = False
+    # drift-rebuild resume: a periodic rebuild re-uses the in-memory
+    # contribution for any lease whose resourceVersion is unchanged
+    # (derivation is deterministic in the lease content; staleness and
+    # quarantine-streak cases are excluded — see _rebuild_derived), so
+    # a no-change rebuild costs O(fleet) dict work, not O(fleet)
+    # re-derivation.  The aggregates are still folded from scratch —
+    # the subtract/add drift bound the rebuild exists for is in the
+    # aggregates, not in the (pure) per-lease derivation.
+    REBUILD_REUSE = True
+    # persisted contribution cache (controller/contribcache.py): lets
+    # a restarted/failed-over replica resume instead of re-deriving
+    # the fleet.  0 bytes disables both the checkpoint writes and the
+    # resume reads.
+    CONTRIB_CACHE_BYTES = 512 * 1024
+    # below this many entries a parallel rebuild is pure thread
+    # overhead — derive serially
+    REBUILD_PARALLEL_MIN = 2048
 
     def _agent_reports(self, policy_name: str) -> List[Any]:
         """Per-node provisioning reports (Leases the agents apply,
         agent/report.py) for one policy, from the shared bucket cache.
         Parse failures and stale heartbeats count as not-ready reports."""
         return [
-            rep for _, rep, _ in self._report_buckets().get(policy_name, [])
+            rep
+            for _, rep, _, _ in self._report_buckets().get(policy_name, [])
         ]
 
     def _report_entries(self, policy_name: str) -> List[Any]:
-        """``(lease_name, report, renewed_ts)`` triples for one policy
-        — the full-rebuild path's input (the incremental path reads
-        single leases from the informer store instead)."""
+        """``(lease_name, report, renewed_ts, resource_version)``
+        tuples for one policy — the full-rebuild path's input (the
+        incremental path reads single leases from the informer store
+        instead).  The rv rides along so the rebuild can resume
+        unchanged leases from the in-memory or persisted contribution
+        cache instead of re-deriving them."""
         return list(self._report_buckets().get(policy_name, []))
 
     def _report_buckets(self) -> Dict[str, List[Any]]:
@@ -955,6 +990,9 @@ class NetworkClusterPolicyReconciler:
             out = buckets.setdefault(policy_name, [])
             lease_name = lease.get("metadata", {}).get("name", "")
             seen.add(lease_name)
+            rv = str(
+                lease.get("metadata", {}).get("resourceVersion", "") or ""
+            )
             rep, renewed = self._parse_one(lease, rpt)
             if (
                 rep.ok
@@ -967,9 +1005,9 @@ class NetworkClusterPolicyReconciler:
                 out.append((lease_name, rpt.ProvisioningReport(
                     node=rep.node, policy=rep.policy, ok=False,
                     error="report stale (agent heartbeat lost)",
-                ), renewed))
+                ), renewed, rv))
                 continue
-            out.append((lease_name, rep, renewed))
+            out.append((lease_name, rep, renewed, rv))
         with self._reports_lock:
             # departed leases must not pin their parse forever
             for name in [k for k in self._lease_memo if k not in seen]:
@@ -1164,8 +1202,8 @@ class NetworkClusterPolicyReconciler:
         else:
             is_degraded = reachable < required
         key = (pname, node)
-        with self._probe_lock:
-            if is_degraded:
+        if is_degraded:
+            with self._probe_lock:
                 streak, last_advance = self._probe_failing.get(
                     key, (0, 0.0)
                 )
@@ -1175,9 +1213,15 @@ class NetworkClusterPolicyReconciler:
                 if streak == 0 or now - last_advance >= interval:
                     streak += 1
                     self._probe_failing[key] = (streak, now)
-            else:
-                self._probe_failing.pop(key, None)
-                streak = 0
+        else:
+            streak = 0
+            # healthy-fleet fast path: skip the lock round-trip per
+            # node when no streak exists anywhere (a racy empty-dict
+            # peek is safe — our own key can only have been written
+            # by this policy's worker, and then the dict is non-empty)
+            if self._probe_failing:
+                with self._probe_lock:
+                    self._probe_failing.pop(key, None)
         state = (
             t.PROBE_STATE_QUARANTINED
             if streak >= qpasses
@@ -1473,36 +1517,222 @@ class NetworkClusterPolicyReconciler:
         if old is not None and (new is None or new.node != old.node):
             self._prune_streak(pname, d, old.node)
 
+    @staticmethod
+    def _resumable(c: NodeContribution, rv: str, renewed, now_wall, ttl):
+        """Whether a cached contribution (in-memory or persisted) may
+        stand in for re-derivation: the lease is byte-identical (rv
+        match — any report change bumps it), the report has not aged
+        stale since the cache entry was cut, and the node is not below
+        quorum (the quarantine streak is controller-clock state the
+        cache cannot carry — degraded nodes always re-derive)."""
+        if not rv or c.rv != rv:
+            return False
+        if c.ok and renewed is not None and now_wall - renewed > ttl:
+            return False
+        state = c.probe_row.state if c.probe_row is not None else ""
+        return state in ("", t.PROBE_STATE_REACHABLE)
+
+    def _derive_entries(
+        self, pname: str, jobs: List[Tuple], ctx_args: Dict[str, Any],
+        rpt,
+    ) -> Dict[int, NodeContribution]:
+        """Derive many contributions, fanning out across the rebuild
+        worker pool when the batch is big enough to amortize it.
+        Contributions are independent per node (the only shared state
+        — the parse memo and the quarantine-streak map — is lock-
+        guarded), so the fan-out needs no coordination; the caller
+        folds results back in deterministic entry order."""
+        workers = self.rebuild_workers
+        if workers <= 0:
+            import os as os_mod
+
+            workers = min(4, os_mod.cpu_count() or 1)
+        if workers <= 1 or len(jobs) < self.REBUILD_PARALLEL_MIN:
+            return {
+                idx: self._contribution(
+                    pname, lease_name, rv, rep, renewed, rpt=rpt,
+                    **ctx_args,
+                )
+                for idx, lease_name, rep, renewed, rv in jobs
+            }
+        from concurrent.futures import ThreadPoolExecutor
+
+        out: Dict[int, NodeContribution] = {}
+
+        def derive_chunk(chunk):
+            return [
+                (idx, self._contribution(
+                    pname, lease_name, rv, rep, renewed, rpt=rpt,
+                    **ctx_args,
+                ))
+                for idx, lease_name, rep, renewed, rv in chunk
+            ]
+
+        step = -(-len(jobs) // workers)
+        chunks = [jobs[i:i + step] for i in range(0, len(jobs), step)]
+        with ThreadPoolExecutor(max_workers=len(chunks)) as pool:
+            for result in pool.map(derive_chunk, chunks):
+                out.update(result)
+        return out
+
     def _rebuild_derived(
         self, pname: str, ps: PassState, entries: List[Any],
         ctx, key_fn, ctx_args: Dict[str, Any],
-        prev_rows: Dict[str, str],
+        prev_rows: Dict[str, str], allow_reuse: bool = False,
+        generation: Any = None,
     ) -> Tuple[PolicyDerived, List[Tuple[str, str, str]]]:
-        """From-scratch rebuild: re-derive every contribution from the
-        (already target-filtered) bucketed report entries, then swap
-        the aggregates wholesale.  Every section version bumps
-        (conservatively — each section's own diff gate still prevents
-        redundant writes).  This is both the legacy full-pass behavior
-        and the drift bound of the incremental path."""
+        """Full rebuild: fold the aggregates from scratch over the
+        (already target-filtered) bucketed report entries.  Every
+        section version bumps (conservatively — each section's own
+        diff gate still prevents redundant writes).  This is both the
+        drift bound of the incremental path and the restart/failover
+        entry point.
+
+        Three tiers keep it off the O(fleet)-re-derivation cliff:
+
+        * ``allow_reuse`` (same process, same spec generation): a lease
+          whose rv is unchanged re-uses its in-memory contribution —
+          the periodic drift rebuild then re-derives only what churned
+          since the last pass, while the aggregate fold stays from-
+          scratch (the part that can actually drift);
+        * persisted resume (no in-memory baseline — restart/failover):
+          entries are diffed against the checkpointed contribution
+          cache (controller/contribcache.py) and only rv-changed
+          leases re-derive; counted in
+          ``tpunet_rebuild_resumed_nodes_total``;
+        * whatever remains derives in parallel across the rebuild
+          worker pool.
+
+        ``FULL_REBUILD_ALWAYS`` (the equivalence reference) disables
+        all three: every contribution derives from its report,
+        serially, every pass — the byte-identical baseline the suite
+        compares against."""
         from ..agent import report as rpt
+        from . import contribcache
 
         old_d = self._derived.get(pname)
         ps.stale_heap = []
+        reference = self.FULL_REBUILD_ALWAYS
+        cache_entries = None
+        cache_versions: List[str] = []
+        if (
+            not reference
+            and old_d is None
+            and self.CONTRIB_CACHE_BYTES > 0
+            and entries
+        ):
+            cache_entries, cache_versions, cache_payloads = (
+                contribcache.load(
+                    self.client, self.namespace, pname, generation,
+                )
+            )
+            if cache_entries is not None:
+                # seed the checkpoint writer's diff gate with what is
+                # ALREADY on the cluster: a failover whose fleet still
+                # matches the checkpoint then skips re-serializing
+                # (and re-applying) the whole thing
+                with self._reports_lock:
+                    self._contrib_applied[pname] = cache_payloads
+                    self._contrib_fp[pname] = contribcache.fingerprint(
+                        generation,
+                        [
+                            (lease, str(e[0]))
+                            for lease, e in cache_entries.items()
+                        ],
+                        cache_versions,
+                    )
+        now_wall = ctx_args["now_wall"]
+        ttl = self.REPORT_TTL_SECONDS
         d = PolicyDerived()
         d.set_shard_ctx(ctx, key_fn)
-        for lease_name, rep, renewed in entries:
-            c = self._contribution(
-                pname, lease_name, "", rep, renewed, rpt=rpt, **ctx_args,
-            )
-            d.apply(lease_name, c)
+        resumed_memory = resumed_cache = 0
+        contribs: List[Optional[NodeContribution]] = [None] * len(entries)
+        jobs: List[Tuple] = []
+        persisted_idx: List[int] = []
+        for idx, (lease_name, rep, renewed, rv) in enumerate(entries):
+            if not reference and allow_reuse and old_d is not None:
+                old_c = old_d.contribs.get(lease_name)
+                if old_c is not None and self._resumable(
+                    old_c, rv, renewed, now_wall, ttl
+                ):
+                    contribs[idx] = old_c
+                    resumed_memory += 1
+                    continue
+            if cache_entries is not None:
+                raw_entry = cache_entries.get(lease_name)
+                if raw_entry is not None and str(raw_entry[0]) == rv:
+                    try:
+                        c = contribcache.decode_entry(
+                            lease_name, raw_entry, rep,
+                        )
+                    except Exception:   # noqa: BLE001 — malformed entry
+                        log.exception(
+                            "contribution cache entry for %s undecodable;"
+                            " re-deriving", lease_name,
+                        )
+                        c = None
+                    if c is not None and self._resumable(
+                        c, rv, renewed, now_wall, ttl
+                    ):
+                        contribs[idx] = c
+                        persisted_idx.append(idx)
+                        resumed_cache += 1
+                        continue
+            jobs.append((idx, lease_name, rep, renewed, rv))
+        derived = self._derive_entries(pname, jobs, ctx_args, rpt)
+        for idx, c in derived.items():
+            contribs[idx] = c
+        if resumed_cache:
+            # agent-version-skew guard: the checkpoint header carries
+            # the fleet version set it was cut under.  If the set the
+            # rebuilt fleet actually carries differs, projection
+            # semantics may have moved in ways per-lease rvs cannot
+            # witness — distrust every resumed entry and re-derive it.
+            live_versions = sorted({
+                c.version for c in contribs if c is not None and c.version
+            })
+            if live_versions != sorted(cache_versions):
+                log.info(
+                    "contribution cache for %s invalidated: agent "
+                    "version skew flipped (%s -> %s); re-deriving %d "
+                    "resumed node(s)", pname, cache_versions,
+                    live_versions, resumed_cache,
+                )
+                redo = [
+                    (idx, entries[idx][0], entries[idx][1],
+                     entries[idx][2], entries[idx][3])
+                    for idx in persisted_idx
+                ]
+                for idx, c in self._derive_entries(
+                    pname, redo, ctx_args, rpt,
+                ).items():
+                    contribs[idx] = c
+                resumed_cache = 0
+        if self.metrics and (resumed_memory or resumed_cache):
+            if resumed_memory:
+                self.metrics.inc(
+                    "tpunet_rebuild_resumed_nodes_total",
+                    {"policy": pname, "source": "memory"},
+                    by=resumed_memory,
+                )
+            if resumed_cache:
+                self.metrics.inc(
+                    "tpunet_rebuild_resumed_nodes_total",
+                    {"policy": pname, "source": "persisted"},
+                    by=resumed_cache,
+                )
+        for (lease_name, rep, renewed, rv), c in zip(entries, contribs):
+            if c is None:
+                continue   # derivation raced a prune; next pass rebuilds
+            d.add_fresh(lease_name, c)
             if old_d is not None:
                 # journal per-node edges against the previous derived
                 # state; with no baseline (process start) the rebuild
                 # journals nothing — a restart must not fabricate a
                 # fleet-wide flood of phantom transitions
-                self._note_contribution_edges(
-                    pname, old_d.contribs.get(lease_name), c,
-                )
+                old_c = old_d.contribs.get(lease_name)
+                if old_c is not c:
+                    self._note_contribution_edges(pname, old_c, c)
             if c.ok and renewed is not None:
                 heapq.heappush(ps.stale_heap, (
                     renewed + self.REPORT_TTL_SECONDS, lease_name,
@@ -1539,6 +1769,165 @@ class NetworkClusterPolicyReconciler:
         self._derived[pname] = d
         self._ingest_report_traces(d.reports())
         return d, changed
+
+    def _save_contrib_cache(
+        self, policy: NetworkClusterPolicy, d: PolicyDerived,
+        generation: Any,
+    ) -> None:
+        """Checkpoint the policy's contributions into the owned
+        ``tpunet-contribcache-*`` ConfigMaps (controller/
+        contribcache.py).  Triple-gated so a steady fleet costs zero
+        requests and zero serialization: a (generation, lease→rv,
+        versions) fingerprint skips unchanged fleets outright, a
+        per-chunk payload diff applies only chunks that moved, and a
+        restart read-back re-seeds the diff gate instead of
+        blind-rewriting every chunk."""
+        if self.CONTRIB_CACHE_BYTES <= 0 or self.FULL_REBUILD_ALWAYS:
+            # the FULL_REBUILD_ALWAYS reference models the pre-sharding
+            # pipeline: no checkpoint writes (and its every-pass
+            # cadence would serialize the fleet per pass)
+            return
+        if not self.dirty.active:
+            # no informer layer = EVERY pass is a full rebuild (the
+            # legacy mode): checkpointing here would rewrite chunks on
+            # every lease heartbeat, and there is no steady state the
+            # resume path could hand back anyway
+            return
+        from . import contribcache
+
+        pname = policy.metadata.name
+        versions = sorted(d.versions)
+        fp = contribcache.fingerprint(
+            generation,
+            [(lease, c.rv) for lease, c in d.contribs.items()],
+            versions,
+        )
+        with self._reports_lock:
+            state = self._contrib_applied.get(pname)
+            if state is not None and self._contrib_fp.get(pname) == fp:
+                return
+            applied = dict(state) if state is not None else None
+        payloads = contribcache.build_payloads(
+            pname, generation, versions, d.contribs,
+            self.CONTRIB_CACHE_BYTES,
+        )
+        if applied is None:
+            # restart/failover: read every desired chunk back once so
+            # an unchanged checkpoint re-seeds the diff gate instead
+            # of being blind-rewritten.  The read-back must also cover
+            # the PRIOR split's chunk range (from chunk-0's meta) —
+            # when load() discarded the cache (e.g. spec generation
+            # moved) nothing else knows about tail chunks past the new
+            # count, and they would otherwise leak until CR deletion.
+            applied = {}
+            readback = set(payloads)
+            try:
+                first = self.client.get(
+                    "v1", "ConfigMap",
+                    contribcache.cm_name(pname, 0), self.namespace,
+                )
+                import json as json_mod
+
+                meta = json_mod.loads(
+                    (first.get("data", {}) or {}).get(
+                        contribcache.META_KEY, "{}"
+                    )
+                )
+                prior = int(meta.get("chunks", 0))
+                if 0 < prior <= contribcache.MAX_CHUNKS:
+                    readback.update(
+                        contribcache.cm_name(pname, i)
+                        for i in range(prior)
+                    )
+            except Exception as e:   # noqa: BLE001 — nothing to GC yet
+                log.debug("contrib cache meta read-back: %s", e)
+            for name in sorted(readback):
+                try:
+                    cur = self.client.get(
+                        "v1", "ConfigMap", name, self.namespace
+                    )
+                    applied[name] = dict(cur.get("data", {}) or {})
+                except kerr.NotFoundError:
+                    pass
+                except Exception as e:   # noqa: BLE001 — apply heals
+                    log.debug("contrib cache read-back failed: %s", e)
+        clean = True
+        for name, data in payloads.items():
+            if applied.get(name) == data:
+                continue
+            oversize = any(
+                len(v.encode()) > self.CONTRIB_CACHE_BYTES
+                for v in data.values()
+            )
+            if oversize:
+                # kilobyte lease names at max split: refuse this chunk
+                # (resume degrades to re-derivation, never truncation)
+                log.error(
+                    "contribution cache chunk %s over budget even at "
+                    "max split; skipping", name,
+                )
+                continue
+            cm = {
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                "metadata": {"name": name, "namespace": self.namespace},
+                "data": data,
+            }
+            self._own(policy, cm)
+            try:
+                self.client.apply(
+                    cm, field_manager=contribcache.FIELD_MANAGER
+                )
+                applied[name] = data
+            except Exception as e:   # noqa: BLE001 — next rebuild retries
+                log.warning("contribution cache apply failed: %s", e)
+                clean = False
+        # GC chunks past the current split count (fleet shrank)
+        for name in [n for n in list(applied) if n not in payloads]:
+            try:
+                self.client.delete(
+                    "v1", "ConfigMap", name, self.namespace
+                )
+                applied.pop(name, None)
+            except kerr.NotFoundError:
+                applied.pop(name, None)
+            except Exception as e:   # noqa: BLE001 — retried next rebuild
+                log.debug("contrib cache chunk GC failed: %s", e)
+        with self._reports_lock:
+            self._contrib_applied[pname] = applied
+            if clean:
+                self._contrib_fp[pname] = fp
+
+    def release_policy(self, name: str) -> None:
+        """Shard handoff: this replica no longer owns the policy —
+        drop every piece of in-memory per-policy state and retract its
+        metric series, WITHOUT any external write (the successor owns
+        the cluster-side objects now; mutating them here would race
+        it).  The inverse of the first reconcile's lazy setup."""
+        self._derived.pop(name, None)
+        self._pass_state.pop(name, None)
+        self._ds_checked.pop(name, None)
+        self.dirty.forget(name)
+        self._prune_probe_state(name)
+        with self._reports_lock:
+            self._plan_cm_applied.pop(name, None)
+            self._plan_labels.pop(name, None)
+            self._rem_applied.pop(name, None)
+            self._rem_ledgers.pop(name, None)
+            self._rem_denied.pop(name, None)
+            self._rem_quorum_held.pop(name, None)
+            self._contrib_applied.pop(name, None)
+            self._contrib_fp.pop(name, None)
+        self._plan_tracker.forget(name)
+        if self.metrics:
+            for gauge in POLICY_GAUGES + PLAN_GAUGES + REMEDIATION_GAUGES:
+                self.metrics.remove_gauge(gauge, {"policy": name})
+            for gauge in (
+                "tpunet_status_bytes", "tpunet_reconcile_dirty_nodes",
+            ):
+                self.metrics.remove_gauge(gauge, {"policy": name})
+            for gauge in TELEMETRY_GAUGES:
+                self.metrics.remove_matching(gauge, {"policy": name})
 
     # -- dataplane probe mesh -------------------------------------------------
 
@@ -3161,9 +3550,23 @@ class NetworkClusterPolicyReconciler:
             }
             d, changed_rows = self._rebuild_derived(
                 pname, ps, entries, ctx, key_fn, ctx_args, prev_rows,
+                # same process + same spec generation: unchanged leases
+                # may re-use their in-memory contributions (the
+                # REBUILD_REUSE drift-rebuild fast path)
+                allow_reuse=(
+                    self.REBUILD_REUSE and ps.generation == generation
+                ),
+                generation=generation,
             )
             n_dirty = len(d.contribs)
             ps.rebuild_due_probe = now_probe + self.FULL_REBUILD_SECONDS
+            # checkpoint the rebuilt contributions (fingerprint + diff
+            # gated — an unchanged fleet's rebuild writes nothing and
+            # skips even the serialization); rebuilds are the ONLY
+            # writers, so the persisted cache lags live churn by at
+            # most FULL_REBUILD_SECONDS — bounded staleness that costs
+            # re-derivation on resume, never wrong output
+            self._save_contrib_cache(policy, d, generation)
         else:
             if pods_dirty or ps.target_nodes is None:
                 new_targets = self._target_nodes(ds)
@@ -3275,12 +3678,45 @@ class NetworkClusterPolicyReconciler:
                 or not ps.peers_clean
                 or verify_due
             ):
-                ps.peers_clean = self._sync_probe_peers(
-                    policy, dict(d.endpoints)
+                endpoints = dict(d.endpoints)
+                with self._reports_lock:
+                    racks_ver_now = self._node_racks_version
+                    peer_state = self._peer_applied.get(pname)
+                # the anti-entropy window judged LIVE (same clock math
+                # as _sync_probe_peers), not off the armed deadline —
+                # a shortened PEER_CM_VERIFY_SECONDS must take effect
+                # on the next pass, not after the old deadline
+                in_verify_window = (
+                    peer_state is not None
+                    and now_probe - peer_state.get("verified_at", -1e9)
+                    < self.PEER_CM_VERIFY_SECONDS
                 )
-                if ps.peers_clean:
+                if (
+                    in_verify_window
+                    and ps.peers_clean
+                    and ps.generation == generation
+                    and ps.peers_endpoints == endpoints
+                    and ps.peers_racks_ver == racks_ver_now
+                ):
+                    # version moved (a rebuild bumps conservatively)
+                    # but every input of the peer distribution —
+                    # endpoint map, spec, rack map — is unchanged:
+                    # the flush would re-derive and then diff away
+                    # the identical payloads.  Skip the derivation,
+                    # but keep the anti-entropy deadline armed (the
+                    # read-back repair must still fire on schedule).
                     ps.peers_synced = d.vers["peers"]
-                ps.verify_due_probe = self._peer_verify_due(pname)
+                    ps.verify_due_probe = self._peer_verify_due(pname)
+                else:
+                    ps.peers_clean = self._sync_probe_peers(
+                        policy, endpoints
+                    )
+                    if ps.peers_clean:
+                        ps.peers_synced = d.vers["peers"]
+                        ps.peers_endpoints = endpoints
+                        with self._reports_lock:
+                            ps.peers_racks_ver = self._node_racks_version
+                    ps.verify_due_probe = self._peer_verify_due(pname)
             phases["project"] += t_phase() - pp
 
             degraded = sorted(d.degraded)
@@ -3672,11 +4108,16 @@ class NetworkClusterPolicyReconciler:
             # CR; this drops the in-memory ledger/diff state + metric
             # series (and re-deletes the CMs, tolerated when gone)
             self._cleanup_remediation(name)
-            # delta pipeline state dies with the policy
+            # delta pipeline state dies with the policy (the persisted
+            # contribution-cache ConfigMaps are owner-GC'd with the CR;
+            # only the in-memory diff gates need dropping here)
             self._derived.pop(name, None)
             self._pass_state.pop(name, None)
             self._ds_checked.pop(name, None)
             self.dirty.forget(name)
+            with self._reports_lock:
+                self._contrib_applied.pop(name, None)
+                self._contrib_fp.pop(name, None)
             # journal + SLO state die with it too (series retracted)
             if self.timeline is not None:
                 self.timeline.forget(name)

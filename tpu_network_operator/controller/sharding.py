@@ -1,0 +1,428 @@
+"""Horizontal control-plane sharding: hash-partitioned policy ownership.
+
+One controller process holds every informer cache and derived
+contribution in RAM, and a from-scratch rebuild is O(fleet) — past
+~10k nodes a single replica is the ceiling.  This module partitions
+**policies** (the unit the workqueue already serializes on) across N
+controller replicas with the same Lease machinery leader election
+already uses:
+
+* every replica maintains a **heartbeat Lease**
+  (``tpunet-replica-<hash>``, holderIdentity = the replica identity);
+  the live membership is the set of unexpired heartbeats;
+* each of the ``n_shards`` fixed shards has a **shard Lease**
+  (``tpunet-shard-<i>``) whose preferred owner is decided by
+  rendezvous (highest-random-weight) hashing of ``(shard, replica)``
+  over the live membership — a replica join/leave moves ONLY the
+  shards that replica wins/loses, never the whole fleet (the HRW
+  property that makes a handoff bounded rather than a rebuild storm);
+* a replica acquires the shard Leases it prefers (CAS on
+  holderIdentity + renewTime, exactly the leader-election contract:
+  an unexpired Lease held by a live peer is never stolen, so two
+  owners of one shard can never coexist) and releases the ones it no
+  longer prefers, which is the whole handoff protocol;
+* a policy belongs to shard ``stable_hash(name) % n_shards`` — pure,
+  stable across processes, no assignment table to coordinate.
+
+The shard-0 owner additionally acts as the thin **aggregator**: every
+owner publishes a per-shard rollup ConfigMap (diff-gated — a steady
+fleet writes nothing) and the shard-0 owner folds them into the
+fleet-level ``tpunet_fleet_*`` gauges.
+
+Like leader election, the coordinator must run over the RAW (retrying)
+client, never a cached read — ownership correctness cannot lag a watch
+stream.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import socket
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..kube import errors as kerr
+from ..probe.topology import stable_hash
+from .leader import LEASE_DURATION, _parse
+
+log = logging.getLogger("tpunet.sharding")
+
+SHARD_LEASE_PREFIX = "tpunet-shard-"
+REPLICA_LEASE_PREFIX = "tpunet-replica-"
+REPLICA_LABEL = "tpunet.dev/shard-replica"
+ROLLUP_CM_PREFIX = "tpunet-shard-rollup-"
+ROLLUP_LABEL = "tpunet.dev/shard-rollup"
+ROLLUP_KEY = "rollup"
+ROLLUP_FIELD_MANAGER = "tpunet-operator-sharding"
+
+
+def shard_of_policy(name: str, n_shards: int) -> int:
+    """Which shard owns a policy — a pure function of (name, shard
+    count), so every replica (and every test) agrees without a lookup."""
+    if n_shards <= 1:
+        return 0
+    return stable_hash(name) % n_shards
+
+
+def preferred_owner(shard: int, members: List[str]) -> str:
+    """Rendezvous/HRW choice: the member with the highest seeded hash
+    for this shard.  Removing one member re-homes exactly the shards it
+    was winning; adding one steals only the shards it now wins."""
+    if not members:
+        return ""
+    return max(members, key=lambda m: (stable_hash(f"{shard}/{m}"), m))
+
+
+def _fmt(ts: float) -> str:
+    """RFC3339 from an arbitrary clock value — the coordinator writes
+    renewTime from its OWN (injectable) clock, so expiry comparisons
+    and renewals share one time domain in tests and benches."""
+    frac = int((ts % 1) * 1_000_000)
+    return time.strftime(
+        f"%Y-%m-%dT%H:%M:%S.{frac:06d}Z", time.gmtime(ts)
+    )
+
+
+def _replica_lease_name(identity: str) -> str:
+    # identities carry host_uuid characters illegal in object names —
+    # the name is a stable digest, the identity rides holderIdentity
+    digest = hashlib.sha1(identity.encode()).hexdigest()[:10]
+    return f"{REPLICA_LEASE_PREFIX}{digest}"
+
+
+class ShardCoordinator:
+    """Per-replica shard membership + ownership state machine.
+
+    ``sync()`` runs one round (heartbeat → membership → acquire/release)
+    and returns ``(gained, lost)`` shard-index sets; the manager reacts
+    by enqueueing newly owned policies and releasing in-memory state
+    for lost ones.  ``owns(policy_name)`` is the hot-path filter —
+    pure in-memory, no I/O.
+
+    ``clock`` is a test seam (wall time: lease expiry must survive a
+    process restart, which is exactly what monotonic clocks don't)."""
+
+    def __init__(
+        self,
+        client,
+        namespace: str,
+        n_shards: int,
+        identity: Optional[str] = None,
+        lease_duration: float = LEASE_DURATION,
+        clock=None,
+        metrics=None,
+    ):
+        import time as time_mod
+
+        self.client = client
+        self.namespace = namespace
+        self.n_shards = max(1, int(n_shards))
+        self.identity = (
+            identity or f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+        )
+        self.lease_duration = lease_duration
+        self.clock = clock or time_mod.time
+        self.metrics = metrics
+        self.owned: Set[int] = set()
+        self._lock = threading.Lock()
+        self._stopped = False
+
+    # -- lease plumbing -------------------------------------------------------
+
+    def _lease_obj(self, name: str, labels: Optional[Dict] = None) -> dict:
+        meta: Dict[str, Any] = {"name": name, "namespace": self.namespace}
+        if labels:
+            meta["labels"] = labels
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": meta,
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": int(self.lease_duration),
+                "renewTime": _fmt(self.clock()),
+            },
+        }
+
+    def _expired(self, lease: Dict[str, Any]) -> bool:
+        spec = lease.get("spec", {}) or {}
+        renew = _parse(str(spec.get("renewTime", "") or ""))
+        return (self.clock() - renew) > self.lease_duration
+
+    def _heartbeat(self) -> None:
+        name = _replica_lease_name(self.identity)
+        obj = self._lease_obj(name, labels={REPLICA_LABEL: "true"})
+        try:
+            self.client.apply(obj, field_manager=ROLLUP_FIELD_MANAGER)
+        except Exception as e:   # noqa: BLE001 — next round retries; an
+            # expired heartbeat just drops us from membership (safe side)
+            log.warning("replica heartbeat failed: %s", e)
+
+    def members(self) -> List[str]:
+        """Live replica identities (unexpired heartbeat Leases), sorted.
+        On a read failure, degrade to {self}: acting as the only member
+        can at worst contend CAS-safely for shards a live peer holds —
+        it can never steal an unexpired Lease."""
+        try:
+            leases = self.client.list(
+                "coordination.k8s.io/v1", "Lease",
+                namespace=self.namespace,
+                label_selector={REPLICA_LABEL: "true"},
+            )
+        except Exception as e:   # noqa: BLE001 — degrade to singleton
+            log.warning("replica membership list failed: %s", e)
+            return [self.identity]
+        out = set()
+        for lease in leases:
+            holder = str(
+                (lease.get("spec", {}) or {}).get("holderIdentity", "")
+                or ""
+            )
+            if holder and not self._expired(lease):
+                out.add(holder)
+        out.add(self.identity)
+        return sorted(out)
+
+    def _try_take_shard(self, shard: int) -> bool:
+        """One CAS round for ``tpunet-shard-<shard>``; True = we hold
+        it.  Identical contract to LeaderElector.try_acquire_or_renew:
+        an unexpired Lease held by someone else is never overwritten
+        (two-leaders-never, per shard)."""
+        name = f"{SHARD_LEASE_PREFIX}{shard}"
+        try:
+            lease = self.client.get(
+                "coordination.k8s.io/v1", "Lease", name, self.namespace
+            )
+        except kerr.NotFoundError:
+            try:
+                self.client.create(self._lease_obj(name))
+                return True
+            except (kerr.AlreadyExistsError, kerr.ConflictError):
+                return False
+        except Exception as e:   # noqa: BLE001 — transient; keep state
+            log.warning("shard %d lease read failed: %s", shard, e)
+            return shard in self.owned
+        spec = lease.setdefault("spec", {})
+        holder = str(spec.get("holderIdentity", "") or "")
+        if holder and holder != self.identity and not self._expired(lease):
+            return False
+        spec["holderIdentity"] = self.identity
+        spec["renewTime"] = _fmt(self.clock())
+        spec["leaseDurationSeconds"] = int(self.lease_duration)
+        try:
+            self.client.update(lease)
+            return True
+        except kerr.ConflictError:
+            return False
+        except Exception as e:   # noqa: BLE001 — transient
+            log.warning("shard %d lease CAS failed: %s", shard, e)
+            return shard in self.owned
+
+    def _release_shard(self, shard: int) -> None:
+        name = f"{SHARD_LEASE_PREFIX}{shard}"
+        try:
+            lease = self.client.get(
+                "coordination.k8s.io/v1", "Lease", name, self.namespace
+            )
+            if (
+                lease.get("spec", {}).get("holderIdentity")
+                == self.identity
+            ):
+                lease["spec"]["holderIdentity"] = ""
+                self.client.update(lease)
+        except kerr.ApiError:
+            pass
+        except Exception as e:   # noqa: BLE001 — expiry hands it off
+            log.debug("shard %d release failed: %s", shard, e)
+
+    # -- one round ------------------------------------------------------------
+
+    def sync(self) -> Tuple[Set[int], Set[int]]:
+        """Heartbeat, recompute preferred ownership over the live
+        membership, acquire/renew preferred shard Leases, release
+        no-longer-preferred ones.  Returns ``(gained, lost)``."""
+        if self._stopped:
+            return set(), set()
+        self._heartbeat()
+        members = self.members()
+        want = {
+            s for s in range(self.n_shards)
+            if preferred_owner(s, members) == self.identity
+        }
+        with self._lock:
+            before = set(self.owned)
+        now_owned = set()
+        for shard in sorted(want):
+            if self._try_take_shard(shard):
+                now_owned.add(shard)
+        # handoff: release shards a membership change re-homed — the
+        # new preferred owner acquires on ITS next round (or, if we
+        # crash before releasing, on our Lease expiry)
+        for shard in sorted(before - want):
+            self._release_shard(shard)
+        with self._lock:
+            self.owned = now_owned
+        gained, lost = now_owned - before, before - now_owned
+        if self.metrics:
+            for shard in range(self.n_shards):
+                if shard in now_owned:
+                    self.metrics.set_gauge(
+                        "tpunet_shard_owner", 1.0,
+                        {"shard": str(shard)},
+                    )
+                else:
+                    self.metrics.remove_gauge(
+                        "tpunet_shard_owner", {"shard": str(shard)}
+                    )
+        if gained or lost:
+            log.info(
+                "shard ownership moved: +%s -%s (now %s of %d, %d "
+                "member(s))", sorted(gained), sorted(lost),
+                sorted(now_owned), self.n_shards, len(members),
+            )
+        return gained, lost
+
+    # -- hot-path filters (no I/O) --------------------------------------------
+
+    def owns_shard(self, shard: int) -> bool:
+        with self._lock:
+            return shard in self.owned
+
+    def owns(self, policy_name: str) -> bool:
+        with self._lock:
+            return shard_of_policy(policy_name, self.n_shards) in self.owned
+
+    def stop(self) -> None:
+        """Release everything held (clean shutdown = immediate handoff
+        instead of a lease_duration wait)."""
+        self._stopped = True
+        with self._lock:
+            owned = sorted(self.owned)
+            self.owned = set()
+        for shard in owned:
+            self._release_shard(shard)
+        if self.metrics:
+            for shard in owned:
+                self.metrics.remove_gauge(
+                    "tpunet_shard_owner", {"shard": str(shard)}
+                )
+        name = _replica_lease_name(self.identity)
+        try:
+            lease = self.client.get(
+                "coordination.k8s.io/v1", "Lease", name, self.namespace
+            )
+            if (
+                lease.get("spec", {}).get("holderIdentity")
+                == self.identity
+            ):
+                lease["spec"]["holderIdentity"] = ""
+                self.client.update(lease)
+        except Exception:   # noqa: BLE001 — expiry drops us anyway
+            pass
+
+
+class ShardAggregator:
+    """The thin fleet-rollup fold.  Every shard owner calls
+    :meth:`publish` with its shards' policy rollups (diff-gated apply,
+    so a steady fleet writes zero requests); the shard-0 owner calls
+    :meth:`aggregate` to fold all rollup ConfigMaps into the
+    fleet-level gauges.  Rollup ConfigMaps are tiny (one JSON object of
+    counters per shard) — the aggregator never sees per-node data, so
+    it stays O(shards) at any fleet size."""
+
+    def __init__(self, client, namespace: str, metrics=None):
+        self.client = client
+        self.namespace = namespace
+        self.metrics = metrics
+        self._applied: Dict[str, str] = {}   # cm name -> payload
+
+    def forget(self, shard: int) -> None:
+        """Shard lost: drop the publish diff gate — another replica
+        owns the rollup now, and trusting a stale last-applied memory
+        on a later re-gain would skip republishing over the interim
+        owner's different payload (same contract as the reconciler's
+        per-policy applied gates in release_policy)."""
+        self._applied.pop(f"{ROLLUP_CM_PREFIX}{shard}", None)
+
+    def publish(
+        self, shard: int, policies: Dict[str, Dict[str, int]]
+    ) -> None:
+        """Write this shard's rollup (policy -> {targets, ready}) if it
+        changed.  ``policies`` holds only policies the caller owns."""
+        name = f"{ROLLUP_CM_PREFIX}{shard}"
+        payload = json.dumps({
+            "shard": shard,
+            "policies": {
+                p: dict(sorted(v.items()))
+                for p, v in sorted(policies.items())
+            },
+        }, sort_keys=True)
+        if self._applied.get(name) == payload:
+            return
+        cm = {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {
+                "name": name,
+                "namespace": self.namespace,
+                # the aggregator reads rollups by this selector — a
+                # namespace-wide CM list at fleet scale would drag
+                # every peer-shard and contribution-cache payload over
+                # the wire to fold a few hundred bytes
+                "labels": {ROLLUP_LABEL: "true"},
+            },
+            "data": {ROLLUP_KEY: payload},
+        }
+        try:
+            self.client.apply(cm, field_manager=ROLLUP_FIELD_MANAGER)
+            self._applied[name] = payload
+        except Exception as e:   # noqa: BLE001 — next tick retries
+            log.warning("shard %d rollup publish failed: %s", shard, e)
+
+    def aggregate(self) -> Dict[str, float]:
+        """Fold every shard's rollup ConfigMap into fleet totals and
+        export them (shard-0 owner only).  Also exports
+        ``tpunet_shard_policies{shard}`` from the published rollups —
+        the fleet-wide view of the partition balance."""
+        try:
+            cms = self.client.list(
+                "v1", "ConfigMap", namespace=self.namespace,
+                label_selector={ROLLUP_LABEL: "true"},
+            )
+        except Exception as e:   # noqa: BLE001 — next tick retries
+            log.warning("rollup aggregation list failed: %s", e)
+            return {}
+        fleet = {"policies": 0.0, "targets": 0.0, "ready": 0.0}
+        per_shard: Dict[str, int] = {}
+        for cm in cms:
+            name = cm.get("metadata", {}).get("name", "")
+            if not name.startswith(ROLLUP_CM_PREFIX):
+                continue
+            try:
+                row = json.loads(
+                    (cm.get("data", {}) or {}).get(ROLLUP_KEY, "{}")
+                )
+            except ValueError:
+                continue
+            policies = row.get("policies", {}) or {}
+            per_shard[str(row.get("shard", name))] = len(policies)
+            fleet["policies"] += len(policies)
+            for v in policies.values():
+                fleet["targets"] += float(v.get("targets", 0))
+                fleet["ready"] += float(v.get("ready", 0))
+        if self.metrics:
+            self.metrics.set_gauge("tpunet_fleet_policies",
+                                   fleet["policies"])
+            self.metrics.set_gauge("tpunet_fleet_nodes", fleet["targets"])
+            self.metrics.set_gauge("tpunet_fleet_ready_nodes",
+                                   fleet["ready"])
+            for shard, count in per_shard.items():
+                self.metrics.set_gauge(
+                    "tpunet_shard_policies", float(count),
+                    {"shard": shard},
+                )
+        return fleet

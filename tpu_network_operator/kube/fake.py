@@ -422,7 +422,8 @@ class FakeCluster:
         )
 
     def simulate_daemonset_controller(
-        self, ready_nodes: Optional[Iterable[str]] = None
+        self, ready_nodes: Optional[Iterable[str]] = None,
+        materialize_pods: bool = True,
     ) -> None:
         """Recompute every DaemonSet's status from current Nodes.
 
@@ -430,7 +431,11 @@ class FakeCluster:
         nodeSelector; numberReady = those of them in ``ready_nodes`` (all, if
         None).  Also materializes one fake agent Pod per scheduled node, owned
         by the DaemonSet (feeds the pod field indexer, ref controller
-        :385-404)."""
+        :385-404) — unless ``materialize_pods=False``, which the
+        100k-node scale sweeps use: per-pod objects triple the fake's
+        footprint while the status math only needs the DS counts (the
+        reconciler's target correlation degrades to trusting the Lease
+        set, its documented no-pods behavior)."""
         with self._lock:
             nodes = self.list("v1", "Node")
             for ds in self.list("apps/v1", "DaemonSet"):
@@ -456,7 +461,8 @@ class FakeCluster:
                     "numberReady": len(ready),
                 }
                 self.update_status(ds)
-                self._materialize_pods(ds, matched, set(ready))
+                if materialize_pods:
+                    self._materialize_pods(ds, matched, set(ready))
 
     def _materialize_pods(
         self, ds: Dict[str, Any], node_names: List[str], ready: set

@@ -250,6 +250,21 @@ class Informer:
         # because a relist can change the store without a per-key event
         # trail it can trust (the watch-gap hole)
         self._resync_listeners: List[Callable[[], None]] = []
+        # interest predicate (None = everything): a sharded controller
+        # replica narrows fleet-sized caches (report Leases, agent
+        # Pods) to the policies its shards own — the memory half of
+        # the single-process ceiling.  Out-of-interest objects are
+        # never stored; set_interest + refilter() re-scope a live
+        # store on shard handoff.  ``_interest_dropped`` tombstones
+        # the rv an object LEFT interest at: deleting it from the
+        # store also discards the stored rv, and without the
+        # tombstone a watch re-establishment replaying an OLDER
+        # (still-in-interest) event would resurrect a ghost until the
+        # next relist.  Cleared on every resync (the relist
+        # re-establishes truth), so it is bounded by the interest
+        # transitions inside one relist window.
+        self._interest: Optional[Callable[[Dict[str, Any]], bool]] = None
+        self._interest_dropped: Dict[Key, int] = {}
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -305,6 +320,23 @@ class Informer:
         as arbitrarily changed and reseed any derived state."""
         self._resync_listeners.append(fn)
 
+    def set_interest(
+        self, fn: Optional[Callable[[Dict[str, Any]], bool]]
+    ) -> None:
+        """Install (or clear) the interest predicate.  Takes effect for
+        new events immediately; call :meth:`refilter` to drop already-
+        stored out-of-interest objects and backfill newly-interesting
+        ones (a relist — the only way to recover objects the narrowed
+        watch path discarded)."""
+        self._interest = fn
+
+    def refilter(self) -> None:
+        """Re-scope the store to the current interest: one relist
+        (resync() skips out-of-interest objects on upsert, and its
+        prune pass drops stored keys the interest no longer admits
+        because they never appear in the live set)."""
+        self.resync()
+
     # -- event application -----------------------------------------------------
 
     def _in_scope(self, obj: Dict[str, Any]) -> bool:
@@ -319,7 +351,46 @@ class Informer:
         key_ns, key_name = m.get("namespace", ""), m.get("name", "")
         if self._resync_active:
             self._resync_touched.add((key_ns, key_name))
+        if (
+            self._interest is not None
+            and ev_type != "DELETED"
+            and not self._interest(obj)
+        ):
+            # out-of-interest: never stored — and an object whose
+            # labels MOVED out of interest must drop from the store,
+            # not linger at its last in-interest state.  The same
+            # stale-replay rv guard as the store path applies FIRST: a
+            # replayed OLDER out-of-interest event must not evict the
+            # newer in-interest object a later event stored.  Then
+            # tombstone the departure rv so a replayed OLDER
+            # in-interest event cannot resurrect it (see __init__).
+            stored_rv = self.store.rv_of(key_name, key_ns)
+            if (
+                stored_rv is not None
+                and _rv(obj)
+                and _rv(obj) < stored_rv
+            ):
+                return
+            if stored_rv is not None:
+                self.store.delete(key_ns, key_name)
+                self._update_gauge()
+            if _rv(obj):
+                self._interest_dropped[(key_ns, key_name)] = _rv(obj)
+            return
         current_rv = self.store.rv_of(key_name, key_ns)
+        if current_rv is None and ev_type != "DELETED":
+            dropped_rv = self._interest_dropped.get((key_ns, key_name))
+            if (
+                dropped_rv is not None
+                and _rv(obj)
+                and _rv(obj) <= dropped_rv
+            ):
+                # stale replay of a state OLDER than the out-of-
+                # interest transition that removed this key
+                return
+            if dropped_rv is not None:
+                # genuinely newer and back in interest: live again
+                del self._interest_dropped[(key_ns, key_name)]
         # replayed/duplicate event older than what the seed list (or a
         # later event) already stored: applying it would regress state —
         # for DELETED too (a stale delete racing the seed list of a
@@ -474,9 +545,17 @@ class Informer:
             raise
         with self._pump_lock:
             self._resync_active = False
+            # the relist re-establishes truth for every key: interest
+            # tombstones from before it are no longer needed
+            self._interest_dropped.clear()
             touched = self._resync_touched
             live = set()
             for obj in items:
+                if self._interest is not None and not self._interest(obj):
+                    # narrowed cache: out-of-interest objects never
+                    # enter the store (and any previously-stored one
+                    # falls to the prune below — it is not "live")
+                    continue
                 m = obj.get("metadata", {})
                 key = (m.get("namespace", ""), m.get("name", ""))
                 live.add(key)
