@@ -53,6 +53,11 @@ scale-bench: ## Control-plane scale proof: marked tests + the 100/2k/10k sweep, 
 	$(PYTHON) -m pytest tests/ -x -q -m "(scale or sharding) and not slow"
 	$(PYTHON) tools/scale_bench.py --out BENCH_scale.json
 
+.PHONY: exec-bench
+exec-bench: ## Execution proof: marked tests + the multi-process collective rung (measured vs the planner's modeled objective)
+	$(PYTHON) -m pytest tests/ -x -q -m "exec and not slow"
+	$(PYTHON) tools/exec_bench.py --out BENCH_exec.json
+
 .PHONY: planner-bench
 planner-bench: ## Topology-planner proof: marked tests + the planned-vs-naive ring bench
 	$(PYTHON) -m pytest tests/ -x -q -m "planner and not slow"

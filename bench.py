@@ -470,14 +470,14 @@ def main() -> None:
         # past 5 minutes at batch 8 x 2048) — shrink every rung so the
         # round completes and the cross-round series still gets a row;
         # the artifact's device_kind/cpu_fallback mark it incomparable.
-        # The adam8 rungs are dropped outright: the blockwise-quantized
-        # embedding update wedges XLA-CPU's constant folder for 8+
-        # minutes (a hang, not an exception — the rung fall-through
-        # cannot catch it); they stay measured on TPU rounds.
+        # The adam8 rungs run here too since optim8bit.init stopped
+        # jitting quantize(zeros): that graph's blockwise reduce-window
+        # over a broadcast zero wedged XLA-CPU's constant folder for
+        # ~1 min per large leaf (tests/test_optim8bit.py::
+        # test_xla_cpu_constant_folding_wedge keeps the repro pinned).
         ladder = [
             (cand_name, cand, 1, 512, opt)
             for (cand_name, cand, _b, _s, opt) in ladder
-            if opt != "adam8"
         ]
         os.environ.setdefault("BENCH_ITERS", "3")
     total_hbm = hbm * n

@@ -156,6 +156,186 @@ def test_two_processes_train_with_sharded_data(tmp_path):
     assert losses[0] == losses[1]
 
 
+# -- plan execution (the exec-bench worker leg) -------------------------------
+
+
+def _toy_plan(n, scenario="uniform"):
+    """A real compute_plan over a hand-built RTT matrix: uniform = one
+    flat rack, skewed = two racks interleaved with the naming order."""
+    from tpu_network_operator.planner import plan as pp
+
+    nodes = [f"exec-{i:03d}" for i in range(n)]
+    groups = {
+        node: (f"rack-{i % 2:02d}" if scenario == "skewed" else "rack-00")
+        for i, node in enumerate(nodes)
+    }
+    obs = {}
+    for i, a in enumerate(nodes):
+        obs[a] = {}
+        for j, b in enumerate(nodes):
+            if i == j:
+                continue
+            base = 0.1 if groups[a] == groups[b] else 5.0
+            obs[a][b] = base * (1.0 + 0.01 * (i + j))
+    return nodes, pp.compute_plan(pp.PlanInputs(
+        nodes=nodes, rtt=pp.build_matrix(obs), groups=groups,
+        excluded=frozenset(), seed="exec-e2e",
+    ))
+
+
+def _write_planned_bootstraps(tmp_path, tag, n, plan, nodes, port):
+    """The agent path per rank (build → write → apply_plan), returning
+    [(path, sha256-of-the-bytes-the-agent-left-on-disk)]."""
+    import hashlib
+
+    from tpu_network_operator.agent.tpu.bootstrap import (
+        apply_plan,
+        build_bootstrap,
+    )
+
+    out = []
+    for pid in range(n):
+        topo = TpuTopology(
+            accelerator_type="cpu-host-1", topology="1x1",
+            ici_mesh=(1, 1), num_chips=1, chips_per_host=1,
+            num_hosts=1, worker_id=0, num_slices=n, slice_id=pid,
+            megascale_coordinator="127.0.0.1",
+        )
+        cfg = build_bootstrap(
+            topo, [{"workerId": 0, "ipAddress": "127.0.0.1"}],
+            coordinator_port=port,
+            megascale_coordinator=topo.megascale_coordinator,
+        )
+        path = tmp_path / f"bootstrap-{tag}{pid}.json"
+        write_bootstrap(cfg, str(path))
+        assert apply_plan(str(path), plan.to_payload(),
+                          node=nodes[pid]) is True
+        out.append((path, hashlib.sha256(path.read_bytes()).hexdigest()))
+    return out
+
+
+@pytest.mark.exec
+def test_plan_bootstrap_byte_equality_property(tmp_path):
+    """The byte-equality half of the exec contract, process-free: for
+    several fleet shapes, the bootstrap the agent leaves on disk after
+    plan adoption (a) is stable — re-applying the same plan is a
+    byte-level no-op — and (b) parses losslessly: read_bootstrap →
+    write_bootstrap round-trips to the identical bytes the worker's
+    sha256 covers.  Together these make the launcher's
+    ``bootstrap_bytes_verified`` gate a property of the pipeline, not
+    of one lucky run."""
+    import hashlib
+
+    from tpu_network_operator.agent.tpu.bootstrap import (
+        apply_plan,
+        read_bootstrap,
+    )
+
+    for n, scenario in [(2, "uniform"), (3, "uniform"), (4, "skewed")]:
+        nodes, plan = _toy_plan(n, scenario)
+        pairs = _write_planned_bootstraps(
+            tmp_path, f"prop-{scenario}{n}-", n, plan, nodes, port=1234
+        )
+        for pid, (path, sha) in enumerate(pairs):
+            # idempotent adoption: same plan again changes nothing
+            assert apply_plan(
+                str(path), plan.to_payload(), node=nodes[pid]
+            ) is False
+            assert hashlib.sha256(path.read_bytes()).hexdigest() == sha
+            # lossless parse: what the worker reads re-serializes to
+            # the exact bytes the agent wrote
+            cfg = read_bootstrap(str(path))
+            assert cfg.plan["version"] == plan.version
+            assert cfg.plan["ringIndex"] == plan.ring.index(nodes[pid])
+            copy = tmp_path / f"rt-{scenario}{n}-{pid}.json"
+            write_bootstrap(cfg, str(copy))
+            assert copy.read_bytes() == path.read_bytes()
+
+
+@pytest.mark.exec
+def test_exec_bench_worker_pair_executes_plan(tmp_path):
+    """mesh_from_bootstrap under REAL 2-process jax.distributed: two
+    ``workload exec-bench`` ranks consume agent-written plan-adopted
+    bootstraps, form the global mesh per the plan's meshAxisOrder, time
+    all strategy variants, and report the sha256 of the exact bytes
+    they consumed — which must match what the agent left on disk."""
+    nodes, plan = _toy_plan(2, "uniform")
+    port = _free_port()
+    pairs = _write_planned_bootstraps(tmp_path, "ex", 2, plan, nodes, port)
+    procs = []
+    try:
+        for path, _ in pairs:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "tpu_network_operator.workload",
+                 "exec-bench", "--bootstrap", str(path),
+                 "--sizes-mb", "0.25", "--iters", "1"],
+                cwd=REPO, env=_child_env(),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            ))
+        results = []
+        for pid, proc in enumerate(procs):
+            out, err = proc.communicate(timeout=300)
+            assert proc.returncode == 0, (
+                f"rank {pid} failed:\nstdout: {out}\nstderr: {err[-2000:]}"
+            )
+            results.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    payload = plan.to_payload()
+    for pid, (r, (_, sha)) in enumerate(zip(results, pairs)):
+        assert r["bootstrap_sha256"] == sha          # byte-equality gate
+        assert r["plan_version"] == plan.version
+        assert r["collective_hint"] == "ring"        # one flat rack
+        assert r["mesh_axis_order"] == payload["meshAxisOrder"]
+        assert r["global_devices"] == 2
+        row = r["results"][0]
+        for key in ("planned_s", "ring_s", "hierarchical_s", "naive_s"):
+            assert row[key] > 0, key
+        # the plan hints ring, so the planned timing IS the ring timing
+        assert row["planned_strategy"] == "ring"
+        assert row["planned_s"] == row["ring_s"]
+
+
+@pytest.mark.exec
+@pytest.mark.slow
+def test_exec_bench_worker_pair_soak_sizes(tmp_path):
+    """The slow leg: the same 2-rank planned consumption at soak
+    payloads (1 MB and 4 MB, multiple iters) — the per-size rows must
+    stay well-formed and the byte contract must hold at every size."""
+    nodes, plan = _toy_plan(2, "uniform")
+    port = _free_port()
+    pairs = _write_planned_bootstraps(tmp_path, "sk", 2, plan, nodes, port)
+    procs = []
+    try:
+        for path, _ in pairs:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "tpu_network_operator.workload",
+                 "exec-bench", "--bootstrap", str(path),
+                 "--sizes-mb", "1", "4", "--iters", "2"],
+                cwd=REPO, env=_child_env(),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            ))
+        results = []
+        for pid, proc in enumerate(procs):
+            out, err = proc.communicate(timeout=600)
+            assert proc.returncode == 0, (
+                f"rank {pid} failed:\nstderr: {err[-2000:]}"
+            )
+            results.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    for r, (_, sha) in zip(results, pairs):
+        assert r["bootstrap_sha256"] == sha
+        assert [row["size_mb"] for row in r["results"]] == [1.0, 4.0]
+        assert all(row["planned_algbw_gbps"] > 0 for row in r["results"])
+
+
 @pytest.mark.slow
 def test_two_processes_sharded_decode(tmp_path):
     """2-process generation: the KV cache and prompt batch shard over the

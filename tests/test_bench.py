@@ -816,3 +816,89 @@ class TestTimelineBench:
             row["chaos"].pop("why_chars", None)
             row.pop("vs_baseline", None)
         assert runs[0] == runs[1]
+
+
+@pytest.mark.exec
+class TestExecBench:
+    """tools/exec_bench.py — the measured half of the planner story."""
+
+    def _load_module(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "exec_bench", os.path.join(REPO_ROOT, "tools", "exec_bench.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_artifact_schema_and_gates(self, tmp_path):
+        """The launcher at toy scale (one 2-proc uniform scenario, one
+        payload): BENCH-style JSON artifact, last stdout line == --out
+        file, gates green, bootstrap bytes verified, and the measured
+        deltas sitting beside the planner's modeled objective."""
+        out = tmp_path / "BENCH_exec.json"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "exec_bench.py"),
+             "--procs-list", "2", "--sizes-mb", "0.25", "--iters", "1",
+             # a single tiny payload is far below the ordering gate's
+             # statistical envelope (the full sweep's best-of-3 over
+             # three sizes); this test gates plumbing + schema, so the
+             # tolerance is opened wide enough that only a broken mesh
+             # (not same-host jitter) can trip it
+             "--order-noise-tol", "3.0",
+             "--out", str(out)],
+            capture_output=True, text=True, timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert row == json.loads(out.read_text())
+        for key in ("metric", "value", "unit", "vs_baseline",
+                    "modeled_improvement_pct",
+                    "measured_vs_modeled_gap_pp",
+                    "measured_hier_vs_ring_pct", "scenarios", "notes",
+                    "ok", "failures"):
+            assert key in row, key
+        assert row["ok"] is True and row["failures"] == []
+        assert row["unit"] == "percent"
+        (s,) = row["scenarios"]
+        assert s["scenario"] == "uniform" and s["procs"] == 2
+        assert s["collective_hint"] == s["expected_hint"] == "ring"
+        assert s["bootstrap_bytes_verified"] is True
+        assert s["global_devices"] == 2 * s["devices_per_proc"]
+        assert len(s["results"]) == 1
+        r0 = s["results"][0]
+        for key in ("planned_s", "ring_s", "hierarchical_s", "naive_s"):
+            assert r0[key] > 0, key
+        # both the measured delta and the modeled objective are present
+        # on the same row — the bench's whole point
+        assert s["modeled_planned_allreduce_ms"] > 0
+        assert "measured_order_improvement_pct" in s
+        assert "measured_vs_modeled_gap_pp" in s
+        # the headline note spells the gap out
+        assert any("measured-vs-modeled gap" in n for n in row["notes"])
+
+    def test_scenario_plans_deterministic_and_hints_match(self):
+        """The plan-level structural half, process-free: same seed →
+        identical plan (version, ring, hint, modeled numbers), and the
+        scenario construction yields the hint the gate expects —
+        hierarchical on the skewed 2-rack fabric, ring on the flat one.
+        This pins the gate's premise without paying a 4-proc spawn in
+        tier-1."""
+        eb = self._load_module()
+        runs = [eb.compute_scenario_plan(4, "skewed", seed=7)
+                for _ in range(2)]
+        (p0, planned0, naive0), (p1, planned1, naive1) = runs
+        assert p0.version == p1.version
+        assert p0.ring == p1.ring
+        assert p0.collective == p1.collective == "hierarchical"
+        assert (planned0, naive0) == (planned1, naive1)
+        # the interleaved skewed fabric is exactly the placement a
+        # name-order ring gets wrong: the model must show a real win
+        assert planned0 < naive0
+        plan_u, planned_u, naive_u = eb.compute_scenario_plan(
+            2, "uniform", seed=7
+        )
+        assert plan_u.collective == "ring"
+        assert planned_u <= naive_u * 1.001

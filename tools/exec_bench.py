@@ -1,0 +1,511 @@
+#!/usr/bin/env python3
+"""Plan-execution benchmark — prints ONE JSON line (BENCH-style).
+
+Closes the measured-vs-modeled loop: every planner number in
+BENCH_planner.json is a ring-perimeter-RTT *model*; this bench executes
+the plan on a live multi-process ``jax.distributed`` mesh (CPU backend,
+Gloo collectives, ``--xla_force_host_platform_device_count`` virtual
+devices per process) and reports what the planned configuration
+actually buys on real collectives, side by side with the model.
+
+The pipeline is the production one end to end — no hand-built configs:
+
+1. a FakeFabric fleet (one node per process) is probed with real
+   Prober/Responder rounds; the measured RTT matrix feeds
+   ``planner.compute_plan`` exactly as the reconciler would;
+2. each rank's bootstrap is written by the agent path —
+   ``build_bootstrap`` → ``write_bootstrap`` → ``apply_plan`` (the
+   agent's plan-adoption step, which stamps ringIndex);
+3. N OS processes run ``workload exec-bench``, which consumes the
+   bootstrap verbatim (sha256-verified against what the agent wrote),
+   forms the global mesh, and times the DCN gradient all-reduce:
+   planned mesh ring vs hierarchical, and planned axis order vs naive
+   name-order.
+
+Scenarios (per --procs-list entry):
+
+* ``uniform``  (2 procs by default) — one flat group: the plan hints
+  ``ring`` and promotes fsdp outermost;
+* ``skewed``   (4+ procs) — two racks interleaved with the naming
+  order, intra 0.1 ms / inter 5 ms links: the plan hints
+  ``hierarchical`` and keeps data outermost.
+
+Gates (in-bench, exit 1 on failure):
+
+* the plan's collective hint matches the scenario (hierarchical on
+  skewed, ring on uniform);
+* planned axis ordering never loses to name-order beyond the same-host
+  noise tolerance (all processes share one host, so axis order is
+  latency-neutral by construction here — the gate catches regressions,
+  the ring-vs-hierarchical delta carries the physical signal);
+* every worker consumed byte-identical bootstrap files to what the
+  agent wrote.
+
+The headline note is the measured-vs-modeled gap: the model predicts
+the planned ring saves most of the naive ring's perimeter RTT, while on
+a single-host fabric the measured ordering delta is ~0 — exactly the
+TopoOpt/DELTA point that modeled topology wins only materialize when
+they meet the real fabric.
+
+Usage: python tools/exec_bench.py [--procs-list 2,4] [--devices-per-proc 2]
+           [--sizes-mb 0.25,1,4] [--iters 3] [--out BENCH_exec.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+POLICY = "exec"
+INTRA_GROUP_S = 0.0001     # 100 µs one-way
+INTER_GROUP_S = 0.005      # 5 ms one-way (≥ planner spread threshold)
+LINK_SPREAD = 0.2          # ± seeded per-pair spread fraction
+PROBE_ROUNDS = 3
+# all worker processes share one host: planned vs name-order axis
+# ordering is latency-neutral by construction, so the never-loses gate
+# carries a noise tolerance.  Same-host Gloo best-of timings drift by
+# ±50%+ between measurement windows (observed across repeated full
+# runs on a 1-core rig), so the gate is sized to catch structural
+# regressions — a wrong mesh or extra collective hop costs 2x+ — not
+# to re-litigate scheduler noise
+ORDER_NOISE_TOL = 0.75
+# generous: N workers time-share whatever cores the rig has (a 1-core
+# box runs the 4-proc scenario fully serialized), and every (mesh,
+# size, strategy) point is a fresh XLA compile on each rank
+WORKER_TIMEOUT_S = 900
+# progress watchdog: workers log every completed size to stderr, and a
+# healthy scenario completes points in seconds — when NO rank's stderr
+# grows for this long, the Gloo rendezvous has wedged (one rank
+# spin-polls, a peer sleeps forever); give up early so the retry can
+# run instead of burning the whole WORKER_TIMEOUT_S budget
+STALL_TIMEOUT_S = 150
+# the Gloo rendezvous occasionally wedges on an oversubscribed host
+# (one rank spin-polls the core, a peer sleeps on a connect that never
+# completes); a wedged scenario is retried from scratch — fresh
+# coordinator port, fresh bootstraps — before failing the run
+SCENARIO_ATTEMPTS = 2
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def node_name(i: int) -> str:
+    return f"exec-{i:03d}"
+
+
+def host_of(i: int) -> str:
+    return f"10.77.0.{i + 1}"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def group_plan(n: int, scenario: str):
+    """Group (rack) per node.  Skewed: two racks INTERLEAVED with the
+    naming order (i % 2), so the name-order ring crosses the slow tier
+    on almost every hop — the placement a name-sorting planner gets
+    wrong.  Uniform: one flat group."""
+    if scenario == "skewed":
+        return {node_name(i): f"rack-{i % 2:02d}" for i in range(n)}
+    return {node_name(i): "rack-00" for i in range(n)}
+
+
+def link_latencies(n: int, scenario: str, seed: int):
+    rng = random.Random(seed)
+    groups = group_plan(n, scenario)
+    lat = {}
+    for i in range(n):
+        for j in range(i + 1, n):
+            a, b = node_name(i), node_name(j)
+            base = (
+                INTRA_GROUP_S if groups[a] == groups[b] else INTER_GROUP_S
+            )
+            lat[(i, j)] = base * (1.0 + LINK_SPREAD * rng.random())
+    return groups, lat
+
+
+def measure_matrix(n: int, scenario: str, seed: int):
+    """Probe the structured FakeFabric full-mesh with real probe rounds
+    and return (groups, {node: {peer: rttMs}}) — the same measurement
+    path the agent's prober feeds the reconciler."""
+    from tpu_network_operator.probe.prober import Prober, Responder
+    from tpu_network_operator.probe.transport import FakeFabric
+
+    groups, lat = link_latencies(n, scenario, seed)
+    fabric = FakeFabric(seed=seed, jitter=0.00001)
+    for (i, j), seconds in lat.items():
+        fabric.set_link_latency(host_of(i), host_of(j), seconds)
+    endpoints = {node_name(i): f"{host_of(i)}:8477" for i in range(n)}
+    for ep in endpoints.values():
+        Responder(fabric.open(ep)).start()
+    probers = {}
+    for i in range(n):
+        name = node_name(i)
+        probers[name] = Prober(
+            fabric.open(f"{host_of(i)}:9"), fabric.clock,
+            window=PROBE_ROUNDS,
+        )
+        probers[name].set_peers({
+            p: a for p, a in endpoints.items() if p != name
+        })
+    for _ in range(PROBE_ROUNDS):
+        for p in probers.values():
+            p.run_round()
+        fabric.advance(5.0)
+    obs = {}
+    for name, p in probers.items():
+        snap = p.snapshot()
+        obs[name] = {
+            peer: stats["rttMs"]
+            for peer, stats in snap.peers.items()
+            if stats["reachable"]
+        }
+    return groups, obs
+
+
+def compute_scenario_plan(n: int, scenario: str, seed: int):
+    from tpu_network_operator.planner import plan as pp
+
+    groups, obs = measure_matrix(n, scenario, seed)
+    rtt = pp.build_matrix(obs)
+    plan = pp.compute_plan(pp.PlanInputs(
+        nodes=sorted(obs), rtt=rtt, groups=groups,
+        excluded=frozenset(), seed=POLICY,
+    ))
+    planned_ms = pp.modeled_allreduce_ms(plan.ring, rtt)
+    naive_ms = pp.modeled_allreduce_ms(sorted(obs), rtt)
+    return plan, planned_ms, naive_ms
+
+
+def write_rank_bootstraps(tmpdir, tag, n, devices_per_proc, plan):
+    """The agent path per rank: build_bootstrap → write_bootstrap →
+    apply_plan.  Returns [(path, sha256)] in rank order — the bytes the
+    workers must consume verbatim."""
+    import hashlib
+
+    from tpu_network_operator.agent.tpu.bootstrap import (
+        apply_plan,
+        build_bootstrap,
+        write_bootstrap,
+    )
+    from tpu_network_operator.agent.tpu.topology import TpuTopology
+
+    port = _free_port()
+    out = []
+    for pid in range(n):
+        topo = TpuTopology(
+            accelerator_type=f"cpu-host-{devices_per_proc}",
+            topology=f"1x{devices_per_proc}",
+            ici_mesh=(1, devices_per_proc),
+            num_chips=devices_per_proc,
+            chips_per_host=devices_per_proc,
+            num_hosts=1, worker_id=0,
+            num_slices=n, slice_id=pid,
+            megascale_coordinator="127.0.0.1",
+        )
+        cfg = build_bootstrap(
+            topo,
+            [{"workerId": 0, "ipAddress": "127.0.0.1"}],
+            coordinator_port=port,
+            megascale_coordinator=topo.megascale_coordinator,
+        )
+        path = os.path.join(tmpdir, f"bootstrap-{tag}-{pid}.json")
+        write_bootstrap(cfg, path)
+        changed = apply_plan(path, plan.to_payload(), node=node_name(pid))
+        if changed is not True:
+            raise RuntimeError(
+                f"agent plan adoption failed for rank {pid}: {changed!r}"
+            )
+        with open(path, "rb") as f:
+            out.append((path, hashlib.sha256(f.read()).hexdigest()))
+    return out
+
+
+def spawn_workers(bootstraps, devices_per_proc, sizes_mb, iters):
+    """One ``workload exec-bench`` OS process per rank; returns each
+    rank's parsed last-JSON-line.  A poll loop watches ALL ranks at
+    once: a rank dying early fails the run immediately (with its
+    stderr tail) instead of leaving the survivors blocked at the
+    collective barrier until the timeout.  Children are killed on any
+    failure — a rank stuck at the barrier must not outlive the run."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices_per_proc}"
+    )
+    cmd_tail = ["--sizes-mb", *[str(s) for s in sizes_mb],
+                "--iters", str(iters)]
+    procs = []
+    logs = []
+    try:
+        for path, _ in bootstraps:
+            # stderr to a sidecar file: PIPE would deadlock a chatty
+            # child once the buffer fills, and the file survives for
+            # post-mortem when another rank is the one that fails
+            err_f = open(path + ".stderr", "w+")
+            logs.append(err_f)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "tpu_network_operator.workload",
+                 "exec-bench", "--bootstrap", path, *cmd_tail],
+                cwd=ROOT, env=env,
+                stdout=subprocess.PIPE, stderr=err_f, text=True,
+            ))
+        deadline = time.monotonic() + WORKER_TIMEOUT_S
+        progress = sum(os.fstat(f.fileno()).st_size for f in logs)
+        last_progress = time.monotonic()
+        while any(p.poll() is None for p in procs):
+            for pid, proc in enumerate(procs):
+                rc = proc.poll()
+                if rc is not None and rc != 0:
+                    raise RuntimeError(
+                        f"rank {pid} exited {rc}:\n"
+                        f"stderr: {_tail(logs[pid])}"
+                    )
+            now = time.monotonic()
+            grown = sum(os.fstat(f.fileno()).st_size for f in logs)
+            if grown != progress:
+                progress, last_progress = grown, now
+            stalled = now - last_progress > STALL_TIMEOUT_S
+            if now > deadline or stalled:
+                stuck = [
+                    i for i, p in enumerate(procs) if p.poll() is None
+                ]
+                why = (
+                    f"no rank made progress for {STALL_TIMEOUT_S}s"
+                    if stalled else
+                    f"ranks still running after {WORKER_TIMEOUT_S}s"
+                )
+                raise RuntimeError(
+                    f"{why} (stuck: {stuck}); rank {stuck[0]} stderr: "
+                    f"{_tail(logs[stuck[0]])}"
+                )
+            time.sleep(0.2)
+        results = []
+        for pid, proc in enumerate(procs):
+            out = proc.stdout.read()
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"rank {pid} exited {proc.returncode}:\n"
+                    f"stderr: {_tail(logs[pid])}"
+                )
+            results.append(json.loads(out.strip().splitlines()[-1]))
+        return results
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        for f in logs:
+            f.close()
+
+
+def _tail(f, n=2000):
+    f.flush()
+    f.seek(0, os.SEEK_END)
+    f.seek(max(0, f.tell() - n))
+    return f.read()
+
+
+def run_scenario(tmpdir, n, devices_per_proc, scenario, seed,
+                 sizes_mb, iters):
+    log(f"== scenario {scenario}: {n} procs x {devices_per_proc} devices")
+    t0 = time.perf_counter()
+    plan, modeled_planned_ms, modeled_naive_ms = compute_scenario_plan(
+        n, scenario, seed
+    )
+    modeled_improvement = 100.0 * (
+        1.0 - modeled_planned_ms / max(modeled_naive_ms, 1e-9)
+    )
+    for attempt in range(SCENARIO_ATTEMPTS):
+        bootstraps = write_rank_bootstraps(
+            tmpdir, f"{scenario}{n}-a{attempt}", n, devices_per_proc, plan
+        )
+        try:
+            ranks = spawn_workers(
+                bootstraps, devices_per_proc, sizes_mb, iters
+            )
+            break
+        except RuntimeError as e:
+            if attempt + 1 >= SCENARIO_ATTEMPTS:
+                raise
+            log(f"   attempt {attempt + 1} failed ({e}); retrying the "
+                "scenario with a fresh coordinator")
+
+    bytes_verified = all(
+        r["bootstrap_sha256"] == sha
+        for r, (_, sha) in zip(ranks, bootstraps)
+    )
+    r0 = ranks[0]
+    ring_total = sum(row["ring_s"] for row in r0["results"])
+    hier_total = sum(row["hierarchical_s"] for row in r0["results"])
+    planned_total = sum(row["planned_s"] for row in r0["results"])
+    naive_total = sum(row["naive_s"] for row in r0["results"])
+    row = {
+        "scenario": scenario,
+        "procs": n,
+        "devices_per_proc": devices_per_proc,
+        "global_devices": r0["global_devices"],
+        "mesh_planned": r0["mesh_planned"],
+        "mesh_naive": r0["mesh_naive"],
+        "mesh_axis_order": r0["mesh_axis_order"],
+        "collective_hint": r0["collective_hint"],
+        "expected_hint": (
+            "hierarchical" if scenario == "skewed" else "ring"
+        ),
+        "plan_version": plan.version,
+        "ring": plan.ring,
+        "sizes_mb": [r["size_mb"] for r in r0["results"]],
+        "results": r0["results"],
+        "bootstrap_bytes_verified": bytes_verified,
+        # measured deltas (positive = the planned side is faster)
+        "measured_order_improvement_pct": round(
+            100.0 * (1.0 - planned_total / max(naive_total, 1e-12)), 1
+        ),
+        "measured_hier_vs_ring_pct": round(
+            100.0 * (1.0 - hier_total / max(ring_total, 1e-12)), 1
+        ),
+        # the planner's modeled objective over the SAME measured RTTs
+        "modeled_planned_allreduce_ms": round(modeled_planned_ms, 3),
+        "modeled_naive_allreduce_ms": round(modeled_naive_ms, 3),
+        "modeled_improvement_pct": round(modeled_improvement, 1),
+        "planned_total_s": round(planned_total, 5),
+        "ring_total_s": round(ring_total, 5),
+        "hierarchical_total_s": round(hier_total, 5),
+        "naive_total_s": round(naive_total, 5),
+        "scenario_seconds": round(time.perf_counter() - t0, 1),
+    }
+    row["measured_vs_modeled_gap_pp"] = round(
+        row["modeled_improvement_pct"]
+        - row["measured_order_improvement_pct"], 1
+    )
+    log(f"   -> hint {row['collective_hint']} "
+        f"(want {row['expected_hint']}); planned {planned_total:.4f}s "
+        f"naive {naive_total:.4f}s "
+        f"({row['measured_order_improvement_pct']}% measured vs "
+        f"{row['modeled_improvement_pct']}% modeled); "
+        f"hier-vs-ring {row['measured_hier_vs_ring_pct']}%")
+    return row
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--procs-list", default="2,4",
+                    help="process counts; <4 runs the uniform scenario, "
+                         ">=4 the skewed one (2 racks need 2 nodes each "
+                         "for an intra-group RTT sample)")
+    ap.add_argument("--devices-per-proc", type=int, default=2,
+                    help="virtual CPU devices per process "
+                         "(--xla_force_host_platform_device_count)")
+    ap.add_argument("--sizes-mb", default="0.25,1,4",
+                    help="payload sweep of the timed all-reduce")
+    ap.add_argument("--iters", type=int, default=3,
+                    help="timed iterations per point (best-of)")
+    ap.add_argument("--order-noise-tol", type=float,
+                    default=ORDER_NOISE_TOL,
+                    help="same-host noise tolerance for the ordering "
+                         "gate; the default suits the full sweep — "
+                         "single-size debug runs carry too few points "
+                         "for it and should pass a looser value")
+    ap.add_argument("--out", default="",
+                    help="also write the JSON artifact to this path")
+    args = ap.parse_args()
+    procs = [int(s) for s in args.procs_list.split(",") if s.strip()]
+    sizes_mb = [float(s) for s in args.sizes_mb.split(",") if s.strip()]
+
+    import tempfile
+
+    rows = []
+    with tempfile.TemporaryDirectory(prefix="exec-bench-") as tmpdir:
+        for n in procs:
+            scenario = "skewed" if n >= 4 else "uniform"
+            rows.append(run_scenario(
+                tmpdir, n, args.devices_per_proc, scenario, args.seed,
+                sizes_mb, args.iters,
+            ))
+
+    failures = []
+    for row in rows:
+        tag = f"{row['scenario']}@{row['procs']}p"
+        if row["collective_hint"] != row["expected_hint"]:
+            failures.append(
+                f"{tag}: plan hinted {row['collective_hint']}, scenario "
+                f"expects {row['expected_hint']}"
+            )
+        if row["planned_total_s"] > row["naive_total_s"] * (
+            1.0 + args.order_noise_tol
+        ):
+            failures.append(
+                f"{tag}: planned ordering lost to name-order beyond the "
+                f"{args.order_noise_tol:.0%} noise tolerance "
+                f"({row['planned_total_s']}s vs {row['naive_total_s']}s)"
+            )
+        if not row["bootstrap_bytes_verified"]:
+            failures.append(
+                f"{tag}: a worker consumed bootstrap bytes differing "
+                "from what the agent wrote"
+            )
+
+    skewed = [r for r in rows if r["scenario"] == "skewed"]
+    head = skewed[-1] if skewed else rows[-1]
+    notes = [
+        "measured-vs-modeled gap: the planner models "
+        f"{head['modeled_improvement_pct']}% all-reduce improvement from "
+        f"ring ordering on the {head['scenario']} fabric, while the "
+        f"executed ordering delta on this rig is "
+        f"{head['measured_order_improvement_pct']}% "
+        f"(gap {head['measured_vs_modeled_gap_pp']} points): all "
+        "processes share one host, so the modeled RTT structure does "
+        "not exist on the wire — the modeled number only transfers to "
+        "fabrics whose topology the collectives actually traverse",
+        "CPU-backend noise floor: same-host Gloo timings jitter at "
+        "small payloads; the ordering gate carries a "
+        f"{args.order_noise_tol:.0%} tolerance (see docs/operator-guide.md)",
+    ]
+    result = {
+        "metric": "executed planned vs name-order DCN all-reduce",
+        "value": head["measured_order_improvement_pct"],
+        "unit": "percent",
+        # planned/naive measured time ratio on the headline scenario
+        "vs_baseline": round(
+            head["planned_total_s"] / max(head["naive_total_s"], 1e-12), 3
+        ),
+        "modeled_improvement_pct": head["modeled_improvement_pct"],
+        "measured_vs_modeled_gap_pp": head["measured_vs_modeled_gap_pp"],
+        "measured_hier_vs_ring_pct": head["measured_hier_vs_ring_pct"],
+        "order_noise_tol": args.order_noise_tol,
+        "seed": args.seed,
+        "procs_list": procs,
+        "sizes_mb": sizes_mb,
+        "devices_per_proc": args.devices_per_proc,
+        "scenarios": rows,
+        "notes": notes,
+        "ok": not failures,
+        "failures": failures,
+    }
+    line = json.dumps(result)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(line)
+    if failures:
+        log("FAILED: " + "; ".join(failures))
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -211,6 +211,20 @@ def main() -> int:
         maybe_run_phase(out, "timeline-bench",
                   [py, "tools/timeline_bench.py",
                    "--out", "BENCH_timeline.json"], timeout=900)
+        # 17. plan execution: the multi-process collective rung — N
+        # local jax.distributed workers (CPU backend) consume a real
+        # agent-written bootstrap + plan block and measure
+        # make_dcn_all_reduce ring vs hierarchical and the planned
+        # meshAxisOrder vs naive name-order across a payload/process
+        # sweep, putting a measured number next to the planner's
+        # modeled objective (gated in-bench: planned ordering must not
+        # lose, collective choice must agree with the plan's hint on
+        # the skewed-RTT scenario; no TPU, gloo collectives).  Runs
+        # strictly serial — the workers time-share the host's cores
+        # and a concurrent load can wedge the gloo rendezvous.
+        maybe_run_phase(out, "exec-bench",
+                  [py, "tools/exec_bench.py",
+                   "--out", "BENCH_exec.json"], timeout=3600)
     print(f"done -> {args.out}")
     return 0
 

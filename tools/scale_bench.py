@@ -589,6 +589,7 @@ def run_failover(n_nodes: int, n_policies: int = 4, churn: int = 50):
     # exactly these must re-derive on the successor
     churned = 0
     churned_policies = set()
+    churned_nodes_list = []
     for pname in a_policies:
         for node in node_of[pname]:
             if churned >= churn:
@@ -604,6 +605,43 @@ def run_failover(n_nodes: int, n_policies: int = 4, churn: int = 50):
             fake.apply(rpt.lease_for(rep, NAMESPACE))
             churned += 1
             churned_policies.add(pname)
+            churned_nodes_list.append((pname, node, i))
+
+    # crash-restart: replica-a comes back as a FRESH process (same
+    # identity, empty parse memo) and re-claims its own shards.  The
+    # persisted contribution cache's rv set substitutes lazy report
+    # proxies for every unchanged lease, so the cold pass JSON-parses
+    # exactly the churned leases — the O(churn) takeover contract —
+    # while everything else resumes from the checkpoint undecoded.
+    a.stop()
+    a2 = Replica(fake, "replica-a", n_shards, clock)
+    a2.coord.sync()
+    a2.start()
+    for pname in a2.owned_policies(policies):
+        a2.mgr.enqueue(pname)
+    t0 = time.perf_counter()
+    a2.drain()
+    cold_restart_seconds = time.perf_counter() - t0
+    cold_parsed = a2.counter("tpunet_report_parses_total")
+    cold_resumed = a2.counter("tpunet_rebuild_resumed_nodes_total")
+    assert cold_parsed == churned, (
+        f"cold restart parsed {cold_parsed} leases, expected exactly "
+        f"the {churned} churned ones (lazy rv-hint parse regressed)"
+    )
+    log(f"   -> cold restart: parsed {cold_parsed}/{departed_nodes} "
+        f"leases (churned {churned}), resumed {cold_resumed}, "
+        f"{cold_restart_seconds:.2f}s")
+
+    # second churn batch for the peer-takeover phase: flip the SAME
+    # nodes back to healthy, so replica-b's resume sees exactly
+    # `churn` rv-mismatched leases against replica-a's re-cut
+    # checkpoint (and no Degraded stragglers that would re-derive
+    # beyond the churn set)
+    for pname, node, i in churned_nodes_list:
+        rep = healthy_report(node, i)
+        rep.policy = pname
+        rep.node = node
+        fake.apply(rpt.lease_for(rep, NAMESPACE))
 
     # kill replica-a (no release — a crash, not a drain) and expire
     # its leases; replica-b's next sync round takes over
@@ -613,12 +651,20 @@ def run_failover(n_nodes: int, n_policies: int = 4, churn: int = 50):
     }
     events_before = len(fake.list("v1", "Event", namespace=NAMESPACE))
     resumed_before = b.counter("tpunet_rebuild_resumed_nodes_total")
+    parsed_before = b.counter("tpunet_report_parses_total")
     now[0] += 120.0   # > lease_duration: a's heartbeat + shards expire
     b.mgr.shard_sync()
     takeover_ok = set(departed_shards) <= b.coord.owned
     t0 = time.perf_counter()
     b.drain()
     takeover_seconds = time.perf_counter() - t0
+    takeover_parsed = (
+        b.counter("tpunet_report_parses_total") - parsed_before
+    )
+    assert takeover_parsed == churned, (
+        f"takeover parsed {takeover_parsed} leases, expected exactly "
+        f"the {churned} churned ones (lazy rv-hint parse regressed)"
+    )
     writes_after = {
         k: v for k, v in fake.request_counts.items()
         if k[0] in ("create", "update", "patch", "delete")
@@ -651,7 +697,7 @@ def run_failover(n_nodes: int, n_policies: int = 4, churn: int = 50):
     duplicate_events = sum(
         n - 1 for n in seen_keys.values() if n > 1
     )
-    a.stop()
+    a2.stop()
     b.stop()
     row = {
         "nodes": n_nodes,
@@ -663,6 +709,12 @@ def run_failover(n_nodes: int, n_policies: int = 4, churn: int = 50):
         "resumed_nodes": resumed,
         "rederived_nodes": rederived,
         "takeover_seconds": round(takeover_seconds, 2),
+        # O(churn) parse contract: JSON report decodes paid across
+        # each handoff (lazy rv-hint proxies cover the rest)
+        "takeover_parsed_leases": takeover_parsed,
+        "cold_restart_parsed_leases": cold_parsed,
+        "cold_restart_resumed_nodes": cold_resumed,
+        "cold_restart_seconds": round(cold_restart_seconds, 2),
         "takeover_clean": bool(takeover_ok),
         "overlap_violations": overlap_violations,
         "cr_status_writes": cr_updates,
@@ -673,7 +725,8 @@ def run_failover(n_nodes: int, n_policies: int = 4, churn: int = 50):
     }
     log(f"   -> departed {departed_nodes} nodes over shards "
         f"{departed_shards}; resumed {resumed}, re-derived {rederived} "
-        f"(churned {churned}), takeover {row['takeover_seconds']}s, "
+        f"(churned {churned}), parsed {takeover_parsed} leases, "
+        f"takeover {row['takeover_seconds']}s, "
         f"{cr_updates} CR status writes, {duplicate_events} dup events")
     return row
 

@@ -27,4 +27,43 @@ else:
             kwargs["check_rep"] = kwargs.pop("check_vma")
         return _shard_map(*args, **kwargs)
 
-__all__ = ["shard_map"]
+
+def enable_cpu_collectives() -> None:
+    """Turn on cross-process collectives for the CPU backend.
+
+    jax 0.4.x ships CPU multi-process support behind the
+    ``jax_cpu_collectives_implementation`` config (gloo); without it,
+    ``jax.distributed.initialize`` succeeds but the first cross-process
+    computation dies with "Multiprocess computations aren't implemented
+    on the CPU backend".  Newer runtimes pick a CPU collectives layer
+    automatically and drop the knob, so a missing option is fine to
+    ignore.  Must run before ``jax.distributed.initialize``.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, KeyError, ValueError):
+        pass   # runtime either lacks the knob or already defaults sanely
+
+
+def safe_donate_argnums(*argnums: int) -> tuple:
+    """``donate_argnums`` for ``jax.jit``, dropped on legacy XLA-CPU.
+
+    On jax 0.4.x CPU, donating a pytree that mixes replicated and
+    sharded leaves through a shard_map'd pallas call trips an XLA
+    aliasing check at runtime ("Expected aliased input ... sub-shape"
+    mismatch) — the donated buffer is held with the replicated layout
+    while the output wants the sharded one.  Donation is purely a
+    memory optimization, so on that backend we return ``()`` and let
+    XLA copy; everywhere else the requested argnums pass through.
+    """
+    import jax
+
+    if jax.__version_info__ < (0, 5) and \
+            jax.default_backend() == "cpu":
+        return ()
+    return tuple(argnums)
+
+
+__all__ = ["shard_map", "enable_cpu_collectives", "safe_donate_argnums"]

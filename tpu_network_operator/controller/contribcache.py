@@ -207,6 +207,48 @@ def fingerprint(
     )
 
 
+def load_hints(
+    client, namespace: str, policy: str,
+) -> Dict[str, List[Any]]:
+    """Per-lease parse hints from whatever checkpoint exists, WITHOUT
+    the generation/version invalidation gates :func:`load` applies:
+    the leading entry scalars (rv, node, renewed, ok, error, version,
+    endpoint) describe the report annotation itself — what a JSON
+    parse of the lease would yield — not the spec-dependent derived
+    terms, so they stay valid across a spec change.  A cold replica
+    substitutes a lazy report proxy for every rv-matched lease and
+    pays the full parse only for leases that actually churned.
+
+    Tolerance is safe here for the same reason: a hint is consulted
+    only under the caller's rv match, and any report change bumps the
+    rv — a stale chunk's hints are therefore unreachable, not wrong.
+    Chunks that are missing or unreadable just contribute nothing."""
+    try:
+        first = client.get(
+            "v1", "ConfigMap", cm_name(policy, 0), namespace
+        )
+        meta = json.loads(
+            (first.get("data", {}) or {}).get(META_KEY, "{}")
+        )
+        n_chunks = int(meta.get("chunks", 0))
+    except Exception:   # noqa: BLE001 — no checkpoint = no hints
+        return {}
+    if not (0 < n_chunks <= MAX_CHUNKS):
+        return {}
+    hints: Dict[str, List[Any]] = {}
+    for i in range(n_chunks):
+        try:
+            cm = first if i == 0 else client.get(
+                "v1", "ConfigMap", cm_name(policy, i), namespace
+            )
+            hints.update(json.loads(
+                (cm.get("data", {}) or {}).get(ENTRIES_KEY, "{}")
+            ))
+        except Exception:   # noqa: BLE001 — partial hints still help
+            continue
+    return hints
+
+
 def load(
     client, namespace: str, policy: str, generation: Any,
 ) -> Tuple[

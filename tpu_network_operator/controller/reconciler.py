@@ -404,6 +404,46 @@ def update_tpu_scale_out_daemonset(
     container["args"] = args
 
 
+class _LazyReport:
+    """Provisioning-report proxy for an rv-unchanged lease on a cold
+    replica: the rollup-relevant scalars ride in eagerly from the
+    persisted contribution-cache hint (controller/contribcache.py)
+    without JSON-decoding the report annotation; touching any deeper
+    field (probe snapshot, telemetry, spans, ...) materializes the
+    real parse on first access and delegates from then on.
+
+    Correctness rests on the same rv guard as the persisted resume: a
+    hint is substituted only when its recorded resourceVersion matches
+    the live Lease, and any report change bumps the rv — so the eager
+    scalars were decoded from byte-identical input.  The win is that a
+    takeover's parse bill becomes O(churned leases): the fleet's
+    unchanged reports are resumed as derived terms and never decoded."""
+
+    __slots__ = (
+        "node", "policy", "ok", "error", "agent_version",
+        "probe_endpoint", "_parse", "_full",
+    )
+
+    def __init__(self, node, policy, ok, error, agent_version,
+                 probe_endpoint, parse):
+        self.node = node
+        self.policy = policy
+        self.ok = ok
+        self.error = error
+        self.agent_version = agent_version
+        self.probe_endpoint = probe_endpoint
+        self._parse = parse
+        self._full = None
+
+    def __getattr__(self, attr):
+        # only non-slot attributes land here; each forces (at most
+        # once) the real parse
+        full = self._full
+        if full is None:
+            full = self._full = self._parse()
+        return getattr(full, attr)
+
+
 class NetworkClusterPolicyReconciler:
     """ref ``NetworkClusterPolicyReconciler`` controller :50-55."""
 
@@ -453,6 +493,14 @@ class NetworkClusterPolicyReconciler:
         # a 10k-node rollup re-parses only the leases whose
         # resourceVersion moved, merging cached shard state for the rest
         self._lease_memo: Dict[str, Any] = {}
+        # cold-start parse hints {lease name: persisted cache entry}
+        # (contribcache.load_hints): an rv-matched lease on a replica
+        # with no memo gets a _LazyReport proxy instead of a JSON
+        # parse, so a takeover's parse bill is O(churned), not
+        # O(fleet).  Probed at most once per policy per process —
+        # warm replicas hit the memo first and never probe.
+        self._lease_hints: Dict[str, Any] = {}
+        self._hints_probed: set = set()
         # last-applied peer distribution per policy:
         # {policy: {"count": n_shards, "payloads": {cm_name: payload}}}
         # — the diff gate that makes a steady mesh cost ZERO ConfigMap
@@ -726,6 +774,13 @@ class NetworkClusterPolicyReconciler:
         if self.tracer is None:
             return
         for rep in reports:
+            if isinstance(rep, _LazyReport) and rep._full is None:
+                # resumed-from-checkpoint lease whose report was never
+                # decoded: reading ``spans`` would force the parse and
+                # defeat the O(churned) takeover.  Its spans were
+                # ingested by the incarnation that first parsed it;
+                # nothing new can ride an rv-unchanged lease.
+                continue
             spans = getattr(rep, "spans", None)
             if not spans:
                 continue
@@ -944,14 +999,21 @@ class NetworkClusterPolicyReconciler:
             self._reports_cached_at = now
         return buckets
 
-    def _parse_one(self, lease: Dict[str, Any], rpt):
+    def _parse_one(self, lease: Dict[str, Any], rpt, policy_name=""):
         """``(report, renewed_ts)`` for one lease, memoized by
         resourceVersion: a 10k-node fleet's rollup pass JSON-parses only
         the leases that actually changed since the last pass and merges
         the cached result for the rest — the sharded-rollup read path.
         The memo holds the PRISTINE parse; staleness aging (a function
         of the current clock, not of the lease) is applied per pass by
-        the caller."""
+        the caller.
+
+        On a memo MISS with a persisted-cache hint whose rv matches
+        (cold start / takeover), a :class:`_LazyReport` proxy is
+        memoized instead of decoding the annotation — the parse is
+        deferred until something actually needs a field beyond the
+        hint's scalars, which the persisted-resume rebuild path never
+        does."""
         name = lease.get("metadata", {}).get("name", "")
         rv = str(
             lease.get("metadata", {}).get("resourceVersion", "") or ""
@@ -964,19 +1026,61 @@ class NetworkClusterPolicyReconciler:
         raw = (
             lease.get("metadata", {}).get("annotations", {}) or {}
         ).get(rpt.REPORT_ANNOTATION, "")
-        try:
-            rep = rpt.ProvisioningReport.from_json(raw)
-        except Exception:   # noqa: BLE001 — malformed = not ready
-            rep = rpt.ProvisioningReport(
-                node=node, ok=False, error="unparseable report"
-            )
         renewed = rpt.parse_micro_time(
             str(lease.get("spec", {}).get("renewTime", "") or "")
         )
+        hint = self._lease_hint(name, policy_name) if rv else None
+        if hint is not None and str(hint[0]) == rv:
+            rep: Any = _LazyReport(
+                node=str(hint[1]), policy=policy_name,
+                ok=bool(hint[3]), error=str(hint[4]),
+                agent_version=str(hint[5]), probe_endpoint=str(hint[6]),
+                parse=lambda: self._decode_report(rpt, raw, node),
+            )
+        else:
+            rep = self._decode_report(rpt, raw, node)
         if rv:
             with self._reports_lock:
                 self._lease_memo[name] = (rv, rep, renewed)
         return rep, renewed
+
+    def _decode_report(self, rpt, raw: str, node: str):
+        """The actual JSON decode of one report annotation — the unit
+        of work the memo and the lazy-hint path exist to avoid.
+        Counted in ``tpunet_report_parses_total`` so the failover
+        bench can assert a takeover parses O(churned) leases."""
+        if self.metrics:
+            self.metrics.inc("tpunet_report_parses_total")
+        try:
+            return rpt.ProvisioningReport.from_json(raw)
+        except Exception:   # noqa: BLE001 — malformed = not ready
+            return rpt.ProvisioningReport(
+                node=node, ok=False, error="unparseable report"
+            )
+
+    def _lease_hint(self, name: str, policy_name: str):
+        """Persisted contribution-cache entry for one lease, probing
+        the policy's checkpoint ConfigMaps at most once per process.
+        Warm replicas never reach here for unchanged leases (memo hit
+        first), so the probe is paid only on cold starts — and only
+        when checkpointing is on at all."""
+        if not policy_name or self.CONTRIB_CACHE_BYTES <= 0:
+            return None
+        with self._reports_lock:
+            if policy_name in self._hints_probed:
+                return self._lease_hints.get(name)
+            self._hints_probed.add(policy_name)
+        from . import contribcache
+
+        try:
+            hints = contribcache.load_hints(
+                self.client, self.namespace, policy_name,
+            )
+        except Exception:   # noqa: BLE001 — no hints = plain parses
+            hints = {}
+        with self._reports_lock:
+            self._lease_hints.update(hints)
+            return self._lease_hints.get(name)
 
     def _parse_buckets(
         self, leases: List[Dict[str, Any]], now: float, rpt
@@ -993,7 +1097,7 @@ class NetworkClusterPolicyReconciler:
             rv = str(
                 lease.get("metadata", {}).get("resourceVersion", "") or ""
             )
-            rep, renewed = self._parse_one(lease, rpt)
+            rep, renewed = self._parse_one(lease, rpt, policy_name)
             if (
                 rep.ok
                 and renewed is not None
@@ -1009,9 +1113,11 @@ class NetworkClusterPolicyReconciler:
                 continue
             out.append((lease_name, rep, renewed, rv))
         with self._reports_lock:
-            # departed leases must not pin their parse forever
+            # departed leases must not pin their parse (or hint) forever
             for name in [k for k in self._lease_memo if k not in seen]:
                 del self._lease_memo[name]
+            for name in [k for k in self._lease_hints if k not in seen]:
+                del self._lease_hints[name]
         return buckets
 
     def _target_nodes(self, ds: Dict[str, Any]) -> set:

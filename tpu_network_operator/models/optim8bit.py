@@ -336,15 +336,31 @@ def adamw8bit(
     never surprising."""
     import optax
 
+    def _zero_q(p, qdtype):
+        # Bit-identical to quantize(jnp.zeros(p.shape)) — the zero-block
+        # guard pins scale to 1.0 — but built directly so a jit'd init
+        # never carries a quantize graph over a constant: XLA-CPU's
+        # constant folder evaluates the blockwise reduce-window of that
+        # broadcast-zero at compile time (~1 min per large leaf), which
+        # is the wedge that forced the adam8 ladder rungs off CPU.
+        if p.ndim == 0:
+            return _QTensor(q=jnp.zeros((), qdtype),
+                            scale=jnp.ones((1,), jnp.float32))
+        b = _leaf_block(p.shape[-1], block)
+        return _QTensor(
+            q=jnp.zeros(p.shape, qdtype),
+            scale=jnp.ones(
+                (*p.shape[:-1], p.shape[-1] // b), jnp.float32
+            ),
+        )
+
     def init(params):
         return Adam8State(
             count=jnp.zeros((), jnp.int32),
-            m=jax.tree.map(lambda p: quantize(
-                jnp.zeros(p.shape, jnp.float32), block
-            ), params),
-            v=jax.tree.map(lambda p: quantize_f8(
-                jnp.zeros(p.shape, jnp.float32), block
-            ), params),
+            m=jax.tree.map(lambda p: _zero_q(p, jnp.int8), params),
+            v=jax.tree.map(
+                lambda p: _zero_q(p, jnp.float8_e4m3fn), params
+            ),
         )
 
     def update(grads, state, params=None):

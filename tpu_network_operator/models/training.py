@@ -14,6 +14,8 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from .. import compat
+
 
 def remat_policy(cfg):
     """Checkpoint policy from a model config's ``remat_policy`` field.
@@ -168,7 +170,7 @@ def make_sharded_train_step(
         step,
         in_shardings=(p_shard, None, tok_shard),
         out_shardings=(p_shard, None, repl),
-        donate_argnums=(0, 1),
+        donate_argnums=compat.safe_donate_argnums(0, 1),
     )
 
     def init_all(key, abstract=False):

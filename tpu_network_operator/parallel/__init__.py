@@ -12,12 +12,14 @@ the (outermost) inter-slice axis.
 """
 
 from .mesh import (  # noqa: F401
+    AXES,
     MeshPlan,
     dcn_collective,
     distributed_init_from_bootstrap,
     make_mesh,
     mesh_from_bootstrap,
     plan_axes,
+    plan_block,
     planned_axis_order,
     planned_ring_index,
 )

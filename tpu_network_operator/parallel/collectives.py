@@ -200,3 +200,51 @@ def make_dcn_all_reduce(mesh: Mesh, dcn_axis: str = "data",
     return jax.jit(shard_map(
         body, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False,
     ))
+
+
+def time_dcn_all_reduce(
+    mesh: Mesh,
+    size_mb: float,
+    *,
+    dcn_axis: str = "data",
+    ici_axis: str = "fsdp",
+    strategy: str = "ring",
+    iters: int = 5,
+    dtype=jnp.bfloat16,
+) -> CollectiveResult:
+    """Wall-time the planned gradient all-reduce on a live mesh — the
+    measured half of the planner's modeled objective (tools/exec_bench).
+    Every participating process must call this with the same arguments
+    (the collective blocks until all ranks join); the returned best-of
+    time is this rank's local observation."""
+    dcn = mesh.shape[dcn_axis]
+    ici = mesh.shape.get(ici_axis, 1)
+    n = dcn * ici
+    itemsize = jnp.dtype(dtype).itemsize
+    # each device's block splits AGAIN over the ICI axis for the
+    # hierarchical reduce-scatter, and the DCN ring then segments the
+    # scattered shard once more — and Gloo's tcp pair aborts on
+    # odd-byte segments (preamble.length > nbytes at
+    # gloo/transport/tcp/pair.cc:446, observed at 4x2 devices with a
+    # 0.25 MB payload).  Round so every level stays 8-byte aligned.
+    divisor = n * ici * dcn * 4
+    n_elems = max(divisor, int(size_mb * 1e6) // itemsize)
+    n_elems -= n_elems % divisor
+    x = jnp.arange(n_elems, dtype=jnp.float32).astype(dtype)
+    x = jax.device_put(
+        x, NamedSharding(mesh, P((dcn_axis, ici_axis)))
+    )
+    fn = make_dcn_all_reduce(
+        mesh, dcn_axis=dcn_axis, ici_axis=ici_axis, strategy=strategy
+    )
+    secs = _timed(fn, x, iters)
+    size_bytes = n_elems * itemsize
+    algbw = size_bytes / secs / 1e9
+    return CollectiveResult(
+        op=f"dcn_all_reduce[{strategy}]",
+        axis=f"{dcn_axis}+{ici_axis}",
+        size_bytes=size_bytes,
+        seconds=secs,
+        algbw_gbps=algbw,
+        busbw_gbps=algbw * _bus_factor("all_reduce", n),
+    )

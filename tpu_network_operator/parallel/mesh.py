@@ -36,6 +36,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from .. import compat
 from ..agent.tpu.bootstrap import BootstrapConfig
 
 AXES = ("data", "fsdp", "pipe", "expert", "seq", "tensor")
@@ -231,6 +232,7 @@ def mesh_from_bootstrap(
 def distributed_init_from_bootstrap(cfg: BootstrapConfig) -> None:
     """``jax.distributed.initialize`` from the operator-emitted file — the
     consuming end of the contract (SURVEY.md §5.8 item iii)."""
+    compat.enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=cfg.coordinator_address,
         num_processes=cfg.num_processes,

@@ -1,0 +1,50 @@
+"""Validation-workload runner: the consuming end of the operator contract.
+
+``python -m tpu_network_operator.workload <subcommand>`` is what a user
+(or the e2e harness) schedules onto operator-labeled nodes
+(``tpu-scale-out=true``).  It closes the provisioning loop the reference
+delegates to Habana's HCCL E2E docs (ref README.md:25-27): read the
+bootstrap file the node agent emitted, ``jax.distributed.initialize``
+from it, build the mesh, and run the workload (SURVEY.md §7 stage 6,
+BASELINE.md configs 2-5).
+
+Subcommands (one module each; :mod:`.cli` assembles the parser):
+
+* ``collectives`` — psum/all-gather/reduce-scatter/ppermute bandwidth
+  sweep over a mesh axis (the BASELINE "JAX all-reduce GB/s over ICI"
+  contract metric);
+* ``train`` — N steps of the dense or MoE model with any mix of
+  dp/fsdp/tp/sp/ep/pp, reporting tokens/sec/chip; optional orbax
+  checkpointing (resumes from the latest step when the directory holds
+  one);
+* ``generate`` — jitted KV-cache decode throughput (tokens/sec);
+* ``exec-bench`` — the worker half of ``tools/exec_bench.py``: execute
+  the operator's topology plan (mesh axis order + DCN collective
+  strategy from the bootstrap's plan block) on a live multi-process
+  mesh and time the planned gradient all-reduce against the unplanned
+  baseline.
+
+Every subcommand takes ``--bootstrap <path>``; without it the job runs
+single-process on the locally visible devices (the dev loop).  Passing
+``--profile <dir>`` wraps the timed region in ``jax.profiler.trace`` —
+the captured trace (TensorBoard/XProf format) shows MXU utilization, HBM
+traffic and the ICI collectives the mesh layout produced, which is how
+sharding layouts get validated on hardware (SURVEY.md §5.1: the
+reference has no tracing; this framework treats it as a first-class
+workload flag).
+"""
+
+from .cli import build_parser, main
+from .common import (
+    LLAMA_PRESET_NAMES,
+    MOE_PRESET_NAMES,
+    log,
+)
+
+__all__ = [
+    "build_parser",
+    "main",
+    "log",
+    "LLAMA_PRESET_NAMES",
+    "MOE_PRESET_NAMES",
+]

@@ -1,0 +1,40 @@
+"""``workload collectives`` — ICI/DCN bandwidth sweep over a mesh axis."""
+
+from __future__ import annotations
+
+from .common import build_mesh, emit, init_distributed, log, maybe_profile
+
+
+def cmd_collectives(args) -> int:
+    bootstrap = init_distributed(args.bootstrap)
+    import jax
+
+    from ..parallel.collectives import peak_busbw, sweep
+
+    mesh = build_mesh(args, bootstrap)
+    axis = args.axis or max(mesh.shape, key=lambda a: mesh.shape[a])
+    if axis not in mesh.shape:
+        raise SystemExit(
+            f"unknown mesh axis {axis!r}; choose from {list(mesh.shape)}"
+        )
+    if mesh.shape[axis] < 2:
+        log(f"axis {axis!r} has size {mesh.shape[axis]}; nothing to sweep")
+        emit({"metric": "collective busbw", "value": 0.0, "unit": "GB/s",
+              "axis": axis, "devices": len(jax.devices())})
+        return 0
+    with maybe_profile(args.profile):
+        results = sweep(
+            mesh, axis=axis, sizes_mb=args.sizes_mb, iters=args.iters
+        )
+    for r in results:
+        log(f"{r.op:15s} {r.size_bytes >> 20:5d}MB "
+            f"alg {r.algbw_gbps:8.2f} GB/s bus {r.busbw_gbps:8.2f} GB/s")
+    emit({
+        "metric": "collective busbw",
+        "value": round(peak_busbw(results), 2),
+        "unit": "GB/s",
+        "axis": axis,
+        "axis_size": mesh.shape[axis],
+        "results": [r.to_dict() for r in results],
+    })
+    return 0

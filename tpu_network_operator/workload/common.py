@@ -1,0 +1,111 @@
+"""Shared plumbing for the workload subcommands: bootstrap consumption,
+mesh construction, result emission, model presets and profiling."""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def init_distributed(bootstrap_path: Optional[str]):
+    """Returns (bootstrap_cfg | None).  Initializes jax.distributed when a
+    bootstrap file is given — the operator-provisioned path.  Holds the
+    bootstrap job lock for the life of the process: the agent's SIGTERM
+    drain waits for it before withdrawing routes (bootstrap.py)."""
+    if not bootstrap_path:
+        return None
+    import atexit
+
+    from ..agent.tpu.bootstrap import acquire_job_lock, read_bootstrap
+    from ..parallel import distributed_init_from_bootstrap
+
+    cfg = read_bootstrap(bootstrap_path)
+    lock = acquire_job_lock(bootstrap_path)
+    atexit.register(lock.release)
+    distributed_init_from_bootstrap(cfg)
+    log(
+        f"jax.distributed initialized: process {cfg.process_id}/"
+        f"{cfg.num_processes} coordinator {cfg.coordinator_address}"
+    )
+    return cfg
+
+
+def build_mesh(args, bootstrap):
+    import jax
+
+    from ..parallel import make_mesh, mesh_from_bootstrap, plan_axes
+
+    kw = dict(tensor=args.tensor, seq=args.seq,
+              expert=getattr(args, "expert", 1),
+              pipe=getattr(args, "pipe", 1))
+    if bootstrap is not None:
+        return mesh_from_bootstrap(bootstrap, **kw)
+    return make_mesh(plan_axes(len(jax.devices()), **kw))
+
+
+def emit(payload: dict) -> None:
+    print(json.dumps(payload))
+
+
+def llama_presets():
+    from ..models import LlamaConfig
+
+    return {
+        "tiny": LlamaConfig.tiny,
+        "llama3-150m": LlamaConfig.llama3_150m,
+        "llama3-1b": LlamaConfig.llama3_1b,
+        "llama3-3b": LlamaConfig.llama3_3b,
+        "llama3-8b": LlamaConfig.llama3_8b,
+    }
+
+
+def moe_presets():
+    from ..models.moe import MoEConfig
+
+    return {
+        "tiny": MoEConfig.tiny,
+        "small": MoEConfig.small,
+        "mixtral-8x7b": MoEConfig.mixtral_8x7b,
+    }
+
+
+LLAMA_PRESET_NAMES = (
+    "tiny", "llama3-150m", "llama3-1b", "llama3-3b", "llama3-8b"
+)
+MOE_PRESET_NAMES = ("tiny", "small", "mixtral-8x7b")
+
+
+def pick_preset(presets: dict, name: str, model: str):
+    if name not in presets:
+        raise SystemExit(
+            f"unknown preset {name!r} for model {model!r}; "
+            f"choose from {sorted(presets)}"
+        )
+    return presets[name]()
+
+
+class maybe_profile:
+    """jax.profiler.trace(dir) when --profile was given, else no-op."""
+
+    def __init__(self, directory: Optional[str]):
+        self._dir = directory
+
+    def __enter__(self):
+        if self._dir:
+            import jax
+
+            jax.profiler.start_trace(self._dir)
+            log(f"profiling to {self._dir}")
+        return self
+
+    def __exit__(self, *exc):
+        if self._dir:
+            import jax
+
+            jax.profiler.stop_trace()
+        return False

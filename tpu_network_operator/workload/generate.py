@@ -1,0 +1,70 @@
+"""``workload generate`` — jitted KV-cache decode throughput."""
+
+from __future__ import annotations
+
+import time
+
+from .common import (
+    build_mesh,
+    emit,
+    init_distributed,
+    llama_presets,
+    log,
+    maybe_profile,
+    pick_preset,
+)
+
+
+def cmd_generate(args) -> int:
+    bootstrap = init_distributed(args.bootstrap)
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.generate import make_generate_fn
+    from ..models.llama import init_params, param_shardings
+
+    mesh = build_mesh(args, bootstrap)
+    cfg = pick_preset(llama_presets(), args.preset, "llama")
+
+    params = jax.jit(
+        lambda k: init_params(k, cfg),
+        out_shardings=param_shardings(cfg, mesh),
+    )(jax.random.key(0))
+    prompt = jnp.ones((args.batch, args.prompt_len), jnp.int32)
+    gen = make_generate_fn(
+        cfg, args.max_new_tokens, temperature=args.temperature,
+        top_k=args.top_k, top_p=args.top_p, mesh=mesh,
+        decode_block=args.decode_block, kv_dtype=args.kv_dtype,
+    )
+
+    def run_once():
+        out = gen(params, prompt)
+        # sync without fetching the global array (device_get on it is
+        # illegal when other processes own part of it): block on all
+        # local shards, then force one local shard to the host — the
+        # experimental axon platform's ready-flag has been observed not
+        # to block (same workaround as bench.py), and the transfer is
+        # the guarantee there
+        out.block_until_ready()
+        jax.device_get(out.addressable_shards[0].data)
+        return out
+
+    t0 = time.perf_counter()
+    out = run_once()
+    log(f"first call (incl. compile) {time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    with maybe_profile(args.profile):
+        out = run_once()
+    dt = time.perf_counter() - t0
+
+    emit({
+        "metric": f"{args.preset} decode throughput",
+        "value": round(args.batch * args.max_new_tokens / dt, 1),
+        "unit": "tokens/sec",
+        "batch": args.batch,
+        "new_tokens": args.max_new_tokens,
+        "kv_dtype": args.kv_dtype,
+        "out_shape": list(out.shape),
+        "mesh": dict(mesh.shape),
+    })
+    return 0

@@ -1,0 +1,248 @@
+"""``workload train`` / ``workload convert`` — training throughput and
+HF-checkpoint import."""
+
+from __future__ import annotations
+
+import os
+import time
+
+from .common import (
+    build_mesh,
+    emit,
+    init_distributed,
+    llama_presets,
+    log,
+    maybe_profile,
+    moe_presets,
+    pick_preset,
+)
+
+
+def cmd_train(args) -> int:
+    bootstrap = init_distributed(args.bootstrap)
+    import jax
+    import jax.numpy as jnp
+
+    # reject axis requests the selected model path won't use — the mesh
+    # would carve devices onto a dead axis and silently replicate compute
+    if args.model != "moe" and args.expert > 1:
+        raise SystemExit("--expert requires --model moe")
+    sp_impl = getattr(args, "sp_impl", "ring")
+    if args.pipe > 1 and args.seq > 1 and sp_impl == "ulysses":
+        raise SystemExit(
+            "--sp-impl ulysses cannot nest inside the pipeline region; "
+            "use --sp-impl ring with --pipe"
+        )
+
+    mesh = build_mesh(args, bootstrap)
+    n = mesh.size
+
+    def _sp_attn_fn():
+        """Sequence-parallel attention for --seq>1 (both model families;
+        the fns are global-view, so jit reshards q/k/v around them).
+        Only the non-pipeline branches call this — the pipeline composes
+        with SP via its own seq_axis mechanism instead (see
+        make_pipeline_train_step)."""
+        if args.seq <= 1:
+            return None
+        if sp_impl == "ulysses":
+            from ..parallel.ulysses import make_ulysses_attn_fn
+
+            return make_ulysses_attn_fn(mesh)
+        from ..parallel.ring import make_ring_attn_fn
+
+        return make_ring_attn_fn(mesh)
+
+    # int8/f8-moment AdamW: halves optimizer HBM (models/optim8bit).
+    # Passed as a sentinel — make_sharded_train_step resolves it with the
+    # mesh + per-leaf PartitionSpecs so the fused per-shard update runs
+    # on multi-device meshes too.
+    optimizer = "adam8bit" if args.optimizer == "adam8bit" else None
+
+    # imported checkpoints (workload convert) carry their true geometry
+    # — incl. family and rope scaling — which beats --model/--preset
+    sidecar_cfg = None
+    cfg_sidecar = (
+        os.path.join(args.checkpoint_dir, "cfg.json")
+        if args.checkpoint_dir else ""
+    )
+    if cfg_sidecar and os.path.exists(cfg_sidecar):
+        from ..models.convert import cfg_from_json
+        from ..models.llama import LlamaConfig
+
+        with open(cfg_sidecar) as f:
+            sidecar_cfg = cfg_from_json(f.read())
+        family = (
+            "llama" if isinstance(sidecar_cfg, LlamaConfig) else "moe"
+        )
+        log(f"config from {cfg_sidecar} ({family}; overrides "
+            "--model/--preset)")
+        args.model = family
+
+    if args.model == "moe":
+        cfg = sidecar_cfg or pick_preset(moe_presets(), args.preset, "moe")
+        if args.pipe > 1:
+            from ..parallel import make_moe_pipeline_train_step
+
+            step, init_all, _ = make_moe_pipeline_train_step(
+                cfg, mesh, n_microbatches=args.microbatches,
+                optimizer=optimizer,
+                seq_axis="seq" if args.seq > 1 else None,
+                schedule=args.pp_schedule,
+                virtual_stages=args.virtual_stages,
+            )
+        else:
+            from ..models.moe import make_train_step
+
+            step, init_all, _ = make_train_step(
+                cfg, mesh, optimizer=optimizer, attn_fn=_sp_attn_fn()
+            )
+    else:
+        from ..models.llama import make_train_step
+
+        cfg = sidecar_cfg or pick_preset(
+            llama_presets(), args.preset, "llama"
+        )
+        if args.pipe > 1:
+            from ..parallel import make_pipeline_train_step
+
+            step, init_all, _ = make_pipeline_train_step(
+                cfg, mesh, n_microbatches=args.microbatches,
+                optimizer=optimizer,
+                seq_axis="seq" if args.seq > 1 else None,
+                schedule=args.pp_schedule,
+                virtual_stages=args.virtual_stages,
+            )
+        else:
+            step, init_all, _ = make_train_step(
+                cfg, mesh, optimizer=optimizer, attn_fn=_sp_attn_fn()
+            )
+
+    start_step = 0
+    ckpt = None
+    if args.checkpoint_dir:
+        from ..models.checkpoint import TrainCheckpointer, abstract_state
+
+        ckpt = TrainCheckpointer(
+            args.checkpoint_dir, max_to_keep=args.keep_checkpoints
+        )
+        if ckpt.latest_step() is not None:
+            # restore onto abstract templates: never materialize a
+            # throwaway init alongside the restored state
+            start_step, params, opt_state = ckpt.restore(
+                abstract_state(init_all)
+            )
+            log(f"resumed from checkpoint step {start_step}")
+        else:
+            params, opt_state = init_all(jax.random.key(0))
+    else:
+        params, opt_state = init_all(jax.random.key(0))
+
+    if args.data:
+        from ..data import DataConfig, MemmapTokens, sharded_batches
+
+        # resumable by construction: the iterator starts at the restored
+        # step, reproducing exactly the batches an uninterrupted run sees
+        data_it = sharded_batches(
+            MemmapTokens(args.data, vocab_size=cfg.vocab_size),
+            DataConfig(batch=args.batch, seq_len=args.seq_len),
+            mesh, start_step=start_step,
+        )
+        next_batch = lambda: next(data_it)   # noqa: E731
+    else:
+        tokens = jax.random.randint(
+            jax.random.key(1), (args.batch, args.seq_len + 1), 0,
+            cfg.vocab_size, jnp.int32,
+        )
+        next_batch = lambda: tokens          # noqa: E731
+
+    def maybe_save(i: int, last: int):
+        if ckpt is not None and (
+            i == last
+            or (args.checkpoint_every and i % args.checkpoint_every == 0)
+        ):
+            ckpt.save(i, params, opt_state)
+
+    # the compile step is optimizer update #start_step+1 — counted, so
+    # checkpoint step labels always equal real update counts
+    last = start_step + args.steps
+    t0 = time.perf_counter()
+    params, opt_state, loss = step(params, opt_state, next_batch())
+    loss_val = float(jax.device_get(loss))
+    compile_dt = time.perf_counter() - t0
+    log(f"first step (incl. compile) {compile_dt:.1f}s loss {loss_val:.4f}")
+    maybe_save(start_step + 1, last)
+
+    timed_steps = args.steps - 1
+    t0 = time.perf_counter()
+    with maybe_profile(args.profile):
+        for i in range(start_step + 2, last + 1):
+            params, opt_state, loss = step(params, opt_state, next_batch())
+            maybe_save(i, last)
+        loss_val = float(jax.device_get(loss))
+    dt = time.perf_counter() - t0
+    if ckpt is not None:
+        ckpt.close()
+
+    if timed_steps == 0:
+        log("steps=1: throughput includes compile time")
+        timed_steps, dt = 1, compile_dt
+    tps_chip = args.batch * args.seq_len * timed_steps / dt / n
+    emit({
+        "metric": f"{args.model}:{args.preset} train throughput",
+        "value": round(tps_chip, 1),
+        "unit": "tokens/sec/chip",
+        "steps": args.steps,
+        "final_loss": round(loss_val, 4),
+        "mesh": dict(mesh.shape),
+        "resumed_from": start_step,
+    })
+    return 0
+
+
+def cmd_convert(args) -> int:
+    """HF Llama checkpoint -> framework train checkpoint (step 0) plus a
+    cfg.json sidecar; `workload train --checkpoint-dir` resumes from it
+    with the checkpoint's true geometry (incl. rope scaling)."""
+    import jax
+
+    from ..models.checkpoint import TrainCheckpointer
+    from ..models.convert import (
+        assign_shardings,
+        cfg_to_json,
+        load_hf_checkpoint,
+    )
+    from ..models.llama import LlamaConfig
+
+    bootstrap = init_distributed(args.bootstrap)
+    mesh = build_mesh(args, bootstrap)
+    params, cfg = load_hf_checkpoint(args.hf_path)
+    log(f"imported {cfg.num_params() / 1e9:.2f}B params from {args.hf_path}")
+    params = assign_shardings(params, cfg, mesh)
+
+    optimizer = "adam8bit" if args.optimizer == "adam8bit" else None
+    # the family's train-step builder defaults the optimizer, keeping
+    # the saved state's structure identical to what cmd_train restores
+    if isinstance(cfg, LlamaConfig):
+        from ..models.llama import make_train_step
+    else:
+        from ..models.moe import make_train_step
+    _, _, optimizer = make_train_step(cfg, mesh, optimizer=optimizer)
+    opt_state = jax.jit(optimizer.init)(params)
+
+    os.makedirs(args.checkpoint_dir, exist_ok=True)
+    with open(os.path.join(args.checkpoint_dir, "cfg.json"), "w") as f:
+        f.write(cfg_to_json(cfg))
+    with TrainCheckpointer(args.checkpoint_dir) as ckpt:
+        ckpt.save(0, params, opt_state)
+        ckpt.wait()
+    emit({
+        "metric": "hf checkpoint import",
+        "value": round(cfg.num_params() / 1e9, 3),
+        "unit": "B params",
+        "checkpoint_dir": args.checkpoint_dir,
+        "family": "llama" if isinstance(cfg, LlamaConfig) else "moe",
+        "rope_scaling": bool(getattr(cfg, "rope_scaling", None)),
+        "mesh": dict(mesh.shape),
+    })
+    return 0
