@@ -102,6 +102,7 @@ class TestGaudiProjection:
         assert container["args"] == [
             "--configure=true",
             "--keep-running",
+            "--log-format=json",
             "--mode=L3",
             "--report-namespace=tpunet-system",
             "--policy-name=gaudi-l3",
@@ -140,6 +141,7 @@ class TestGaudiProjection:
         assert container["args"] == [
             "--configure=true",
             "--keep-running",
+            "--log-format=json",
             "--mode=L2",
             "--report-namespace=tpunet-system",
             "--policy-name=gaudi-l3",
@@ -193,6 +195,7 @@ class TestTpuProjection:
         assert container["args"] == [
             "--configure=true",
             "--keep-running",
+            "--log-format=json",
             "--backend=tpu",
             "--mode=L3",
             "--report-namespace=tpunet-system",

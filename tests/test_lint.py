@@ -1,14 +1,26 @@
 """Tests for the from-scratch AST static checker (tools/lint.py) — the
 stand-in for the reference's 19-linter golangci gate
-(ref .golangci.yml:24-44) in an environment without ruff/mypy."""
+(ref .golangci.yml:24-44) in an environment without ruff/mypy.
+
+The whole-program passes (T001/T002 lock discipline, C001 RBAC
+consistency, C002 flag projection) live in tools/analyze/ and are
+covered by the @pytest.mark.analyze classes below, including the
+repo-clean + determinism gates over the full suite."""
 
 import ast
+import shutil
 import sys
 import os
+import textwrap
+
+import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools"))
 
 import lint   # noqa: E402
+from analyze import contracts, core, races   # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def findings_of(src: str):
@@ -443,3 +455,471 @@ def test_repo_is_lint_clean():
                 lint.lint_file(path, metric_help=metric_help)
             )
     assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# -- whole-program passes (tools/analyze/) ------------------------------------
+
+RACE_PATH = "tpu_network_operator/controller/x.py"
+
+
+def race_info(src):
+    src = textwrap.dedent(src)
+    return core.FileInfo(RACE_PATH, src, ast.parse(src))
+
+
+def race_findings(src):
+    return races.check_file(race_info(src))
+
+
+@pytest.mark.analyze
+class TestLockDiscipline:
+    """T001: an attribute guarded by `with self._lock:` somewhere must
+    not be mutated lock-free anywhere reachable from >=2 thread roots.
+    T002: user callbacks must not be invoked while the lock is held."""
+
+    RACY = """
+    import threading
+
+    class Tracker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+            self._t = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            while True:
+                with self._lock:
+                    self._items["beat"] = 1
+
+        def add(self, k, v):
+            self._items[k] = v
+    """
+
+    GUARDED = """
+    import threading
+
+    class Tracker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+            self._t = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            while True:
+                with self._lock:
+                    self._items["beat"] = 1
+
+        def add(self, k, v):
+            with self._lock:
+                self._items[k] = v
+    """
+
+    def test_unguarded_write_flagged(self):
+        fs = race_findings(self.RACY)
+        assert any(
+            f.code == "T001" and "Tracker._items" in f.message
+            for f in fs
+        ), [str(f) for f in fs]
+
+    def test_guarded_write_ok(self):
+        assert race_findings(self.GUARDED) == []
+
+    def test_single_root_not_flagged(self):
+        # no second thread ever touches the attr — inconsistent locking
+        # is sloppy but not a race
+        src = self.RACY.replace(
+            "self._t = threading.Thread(target=self._loop)", "pass"
+        )
+        assert not any(
+            f.code == "T001" for f in race_findings(src)
+        )
+
+    def test_locked_suffix_convention_exempt(self):
+        src = self.RACY.replace("def add(", "def _add_locked(")
+        assert not any(
+            f.code == "T001" for f in race_findings(src)
+        )
+
+    def test_always_locked_private_helper_inherits_guard(self):
+        # `_bump` is only ever called from `with self._lock:` bodies —
+        # the caller's lock is provably held on every entry
+        src = """
+        import threading
+
+        class Tracker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+                self._t = threading.Thread(target=self._loop)
+
+            def _bump(self, k):
+                self._items[k] = 1
+
+            def _loop(self):
+                while True:
+                    with self._lock:
+                        self._bump("beat")
+
+            def add(self, k):
+                with self._lock:
+                    self._bump(k)
+        """
+        assert race_findings(src) == []
+
+    CALLBACK = """
+    import threading
+
+    class Hub:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._listeners = []
+
+        def subscribe(self, fn):
+            with self._lock:
+                self._listeners.append(fn)
+
+        def fire(self, evt):
+            with self._lock:
+                for fn in list(self._listeners):
+                    fn(evt)
+    """
+
+    def test_callback_under_lock_flagged(self):
+        fs = race_findings(self.CALLBACK)
+        assert any(f.code == "T002" for f in fs), [str(f) for f in fs]
+
+    def test_snapshot_then_call_after_release_ok(self):
+        src = """
+        import threading
+
+        class Hub:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._listeners = []
+
+            def subscribe(self, fn):
+                with self._lock:
+                    self._listeners.append(fn)
+
+            def fire(self, evt):
+                with self._lock:
+                    snapshot = list(self._listeners)
+                for fn in snapshot:
+                    fn(evt)
+        """
+        assert not any(
+            f.code == "T002" for f in race_findings(src)
+        )
+
+
+@pytest.mark.analyze
+class TestWaivers:
+    """`# tpunet: allow=<RULE> <reason>` suppresses only with a
+    non-empty justification; a bare waiver leaves the finding
+    standing."""
+
+    def _waived(self, comment):
+        src = TestLockDiscipline.RACY.replace(
+            "self._items[k] = v",
+            f"self._items[k] = v  {comment}",
+        )
+        info = race_info(src)
+        findings = races.check_file(info)
+        return core.apply_waivers(findings, {info.path: info})
+
+    def test_justified_waiver_suppresses(self):
+        out = self._waived(
+            "# tpunet: allow=T001 monotonic flag, torn read is benign"
+        )
+        assert not any(f.code == "T001" for f in out)
+
+    def test_bare_waiver_does_not_suppress(self):
+        out = self._waived("# tpunet: allow=T001")
+        assert any(f.code == "T001" for f in out)
+
+    def test_comment_above_style(self):
+        src = TestLockDiscipline.RACY.replace(
+            "            self._items[k] = v",
+            "            # tpunet: allow=T001 benign, see above\n"
+            "            self._items[k] = v",
+        )
+        info = race_info(src)
+        out = core.apply_waivers(
+            races.check_file(info), {info.path: info}
+        )
+        assert not any(f.code == "T001" for f in out)
+
+    def test_waiver_is_rule_scoped(self):
+        # a waiver for a DIFFERENT rule does not suppress T001
+        out = self._waived("# tpunet: allow=C001 wrong rule entirely")
+        assert any(f.code == "T001" for f in out)
+
+
+# -- C001: RBAC cross-artifact consistency ------------------------------------
+
+USAGE_PATH = "tpu_network_operator/controller/x.py"
+
+ROLE_HEADER = (
+    "apiVersion: rbac.authorization.k8s.io/v1\n"
+    "kind: ClusterRole\n"
+    "metadata:\n"
+    "  name: tpunet-manager-role\n"
+    "rules:\n"
+)
+
+
+def usage_infos(src):
+    src = textwrap.dedent(src)
+    return [core.FileInfo(USAGE_PATH, src, ast.parse(src))]
+
+
+def write_tree(root, files):
+    for rel, text in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(text)
+
+
+DELETE_POD_SRC = """
+class R:
+    def reconcile(self):
+        self.client.delete("v1", "Pod", "ns", "n")
+"""
+
+
+@pytest.mark.analyze
+class TestRbacContract:
+    def test_usage_granted_everywhere_ok(self, tmp_path):
+        write_tree(str(tmp_path), {
+            "deploy/rbac/role.yaml": ROLE_HEADER
+            + "- apiGroups: [\"\"]\n  resources: [pods]\n"
+              "  verbs: [delete]\n",
+        })
+        findings, _, stats = contracts.check_rbac(
+            usage_infos(DELETE_POD_SRC), str(tmp_path)
+        )
+        assert findings == []
+        assert stats["call_sites"] == 1
+
+    def test_usage_missing_in_one_artifact(self, tmp_path):
+        # granted in the chart, absent from deploy/rbac — the finding
+        # names exactly the artifact set that would 403
+        write_tree(str(tmp_path), {
+            "deploy/rbac/role.yaml": ROLE_HEADER
+            + "- apiGroups: [\"\"]\n  resources: [pods]\n"
+              "  verbs: [list]\n",
+            "charts/op/templates/clusterrole.yaml": ROLE_HEADER
+            + "- apiGroups: [\"\"]\n  resources: [pods]\n"
+              "  verbs: [delete, list]\n",
+        })
+        findings, _, _ = contracts.check_rbac(
+            usage_infos(DELETE_POD_SRC), str(tmp_path)
+        )
+        hits = [f for f in findings if "delete pods" in f.message]
+        assert hits and "deploy/rbac" in hits[0].message
+        assert "chart" not in hits[0].message.split("no grant in:")[1]
+
+    def test_usage_missing_in_all_artifacts(self, tmp_path):
+        write_tree(str(tmp_path), {
+            "deploy/rbac/role.yaml": ROLE_HEADER
+            + "- apiGroups: [\"\"]\n  resources: [pods]\n"
+              "  verbs: [list]\n",
+            "charts/op/templates/clusterrole.yaml": ROLE_HEADER
+            + "- apiGroups: [\"\"]\n  resources: [pods]\n"
+              "  verbs: [list]\n",
+        })
+        findings, _, _ = contracts.check_rbac(
+            usage_infos(DELETE_POD_SRC), str(tmp_path)
+        )
+        hits = [f for f in findings if "delete pods" in f.message]
+        assert hits
+        assert "deploy/rbac" in hits[0].message
+        assert "chart" in hits[0].message
+
+    def test_unused_grant_is_stale_row(self, tmp_path):
+        write_tree(str(tmp_path), {
+            "deploy/rbac/role.yaml": ROLE_HEADER
+            + "- apiGroups: [\"\"]\n  resources: [pods]\n"
+              "  verbs: [delete, watch]\n",
+        })
+        findings, _, _ = contracts.check_rbac(
+            usage_infos(DELETE_POD_SRC), str(tmp_path)
+        )
+        assert any(
+            "watch pods" in f.message and "stale row" in f.message
+            for f in findings
+        )
+
+    def test_apply_needs_patch_and_create(self, tmp_path):
+        # SSA apply is an upsert: PATCH plus the create fallback
+        src = """
+        class R:
+            def reconcile(self):
+                self.client.apply(
+                    {"apiVersion": "v1", "kind": "Pod"}
+                )
+        """
+        write_tree(str(tmp_path), {
+            "deploy/rbac/role.yaml": ROLE_HEADER
+            + "- apiGroups: [\"\"]\n  resources: [pods]\n"
+              "  verbs: [patch]\n",
+        })
+        findings, _, _ = contracts.check_rbac(
+            usage_infos(src), str(tmp_path)
+        )
+        assert any("create pods" in f.message for f in findings)
+        assert not any("patch pods" in f.message for f in findings)
+
+
+@pytest.fixture(scope="module")
+def pkg_infos():
+    infos = []
+    for path in core.iter_py_files(
+        [os.path.join(REPO_ROOT, "tpu_network_operator")]
+    ):
+        info, fail = core.load_file(path)
+        assert fail is None, fail
+        infos.append(info)
+    return infos
+
+
+@pytest.mark.analyze
+class TestRbacGateOnRealRepo:
+    def test_repo_artifacts_consistent(self, pkg_infos):
+        findings, sources, stats = contracts.check_rbac(
+            pkg_infos, REPO_ROOT
+        )
+        findings = core.apply_waivers(
+            findings, {i.path: i for i in pkg_infos}, sources
+        )
+        assert findings == [], "\n".join(str(f) for f in findings)
+        # the pass actually saw the artifacts — a silently-empty run
+        # would vacuously pass
+        assert stats["call_sites"] > 40
+        assert stats["grant_rows"] > 80
+
+    def test_deleting_a_granted_verb_fails_the_gate(
+        self, pkg_infos, tmp_path
+    ):
+        """ISSUE acceptance: drop one exercised verb from
+        deploy/rbac/role.yaml and C001 must fail, naming that
+        artifact."""
+        for d in ("deploy", "charts", "bundle"):
+            shutil.copytree(
+                os.path.join(REPO_ROOT, d), str(tmp_path / d)
+            )
+        role = tmp_path / "deploy" / "rbac" / "role.yaml"
+        text = role.read_text()
+        assert "verbs: [delete, list]" in text    # pods
+        role.write_text(
+            text.replace("verbs: [delete, list]", "verbs: [list]", 1)
+        )
+        findings, sources, _ = contracts.check_rbac(
+            pkg_infos, str(tmp_path)
+        )
+        findings = core.apply_waivers(
+            findings, {i.path: i for i in pkg_infos}, sources
+        )
+        hits = [
+            f for f in findings
+            if f.code == "C001" and "delete pods" in f.message
+        ]
+        assert hits, "gate did not notice the dropped verb"
+        assert "deploy/rbac" in hits[0].message
+
+
+# -- C002: agent flag projection ----------------------------------------------
+
+AGENT_PATH = "tpu_network_operator/agent/cli.py"
+PROJ_PATH = "tpu_network_operator/controller/reconciler.py"
+
+
+def flag_infos(agent_src, proj_src):
+    return [
+        core.FileInfo(
+            AGENT_PATH, agent_src, ast.parse(agent_src)
+        ),
+        core.FileInfo(
+            PROJ_PATH, proj_src, ast.parse(proj_src)
+        ),
+    ]
+
+
+@pytest.mark.analyze
+class TestFlagProjection:
+    AGENT = (
+        "def build(p):\n"
+        "    p.add_argument(\"--mode\")\n"
+        "    p.add_argument(\"--keep-running\")\n"
+    )
+    PROJ = "ARGS = [\"--keep-running\", f\"--mode={1}\"]\n"
+
+    def test_matched_flags_ok(self):
+        assert contracts.check_flag_projection(
+            flag_infos(self.AGENT, self.PROJ)
+        ) == []
+
+    def test_parsed_but_never_projected(self):
+        agent = self.AGENT + "    p.add_argument(\"--orphan\")\n"
+        fs = contracts.check_flag_projection(
+            flag_infos(agent, self.PROJ)
+        )
+        assert any(
+            f.code == "C002" and "--orphan" in f.message
+            and f.path == AGENT_PATH for f in fs
+        ), [str(f) for f in fs]
+
+    def test_projected_but_never_parsed(self):
+        proj = self.PROJ.replace(
+            "\"--keep-running\"", "\"--keep-running\", \"--ghost\""
+        )
+        fs = contracts.check_flag_projection(
+            flag_infos(self.AGENT, proj)
+        )
+        assert any(
+            f.code == "C002" and "--ghost" in f.message
+            and f.path == PROJ_PATH for f in fs
+        ), [str(f) for f in fs]
+
+    def test_projectors_own_cli_not_a_projection(self):
+        # reconciler may parse its own flags; those are not agent-arg
+        # projections
+        proj = self.PROJ + "def own(p):\n    p.add_argument(\"--me\")\n"
+        fs = contracts.check_flag_projection(
+            flag_infos(self.AGENT, proj)
+        )
+        assert not any("--me" in f.message for f in fs)
+
+
+# -- full-suite gates ---------------------------------------------------------
+
+@pytest.mark.analyze
+def test_full_suite_repo_clean():
+    """THE enforcement point: every rule family over the whole tree
+    (what `make lint` runs) must report zero findings."""
+    targets = [
+        os.path.join(REPO_ROOT, t) for t in lint.DEFAULT_TARGETS
+        if os.path.exists(os.path.join(REPO_ROOT, t))
+    ]
+    findings, _ = lint.run_suite(targets, repo_root=REPO_ROOT)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+@pytest.mark.analyze
+def test_suite_is_deterministic(tmp_path):
+    """Two runs over the same (finding-rich) tree produce identical,
+    sorted output — CI diffs stay meaningful."""
+    write_tree(str(tmp_path), {
+        "a.py": "import os\nx = f'static'\n",
+        "b.py": "def f(a=[]):\n    return pritn(a)\n",
+    })
+    runs = []
+    for _ in range(2):
+        findings, _ = lint.run_suite(
+            [str(tmp_path)], repo_root=str(tmp_path)
+        )
+        runs.append([str(f) for f in findings])
+    assert runs[0] == runs[1]
+    assert len(runs[0]) >= 3
+    assert runs[0] == sorted(runs[0])

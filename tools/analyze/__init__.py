@@ -1,0 +1,37 @@
+"""Whole-program analysis suite for the tpu-network-operator repo.
+
+Layout:
+
+* ``core``        — shared substrate: one-parse/one-walk ``FileInfo``,
+  the ``# tpunet: allow=<RULE> <reason>`` waiver table, finding type.
+* ``local_rules`` — the per-file families (F821/F401/E722/F541/B006/
+  E711/B011/G004/R001/M001) on the shared node index.
+* ``races``       — T001/T002 lock-discipline race detection.
+* ``contracts``   — C001 RBAC cross-artifact consistency, C002 agent
+  flag projection consistency.
+* ``driver``      — ``run_suite`` + the CLI (``--rule``, ``--stats``).
+
+``tools/lint.py`` re-exports the public surface so ``make lint`` and
+older imports keep working unchanged.
+"""
+
+from .core import (      # noqa: F401
+    ALL_RULES,
+    FileInfo,
+    Finding,
+    Waivers,
+    apply_waivers,
+    iter_py_files,
+    load_file,
+)
+from .local_rules import (   # noqa: F401
+    Checker,
+    STRUCTURED_LOG_DIRS,
+    load_metric_help,
+)
+from .driver import (    # noqa: F401
+    DEFAULT_TARGETS,
+    main,
+    parse_rule_arg,
+    run_suite,
+)

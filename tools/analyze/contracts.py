@@ -1,0 +1,855 @@
+"""C001/C002 — cross-artifact contract checks.
+
+**C001 (RBAC consistency).**  The operator's effective privileges live
+in three places that have repeatedly drifted in review: the kustomize
+roles (``deploy/rbac/*.yaml``), the Helm chart ClusterRole/Role
+templates, and the OLM bundle CSV.  This pass extracts every
+``(verb, apiGroup/resource)`` pair the code can actually issue — from
+call sites of the ``kube.client`` interface (``get/list/watch/create/
+update/update_status/delete/apply``), through the ``RetryingClient``/
+``CachedClient`` wrappers (same method surface, receiver named
+``client``) — and diffs it against the grants parsed from all three
+artifacts:
+
+* usage not granted in an artifact  -> finding at the first call site;
+* an audited-role grant the code never exercises -> finding at the
+  artifact (stale row).
+
+Kind resolution is whole-program: string literals, module/class
+constants (``LEASE_API``, ``NetworkClusterPolicy.KIND``,
+``t.API_VERSION``), dict-literal objects, local assignments, parameter
+annotations, and constructor functions whose return value is a dict
+literal with a ``kind`` key (or a ``copy.deepcopy`` of a parsed
+embedded YAML template).  Verb mapping: ``apply`` is server-side apply
+= ``patch`` + ``create`` (upsert); ``update_status`` is ``update`` on
+the ``<resource>/status`` subresource.  Call sites where the object
+pre-exists by construction can waive the ``create`` half inline.
+
+Audited roles (stale-row direction) are the operator-owned ones:
+manager, leader-election and agent-report.  User-facing editor/viewer
+roles and the kube-rbac-proxy-style metrics roles are grant surface for
+OTHER principals — they stay out of the stale-row audit but still count
+toward coverage.  A small EXEMPT table documents grants that are real
+but never appear as client calls (apiserver-side enforcement).
+
+**C002 (flag projection).**  Every ``--flag`` the agent's ``CmdConfig``
+parses (``agent/cli.py`` ``add_argument``) must be projected into the
+DaemonSet args by the controller (``controller/reconciler.py`` /
+``templates.py``), and every projected flag must be parsed — the drift
+class behind the ``--telemetry*``/``--probe*``/``--planner`` wiring
+bugs.  Standalone-only flags carry an inline waiver with the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import FileInfo, Finding
+
+# -- k8s shape tables ---------------------------------------------------------
+
+# kind -> plural, mirroring kube/client.py plural(); the irregulars are
+# parsed out of client.py's _PLURALS at runtime when available so the
+# two tables cannot drift (see _load_plurals).
+_FALLBACK_PLURALS = {
+    "NetworkClusterPolicy": "networkclusterpolicies",
+    "DaemonSet": "daemonsets",
+    "Pod": "pods",
+    "ServiceAccount": "serviceaccounts",
+    "RoleBinding": "rolebindings",
+    "Lease": "leases",
+}
+
+VERB_MAP = {
+    "get": ("get",),
+    "list": ("list",),
+    "watch": ("watch",),
+    "create": ("create",),
+    "update": ("update",),
+    "delete": ("delete",),
+    # server-side apply upserts: PATCH, falling back to create when the
+    # object does not exist yet
+    "apply": ("patch", "create"),
+}
+CLIENT_METHODS = set(VERB_MAP) | {"update_status"}
+OBJECT_METHODS = {"create", "update", "apply", "update_status"}
+CLIENT_RECEIVERS = {"client", "_client", "kube_client", "api_client", "cli"}
+
+# grants that are correct but never appear as a client call — the
+# enforcement happens inside the apiserver
+EXEMPT_GRANTS = {
+    ("tpunet.dev", "networkclusterpolicies/finalizers", "update"):
+        "ownerReference blockOwnerDeletion is checked apiserver-side "
+        "(OwnerReferencesPermissionEnforcement), never a client call",
+}
+
+# roles audited for stale rows; everything else (editor/viewer/metrics)
+# is grant surface for other principals
+AUDITED_ROLE_RE = re.compile(
+    r"(manager-role|leader[-_]election|agent[-_]report)"
+)
+
+
+@dataclass
+class Usage:
+    group: str
+    resource: str
+    verb: str
+    path: str
+    line: int
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.group, self.resource, self.verb)
+
+    @property
+    def pretty(self) -> str:
+        res = f"{self.group}/{self.resource}" if self.group else self.resource
+        return f"{self.verb} {res}"
+
+
+@dataclass
+class Grant:
+    group: str
+    resource: str
+    verb: str
+    artifact: str          # file path
+    role: str
+    line: int              # best-effort anchor in the artifact
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.group, self.resource, self.verb)
+
+
+def group_of(api_version: str) -> str:
+    return api_version.split("/", 1)[0] if "/" in api_version else ""
+
+
+# -- whole-program symbol tables ---------------------------------------------
+
+class SymbolTable:
+    """Module constants, class constants and object-constructor returns
+    across the package — the resolution substrate for call-site kinds."""
+
+    def __init__(self, infos: List[FileInfo]):
+        self.module_consts: Dict[str, Dict[str, str]] = {}
+        self.by_name: Dict[str, Set[str]] = {}
+        self.class_consts: Dict[str, Dict[str, str]] = {}
+        self.ctors: Dict[str, Tuple[Optional[str], Optional[str]]] = {}
+        self.plurals = dict(_FALLBACK_PLURALS)
+        for info in infos:
+            self._collect(info)
+
+    def _collect(self, info: FileInfo):
+        mod = self.module_consts.setdefault(info.path, {})
+        for stmt in info.tree.body:
+            name, value = _const_assign(stmt)
+            if name and isinstance(value, str):
+                mod[name] = value
+                self.by_name.setdefault(name, set()).add(value)
+        # f-string module constants with only constant-foldable parts:
+        # API_VERSION = f"{GROUP}/{VERSION}" resolves once GROUP/VERSION
+        # are known (one fixpoint round is enough for this repo's use)
+        for stmt in info.tree.body:
+            name, expr = _assign_target_expr(stmt)
+            if not name or name in mod or not isinstance(
+                expr, ast.JoinedStr
+            ):
+                continue
+            parts = []
+            for v in expr.values:
+                if isinstance(v, ast.Constant):
+                    parts.append(str(v.value))
+                elif isinstance(v, ast.FormattedValue) and isinstance(
+                    v.value, ast.Name
+                ) and v.value.id in mod:
+                    parts.append(mod[v.value.id])
+                else:
+                    parts = None
+                    break
+            if parts is not None:
+                mod[name] = "".join(parts)
+                self.by_name.setdefault(name, set()).add(mod[name])
+
+        for cls in info.nodes(ast.ClassDef):
+            slot = self.class_consts.setdefault(cls.name, {})
+            for stmt in cls.body:
+                name, expr = _assign_target_expr(stmt)
+                if not name:
+                    continue
+                if isinstance(expr, ast.Constant) and isinstance(
+                    expr.value, str
+                ):
+                    slot[name] = expr.value
+                elif isinstance(expr, ast.Name) and expr.id in mod:
+                    # API_VERSION = API_VERSION style re-export
+                    slot[name] = mod[expr.id]
+
+        # template-parse chain: _X = _parse(YAML_CONST) / yaml.safe_load
+        parsed_vars: Dict[str, Tuple[Optional[str], Optional[str]]] = {}
+        for stmt in info.tree.body:
+            name, expr = _assign_target_expr(stmt)
+            if not name or not isinstance(expr, ast.Call):
+                continue
+            fname = _terminal_name(expr.func)
+            if fname in ("_parse", "safe_load", "load") and expr.args:
+                arg = expr.args[0]
+                text = None
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    text = arg.value
+                elif isinstance(arg, ast.Name):
+                    text = mod.get(arg.id)
+                if text:
+                    parsed_vars[name] = (
+                        _yaml_scalar(text, "apiVersion"),
+                        _yaml_scalar(text, "kind"),
+                    )
+
+        for fn in info.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            ret = self._ctor_return(fn, mod, parsed_vars)
+            if ret is not None:
+                self.ctors.setdefault(fn.name, ret)
+
+        if info.norm_path.endswith("kube/client.py"):
+            self._load_plurals(info)
+
+    def _ctor_return(self, fn, mod, parsed_vars):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            val = node.value
+            if isinstance(val, ast.Call) and _terminal_name(
+                val.func
+            ) == "deepcopy" and val.args and isinstance(
+                val.args[0], ast.Name
+            ):
+                hit = parsed_vars.get(val.args[0].id)
+                if hit and hit[1]:
+                    return hit
+            if isinstance(val, ast.Dict):
+                av = kind = None
+                for k, v in zip(val.keys, val.values):
+                    if not (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        continue
+                    s = None
+                    if isinstance(v, ast.Constant) and isinstance(
+                        v.value, str
+                    ):
+                        s = v.value
+                    elif isinstance(v, ast.Name):
+                        s = mod.get(v.id)
+                    if k.value == "apiVersion":
+                        av = s
+                    elif k.value == "kind":
+                        kind = s
+                if kind:
+                    return (av, kind)
+        return None
+
+    def _load_plurals(self, info: FileInfo):
+        for stmt in info.tree.body:
+            name, expr = _assign_target_expr(stmt)
+            if name == "_PLURALS" and isinstance(expr, ast.Dict):
+                for k, v in zip(expr.keys, expr.values):
+                    if (isinstance(k, ast.Constant)
+                            and isinstance(v, ast.Constant)):
+                        self.plurals[k.value] = v.value
+
+    # -- expression resolution ------------------------------------------------
+
+    def resolve_str(self, expr, path: str) -> Optional[str]:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        if isinstance(expr, ast.Name):
+            hit = self.module_consts.get(path, {}).get(expr.id)
+            if hit is not None:
+                return hit
+            vals = self.by_name.get(expr.id, set())
+            return next(iter(vals)) if len(vals) == 1 else None
+        if isinstance(expr, ast.Attribute):
+            # Class.ATTR first, then any-module ATTR if unambiguous
+            if isinstance(expr.value, ast.Name):
+                cls = self.class_consts.get(expr.value.id, {})
+                if expr.attr in cls:
+                    return cls[expr.attr]
+            vals = set(self.by_name.get(expr.attr, set()))
+            for slot in self.class_consts.values():
+                if expr.attr in slot:
+                    vals.add(slot[expr.attr])
+            # base-class placeholder defaults ("") are not candidates
+            vals = {v for v in vals if v}
+            return next(iter(vals)) if len(vals) == 1 else None
+        return None
+
+    def plural(self, kind: str) -> str:
+        return self.plurals.get(kind, kind.lower() + "s")
+
+
+def _terminal_name(fn) -> str:
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _assign_value(stmt):
+    if isinstance(stmt, ast.Assign):
+        return stmt.value
+    if isinstance(stmt, ast.AnnAssign):
+        return stmt.value
+    return None
+
+
+def _assign_target_expr(stmt):
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and isinstance(
+        stmt.targets[0], ast.Name
+    ):
+        return stmt.targets[0].id, stmt.value
+    if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+        return stmt.target.id, stmt.value
+    return None, None
+
+
+def _const_assign(stmt):
+    name, expr = _assign_target_expr(stmt)
+    if name and isinstance(expr, ast.Constant):
+        return name, expr.value
+    return None, None
+
+
+_YAML_SCALAR_RE = {
+    "kind": re.compile(r"^kind:\s*([\w./-]+)", re.M),
+    "apiVersion": re.compile(r"^apiVersion:\s*([\w./-]+)", re.M),
+}
+
+
+def _yaml_scalar(text: str, key: str) -> Optional[str]:
+    m = _YAML_SCALAR_RE[key].search(text)
+    return m.group(1) if m else None
+
+
+# -- usage extraction ---------------------------------------------------------
+
+def _is_clientish(recv: ast.AST) -> bool:
+    """True for a client-named receiver: ``client`` / ``self.client`` /
+    ``self._client`` / ``mgr.client`` ..."""
+    if isinstance(recv, ast.Name) and recv.id in CLIENT_RECEIVERS:
+        return True
+    if isinstance(recv, ast.Attribute) and recv.attr in CLIENT_RECEIVERS:
+        return True
+    return False
+
+
+def _is_client_call(node: ast.Call) -> Optional[str]:
+    """Method name when ``node`` is a kube-client interface call on a
+    client-named receiver (client / self.client / self._client / ...)."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute) or fn.attr not in CLIENT_METHODS:
+        return None
+    return fn.attr if _is_clientish(fn.value) else None
+
+
+class UsageExtractor:
+    def __init__(self, syms: SymbolTable):
+        self.syms = syms
+        self.usages: List[Usage] = []
+        self.unresolved: List[Tuple[str, int, str]] = []
+
+    def scan(self, info: FileInfo):
+        # enclosing-function map for local-variable resolution, plus
+        # per-function aliases of client methods:
+        #   list_fn = getattr(self.client, "list_readonly", None) \
+        #       or self.client.list
+        enclosing: Dict[int, ast.AST] = {}
+        aliases: Dict[int, Dict[str, str]] = {}
+        for fn in info.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            amap = aliases.setdefault(id(fn), {})
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    enclosing.setdefault(id(sub), fn)
+                elif isinstance(sub, ast.Assign) and len(
+                    sub.targets
+                ) == 1 and isinstance(sub.targets[0], ast.Name):
+                    for part in ast.walk(sub.value):
+                        if isinstance(part, ast.Attribute) and \
+                                part.attr in CLIENT_METHODS and \
+                                _is_clientish(part.value):
+                            amap[sub.targets[0].id] = part.attr
+        for call in info.nodes(ast.Call):
+            method = _is_client_call(call)
+            fn = enclosing.get(id(call))
+            if method is None and isinstance(call.func, ast.Name) \
+                    and fn is not None:
+                method = aliases.get(id(fn), {}).get(call.func.id)
+            if method is None:
+                continue
+            gvk = self._resolve_call(call, method, info, fn)
+            if gvk is None:
+                self.unresolved.append(
+                    (info.path, call.lineno, method)
+                )
+                continue
+            av, kind = gvk
+            group = group_of(av or "")
+            resource = self.syms.plural(kind)
+            verbs = VERB_MAP.get(method)
+            if method == "update_status":
+                verbs, resource = ("update",), f"{resource}/status"
+            for verb in verbs:
+                self.usages.append(Usage(
+                    group, resource, verb, info.path, call.lineno
+                ))
+
+    # -- resolution -----------------------------------------------------------
+
+    def _resolve_call(self, call, method, info, fn):
+        if method in OBJECT_METHODS:
+            if not call.args:
+                return None
+            return self._resolve_obj(call.args[0], info, fn, depth=0)
+        # positional (api_version, kind, ...) methods
+        if len(call.args) < 2:
+            return None
+        av = self.syms.resolve_str(call.args[0], info.path)
+        kind = self.syms.resolve_str(call.args[1], info.path)
+        if av is None or kind is None:
+            return None
+        return (av, kind)
+
+    def _resolve_obj(self, expr, info, fn, depth) -> Optional[tuple]:
+        if depth > 4:
+            return None
+        if isinstance(expr, ast.Dict):
+            av = kind = None
+            for k, v in zip(expr.keys, expr.values):
+                if isinstance(k, ast.Constant) and k.value == "apiVersion":
+                    av = self.syms.resolve_str(v, info.path)
+                elif isinstance(k, ast.Constant) and k.value == "kind":
+                    kind = self.syms.resolve_str(v, info.path)
+            return (av, kind) if kind else None
+        if isinstance(expr, ast.IfExp):
+            return (
+                self._resolve_obj(expr.body, info, fn, depth + 1)
+                or self._resolve_obj(expr.orelse, info, fn, depth + 1)
+            )
+        if isinstance(expr, ast.Subscript):
+            # `owned[0]` where `owned = client.list(...)` — an element
+            # of a listed collection has the collection's GVK
+            return self._resolve_obj(expr.value, info, fn, depth + 1)
+        if isinstance(expr, ast.Call):
+            name = _terminal_name(expr.func)
+            if name == "to_dict" and isinstance(expr.func, ast.Attribute):
+                return self._resolve_obj(
+                    expr.func.value, info, fn, depth + 1
+                )
+            if name in self.syms.ctors:
+                av, kind = self.syms.ctors[name]
+                return (av, kind) if kind else None
+            # Class.from_dict(...) / NetworkClusterPolicy(...) style
+            owner = expr.func
+            if isinstance(owner, ast.Attribute):
+                owner = owner.value
+            if isinstance(owner, ast.Name):
+                hit = self._class_gvk(owner.id)
+                if hit:
+                    return hit
+            # client.get(...) feeding create/update: same call shape
+            m = _is_client_call(expr)
+            if m in ("get", "list"):
+                return self._resolve_call(expr, m, info, fn)
+            return None
+        if isinstance(expr, ast.Name):
+            # parameter annotation
+            if fn is not None:
+                for arg in (
+                    list(fn.args.posonlyargs) + list(fn.args.args)
+                    + list(fn.args.kwonlyargs)
+                ):
+                    if arg.arg == expr.id and arg.annotation is not None:
+                        ann = arg.annotation
+                        tname = _terminal_name(ann) or (
+                            ann.value if isinstance(ann, ast.Constant)
+                            else ""
+                        )
+                        hit = self._class_gvk(str(tname))
+                        if hit:
+                            return hit
+                # local assignments (last statically-seen one wins)
+                hit = None
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == expr.id
+                        for t in node.targets
+                    ):
+                        got = self._resolve_obj(
+                            node.value, info, fn, depth + 1
+                        )
+                        if got:
+                            hit = got
+                return hit
+        if isinstance(expr, ast.Attribute):
+            attr_owner = expr.value
+            if isinstance(attr_owner, ast.Name):
+                hit = self._class_gvk(attr_owner.id)
+                if hit:
+                    return hit
+        return None
+
+    def _class_gvk(self, class_name: str) -> Optional[tuple]:
+        slot = self.syms.class_consts.get(class_name, {})
+        if "KIND" in slot:
+            return (slot.get("API_VERSION"), slot["KIND"])
+        return None
+
+
+# -- artifact grant parsing ---------------------------------------------------
+
+_HELM_INLINE = re.compile(r"\{\{.*?\}\}")
+
+
+def _sanitize_helm(text: str) -> str:
+    out = []
+    for line in text.splitlines():
+        stripped = line.strip()
+        if stripped.startswith("{{") and stripped.endswith("}}"):
+            out.append("")   # keep line numbers stable
+            continue
+        out.append(_HELM_INLINE.sub("HELM", line))
+    return "\n".join(out)
+
+
+def _split_docs(text: str) -> List[Tuple[int, str]]:
+    """(start_line, doc_text) per ``---``-separated YAML document."""
+    docs: List[Tuple[int, str]] = []
+    start = 1
+    cur: List[str] = []
+    for i, line in enumerate(text.splitlines(), start=1):
+        if line.strip() == "---":
+            if any(s.strip() for s in cur):
+                docs.append((start, "\n".join(cur)))
+            cur, start = [], i + 1
+        else:
+            cur.append(line)
+    if any(s.strip() for s in cur):
+        docs.append((start, "\n".join(cur)))
+    return docs
+
+
+def _grant_rows(doc: dict, path: str, start_line: int,
+                doc_text: str) -> List[Grant]:
+    rows: List[Grant] = []
+    if not isinstance(doc, dict):
+        return rows
+    if doc.get("kind") not in ("Role", "ClusterRole"):
+        return rows
+    role = str((doc.get("metadata") or {}).get("name", ""))
+    lines = doc_text.splitlines()
+
+    def anchor(token: str) -> int:
+        for i, line in enumerate(lines):
+            if token in line:
+                return start_line + i
+        return start_line
+
+    for rule in doc.get("rules") or []:
+        if not isinstance(rule, dict) or "nonResourceURLs" in rule:
+            continue
+        groups = rule.get("apiGroups") or [""]
+        for res in rule.get("resources") or []:
+            ln = anchor(str(res))
+            for grp in groups:
+                for verb in rule.get("verbs") or []:
+                    rows.append(Grant(
+                        str(grp or ""), str(res), str(verb),
+                        path, role, ln,
+                    ))
+    return rows
+
+
+def _csv_grant_rows(doc: dict, path: str, raw: str) -> List[Grant]:
+    rows: List[Grant] = []
+    lines = raw.splitlines()
+
+    def anchor(token: str, after: int = 0) -> int:
+        for i in range(after, len(lines)):
+            if token in lines[i]:
+                return i + 1
+        return 1
+
+    spec = ((doc.get("spec") or {}).get("install") or {}).get("spec") or {}
+    for section in ("permissions", "clusterPermissions"):
+        for perm in spec.get(section) or []:
+            sa = str(perm.get("serviceAccountName", ""))
+            role = f"{section}:{sa}"
+            for rule in perm.get("rules") or []:
+                if not isinstance(rule, dict) or "nonResourceURLs" in rule:
+                    continue
+                groups = rule.get("apiGroups") or [""]
+                for res in rule.get("resources") or []:
+                    ln = anchor(f"- {res}", anchor(section))
+                    for grp in groups:
+                        for verb in rule.get("verbs") or []:
+                            rows.append(Grant(
+                                str(grp or ""), str(res), str(verb),
+                                path, role, ln,
+                            ))
+    return rows
+
+
+@dataclass
+class ArtifactSet:
+    name: str              # "deploy/rbac" | "chart" | "bundle"
+    grants: List[Grant] = field(default_factory=list)
+    sources: Dict[str, str] = field(default_factory=dict)   # path -> text
+
+    @property
+    def keys(self) -> Set[Tuple[str, str, str]]:
+        return {g.key for g in self.grants}
+
+    def covers(self, usage: Usage) -> bool:
+        for g in self.grants:
+            if (g.group == usage.group or g.group == "*") and (
+                g.resource == usage.resource or g.resource == "*"
+            ) and (g.verb == usage.verb or g.verb == "*"):
+                return True
+        return False
+
+
+def load_artifacts(repo_root: str) -> List[ArtifactSet]:
+    import yaml
+
+    sets: List[ArtifactSet] = []
+
+    deploy = ArtifactSet("deploy/rbac")
+    rbac_dir = os.path.join(repo_root, "deploy", "rbac")
+    if os.path.isdir(rbac_dir):
+        for fname in sorted(os.listdir(rbac_dir)):
+            if not fname.endswith(".yaml"):
+                continue
+            path = os.path.join(rbac_dir, fname)
+            text = open(path, encoding="utf-8").read()
+            rel = os.path.relpath(path, repo_root)
+            deploy.sources[rel] = text
+            for start, doc_text in _split_docs(text):
+                try:
+                    doc = yaml.safe_load(doc_text)
+                except yaml.YAMLError:
+                    continue
+                deploy.grants.extend(
+                    _grant_rows(doc, rel, start, doc_text)
+                )
+    sets.append(deploy)
+
+    chart = ArtifactSet("chart")
+    tmpl_root = os.path.join(repo_root, "charts")
+    for root, _dirs, files in os.walk(tmpl_root):
+        if os.path.basename(root) != "templates":
+            continue
+        for fname in sorted(files):
+            if not fname.endswith(".yaml"):
+                continue
+            path = os.path.join(root, fname)
+            text = open(path, encoding="utf-8").read()
+            rel = os.path.relpath(path, repo_root)
+            chart.sources[rel] = text
+            sane = _sanitize_helm(text)
+            for start, doc_text in _split_docs(sane):
+                try:
+                    doc = yaml.safe_load(doc_text)
+                except yaml.YAMLError:
+                    continue
+                chart.grants.extend(
+                    _grant_rows(doc, rel, start, doc_text)
+                )
+    sets.append(chart)
+
+    bundle = ArtifactSet("bundle")
+    man_dir = os.path.join(repo_root, "bundle", "manifests")
+    if os.path.isdir(man_dir):
+        for fname in sorted(os.listdir(man_dir)):
+            if "clusterserviceversion" not in fname:
+                continue
+            path = os.path.join(man_dir, fname)
+            text = open(path, encoding="utf-8").read()
+            rel = os.path.relpath(path, repo_root)
+            bundle.sources[rel] = text
+            try:
+                doc = yaml.safe_load(text)
+            except yaml.YAMLError:
+                continue
+            bundle.grants.extend(_csv_grant_rows(doc, rel, text))
+    sets.append(bundle)
+    return sets
+
+
+# -- C001 driver --------------------------------------------------------------
+
+# usage scan scope: the operator package minus the client plumbing
+# itself (kube/ implements the interface; its internal calls are not
+# privilege usage) and minus the pure-compute packages
+_USAGE_SKIP = ("tpu_network_operator/kube/",)
+
+
+def check_rbac(
+    infos: List[FileInfo], repo_root: str,
+) -> Tuple[List[Finding], Dict[str, str], Dict[str, int]]:
+    """Returns (findings, artifact-sources-for-waivers, stats)."""
+    pkg = [
+        i for i in infos
+        if "tpu_network_operator/" in i.norm_path
+        and not any(s in i.norm_path for s in _USAGE_SKIP)
+    ]
+    syms = SymbolTable(
+        [i for i in infos if "tpu_network_operator/" in i.norm_path]
+    )
+    ex = UsageExtractor(syms)
+    for info in pkg:
+        ex.scan(info)
+
+    artifacts = load_artifacts(repo_root)
+    findings: List[Finding] = []
+    sources: Dict[str, str] = {}
+    for a in artifacts:
+        sources.update(a.sources)
+
+    present = [a for a in artifacts if a.grants]
+    # per-call-site waivers: a usage whose own line carries a justified
+    # C001 waiver is dropped from the coverage direction (every site
+    # must be waived for the finding to clear — the anchor jumps to the
+    # next unwaived site), but still counts as exercising grants
+    by_path = {i.path: i for i in infos}
+
+    def waived(u: Usage) -> bool:
+        info = by_path.get(u.path)
+        return info is not None and info.waivers.covers(u.line, "C001")
+
+    # usage -> every artifact set must grant it
+    by_key: Dict[Tuple[str, str, str], List[Usage]] = {}
+    for u in ex.usages:
+        by_key.setdefault(u.key, []).append(u)
+    for key in sorted(by_key):
+        uses = [u for u in by_key[key] if not waived(u)]
+        if not uses:
+            continue
+        missing = [a.name for a in present if not a.covers(uses[0])]
+        if not missing:
+            continue
+        first = min(uses, key=lambda u: (u.path, u.line))
+        findings.append(Finding(
+            first.path, first.line, "C001",
+            f"client usage '{first.pretty}' has no grant in: "
+            f"{', '.join(missing)} "
+            f"({len(uses)} call site(s))",
+        ))
+
+    # stale rows: audited-role grants never exercised
+    used_keys = set(by_key)
+    for a in present:
+        seen: Set[Tuple[str, str, str, str]] = set()
+        for g in a.grants:
+            if not AUDITED_ROLE_RE.search(g.role):
+                continue
+            if g.key in used_keys:
+                continue
+            reason = EXEMPT_GRANTS.get(g.key)
+            if reason is not None:
+                continue
+            dedup = g.key + (g.artifact,)
+            if dedup in seen:
+                continue
+            seen.add(dedup)
+            res = f"{g.group}/{g.resource}" if g.group else g.resource
+            findings.append(Finding(
+                g.artifact, g.line, "C001",
+                f"grant '{g.verb} {res}' in role '{g.role}' is never "
+                f"exercised by the code (stale row)",
+            ))
+
+    stats = {
+        "call_sites": len(ex.usages),
+        "unresolved": len(ex.unresolved),
+        "grant_rows": sum(len(a.grants) for a in artifacts),
+    }
+    return findings, sources, stats
+
+
+# -- C002 flag projection -----------------------------------------------------
+
+_FLAG_RE = re.compile(r"^--[a-z][a-z0-9-]*")
+
+AGENT_CLI = "tpu_network_operator/agent/cli.py"
+PROJECTION_FILES = (
+    "tpu_network_operator/controller/reconciler.py",
+    "tpu_network_operator/controller/templates.py",
+)
+
+
+def _flag_of(text: str) -> Optional[str]:
+    m = _FLAG_RE.match(text)
+    return m.group(0) if m else None
+
+
+def check_flag_projection(infos: List[FileInfo]) -> List[Finding]:
+    agent = next(
+        (i for i in infos if i.norm_path.endswith(AGENT_CLI)), None
+    )
+    projectors = [
+        i for i in infos
+        if any(i.norm_path.endswith(p) for p in PROJECTION_FILES)
+    ]
+    if agent is None or not projectors:
+        return []
+
+    parsed: Dict[str, Tuple[str, int]] = {}
+    for call in agent.nodes(ast.Call):
+        if _terminal_name(call.func) != "add_argument" or not call.args:
+            continue
+        for arg in call.args:
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                flag = _flag_of(arg.value)
+                if flag:
+                    parsed.setdefault(flag, (agent.path, call.lineno))
+
+    projected: Dict[str, Tuple[str, int]] = {}
+    for info in projectors:
+        # flags inside the projector's own add_argument calls (if any)
+        # are ITS cli, not a projection
+        own_cli = {
+            id(arg)
+            for call in info.nodes(ast.Call)
+            if _terminal_name(call.func) == "add_argument"
+            for arg in ast.walk(call)
+        }
+        for node in info.nodes(ast.Constant):
+            if id(node) in own_cli or not isinstance(node.value, str):
+                continue
+            flag = _flag_of(node.value)
+            if flag:
+                projected.setdefault(flag, (info.path, node.lineno))
+
+    findings: List[Finding] = []
+    for flag in sorted(set(parsed) - set(projected)):
+        path, line = parsed[flag]
+        findings.append(Finding(
+            path, line, "C002",
+            f"agent flag '{flag}' is parsed by CmdConfig but never "
+            f"projected by the controller (reconciler/templates) — "
+            f"managed DaemonSets cannot set it",
+        ))
+    for flag in sorted(set(projected) - set(parsed)):
+        path, line = projected[flag]
+        findings.append(Finding(
+            path, line, "C002",
+            f"controller projects '{flag}' but the agent CmdConfig "
+            f"does not parse it — agents will reject their own args",
+        ))
+    return findings

@@ -1,0 +1,194 @@
+"""Shared analysis substrate for the whole-program suite.
+
+Every rule family (the per-file lint rules, the T001/T002 race pass and
+the C001/C002 cross-artifact contract passes) consumes the same parsed
+artifacts:
+
+* :class:`FileInfo` — one ``ast.parse`` and ONE ``ast.walk`` per file,
+  exposed as a by-type node index.  Rules iterate ``info.nodes(ast.Call)``
+  instead of re-walking the tree, so adding a rule costs O(nodes-of-kind),
+  not another O(tree) traversal.
+* :class:`Waivers` — the inline ``# tpunet: allow=<RULE> <reason>``
+  exception syntax.  A waiver only suppresses when it carries a
+  justification string; a bare ``allow=T001`` is ignored (and the
+  finding stands), so the exception path is always documented.
+
+Zero third-party dependencies (stdlib + the repo's own pyyaml, used only
+by the contract pass for the deploy/chart/bundle artifacts).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+# -- inline waivers -----------------------------------------------------------
+
+# `# tpunet: allow=T001 <reason>` / `# tpunet: allow=T001,C001 <reason>`
+_WAIVER_RE = re.compile(
+    r"#\s*tpunet:\s*allow=(?P<rules>[A-Z]\d{3}(?:,[A-Z]\d{3})*)"
+    r"(?P<reason>[^\n]*)"
+)
+
+
+class Waivers:
+    """Per-file waiver table: (line, rule) -> has-justification.
+
+    A finding at line L is waived when line L (or, for findings anchored
+    on a statement whose waiver rides the preceding comment line, L-1)
+    carries ``# tpunet: allow=<RULE> <reason>`` with non-empty reason
+    text.  Works identically for Python and YAML sources — both use
+    ``#`` comments.
+    """
+
+    def __init__(self, source: str):
+        # line -> {rule -> reason-present}
+        self._by_line: Dict[int, Dict[str, bool]] = {}
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = _WAIVER_RE.search(text)
+            if not m:
+                continue
+            has_reason = bool(m.group("reason").strip())
+            slot = self._by_line.setdefault(i, {})
+            for rule in m.group("rules").split(","):
+                slot[rule] = has_reason
+
+    def covers(self, line: int, code: str) -> bool:
+        """True when a JUSTIFIED waiver for ``code`` is on ``line`` or
+        the line directly above it (comment-above style)."""
+        for ln in (line, line - 1):
+            if self._by_line.get(ln, {}).get(code, False):
+                return True
+        return False
+
+    def bare_waiver_lines(self, code: str) -> List[int]:
+        """Lines carrying a waiver for ``code`` WITHOUT a reason —
+        surfaced so the gate can explain why the waiver did not take."""
+        return sorted(
+            ln for ln, slot in self._by_line.items()
+            if code in slot and not slot[code]
+        )
+
+
+# -- one-parse one-walk file record -------------------------------------------
+
+class FileInfo:
+    """A parsed source file plus a single-walk node index.
+
+    ``nodes(ast.Call)`` returns every Call in the tree (in walk order,
+    which is deterministic for a given source) without re-walking.
+    """
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.norm_path = path.replace(os.sep, "/")
+        self.waivers = Waivers(source)
+        self._index: Dict[type, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            self._index.setdefault(type(node), []).append(node)
+
+    def nodes(self, *types: type) -> List[ast.AST]:
+        if len(types) == 1:
+            return self._index.get(types[0], [])
+        out: List[ast.AST] = []
+        for t in types:
+            out.extend(self._index.get(t, []))
+        return out
+
+
+@dataclass
+class ParseFailure:
+    path: str
+    line: int
+    message: str
+
+
+def load_file(path: str) -> Tuple[Optional[FileInfo], Optional[ParseFailure]]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return None, ParseFailure(path, e.lineno or 0, e.msg or "syntax error")
+    return FileInfo(path, source, tree), None
+
+
+def iter_py_files(targets: Iterable[str]) -> Iterable[str]:
+    for t in targets:
+        if os.path.isfile(t):
+            yield t
+        else:
+            for root, dirs, files in os.walk(t):
+                dirs[:] = [d for d in dirs if d not in
+                           ("__pycache__", ".git", ".pytest_cache")]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def apply_waivers(
+    findings: Iterable[Finding],
+    infos_by_path: Dict[str, "FileInfo"],
+    extra_sources: Optional[Dict[str, str]] = None,
+) -> List[Finding]:
+    """Drop findings covered by a justified inline waiver.
+
+    ``extra_sources`` maps non-Python paths (YAML artifacts the contract
+    pass reports on) to their raw text so their ``#`` comments get the
+    same waiver treatment.
+    """
+    extra: Dict[str, Waivers] = {}
+    out: List[Finding] = []
+    for f in findings:
+        info = infos_by_path.get(f.path)
+        if info is not None:
+            if info.waivers.covers(f.line, f.code):
+                continue
+        elif extra_sources and f.path in extra_sources:
+            if f.path not in extra:
+                extra[f.path] = Waivers(extra_sources[f.path])
+            if extra[f.path].covers(f.line, f.code):
+                continue
+        out.append(f)
+    return out
+
+
+@dataclass
+class PassStats:
+    """--stats accounting: wall time and finding count per rule pass."""
+    name: str
+    seconds: float = 0.0
+    findings: int = 0
+    extras: Dict[str, int] = field(default_factory=dict)
+
+    def __str__(self):
+        extra = "".join(
+            f" {k}={v}" for k, v in sorted(self.extras.items())
+        )
+        return (
+            f"{self.name:<10} {self.seconds * 1000:8.1f} ms "
+            f"{self.findings:4d} finding(s){extra}"
+        )
+
+
+ALL_RULES: Set[str] = {
+    "F821", "F401", "E722", "F541", "B006", "E711", "B011",
+    "G004", "R001", "M001", "T001", "T002", "C001", "C002",
+}

@@ -1,0 +1,219 @@
+"""Suite driver: one parse pass, N rule passes, one sorted report.
+
+``run_suite`` is what both ``tools/lint.py`` (the ``make lint`` entry
+point) and the test-suite gates call.  It:
+
+1. parses every target file ONCE into :class:`FileInfo` records;
+2. runs the per-file rule families (F/E/B/G/R/M) through the shared
+   node index;
+3. runs the whole-program passes — T001/T002 over the operator
+   package, C001/C002 over the package + deploy/chart/bundle
+   artifacts;
+4. applies inline waivers centrally (Python comments and the YAML
+   artifacts' ``#`` comments alike) and reports bare waivers that
+   carry no justification;
+5. returns findings sorted by (path, line, code, message) — two runs
+   over the same tree produce byte-identical output (the determinism
+   gate in tests/test_lint.py holds the suite to this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import (
+    ALL_RULES,
+    FileInfo,
+    Finding,
+    ParseFailure,
+    PassStats,
+    apply_waivers,
+    iter_py_files,
+    load_file,
+)
+from . import contracts, local_rules, races
+
+DEFAULT_TARGETS = [
+    "tpu_network_operator",
+    "tests",
+    "tools",
+    "bench.py",
+    "__graft_entry__.py",
+]
+
+# whole-program passes only look at the package itself
+_RACE_SCOPE = "tpu_network_operator/"
+
+
+def _local_codes(enabled: Set[str]) -> Set[str]:
+    return enabled & {
+        "F821", "F401", "E722", "F541", "B006", "E711", "B011",
+        "G004", "R001", "M001",
+    }
+
+
+def run_suite(
+    targets: Sequence[str],
+    enabled: Optional[Set[str]] = None,
+    repo_root: Optional[str] = None,
+    collect_stats: bool = False,
+) -> Tuple[List[Finding], List[PassStats]]:
+    """Run every enabled rule family over ``targets``.
+
+    Returns ``(findings, stats)``; findings are already waiver-filtered
+    and sorted.  Parse failures surface as E999 findings so a broken
+    file fails the gate instead of silently dropping out of analysis.
+    """
+    enabled = set(enabled) if enabled is not None else set(ALL_RULES)
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+
+    stats: List[PassStats] = []
+    findings: List[Finding] = []
+
+    # -- pass 0: parse everything once
+    t0 = time.perf_counter()
+    infos: List[FileInfo] = []
+    failures: List[ParseFailure] = []
+    for path in iter_py_files(targets):
+        info, fail = load_file(path)
+        if fail is not None:
+            failures.append(fail)
+        else:
+            infos.append(info)
+    infos_by_path = {i.path: i for i in infos}
+    if collect_stats:
+        stats.append(PassStats(
+            "parse", time.perf_counter() - t0, len(failures),
+            {"files": len(infos)},
+        ))
+    for fail in failures:
+        findings.append(Finding(
+            fail.path, fail.line, "E999", fail.message,
+        ))
+
+    # -- per-file rule families
+    local = _local_codes(enabled)
+    if local:
+        t0 = time.perf_counter()
+        metric_help = (
+            local_rules.load_metric_help() if "M001" in local else None
+        )
+        n = 0
+        for info in infos:
+            got = local_rules.Checker(
+                info.path, info.tree, info.source,
+                metric_help=metric_help, info=info, enabled=local,
+            ).run()
+            findings.extend(got)
+            n += len(got)
+        if collect_stats:
+            stats.append(PassStats(
+                "local", time.perf_counter() - t0, n,
+                {"rules": len(local)},
+            ))
+
+    # -- T001/T002 race pass
+    if enabled & {"T001", "T002"}:
+        t0 = time.perf_counter()
+        n = 0
+        for info in infos:
+            if _RACE_SCOPE not in info.norm_path:
+                continue
+            got = [
+                f for f in races.check_file(info)
+                if f.code in enabled
+            ]
+            findings.extend(got)
+            n += len(got)
+        if collect_stats:
+            stats.append(PassStats(
+                "races", time.perf_counter() - t0, n,
+            ))
+
+    # -- C001 RBAC / C002 flag projection
+    extra_sources: Dict[str, str] = {}
+    if "C001" in enabled:
+        t0 = time.perf_counter()
+        got, sources, cstats = contracts.check_rbac(infos, repo_root)
+        extra_sources.update(sources)
+        findings.extend(got)
+        if collect_stats:
+            stats.append(PassStats(
+                "rbac", time.perf_counter() - t0, len(got), cstats,
+            ))
+    if "C002" in enabled:
+        t0 = time.perf_counter()
+        got = contracts.check_flag_projection(infos)
+        findings.extend(got)
+        if collect_stats:
+            stats.append(PassStats(
+                "flags", time.perf_counter() - t0, len(got),
+            ))
+
+    t0 = time.perf_counter()
+    pre = len(findings)
+    findings = apply_waivers(findings, infos_by_path, extra_sources)
+    if collect_stats:
+        stats.append(PassStats(
+            "waivers", time.perf_counter() - t0, len(findings),
+            {"suppressed": max(0, pre - len(findings))},
+        ))
+    findings.sort(key=lambda f: (f.path, f.line, f.code, f.message))
+    return findings, stats
+
+
+def parse_rule_arg(values: Iterable[str]) -> Set[str]:
+    out: Set[str] = set()
+    for v in values:
+        for code in v.split(","):
+            code = code.strip()
+            if not code:
+                continue
+            if code not in ALL_RULES:
+                raise SystemExit(
+                    f"unknown rule '{code}' "
+                    f"(known: {', '.join(sorted(ALL_RULES))})"
+                )
+            out.add(code)
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="tpu-network-operator whole-program analysis suite"
+    )
+    ap.add_argument("targets", nargs="*", default=None,
+                    help="files/dirs to analyze (default: repo tree)")
+    ap.add_argument("--rule", action="append", default=[],
+                    metavar="ID[,ID...]",
+                    help="run only these rule families (repeatable)")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-pass timing/finding counts")
+    args = ap.parse_args(argv)
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    targets = args.targets or [
+        os.path.join(repo_root, t) for t in DEFAULT_TARGETS
+        if os.path.exists(os.path.join(repo_root, t))
+    ]
+    enabled = parse_rule_arg(args.rule) if args.rule else None
+
+    findings, stats = run_suite(
+        targets, enabled=enabled, repo_root=repo_root,
+        collect_stats=args.stats,
+    )
+    for f in findings:
+        print(f)
+    if args.stats:
+        for s in stats:
+            print(s)
+    if findings:
+        print(f"{len(findings)} finding(s)")
+        return 1
+    return 0

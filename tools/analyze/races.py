@@ -1,0 +1,586 @@
+"""T001/T002 — lock-discipline race detection.
+
+The controller/agent web runs ~15 thread spawns against ~21
+``threading.Lock``s; the two bug classes no test reliably catches are
+(a) a guarded attribute mutated on some path that skips the lock and
+(b) a user callback invoked while a lock is held (deadlock / reentrancy
+fuel — the repeated "notify listeners outside the lock" review fix).
+This pass infers both from the AST, class by class:
+
+1. **Guard map** — an attribute of ``self`` read or written inside a
+   ``with self.<lock>:`` body is *guarded* (the class's own locking
+   discipline is the spec; no annotations needed).  ``<lock>`` is any
+   attribute assigned ``threading.Lock()/RLock()/Condition()`` or used
+   as a ``with`` context whose name contains ``lock``/``cv``/``cond``.
+2. **Thread roots** — methods (or method-local closures) that can run
+   on another thread: ``threading.Thread(target=...)`` / ``Timer``
+   targets, ``run()`` on Thread subclasses, and methods that *escape*
+   as callbacks (``self.m`` passed as an argument or stored without
+   being called — listener registration, workqueue handlers, informer
+   callbacks).  The implicit ``main`` root reaches every public method.
+3. **Reachability** — intra-class call graph over ``self.m()`` edges
+   (plus local-closure calls).  A write site reachable from >= 2
+   distinct roots can genuinely race.
+
+**T001** fires on an unlocked mutation (assign / augment / del /
+mutating container-method call) of a guarded attribute at such a site.
+``__init__`` is exempt (single-threaded construction), as are methods
+whose name ends in ``_locked`` (the repo convention for
+"caller holds the lock").
+
+**T002** fires on a call made while a lock is held whose callee is
+listener-shaped: an element of a listeners/callbacks/hooks/handlers/
+subscribers collection on ``self`` (direct subscript call, loop
+variable, or snapshot taken *inside* the lock), or a ``self`` attribute
+named like a hook (``*_callback``/``*_hook``/``*_listener``/``on_*``).
+
+Both rules honor the inline ``# tpunet: allow=T00x <reason>`` waiver
+(reason text required — see core.Waivers).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import FileInfo, Finding
+
+LOCKISH_NAME = re.compile(r"(^|_)(lock|mutex|cv|cond(ition)?)($|_)|lock$")
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+LISTENERISH = re.compile(
+    r"(listener|callback|hook|subscriber|observer)s?$"
+)
+HOOK_ATTR = re.compile(
+    r"(^on_[a-z0-9_]+$)|(_(callback|hook|listener|cb)$)"
+)
+
+# container methods that mutate the receiver in place
+MUTATORS = {
+    "append", "extend", "insert", "add", "update", "setdefault",
+    "remove", "discard", "pop", "popitem", "clear", "appendleft",
+    "popleft", "sort", "reverse",
+}
+# dict/set reads that look like calls but do not mutate — excluded so
+# `self._cache.get(k)` under no lock is a read, not a T001 write
+NON_MUTATING = {"get", "keys", "values", "items", "copy", "count", "index"}
+
+MAIN_ROOT = "<main>"
+
+
+def _is_self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+@dataclass
+class Access:
+    attr: str
+    node: ast.AST
+    write: bool
+    lock: Optional[str]          # lock attr held (innermost), or None
+
+
+@dataclass
+class MethodFacts:
+    name: str                    # "method" or "method.<local>"
+    node: ast.AST
+    accesses: List[Access] = field(default_factory=list)
+    calls: Set[str] = field(default_factory=set)       # self.m() edges
+    local_calls: Set[str] = field(default_factory=set)  # bare-name calls
+    call_edges: List[Tuple[str, Optional[str]]] = field(
+        default_factory=list
+    )   # (callee, lock held at the call site) — for lock propagation
+    escapes: Set[str] = field(default_factory=set)     # self.m refs not called
+    thread_targets: Set[str] = field(default_factory=set)
+    callback_calls: List[Tuple[ast.AST, str, str]] = field(
+        default_factory=list
+    )   # (node, lock, description) — calls made while a lock is held
+
+
+class _MethodScanner(ast.NodeVisitor):
+    """One pass over a method body collecting accesses, call edges,
+    escapes and thread targets, tracking the lexical lock stack."""
+
+    def __init__(self, facts: MethodFacts, lock_attrs: Set[str],
+                 local_fn_names: Set[str]):
+        self.facts = facts
+        self.lock_attrs = lock_attrs
+        self.local_fn_names = local_fn_names
+        self.lock_stack: List[str] = []
+        # names bound (inside the current lock region) from listener
+        # collections: `cbs = list(self._listeners)` / `for cb in ...`
+        self.listener_names: Set[str] = set()
+
+    # -- lock tracking --------------------------------------------------------
+
+    def _lock_of_withitem(self, item: ast.withitem) -> Optional[str]:
+        ctx = item.context_expr
+        # `with self._lock:` and `with self._cv:` both guard
+        attr = _is_self_attr(ctx)
+        if attr is not None and (
+            attr in self.lock_attrs or LOCKISH_NAME.search(attr)
+        ):
+            return attr
+        return None
+
+    def visit_With(self, node: ast.With):
+        locks = []
+        for item in node.items:
+            held = self._lock_of_withitem(item)
+            if held is not None:
+                locks.append(held)
+        for item in node.items:
+            self.visit(item.context_expr)
+        self.lock_stack.extend(locks)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in locks:
+            self.lock_stack.pop()
+        if locks:
+            # listener snapshots taken under the lock stay "hot" only
+            # within the lock; once released, calling them is fine
+            self.listener_names.clear()
+
+    def _held(self) -> Optional[str]:
+        return self.lock_stack[-1] if self.lock_stack else None
+
+    # -- nested scopes: local closures are separate graph nodes ---------------
+
+    def visit_FunctionDef(self, node):
+        # handled by ClassFacts (flattened as method.<local>); record the
+        # definition site only
+        self.facts.local_calls.add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        # lambda bodies run later on whatever thread calls them; their
+        # self.m references are escapes, not direct calls
+        for sub in ast.walk(node.body):
+            attr = _is_self_attr(sub)
+            if attr is not None and isinstance(sub.ctx, ast.Load):
+                self.facts.escapes.add(attr)
+
+    # -- accesses -------------------------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = _is_self_attr(node)
+        if attr is not None:
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                self.facts.accesses.append(
+                    Access(attr, node, True, self._held())
+                )
+            elif isinstance(node.ctx, ast.Load):
+                self.facts.accesses.append(
+                    Access(attr, node, False, self._held())
+                )
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._subscript_writes([node.target])
+        attr = _is_self_attr(node.target)
+        if attr is not None:
+            # AugAssign target ctx is Store; the read side is implicit —
+            # record it so `self.n += 1` counts as read+write
+            self.facts.accesses.append(
+                Access(attr, node, False, self._held())
+            )
+        self.generic_visit(node)
+
+    def _subscript_writes(self, targets) -> None:
+        """`self.x[k] = v` / `del self.x[k]` mutate the container but
+        the Attribute node's ctx is Load — record the write here."""
+        held = self._held()
+        stack = list(targets)
+        while stack:
+            t = stack.pop()
+            if isinstance(t, (ast.Tuple, ast.List)):
+                stack.extend(t.elts)
+            elif isinstance(t, ast.Starred):
+                stack.append(t.value)
+            elif isinstance(t, ast.Subscript):
+                attr = _is_self_attr(t.value)
+                if attr is not None:
+                    self.facts.accesses.append(
+                        Access(attr, t, True, held)
+                    )
+
+    def visit_Delete(self, node: ast.Delete):
+        self._subscript_writes(node.targets)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        self._subscript_writes(node.targets)
+        # listener snapshot under the lock: `cbs = list(self._listeners)`
+        held = self._held()
+        if held is not None and len(node.targets) == 1 and isinstance(
+            node.targets[0], ast.Name
+        ):
+            src = node.value
+            if isinstance(src, ast.Call) and isinstance(src.func, ast.Name) \
+                    and src.func.id in ("list", "tuple", "sorted") \
+                    and src.args:
+                src = src.args[0]
+            attr = _is_self_attr(src)
+            if attr is not None and LISTENERISH.search(attr):
+                self.listener_names.add(node.targets[0].id)
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For):
+        held = self._held()
+        if held is not None and isinstance(node.target, ast.Name):
+            it = node.iter
+            if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                    and it.func.id in ("list", "tuple", "sorted") and it.args:
+                it = it.args[0]
+            attr = _is_self_attr(it)
+            if attr is not None and LISTENERISH.search(attr):
+                self.listener_names.add(node.target.id)
+        self.generic_visit(node)
+
+    # -- calls ----------------------------------------------------------------
+
+    def _thread_target_of(self, node: ast.Call) -> List[ast.AST]:
+        """Callables handed to threading.Thread/Timer — run on another
+        thread."""
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else ""
+        )
+        out: List[ast.AST] = []
+        if name in ("Thread", "Timer"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    out.append(kw.value)
+            if name == "Timer" and len(node.args) >= 2:
+                out.append(node.args[1])
+        return out
+
+    def visit_Call(self, node: ast.Call):
+        held = self._held()
+        fn = node.func
+
+        # thread spawn targets
+        for tgt in self._thread_target_of(node):
+            attr = _is_self_attr(tgt)
+            if attr is not None:
+                self.facts.thread_targets.add(attr)
+            elif isinstance(tgt, ast.Name) and tgt.id in self.local_fn_names:
+                self.facts.thread_targets.add(
+                    f"{self.facts.name.split('.')[0]}.{tgt.id}"
+                )
+
+        attr = _is_self_attr(fn)
+        if attr is not None:
+            # self.m(...) — call edge; self.attr.mutator(...) — mutation
+            self.facts.calls.add(attr)
+            self.facts.call_edges.append((attr, held))
+            if held is not None and HOOK_ATTR.search(attr):
+                self.facts.callback_calls.append(
+                    (node, held, f"self.{attr}(...)")
+                )
+        elif isinstance(fn, ast.Attribute):
+            recv_attr = _is_self_attr(fn.value)
+            if recv_attr is not None and fn.attr in MUTATORS:
+                self.facts.accesses.append(
+                    Access(recv_attr, node, True, held)
+                )
+            elif recv_attr is not None and fn.attr not in NON_MUTATING:
+                # self.attr.method() — reading the container
+                self.facts.accesses.append(
+                    Access(recv_attr, node, False, held)
+                )
+        elif isinstance(fn, ast.Name):
+            if fn.id in self.local_fn_names:
+                self.facts.local_calls.add(fn.id)
+                self.facts.call_edges.append((fn.id, held))
+            if held is not None and fn.id in self.listener_names:
+                self.facts.callback_calls.append(
+                    (node, held, f"{fn.id}(...) from a listener collection")
+                )
+        elif isinstance(fn, ast.Subscript):
+            # self._callbacks[kind](...) under the lock
+            sattr = _is_self_attr(fn.value)
+            if held is not None and sattr is not None \
+                    and LISTENERISH.search(sattr):
+                self.facts.callback_calls.append(
+                    (node, held, f"self.{sattr}[...](...)")
+                )
+
+        # self.m passed as an argument = escape (callback registration)
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            a = _is_self_attr(arg)
+            if a is not None:
+                self.facts.escapes.add(a)
+
+        self.generic_visit(node)
+
+
+class ClassFacts:
+    """Guard map + call graph + roots for one class."""
+
+    def __init__(self, cls: ast.ClassDef, path: str):
+        self.cls = cls
+        self.path = path
+        self.lock_attrs: Set[str] = set()
+        self.methods: Dict[str, MethodFacts] = {}
+        self.is_thread_subclass = any(
+            (isinstance(b, ast.Name) and b.id == "Thread")
+            or (isinstance(b, ast.Attribute) and b.attr == "Thread")
+            for b in cls.bases
+        )
+        self._collect_locks()
+        self._scan_methods()
+
+    def _collect_locks(self):
+        for node in ast.walk(self.cls):
+            # self._lock = threading.Lock() / Lock() / RLock() / Condition()
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                fn = node.value.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else ""
+                )
+                if name in LOCK_FACTORIES:
+                    for t in node.targets:
+                        attr = _is_self_attr(t)
+                        if attr is not None:
+                            self.lock_attrs.add(attr)
+            # `with self.<lockish>:` names count even without seeing the
+            # factory (lock created by a parent class / passed in)
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    attr = _is_self_attr(item.context_expr)
+                    if attr is not None and LOCKISH_NAME.search(attr):
+                        self.lock_attrs.add(attr)
+
+    def _scan_methods(self):
+        for stmt in self.cls.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            self._scan_one(stmt.name, stmt)
+            # method-local closures become their own graph nodes
+            # (thread bodies are usually `def loop(): ...` locals)
+            for sub in stmt.body:
+                for local in ast.walk(sub):
+                    if isinstance(
+                        local, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ) and local is not stmt:
+                        self._scan_one(f"{stmt.name}.{local.name}", local)
+
+    def _scan_one(self, qual: str, node):
+        local_names = {
+            n.name for n in ast.walk(node)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not node
+        }
+        facts = MethodFacts(qual, node)
+        scanner = _MethodScanner(facts, self.lock_attrs, local_names)
+        for stmt in node.body:
+            # do not descend into local defs here; they are scanned as
+            # their own nodes
+            scanner.visit(stmt) if not isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) else facts.local_calls.add(stmt.name)
+        self.methods[qual] = facts
+
+    # -- analysis -------------------------------------------------------------
+
+    def guarded_attrs(
+        self, eff: Optional[Dict[str, Optional[str]]] = None,
+    ) -> Dict[str, Set[str]]:
+        """attr -> set of locks it was accessed under (write-anywhere-
+        under-lock marks the attr guarded; read-only-under-lock attrs
+        are included too, per the guard-map definition).  ``eff`` folds
+        in caller-held locks for always-locked helpers."""
+        eff = eff or {}
+        out: Dict[str, Set[str]] = {}
+        for name, m in self.methods.items():
+            for acc in m.accesses:
+                lock = acc.lock if acc.lock is not None else eff.get(name)
+                if lock is not None and acc.attr not in self.lock_attrs:
+                    out.setdefault(acc.attr, set()).add(lock)
+        return out
+
+    def explicit_roots(self) -> Set[str]:
+        """Thread targets, escaped callbacks and Thread.run — entry
+        points invoked from OUTSIDE the class's own call graph."""
+        explicit: Set[str] = set()
+        for m in self.methods.values():
+            for t in m.thread_targets:
+                if t in self.methods:
+                    explicit.add(t)
+            for e in m.escapes:
+                if e in self.methods:
+                    explicit.add(e)
+        if self.is_thread_subclass and "run" in self.methods:
+            explicit.add("run")
+        return explicit
+
+    def _resolve_edge(self, caller: str, callee: str) -> Optional[str]:
+        if callee in self.methods:
+            return callee
+        base = caller.split(".")[0]
+        if f"{base}.{callee}" in self.methods:
+            return f"{base}.{callee}"
+        if f"{caller}.{callee}" in self.methods:
+            return f"{caller}.{callee}"
+        return None
+
+    def effective_locks(self) -> Dict[str, Optional[str]]:
+        """method -> lock provably held on EVERY entry (every in-class
+        call site acquires it, and the method is not independently
+        callable from outside), else None.  Generalizes the
+        ``*_locked`` naming convention to inferred call-site facts:
+        a private helper only ever invoked from ``with self._lock:``
+        bodies is as guarded as its callers."""
+        explicit = self.explicit_roots()
+        # incoming edges with the lock held at each call site
+        incoming: Dict[str, List[Tuple[str, Optional[str]]]] = {}
+        for caller, m in self.methods.items():
+            for callee, lock in m.call_edges:
+                q = self._resolve_edge(caller, callee)
+                if q is not None:
+                    incoming.setdefault(q, []).append((caller, lock))
+
+        eff: Dict[str, Optional[str]] = {n: None for n in self.methods}
+        for _ in range(4):   # short fixpoint: caller chains are shallow
+            changed = False
+            for name in self.methods:
+                top = name.split(".")[0]
+                if name in explicit or top in explicit:
+                    continue   # runs on its own thread — no inherited lock
+                if not top.startswith("_") or (
+                    top.startswith("__") and top.endswith("__")
+                        and top != "__init__"):
+                    continue   # public API — callable without the lock
+                edges = incoming.get(name)
+                if not edges:
+                    continue
+                locks = set()
+                for caller, lock in edges:
+                    locks.add(lock if lock is not None else eff[caller])
+                if len(locks) == 1 and None not in locks:
+                    lock = locks.pop()
+                    if eff[name] != lock:
+                        eff[name] = lock
+                        changed = True
+            if not changed:
+                break
+        return eff
+
+    def roots(self) -> Dict[str, Set[str]]:
+        """method -> set of distinct thread roots that reach it."""
+        explicit = self.explicit_roots()
+
+        edges: Dict[str, Set[str]] = {}
+        for name, m in self.methods.items():
+            targets = set()
+            for c in m.calls:
+                if c in self.methods:
+                    targets.add(c)
+            base = name.split(".")[0]
+            for lc in m.local_calls:
+                q = f"{base}.{lc}" if "." not in lc else lc
+                if q in self.methods:
+                    targets.add(q)
+                elif f"{name}.{lc}" in self.methods:
+                    targets.add(f"{name}.{lc}")
+            edges[name] = targets
+
+        def reach(start: str) -> Set[str]:
+            seen = {start}
+            stack = [start]
+            while stack:
+                cur = stack.pop()
+                for nxt in edges.get(cur, ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+            return seen
+
+        result: Dict[str, Set[str]] = {n: set() for n in self.methods}
+        for root in explicit:
+            for n in reach(root):
+                result[n].add(root)
+        # the implicit main root: public entry points (constructors
+        # excluded — single-threaded by construction)
+        for name in self.methods:
+            top = name.split(".")[0]
+            if top.startswith("_") and not (
+                top.startswith("__") and top.endswith("__")
+            ):
+                continue
+            if top in ("__init__", "__del__", "__enter__", "__exit__"):
+                continue
+            for n in reach(name):
+                result[n].add(MAIN_ROOT)
+        return result
+
+
+def check_file(info: FileInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls in info.nodes(ast.ClassDef):
+        facts = ClassFacts(cls, info.path)
+        if not facts.lock_attrs:
+            continue
+        eff = facts.effective_locks()
+        guarded = facts.guarded_attrs(eff)
+        roots = facts.roots()
+
+        # a data race needs the ATTRIBUTE reachable from >=2 distinct
+        # roots (across all its accessor methods), not the mutating
+        # method itself — `add()` called only from main still races
+        # against a worker loop appending under the lock
+        attr_roots: Dict[str, Set[str]] = {}
+        for mname, m in facts.methods.items():
+            for acc in m.accesses:
+                attr_roots.setdefault(acc.attr, set()).update(
+                    roots.get(mname, set())
+                )
+
+        for mname, m in facts.methods.items():
+            top = mname.split(".")[0]
+            if top == "__init__" and "." not in mname:
+                continue   # single-threaded construction
+            if top.endswith("_locked") or mname.endswith("_locked"):
+                continue   # repo convention: caller holds the lock
+            for acc in m.accesses:
+                if not acc.write or acc.lock is not None:
+                    continue
+                if eff.get(mname) is not None:
+                    continue   # every caller enters with the lock held
+                locks = guarded.get(acc.attr)
+                if not locks:
+                    continue
+                aroots = attr_roots.get(acc.attr, set())
+                if len(aroots) < 2:
+                    continue
+                others = sorted(r for r in aroots if r != MAIN_ROOT)
+                findings.append(Finding(
+                    info.path, getattr(acc.node, "lineno", 0), "T001",
+                    f"'{cls.name}.{acc.attr}' is guarded by "
+                    f"'self.{sorted(locks)[0]}' elsewhere but mutated "
+                    f"without it in '{mname}' (attr reachable from "
+                    f"thread roots: {', '.join(others) or MAIN_ROOT}"
+                    f"{' + main' if MAIN_ROOT in aroots else ''})",
+                ))
+
+        for mname, m in facts.methods.items():
+            for node, lock, desc in m.callback_calls:
+                findings.append(Finding(
+                    info.path, getattr(node, "lineno", 0), "T002",
+                    f"user callback {desc} invoked while "
+                    f"'self.{lock}' is held in '{cls.name}.{mname}'; "
+                    f"snapshot under the lock, call after release",
+                ))
+    return findings

@@ -1,670 +1,55 @@
 #!/usr/bin/env python3
-"""Static analysis gate (`make lint`).
+"""Static analysis gate (`make lint`) — compatibility entry point.
 
-The reference gates CI on 19 golangci linters
-(`/root/reference/.golangci.yml:24-44`); the Python toolchain baked into
-this environment has neither ruff nor mypy, so this is a from-scratch
-AST checker covering the highest-signal subset:
+The checker grew from a single-file AST linter into the whole-program
+suite under ``tools/analyze/``:
 
-  F821  undefined name (scope-aware: module/function/class/comprehension,
-        global/nonlocal, wildcard-import poisoning)
-  F401  unused import (module scope; `__init__.py` re-exports and
-        `__all__` entries excluded)
-  E722  bare `except:`
-  F541  f-string without placeholders
-  B006  mutable default argument (list/dict/set literal)
-  E711  comparison to None with ==/!=
-  B011  assert on a non-empty tuple literal (always true)
-  G004  f-string-interpolated log call (`log.info(f"...")`) in
-        controller/, agent/, obs/, probe/ and kube/ — those records
-        must stay structured (%-style lazy args) so the JSON formatter
-        and log aggregation keep a stable message template; also skips
-        interpolation cost on disabled levels
-  R001  ad-hoc retry loop catching the base `ApiError` (a swallowing
-        `except ApiError` handler inside a retry-shaped loop: `while`
-        or `for _ in range(n)`) anywhere in the package outside
-        kube/retry.py — retry policy (backoff, jitter, Retry-After,
-        budgets, metrics) is centralized in kube.retry.RetryingClient;
-        scattered blind-retry loops hide outages, hammer a throttling
-        apiserver, and dodge the tpunet_client_* accounting.  Handlers
-        that give up instead of re-attempting (raise / break / return),
-        handlers catching specific subclasses (NotFoundError,
-        ConflictError, ...), and per-item fan-out over a collection
-        (`for item in batch`) are NOT retry policy and stay allowed.
-  M001  metric family registered via health.Metrics without a
-        METRIC_HELP entry (controller/health.py).  Scrapers warn on
-        TYPE without HELP and the table was previously maintained by
-        convention only; the rule makes it enforced.  A "registration"
-        is a string literal starting with `tpunet_` passed as the
-        first argument to `.inc()`/`.set_gauge()`/`.observe()`/
-        `.remove_gauge()`/`.remove_matching()`, or an element of a
-        module-level tuple/list whose members are ALL such names (the
-        POLICY_GAUGES-style family lists the retraction sweeps drive).
-        Scoped to the package — tests/tools assert on names the
-        package must already register.
+* per-file rules (F821, F401, E722, F541, B006, E711, B011, G004,
+  R001, M001) — ``analyze.local_rules``;
+* T001/T002 lock-discipline race detection — ``analyze.races``;
+* C001 RBAC cross-artifact consistency and C002 agent flag projection
+  — ``analyze.contracts``;
+* the suite driver with ``--rule <id>`` / ``--stats`` —
+  ``analyze.driver``.
 
-Zero third-party dependencies; exits 1 on any finding.  Run as
-`python tools/lint.py [paths...]` (defaults to the package, tests, tools
-and the repo-root entry points).
+This module re-exports the public surface so ``make lint``,
+``python tools/lint.py`` and the imports in ``tests/test_lint.py``
+keep working unchanged.  See the "Static analysis" section of
+``CONTRIBUTING.md`` for the rule table and waiver policy
+(``# tpunet: allow=<RULE> <reason>``).
 """
 
-from __future__ import annotations
-
-import ast
-import builtins
-import os
 import sys
-from dataclasses import dataclass, field
 from typing import List, Optional, Set
 
-DEFAULT_TARGETS = [
-    "tpu_network_operator",
-    "tests",
-    "tools",
-    "bench.py",
-    "__graft_entry__.py",
+__all__ = [
+    "ALL_RULES", "Checker", "DEFAULT_TARGETS", "FileInfo", "Finding",
+    "STRUCTURED_LOG_DIRS", "Waivers", "iter_py_files", "lint_file",
+    "load_metric_help", "main", "run_suite",
 ]
 
-# G004 scope: the log streams the obs/ JSON formatter structures — an
-# f-string log call pre-interpolates the template away.  Every package
-# whose records reach the operator/agent processes is in scope (obs/,
-# probe/ and kube/ all log through those same handlers).
-STRUCTURED_LOG_DIRS = (
-    "tpu_network_operator/controller",
-    "tpu_network_operator/agent",
-    "tpu_network_operator/obs",
-    "tpu_network_operator/probe",
-    "tpu_network_operator/kube",
+from analyze import (        # noqa: F401
+    ALL_RULES,
+    Checker,
+    DEFAULT_TARGETS,
+    FileInfo,
+    Finding,
+    STRUCTURED_LOG_DIRS,
+    Waivers,
+    iter_py_files,
+    load_metric_help,
+    main,
+    run_suite,
 )
-LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical"}
-LOGGER_NAMES = {"log", "logger", "logging"}
-
-BUILTINS = set(dir(builtins)) | {
-    "__file__", "__name__", "__doc__", "__package__", "__spec__",
-    "__loader__", "__builtins__", "__debug__", "__path__", "__all__",
-    "__version__", "__class__",   # implicit cell in methods using super()
-}
-
-
-@dataclass
-class Finding:
-    path: str
-    line: int
-    code: str
-    message: str
-
-    def __str__(self):
-        return f"{self.path}:{self.line}: {self.code} {self.message}"
-
-
-@dataclass
-class Scope:
-    kind: str                      # "module" | "function" | "class" | "comp"
-    bindings: Set[str] = field(default_factory=set)
-    globals_decl: Set[str] = field(default_factory=set)
-    has_star_import: bool = False
-
-
-class _BindingCollector(ast.NodeVisitor):
-    """Collect every name bound anywhere in one scope body (order-blind:
-    we check existence, not use-before-def, trading completeness for zero
-    false positives on forward references)."""
-
-    def __init__(self):
-        self.names: Set[str] = set()
-        self.star = False
-
-    def _bind_target(self, t):
-        if isinstance(t, ast.Name):
-            self.names.add(t.id)
-        elif isinstance(t, (ast.Tuple, ast.List)):
-            for e in t.elts:
-                self._bind_target(e)
-        elif isinstance(t, ast.Starred):
-            self._bind_target(t.value)
-
-    def visit_Assign(self, node):
-        for t in node.targets:
-            self._bind_target(t)
-        self.generic_visit(node)
-
-    def visit_AnnAssign(self, node):
-        self._bind_target(node.target)
-        self.generic_visit(node)
-
-    def visit_AugAssign(self, node):
-        self._bind_target(node.target)
-        self.generic_visit(node)
-
-    def visit_NamedExpr(self, node):   # walrus binds in the nearest fn scope
-        self._bind_target(node.target)
-        self.generic_visit(node)
-
-    def visit_For(self, node):
-        self._bind_target(node.target)
-        self.generic_visit(node)
-
-    visit_AsyncFor = visit_For
-
-    def visit_withitem(self, node):
-        if node.optional_vars is not None:
-            self._bind_target(node.optional_vars)
-        self.generic_visit(node)
-
-    def visit_ExceptHandler(self, node):
-        if node.name:
-            self.names.add(node.name)
-        self.generic_visit(node)
-
-    def visit_Import(self, node):
-        for a in node.names:
-            self.names.add((a.asname or a.name).split(".")[0])
-
-    def visit_ImportFrom(self, node):
-        for a in node.names:
-            if a.name == "*":
-                self.star = True
-            else:
-                self.names.add(a.asname or a.name)
-
-    def visit_Global(self, node):
-        self.names.update(node.names)
-
-    def visit_Nonlocal(self, node):
-        self.names.update(node.names)
-
-    def visit_MatchAs(self, node):
-        if node.name:
-            self.names.add(node.name)
-        self.generic_visit(node)
-
-    def visit_MatchStar(self, node):
-        if node.name:
-            self.names.add(node.name)
-        self.generic_visit(node)
-
-    def visit_MatchMapping(self, node):
-        if node.rest:
-            self.names.add(node.rest)
-        self.generic_visit(node)
-
-    def visit_FunctionDef(self, node):
-        self.names.add(node.name)
-        # decorators/defaults/annotations evaluate in THIS scope
-        for d in node.decorator_list:
-            self.generic_visit(d)
-        for d in list(node.args.defaults) + [
-            d for d in node.args.kw_defaults if d is not None
-        ]:
-            self.generic_visit(d)
-        # body is its own scope: do not descend
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def visit_ClassDef(self, node):
-        self.names.add(node.name)
-        for d in node.decorator_list + node.bases + [
-            k.value for k in node.keywords
-        ]:
-            self.generic_visit(d)
-        # body is its own scope
-
-    def visit_Lambda(self, node):
-        for d in list(node.args.defaults) + [
-            d for d in node.args.kw_defaults if d is not None
-        ]:
-            self.generic_visit(d)
-        # body is its own scope
-
-    def _comp(self, node):
-        # py3 comprehensions are their own scope; only the first
-        # iterable evaluates here
-        self.generic_visit(node.generators[0].iter)
-
-    visit_ListComp = visit_SetComp = visit_DictComp = visit_GeneratorExp = _comp
-
-
-def _arg_names(args: ast.arguments) -> Set[str]:
-    names = set()
-    for a in (
-        list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
-    ):
-        names.add(a.arg)
-    if args.vararg:
-        names.add(args.vararg.arg)
-    if args.kwarg:
-        names.add(args.kwarg.arg)
-    return names
-
-
-class Checker:
-    def __init__(self, path: str, tree: ast.Module, source: str,
-                 metric_help: Optional[Set[str]] = None):
-        self.path = path
-        self.tree = tree
-        self.source = source
-        self.findings: List[Finding] = []
-        self.is_init = os.path.basename(path) == "__init__.py"
-        norm = path.replace(os.sep, "/")
-        self.check_log_fstrings = any(
-            d in norm for d in STRUCTURED_LOG_DIRS
-        )
-        # R001 scope: the whole operator package except the one module
-        # that IS the retry policy
-        self.check_retry_loops = (
-            "tpu_network_operator" in norm
-            and not norm.endswith("kube/retry.py")
-        )
-        # M001 scope: package files only, and only when the caller
-        # resolved the METRIC_HELP table (None = rule off — ad-hoc
-        # single-file runs outside a repo checkout stay usable)
-        self.metric_help = metric_help
-        self.check_metric_help = (
-            metric_help is not None and "tpu_network_operator" in norm
-        )
-
-    def report(self, node, code, message):
-        self.findings.append(
-            Finding(self.path, getattr(node, "lineno", 0), code, message)
-        )
-
-    # -- driver ---------------------------------------------------------------
-
-    def run(self) -> List[Finding]:
-        module_scope = self._scope_of("module", self.tree.body)
-        self._check_body(self.tree.body, [module_scope])
-        self._check_unused_imports()
-        # format specs ({x:.1f}) parse as nested JoinedStr with only
-        # constant parts — they are not user f-strings, exclude from F541
-        self._format_specs = {
-            id(node.format_spec)
-            for node in ast.walk(self.tree)
-            if isinstance(node, ast.FormattedValue)
-            and node.format_spec is not None
-        }
-        for node in ast.walk(self.tree):
-            self._check_misc(node)
-        self._check_retry_loops()
-        self._check_metric_families()
-        return self.findings
-
-    def _scope_of(self, kind: str, body, extra: Optional[Set[str]] = None):
-        coll = _BindingCollector()
-        for stmt in body:
-            coll.visit(stmt)
-        scope = Scope(kind=kind, bindings=coll.names | (extra or set()))
-        scope.has_star_import = coll.star
-        return scope
-
-    # -- undefined names (F821) ----------------------------------------------
-
-    def _lookup(self, name: str, stack: List[Scope]) -> bool:
-        if name in BUILTINS:
-            return True
-        for scope in reversed(stack):
-            # class scopes are invisible to nested functions, but we are
-            # order-blind anyway; skipping them only when they are not
-            # the innermost scope matches the runtime rule
-            if scope.kind == "class" and scope is not stack[-1]:
-                continue
-            if name in scope.bindings or scope.has_star_import:
-                return True
-        return False
-
-    def _check_body(self, body, stack: List[Scope]):
-        for stmt in body:
-            self._check_stmt(stmt, stack)
-
-    def _check_stmt(self, stmt, stack: List[Scope]):
-        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for d in stmt.decorator_list:
-                self._check_names_shallow(d, stack)
-            inner = self._scope_of(
-                "function", stmt.body, extra=_arg_names(stmt.args)
-            )
-            self._check_body(stmt.body, stack + [inner])
-        elif isinstance(stmt, ast.ClassDef):
-            for d in stmt.decorator_list + stmt.bases:
-                self._check_names_shallow(d, stack)
-            inner = self._scope_of("class", stmt.body)
-            self._check_body(stmt.body, stack + [inner])
-        else:
-            self._check_names_shallow(stmt, stack)
-            for child in ast.iter_child_nodes(stmt):
-                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                      ast.ClassDef)):
-                    self._check_stmt(child, stack)
-                elif hasattr(child, "body") and isinstance(
-                    getattr(child, "body"), list
-                ):
-                    # nested blocks (if/for/while/try/with) share the scope
-                    self._check_stmt_block(child, stack)
-
-    def _check_stmt_block(self, node, stack):
-        for name in ("body", "orelse", "finalbody"):
-            for sub in getattr(node, name, []) or []:
-                self._check_stmt(sub, stack)
-        for h in getattr(node, "handlers", []) or []:
-            self._check_stmt_block(h, stack)
-
-    def _check_names_shallow(self, node, stack: List[Scope]):
-        """Check Load-names in this statement, descending into nested
-        scopes (lambda/comprehension) with extended stacks but NOT into
-        nested statement lists (handled by _check_stmt)."""
-        skip_bodies = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
-
-        def walk(n, stack):
-            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
-                if not self._lookup(n.id, stack):
-                    self.report(n, "F821", f"undefined name '{n.id}'")
-                return
-            if isinstance(n, ast.Lambda):
-                inner = Scope("function", _arg_names(n.args))
-                coll = _BindingCollector()
-                coll.visit(n.body)
-                inner.bindings |= coll.names
-                for d in list(n.args.defaults) + [
-                    d for d in n.args.kw_defaults if d is not None
-                ]:
-                    walk(d, stack)
-                walk(n.body, stack + [inner])
-                return
-            if isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
-                              ast.GeneratorExp)):
-                inner = Scope("comp")
-                for gen in n.generators:
-                    coll = _BindingCollector()
-                    coll._bind_target(gen.target)
-                    inner.bindings |= coll.names
-                walk(n.generators[0].iter, stack)
-                new_stack = stack + [inner]
-                for gen in n.generators:
-                    if gen is not n.generators[0]:
-                        walk(gen.iter, new_stack)
-                    for cond in gen.ifs:
-                        walk(cond, new_stack)
-                if isinstance(n, ast.DictComp):
-                    walk(n.key, new_stack)
-                    walk(n.value, new_stack)
-                else:
-                    walk(n.elt, new_stack)
-                return
-            if isinstance(n, skip_bodies):
-                return
-            if isinstance(n, ast.stmt) and hasattr(n, "body") and n is not node:
-                return   # nested statement blocks handled by _check_stmt
-            for child in ast.iter_child_nodes(n):
-                walk(child, stack)
-
-        walk(node, stack)
-
-    # -- unused imports (F401) -----------------------------------------------
-
-    def _check_unused_imports(self):
-        if self.is_init:
-            return   # __init__.py imports are the public re-export surface
-        imported = {}   # name -> node
-        for stmt in self.tree.body:
-            if isinstance(stmt, ast.Import):
-                for a in stmt.names:
-                    imported[(a.asname or a.name).split(".")[0]] = stmt
-            elif isinstance(stmt, ast.ImportFrom):
-                if stmt.module == "__future__":
-                    continue
-                for a in stmt.names:
-                    if a.name != "*":
-                        imported[a.asname or a.name] = stmt
-        if not imported:
-            return
-        used: Set[str] = set()
-        for node in ast.walk(self.tree):
-            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
-                used.add(node.id)
-            elif isinstance(node, ast.Attribute):
-                base = node
-                while isinstance(base, ast.Attribute):
-                    base = base.value
-                if isinstance(base, ast.Name):
-                    used.add(base.id)
-        # names re-exported via __all__ count as used
-        for node in ast.walk(self.tree):
-            if (
-                isinstance(node, ast.Assign)
-                and any(
-                    isinstance(t, ast.Name) and t.id == "__all__"
-                    for t in node.targets
-                )
-                and isinstance(node.value, (ast.List, ast.Tuple))
-            ):
-                for elt in node.value.elts:
-                    if isinstance(elt, ast.Constant) and isinstance(
-                        elt.value, str
-                    ):
-                        used.add(elt.value)
-        # strings in annotations may reference imports (from __future__)
-        for node in ast.walk(self.tree):
-            if isinstance(node, ast.Constant) and isinstance(node.value, str):
-                for name in imported:
-                    if name in node.value:
-                        used.add(name)
-        for name, node in sorted(imported.items()):
-            if name not in used:
-                self.report(node, "F401", f"'{name}' imported but unused")
-
-    # -- ad-hoc ApiError retry loops (R001) ------------------------------------
-
-    @staticmethod
-    def _catches_base_api_error(handler: ast.ExceptHandler) -> bool:
-        def is_base(n) -> bool:
-            return (
-                (isinstance(n, ast.Name) and n.id == "ApiError")
-                or (isinstance(n, ast.Attribute) and n.attr == "ApiError")
-            )
-
-        tp = handler.type
-        if tp is None:
-            return False   # bare except is E722's finding
-        if isinstance(tp, ast.Tuple):
-            return any(is_base(e) for e in tp.elts)
-        return is_base(tp)
-
-    def _check_retry_loops(self):
-        if not self.check_retry_loops:
-            return
-
-        def swallows(handler: ast.ExceptHandler) -> bool:
-            # only handlers that let the loop RE-ATTEMPT the call are
-            # retry policy: any raise (propagates), break, or return
-            # (loop over) anywhere in the handler means it gives up on
-            # the API error rather than retrying — allowed
-            return not any(
-                isinstance(n, (ast.Raise, ast.Break, ast.Return))
-                for n in ast.walk(handler)
-            )
-
-        def is_retry_shaped(loop) -> bool:
-            # retry loops are `while ...` or `for _ in range(n)`; a
-            # `for` over a COLLECTION is per-item fan-out — swallowing
-            # an ApiError there moves on to the NEXT item, it never
-            # re-attempts the same request
-            if isinstance(loop, ast.While):
-                return True
-            it = loop.iter
-            return (
-                isinstance(it, ast.Call)
-                and isinstance(it.func, ast.Name)
-                and it.func.id == "range"
-            )
-
-        def walk(node, in_loop: bool):
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef, ast.Lambda)):
-                    # a function defined inside a loop body runs later,
-                    # not per-iteration — its handlers start loop-free
-                    walk(child, False)
-                    continue
-                if isinstance(child, (ast.While, ast.For, ast.AsyncFor)):
-                    walk(child, in_loop or is_retry_shaped(child))
-                    continue
-                if (
-                    in_loop
-                    and isinstance(child, ast.ExceptHandler)
-                    and self._catches_base_api_error(child)
-                    and swallows(child)
-                ):
-                    self.report(
-                        child, "R001",
-                        "retry loop catching base ApiError; centralize "
-                        "retry policy in kube.retry.RetryingClient",
-                    )
-                walk(child, in_loop)
-
-        walk(self.tree, False)
-
-    # -- metric families without HELP (M001) ------------------------------------
-
-    # the Metrics registration surface: a tpunet_* literal in the first
-    # argument of any of these IS a family the registry will export
-    METRIC_METHODS = frozenset({
-        "inc", "set_gauge", "observe", "remove_gauge", "remove_matching",
-    })
-
-    def _check_metric_families(self):
-        if not self.check_metric_help:
-            return
-        seen: Set[str] = set()
-
-        def flag(name: str, node) -> None:
-            if name in self.metric_help or name in seen:
-                return
-            seen.add(name)
-            self.report(
-                node, "M001",
-                f"metric family '{name}' registered without a "
-                f"METRIC_HELP entry (controller/health.py)",
-            )
-
-        for node in ast.walk(self.tree):
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in self.METRIC_METHODS
-                and node.args
-                and isinstance(node.args[0], ast.Constant)
-                and isinstance(node.args[0].value, str)
-                and node.args[0].value.startswith("tpunet_")
-            ):
-                flag(node.args[0].value, node)
-        # module-level family lists (POLICY_GAUGES-style): every
-        # element a tpunet_* literal — driven through loops, so the
-        # call-site shape above never sees the names
-        for stmt in self.tree.body:
-            if not isinstance(stmt, ast.Assign):
-                continue
-            value = stmt.value
-            if not isinstance(value, (ast.Tuple, ast.List)):
-                continue
-            elts = value.elts
-            if elts and all(
-                isinstance(e, ast.Constant)
-                and isinstance(e.value, str)
-                and e.value.startswith("tpunet_")
-                for e in elts
-            ):
-                for e in elts:
-                    flag(e.value, stmt)
-
-    # -- misc single-node checks ----------------------------------------------
-
-    def _check_misc(self, node):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            self.report(node, "E722", "bare 'except:'")
-        if isinstance(node, ast.JoinedStr) and id(node) not in self._format_specs:
-            if not any(
-                isinstance(v, ast.FormattedValue) for v in node.values
-            ):
-                self.report(node, "F541", "f-string without placeholders")
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for d in node.args.defaults + [
-                d for d in node.args.kw_defaults if d is not None
-            ]:
-                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
-                    self.report(
-                        d, "B006",
-                        "mutable default argument (list/dict/set literal)",
-                    )
-        if isinstance(node, ast.Compare):
-            for op, cmp in zip(node.ops, node.comparators):
-                if isinstance(op, (ast.Eq, ast.NotEq)) and (
-                    isinstance(cmp, ast.Constant) and cmp.value is None
-                ):
-                    self.report(
-                        node, "E711", "comparison to None (use 'is None')"
-                    )
-        if isinstance(node, ast.Assert) and isinstance(node.test, ast.Tuple):
-            if node.test.elts:
-                self.report(
-                    node, "B011", "assert on tuple literal is always true"
-                )
-        if (
-            self.check_log_fstrings
-            and isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr in LOG_METHODS
-            and isinstance(node.func.value, ast.Name)
-            and node.func.value.id in LOGGER_NAMES
-            and node.args
-            and isinstance(node.args[0], ast.JoinedStr)
-        ):
-            self.report(
-                node, "G004",
-                f"f-string-interpolated log call "
-                f"(log.{node.func.attr}(f\"...\")); use %-style lazy "
-                f"args to keep the record template structured",
-            )
-
-
-def load_metric_help(path: str = "") -> Optional[Set[str]]:
-    """The METRIC_HELP table's keys, parsed from health.py's AST (the
-    linter never imports the package).  The default path is anchored
-    to THIS file's repo checkout, not the CWD — `python /repo/tools/
-    lint.py` from anywhere must not silently switch M001 off.  None
-    when the module (or the table) cannot be found."""
-    if not path:
-        path = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "tpu_network_operator", "controller", "health.py",
-        )
-    if not os.path.isfile(path):
-        return None
-    try:
-        tree = ast.parse(open(path, encoding="utf-8").read())
-    except SyntaxError:
-        return None
-    for node in tree.body:
-        target = None
-        if isinstance(node, ast.Assign):
-            target = next(
-                (t.id for t in node.targets if isinstance(t, ast.Name)),
-                None,
-            )
-        elif isinstance(node, ast.AnnAssign) and isinstance(
-            node.target, ast.Name
-        ):
-            target = node.target.id
-        if target == "METRIC_HELP" and isinstance(node.value, ast.Dict):
-            return {
-                k.value for k in node.value.keys
-                if isinstance(k, ast.Constant)
-                and isinstance(k.value, str)
-            }
-    return None
 
 
 def lint_file(
     path: str, metric_help: Optional[Set[str]] = None
 ) -> List[Finding]:
+    """Per-file rules only (the whole-program passes need the full
+    tree; use ``run_suite`` / the CLI for those)."""
+    import ast
+
     with open(path, encoding="utf-8") as f:
         source = f.read()
     try:
@@ -672,33 +57,6 @@ def lint_file(
     except SyntaxError as e:
         return [Finding(path, e.lineno or 0, "E999", f"syntax error: {e.msg}")]
     return Checker(path, tree, source, metric_help=metric_help).run()
-
-
-def iter_py_files(targets):
-    for t in targets:
-        if os.path.isfile(t):
-            yield t
-        else:
-            for root, dirs, files in os.walk(t):
-                dirs[:] = [d for d in dirs if d not in
-                           ("__pycache__", ".git", ".pytest_cache")]
-                for f in sorted(files):
-                    if f.endswith(".py"):
-                        yield os.path.join(root, f)
-
-
-def main(argv=None) -> int:
-    targets = (argv or sys.argv[1:]) or DEFAULT_TARGETS
-    metric_help = load_metric_help()
-    findings: List[Finding] = []
-    n = 0
-    for path in iter_py_files(targets):
-        n += 1
-        findings.extend(lint_file(path, metric_help=metric_help))
-    for f in findings:
-        print(f)
-    print(f"lint: {n} files, {len(findings)} finding(s)", file=sys.stderr)
-    return 1 if findings else 0
 
 
 if __name__ == "__main__":

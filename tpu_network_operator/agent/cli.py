@@ -1623,6 +1623,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--wait", default="30s",
                    help="LLDP wait budget (e.g. 90s)")
     p.add_argument("--gaudinet", default="")
+    # tpunet: allow=C002 standalone-only backend — writes networkd unit files on bare hosts; managed DaemonSets configure links in-container
     p.add_argument("--systemd-networkd", dest="networkd", default="")
     p.add_argument("--interfaces", default="",
                    help="comma-separated extra interfaces")
@@ -1640,6 +1641,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--drain-timeout", default="30s",
                    help="max wait for an active job to release the "
                         "bootstrap lock before teardown (e.g. 45s)")
+    # tpunet: allow=C002 standalone tuning knob; managed agents run the default cadence (no CRD field — the reconciler stamps no override)
     p.add_argument("--recheck-interval", default="60s",
                    help="idle data-plane health recheck cadence")
     p.add_argument("--probe", dest="probe_enabled", default=False,
@@ -1701,6 +1703,7 @@ def build_parser() -> argparse.ArgumentParser:
                    default=telem.DEFAULT_STALL_TICKS,
                    help="min window depth before an oper-up interface "
                         "with a frozen rx counter counts as stalled")
+    # tpunet: allow=C002 projected as the TPUNET_TRACE_ID downward-API env (templates.py), not an arg — the pod annotation is the transport
     p.add_argument("--trace-id", default="",
                    help="trace ID for this provisioning attempt "
                         "(default: TPUNET_TRACE_ID env — the operator's "

@@ -32,6 +32,8 @@ METRIC_HELP: Dict[str, str] = {
     "tpunet_reconcile_duration_seconds":
         "Wall-clock latency of one reconcile pass.",
     "tpunet_workqueue_depth": "Keys waiting in the reconcile workqueue.",
+    "tpunet_report_parses_total":
+        "Agent report JSON decodes (cache misses of the report memo).",
     "tpunet_apiserver_requests_total":
         "Kubernetes API round-trips by verb and kind.",
     "tpunet_client_retries_total":

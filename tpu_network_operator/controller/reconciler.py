@@ -233,7 +233,13 @@ def update_gaudi_scale_out_daemonset(
     if so.pull_policy:
         container["imagePullPolicy"] = so.pull_policy
 
-    args = ["--configure=true", "--keep-running", f"--mode={so.layer}"]
+    # managed agents always log json: records join the cluster log
+    # pipeline and carry the trace context the TPUNET_TRACE_ID env
+    # (templates.py downward API) hands them
+    args = [
+        "--configure=true", "--keep-running", "--log-format=json",
+        f"--mode={so.layer}",
+    ]
     args += [
         f"--report-namespace={namespace}",
         f"--policy-name={policy.metadata.name}",
@@ -293,6 +299,7 @@ def update_tpu_scale_out_daemonset(
     args = [
         "--configure=true",
         "--keep-running",
+        "--log-format=json",
         "--backend=tpu",
         f"--mode={so.layer or t.LAYER_L2}",
     ]
@@ -2792,6 +2799,7 @@ class NetworkClusterPolicyReconciler:
                 },
             }
             try:
+                # tpunet: allow=C001 SSA label patch on pre-existing Nodes — the create half of apply never runs (only `patch nodes` is granted)
                 self.client.apply(
                     patch, field_manager=PLAN_FIELD_MANAGER
                 )
@@ -2978,6 +2986,7 @@ class NetworkClusterPolicyReconciler:
                     labeled.add(node)
         for node in sorted(labeled):
             try:
+                # tpunet: allow=C001 SSA label strip on pre-existing Nodes — the create half of apply never runs (only `patch nodes` is granted)
                 self.client.apply({
                     "apiVersion": "v1",
                     "kind": "Node",
