@@ -73,6 +73,11 @@ timeline-bench: ## Flight-recorder proof: marked tests + the 10k scale / chaos-c
 	$(PYTHON) -m pytest tests/ -x -q -m "timeline and not slow"
 	$(PYTHON) tools/timeline_bench.py --out BENCH_timeline.json
 
+.PHONY: history-bench
+history-bench: ## History-plane proof: marked tests + the chronic-flap soak (priors on vs off) and zero-steady-write sweep
+	$(PYTHON) -m pytest tests/ -x -q -m "history and not slow"
+	$(PYTHON) tools/history_bench.py --out BENCH_history.json
+
 .PHONY: test-cluster
 test-cluster: ## kind-cluster e2e + live fuzz (needs kind/docker/kubectl; skips cleanly without — ref test/e2e + test/fuzz)
 	$(PYTHON) -m pytest tests/cluster -x -q

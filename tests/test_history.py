@@ -1,0 +1,742 @@
+"""History plane (obs/history.py): journal-mined flap priors with
+exponential decay + sticky-penalty hysteresis, per-rung remediation
+success rates and the skip sets they drive, the burn-rate urgency
+window, the reconciler's checkpoint ConfigMap (diff-gated, resumable
+across shard failover), the bounded ``status.history`` rollup's
+zero-steady-write contract, ``/debug/history``, ``why --forecast``,
+and the support bundle's history member."""
+
+import json
+import os
+import sys
+import tarfile
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_network_operator.agent import report as rpt
+from tpu_network_operator.api.v1alpha1 import (
+    NetworkClusterPolicy,
+    default_policy,
+)
+from tpu_network_operator.api.v1alpha1.types import API_VERSION
+from tpu_network_operator.controller.health import (
+    METRIC_HELP,
+    HealthServer,
+    Metrics,
+)
+from tpu_network_operator.controller.reconciler import (
+    NetworkClusterPolicyReconciler,
+)
+from tpu_network_operator.kube.fake import FakeCluster
+from tpu_network_operator.obs import HistoryEngine, SloEngine, Timeline
+from tpu_network_operator.obs import history as hist_mod
+from tpu_network_operator.obs import timeline as tl_mod
+from tpu_network_operator.remediation import Knobs
+from tpu_network_operator.remediation.policy import (
+    LADDERS,
+    effective_ladder,
+)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools",
+))
+import why as why_mod   # noqa: E402 — tools/ scripts, not a package
+import diag as diag_mod   # noqa: E402
+
+NAMESPACE = "tpunet-system"
+POLICY = "hist-pol"
+
+pytestmark = pytest.mark.history
+
+
+def engine(clock, **kw):
+    tl = Timeline(clock=lambda: clock[0])
+    return tl, HistoryEngine(tl, clock=lambda: clock[0], **kw)
+
+
+def flap(tl, node, ts=None, heal=False):
+    """One probe verdict edge; the Reachable -> Degraded direction is
+    the flap the engine scores."""
+    tl.record(
+        POLICY, tl_mod.KIND_PROBE, node=node,
+        frm="Degraded" if heal else "Reachable",
+        to="Reachable" if heal else "Degraded", ts=ts,
+    )
+
+
+# -- flap priors: decay scoring + hysteresis -----------------------------------
+
+
+class TestFlapPriors:
+    def test_decay_scoring(self):
+        clock = [0.0]
+        tl, h = engine(clock)
+        flap(tl, "n1", ts=0.0)
+        assert h.flap_score(POLICY, "n1", asof=0.0) \
+            == pytest.approx(1.0)
+        # one half-life halves the mass; two quarter it
+        assert h.flap_score(POLICY, "n1", asof=1800.0) \
+            == pytest.approx(0.5)
+        assert h.flap_score(POLICY, "n1", asof=3600.0) \
+            == pytest.approx(0.25)
+        flap(tl, "n1", ts=1800.0)
+        assert h.flap_score(POLICY, "n1", asof=1800.0) \
+            == pytest.approx(1.5)
+
+    def test_latch_asserts_at_threshold(self):
+        clock = [0.0]
+        tl, h = engine(clock)
+        flap(tl, "n1", ts=0.0)
+        flap(tl, "n1", ts=0.0)
+        assert h.penalized(POLICY) == frozenset()
+        flap(tl, "n1", ts=0.0)   # decayed mass 3.0 >= assert
+        assert ("n1", "") in h.penalized(POLICY)
+        assert h.plan_penalties(POLICY) == {
+            "n1": hist_mod.PLAN_PENALTY_RTT_MS,
+        }
+        assert h.plan_fingerprint(POLICY) == "n1|"
+
+    def test_hysteresis_outlives_heals_then_releases(self):
+        clock = [0.0]
+        tl, h = engine(clock)
+        for ts in (0.0, 0.0, 0.0):
+            flap(tl, "n1", ts=ts)
+        assert ("n1", "") in h.penalized(POLICY)
+        # one half-life later the mass (~1.5) is BELOW assert but
+        # above release: the latch holds — a just-healed chronic
+        # flapper is not re-trusted on the first quiet pass
+        clock[0] = 1800.0
+        assert h.flap_score(POLICY, "n1") < h.penalty_assert
+        assert ("n1", "") in h.penalized(POLICY)
+        # two half-lives on, the mass (~0.75) crosses below release
+        # and the latch lets go
+        clock[0] = 3600.0
+        assert ("n1", "") not in h.penalized(POLICY)
+        assert h.plan_fingerprint(POLICY) == ""
+
+    def test_release_bumps_version_for_structural_replan(self):
+        clock = [0.0]
+        tl, h = engine(clock)
+        for ts in (0.0, 0.0, 0.0):
+            flap(tl, "n1", ts=ts)
+        v_latched = h.priors_version(POLICY)
+        clock[0] = 3600.0
+        h.penalized(POLICY)   # lazy release happens on read
+        assert h.priors_version(POLICY) > v_latched
+
+    def test_telemetry_anomaly_scores_per_interface(self):
+        clock = [0.0]
+        tl, h = engine(clock)
+        tl.record(POLICY, tl_mod.KIND_TELEMETRY, node="n2",
+                  frm="nominal", to="anomalous",
+                  detail="ens9: error-ratio", ts=5.0)
+        assert h.flap_score(POLICY, "n2", iface="ens9", asof=5.0) \
+            == pytest.approx(1.0)
+        assert h.flap_score(POLICY, "n2", asof=5.0) == 0.0
+
+    def test_departed_node_drops_its_priors(self):
+        clock = [0.0]
+        tl, h = engine(clock)
+        for ts in (0.0, 0.0, 0.0):
+            flap(tl, "n1", ts=ts)
+        assert ("n1", "") in h.penalized(POLICY)
+        tl.record(POLICY, tl_mod.KIND_READINESS, node="n1",
+                  frm="not-ready", to="departed", ts=3.0)
+        assert h.penalized(POLICY) == frozenset()
+        assert h.flap_score(POLICY, "n1") == 0.0
+
+    def test_key_bound_evicts_quietest_not_sticky(self):
+        clock = [0.0]
+        tl, h = engine(clock)
+        for ts in (0.0, 0.0, 0.0):
+            flap(tl, "sticky-node", ts=ts)
+        for i in range(hist_mod.MAX_KEYS + 8):
+            flap(tl, f"noise-{i:04d}", ts=10.0 + i)
+        assert ("sticky-node", "") in h.penalized(POLICY)
+
+
+# -- rung priors ---------------------------------------------------------------
+
+
+def rem_started(tl, node, cls, action, did, ts=None):
+    tl.record(POLICY, tl_mod.KIND_REMEDIATION, node=node,
+              frm=cls, to=action, reason="RemediationStarted",
+              directive_id=did, ts=ts)
+
+
+def rem_outcome(tl, node, did, ok, ts=None):
+    tl.record(POLICY, tl_mod.KIND_REMEDIATION, node=node,
+              frm="pending", to="ok" if ok else "failed",
+              reason="RemediationOutcome", directive_id=did, ts=ts)
+
+
+class TestRungPriors:
+    def test_outcomes_mined_by_directive_id(self):
+        clock = [0.0]
+        tl, h = engine(clock)
+        rem_started(tl, "n1", "probe", "re-probe", "d1")
+        rem_outcome(tl, "n1", "d1", ok=True)
+        rem_started(tl, "n1", "probe", "re-probe", "d2")
+        rem_outcome(tl, "n1", "d2", ok=False)
+        assert h.rung_stats(POLICY) == {
+            ("probe", "re-probe"): (2, 1, 1, 0),
+        }
+
+    def test_escalation_counts_against_the_from_rung(self):
+        clock = [0.0]
+        tl, h = engine(clock)
+        tl.record(POLICY, tl_mod.KIND_REMEDIATION, node="n1",
+                  frm="re-probe", to="peer-shift",
+                  reason="RemediationEscalated", detail="probe")
+        assert h.rung_stats(POLICY) == {
+            ("probe", "re-probe"): (0, 0, 0, 1),
+        }
+
+    def test_skip_needs_min_samples_below_floor(self):
+        clock = [0.0]
+        tl, h = engine(clock)
+        rem_started(tl, "n1", "probe", "re-probe", "d1")
+        rem_outcome(tl, "n1", "d1", ok=False)
+        rem_started(tl, "n1", "probe", "re-probe", "d2")
+        rem_outcome(tl, "n1", "d2", ok=False)
+        # 0/2 — below floor but under min samples: no skip yet
+        assert h.rung_skips(POLICY) == {}
+        rem_started(tl, "n1", "probe", "re-probe", "d3")
+        rem_outcome(tl, "n1", "d3", ok=False)
+        assert h.rung_skips(POLICY) == {
+            "probe": frozenset({"re-probe"}),
+        }
+
+    def test_succeeding_rung_never_skipped(self):
+        clock = [0.0]
+        tl, h = engine(clock)
+        for i in range(6):
+            did = f"d{i}"
+            rem_started(tl, "n1", "probe", "re-probe", did)
+            rem_outcome(tl, "n1", did, ok=(i % 2 == 0))   # 50% >> floor
+        assert h.rung_skips(POLICY) == {}
+
+    def test_effective_ladder_filters_but_never_empties(self):
+        skips = {"probe": frozenset({"re-probe"})}
+        assert effective_ladder("probe", Knobs(skip_actions=skips)) \
+            == ("peer-shift", "restart-agent")
+        # every rung below the floor: the LAST rung survives — a
+        # fleet that mined "nothing works" still escalates somewhere
+        for cls, ladder in LADDERS.items():
+            knobs = Knobs(skip_actions={cls: frozenset(ladder)})
+            assert effective_ladder(cls, knobs) == ladder[-1:]
+
+
+# -- urgency -------------------------------------------------------------------
+
+
+class _FakeSlo:
+    def __init__(self, burn):
+        self.burn = burn
+
+    def burn_rate(self, policy, window):
+        return self.burn
+
+
+class TestUrgency:
+    def test_burn_shrinks_window_capped(self):
+        h = HistoryEngine(slo=_FakeSlo(2.0))
+        assert h.budget_window(POLICY, 300.0) == pytest.approx(150.0)
+        h.slo = _FakeSlo(100.0)
+        assert h.budget_window(POLICY, 300.0) == pytest.approx(
+            300.0 / hist_mod.URGENCY_MAX_SCALE
+        )
+
+    def test_healthy_burn_keeps_configured_pace(self):
+        h = HistoryEngine(slo=_FakeSlo(0.4))
+        assert h.budget_window(POLICY, 300.0) == 300.0
+        h_none = HistoryEngine()
+        assert h_none.budget_window(POLICY, 300.0) == 300.0
+        assert h_none.urgency(POLICY) == 0.0
+
+
+# -- rollup + metrics ----------------------------------------------------------
+
+
+class TestRollup:
+    def test_none_until_anything_folds(self):
+        clock = [0.0]
+        tl, h = engine(clock)
+        assert h.history_status(POLICY) is None
+
+    def test_steady_reads_serve_identical_object(self):
+        clock = [0.0]
+        tl, h = engine(clock)
+        flap(tl, "n1", ts=0.0)
+        s1 = h.history_status(POLICY)
+        assert s1.tracked_links == 1
+        # same fold version + same decay bucket -> the SAME object,
+        # so the reconciler's status diff sees no change
+        assert h.history_status(POLICY) is s1
+        flap(tl, "n1", ts=1.0)
+        s2 = h.history_status(POLICY)
+        assert s2 is not s1
+
+    def test_rollup_counts_and_gauges(self):
+        clock = [0.0]
+        m = Metrics()
+        tl = Timeline(clock=lambda: clock[0])
+        h = HistoryEngine(tl, metrics=m, clock=lambda: clock[0])
+        for ts in (0.0, 0.0, 0.0):
+            flap(tl, "n1", ts=ts)
+        for i in range(3):
+            did = f"d{i}"
+            rem_started(tl, "n1", "probe", "re-probe", did)
+            rem_outcome(tl, "n1", did, ok=False)
+        h.budget_window(POLICY, 300.0)
+        status = h.history_status(POLICY)
+        assert status.tracked_links == 1
+        assert status.sticky_penalties == 1
+        assert status.flapping_nodes == 1
+        assert status.remediation_success_rate == 0.0
+        assert status.rungs_skipped == 1
+        assert status.budget_window_seconds == 300.0
+        rendered = m.render()
+        assert "tpunet_history_tracked_links" in rendered
+        assert "tpunet_history_sticky_penalties" in rendered
+        assert "tpunet_history_rung_success_rate" in rendered
+        h.forget(POLICY)
+        rendered = m.render()
+        for family in hist_mod.HISTORY_GAUGES:
+            assert family not in rendered
+        assert h.history_status(POLICY) is None
+
+    def test_metric_help_covers_history_families(self):
+        for name in hist_mod.HISTORY_GAUGES:
+            assert name in METRIC_HELP
+        assert "tpunet_fleet_sticky_penalties" in METRIC_HELP
+
+
+# -- persistence ---------------------------------------------------------------
+
+
+class TestPayload:
+    def _mined(self):
+        clock = [0.0]
+        tl, h = engine(clock)
+        for ts in (0.0, 0.0, 0.0):
+            flap(tl, "n1", ts=ts)
+        rem_started(tl, "n1", "probe", "re-probe", "d1")
+        rem_outcome(tl, "n1", "d1", ok=False)
+        return clock, h
+
+    def test_round_trip(self):
+        clock, h = self._mined()
+        payload = h.to_payload(POLICY)
+        assert payload["v"] == hist_mod.PAYLOAD_VERSION
+        h2 = HistoryEngine(clock=lambda: clock[0])
+        assert h2.load_payload(POLICY, payload)
+        assert ("n1", "") in h2.penalized(POLICY)
+        assert h2.rung_stats(POLICY) == h.rung_stats(POLICY)
+        assert h2.flap_score(POLICY, "n1", asof=0.0) \
+            == pytest.approx(h.flap_score(POLICY, "n1", asof=0.0))
+
+    def test_load_is_cold_only(self):
+        clock, h = self._mined()
+        payload = h.to_payload(POLICY)
+        warm = HistoryEngine(clock=lambda: clock[0])
+        tl2 = Timeline(clock=lambda: clock[0])
+        tl2.add_listener(warm._fold)
+        tl2.record(POLICY, tl_mod.KIND_PROBE, node="other",
+                   frm="Reachable", to="Degraded", ts=0.0)
+        assert not warm.load_payload(POLICY, payload)
+        assert warm.flap_score(POLICY, "n1") == 0.0
+
+    def test_mangled_payload_loads_nothing(self):
+        h = HistoryEngine()
+        assert not h.load_payload(POLICY, None)
+        assert not h.load_payload(POLICY, {"v": 999})
+        assert not h.load_payload(POLICY, {
+            "v": hist_mod.PAYLOAD_VERSION,
+            "rungs": {"probe|re-probe": ["NaN-ish", "x"]},
+        })
+        assert h.priors_version(POLICY) == 0
+
+
+# -- reconciler integration: checkpoint + failover resume ----------------------
+
+
+def probe_payload(n, bad=False):
+    return {
+        "peersTotal": n - 1,
+        "peersReachable": 0 if bad else n - 1,
+        "unreachable": [],
+        "rttP50Ms": 0.4, "rttP99Ms": 1.1,
+        "lossRatio": 1.0 if bad else 0.0,
+        "state": "Degraded" if bad else "Healthy",
+    }
+
+
+def fleet_report(node, i, n, bad=False):
+    return rpt.ProvisioningReport(
+        node=node, policy=POLICY, ok=not bad,
+        error="link eth1 down" if bad else "",
+        backend="tpu", mode="L2",
+        interfaces_configured=2, interfaces_total=2,
+        probe_endpoint=f"10.7.0.{i + 1}:8477",
+        probe=probe_payload(n, bad=bad),
+    )
+
+
+def make_reconciler(fake, clock):
+    m = Metrics()
+    tl = Timeline(clock=lambda: clock[0], metrics=m)
+    slo = SloEngine(tl, metrics=m, clock=lambda: clock[0])
+    h = HistoryEngine(tl, metrics=m, slo=slo, clock=lambda: clock[0])
+    rec = NetworkClusterPolicyReconciler(
+        fake, NAMESPACE, metrics=m, timeline=tl, slo=slo, history=h,
+    )
+    rec._rem_clock = lambda: clock[0]
+    rec.setup()
+    return rec, h, tl
+
+
+def make_env(n=4):
+    p = NetworkClusterPolicy()
+    p.metadata.name = POLICY
+    p.spec.configuration_type = "tpu-so"
+    p.spec.node_selector = {"tpunet.dev/pool": POLICY}
+    p.spec.tpu_scale_out.probe.enabled = True
+    fake = FakeCluster()
+    fake.create(default_policy(p).to_dict())
+    for i in range(n):
+        node = f"node-{i:03d}"
+        fake.add_node(node, {"tpunet.dev/pool": POLICY})
+        fake.apply(rpt.lease_for(fleet_report(node, i, n), NAMESPACE))
+    clock = [10_000.0]
+    rec, h, tl = make_reconciler(fake, clock)
+    rec.reconcile(POLICY)
+    fake.simulate_daemonset_controller()
+    rec.reconcile(POLICY)
+    return fake, rec, h, tl, clock
+
+
+def mine_chronic_flapper(fake, rec, clock, node="node-000", flips=4):
+    """Flap one node until the sticky latch asserts (each bad/good
+    report pair is one Reachable -> Degraded edge)."""
+    for _ in range(flips):
+        fake.apply(rpt.lease_for(
+            fleet_report(node, 0, 4, bad=True), NAMESPACE
+        ))
+        rec.reconcile(POLICY)
+        clock[0] += 5.0
+        fake.apply(rpt.lease_for(fleet_report(node, 0, 4), NAMESPACE))
+        rec.reconcile(POLICY)
+        clock[0] += 5.0
+
+
+class TestReconcilerHistory:
+    def test_status_history_rollup_published(self):
+        fake, rec, h, tl, clock = make_env()
+        mine_chronic_flapper(fake, rec, clock)
+        rec.reconcile(POLICY)
+        cr = fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+        history = cr["status"]["history"]
+        assert history["trackedLinks"] == 1
+        assert history["stickyPenalties"] == 1
+        assert history["flappingNodes"] == 1
+
+    def test_checkpoint_cm_written_and_diff_gated(self):
+        fake, rec, h, tl, clock = make_env()
+        mine_chronic_flapper(fake, rec, clock)
+        rec.reconcile(POLICY)
+        cm = fake.get(
+            "v1", "ConfigMap", hist_mod.history_cm_name(POLICY),
+            NAMESPACE,
+        )
+        payload = json.loads(cm["data"][hist_mod.HISTORY_CM_KEY])
+        assert payload["v"] == hist_mod.PAYLOAD_VERSION
+        assert payload["sticky"] == ["node-000|"]
+        # the CR owns the checkpoint: policy delete collects it
+        owner = cm["metadata"]["ownerReferences"][0]
+        assert owner["kind"] == "NetworkClusterPolicy"
+        assert owner["name"] == POLICY
+
+    def test_zero_steady_writes_and_appends_with_priors_live(self):
+        fake, rec, h, tl, clock = make_env()
+        mine_chronic_flapper(fake, rec, clock)
+        rec.reconcile(POLICY)
+        rec.reconcile(POLICY)   # absorb trailing journal records
+        before = {
+            k: v for k, v in fake.request_counts.items()
+            if k[0] in ("create", "update", "patch", "apply")
+        }
+        appended = tl.appended()
+        for _ in range(5):
+            rec.reconcile(POLICY)
+        after = {
+            k: v for k, v in fake.request_counts.items()
+            if k[0] in ("create", "update", "patch", "apply")
+        }
+        assert before == after
+        assert tl.appended() == appended
+
+    def test_failover_successor_does_not_retrust_flapper(self):
+        """The ISSUE's resume contract: replica B starts with a COLD
+        engine, loads replica A's checkpoint on its first pass, and
+        keeps the chronic flapper penalized — no re-learning window
+        in which the planner would route back through it."""
+        fake, rec_a, h_a, tl_a, clock = make_env()
+        mine_chronic_flapper(fake, rec_a, clock)
+        rec_a.reconcile(POLICY)
+        assert ("node-000", "") in h_a.penalized(POLICY)
+        # replica B: fresh process, fresh engine, same cluster
+        rec_b, h_b, tl_b = make_reconciler(fake, clock)
+        assert h_b.priors_version(POLICY) == 0
+        rec_b.reconcile(POLICY)
+        assert ("node-000", "") in h_b.penalized(POLICY)
+        assert h_b.rung_stats(POLICY) == h_a.rung_stats(POLICY)
+        # ... and B's first save diffs against the loaded payload:
+        # no rewrite of an unchanged checkpoint
+        cm_before = fake.get(
+            "v1", "ConfigMap", hist_mod.history_cm_name(POLICY),
+            NAMESPACE,
+        )
+        rec_b.reconcile(POLICY)
+        cm_after = fake.get(
+            "v1", "ConfigMap", hist_mod.history_cm_name(POLICY),
+            NAMESPACE,
+        )
+        assert cm_before["metadata"].get("resourceVersion") \
+            == cm_after["metadata"].get("resourceVersion")
+
+    def test_release_policy_forgets_and_reacquire_reloads(self):
+        """Shard handoff: releasing a policy drops the local priors
+        (the successor's engine is the authority), and a re-gain
+        reloads whatever checkpoint the successor persisted."""
+        fake, rec, h, tl, clock = make_env()
+        mine_chronic_flapper(fake, rec, clock)
+        rec.reconcile(POLICY)
+        rec.release_policy(POLICY)
+        assert h.priors_version(POLICY) == 0
+        assert h.penalized(POLICY) == frozenset()
+        rec.reconcile(POLICY)   # re-gained: first pass reloads
+        assert ("node-000", "") in h.penalized(POLICY)
+
+    def test_cr_delete_forgets_priors_and_checkpoint_state(self):
+        fake, rec, h, tl, clock = make_env()
+        mine_chronic_flapper(fake, rec, clock)
+        rec.reconcile(POLICY)
+        fake.delete(API_VERSION, "NetworkClusterPolicy", POLICY)
+        rec.reconcile(POLICY)
+        assert h.priors_version(POLICY) == 0
+        assert h.history_status(POLICY) is None
+
+
+# -- shard ownership journal (satellite) ---------------------------------------
+
+
+class TestShardJournal:
+    def _coord(self, fake, ident, clock, tl):
+        from tpu_network_operator.controller.sharding import (
+            ShardCoordinator,
+        )
+
+        return ShardCoordinator(
+            fake, NAMESPACE, n_shards=2, identity=ident,
+            lease_duration=30.0, clock=lambda: clock[0], timeline=tl,
+        )
+
+    def test_acquire_release_failover_edges(self):
+        fake = FakeCluster()
+        clock = [1000.0]
+        tl = Timeline(clock=lambda: clock[0])
+        a = self._coord(fake, "replica-a", clock, tl)
+        a.sync()
+        records = tl.snapshot(policy=tl_mod.SHARD_POLICY,
+                              kind=tl_mod.KIND_SHARD)
+        assert {(r["to"], r["cause"]["directiveId"])
+                for r in records} \
+            == {("acquired", "replica-a"), ("acquired", "replica-a")}
+        # steady renewals journal nothing
+        n0 = tl.appended()
+        clock[0] += 10.0
+        a.sync()
+        assert tl.appended() == n0
+        # a crashes (NO clean stop — its leases expire still naming it
+        # as holder); b takes the expired leases -> failover edges
+        # naming the previous holder as the from-state
+        clock[0] += 100.0
+        b = self._coord(fake, "replica-b", clock, tl)
+        b.sync()
+        takeovers = [
+            r for r in tl.snapshot(kind=tl_mod.KIND_SHARD)
+            if r["cause"]["directiveId"] == "replica-b"
+        ]
+        assert len(takeovers) == 2
+        assert all(r["to"] == "failover" for r in takeovers)
+        assert all(r["from"] == "replica-a" for r in takeovers)
+        # a clean shutdown journals the release edges
+        b.stop()
+        released = [
+            r for r in tl.snapshot(kind=tl_mod.KIND_SHARD)
+            if r["to"] == "released"
+        ]
+        assert len(released) == 2
+        assert all(r["from"] == "replica-b" for r in released)
+
+
+# -- /debug/history ------------------------------------------------------------
+
+
+def _get(url, token=""):
+    req = urllib.request.Request(
+        url,
+        headers={"Authorization": f"Bearer {token}"} if token else {},
+    )
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestDebugHistoryEndpoint:
+    def _history(self):
+        clock = [0.0]
+        tl, h = engine(clock)
+        for ts in (0.0, 0.0, 0.0):
+            flap(tl, "n1", ts=ts)
+        return h
+
+    def test_serves_summary(self):
+        srv = HealthServer(port=0, history=self._history())
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            status, body = _get(f"{base}/debug/history")
+            assert status == 200
+            data = json.loads(body)
+            assert data["penaltyAssert"] == hist_mod.PENALTY_ASSERT_FLAPS
+            link = data["policies"][POLICY]["links"][0]
+            assert link["node"] == "n1"
+            assert link["sticky"] is True
+        finally:
+            srv.stop()
+
+    def test_404_without_history(self):
+        srv = HealthServer(port=0)
+        srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(f"http://127.0.0.1:{srv.port}/debug/history")
+            assert err.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_bearer_gate(self):
+        srv = HealthServer(port=0, history=self._history(),
+                           metrics_auth=lambda tok: tok == "s3cr3t")
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(f"{base}/debug/history")
+            assert err.value.code == 403
+            status, _ = _get(f"{base}/debug/history", token="s3cr3t")
+            assert status == 200
+        finally:
+            srv.stop()
+
+
+# -- why --forecast ------------------------------------------------------------
+
+
+class TestWhyForecast:
+    def _engine(self):
+        clock = [0.0]
+        tl, h = engine(clock)
+        for ts in (0.0, 0.0, 0.0):
+            flap(tl, "n1", ts=ts)
+        for i in range(3):
+            did = f"d{i}"
+            rem_started(tl, "n1", "probe", "re-probe", did)
+            rem_outcome(tl, "n1", did, ok=False)
+        return h
+
+    def test_forecast_renders_priors_and_skips(self):
+        out = why_mod.forecast("n1", self._engine().summary())
+        assert "forecast n1" in out
+        assert "STICKY" in out
+        assert "re-probe" in out
+        assert "success 0.00" in out   # the mined 0/3 rate
+        assert "SKIPPED" in out
+
+    def test_forecast_without_evidence(self):
+        out = why_mod.forecast("ghost", {"policies": {}})
+        assert "no mined priors" in out
+
+    def test_cli_forecast_with_inprocess_engine(self, capsys):
+        rc = why_mod.main(
+            ["n1", "--forecast", "--policy", POLICY],
+            history=self._engine(),
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "forecast n1" in out
+        assert "STICKY" in out
+
+    def test_cli_forecast_without_source_errors(self, capsys):
+        rc = why_mod.main(["n1", "--forecast"])
+        assert rc == 1
+        assert "--history-url" in capsys.readouterr().err
+
+
+# -- support bundle ------------------------------------------------------------
+
+
+class TestDiagHistory:
+    def test_bundle_contains_live_history(self, tmp_path):
+        clock = [0.0]
+        tl, h = engine(clock)
+        for ts in (0.0, 0.0, 0.0):
+            flap(tl, "n1", ts=ts)
+        out = tmp_path / "bundle.tar.gz"
+        members = diag_mod.collect_bundle(
+            FakeCluster(), NAMESPACE, str(out), history=h,
+        )
+        assert "history.json" in members
+        with tarfile.open(out) as tar:
+            body = json.load(tar.extractfile("history.json"))
+            manifest = json.load(tar.extractfile("manifest.json"))
+        assert body["policies"][POLICY]["links"][0]["sticky"] is True
+        assert "history.json" in manifest["files"]
+
+    def test_bundle_derives_from_status_without_live_engine(
+        self, tmp_path
+    ):
+        fake, rec, h, tl, clock = make_env()
+        mine_chronic_flapper(fake, rec, clock)
+        rec.reconcile(POLICY)
+        out = tmp_path / "bundle.tar.gz"
+        members = diag_mod.collect_bundle(fake, NAMESPACE, str(out))
+        assert "history.json" in members
+        with tarfile.open(out) as tar:
+            body = json.load(tar.extractfile("history.json"))
+            cm_member = (
+                f"configmaps/{hist_mod.history_cm_name(POLICY)}.json"
+            )
+            cm = json.load(tar.extractfile(cm_member))
+        assert body["source"] == "status.history"
+        assert body["policies"][POLICY]["stickyPenalties"] == 1
+        # the priors checkpoint CM rides in the configmap capture
+        assert hist_mod.HISTORY_CM_KEY in cm.get("data", {})
+
+    def test_history_body_redacted(self, tmp_path):
+        out = tmp_path / "bundle.tar.gz"
+        diag_mod.collect_bundle(
+            FakeCluster(), NAMESPACE, str(out),
+            history_json=json.dumps({
+                "policies": {"p": {
+                    "note": "auth failed: Bearer sk-meta-XYZ12345",
+                }},
+            }),
+        )
+        with tarfile.open(out) as tar:
+            body = tar.extractfile("history.json").read().decode()
+        assert "XYZ12345" not in body
+        assert "**REDACTED**" in body

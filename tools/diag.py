@@ -99,6 +99,7 @@ def collect_files(
     traces_json: str = "",
     timeline_json: str = "",
     slo_json: str = "",
+    history_json: str = "",
 ) -> Dict[str, str]:
     """Gather every bundle member as {relative path: content}.  Each
     section is best-effort: a forbidden or failing list yields an
@@ -118,17 +119,23 @@ def collect_files(
             errors[name] = f"{type(e).__name__}: {e}"
 
     derived_slo: Dict[str, Any] = {}
+    derived_history: Dict[str, Any] = {}
 
     def policies():
         items = client.list(t.API_VERSION, t.NetworkClusterPolicy.KIND)
         files["policies.json"] = _jdump(redact(items))
         # the CR status carries the SLO engine's bounded rollup — a
-        # live collection (no in-process engine) still gets slo.json
+        # live collection (no in-process engine) still gets slo.json.
+        # Same for the history plane's status.history rollup.
         for item in items:
             name = (item.get("metadata", {}) or {}).get("name", "")
-            health = (item.get("status", {}) or {}).get("health")
+            status = item.get("status", {}) or {}
+            health = status.get("health")
             if name and isinstance(health, dict):
                 derived_slo[name] = health
+            history = status.get("history")
+            if name and isinstance(history, dict):
+                derived_history[name] = history
 
     def events():
         items = client.list("v1", "Event", namespace=namespace)
@@ -139,11 +146,14 @@ def collect_files(
         # probe peer lists, the topology plan, and the remediation
         # ledger + directive pair.  ONLY these prefixes are collected —
         # never co-located app config (could hold anything)
+        from tpu_network_operator.obs import history as obs_history
+
         prefixes = (
             rpt.PEER_CONFIGMAP_PREFIX,
             rpt.PLAN_CONFIGMAP_PREFIX,
             rpt.REMEDIATION_CONFIGMAP_PREFIX,
             rpt.DIRECTIVE_CONFIGMAP_PREFIX,
+            obs_history.HISTORY_CM_PREFIX,
         )
         for cm in client.list("v1", "ConfigMap", namespace=namespace):
             name = cm.get("metadata", {}).get("name", "")
@@ -206,8 +216,13 @@ def collect_files(
         slo_json = json.dumps({
             "source": "status.health", "policies": derived_slo,
         })
+    if not history_json and derived_history:
+        history_json = json.dumps({
+            "source": "status.history", "policies": derived_history,
+        })
     for name, body in (("timeline.json", timeline_json),
-                       ("slo.json", slo_json)):
+                       ("slo.json", slo_json),
+                       ("history.json", history_json)):
         if not body:
             continue
         try:
@@ -254,15 +269,17 @@ def collect_bundle(
     tracer=None,
     timeline=None,
     slo=None,
+    history=None,
     metrics_text: str = "",
     traces_json: str = "",
     timeline_json: str = "",
     slo_json: str = "",
+    history_json: str = "",
 ) -> List[str]:
     """One-call collection: accepts live ``metrics``/``tracer``/
-    ``timeline``/``slo`` objects (in-process use and tests) or
-    pre-fetched endpoint bodies (the CLI).  Returns the bundle's member
-    names."""
+    ``timeline``/``slo``/``history`` objects (in-process use and
+    tests) or pre-fetched endpoint bodies (the CLI).  Returns the
+    bundle's member names."""
     if metrics is not None and not metrics_text:
         metrics_text = metrics.render()
     if tracer is not None and not traces_json:
@@ -279,10 +296,13 @@ def collect_bundle(
         })
     if slo is not None and not slo_json:
         slo_json = json.dumps(slo.summary())
+    if history is not None and not history_json:
+        history_json = json.dumps(history.summary())
     files = collect_files(
         client, namespace,
         metrics_text=metrics_text, traces_json=traces_json,
         timeline_json=timeline_json, slo_json=slo_json,
+        history_json=history_json,
     )
     write_bundle(files, out_path)
     return sorted(files)
@@ -314,6 +334,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="operator /debug/traces endpoint to snapshot")
     ap.add_argument("--timeline-url", default="",
                     help="operator /debug/timeline endpoint to snapshot")
+    ap.add_argument("--history-url", default="",
+                    help="operator /debug/history endpoint to snapshot")
     ap.add_argument("--token-env", default="TPUNET_KUBE_TOKEN",
                     help="env var holding the bearer token for the "
                          "endpoints above (never passed on argv)")
@@ -329,10 +351,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         client = ApiClient.in_cluster()
 
-    bodies = {"metrics_text": "", "traces_json": "", "timeline_json": ""}
+    bodies = {"metrics_text": "", "traces_json": "",
+              "timeline_json": "", "history_json": ""}
     for url, attr in ((args.metrics_url, "metrics_text"),
                       (args.traces_url, "traces_json"),
-                      (args.timeline_url, "timeline_json")):
+                      (args.timeline_url, "timeline_json"),
+                      (args.history_url, "history_json")):
         if not url:
             continue
         try:
@@ -348,6 +372,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         metrics_text=bodies["metrics_text"],
         traces_json=bodies["traces_json"],
         timeline_json=bodies["timeline_json"],
+        history_json=bodies["history_json"],
     )
     print(f"wrote {out} ({len(members)} files)")
     for m in members:

@@ -1,0 +1,587 @@
+#!/usr/bin/env python3
+"""History-plane benchmark — prints ONE JSON line (BENCH-style).
+
+Proves the mined-priors contract (perf_session phase 17): the flight
+recorder is not just narrative — folded into priors, it changes what
+the control plane DOES next.
+
+1. **Chronic-flap soak (priors on vs off)** — a seeded FakeFabric mesh
+   driven through REAL ProbeRunners and the REAL reconciler, with one
+   victim flapping repeatedly (partition → degrade → remediate → heal,
+   N cycles) and every remediation rung failing on it (a chronic fault
+   no rung fixes).  Run twice, identical scenario:
+
+   * priors ON: the sticky flap penalty must assert BEFORE the next
+     injected fault — observable both as the victim entering the
+     penalized set and as a replan journaled with trigger ``priors``
+     (the pre-emptive route-around);
+   * the mined per-rung success rates must drive rung skipping, so the
+     priors-on run fires STRICTLY FEWER total remediation actions than
+     the priors-off baseline (stop re-firing what never works);
+   * the ladder must NEVER empty under rung-skipping — even when every
+     mined rung sits below the success floor, the last rung survives.
+
+2. **Steady-state scale** — the 10k-node sweep with the full history
+   plane wired (engine + status rollup + priors checkpoint ConfigMap):
+   after fault-driven churn establishes non-empty priors AND their
+   checkpoint, steady passes must issue ZERO apiserver writes and
+   append ZERO journal records — the rollup is fold-version cached and
+   the checkpoint is double-gated (version, then payload diff).
+
+The artifact carries only deterministic fields (counts, booleans,
+seeds) + wall_seconds, so two runs with the same arguments produce
+byte-identical rows modulo wall_seconds.
+
+Usage: python tools/history_bench.py [--nodes 10000] [--cycles 5]
+       [--seed 7] [--out BENCH_history.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import scale_bench as sb   # noqa: E402 — shared fleet/seed helpers
+
+NAMESPACE = "tpunet-system"
+POLICY = sb.POLICY
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+# -- phase 1: seeded FakeFabric chronic-flap soak ------------------------------
+
+
+def make_soak_policy(n: int):
+    from tpu_network_operator.api.v1alpha1 import (
+        NetworkClusterPolicy,
+        default_policy,
+    )
+
+    p = NetworkClusterPolicy()
+    p.metadata.name = POLICY
+    p.spec.configuration_type = "tpu-so"
+    p.spec.node_selector = {"tpunet.dev/pool": POLICY}
+    so = p.spec.tpu_scale_out
+    so.probe.enabled = True
+    so.probe.interval_seconds = sb.PROBE_INTERVAL
+    so.planner.enabled = True
+    so.remediation.enabled = True
+    # short cooldown keeps the flap cycles dense on the sim clock: the
+    # chronic flapper's events must land well inside the decay half-
+    # life or the production assert threshold can never latch
+    so.remediation.cooldown_seconds = 15
+    # no pod rolls: restart-agent would depart the node (pod delete ->
+    # membership exit -> priors drop, by design), ending the chronic-
+    # flap history this bench exists to accumulate
+    so.remediation.allowed_actions = ["re-probe", "peer-shift"]
+    return default_policy(p)
+
+
+def run_flap_soak(priors_on: bool, n: int, cycles: int, seed: int):
+    """One full chronic-flap scenario; returns deterministic counters.
+    ``priors_on`` wires the HistoryEngine into the reconciler (the ONLY
+    difference between the two runs)."""
+    from tpu_network_operator.agent import report as rpt
+    from tpu_network_operator.controller.health import Metrics
+    from tpu_network_operator.controller.reconciler import (
+        NetworkClusterPolicyReconciler,
+    )
+    from tpu_network_operator.kube.fake import FakeCluster
+    from tpu_network_operator.obs import (
+        EventRecorder,
+        HistoryEngine,
+        SloEngine,
+        Timeline,
+    )
+    from tpu_network_operator.probe import FakeFabric, ProbeRunner
+
+    log(f"== chronic-flap soak: {n}-node FakeFabric mesh, "
+        f"{cycles} flap cycles, priors "
+        + ("ON" if priors_on else "OFF"))
+    nodes = [f"node-{i:03d}" for i in range(n)]
+    endpoints = {
+        node: f"10.9.0.{i + 1}:8477" for i, node in enumerate(nodes)
+    }
+    fabric = FakeFabric(seed=seed, latency=0.0005, jitter=0.0002)
+    runners = {
+        node: ProbeRunner(
+            fabric, endpoints[node], node,
+            (lambda node=node: {
+                p: a for p, a in endpoints.items() if p != node
+            }),
+            interval=sb.PROBE_INTERVAL,
+        )
+        for node in nodes
+    }
+    for r in runners.values():
+        r.responder.start()
+
+    sim = [100_000.0]
+    fake = FakeCluster()
+    fake.create(make_soak_policy(n).to_dict())
+    for node in nodes:
+        fake.add_node(node, {"tpunet.dev/pool": POLICY})
+    metrics = Metrics()
+    timeline = Timeline(clock=lambda: sim[0], metrics=metrics)
+    slo = SloEngine(timeline, metrics=metrics, clock=lambda: sim[0])
+    history = None
+    if priors_on:
+        history = HistoryEngine(
+            timeline, metrics=metrics, slo=slo, clock=lambda: sim[0],
+        )
+    rec = NetworkClusterPolicyReconciler(
+        fake, NAMESPACE, metrics=metrics,
+        events=EventRecorder(fake, NAMESPACE), timeline=timeline,
+        slo=slo, history=history,
+    )
+    rec._rem_clock = lambda: sim[0]
+    rec.setup()
+
+    outcomes = {}
+
+    def publish(node):
+        export = runners[node].export() or {}
+        ready = runners[node].ready()
+        fake.apply(rpt.lease_for(rpt.ProvisioningReport(
+            node=node, policy=POLICY, ok=ready,
+            error="" if ready else "probe mesh below quorum",
+            backend="tpu", mode="L2",
+            interfaces_configured=2, interfaces_total=2,
+            probe_endpoint=endpoints[node],
+            probe=export,
+            remediation=outcomes.get(node),
+        ), NAMESPACE))
+
+    def probe_round():
+        for r in runners.values():
+            r.step()
+        fabric.advance(sb.PROBE_INTERVAL)
+        sim[0] += sb.PROBE_INTERVAL
+
+    def directive_for(node):
+        from tpu_network_operator.kube import errors as kerr
+
+        try:
+            cm = fake.get(
+                "v1", "ConfigMap",
+                rpt.directive_configmap_name(POLICY), NAMESPACE,
+            )
+        except kerr.NotFoundError:
+            return None
+        payload = json.loads(cm["data"][rpt.DIRECTIVES_KEY])
+        return payload["directives"].get(node)
+
+    # converge healthy
+    for _ in range(5):
+        probe_round()
+    for node in nodes:
+        publish(node)
+    rec.reconcile(POLICY)
+    fake.simulate_daemonset_controller()
+    rec.reconcile(POLICY)
+
+    victim = nodes[n // 2]
+    victim_host = endpoints[victim].rpartition(":")[0]
+
+    penalized_before_fault = []
+    executed = set()
+    for cycle in range(cycles):
+        # GATE A observation point: is the chronic flapper already
+        # penalized BEFORE this fault is injected?  (Meaningful from
+        # cycle 1 on; the priors-off run never penalizes.)
+        pen = bool(
+            history is not None
+            and (victim, "") in history.penalized(POLICY)
+        )
+        penalized_before_fault.append(pen)
+
+        fabric.partition(victim_host)
+        for _ in range(6):
+            probe_round()
+            if not runners[victim].ready():
+                break
+        publish(victim)
+        rec.reconcile(POLICY)
+
+        # the "agent": execute whatever rung the controller fired, and
+        # report it FAILED — a chronic fabric fault no rung fixes.
+        # Loop until the cooldown'd ladder stops issuing new work this
+        # cycle (cooldown elapses via the sim clock).
+        for _ in range(6):
+            directive = directive_for(victim)
+            if directive is None or directive["id"] in executed:
+                sim[0] += 16.0   # past the cooldown: next rung due
+                rec.reconcile(POLICY)
+                directive = directive_for(victim)
+                if directive is None or directive["id"] in executed:
+                    break
+            executed.add(directive["id"])
+            outcomes[victim] = {
+                "directiveId": directive["id"],
+                "action": directive["action"], "ok": False,
+                "error": "link still flapping",
+            }
+            publish(victim)
+            rec.reconcile(POLICY)
+
+        fabric.heal(victim_host)
+        for _ in range(8):
+            probe_round()
+            if runners[victim].ready():
+                break
+        outcomes.pop(victim, None)
+        publish(victim)
+        rec.reconcile(POLICY)
+        sim[0] += 16.0   # cooldown elapses: the heal/recovery edge fires
+        rec.reconcile(POLICY)
+
+    def plan_modeled_ms():
+        from tpu_network_operator.kube import errors as kerr
+
+        try:
+            cm = fake.get(
+                "v1", "ConfigMap",
+                rpt.plan_configmap_name(POLICY), NAMESPACE,
+            )
+        except kerr.NotFoundError:
+            return 0.0
+        key = next(iter(cm.get("data", {})), None)
+        if key is None:
+            return 0.0
+        return float(
+            json.loads(cm["data"][key]).get("modeledAllreduceMs", 0.0)
+        )
+
+    # the latch must have survived every heal (hysteresis): capture it
+    # — and the modeled collective cost it inflates — BEFORE the
+    # release epilogue below decays it away
+    victim_sticky = bool(
+        history is not None
+        and (victim, "") in history.penalized(POLICY)
+    )
+    victim_priced = bool(
+        history is not None
+        and victim in history.plan_penalties(POLICY)
+    )
+    modeled_sticky_ms = plan_modeled_ms()
+
+    # release epilogue: idle long past the decay window, then one more
+    # pass.  Membership and exclusions are unchanged — the ONLY moving
+    # input is the sticky set unlatching — so the tracker's structural
+    # priors term forces a recompute on the now-unpenalized matrix.
+    # The ring itself is penalty-invariant (every Hamiltonian cycle
+    # pays a per-node surcharge exactly twice), so the observable is
+    # the modeled all-reduce (ring perimeter on the PRICED matrix):
+    # it must drop by ~2x the per-node penalty when the latch lets go.
+    sim[0] += 6 * 1800.0
+    rec.reconcile(POLICY)
+    modeled_released_ms = plan_modeled_ms()
+
+    for r in runners.values():
+        r.stop()
+
+    started = [
+        r for r in timeline.snapshot(policy=POLICY, kind="remediation")
+        if (r.get("cause", {}) or {}).get("reason")
+        == "RemediationStarted"
+    ]
+    plan_triggers = [
+        r.get("detail", "")
+        for r in timeline.snapshot(policy=POLICY, kind="plan")
+    ]
+
+    row = {
+        "priors_on": priors_on,
+        "nodes": n,
+        "cycles": cycles,
+        "victim": victim,
+        "remediation_actions": len(started),
+        "actions_by_rung": sorted(
+            {r["to"] for r in started}
+        ),
+        "penalized_before_fault": penalized_before_fault,
+        "plan_triggers": plan_triggers,
+        "modeled_sticky_ms": round(modeled_sticky_ms, 3),
+        "modeled_released_ms": round(modeled_released_ms, 3),
+    }
+    if history is not None:
+        skips = history.rung_skips(POLICY)
+        row.update({
+            "victim_sticky": victim_sticky,
+            "victim_priced_into_plan": victim_priced,
+            "penalty_released_after_decay":
+                (victim, "") not in history.penalized(POLICY),
+            "rung_skips": {
+                cls: sorted(acts) for cls, acts in sorted(skips.items())
+            },
+            "max_urgency": round(history.urgency(POLICY), 3),
+            "priors_version": history.priors_version(POLICY),
+        })
+        # GATE C: the ladder never empties under rung-skipping — with
+        # the MINED skips, and even with every action skipped
+        from tpu_network_operator.remediation import Knobs
+        from tpu_network_operator.remediation.policy import (
+            LADDERS,
+            effective_ladder,
+        )
+
+        mined_ok = all(
+            effective_ladder(cls, Knobs(skip_actions=skips))
+            for cls in LADDERS
+        )
+        full_skip = {
+            cls: frozenset(ladder) for cls, ladder in LADDERS.items()
+        }
+        full_ok = all(
+            effective_ladder(cls, Knobs(skip_actions=full_skip))
+            == LADDERS[cls][-1:]
+            for cls in LADDERS
+        )
+        row["ladder_never_empties"] = mined_ok and full_ok
+        # the checkpoint CM must exist once priors are non-trivial
+        from tpu_network_operator.kube import errors as kerr
+        from tpu_network_operator.obs import history as obs_history
+
+        try:
+            fake.get(
+                "v1", "ConfigMap",
+                obs_history.history_cm_name(POLICY), NAMESPACE,
+            )
+            row["checkpoint_cm_exists"] = True
+        except kerr.NotFoundError:
+            row["checkpoint_cm_exists"] = False
+    log(f"   -> {row['remediation_actions']} remediation action(s), "
+        f"penalized-before-fault {penalized_before_fault}, "
+        f"plan triggers {plan_triggers}")
+    return row
+
+
+# -- phase 2: steady-state scale with the history plane wired ------------------
+
+
+def run_scale(n_nodes: int, rounds: int = 5):
+    from tpu_network_operator.agent import report as rpt
+    from tpu_network_operator.api.v1alpha1.types import API_VERSION
+    from tpu_network_operator.controller.health import Metrics
+    from tpu_network_operator.controller.reconciler import (
+        NetworkClusterPolicyReconciler,
+    )
+    from tpu_network_operator.kube.fake import FakeCluster
+    from tpu_network_operator.kube.informer import CachedClient
+    from tpu_network_operator.obs import HistoryEngine, SloEngine, Timeline
+
+    log(f"== scale sweep (history plane on): {n_nodes} nodes")
+    fake = FakeCluster()
+    fake.create(sb.make_policy())
+    t0 = time.perf_counter()
+    for i in range(n_nodes):
+        node = f"node-{i:05d}"
+        fake.add_node(node, sb.rack_labels(i))
+        fake.apply(rpt.lease_for(sb.healthy_report(node, i), NAMESPACE))
+    log(f"   seeded in {time.perf_counter() - t0:.1f}s")
+
+    split = CachedClient(fake)
+    split.cache(API_VERSION, "NetworkClusterPolicy")
+    split.cache("apps/v1", "DaemonSet", namespace=NAMESPACE)
+    split.cache("v1", "Pod", namespace=NAMESPACE)
+    split.cache(rpt.LEASE_API, "Lease", namespace=NAMESPACE)
+    split.cache("v1", "Node")
+    split.start()
+    metrics = Metrics()
+    timeline = Timeline(metrics=metrics)
+    slo = SloEngine(timeline, metrics=metrics)
+    history = HistoryEngine(timeline, metrics=metrics, slo=slo)
+    rec = NetworkClusterPolicyReconciler(
+        split, NAMESPACE, metrics=metrics, timeline=timeline, slo=slo,
+        history=history,
+    )
+    rec.REPORT_CACHE_SECONDS = 0.0
+    rec.setup()
+
+    rec.reconcile(POLICY)
+    fake.simulate_daemonset_controller()
+    for _ in range(5):
+        before = sb.write_counts(fake)
+        rec.reconcile(POLICY)
+        if sb.delta_writes(before, sb.write_counts(fake)) == 0:
+            break
+
+    # churn first: flap one node a few times so the history plane has
+    # REAL priors (and a persisted checkpoint) before the steady
+    # measurement — an empty engine trivially writes nothing
+    for j in range(8):
+        rep = sb.healthy_report("node-00000", 0)
+        if j % 2 == 0:
+            rep.ok = False
+            rep.error = "link eth1 down"
+            rep.probe["peersReachable"] = 0
+            rep.probe["state"] = "Degraded"
+        fake.apply(rpt.lease_for(rep, NAMESPACE))
+        rec.reconcile(POLICY)
+
+    priors_version = history.priors_version(POLICY)
+    cr = fake.get(API_VERSION, "NetworkClusterPolicy", POLICY)
+    history_status = (cr.get("status", {}) or {}).get("history") or {}
+
+    # steady state: zero writes AND zero journal appends, with the
+    # rollup + checkpoint machinery live on every pass
+    steady_rounds = max(rounds * 4, 20)
+    before = sb.write_counts(fake)
+    records_before = timeline.appended()
+    steady_lat = []
+    for _ in range(steady_rounds):
+        t0 = time.perf_counter()
+        rec.reconcile(POLICY)
+        steady_lat.append(time.perf_counter() - t0)
+    steady_writes = sb.delta_writes(before, sb.write_counts(fake))
+    steady_records = timeline.appended() - records_before
+    split.stop()
+
+    log(f"   -> steady p50 "
+        f"{sb.pctile(sorted(steady_lat), 0.5) * 1e3:.3f}ms, "
+        f"{steady_writes} writes / {steady_records} journal "
+        f"records over {steady_rounds} steady passes")
+    return {
+        "nodes": n_nodes,
+        "steady_rounds": steady_rounds,
+        "steady_writes": int(steady_writes),
+        "steady_records_appended": int(steady_records),
+        "priors_version_nonzero": priors_version > 0,
+        "history_in_status": bool(history_status),
+        "tracked_links": int(history_status.get("trackedLinks", 0)),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=10000,
+                    help="steady-state sweep size")
+    ap.add_argument("--soak-nodes", type=int, default=8)
+    ap.add_argument("--cycles", type=int, default=5,
+                    help="chronic-flap fault cycles")
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default="",
+                    help="also write the JSON artifact to this path")
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    on = run_flap_soak(True, args.soak_nodes, args.cycles, args.seed)
+    off = run_flap_soak(False, args.soak_nodes, args.cycles, args.seed)
+    scale = run_scale(args.nodes, args.rounds)
+    wall = time.perf_counter() - t0
+
+    failures = []
+    # gate 1: priors-on penalizes the chronic flapper BEFORE the next
+    # injected fault, and the plan repriced on the priors trigger
+    if not any(on["penalized_before_fault"]):
+        failures.append(
+            "soak: the chronic flapper was never penalized before the "
+            "next injected fault"
+        )
+    if not on.get("victim_sticky"):
+        failures.append("soak: the victim's penalty did not stick")
+    if not on.get("victim_priced_into_plan"):
+        failures.append(
+            "soak: the latched victim never earned a plan RTT penalty"
+        )
+    # the penalty must REACH the distributed plan: the modeled
+    # all-reduce (ring perimeter on the priced matrix) carries ~2x the
+    # per-node surcharge while the latch holds, and sheds it on release
+    if not (on["modeled_sticky_ms"] - on["modeled_released_ms"]
+            >= 100.0):
+        failures.append(
+            f"soak: modeled all-reduce moved only "
+            f"{on['modeled_sticky_ms'] - on['modeled_released_ms']:.1f}"
+            "ms across the latch release — the penalty never reached "
+            "the distributed plan"
+        )
+    if not on.get("penalty_released_after_decay"):
+        failures.append(
+            "soak: the sticky penalty failed to release after decay"
+        )
+    if any(off["penalized_before_fault"]) or \
+            abs(off["modeled_sticky_ms"] - off["modeled_released_ms"]) \
+            >= 100.0:
+        failures.append(
+            "soak: the priors-off baseline somehow penalized/repriced"
+        )
+    # gate 2: mined rung skipping fires STRICTLY fewer total actions
+    if not on["remediation_actions"] < off["remediation_actions"]:
+        failures.append(
+            f"soak: priors-on fired {on['remediation_actions']} "
+            f"action(s), not strictly below the priors-off baseline's "
+            f"{off['remediation_actions']}"
+        )
+    if not on.get("rung_skips"):
+        failures.append(
+            "soak: no rung ever fell below the success floor — the "
+            "skip path was never exercised"
+        )
+    # gate 3: the ladder never empties under rung-skipping
+    if not on.get("ladder_never_empties"):
+        failures.append("soak: rung-skipping emptied a ladder")
+    if not on.get("checkpoint_cm_exists"):
+        failures.append("soak: priors checkpoint ConfigMap missing")
+    # gate 4: steady passes at scale cost zero writes, zero appends —
+    # with non-trivial priors live in the engine and in status
+    if scale["steady_writes"] != 0:
+        failures.append(
+            f"scale: {scale['steady_writes']} apiserver write(s) "
+            "across steady passes (want 0)"
+        )
+    if scale["steady_records_appended"] != 0:
+        failures.append(
+            f"scale: steady passes appended "
+            f"{scale['steady_records_appended']} journal records "
+            "(want 0)"
+        )
+    if not scale["priors_version_nonzero"]:
+        failures.append(
+            "scale: churn produced no priors — the steady gates "
+            "proved nothing"
+        )
+    if not scale["history_in_status"]:
+        failures.append("scale: status.history missing after churn")
+
+    result = {
+        "metric": "remediation actions avoided by mined priors over "
+                  f"{on['cycles']} chronic-flap cycles",
+        "value": off["remediation_actions"] - on["remediation_actions"],
+        "unit": "actions",
+        # priors-on actions as a fraction of the priors-off baseline
+        # (< 1.0 = the history plane is strictly cheaper)
+        "vs_baseline": round(
+            on["remediation_actions"]
+            / max(off["remediation_actions"], 1), 3
+        ),
+        "seed": args.seed,
+        "priors_on": on,
+        "priors_off": off,
+        "scale": scale,
+        "wall_seconds": round(wall, 3),
+        "ok": not failures,
+        "failures": failures,
+    }
+    line = json.dumps(result)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(line)
+    if failures:
+        log("FAILED: " + "; ".join(failures))
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

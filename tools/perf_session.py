@@ -211,6 +211,18 @@ def main() -> int:
         maybe_run_phase(out, "timeline-bench",
                   [py, "tools/timeline_bench.py",
                    "--out", "BENCH_timeline.json"], timeout=900)
+        # 16b. history plane: the flight recorder mined into priors —
+        # a seeded chronic-flap soak run twice (priors on vs off) must
+        # latch the flapper's sticky penalty before the next injected
+        # fault, price it into the distributed plan's modeled
+        # all-reduce, and fire strictly fewer remediation actions via
+        # mined rung-skipping (ladder never empties); the 10k-node
+        # steady sweep with the full history plane + checkpoint CM
+        # wired must stay at zero writes and zero journal appends
+        # (no TPU, in-process)
+        maybe_run_phase(out, "history-bench",
+                  [py, "tools/history_bench.py",
+                   "--out", "BENCH_history.json"], timeout=900)
         # 17. plan execution: the multi-process collective rung — N
         # local jax.distributed workers (CPU backend) consume a real
         # agent-written bootstrap + plan block and measure

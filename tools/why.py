@@ -231,6 +231,98 @@ def explain(
     return "\n".join(lines)
 
 
+def forecast(
+    node: str, summary: Dict[str, Any], policy: str = ""
+) -> str:
+    """The forward-looking companion to :func:`explain`: instead of
+    narrating how the node GOT here, render what the history plane
+    predicts and is already doing about it — decayed flap score vs the
+    sticky thresholds, the plan-pricing consequence, mined per-rung
+    success rates and the skips they drive, and the burn-rate urgency.
+    ``summary`` is a ``/debug/history`` body (HistoryEngine.summary())."""
+    policies = summary.get("policies", {}) or {}
+    pols = (
+        [policy] if policy
+        else sorted(
+            p for p, body in policies.items()
+            if any(
+                link.get("node") == node
+                for link in body.get("links", []) or []
+            )
+        ) or sorted(policies)
+    )
+    assert_at = float(summary.get("penaltyAssert", 0.0) or 0.0)
+    release_at = float(summary.get("penaltyRelease", 0.0) or 0.0)
+
+    lines = [f"forecast {node}"]
+    if not pols:
+        lines.append(
+            "  no mined priors yet — the history plane has seen no "
+            "journaled transitions (or the operator just started)"
+        )
+        return "\n".join(lines)
+    for pol in pols:
+        body = policies.get(pol, {}) or {}
+        lines.append(f"  policy {pol}:")
+        links = [
+            link for link in body.get("links", []) or []
+            if link.get("node") == node
+        ]
+        if links:
+            for link in links:
+                iface = link.get("interface", "")
+                label = f"{node}/{iface}" if iface else node
+                score = float(link.get("flapScore", 0.0) or 0.0)
+                line = (
+                    f"    flap prior {label}: score {score:.2f} over "
+                    f"{link.get('events', 0)} event(s)"
+                )
+                if link.get("sticky"):
+                    line += (
+                        f" — STICKY (plan prices this node's edges "
+                        f"up until the score decays below "
+                        f"{release_at:g})"
+                    )
+                else:
+                    line += f" (asserts at {assert_at:g})"
+                lines.append(line)
+        else:
+            lines.append(
+                "    no flap evidence for this node — steady, or "
+                "decayed out of the window"
+            )
+        skips = body.get("skips", {}) or {}
+        for rung in body.get("rungs", []) or []:
+            cls, action = rung.get("class", ""), rung.get("action", "")
+            fired = int(rung.get("fired", 0) or 0)
+            ok = int(rung.get("ok", 0) or 0)
+            failed = int(rung.get("failed", 0) or 0)
+            esc = int(rung.get("escalated", 0) or 0)
+            samples = ok + failed + esc
+            rate = (ok / samples) if samples else 1.0
+            line = (
+                f"    rung prior {cls}/{action}: success {rate:.2f} "
+                f"({fired} fired, {ok} ok, {failed} failed, "
+                f"{esc} escalated)"
+            )
+            if action in (skips.get(cls) or []):
+                line += " — SKIPPED (below the success floor)"
+            lines.append(line)
+        burn = float(body.get("urgencyBurnRate", 0.0) or 0.0)
+        if burn > 1.0:
+            lines.append(
+                f"    urgency: readiness burn rate {burn:.2f} — the "
+                f"remediation budget window is scaled down to act "
+                f"faster"
+            )
+        elif burn:
+            lines.append(
+                f"    urgency: readiness burn rate {burn:.2f} "
+                f"(sustainable)"
+            )
+    return "\n".join(lines)
+
+
 # -- data sources --------------------------------------------------------------
 
 
@@ -281,10 +373,11 @@ def main(
     client=None,
     timeline=None,
     tracer=None,
+    history=None,
 ) -> int:
-    """CLI entry.  ``client``/``timeline``/``tracer`` are in-process
-    seams: tests and benches pass a FakeCluster + live Timeline/Tracer
-    and skip all HTTP."""
+    """CLI entry.  ``client``/``timeline``/``tracer``/``history`` are
+    in-process seams: tests and benches pass a FakeCluster + live
+    Timeline/Tracer/HistoryEngine and skip all HTTP."""
     ap = argparse.ArgumentParser(
         prog="tpunet-why",
         description="explain a node's health history causally",
@@ -303,8 +396,31 @@ def main(
     ap.add_argument("--token-env", default="TPUNET_KUBE_TOKEN")
     ap.add_argument("--max", type=int, default=50,
                     help="newest transitions to narrate")
+    ap.add_argument("--forecast", action="store_true",
+                    help="render the history plane's forward-looking "
+                         "view (flap priors, rung success rates, "
+                         "active skips) instead of the causal chain")
+    ap.add_argument("--history-url", default="",
+                    help="operator /debug/history endpoint")
     args = ap.parse_args(argv)
     token = os.environ.get(args.token_env, "")
+
+    if args.forecast:
+        if history is not None:
+            summary = history.summary()
+        elif args.history_url:
+            try:
+                summary = json.loads(_http_get(args.history_url, token))
+            except Exception as e:   # noqa: BLE001 — explain the miss
+                print(f"error: fetch {args.history_url} failed: {e}",
+                      file=sys.stderr)
+                return 1
+        else:
+            print("error: --forecast needs --history-url (or an "
+                  "in-process history seam)", file=sys.stderr)
+            return 1
+        print(forecast(args.node, summary, policy=args.policy))
+        return 0
 
     records: List[Dict[str, Any]] = []
     spans: List[Dict[str, Any]] = []

@@ -609,6 +609,26 @@ def openapi_schema() -> Dict[str, Any]:
                             "transitionsTotal": {"type": "integer"},
                         },
                     },
+                    "history": {
+                        "type": "object",
+                        "description": (
+                            "History-plane rollup mined from the fleet "
+                            "timeline journal: sticky flap penalties "
+                            "priced into the topology plan, per-rung "
+                            "remediation success rates driving rung "
+                            "skips, and the burn-scaled budget window "
+                            "(full priors served from /debug/history)."
+                        ),
+                        "properties": {
+                            "trackedLinks": {"type": "integer"},
+                            "stickyPenalties": {"type": "integer"},
+                            "flappingNodes": {"type": "integer"},
+                            "remediationSuccessRate": {"type": "number"},
+                            "rungsSkipped": {"type": "integer"},
+                            "budgetWindowSeconds": {"type": "number"},
+                            "urgencyBurnRate": {"type": "number"},
+                        },
+                    },
                     "summary": {
                         "type": "object",
                         "description": (

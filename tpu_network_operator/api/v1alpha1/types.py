@@ -536,6 +536,37 @@ class HealthStatus:
 
 
 @dataclass
+class HistoryStatus:
+    """Bounded history-plane rollup (obs/history.py) — what the priors
+    mined from the flight recorder currently say about this policy's
+    fleet.  Scalars only: the full priors snapshot lives in the
+    ``tpunet-history-<policy>`` checkpoint ConfigMap and behind
+    ``/debug/history``.  Cached per fold-version, so a steady pass
+    serializes it byte-identically (zero-steady-write contract)."""
+
+    # (node, interface) flap keys with observed flap events in the
+    # decay window
+    tracked_links: int = j("trackedLinks", 0)
+    # keys currently under the sticky hysteresis penalty (chronic
+    # flappers the planner prices around pre-emptively)
+    sticky_penalties: int = j("stickyPenalties", 0)
+    # distinct nodes carrying at least one sticky penalty
+    flapping_nodes: int = j("flappingNodes", 0)
+    # remediation outcomes mined from the journal: ok/(ok+failed+
+    # escalated) across all (class, action) rungs (1.0 when unobserved)
+    remediation_success_rate: float = j("remediationSuccessRate", 1.0)
+    # (anomaly class, action) rungs currently skipped for chronically
+    # poor measured success
+    rungs_skipped: int = j("rungsSkipped", 0)
+    # the adaptive remediation budget window currently in force
+    # (seconds; shrinks below the configured window while the readiness
+    # SLO burns)
+    budget_window_seconds: float = j("budgetWindowSeconds", 0.0)
+    # the live urgency signal: the SLO engine's fast-window burn rate
+    urgency_burn_rate: float = j("urgencyBurnRate", 0.0)
+
+
+@dataclass
 class PolicyCondition:
     """metav1.Condition subset (the DataplaneDegraded carrier)."""
 
@@ -577,6 +608,9 @@ class NetworkClusterPolicyStatus:
     # SLO rollup from the fleet timeline journal (omit-empty: absent
     # unless the operator runs with the SLO engine wired)
     health: Optional[HealthStatus] = j("health", None)
+    # history-plane priors rollup (omit-empty: absent unless the
+    # operator runs with the history engine wired)
+    history: Optional[HistoryStatus] = j("history", None)
 
 
 @dataclass

@@ -163,6 +163,28 @@ METRIC_HELP: Dict[str, str] = {
     "tpunet_fleet_ready_nodes":
         "Ready nodes across every control-plane shard (the "
         "aggregator's fleet fold; shard-0 owner only).",
+    "tpunet_fleet_sticky_penalties":
+        "Links under a sticky history-plane flap penalty across every "
+        "control-plane shard (the aggregator's fleet fold; shard-0 "
+        "owner only).",
+    "tpunet_history_tracked_links":
+        "Links (node or node/interface) the history plane currently "
+        "holds flap evidence for, per policy.",
+    "tpunet_history_sticky_penalties":
+        "Links under a sticky flap penalty per policy — priced into "
+        "the topology plan as an RTT surcharge until the decayed flap "
+        "score falls below the release threshold.",
+    "tpunet_history_rung_success_rate":
+        "Mined success rate of one remediation rung, per policy, "
+        "anomaly class and action (outcomes ok / (ok + failed + "
+        "escalated); 1.0 until the rung has samples).",
+    "tpunet_history_rungs_skipped":
+        "Remediation rungs the ladder currently skips because their "
+        "mined success rate sits below the floor, per policy.",
+    "tpunet_history_budget_window_seconds":
+        "Effective remediation budget window after burn-rate scaling, "
+        "per policy (equals the configured window while the readiness "
+        "burn rate is sustainable).",
     "tpunet_rebuild_resumed_nodes_total":
         "Nodes a full rebuild resumed from a contribution cache "
         "instead of re-deriving, by source (memory = unchanged lease "
@@ -454,6 +476,7 @@ class HealthServer:
         tls_cert_dir: Optional[str] = None,
         tracer=None,
         timeline=None,
+        history=None,
     ):
         """``metrics=None`` means NO /metrics endpoint on this server (the
         probe port must not leak the registry the secure port protects).
@@ -466,12 +489,16 @@ class HealthServer:
         names the probe port must not leak).  ``timeline`` (an
         :class:`..obs.Timeline`) serves the fleet transition journal
         from ``/debug/timeline`` behind the same gate, with
-        policy/node/kind/since/limit query filters."""
+        policy/node/kind/since/limit query filters.  ``history`` (an
+        :class:`..obs.HistoryEngine`) serves the mined priors —
+        sticky flap penalties, per-rung success rates, active skips —
+        from ``/debug/history`` behind the same gate."""
         self.checks: Dict[str, Callable[[], bool]] = {"ping": lambda: True}
         self.ready_checks: Dict[str, Callable[[], bool]] = {"ping": lambda: True}
         self.metrics = metrics
         self.tracer = tracer
         self.timeline = timeline
+        self.history = history
         self._metrics_auth = metrics_auth
 
         outer = self
@@ -574,6 +601,18 @@ class HealthServer:
                             "dropped": outer.timeline.dropped(),
                             "policies": outer.timeline.policies(),
                         }),
+                        "application/json",
+                    )
+                elif path == "/debug/history":
+                    if outer.history is None:
+                        self._respond(404, "history not served here")
+                        return
+                    if not self._authorized():
+                        self._respond(403, "forbidden")
+                        return
+                    self._respond(
+                        200,
+                        json.dumps(outer.history.summary()),
                         "application/json",
                     )
                 else:

@@ -27,7 +27,7 @@ from ..api.v1alpha1.types import API_VERSION, NetworkClusterPolicy
 from ..kube.client import ApiClient, is_openshift
 from ..kube.informer import CachedClient
 from ..kube.retry import RetryingClient
-from ..obs import EventRecorder, SloEngine, Timeline, Tracer
+from ..obs import EventRecorder, HistoryEngine, SloEngine, Timeline, Tracer
 from ..obs import logging as obs_logging
 from .health import DEFAULT as METRICS, CachedTokenAuthenticator, HealthServer
 from .leader import LeaderElector
@@ -206,13 +206,17 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
     # transitions at its existing edge-detection points (steady passes
     # append nothing) and the engine folds them into tpunet_slo_*
     # burn-rate metrics and the status.health rollup
-    timeline = slo = None
+    timeline = slo = history = None
     if args.timeline_buffer_bytes > 0:
         timeline = Timeline(
             policy_byte_budget=args.timeline_buffer_bytes,
             metrics=METRICS,
         )
         slo = SloEngine(timeline, metrics=METRICS)
+        # history plane: the same journal mined into priors that feed
+        # BACK into the planner (pre-emptive route-around) and the
+        # remediation ladder (rung skipping, burn-scaled budgets)
+        history = HistoryEngine(timeline, metrics=METRICS, slo=slo)
 
     # horizontal sharding (controller/sharding.py): per-shard Leases
     # partition the policy set across replicas.  Like leader election,
@@ -226,6 +230,9 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
             RetryingClient(client, max_attempts=3, budget=1.5,
                            metrics=METRICS),
             args.namespace, n_shards=args.shard_count, metrics=METRICS,
+            # shard ownership edges journal into the flight recorder
+            # (acquire/failover/release under the _shards pseudo-policy)
+            timeline=timeline,
         )
         aggregator = ShardAggregator(
             RetryingClient(client, max_attempts=3, budget=1.5,
@@ -244,7 +251,7 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
                   metrics=METRICS,
                   concurrent_reconciles=args.concurrent_reconciles,
                   tracer=tracer, events=recorder,
-                  timeline=timeline, slo=slo,
+                  timeline=timeline, slo=slo, history=history,
                   sharding=coordinator, aggregator=aggregator)
     mgr.reconciler.REPORT_CACHE_SECONDS = args.report_cache_seconds
     if args.peer_shard_byte_budget > 0:
@@ -280,14 +287,14 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
                     "--metrics-secure: no serving cert in %s; metrics "
                     "served over plain HTTP", args.webhook_cert_dir,
                 )
-        # the metrics listener also serves /debug/traces and
-        # /debug/timeline (same authn gate): span attributes and
-        # journal records carry object names the unauthenticated probe
-        # port must not leak
+        # the metrics listener also serves /debug/traces,
+        # /debug/timeline and /debug/history (same authn gate): span
+        # attributes, journal records and mined priors carry object
+        # names the unauthenticated probe port must not leak
         servers.append(HealthServer(
             port=_port_of(args.metrics_bind_address),
             metrics=METRICS, metrics_auth=auth, tls_cert_dir=tls_dir,
-            tracer=tracer, timeline=timeline,
+            tracer=tracer, timeline=timeline, history=history,
         ))
 
     webhook_server = None

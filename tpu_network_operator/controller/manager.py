@@ -121,7 +121,8 @@ class Manager:
         self, client, namespace: str, is_openshift: bool = False,
         metrics=None, resync_interval: float = 60.0,
         concurrent_reconciles: int = 4, tracer=None, events=None,
-        timeline=None, slo=None, sharding=None, aggregator=None,
+        timeline=None, slo=None, history=None, sharding=None,
+        aggregator=None,
     ):
         self.client = client
         self.namespace = namespace
@@ -156,6 +157,7 @@ class Manager:
         self.reconciler = NetworkClusterPolicyReconciler(
             client, namespace, is_openshift, metrics=metrics,
             tracer=tracer, events=events, timeline=timeline, slo=slo,
+            history=history,
             # the rebuild fan-out shares the worker budget the operator
             # was sized for (--concurrent-reconciles)
             rebuild_workers=self.concurrent_reconciles,
@@ -342,9 +344,16 @@ class Manager:
                 except Exception:   # noqa: BLE001 — deleted mid-tick
                     continue
                 status = obj.get("status", {}) or {}
+                history = status.get("history", {}) or {}
                 rollups.setdefault(shard, {})[name] = {
                     "targets": int(status.get("targets", 0) or 0),
                     "ready": int(status.get("ready", 0) or 0),
+                    # history-plane rollup rides the same CM so the
+                    # shard-0 aggregator can export a fleet-level
+                    # prior count without any new read path
+                    "stickyPenalties": int(
+                        history.get("stickyPenalties", 0) or 0
+                    ),
                 }
             for shard in sorted(sc.owned):
                 self.aggregator.publish(shard, rollups.get(shard, {}))

@@ -27,6 +27,7 @@ from ..api.v1alpha1.types import NetworkClusterPolicy
 from ..kube import errors as kerr
 from ..kube.informer import LIST_PAGE_SIZE   # noqa: F401 — re-exported
 from ..obs import events as obs_events
+from ..obs import history as obs_history
 from ..obs import timeline as obs_tl
 from ..obs.trace import TRACE_ANNOTATION, current_trace_id
 from ..planner import PlanTracker
@@ -155,6 +156,10 @@ REMEDIATION_GAUGES = ("tpunet_remediation_pending",)
 # ConfigMaps) — distinct from the probe/planner managers so server-
 # side-apply ownership never collides across subsystems
 REMEDIATION_FIELD_MANAGER = "tpunet-operator-remediation"
+
+# field manager for the history-plane priors checkpoint ConfigMap —
+# same ownership-isolation rationale as the managers above
+HISTORY_FIELD_MANAGER = "tpunet-operator-history"
 
 
 @dataclass
@@ -457,7 +462,7 @@ class NetworkClusterPolicyReconciler:
     def __init__(
         self, client, namespace: str, is_openshift: bool = False,
         metrics=None, tracer=None, events=None, timeline=None, slo=None,
-        rebuild_workers: int = 0,
+        history=None, rebuild_workers: int = 0,
     ):
         self.client = client
         self.namespace = namespace
@@ -475,6 +480,13 @@ class NetworkClusterPolicyReconciler:
         self.events = events
         self.timeline = timeline
         self.slo = slo
+        # history engine (obs/history.py): priors mined from the
+        # timeline drive pre-emptive plan pricing, rung skipping and
+        # the adaptive remediation budget; the reconciler additionally
+        # checkpoints its priors into a diff-gated owned ConfigMap so
+        # a failed-over shard replica resumes them (amnesia would
+        # re-trust every chronic flapper on takeover)
+        self.history = history
         self._reports_cache: Optional[Dict[str, List[Any]]] = None
         self._reports_cached_at = 0.0
         # concurrent workers share one reconciler instance; the bucket
@@ -581,6 +593,17 @@ class NetworkClusterPolicyReconciler:
         # _reports_lock like the peer-flush state
         self._contrib_applied: Dict[str, Dict[str, Dict[str, str]]] = {}
         self._contrib_fp: Dict[str, Any] = {}
+        # history-priors checkpoint (obs/history.py to_payload): the
+        # fold version the last checkpoint was serialized from (skips
+        # even serialization on steady passes) and the last-applied CM
+        # payload (the write diff gate); policies whose checkpoint was
+        # already probed for a resume; all under _reports_lock
+        self._history_applied: Dict[str, str] = {}
+        self._history_version: Dict[str, int] = {}
+        self._history_probed: set = set()
+        # last priors fingerprint the plan consumed, for replan-trigger
+        # classification (single-writer per policy, workqueue contract)
+        self._plan_priors: Dict[str, str] = {}
 
     # -- setup ----------------------------------------------------------------
 
@@ -2031,6 +2054,17 @@ class NetworkClusterPolicyReconciler:
             self._rem_quorum_held.pop(name, None)
             self._contrib_applied.pop(name, None)
             self._contrib_fp.pop(name, None)
+            self._history_applied.pop(name, None)
+        self._history_version.pop(name, None)
+        # un-probe so a later re-acquire reloads the checkpoint the
+        # successor has been writing in the meantime — and hand the
+        # mined state itself back too: the successor's engine is the
+        # authority now, and keeping a stale local copy would feed the
+        # planner pre-failover priors if ownership ever flips back
+        self._history_probed.discard(name)
+        self._plan_priors.pop(name, None)
+        if self.history is not None:
+            self.history.forget(name)
         self._plan_tracker.forget(name)
         if self.metrics:
             for gauge in POLICY_GAUGES + PLAN_GAUGES + REMEDIATION_GAUGES:
@@ -2854,11 +2888,22 @@ class NetworkClusterPolicyReconciler:
         # a persisted degradation) — the same exclusion set the old
         # fleet-wide row scan produced
         excluded = (d.degraded | set(d.anomalous_nodes())) & set(nodes)
+        rtt = planner_plan.build_matrix({
+            n: dict(row) for n, row in d.plan_obs.items()
+        })
+        priors_fp = ""
+        if self.history is not None:
+            # price the history plane's sticky flap penalties into the
+            # measured matrix: a chronic flapper's links cost extra
+            # BEFORE its next fault (pre-emptive route-around), and the
+            # fingerprint makes latch flips structural to the tracker
+            rtt = planner_plan.apply_penalties(
+                rtt, self.history.plan_penalties(pname)
+            )
+            priors_fp = self.history.plan_fingerprint(pname)
         inputs = planner_plan.PlanInputs(
             nodes=nodes,
-            rtt=planner_plan.build_matrix({
-                n: dict(row) for n, row in d.plan_obs.items()
-            }),
+            rtt=rtt,
             groups=groups,
             excluded=frozenset(excluded),
             seed=pname,
@@ -2866,6 +2911,7 @@ class NetworkClusterPolicyReconciler:
                 spec.spread_threshold_ms
                 or t.DEFAULT_PLAN_SPREAD_THRESHOLD_MS
             ),
+            priors=priors_fp,
         )
         old_version = (
             policy.status.plan.version if policy.status.plan else ""
@@ -2921,6 +2967,11 @@ class NetworkClusterPolicyReconciler:
                 trigger = "membership"
             elif sorted(prev_plan.excluded) != sorted(plan.excluded):
                 trigger = "exclusion"
+            elif self._plan_priors.get(pname, "") != priors_fp:
+                # same membership/exclusions but the sticky-penalty set
+                # flipped: the replan is the history plane routing the
+                # ring around (or back through) a chronic flapper
+                trigger = "priors"
             else:
                 trigger = "drift"
             if self.timeline is not None:
@@ -2942,6 +2993,7 @@ class NetworkClusterPolicyReconciler:
                     if plan.excluded else ""
                 ),
             )
+        self._plan_priors[pname] = priors_fp
         excluded = plan.excluded
         if len(excluded) > t.PLAN_STATUS_EXCLUDED_K:
             excluded = excluded[:t.PLAN_STATUS_EXCLUDED_K] + [
@@ -2977,6 +3029,7 @@ class NetworkClusterPolicyReconciler:
             known = dict(self._plan_labels.pop(policy_name, {}) or {})
             self._plan_cm_applied.pop(policy_name, None)
         self._plan_tracker.forget(policy_name)
+        self._plan_priors.pop(policy_name, None)
         labeled = set(known)
         if members:
             for node, state in self._current_plan_labels(
@@ -3138,6 +3191,90 @@ class NetworkClusterPolicyReconciler:
             log.warning("remediation ConfigMap apply failed: %s", e)
             return False
 
+    def _ensure_history_loaded(self, pname: str) -> None:
+        """Resume mined priors from the ``tpunet-history-<policy>``
+        checkpoint ConfigMap — ONE read per policy acquire (restart or
+        shard failover), the ledger-restore pattern.  NotFound is the
+        normal cold start (nothing to resume); a transient read error
+        leaves the policy unprobed so the next pass retries instead of
+        silently running amnesiac forever."""
+        import json
+
+        if pname in self._history_probed:
+            return
+        try:
+            cm = self.client.get(
+                "v1", "ConfigMap",
+                obs_history.history_cm_name(pname), self.namespace,
+            )
+        except kerr.NotFoundError:
+            self._history_probed.add(pname)
+            return
+        except Exception as e:   # noqa: BLE001 — retry next pass
+            log.debug("history checkpoint read failed: %s", e)
+            return
+        self._history_probed.add(pname)
+        raw = (cm.get("data", {}) or {}).get(obs_history.HISTORY_CM_KEY)
+        if not raw:
+            return
+        try:
+            payload = json.loads(raw)
+        except ValueError:
+            log.warning("history checkpoint for %s unparseable; "
+                        "re-mining from scratch", pname)
+            return
+        if self.history.load_payload(pname, payload):
+            log.info("resumed history priors for %s from checkpoint",
+                     pname)
+        # seed the save-side diff gate with what the cluster holds —
+        # whether or not the engine accepted the payload (a warm
+        # engine's next snapshot diffs against this and writes once)
+        with self._reports_lock:
+            self._history_applied[pname] = raw
+
+    def _save_history_checkpoint(
+        self, policy: NetworkClusterPolicy
+    ) -> None:
+        """Diff-gated priors checkpoint, double-gated for the
+        zero-steady-write contract: the engine's fold version gates
+        serialization (a pass with no new journal records costs a dict
+        lookup), and the serialized payload gates the apply (a fold
+        that didn't move the snapshot costs zero apiserver requests).
+        The CM is owned by the policy CR, so it is GC'd with it."""
+        import json
+
+        pname = policy.metadata.name
+        version = self.history.priors_version(pname)
+        if version == 0 or self._history_version.get(pname) == version:
+            return
+        payload = json.dumps(
+            self.history.to_payload(pname),
+            sort_keys=True, separators=(",", ":"),
+        )
+        with self._reports_lock:
+            applied = self._history_applied.get(pname)
+        if applied == payload:
+            self._history_version[pname] = version
+            return
+        cm = {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {
+                "name": obs_history.history_cm_name(pname),
+                "namespace": self.namespace,
+            },
+            "data": {obs_history.HISTORY_CM_KEY: payload},
+        }
+        self._own(policy, cm)
+        try:
+            self.client.apply(cm, field_manager=HISTORY_FIELD_MANAGER)
+        except Exception as e:   # noqa: BLE001 — next pass retries
+            log.warning("history checkpoint apply failed: %s", e)
+            return
+        with self._reports_lock:
+            self._history_applied[pname] = payload
+        self._history_version[pname] = version
+
     def _restart_agent_pod(self, ds: Dict[str, Any], node: str):
         """The restart-agent rung, executed controller-side: delete the
         node's agent pod (the DaemonSet controller re-creates it — a
@@ -3229,15 +3366,28 @@ class NetworkClusterPolicyReconciler:
         # floor would collapse the safety margin on any fleet larger
         # than the peer quorum.
         min_healthy = len(members) // 2
+        window_seconds = float(
+            spec.window_seconds
+            or t.DEFAULT_REMEDIATION_WINDOW_SECONDS
+        )
+        skip_actions: Dict[str, FrozenSet[str]] = {}
+        if self.history is not None:
+            # history plane: shrink the budget window while the
+            # readiness SLO burns (the same node budget refills
+            # faster — remediate with urgency, hold the configured
+            # pace when healthy) and skip rungs whose MEASURED success
+            # rate for this anomaly class fell below the floor
+            # (bounded: effective_ladder never empties)
+            window_seconds = self.history.budget_window(
+                pname, window_seconds
+            )
+            skip_actions = self.history.rung_skips(pname)
         knobs = Knobs(
             max_nodes_per_window=(
                 spec.max_nodes_per_window
                 or t.DEFAULT_REMEDIATION_MAX_NODES_PER_WINDOW
             ),
-            window_seconds=float(
-                spec.window_seconds
-                or t.DEFAULT_REMEDIATION_WINDOW_SECONDS
-            ),
+            window_seconds=window_seconds,
             cooldown_seconds=float(
                 spec.cooldown_seconds
                 or t.DEFAULT_REMEDIATION_COOLDOWN_SECONDS
@@ -3252,6 +3402,7 @@ class NetworkClusterPolicyReconciler:
                 else frozenset(rem_policy.ACTIONS)
             ),
             min_healthy=min_healthy,
+            skip_actions=skip_actions,
         )
         now = self._rem_clock()
         # a span under the stitched reconcile trace, but only when the
@@ -3593,6 +3744,12 @@ class NetworkClusterPolicyReconciler:
         from ..agent import report as rpt
 
         pname = policy.metadata.name
+        if self.history is not None:
+            # priors resume (one read per acquire): must land BEFORE
+            # the plan/remediation passes below consume the priors, or
+            # a failed-over replica's first pass re-trusts a chronic
+            # flapper the predecessor had already penalized
+            self._ensure_history_loaded(pname)
         ps = self._pass_state.setdefault(pname, PassState())
         now_wall = time_mod.time()
         now_probe = self._probe_clock()
@@ -3757,6 +3914,7 @@ class NetworkClusterPolicyReconciler:
         old_plan = am.to_dict(policy.status.plan)
         old_remediation = am.to_dict(policy.status.remediation)
         old_health = am.to_dict(policy.status.health)
+        old_history = am.to_dict(policy.status.history)
         # reaching a status pass IS a successful reconcile: clear any
         # ReconcileDegraded condition a past permanent failure parked
         # here (the conditions diff below flushes the change)
@@ -4103,6 +4261,16 @@ class NetworkClusterPolicyReconciler:
             policy.status.health = self.slo.health_status(pname)
         else:
             policy.status.health = None
+        # history rollup + priors checkpoint: the engine caches the
+        # rollup per fold-version (identical object on steady passes)
+        # and the checkpoint write is double-gated (version, then
+        # payload diff) — a steady pass costs zero serialization and
+        # zero apiserver requests here
+        if self.history is not None:
+            policy.status.history = self.history.history_status(pname)
+            self._save_history_checkpoint(policy)
+        else:
+            policy.status.history = None
         phases["aggregate"] += t_phase() - p0
 
         # -- phase: project — status diff + (maybe) one write ---------
@@ -4120,6 +4288,7 @@ class NetworkClusterPolicyReconciler:
             or am.to_dict(policy.status.plan) != old_plan
             or am.to_dict(policy.status.remediation) != old_remediation
             or am.to_dict(policy.status.health) != old_health
+            or am.to_dict(policy.status.history) != old_history
         )
         policy.status.targets = targets
         policy.status.ready_nodes = ready
@@ -4238,6 +4407,16 @@ class NetworkClusterPolicyReconciler:
                 self.timeline.forget(name)
             if self.slo is not None:
                 self.slo.forget(name)
+            # history priors die with the policy (the checkpoint CM is
+            # owner-GC'd with the CR; drop the mined state + diff
+            # gates + metric series here)
+            if self.history is not None:
+                self.history.forget(name)
+            with self._reports_lock:
+                self._history_applied.pop(name, None)
+            self._history_version.pop(name, None)
+            self._history_probed.discard(name)
+            self._plan_priors.pop(name, None)
             return Result()
 
         owned = self.client.list(

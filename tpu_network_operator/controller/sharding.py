@@ -46,6 +46,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ..kube import errors as kerr
+from ..obs import timeline as obs_tl
 from ..probe.topology import stable_hash
 from .leader import LEASE_DURATION, _parse
 
@@ -115,6 +116,7 @@ class ShardCoordinator:
         lease_duration: float = LEASE_DURATION,
         clock=None,
         metrics=None,
+        timeline=None,
     ):
         import time as time_mod
 
@@ -127,9 +129,27 @@ class ShardCoordinator:
         self.lease_duration = lease_duration
         self.clock = clock or time_mod.time
         self.metrics = metrics
+        # flight recorder seam: ownership EDGES (acquire / failover /
+        # release) journal under the reserved fleet-scoped pseudo-
+        # policy — renewals are steady state and never append
+        self.timeline = timeline
         self.owned: Set[int] = set()
+        # shard -> holderIdentity observed on the lease just before we
+        # took it (sync() uses it to tell a failover takeover from a
+        # fresh/clean acquire when journaling the gained edge)
+        self._observed_holder: Dict[int, str] = {}
         self._lock = threading.Lock()
         self._stopped = False
+
+    def _journal(self, shard: int, to: str, frm: str = "") -> None:
+        if self.timeline is None:
+            return
+        self.timeline.record(
+            obs_tl.SHARD_POLICY, obs_tl.KIND_SHARD,
+            node=f"shard-{shard}", frm=frm, to=to,
+            reason="ShardOwnership", directive_id=self.identity,
+            detail=self.identity, ts=self.clock(),
+        )
 
     # -- lease plumbing -------------------------------------------------------
 
@@ -200,6 +220,7 @@ class ShardCoordinator:
         except kerr.NotFoundError:
             try:
                 self.client.create(self._lease_obj(name))
+                self._observed_holder[shard] = ""
                 return True
             except (kerr.AlreadyExistsError, kerr.ConflictError):
                 return False
@@ -215,6 +236,7 @@ class ShardCoordinator:
         spec["leaseDurationSeconds"] = int(self.lease_duration)
         try:
             self.client.update(lease)
+            self._observed_holder[shard] = holder
             return True
         except kerr.ConflictError:
             return False
@@ -267,6 +289,17 @@ class ShardCoordinator:
         with self._lock:
             self.owned = now_owned
         gained, lost = now_owned - before, before - now_owned
+        for shard in sorted(gained):
+            prev = self._observed_holder.get(shard, "")
+            if prev and prev != self.identity:
+                # took over a lease a DIFFERENT replica let expire —
+                # the failover edge tools/why.py walks to explain why
+                # priors/state resumed from a checkpoint
+                self._journal(shard, "failover", frm=prev)
+            else:
+                self._journal(shard, "acquired")
+        for shard in sorted(lost):
+            self._journal(shard, "released", frm=self.identity)
         if self.metrics:
             for shard in range(self.n_shards):
                 if shard in now_owned:
@@ -305,6 +338,7 @@ class ShardCoordinator:
             self.owned = set()
         for shard in owned:
             self._release_shard(shard)
+            self._journal(shard, "released", frm=self.identity)
         if self.metrics:
             for shard in owned:
                 self.metrics.remove_gauge(
@@ -396,7 +430,10 @@ class ShardAggregator:
         except Exception as e:   # noqa: BLE001 — next tick retries
             log.warning("rollup aggregation list failed: %s", e)
             return {}
-        fleet = {"policies": 0.0, "targets": 0.0, "ready": 0.0}
+        fleet = {
+            "policies": 0.0, "targets": 0.0, "ready": 0.0,
+            "stickyPenalties": 0.0,
+        }
         per_shard: Dict[str, int] = {}
         for cm in cms:
             name = cm.get("metadata", {}).get("name", "")
@@ -414,12 +451,17 @@ class ShardAggregator:
             for v in policies.values():
                 fleet["targets"] += float(v.get("targets", 0))
                 fleet["ready"] += float(v.get("ready", 0))
+                fleet["stickyPenalties"] += float(
+                    v.get("stickyPenalties", 0)
+                )
         if self.metrics:
             self.metrics.set_gauge("tpunet_fleet_policies",
                                    fleet["policies"])
             self.metrics.set_gauge("tpunet_fleet_nodes", fleet["targets"])
             self.metrics.set_gauge("tpunet_fleet_ready_nodes",
                                    fleet["ready"])
+            self.metrics.set_gauge("tpunet_fleet_sticky_penalties",
+                                   fleet["stickyPenalties"])
             for shard, count in per_shard.items():
                 self.metrics.set_gauge(
                     "tpunet_shard_policies", float(count),

@@ -28,9 +28,17 @@ traffic at fleet scale):
   SLOs (fleet readiness, fault-detection latency, remediation
   convergence, fast-path hit ratio) exported as ``tpunet_slo_*``
   metrics and the bounded ``status.health`` rollup.
+* :mod:`.history` — the history plane: the same journal mined into
+  decision-grade priors (flap-frequency penalties with hysteresis,
+  per-rung remediation success rates, burn-rate urgency) that feed
+  BACK into the planner and remediation ladder — pre-emptive
+  route-around, rung skipping, adaptive budget windows — exported as
+  ``tpunet_history_*`` metrics, the bounded ``status.history``
+  rollup, and ``/debug/history``.
 """
 
 from .events import EventRecorder
+from .history import HistoryEngine
 from .logging import JsonFormatter, setup_logging
 from .slo import SloEngine
 from .timeline import Timeline
@@ -44,6 +52,7 @@ from .trace import (
 
 __all__ = [
     "EventRecorder",
+    "HistoryEngine",
     "JsonFormatter",
     "setup_logging",
     "SloEngine",

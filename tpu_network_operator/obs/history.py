@@ -1,0 +1,629 @@
+"""History engine: mine the flight recorder into decision-grade priors.
+
+The timeline journal (obs/timeline.py) remembers *what happened*; until
+now nothing but ``tools/why.py`` read it.  This module closes the loop:
+it subscribes to the journal exactly like :class:`.slo.SloEngine` and
+folds transition records into three prior families the control plane
+consumes **before** the next fault instead of after it:
+
+* **flap priors** — per-(policy, node, interface) flap-event mass with
+  exponential time decay.  A link that flaps repeatedly inside the
+  decay window crosses the assert threshold and earns a **sticky
+  penalty** (hysteresis: the latch releases only when the decayed mass
+  falls below a strictly lower release threshold, so it outlives any
+  single heal).  The planner prices penalized endpoints into the RTT
+  matrix — a pre-emptive route-around, not a reactive exclusion — and
+  the plan tracker treats latch flips as structural.
+* **rung priors** — per-(anomaly class, action) remediation success /
+  failure / escalation counts mined from the ledger's journal records.
+  Rungs whose measured success rate sits below the floor (with enough
+  samples) land in a skip set the remediation policy filters — bounded,
+  the ladder never empties.
+* **urgency** — the SLO engine's fast-window readiness burn rate,
+  scaled into an adaptive remediation budget window: remediate faster
+  while the error budget is burning, hold the configured pace when
+  healthy.
+
+Everything is event-sourced off journal edges, so the zero-steady-write
+contract holds: a steady pass folds nothing, the ``status.history``
+rollup is cached per fold-version and serves the identical object, and
+the priors checkpoint ConfigMap (reconciler-owned, contribcache-style
+diff gate) is re-serialized only when the fold version moved.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter, deque
+from typing import Any, Callable, Dict, FrozenSet, Optional, Set, Tuple
+
+from ..api.v1alpha1 import types as t
+from . import timeline as tl
+
+# exponential decay half-life for flap-event mass: one flap contributes
+# 1.0 at its timestamp, 0.5 after this many seconds, 0.25 after twice
+DECAY_HALFLIFE_SECONDS = 1800.0
+# decayed flap mass at which the sticky penalty asserts...
+PENALTY_ASSERT_FLAPS = 3.0
+# ...and the strictly lower mass below which it releases (hysteresis:
+# a just-healed chronic flapper stays penalized until its history
+# actually decays away, not until the first quiet pass)
+PENALTY_RELEASE_FLAPS = 1.0
+# RTT surcharge (ms) the planner adds per penalized endpoint on every
+# measured edge — 2x the unmeasured-edge default, so a chronic
+# flapper's links price worse than edges the mesh never even validated
+PLAN_PENALTY_RTT_MS = 100.0
+
+# a (class, action) rung is skipped when its measured success rate sits
+# below the floor with at least MIN_RUNG_SAMPLES resolved outcomes
+RUNG_SUCCESS_FLOOR = 0.25
+MIN_RUNG_SAMPLES = 3
+
+# adaptive budget window: while the fast burn rate exceeds 1.0 (budget
+# burning faster than sustainable) the configured window shrinks by the
+# burn factor, capped — remediation throughput rises with urgency but
+# never unboundedly
+URGENCY_MAX_SCALE = 4.0
+
+# bounds: flap events per key, tracked keys per policy, unresolved
+# remediation directives (all FIFO/score-evicted, never silent growth)
+MAX_FLAP_EVENTS = 32
+MAX_KEYS = 1024
+MAX_PENDING = 512
+
+# the rollup/latch-release recompute cadence (the slo.py decay-bucket
+# idiom): lazy releases and burn windows advance once per bucket, so a
+# steady fleet recomputes at most once per bucket and the cached status
+# object stays identical between recomputes
+BUCKET_SECONDS = 300.0
+
+# priors snapshot schema version (checkpoint CM invalidation)
+PAYLOAD_VERSION = 1
+
+# priors checkpoint ConfigMap (owned by the policy CR, diff-gated
+# writes — the contribcache pattern): a failed-over shard replica
+# resumes the mined priors instead of re-learning them from scratch
+HISTORY_CM_PREFIX = "tpunet-history-"
+HISTORY_CM_KEY = "priors"
+
+
+def history_cm_name(policy: str) -> str:
+    return HISTORY_CM_PREFIX + policy
+
+# every metric family the engine owns — set sites + forget-time
+# retraction (the reconciler's phantom-series contract)
+HISTORY_GAUGES = (
+    "tpunet_history_tracked_links",
+    "tpunet_history_sticky_penalties",
+    "tpunet_history_rung_success_rate",
+    "tpunet_history_rungs_skipped",
+    "tpunet_history_budget_window_seconds",
+)
+
+_BAD_PROBE_STATES = (t.PROBE_STATE_DEGRADED, t.PROBE_STATE_QUARANTINED)
+
+FlapKey = Tuple[str, str]   # (node, interface); iface "" = node-level
+
+
+class _RungStat:
+    """Mined outcome counters for one (anomaly class, action) rung."""
+
+    __slots__ = ("fired", "ok", "failed", "escalated")
+
+    def __init__(self, fired=0, ok=0, failed=0, escalated=0):
+        self.fired = fired
+        self.ok = ok
+        self.failed = failed
+        self.escalated = escalated
+
+    def samples(self) -> int:
+        return self.ok + self.failed + self.escalated
+
+    def success_rate(self) -> float:
+        n = self.samples()
+        return self.ok / n if n else 1.0
+
+
+class HistoryEngine:
+    """Journal-fed priors + the bounded ``status.history`` rollup.
+
+    Thread-safe: reconcile workers fold records and read priors; scrape
+    threads read nothing here (gauges live in the shared registry)."""
+
+    def __init__(
+        self,
+        timeline: Optional[tl.Timeline] = None,
+        metrics=None,
+        slo=None,
+        decay_halflife_seconds: float = DECAY_HALFLIFE_SECONDS,
+        penalty_assert: float = PENALTY_ASSERT_FLAPS,
+        penalty_release: float = PENALTY_RELEASE_FLAPS,
+        rung_success_floor: float = RUNG_SUCCESS_FLOOR,
+        min_rung_samples: int = MIN_RUNG_SAMPLES,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.timeline = timeline
+        self.metrics = metrics
+        self.slo = slo
+        self.halflife = max(float(decay_halflife_seconds), 1.0)
+        self.penalty_assert = float(penalty_assert)
+        # hysteresis needs release strictly below assert or the latch
+        # degenerates into a plain threshold
+        self.penalty_release = min(
+            float(penalty_release), self.penalty_assert * 0.99
+        )
+        self.rung_success_floor = float(rung_success_floor)
+        self.min_rung_samples = max(int(min_rung_samples), 1)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # policy -> key -> deque[flap ts] (newest-last, bounded)
+        self._flaps: Dict[str, Dict[FlapKey, deque]] = {}
+        # policy -> keys currently under the sticky penalty
+        self._sticky: Dict[str, Set[FlapKey]] = {}
+        # policy -> (cls, action) -> _RungStat
+        self._rungs: Dict[str, Dict[Tuple[str, str], _RungStat]] = {}
+        # directive_id -> (policy, cls, action): fired, outcome pending
+        self._pending: Dict[str, Tuple[str, str, str]] = {}
+        # policy -> adaptive window seconds last computed by the
+        # reconciler (display-only feed, like SloEngine.note_pass: no
+        # version bump, no status write)
+        self._window: Dict[str, float] = {}
+        # fold-version per policy — with the decay bucket it forms the
+        # rollup/penalty cache key; bumps on every relevant fold AND on
+        # every lazy latch release
+        self._version: Counter = Counter()
+        self._status_cache: Dict[
+            str, Tuple[Tuple[int, int], t.HistoryStatus]
+        ] = {}
+        if timeline is not None:
+            timeline.add_listener(self._fold)
+
+    # -- journal fold ----------------------------------------------------------
+
+    def _fold(self, rec: Dict[str, Any]) -> None:
+        policy = rec.get("policy", "")
+        kind = rec.get("kind", "")
+        if kind == tl.KIND_PROBE:
+            if rec.get("to", "") in _BAD_PROBE_STATES \
+                    and rec.get("from", "") not in _BAD_PROBE_STATES:
+                # the Reachable -> bad edge is the flap; a Degraded ->
+                # Quarantined escalation is the SAME incident worsening
+                self._note_flap(
+                    policy, (str(rec.get("node", "")), ""),
+                    float(rec.get("ts", 0.0) or 0.0),
+                )
+        elif kind == tl.KIND_TELEMETRY:
+            if rec.get("to") == "anomalous":
+                iface = str(rec.get("detail", "")).split(":", 1)[0]
+                self._note_flap(
+                    policy, (str(rec.get("node", "")), iface),
+                    float(rec.get("ts", 0.0) or 0.0),
+                )
+        elif kind == tl.KIND_READINESS:
+            if rec.get("to") == "departed":
+                # the node left the fleet: its priors go with it (a
+                # re-join starts clean — bounded state, no phantoms)
+                self._drop_node(policy, str(rec.get("node", "")))
+        elif kind == tl.KIND_REMEDIATION:
+            self._fold_remediation(policy, rec)
+
+    def _note_flap(self, policy: str, key: FlapKey, ts: float) -> None:
+        with self._lock:
+            keys = self._flaps.setdefault(policy, {})
+            ring = keys.get(key)
+            if ring is None:
+                if len(keys) >= MAX_KEYS:
+                    self._evict_key(policy, keys)
+                ring = keys[key] = deque(maxlen=MAX_FLAP_EVENTS)
+            ring.append(ts)
+            self._version[policy] += 1
+            if self._score(ring, ts) >= self.penalty_assert:
+                self._sticky.setdefault(policy, set()).add(key)
+
+    def _evict_key(self, policy: str, keys: Dict[FlapKey, deque]) -> None:
+        # caller holds _lock.  Evict the quietest non-sticky key (oldest
+        # newest-event); when everything is sticky, the quietest sticky
+        # key goes — bounded memory beats a perfect latch under a
+        # pathological 1000-link flap storm
+        sticky = self._sticky.get(policy, set())
+        candidates = [k for k in keys if k not in sticky] or list(keys)
+        victim = min(candidates, key=lambda k: (keys[k][-1], k))
+        del keys[victim]
+        sticky.discard(victim)
+
+    def _drop_node(self, policy: str, node: str) -> None:
+        with self._lock:
+            keys = self._flaps.get(policy, {})
+            doomed = [k for k in keys if k[0] == node]
+            for key in doomed:
+                del keys[key]
+                self._sticky.get(policy, set()).discard(key)
+            if doomed:
+                self._version[policy] += 1
+
+    def _fold_remediation(self, policy: str, rec: Dict[str, Any]) -> None:
+        cause = rec.get("cause", {}) or {}
+        reason = cause.get("reason", "")
+        did = cause.get("directiveId", "")
+        with self._lock:
+            if reason == "RemediationStarted":
+                cls = str(rec.get("from", ""))
+                action = str(rec.get("to", ""))
+                if not cls or not action:
+                    return
+                stat = self._rungs.setdefault(policy, {}).setdefault(
+                    (cls, action), _RungStat()
+                )
+                stat.fired += 1
+                if did:
+                    if len(self._pending) >= MAX_PENDING:
+                        # FIFO-evict the oldest unresolved directive
+                        # (its outcome, if it ever lands, just won't
+                        # score — bounded beats complete)
+                        self._pending.pop(next(iter(self._pending)))
+                    self._pending[did] = (policy, cls, action)
+                self._version[policy] += 1
+            elif reason == "RemediationOutcome":
+                hit = self._pending.pop(did, None) if did else None
+                if hit is None:
+                    return
+                p, cls, action = hit
+                stat = self._rungs.setdefault(p, {}).setdefault(
+                    (cls, action), _RungStat()
+                )
+                if rec.get("to") == "ok":
+                    stat.ok += 1
+                else:
+                    stat.failed += 1
+                self._version[p] += 1
+            elif reason == "RemediationEscalated":
+                # the rung cleared its agent ack but not the anomaly —
+                # the ladder moved past it: a failure of the FROM action
+                cls = str(rec.get("detail", ""))
+                action = str(rec.get("from", ""))
+                if not cls or not action:
+                    return
+                stat = self._rungs.setdefault(policy, {}).setdefault(
+                    (cls, action), _RungStat()
+                )
+                stat.escalated += 1
+                self._version[policy] += 1
+
+    # -- flap priors -----------------------------------------------------------
+
+    def _score(self, events, asof: float) -> float:
+        # caller holds _lock (or owns the deque); pure decay sum.  An
+        # event newer than ``asof`` counts at full mass, not zero: the
+        # release pass evaluates at the bucket-FLOORED clock (for rollup
+        # cache stability), which can trail a just-folded flap by up to
+        # BUCKET_SECONDS — excluding those events would unlatch a key in
+        # the same pass that asserted it.
+        return sum(
+            0.5 ** (max(0.0, asof - ts) / self.halflife)
+            for ts in events
+        )
+
+    def _bucket(self) -> int:
+        return int(self._clock() // BUCKET_SECONDS)
+
+    def _release_latches(self, policy: str, asof: float) -> None:
+        # caller holds _lock.  Lazy hysteresis release: a latched key
+        # whose decayed mass fell below the release threshold unlatches
+        # (and bumps the version so cached rollups/fingerprints move).
+        sticky = self._sticky.get(policy)
+        if not sticky:
+            return
+        keys = self._flaps.get(policy, {})
+        released = [
+            k for k in sticky
+            if self._score(keys.get(k, ()), asof) < self.penalty_release
+        ]
+        for key in released:
+            sticky.discard(key)
+        if released:
+            self._version[policy] += 1
+
+    def flap_score(
+        self, policy: str, node: str, iface: str = "",
+        asof: Optional[float] = None,
+    ) -> float:
+        """Current decayed flap mass for one (node, interface) key."""
+        when = self._clock() if asof is None else float(asof)
+        with self._lock:
+            ring = self._flaps.get(policy, {}).get((node, iface), ())
+            return self._score(ring, when)
+
+    def penalized(self, policy: str) -> FrozenSet[FlapKey]:
+        """The sticky-latched (node, interface) keys, after lazy
+        release at the current decay bucket."""
+        with self._lock:
+            self._release_latches(policy, self._bucket() * BUCKET_SECONDS)
+            return frozenset(self._sticky.get(policy, ()))
+
+    def plan_penalties(self, policy: str) -> Dict[str, float]:
+        """Per-node RTT surcharge (ms) the planner adds to every
+        measured edge touching a penalized node.  Constant per latched
+        node — between latch flips the priced matrix is stable, so the
+        tracker's drift hysteresis never sees prior-driven jitter."""
+        return {
+            node: PLAN_PENALTY_RTT_MS
+            for node, _ in self.penalized(policy)
+        }
+
+    def plan_fingerprint(self, policy: str) -> str:
+        """Stable fingerprint of the latched key set — carried in
+        :class:`..planner.plan.PlanInputs` so the tracker treats a
+        latch assert/release as STRUCTURAL (replan immediately, no
+        hold-window deferral): routing around a chronic flapper is the
+        point, and it must land within one reconcile of the latch."""
+        keys = self.penalized(policy)
+        return ",".join(sorted(f"{n}|{i}" for n, i in keys))
+
+    # -- rung priors -----------------------------------------------------------
+
+    def rung_skips(self, policy: str) -> Dict[str, FrozenSet[str]]:
+        """Per-anomaly-class actions whose measured success rate sits
+        below the floor with enough samples.  The remediation policy
+        filters its ladder through this set — with a never-empty
+        guarantee on that side (skipping everything keeps the last
+        rung)."""
+        with self._lock:
+            out: Dict[str, Set[str]] = {}
+            for (cls, action), stat in self._rungs.get(policy, {}).items():
+                if stat.samples() >= self.min_rung_samples \
+                        and stat.success_rate() < self.rung_success_floor:
+                    out.setdefault(cls, set()).add(action)
+            return {cls: frozenset(acts) for cls, acts in out.items()}
+
+    def rung_stats(
+        self, policy: str
+    ) -> Dict[Tuple[str, str], Tuple[int, int, int, int]]:
+        """(fired, ok, failed, escalated) per (class, action) — the
+        diag/why surface."""
+        with self._lock:
+            return {
+                key: (s.fired, s.ok, s.failed, s.escalated)
+                for key, s in self._rungs.get(policy, {}).items()
+            }
+
+    # -- urgency ---------------------------------------------------------------
+
+    def budget_window(
+        self, policy: str, configured_seconds: float
+    ) -> float:
+        """The adaptive remediation budget window: the configured
+        window, shrunk by the fast burn rate while the readiness SLO is
+        burning (burn 2.0 halves the window — the same node budget
+        refills twice as fast), capped at URGENCY_MAX_SCALE.  Healthy
+        fleets (burn <= 1.0) keep the configured pace.  Deterministic:
+        the burn rate is anchored at the SLO engine's samples."""
+        window = float(configured_seconds)
+        if self.slo is not None and window > 0:
+            burn = self.slo.burn_rate(policy, BUCKET_SECONDS)
+            if burn > 1.0:
+                window = window / min(burn, URGENCY_MAX_SCALE)
+        with self._lock:
+            self._window[policy] = window
+        return window
+
+    def urgency(self, policy: str) -> float:
+        """The live urgency signal (fast-window burn rate), 0.0 when no
+        SLO engine is wired."""
+        if self.slo is None:
+            return 0.0
+        return self.slo.burn_rate(policy, BUCKET_SECONDS)
+
+    # -- rollup ----------------------------------------------------------------
+
+    def priors_version(self, policy: str) -> int:
+        """The fold version — the checkpoint writer's cheap has-anything-
+        changed gate (a steady pass sees the same version and skips even
+        serialization)."""
+        with self._lock:
+            return self._version.get(policy, 0)
+
+    def history_status(self, policy: str) -> Optional[t.HistoryStatus]:
+        """The bounded ``status.history`` rollup — cached per (fold
+        version, decay bucket) so a steady pass serves the IDENTICAL
+        object and the status diff sees no change (the slo.py
+        health_status contract)."""
+        with self._lock:
+            bucket = self._bucket()
+            self._release_latches(policy, bucket * BUCKET_SECONDS)
+            version = self._version.get(policy, 0)
+            if version == 0:
+                return None
+            key = (version, bucket)
+            cached = self._status_cache.get(policy)
+            if cached is not None and cached[0] == key:
+                return cached[1]
+            keys = self._flaps.get(policy, {})
+            sticky = self._sticky.get(policy, set())
+            rungs = self._rungs.get(policy, {})
+            ok = sum(s.ok for s in rungs.values())
+            samples = sum(s.samples() for s in rungs.values())
+            skipped = sum(
+                1 for s in rungs.values()
+                if s.samples() >= self.min_rung_samples
+                and s.success_rate() < self.rung_success_floor
+            )
+            window = self._window.get(policy, 0.0)
+            rung_rows = [
+                (cls, action, s.success_rate())
+                for (cls, action), s in rungs.items()
+            ]
+            tracked = len(keys)
+            n_sticky = len(sticky)
+            n_nodes = len({n for n, _ in sticky})
+        urgency = self.urgency(policy)
+        status = t.HistoryStatus(
+            tracked_links=tracked,
+            sticky_penalties=n_sticky,
+            flapping_nodes=n_nodes,
+            remediation_success_rate=round(
+                ok / samples if samples else 1.0, 4
+            ),
+            rungs_skipped=skipped,
+            budget_window_seconds=round(window, 1),
+            urgency_burn_rate=round(urgency, 3),
+        )
+        with self._lock:
+            self._status_cache[policy] = (key, status)
+        if self.metrics is not None:
+            labels = {"policy": policy}
+            self.metrics.set_gauge(
+                "tpunet_history_tracked_links", float(tracked), labels
+            )
+            self.metrics.set_gauge(
+                "tpunet_history_sticky_penalties", float(n_sticky),
+                labels,
+            )
+            self.metrics.set_gauge(
+                "tpunet_history_rungs_skipped", float(skipped), labels
+            )
+            self.metrics.set_gauge(
+                "tpunet_history_budget_window_seconds", float(window),
+                labels,
+            )
+            for cls, action, rate in rung_rows:
+                self.metrics.set_gauge(
+                    "tpunet_history_rung_success_rate", round(rate, 4),
+                    {"policy": policy, "class": cls, "action": action},
+                )
+        return status
+
+    def summary(self) -> Dict[str, Any]:
+        """One JSON-able snapshot across policies — the support-bundle
+        capture (tools/diag.py) and the ``/debug/history`` body."""
+        with self._lock:
+            policies = sorted(set(self._flaps) | set(self._rungs)
+                              | set(self._version))
+        now = self._bucket() * BUCKET_SECONDS
+        out: Dict[str, Any] = {
+            "halflifeSeconds": self.halflife,
+            "penaltyAssert": self.penalty_assert,
+            "penaltyRelease": self.penalty_release,
+            "rungSuccessFloor": self.rung_success_floor,
+            "policies": {},
+        }
+        for policy in policies:
+            sticky = self.penalized(policy)
+            with self._lock:
+                keys = self._flaps.get(policy, {})
+                links = [
+                    {
+                        "node": n, "interface": i,
+                        "flapScore": round(self._score(ring, now), 3),
+                        "events": len(ring),
+                        "sticky": (n, i) in sticky,
+                    }
+                    for (n, i), ring in sorted(keys.items())
+                ]
+            rungs = [
+                {
+                    "class": cls, "action": action, "fired": fired,
+                    "ok": ok, "failed": failed, "escalated": esc,
+                }
+                for (cls, action), (fired, ok, failed, esc)
+                in sorted(self.rung_stats(policy).items())
+            ]
+            skips = self.rung_skips(policy)
+            out["policies"][policy] = {
+                "links": links,
+                "rungs": rungs,
+                "skips": {
+                    cls: sorted(acts) for cls, acts in sorted(skips.items())
+                },
+                "urgencyBurnRate": round(self.urgency(policy), 3),
+            }
+        return out
+
+    # -- persistence (checkpoint CM payload) -----------------------------------
+
+    def to_payload(self, policy: str) -> Dict[str, Any]:
+        """The priors snapshot the reconciler checkpoints — compact,
+        JSON-able, deterministic (sorted keys) so the diff gate
+        compares serialized bytes meaningfully."""
+        with self._lock:
+            keys = self._flaps.get(policy, {})
+            sticky = self._sticky.get(policy, set())
+            rungs = self._rungs.get(policy, {})
+            return {
+                "v": PAYLOAD_VERSION,
+                "flaps": {
+                    f"{n}|{i}": [round(ts, 3) for ts in ring]
+                    for (n, i), ring in sorted(keys.items())
+                },
+                "sticky": sorted(f"{n}|{i}" for n, i in sticky),
+                "rungs": {
+                    f"{cls}|{action}": [
+                        s.fired, s.ok, s.failed, s.escalated,
+                    ]
+                    for (cls, action), s in sorted(rungs.items())
+                },
+            }
+
+    def load_payload(
+        self, policy: str, payload: Optional[Dict[str, Any]]
+    ) -> bool:
+        """Resume priors from a checkpoint — COLD ONLY: a policy that
+        already folded live records keeps them (merging would double-
+        count on repeated loads).  Returns whether anything loaded.
+        Tolerant parse: a mangled checkpoint loads nothing rather than
+        poisoning the priors."""
+        if not isinstance(payload, dict) \
+                or payload.get("v") != PAYLOAD_VERSION:
+            return False
+        try:
+            flaps = {}
+            for key, events in (payload.get("flaps", {}) or {}).items():
+                node, _, iface = str(key).partition("|")
+                flaps[(node, iface)] = deque(
+                    (float(ts) for ts in events[-MAX_FLAP_EVENTS:]),
+                    maxlen=MAX_FLAP_EVENTS,
+                )
+            sticky = set()
+            for key in payload.get("sticky", []) or []:
+                node, _, iface = str(key).partition("|")
+                sticky.add((node, iface))
+            rungs = {}
+            for key, row in (payload.get("rungs", {}) or {}).items():
+                cls, _, action = str(key).partition("|")
+                rungs[(cls, action)] = _RungStat(
+                    int(row[0]), int(row[1]), int(row[2]), int(row[3])
+                )
+        except (TypeError, ValueError, IndexError):
+            return False
+        with self._lock:
+            if self._version.get(policy, 0):
+                return False
+            if flaps:
+                self._flaps[policy] = flaps
+            if sticky:
+                self._sticky[policy] = sticky
+            if rungs:
+                self._rungs[policy] = rungs
+            if flaps or sticky or rungs:
+                self._version[policy] += 1
+                return True
+            return False
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def forget(self, policy: str) -> None:
+        """Drop a deleted policy's priors and retract its series."""
+        with self._lock:
+            self._flaps.pop(policy, None)
+            self._sticky.pop(policy, None)
+            self._rungs.pop(policy, None)
+            self._window.pop(policy, None)
+            self._version.pop(policy, None)
+            self._status_cache.pop(policy, None)
+            for did in [
+                d for d, (p, _, _) in self._pending.items() if p == policy
+            ]:
+                del self._pending[did]
+        if self.metrics is not None:
+            for family in HISTORY_GAUGES:
+                self.metrics.remove_matching(family, {"policy": policy})

@@ -46,11 +46,18 @@ KIND_REMEDIATION = "remediation"    # rung fire / outcome / escalation / heal
 KIND_CONDITION = "condition"        # status condition flips
 KIND_STATE = "state"                # policy headline state-machine flips
 KIND_RECONCILE = "reconcile"        # permanent-error open/close edges
+KIND_SHARD = "shard"                # shard-ownership acquire/release edges
 
 KINDS = frozenset({
     KIND_READINESS, KIND_PROBE, KIND_TELEMETRY, KIND_PLAN,
     KIND_REMEDIATION, KIND_CONDITION, KIND_STATE, KIND_RECONCILE,
+    KIND_SHARD,
 })
+
+# shard records are fleet-scoped (shard ownership is not a property of
+# any one policy) — they journal under this reserved pseudo-policy key
+# so per-policy rings and budgets stay isolated from control-plane noise
+SHARD_POLICY = "_shards"
 
 # per-policy ring byte budget: generous for weeks of edge-rate records
 # (transitions are rare by construction), small enough that a 25-policy

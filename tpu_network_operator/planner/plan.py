@@ -127,6 +127,30 @@ class PlanInputs:
     excluded: FrozenSet[str] = frozenset()  # degraded/quarantined/anomalous
     seed: str = ""                          # policy name (restart-stable)
     spread_threshold_ms: float = DEFAULT_SPREAD_THRESHOLD_MS
+    # history-plane prior fingerprint (obs/history.py sticky-penalty
+    # set): the caller prices the penalties into ``rtt`` BEFORE
+    # building these inputs; this field makes a latch assert/release
+    # STRUCTURAL to the tracker — a chronic flapper is routed around
+    # within one reconcile, never deferred by the drift hold window
+    priors: str = ""
+
+
+def apply_penalties(
+    rtt: Dict[Edge, float], penalties: Mapping[str, float]
+) -> Dict[Edge, float]:
+    """Price history-plane penalties into a measured RTT matrix: every
+    measured edge touching a penalized node costs extra (surcharges
+    add when both ends are penalized).  Pre-emptive route-around: the
+    node stays in the ring (membership untouched) but the heuristic
+    stops spending hops on its links — unmeasured edges already cost
+    DEFAULT_RTT_MS, so a PLAN_PENALTY_RTT_MS surcharge prices a chronic
+    flapper's measured links worse than links never validated at all."""
+    if not penalties:
+        return rtt
+    return {
+        (a, b): ms + penalties.get(a, 0.0) + penalties.get(b, 0.0)
+        for (a, b), ms in rtt.items()
+    }
 
 
 @dataclass

@@ -118,6 +118,11 @@ class PlanTracker:
                 or prev.excluded != inputs.excluded
                 or prev.seed != inputs.seed
                 or prev.spread_threshold_ms != inputs.spread_threshold_ms
+                # history-plane prior flips are structural, not drift:
+                # a sticky flap penalty asserting (or releasing) must
+                # replan within one reconcile — the repriced matrix
+                # must never wait out the drift hold window
+                or prev.priors != inputs.priors
             )
             if not structural:
                 drift = significant_rtt_drift(

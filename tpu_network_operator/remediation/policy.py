@@ -123,6 +123,11 @@ class Knobs:
     # quorum floor: disruptive actions are withheld while the healthy
     # node count is at or below this
     min_healthy: int = 0
+    # history-plane rung priors (obs/history.py): per-anomaly-class
+    # actions whose MEASURED success rate fell below the floor — the
+    # ladder filters them out, bounded by the never-empties guarantee
+    # in :func:`effective_ladder`
+    skip_actions: Dict[str, FrozenSet[str]] = field(default_factory=dict)
 
 
 @dataclass
@@ -149,6 +154,23 @@ def allowed_ladder(cls: str, allowed: FrozenSet[str]) -> Tuple[str, ...]:
     """The class ladder filtered to the operator-allowed actions (rung
     order preserved)."""
     return tuple(a for a in LADDERS.get(cls, ()) if a in allowed)
+
+
+def effective_ladder(
+    cls: str, knobs: Knobs
+) -> Tuple[str, ...]:
+    """The allowed ladder minus the history-skipped rungs, with the
+    never-empties guarantee: when the priors condemn EVERY remaining
+    rung, the final rung survives — the last resort runs rather than
+    remediation silently giving up while the anomaly stands.  (An
+    operator who wants detection-only uses ``allowed_actions``, an
+    explicit spec decision; measured priors only re-order within it.)"""
+    ladder = allowed_ladder(cls, knobs.allowed_actions)
+    skips = knobs.skip_actions.get(cls) if knobs.skip_actions else None
+    if not ladder or not skips:
+        return ladder
+    kept = tuple(a for a in ladder if a not in skips)
+    return kept if kept else ladder[-1:]
 
 
 def primary_anomaly(anomalies: List[Anomaly]) -> Optional[Anomaly]:
@@ -215,7 +237,7 @@ def decide(
         anom = primary_anomaly(by_node[node])
         if anom is None:
             continue
-        ladder = allowed_ladder(anom.cls, knobs.allowed_actions)
+        ladder = effective_ladder(anom.cls, knobs)
         if not ladder:
             continue   # every rung disabled: detection-only for this class
         entry = ledger.entry(node, anom.cls)
@@ -296,5 +318,5 @@ __all__ = [
     "DEFAULT_COOLDOWN_SECONDS", "DEFAULT_ESCALATE_AFTER",
     "DEFAULT_MAX_NODES_PER_WINDOW", "DEFAULT_WINDOW_SECONDS",
     "Directive", "Entry", "Knobs", "LADDERS", "Ledger", "NON_DISRUPTIVE",
-    "allowed_ladder", "decide", "primary_anomaly",
+    "allowed_ladder", "decide", "effective_ladder", "primary_anomaly",
 ]
