@@ -78,6 +78,11 @@ history-bench: ## History-plane proof: marked tests + the chronic-flap soak (pri
 	$(PYTHON) -m pytest tests/ -x -q -m "history and not slow"
 	$(PYTHON) tools/history_bench.py --out BENCH_history.json
 
+.PHONY: profile-bench
+profile-bench: ## Profiling-plane proof: marked tests + the overhead/attribution/parallel-efficiency bench
+	$(PYTHON) -m pytest tests/ -x -q -m "profile and not slow"
+	$(PYTHON) tools/profile_bench.py --out BENCH_profile.json
+
 .PHONY: test-cluster
 test-cluster: ## kind-cluster e2e + live fuzz (needs kind/docker/kubectl; skips cleanly without — ref test/e2e + test/fuzz)
 	$(PYTHON) -m pytest tests/cluster -x -q

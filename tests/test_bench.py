@@ -981,3 +981,68 @@ class TestExecBench:
         )
         assert plan_u.collective == "ring"
         assert planned_u <= naive_u * 1.001
+
+
+@pytest.mark.profile
+class TestProfileBench:
+    """tools/profile_bench.py — the profiling plane's honesty gates:
+    overhead, attribution, the parallel-efficiency baseline, and
+    zero-write observation."""
+
+    # nodes must clear REBUILD_PARALLEL_MIN (2048) or the pooled
+    # rebuild — and its efficiency measurement — never runs
+    ARGS = ["--nodes", "2500", "--rounds", "5", "--blocks", "2",
+            "--capture-seconds", "0.25"]
+
+    def _run(self, out=None):
+        argv = [sys.executable,
+                os.path.join(REPO_ROOT, "tools", "profile_bench.py"),
+                *self.ARGS]
+        if out is not None:
+            argv += ["--out", str(out)]
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr[-1200:]
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    def test_artifact_schema_and_gates(self, tmp_path):
+        """The profile bench at reduced scale: one-line JSON artifact
+        (stdout tail == --out file), driver contract keys, and every
+        gate green — overhead inside the budget, the seeded hot loop
+        attributed to phase:plan with its frame named, the pooled
+        rebuild's parallel efficiency recorded and exported, steady
+        passes write-free under a running profiler."""
+        out = tmp_path / "BENCH_profile.json"
+        row = self._run(out)
+        assert row == json.loads(out.read_text())
+        assert set(row) >= {"metric", "value", "unit", "vs_baseline"}
+        assert row["ok"] is True and row["failures"] == []
+        assert row["unit"] == "percent"
+        overhead = row["overhead"]
+        assert overhead["nodes"] == 2500
+        assert overhead["steady_writes"] == 0
+        assert overhead["parallel_efficiency"] > 0
+        assert overhead["parallel_efficiency_exported"] is True
+        assert overhead["lock_metrics_exported"] is True
+        attribution = row["attribution"]
+        assert attribution["capture_samples"] > 0
+        assert attribution["plan_share"] >= 0.5
+        assert attribution["hot_frame_named"] is True
+
+    def test_deterministic_across_runs(self):
+        """Timings are host-dependent; the structural core — fleet
+        shape, gate verdicts, write/attribution booleans — must be
+        identical across runs."""
+        runs = [self._run() for _ in range(2)]
+        for row in runs:
+            row.pop("wall_seconds", None)
+            row.pop("value", None)
+            row.pop("vs_baseline", None)
+            for key in ("p50_off_ms", "p50_on_ms", "overhead_pct",
+                        "profiler_samples", "profiler_expected_samples",
+                        "profiler_evictions", "parallel_efficiency"):
+                row["overhead"].pop(key, None)
+            for key in ("capture_samples", "plan_share"):
+                row["attribution"].pop(key, None)
+        assert runs[0] == runs[1]

@@ -923,3 +923,98 @@ def test_suite_is_deterministic(tmp_path):
     assert runs[0] == runs[1]
     assert len(runs[0]) >= 3
     assert runs[0] == sorted(runs[0])
+
+
+@pytest.mark.analyze
+class TestLockInstrumentation:
+    """T003: every bare ``threading.Lock()`` constructed inside the
+    contention-traced tree (controller/, obs/, kube/) must either be
+    an obs.profile.TracedLock or carry a reasoned waiver — an
+    untraced hot-path mutex is a blind spot in
+    tpunet_lock_wait_seconds."""
+
+    SRC = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+    """
+
+    def _findings(self, src, path=RACE_PATH):
+        src = textwrap.dedent(src)
+        info = core.FileInfo(path, src, ast.parse(src))
+        return races.check_lock_instrumentation(info)
+
+    def test_bare_lock_flagged_in_scope(self):
+        for sub in ("controller", "obs", "kube"):
+            (f,) = self._findings(
+                self.SRC, f"tpu_network_operator/{sub}/x.py"
+            )
+            assert f.code == "T003"
+            assert "TracedLock" in f.message
+
+    def test_from_import_lock_flagged(self):
+        src = """
+        from threading import Lock
+
+        guard = Lock()
+        """
+        (f,) = self._findings(src)
+        assert f.code == "T003"
+
+    def test_bare_name_without_threading_import_not_flagged(self):
+        # a local Lock() factory that is NOT threading's is not ours
+        src = """
+        from multiprocessing import Lock
+
+        guard = Lock()
+        """
+        assert self._findings(src) == []
+
+    def test_rlock_and_condition_not_flagged(self):
+        src = """
+        import threading
+
+        a = threading.RLock()
+        b = threading.Condition()
+        """
+        assert self._findings(src) == []
+
+    def test_tracedlock_not_flagged(self):
+        src = """
+        from tpu_network_operator.obs.profile import TracedLock
+
+        guard = TracedLock("guard")
+        """
+        assert self._findings(src) == []
+
+    def test_outside_traced_tree_not_flagged(self):
+        for path in ("tpu_network_operator/agent/x.py",
+                     "tools/helper.py", "tests/test_x.py"):
+            assert self._findings(self.SRC, path) == []
+
+    def test_waiver_with_reason_suppresses(self):
+        src = textwrap.dedent("""
+        import threading
+
+        # tpunet: allow=T003 cold startup-only lock
+        guard = threading.Lock()
+        """)
+        info = core.FileInfo(RACE_PATH, src, ast.parse(src))
+        found = races.check_lock_instrumentation(info)
+        assert len(found) == 1
+        assert core.apply_waivers(found, {RACE_PATH: info}, {}) == []
+
+    def test_t003_runs_through_the_suite_driver(self, tmp_path):
+        pkg = os.path.join(
+            str(tmp_path), "tpu_network_operator", "controller"
+        )
+        os.makedirs(pkg)
+        with open(os.path.join(pkg, "hot.py"), "w") as f:
+            f.write("import threading\nguard = threading.Lock()\n")
+        findings, _ = lint.run_suite(
+            [str(tmp_path)], enabled={"T003"},
+            repo_root=str(tmp_path),
+        )
+        assert [f.code for f in findings] == ["T003"]

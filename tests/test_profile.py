@@ -1,0 +1,624 @@
+"""Profiling plane: TracedLock wait/hold accounting, the byte-budgeted
+folded-stack trie, span-joined sampling, the rebuild parallel-
+efficiency measurement, the /debug/profile + /debug/index endpoints
+(same bearer gate + degrade-to-default query contract as
+/debug/traces), the prof CLI, and the profile.json diag-bundle member.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_network_operator.controller.health import (
+    SUB_MS_BUCKETS,
+    HealthServer,
+    Metrics,
+)
+from tpu_network_operator.obs import SamplingProfiler, StackTrie, Tracer
+from tpu_network_operator.obs import profile as profile_mod
+from tpu_network_operator.obs.profile import (
+    MAX_STACK_DEPTH,
+    TracedLock,
+    parallel_efficiency,
+)
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+)
+
+
+class FakeMetrics:
+    """Signature-compatible observation recorder."""
+
+    def __init__(self):
+        self.observed = []       # (name, value, labels)
+        self.incs = []           # (name, labels, by)
+        self.gauges = {}         # name -> value
+
+    def observe(self, name, value, labels=None):
+        self.observed.append((name, value, dict(labels or {})))
+
+    def inc(self, name, labels=None, by=1):
+        self.incs.append((name, dict(labels or {}), by))
+
+    def set_gauge(self, name, value, labels=None):
+        self.gauges[name] = value
+
+
+class FakeClock:
+    """clock() returns the next scripted instant."""
+
+    def __init__(self, times):
+        self.times = list(times)
+
+    def __call__(self):
+        return self.times.pop(0) if self.times else 0.0
+
+
+# -- TracedLock ---------------------------------------------------------------
+
+
+@pytest.mark.profile
+class TestTracedLock:
+    def test_wait_and_hold_math(self):
+        """acquire reads the clock twice (wait = blocked time), release
+        once (hold = owned time); both observe after the release."""
+        m = FakeMetrics()
+        lock = TracedLock(
+            "x", metrics=m, clock=FakeClock([10.0, 10.5, 10.75])
+        )
+        with lock:
+            assert m.observed == []   # nothing recorded while held
+        assert m.observed == [
+            ("tpunet_lock_wait_seconds", 0.5, {"lock": "x"}),
+            ("tpunet_lock_hold_seconds", 0.25, {"lock": "x"}),
+        ]
+
+    def test_reentrant_measures_outermost_pair_only(self):
+        m = FakeMetrics()
+        lock = TracedLock(
+            "r", metrics=m, reentrant=True,
+            clock=FakeClock([0.0, 1.0, 5.0]),
+        )
+        with lock:
+            with lock:       # nested: no clock reads, no observation
+                pass
+            assert m.observed == []
+        names = [n for n, _, _ in m.observed]
+        assert names == [
+            "tpunet_lock_wait_seconds", "tpunet_lock_hold_seconds",
+        ]
+        assert m.observed[1][1] == 4.0   # hold spans the OUTER pair
+
+    def test_non_reentrant_protocol_and_locked(self):
+        lock = TracedLock("p", metrics=None)
+        assert lock.acquire()
+        assert lock.locked()
+        lock.release()
+        assert not lock.locked()
+        assert lock.name == "p"
+        assert "TracedLock" in repr(lock)
+
+    def test_failed_acquire_records_nothing(self):
+        m = FakeMetrics()
+        lock = TracedLock("f", metrics=m)
+        lock.acquire()
+        t = threading.Thread(
+            target=lambda: lock.acquire(blocking=False)
+        )
+        t.start()
+        t.join()
+        lock.release()
+        # exactly one wait/hold pair: the successful owner's
+        assert len(m.observed) == 2
+
+    def test_metrics_own_lock_is_traced_without_recursion(self):
+        """The registry's internal lock is itself a TracedLock that
+        records into the registry it guards — the per-thread busy
+        guard must stop the release->observe->release chain at depth
+        one, and the outer lock's observation must land."""
+        m = Metrics()
+        lock = TracedLock("outer", metrics=m)
+        with lock:
+            pass
+        text = m.render()
+        assert 'tpunet_lock_wait_seconds_count{lock="outer"} 1' in text
+        assert 'tpunet_lock_hold_seconds_count{lock="outer"} 1' in text
+
+    def test_default_sink_wired_by_set_metrics(self):
+        m = FakeMetrics()
+        profile_mod.set_metrics(m)
+        try:
+            lock = TracedLock("sinkless")
+            with lock:
+                pass
+            assert [n for n, _, _ in m.observed] == [
+                "tpunet_lock_wait_seconds",
+                "tpunet_lock_hold_seconds",
+            ]
+        finally:
+            profile_mod.set_metrics(None)
+
+    def test_lock_histograms_use_sub_ms_ladder(self):
+        m = Metrics()
+        assert m.buckets_for("tpunet_lock_wait_seconds") \
+            == SUB_MS_BUCKETS
+        assert m.buckets_for("tpunet_lock_hold_seconds") \
+            == SUB_MS_BUCKETS
+        assert m.buckets_for("tpunet_reconcile_status_phase_seconds") \
+            == SUB_MS_BUCKETS
+
+
+# -- StackTrie ----------------------------------------------------------------
+
+
+@pytest.mark.profile
+class TestStackTrie:
+    def test_folded_roundtrip_and_totals(self):
+        trie = StackTrie()
+        trie.add(["a", "b", "c"], 3)
+        trie.add(["a", "b"], 2)
+        trie.add(["a", "x"], 1)
+        assert trie.folded() == "a;b 2\na;b;c 3\na;x 1\n"
+        assert trie.samples() == 6
+        assert trie.nodes() == 4
+        assert trie.evicted() == 0
+
+    def test_empty(self):
+        trie = StackTrie()
+        assert trie.folded() == ""
+        assert trie.samples() == 0
+        trie.add([], 5)          # no frames: not a sample
+        assert trie.samples() == 0
+
+    def test_budget_evicts_coldest_and_preserves_totals(self):
+        trie = StackTrie(byte_budget=1)   # clamps to the 4096 floor
+        assert trie.byte_budget == 4096
+        for i in range(200):
+            # distinct cold leaves under one shared hot root; count
+            # grows with i so the earliest leaves are the coldest
+            trie.add(["root", f"leaf-{i:03d}"], 1 + i)
+        assert trie.total_bytes() <= trie.byte_budget
+        assert trie.evicted() > 0
+        # every evicted leaf folded its count into the parent: the
+        # sample total survives truncation exactly
+        assert trie.samples() == sum(1 + i for i in range(200))
+        folded_total = sum(
+            int(line.rsplit(" ", 1)[1])
+            for line in trie.folded().splitlines()
+        )
+        assert folded_total == trie.samples()
+
+    def test_just_inserted_leaf_survives_its_own_eviction(self):
+        trie = StackTrie(byte_budget=1)
+        for i in range(200):
+            trie.add(["root", f"hot-{i:03d}"], 1000)
+        trie.add(["root", "newest"], 1)   # coldest by count, protected
+        assert "root;newest 1" in trie.folded()
+
+    def test_deep_stack_truncates_to_hot_end(self):
+        trie = StackTrie()
+        frames = [f"f{i}" for i in range(MAX_STACK_DEPTH + 10)]
+        trie.add(frames, 1)
+        (line,) = trie.folded().splitlines()
+        stack = line.rsplit(" ", 1)[0].split(";")
+        assert len(stack) == MAX_STACK_DEPTH
+        assert stack[-1] == frames[-1]    # deepest frames kept
+        assert stack[0] == frames[10]
+
+
+# -- sampling + span attribution ----------------------------------------------
+
+
+class _Frame:
+    """Minimal frame-shaped object for the deterministic seam."""
+
+    class _Code:
+        def __init__(self, filename, name):
+            self.co_filename = filename
+            self.co_name = name
+
+    def __init__(self, chain):
+        # chain is leaf-last: [("mod.py", "outer"), ("mod.py", "inner")]
+        filename, name = chain[-1]
+        self.f_code = self._Code(filename, name)
+        self.f_back = _Frame(chain[:-1]) if len(chain) > 1 else None
+
+
+class _Span:
+    def __init__(self, name):
+        self.name = name
+
+
+@pytest.mark.profile
+class TestSamplingProfiler:
+    def test_sample_once_joins_spans(self):
+        m = FakeMetrics()
+        p = SamplingProfiler(hz=0, metrics=m)
+        frames = {
+            1: _Frame([("/x/loop.py", "run"), ("/x/plan.py", "solve")]),
+            2: _Frame([("/x/idle.py", "wait")]),
+        }
+        spans = {1: _Span("plan")}
+        assert p.sample_once(frames=frames, spans=spans) == 2
+        folded = p.folded()
+        assert "phase:plan;loop.run;plan.solve 1\n" in folded
+        assert "phase:unattributed;idle.wait 1\n" in folded
+        phases = {
+            labels["phase"] for name, labels, _ in m.incs
+            if name == "tpunet_profile_samples_total"
+        }
+        assert phases == {"plan", "unattributed"}
+        assert m.gauges["tpunet_profile_stack_bytes"] \
+            == float(p.stats()["bytes"])
+
+    def test_phase_and_frame_names_scrubbed(self):
+        """``;`` and space are the folded format's reserved bytes —
+        scrubbed from span names and frame names alike."""
+        p = SamplingProfiler(hz=0)
+        frames = {1: _Frame([("/x/my file.py", "fn;odd")])}
+        p.sample_once(frames=frames, spans={1: _Span("my phase;x")})
+        (line,) = p.folded().splitlines()
+        assert line == "phase:my_phase:x;my_file.fn:odd 1"
+
+    def test_own_thread_excluded(self):
+        p = SamplingProfiler(hz=0)
+        me = threading.get_ident()
+        frames = {me: _Frame([("/x/self.py", "sampling")])}
+        assert p.sample_once(frames=frames, spans={}) == 0
+        assert p.folded() == ""
+
+    def test_eviction_delta_exported_once(self):
+        m = FakeMetrics()
+        p = SamplingProfiler(hz=0, byte_budget=1, metrics=m)
+        for i in range(300):
+            p.sample_once(
+                frames={1: _Frame([(f"/x/m{i:03d}.py", f"f{i:03d}")])},
+                spans={},
+            )
+        total = sum(
+            by for name, _, by in m.incs
+            if name == "tpunet_profile_evictions_total"
+        )
+        assert total == p.stats()["evictions"] > 0
+
+    def test_live_attribution_across_threads(self):
+        """A worker inside a tracer span is attributed to that span by
+        a sample taken from ANOTHER thread — the cross-thread registry
+        contextvars cannot provide."""
+        tracer = Tracer()
+        ready, done = threading.Event(), threading.Event()
+
+        def worker():
+            with tracer.span("remediation"):
+                ready.set()
+                done.wait(timeout=10)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        assert ready.wait(timeout=10)
+        try:
+            p = SamplingProfiler(hz=0)
+            p.sample_once()
+            assert "phase:remediation;" in p.folded()
+        finally:
+            done.set()
+            t.join(timeout=10)
+
+    def test_start_stop_and_hz_zero_disables(self):
+        p = SamplingProfiler(hz=0)
+        p.start()
+        assert not p.running       # 0 Hz: disabled, no thread
+        p = SamplingProfiler(hz=200)
+        p.start()
+        try:
+            assert p.running
+            assert p.stats()["running"] is True
+        finally:
+            p.stop()
+        assert not p.running
+
+    def test_capture_is_a_separate_window(self):
+        p = SamplingProfiler(hz=50)
+        p.sample_once(
+            frames={1: _Frame([("/x/old.py", "old")])}, spans={}
+        )
+        folded = p.capture(0)      # one immediate sweep, live frames
+        assert "old.old" not in folded           # fresh window
+        assert "phase:" in p.folded()            # buffer untouched
+
+    def test_capture_clamps_seconds(self):
+        ticks = [0.0]
+
+        def clock():
+            ticks[0] += 100.0      # any positive window "elapses"
+            return ticks[0]
+
+        p = SamplingProfiler(hz=1000, clock=clock)
+        t0 = time.perf_counter()
+        p.capture(10_000)          # clamped: returns immediately
+        assert time.perf_counter() - t0 < 5.0
+
+    def test_stats_shape(self):
+        p = SamplingProfiler(hz=0)
+        p.sample_once(
+            frames={1: _Frame([("/x/a.py", "f")])}, spans={}
+        )
+        st = p.stats()
+        assert st["samples"] == 1
+        assert st["frames"] == len(p) == 2     # phase marker + frame
+        assert st["byteBudget"] == p._trie.byte_budget
+        assert st["bytes"] > 0 and st["evictions"] == 0
+
+
+@pytest.mark.profile
+class TestParallelEfficiency:
+    def test_math(self):
+        assert parallel_efficiency([1.0, 1.0], 2.0) == 1.0
+        assert parallel_efficiency([1.0, 1.0], 1.0) == 2.0
+        assert parallel_efficiency([], 1.0) == 0.0
+        assert parallel_efficiency([1.0], 0.0) == 0.0
+        assert parallel_efficiency([1.0], -1.0) == 0.0
+
+
+# -- /debug/profile + /debug/index --------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _seeded_profiler():
+    p = SamplingProfiler(hz=0)
+    p.sample_once(
+        frames={1: _Frame([("/x/plan.py", "solve")])},
+        spans={1: _Span("plan")},
+    )
+    return p
+
+
+@pytest.mark.profile
+class TestDebugProfileEndpoint:
+    def test_serves_folded_buffer(self):
+        srv = HealthServer(port=0, profiler=_seeded_profiler())
+        srv.start()
+        try:
+            status, body = _get(
+                f"http://127.0.0.1:{srv.port}/debug/profile"
+            )
+            assert status == 200
+            assert body == "phase:plan;plan.solve 1\n"
+        finally:
+            srv.stop()
+
+    def test_query_parameter_edge_cases(self):
+        """?seconds=0, negative and non-numeric all degrade to the
+        continuous buffer — none of them may 500 (the /debug/traces
+        contract)."""
+        srv = HealthServer(port=0, profiler=_seeded_profiler())
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}/debug/profile"
+            for q in ("?seconds=0", "?seconds=-3", "?seconds=bogus"):
+                status, body = _get(base + q)
+                assert status == 200
+                assert "plan.solve" in body
+        finally:
+            srv.stop()
+
+    def test_seconds_runs_bounded_capture(self):
+        srv = HealthServer(port=0, profiler=_seeded_profiler())
+        srv.start()
+        try:
+            status, body = _get(
+                f"http://127.0.0.1:{srv.port}"
+                "/debug/profile?seconds=0.05"
+            )
+            assert status == 200
+            # a fresh window: the seeded buffer line is NOT in it, but
+            # the serving thread itself gets sampled
+            assert "plan.solve 1" not in body
+        finally:
+            srv.stop()
+
+    def test_404_without_profiler(self):
+        srv = HealthServer(port=0)
+        srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(f"http://127.0.0.1:{srv.port}/debug/profile")
+            assert err.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_auth_gate_shared_with_metrics(self):
+        srv = HealthServer(
+            port=0, metrics=Metrics(), profiler=_seeded_profiler(),
+            metrics_auth=lambda tok: tok == "s3cr3t",
+        )
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(f"{base}/debug/profile")
+            assert err.value.code == 403
+            req = urllib.request.Request(
+                f"{base}/debug/profile",
+                headers={"Authorization": "Bearer s3cr3t"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 200
+        finally:
+            srv.stop()
+
+
+@pytest.mark.profile
+class TestDebugIndexEndpoint:
+    def test_enumerates_wired_surfaces(self):
+        from tpu_network_operator.obs import Timeline
+
+        tr = Tracer()
+        with tr.span("op", trace_id="ad" * 8):
+            pass
+        tl = Timeline()
+        tl.record("pol-a", "probe", node="n0", frm="a", to="b")
+        srv = HealthServer(
+            port=0, tracer=tr, timeline=tl,
+            profiler=_seeded_profiler(),
+        )
+        srv.start()
+        try:
+            status, body = _get(
+                f"http://127.0.0.1:{srv.port}/debug/index"
+            )
+            assert status == 200
+            surfaces = json.loads(body)["surfaces"]
+            assert set(surfaces) == {"traces", "timeline", "profile"}
+            assert surfaces["traces"] == {
+                "path": "/debug/traces", "spans": 1, "traceIds": 1,
+            }
+            assert surfaces["timeline"]["path"] == "/debug/timeline"
+            assert surfaces["timeline"]["records"] == 1
+            assert surfaces["timeline"]["bytes"] > 0
+            assert surfaces["profile"]["samples"] == 1
+            assert surfaces["profile"]["path"] == "/debug/profile"
+        finally:
+            srv.stop()
+
+    def test_404_when_nothing_wired(self):
+        srv = HealthServer(port=0, metrics=Metrics())
+        srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(f"http://127.0.0.1:{srv.port}/debug/index")
+            assert err.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_auth_gate(self):
+        srv = HealthServer(
+            port=0, profiler=_seeded_profiler(),
+            metrics_auth=lambda tok: tok == "s3cr3t",
+        )
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(f"{base}/debug/index")
+            assert err.value.code == 403
+            req = urllib.request.Request(
+                f"{base}/debug/index",
+                headers={"Authorization": "Bearer s3cr3t"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                body = json.loads(resp.read().decode())
+            assert "profile" in body["surfaces"]
+        finally:
+            srv.stop()
+
+
+# -- operator wiring -----------------------------------------------------------
+
+
+@pytest.mark.profile
+class TestOperatorFlags:
+    def test_profile_flags(self):
+        from tpu_network_operator.controller.main import build_parser
+
+        args = build_parser().parse_args([])
+        assert args.profile_hz == 29.0
+        assert args.profile_buffer_bytes == 256 * 1024
+        args = build_parser().parse_args(
+            ["--profile-hz", "0", "--profile-buffer-bytes", "8192"]
+        )
+        assert args.profile_hz == 0.0
+        assert args.profile_buffer_bytes == 8192
+
+
+# -- prof CLI + diag bundle ----------------------------------------------------
+
+
+@pytest.mark.profile
+class TestProfCli:
+    def test_top_n_report_from_in_process_profiler(self, capsys):
+        import prof
+
+        p = SamplingProfiler(hz=0)
+        for _ in range(3):
+            p.sample_once(
+                frames={
+                    1: _Frame([("/x/loop.py", "run"),
+                               ("/x/plan.py", "solve")]),
+                },
+                spans={1: _Span("plan")},
+            )
+        p.sample_once(
+            frames={1: _Frame([("/x/agg.py", "fold")])},
+            spans={1: _Span("aggregate")},
+        )
+        assert prof.main([], profiler=p) == 0
+        out = capsys.readouterr().out
+        assert "4 samples" in out
+        assert "plan.solve" in out
+        # phase split covers both phases, ordered hot-first
+        assert out.index("plan") < out.index("aggregate")
+
+    def test_phase_filter_and_empty(self, capsys):
+        import prof
+
+        p = SamplingProfiler(hz=0)
+        p.sample_once(
+            frames={1: _Frame([("/x/a.py", "f")])},
+            spans={1: _Span("plan")},
+        )
+        assert prof.main(["--phase", "nosuch"], profiler=p) == 0
+        assert "no samples" in capsys.readouterr().out
+
+    def test_parse_folded_skips_malformed(self):
+        import prof
+
+        stacks = prof.parse_folded(
+            "a;b 3\n\nbroken-line\nc;d notanumber\nc;d -1\ne 2\n"
+        )
+        assert stacks == [(["a", "b"], 3), (["e"], 2)]
+
+    def test_requires_a_source(self, capsys):
+        import prof
+
+        assert prof.main([]) == 1
+        assert "need --url" in capsys.readouterr().err
+
+
+@pytest.mark.profile
+class TestDiagProfileMember:
+    def test_bundle_includes_redacted_profile_json(self, tmp_path):
+        import tarfile
+
+        import diag
+
+        from tpu_network_operator.kube.fake import FakeCluster
+
+        p = SamplingProfiler(hz=0)
+        p.sample_once(
+            frames={1: _Frame([("/x/a.py", "Bearer_tok")])},
+            spans={1: _Span("plan")},
+        )
+        out = str(tmp_path / "bundle.tar.gz")
+        members = diag.collect_bundle(
+            FakeCluster(), "tpunet-system", out, profiler=p,
+        )
+        assert "profile.json" in members
+        with tarfile.open(out) as tar:
+            body = json.loads(
+                tar.extractfile("profile.json").read().decode()
+            )
+        assert body["stats"]["samples"] == 1
+        assert "phase:plan" in body["folded"]
+        assert "manifest.json" in members

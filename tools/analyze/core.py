@@ -190,5 +190,5 @@ class PassStats:
 
 ALL_RULES: Set[str] = {
     "F821", "F401", "E722", "F541", "B006", "E711", "B011",
-    "G004", "R001", "M001", "T001", "T002", "C001", "C002",
+    "G004", "R001", "M001", "T001", "T002", "T003", "C001", "C002",
 }

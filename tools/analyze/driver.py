@@ -6,7 +6,7 @@ point) and the test-suite gates call.  It:
 1. parses every target file ONCE into :class:`FileInfo` records;
 2. runs the per-file rule families (F/E/B/G/R/M) through the shared
    node index;
-3. runs the whole-program passes — T001/T002 over the operator
+3. runs the whole-program passes — T001/T002/T003 over the operator
    package, C001/C002 over the package + deploy/chart/bundle
    artifacts;
 4. applies inline waivers centrally (Python comments and the YAML
@@ -117,15 +117,18 @@ def run_suite(
                 {"rules": len(local)},
             ))
 
-    # -- T001/T002 race pass
-    if enabled & {"T001", "T002"}:
+    # -- T001/T002/T003 race pass
+    if enabled & {"T001", "T002", "T003"}:
         t0 = time.perf_counter()
         n = 0
         for info in infos:
             if _RACE_SCOPE not in info.norm_path:
                 continue
             got = [
-                f for f in races.check_file(info)
+                f for f in (
+                    races.check_file(info)
+                    + races.check_lock_instrumentation(info)
+                )
                 if f.code in enabled
             ]
             findings.extend(got)

@@ -1,4 +1,4 @@
-"""T001/T002 — lock-discipline race detection.
+"""T001/T002/T003 — lock-discipline race detection.
 
 The controller/agent web runs ~15 thread spawns against ~21
 ``threading.Lock``s; the two bug classes no test reliably catches are
@@ -34,7 +34,15 @@ subscribers collection on ``self`` (direct subscript call, loop
 variable, or snapshot taken *inside* the lock), or a ``self`` attribute
 named like a hook (``*_callback``/``*_hook``/``*_listener``/``on_*``).
 
-Both rules honor the inline ``# tpunet: allow=T00x <reason>`` waiver
+**T003** fires on a bare ``threading.Lock()`` constructed inside the
+contention-traced tree (``controller/``, ``obs/``, ``kube/``).  Those
+packages make up the control plane's hot path, and the profiling plane
+attributes lock wait/hold time via :class:`..obs.profile.TracedLock` —
+a plain ``Lock`` there is a blind spot in
+``tpunet_lock_wait_seconds``.  Either construct a ``TracedLock`` or
+state why the lock is cold in a waiver.
+
+All rules honor the inline ``# tpunet: allow=T00x <reason>`` waiver
 (reason text required — see core.Waivers).
 """
 
@@ -525,6 +533,51 @@ class ClassFacts:
             for n in reach(name):
                 result[n].add(MAIN_ROOT)
         return result
+
+
+# the contention-traced tree: every mutex here is expected to report
+# wait/hold into the lock histograms.  agent/ is deliberately outside
+# the scope — the node agent runs one short-lived provisioning flow
+# with no long-lived metrics registry to record into.
+T003_SCOPE = (
+    "tpu_network_operator/controller/",
+    "tpu_network_operator/obs/",
+    "tpu_network_operator/kube/",
+)
+
+
+def check_lock_instrumentation(info: FileInfo) -> List[Finding]:
+    """T003 — bare ``threading.Lock()`` calls in the traced tree."""
+    if not any(p in info.norm_path for p in T003_SCOPE):
+        return []
+    # `Lock()` as a bare name only counts when it is threading's Lock
+    bare_lock_imported = any(
+        imp.module == "threading"
+        and any(a.name == "Lock" for a in imp.names)
+        for imp in info.nodes(ast.ImportFrom)
+    )
+    findings: List[Finding] = []
+    for call in info.nodes(ast.Call):
+        fn = call.func
+        hit = (
+            isinstance(fn, ast.Attribute)
+            and fn.attr == "Lock"
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "threading"
+        ) or (
+            bare_lock_imported
+            and isinstance(fn, ast.Name)
+            and fn.id == "Lock"
+        )
+        if hit:
+            findings.append(Finding(
+                info.path, getattr(call, "lineno", 0), "T003",
+                "bare threading.Lock() in the contention-traced tree; "
+                "construct obs.profile.TracedLock('<name>') so "
+                "wait/hold land in tpunet_lock_wait_seconds, or "
+                "waiver with a reason explaining why the lock is cold",
+            ))
+    return findings
 
 
 def check_file(info: FileInfo) -> List[Finding]:

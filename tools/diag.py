@@ -6,8 +6,9 @@ dataplane incident without cluster access of their own: the
 NetworkClusterPolicy CRs (spec + status rollups), the namespace Events,
 the distributed probe peer ConfigMaps, the per-node provisioning-report
 Leases (including their telemetry counter samples, split out per node
-for direct diffing), the ``/metrics`` exposition and the
-``/debug/traces`` flight recorder — all into one gzip tarball.
+for direct diffing), the ``/metrics`` exposition, the
+``/debug/traces`` flight recorder and the ``/debug/profile``
+folded-stack buffer — all into one gzip tarball.
 
 Everything is **redacted before it is written**: values under
 secret-shaped keys (token/secret/password/authorization/credential/
@@ -100,6 +101,7 @@ def collect_files(
     timeline_json: str = "",
     slo_json: str = "",
     history_json: str = "",
+    profile_json: str = "",
 ) -> Dict[str, str]:
     """Gather every bundle member as {relative path: content}.  Each
     section is best-effort: a forbidden or failing list yields an
@@ -222,7 +224,8 @@ def collect_files(
         })
     for name, body in (("timeline.json", timeline_json),
                        ("slo.json", slo_json),
-                       ("history.json", history_json)):
+                       ("history.json", history_json),
+                       ("profile.json", profile_json)):
         if not body:
             continue
         try:
@@ -270,16 +273,18 @@ def collect_bundle(
     timeline=None,
     slo=None,
     history=None,
+    profiler=None,
     metrics_text: str = "",
     traces_json: str = "",
     timeline_json: str = "",
     slo_json: str = "",
     history_json: str = "",
+    profile_json: str = "",
 ) -> List[str]:
     """One-call collection: accepts live ``metrics``/``tracer``/
-    ``timeline``/``slo``/``history`` objects (in-process use and
-    tests) or pre-fetched endpoint bodies (the CLI).  Returns the
-    bundle's member names."""
+    ``timeline``/``slo``/``history``/``profiler`` objects (in-process
+    use and tests) or pre-fetched endpoint bodies (the CLI).  Returns
+    the bundle's member names."""
     if metrics is not None and not metrics_text:
         metrics_text = metrics.render()
     if tracer is not None and not traces_json:
@@ -298,11 +303,16 @@ def collect_bundle(
         slo_json = json.dumps(slo.summary())
     if history is not None and not history_json:
         history_json = json.dumps(history.summary())
+    if profiler is not None and not profile_json:
+        profile_json = json.dumps({
+            "stats": profiler.stats(),
+            "folded": profiler.folded(),
+        })
     files = collect_files(
         client, namespace,
         metrics_text=metrics_text, traces_json=traces_json,
         timeline_json=timeline_json, slo_json=slo_json,
-        history_json=history_json,
+        history_json=history_json, profile_json=profile_json,
     )
     write_bundle(files, out_path)
     return sorted(files)
@@ -336,6 +346,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="operator /debug/timeline endpoint to snapshot")
     ap.add_argument("--history-url", default="",
                     help="operator /debug/history endpoint to snapshot")
+    ap.add_argument("--profile-url", default="",
+                    help="operator /debug/profile endpoint to snapshot")
     ap.add_argument("--token-env", default="TPUNET_KUBE_TOKEN",
                     help="env var holding the bearer token for the "
                          "endpoints above (never passed on argv)")
@@ -352,17 +364,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         client = ApiClient.in_cluster()
 
     bodies = {"metrics_text": "", "traces_json": "",
-              "timeline_json": "", "history_json": ""}
+              "timeline_json": "", "history_json": "",
+              "profile_json": ""}
     for url, attr in ((args.metrics_url, "metrics_text"),
                       (args.traces_url, "traces_json"),
                       (args.timeline_url, "timeline_json"),
-                      (args.history_url, "history_json")):
+                      (args.history_url, "history_json"),
+                      (args.profile_url, "profile_json")):
         if not url:
             continue
         try:
             bodies[attr] = _http_get(url, token)
         except Exception as e:   # noqa: BLE001 — partial bundle > none
             print(f"warning: fetch {url} failed: {e}", file=sys.stderr)
+    # /debug/profile serves plain folded-stack text, not JSON — wrap it
+    # so profile.json stays a JSON member and rides deep redaction
+    if bodies["profile_json"]:
+        bodies["profile_json"] = json.dumps(
+            {"folded": bodies["profile_json"]}
+        )
 
     out = args.out or time.strftime(
         "tpunet-diag-%Y%m%d-%H%M%S.tar.gz", time.gmtime()
@@ -373,6 +393,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         traces_json=bodies["traces_json"],
         timeline_json=bodies["timeline_json"],
         history_json=bodies["history_json"],
+        profile_json=bodies["profile_json"],
     )
     print(f"wrote {out} ({len(members)} files)")
     for m in members:

@@ -223,6 +223,18 @@ def main() -> int:
         maybe_run_phase(out, "history-bench",
                   [py, "tools/history_bench.py",
                    "--out", "BENCH_history.json"], timeout=900)
+        # 16c. the profiling plane's honesty gates: sampler + traced
+        # locks must cost <=2% of the 10k-node steady-pass p50 (run
+        # interleaved off/on), a seeded hot loop inside a span named
+        # 'plan' must attribute to phase:plan with its frame named,
+        # the pooled rebuild's parallel efficiency (~1.0 under the
+        # GIL — the regression anchor a columnar-derivation PR must
+        # move) must be recorded + exported, and steady passes stay
+        # at zero apiserver writes with the profiler running
+        # (no TPU, in-process)
+        maybe_run_phase(out, "profile-bench",
+                  [py, "tools/profile_bench.py",
+                   "--out", "BENCH_profile.json"], timeout=900)
         # 17. plan execution: the multi-process collective rung — N
         # local jax.distributed workers (CPU backend) consume a real
         # agent-written bootstrap + plan block and measure

@@ -1,0 +1,191 @@
+#!/usr/bin/env python3
+"""``prof`` — where does the control plane spend its CPU?
+
+Consumes the folded-stack text the sampling profiler serves from
+``/debug/profile`` (``frame1;frame2;...;frameN count``, one line per
+distinct stack, first frame ``phase:<name>``) and renders the two
+answers an operator actually asks:
+
+1. **top-N self time** — which frames were on TOP of the stack when the
+   sampler fired (leaf attribution: the code that was literally
+   executing), with inclusive counts alongside so a hot leaf inside a
+   hot parent reads as such;
+2. **per-phase split** — how the samples divide across the reconcile
+   phases the tracer names (``contributions`` / ``aggregate`` / ``plan``
+   / ``remediation`` / ``project`` / ``unattributed``).
+
+Input comes from one of three seams, checked in order:
+
+* an in-process ``profiler=`` object (tests, benches — no HTTP);
+* ``--url http://...:8443/debug/profile`` with the bearer token from
+  ``--token-env`` (add ``--seconds`` for a fresh bounded capture
+  instead of the continuous buffer);
+* ``--file dump.folded`` (or ``-`` for stdin) — a saved dump, e.g. the
+  ``profile.json`` member of a diag bundle or a flamegraph.pl input.
+
+Usage:
+    python tools/prof.py --url https://host:8443/debug/profile --top 15
+    python tools/prof.py --file profile.folded --phase plan
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+from diag import _http_get   # noqa: E402
+
+PHASE_PREFIX = "phase:"
+
+
+def parse_folded(text: str) -> List[Tuple[List[str], int]]:
+    """Folded lines -> ``(frames, count)`` pairs.  Malformed lines are
+    skipped, not fatal — a truncated capture is still evidence."""
+    out: List[Tuple[List[str], int]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        stack, _, count_s = line.rpartition(" ")
+        if not stack:
+            continue
+        try:
+            count = int(count_s)
+        except ValueError:
+            continue
+        if count <= 0:
+            continue
+        out.append((stack.split(";"), count))
+    return out
+
+
+def aggregate(
+    stacks: List[Tuple[List[str], int]], phase: str = ""
+) -> Tuple[Dict[str, int], Dict[str, int], Dict[str, int], int]:
+    """``(self, inclusive, by_phase, total)`` sample counts.
+
+    ``self`` attributes each stack's count to its leaf frame;
+    ``inclusive`` to every distinct frame on the stack (a frame
+    appearing twice through recursion counts once per stack, so
+    inclusive never exceeds total).  ``phase`` filters stacks to one
+    span name before attribution; the phase marker frame itself is
+    excluded from the frame tables.
+    """
+    self_t: Dict[str, int] = {}
+    incl: Dict[str, int] = {}
+    by_phase: Dict[str, int] = {}
+    total = 0
+    for frames, count in stacks:
+        ph = ""
+        if frames and frames[0].startswith(PHASE_PREFIX):
+            ph = frames[0][len(PHASE_PREFIX):]
+            frames = frames[1:]
+        if phase and ph != phase:
+            continue
+        if not frames:
+            continue
+        total += count
+        by_phase[ph or "unattributed"] = (
+            by_phase.get(ph or "unattributed", 0) + count
+        )
+        self_t[frames[-1]] = self_t.get(frames[-1], 0) + count
+        for f in set(frames):
+            incl[f] = incl.get(f, 0) + count
+    return self_t, incl, by_phase, total
+
+
+def render(
+    self_t: Dict[str, int],
+    incl: Dict[str, int],
+    by_phase: Dict[str, int],
+    total: int,
+    top: int = 20,
+) -> str:
+    if total <= 0:
+        return "no samples (profiler off, just started, or phase filter matched nothing)"
+    lines: List[str] = []
+    lines.append(f"{total} samples")
+    lines.append("")
+    lines.append("phase split:")
+    for ph, n in sorted(by_phase.items(), key=lambda kv: (-kv[1], kv[0])):
+        lines.append(f"  {100.0 * n / total:5.1f}%  {n:6d}  {ph}")
+    lines.append("")
+    lines.append(f"top {min(top, len(self_t))} by self time:")
+    lines.append(f"  {'self%':>6} {'self':>6} {'incl%':>6}  frame")
+    ranked = sorted(self_t.items(), key=lambda kv: (-kv[1], kv[0]))[:top]
+    for frame, n in ranked:
+        lines.append(
+            f"  {100.0 * n / total:5.1f}% {n:6d} "
+            f"{100.0 * incl.get(frame, n) / total:5.1f}%  {frame}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None, profiler=None) -> int:
+    """CLI entry.  ``profiler`` is the in-process seam: tests pass a
+    live :class:`tpu_network_operator.obs.profile.SamplingProfiler`
+    and skip HTTP/files entirely."""
+    ap = argparse.ArgumentParser(
+        prog="tpunet-prof",
+        description="top-N self-time report over folded profiler stacks",
+    )
+    ap.add_argument("--url", default="",
+                    help="operator /debug/profile endpoint")
+    ap.add_argument("--file", default="",
+                    help="folded-stack dump ('-' for stdin)")
+    ap.add_argument("--seconds", type=float, default=0.0,
+                    help="with --url: fresh bounded capture instead of "
+                         "the continuous buffer")
+    ap.add_argument("--top", type=int, default=20,
+                    help="frames to list (default 20)")
+    ap.add_argument("--phase", default="",
+                    help="restrict to one reconcile phase "
+                         "(e.g. plan, contributions)")
+    ap.add_argument("--token-env", default="TPUNET_KUBE_TOKEN")
+    args = ap.parse_args(argv)
+
+    if profiler is not None:
+        if args.seconds > 0:
+            text = profiler.capture(args.seconds).folded()
+        else:
+            text = profiler.folded()
+    elif args.url:
+        url = args.url
+        if args.seconds > 0:
+            sep = "&" if "?" in url else "?"
+            url = f"{url}{sep}seconds={args.seconds:g}"
+        token = os.environ.get(args.token_env, "")
+        try:
+            text = _http_get(url, token)
+        except Exception as e:   # noqa: BLE001 — explain the miss
+            print(f"error: fetch {url} failed: {e}", file=sys.stderr)
+            return 1
+    elif args.file:
+        if args.file == "-":
+            text = sys.stdin.read()
+        else:
+            try:
+                with open(args.file, "r", encoding="utf-8") as fh:
+                    text = fh.read()
+            except OSError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
+    else:
+        print("error: need --url, --file, or an in-process profiler",
+              file=sys.stderr)
+        return 1
+
+    stacks = parse_folded(text)
+    self_t, incl, by_phase, total = aggregate(stacks, phase=args.phase)
+    print(render(self_t, incl, by_phase, total, top=max(1, args.top)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

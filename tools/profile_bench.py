@@ -1,0 +1,335 @@
+#!/usr/bin/env python3
+"""Profiling-plane benchmark — prints ONE JSON line (BENCH-style).
+
+Proves the profiling plane observes without perturbing, and that what
+it reports is true:
+
+1. **Overhead gate** — the 10k-node steady-state sweep (the repo's
+   regression anchor) run in interleaved blocks with the sampling
+   profiler OFF and ON (29 Hz default, TracedLocks recording in both
+   states — they are always live).  The ON p50 must sit within 2% of
+   the OFF p50: a profiler you cannot leave running in production is a
+   profiler nobody runs during the incident.
+
+2. **Attribution gate** — a worker thread burns CPU inside a tracer
+   span named ``plan`` while a bounded capture runs; the folded output
+   must attribute the majority of samples to ``phase:plan`` and name
+   the burning function.  A profiler that misattributes is worse than
+   none.
+
+3. **Parallel-efficiency baseline** — the first 10k-node reconcile
+   exercises the pooled entry rebuild; the measured
+   ``tpunet_rebuild_parallel_efficiency`` gauge must be recorded and
+   positive.  Under the GIL the expected value is ~1.0 — this artifact
+   IS the baseline a future free-threaded/subinterpreter rung gets
+   compared against.
+
+4. **Steady-writes gate** — with the profiler running, steady passes
+   still issue ZERO apiserver writes: observation must not create
+   control-plane traffic.
+
+The artifact carries deterministic fields (counts, booleans) plus the
+measured timings; two runs produce identical rows modulo the timing
+fields (wall_seconds, p50s, overhead, efficiency, sample counts).
+
+Usage: python tools/profile_bench.py [--nodes 10000] [--rounds 12]
+       [--blocks 3] [--out BENCH_profile.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import scale_bench as sb   # noqa: E402 — shared fleet/seed helpers
+
+NAMESPACE = "tpunet-system"
+POLICY = sb.POLICY
+
+OVERHEAD_LIMIT_PCT = 2.0
+# delta-tracked steady passes measure in the sub-millisecond range,
+# where 2% is single-digit microseconds — below perf_counter jitter on
+# a shared box.  The absolute floor keeps the gate about the profiler,
+# not the scheduler.
+OVERHEAD_FLOOR_MS = 0.05
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+# -- phase 1+3+4: 10k-node steady sweep, profiler off vs on --------------------
+
+
+def run_overhead(n_nodes: int, rounds: int, blocks: int):
+    """Interleaved OFF/ON latency blocks over one converged fleet.
+
+    Interleaving (off, on, off, on, ...) instead of two contiguous
+    halves cancels slow drift (allocator warmup, cache effects) that
+    would otherwise masquerade as profiler overhead.
+    """
+    from tpu_network_operator.agent import report as rpt
+    from tpu_network_operator.api.v1alpha1.types import API_VERSION
+    from tpu_network_operator.controller.health import Metrics
+    from tpu_network_operator.controller.reconciler import (
+        NetworkClusterPolicyReconciler,
+    )
+    from tpu_network_operator.kube.fake import FakeCluster
+    from tpu_network_operator.kube.informer import CachedClient
+    from tpu_network_operator.obs import SamplingProfiler
+    from tpu_network_operator.obs import profile as obs_profile
+
+    log(f"== overhead sweep: {n_nodes} nodes, "
+        f"{blocks}x{rounds} passes per state")
+    fake = FakeCluster()
+    fake.create(sb.make_policy())
+    t0 = time.perf_counter()
+    for i in range(n_nodes):
+        node = f"node-{i:05d}"
+        fake.add_node(node, sb.rack_labels(i))
+        fake.apply(rpt.lease_for(sb.healthy_report(node, i), NAMESPACE))
+    log(f"   seeded in {time.perf_counter() - t0:.1f}s")
+
+    split = CachedClient(fake)
+    split.cache(API_VERSION, "NetworkClusterPolicy")
+    split.cache("apps/v1", "DaemonSet", namespace=NAMESPACE)
+    split.cache("v1", "Pod", namespace=NAMESPACE)
+    split.cache(rpt.LEASE_API, "Lease", namespace=NAMESPACE)
+    split.cache("v1", "Node")
+    split.start()
+    metrics = Metrics()
+    obs_profile.set_metrics(metrics)
+    # rebuild_workers pinned: the auto heuristic (min(4, cpu_count))
+    # degrades to the sequential path on a 1-core box, and this bench
+    # must exercise the pooled fan-out to record its efficiency
+    rec = NetworkClusterPolicyReconciler(
+        split, NAMESPACE, metrics=metrics, rebuild_workers=4,
+    )
+    rec.REPORT_CACHE_SECONDS = 0.0
+    rec.setup()
+
+    # converge: the first pass exercises the pooled entry rebuild and
+    # records the parallel-efficiency baseline this bench exists to pin
+    rec.reconcile(POLICY)
+    fake.simulate_daemonset_controller()
+    for _ in range(5):
+        before = sb.write_counts(fake)
+        rec.reconcile(POLICY)
+        if sb.delta_writes(before, sb.write_counts(fake)) == 0:
+            break
+    parallel_eff = float(rec._last_parallel_efficiency)
+    exposition = metrics.render()
+    eff_exported = "tpunet_rebuild_parallel_efficiency" in exposition
+    locks_exported = "tpunet_lock_wait_seconds" in exposition
+
+    profiler = SamplingProfiler(metrics=metrics)   # shipped defaults
+    lat_off, lat_on = [], []
+    steady_writes = 0
+    try:
+        for block in range(2 * blocks):
+            on = block % 2 == 1
+            if on:
+                profiler.start()
+            # one unmeasured pass absorbs the state flip (thread
+            # start/stop, first-sample trie faults)
+            rec.reconcile(POLICY)
+            before = sb.write_counts(fake)
+            sink = lat_on if on else lat_off
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                rec.reconcile(POLICY)
+                sink.append(time.perf_counter() - t0)
+            steady_writes += sb.delta_writes(
+                before, sb.write_counts(fake)
+            )
+            if on:
+                profiler.stop()
+    finally:
+        profiler.stop()
+        split.stop()
+        obs_profile.set_metrics(None)
+
+    p50_off = sb.pctile(sorted(lat_off), 0.5)
+    p50_on = sb.pctile(sorted(lat_on), 0.5)
+    overhead_pct = 100.0 * (p50_on / p50_off - 1.0) if p50_off else 0.0
+    stats = profiler.stats()
+    # the zero-samples sanity check only means something if the ON
+    # blocks ran long enough for the sampler to plausibly fire at all
+    expected_samples = profiler.hz * sum(lat_on)
+    log(f"   -> p50 off {p50_off * 1e3:.3f}ms / on {p50_on * 1e3:.3f}ms "
+        f"({overhead_pct:+.2f}%), {stats['samples']} samples, "
+        f"parallel efficiency {parallel_eff:.3f}, "
+        f"{steady_writes} steady writes")
+    return {
+        "nodes": n_nodes,
+        "passes_per_state": blocks * rounds,
+        "p50_off_ms": round(p50_off * 1e3, 3),
+        "p50_on_ms": round(p50_on * 1e3, 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "profiler_samples": stats["samples"],
+        "profiler_expected_samples": round(expected_samples, 1),
+        "profiler_evictions": stats["evictions"],
+        "steady_writes": int(steady_writes),
+        "parallel_efficiency": round(parallel_eff, 3),
+        "parallel_efficiency_exported": eff_exported,
+        "lock_metrics_exported": locks_exported,
+    }
+
+
+# -- phase 2: seeded hot-phase attribution -------------------------------------
+
+
+def burn_in_plan_span(tracer, stop: threading.Event):
+    """The seeded hot function: spins inside a span named ``plan`` so
+    every sample taken on this thread must fold under ``phase:plan``
+    and end in this frame."""
+    with tracer.span("plan"):
+        x = 0
+        while not stop.is_set():
+            for i in range(2000):
+                x = (x + i * i) % 997
+    return x
+
+
+def run_attribution(seconds: float = 0.4):
+    from tpu_network_operator.obs import SamplingProfiler, Tracer
+
+    log(f"== attribution capture: {seconds:g}s against a seeded "
+        "hot loop in span 'plan'")
+    tracer = Tracer()
+    stop = threading.Event()
+    worker = threading.Thread(
+        target=burn_in_plan_span, args=(tracer, stop), daemon=True,
+    )
+    worker.start()
+    profiler = SamplingProfiler(hz=97.0)
+    try:
+        folded = profiler.capture(seconds)
+    finally:
+        stop.set()
+        worker.join(timeout=5)
+    total = plan = 0
+    hot_frame = False
+    for line in folded.splitlines():
+        stack, _, count_s = line.rpartition(" ")
+        n = int(count_s)
+        total += n
+        if stack.startswith("phase:plan;"):
+            plan += n
+            if "burn_in_plan_span" in stack:
+                hot_frame = True
+    share = plan / total if total else 0.0
+    log(f"   -> {total} samples, {100 * share:.0f}% in phase:plan, "
+        f"hot frame {'named' if hot_frame else 'MISSING'}")
+    return {
+        "capture_samples": total,
+        "plan_share": round(share, 3),
+        "hot_frame_named": hot_frame,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=10000,
+                    help="steady-state sweep size")
+    ap.add_argument("--rounds", type=int, default=40,
+                    help="measured passes per block")
+    ap.add_argument("--blocks", type=int, default=3,
+                    help="off/on block pairs to interleave")
+    ap.add_argument("--capture-seconds", type=float, default=0.4)
+    ap.add_argument("--out", default="",
+                    help="also write the JSON artifact to this path")
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    overhead = run_overhead(args.nodes, args.rounds, args.blocks)
+    attribution = run_attribution(args.capture_seconds)
+    wall = time.perf_counter() - t0
+
+    failures = []
+    # gate 1: the profiler is cheap enough to leave on
+    delta_ms = overhead["p50_on_ms"] - overhead["p50_off_ms"]
+    if (overhead["overhead_pct"] > OVERHEAD_LIMIT_PCT
+            and delta_ms > OVERHEAD_FLOOR_MS):
+        failures.append(
+            f"overhead: profiler-on p50 {overhead['p50_on_ms']}ms is "
+            f"{overhead['overhead_pct']}% over the off baseline "
+            f"{overhead['p50_off_ms']}ms (limit {OVERHEAD_LIMIT_PCT}% "
+            f"or {OVERHEAD_FLOOR_MS}ms)"
+        )
+    if (overhead["profiler_samples"] <= 0
+            and overhead["profiler_expected_samples"] >= 3):
+        failures.append(
+            "overhead: the ON blocks collected zero samples — the "
+            "gate compared nothing"
+        )
+    # gate 2: samples land on the right phase and name the hot frame
+    if attribution["capture_samples"] <= 0:
+        failures.append("attribution: capture collected zero samples")
+    if attribution["plan_share"] < 0.5:
+        failures.append(
+            f"attribution: only {attribution['plan_share']:.0%} of "
+            "samples landed in phase:plan (want >=50%)"
+        )
+    if not attribution["hot_frame_named"]:
+        failures.append(
+            "attribution: the seeded hot function never appeared on a "
+            "phase:plan stack"
+        )
+    # gate 3: the rebuild parallel-efficiency baseline is recorded
+    if not overhead["parallel_efficiency"] > 0:
+        failures.append(
+            "parallel-efficiency: pooled rebuild recorded no "
+            "measurement"
+        )
+    if not overhead["parallel_efficiency_exported"]:
+        failures.append(
+            "parallel-efficiency: gauge missing from /metrics"
+        )
+    if not overhead["lock_metrics_exported"]:
+        failures.append(
+            "locks: tpunet_lock_wait_seconds missing from /metrics"
+        )
+    # gate 4: observation creates no control-plane traffic
+    if overhead["steady_writes"] != 0:
+        failures.append(
+            f"steady: {overhead['steady_writes']} apiserver write(s) "
+            "across measured passes (want 0)"
+        )
+
+    result = {
+        "metric": "profiler-on steady-pass p50 overhead at "
+                  f"{overhead['nodes']} nodes",
+        "value": overhead["overhead_pct"],
+        "unit": "percent",
+        # ON p50 as a fraction of the OFF baseline (1.0 = free)
+        "vs_baseline": round(
+            overhead["p50_on_ms"] / max(overhead["p50_off_ms"], 1e-9),
+            3,
+        ),
+        "overhead": overhead,
+        "attribution": attribution,
+        "wall_seconds": round(wall, 3),
+        "ok": not failures,
+        "failures": failures,
+    }
+    line = json.dumps(result)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(line)
+    if failures:
+        log("FAILED: " + "; ".join(failures))
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -77,6 +77,7 @@ class DirtyTracker:
     forever, which is exactly the legacy full-rebuild behavior."""
 
     def __init__(self):
+        # tpunet: allow=T003 fires inside informer delta dispatch under the traced informer.store lock; set-add critical sections, and tracing both sides would double-count one contention point
         self._lock = threading.Lock()
         # policy -> {(node, lease_name_or_None)} — the lease name rides
         # along when the delta saw it (Leases with unconventional names

@@ -190,6 +190,30 @@ METRIC_HELP: Dict[str, str] = {
         "instead of re-deriving, by source (memory = unchanged lease "
         "within one process; persisted = the checkpointed "
         "contribution cache after a restart/failover).",
+    "tpunet_lock_wait_seconds":
+        "Time acquire() blocked on one named control-plane lock "
+        "(obs.profile.TracedLock) — the contention signal; near-zero "
+        "sums are healthy.",
+    "tpunet_lock_hold_seconds":
+        "Time one named control-plane lock was held per "
+        "acquire/release cycle — long holds are what the waiters in "
+        "tpunet_lock_wait_seconds are waiting on.",
+    "tpunet_profile_samples_total":
+        "Stack samples folded by the sampling profiler, by the "
+        "reconcile phase (trace span) active on the sampled thread "
+        "(unattributed = no span).",
+    "tpunet_profile_stack_bytes":
+        "Bytes the profiler's folded-stack trie currently holds "
+        "(bounded by its byte budget; see "
+        "tpunet_profile_evictions_total).",
+    "tpunet_profile_evictions_total":
+        "Coldest-leaf evictions the profiler's trie performed to stay "
+        "inside its byte budget (counts fold into the parent frame — "
+        "totals survive, detail truncates).",
+    "tpunet_rebuild_parallel_efficiency":
+        "Effective concurrent cores of the last per-shard rebuild "
+        "fan-out (summed worker thread_time over wall time) per "
+        "policy; ~1.0 means the GIL serialized the workers.",
 }
 
 
@@ -200,6 +224,19 @@ def set_build_info(metrics: "Metrics") -> None:
     from .. import __version__
 
     metrics.set_gauge("tpunet_build_info", 1.0, {"version": __version__})
+
+
+# sub-millisecond-biased bucket ladder, shared by every family whose
+# signal lives below the default buckets' first edge: status-pass
+# phases on steady/small-churn passes, and lock wait/hold times (an
+# uncontended stdlib acquire is ~100ns — a wait that registers in the
+# 0.5ms bucket at all IS the contention signal).  ONE constant on
+# purpose: this ladder was hand-copied once already, and a third copy
+# drifting would silently split dashboards.
+SUB_MS_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5,
+)
 
 
 class Metrics:
@@ -221,13 +258,9 @@ class Metrics:
             0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 120.0,
             300.0,
         ),
-        # status-pass phases run at sub-millisecond scale on steady
-        # and small-churn passes — the default buckets would dump
-        # everything into the first edge with zero resolution
-        "tpunet_reconcile_status_phase_seconds": (
-            0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
-            0.25, 0.5, 1.0, 2.5,
-        ),
+        "tpunet_reconcile_status_phase_seconds": SUB_MS_BUCKETS,
+        "tpunet_lock_wait_seconds": SUB_MS_BUCKETS,
+        "tpunet_lock_hold_seconds": SUB_MS_BUCKETS,
         # SLO episode latencies run at probe-interval timescales and
         # beyond (detection within a round, convergence across
         # cooldown windows)
@@ -244,7 +277,13 @@ class Metrics:
         return self.BUCKETS_BY_NAME.get(name, self.HISTOGRAM_BUCKETS)
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # the registry's own lock is traced into the registry it
+        # guards: TracedLock records after release, behind a
+        # per-thread re-entrancy guard, so the self-reference is
+        # deadlock- and recursion-free (see obs.profile)
+        from ..obs.profile import TracedLock
+
+        self._lock = TracedLock("metrics", metrics=self)
         self._counters: Counter = Counter()
         self._gauges: Dict[Tuple[str, tuple], float] = {}
         # (name, labels) -> [bucket counts..., +Inf count, sum]
@@ -404,6 +443,7 @@ class CachedTokenAuthenticator:
         self._failure_ttl = failure_ttl
         self._max_entries = max_entries
         self._clock = clock
+        # tpunet: allow=T003 auth-cache lock guards the gate in FRONT of the metrics surface; no registry is in scope to record into
         self._lock = threading.Lock()
         self._cache: Dict[str, Tuple[bool, float]] = {}
         # key -> Event: a review for this token is in flight (coalescing)
@@ -477,6 +517,7 @@ class HealthServer:
         tracer=None,
         timeline=None,
         history=None,
+        profiler=None,
     ):
         """``metrics=None`` means NO /metrics endpoint on this server (the
         probe port must not leak the registry the secure port protects).
@@ -492,13 +533,20 @@ class HealthServer:
         policy/node/kind/since/limit query filters.  ``history`` (an
         :class:`..obs.HistoryEngine`) serves the mined priors —
         sticky flap penalties, per-rung success rates, active skips —
-        from ``/debug/history`` behind the same gate."""
+        from ``/debug/history`` behind the same gate.  ``profiler``
+        (an :class:`..obs.SamplingProfiler`) serves the continuous
+        folded-stack buffer from ``/debug/profile`` (text,
+        flamegraph.pl/speedscope input; ``?seconds=N`` captures a
+        fresh bounded window instead) behind the same gate.  With any
+        debug surface wired, ``/debug/index`` enumerates them all
+        with per-buffer record/byte counts."""
         self.checks: Dict[str, Callable[[], bool]] = {"ping": lambda: True}
         self.ready_checks: Dict[str, Callable[[], bool]] = {"ping": lambda: True}
         self.metrics = metrics
         self.tracer = tracer
         self.timeline = timeline
         self.history = history
+        self.profiler = profiler
         self._metrics_auth = metrics_auth
 
         outer = self
@@ -613,6 +661,75 @@ class HealthServer:
                     self._respond(
                         200,
                         json.dumps(outer.history.summary()),
+                        "application/json",
+                    )
+                elif path == "/debug/profile":
+                    if outer.profiler is None:
+                        self._respond(404, "profile not served here")
+                        return
+                    if not self._authorized():
+                        self._respond(403, "forbidden")
+                        return
+                    q = urllib.parse.parse_qs(parsed.query)
+                    try:
+                        seconds = float(q.get("seconds", ["0"])[0])
+                    except ValueError:
+                        # degrade-to-default, same contract as the
+                        # /debug/traces limit: bad params never 500 —
+                        # serve the continuous buffer instead
+                        seconds = 0.0
+                    if seconds > 0:
+                        # bounded on-demand window (the profiler clamps
+                        # it); the continuous buffer keeps accumulating
+                        body = outer.profiler.capture(seconds)
+                    else:
+                        body = outer.profiler.folded()
+                    self._respond(200, body, "text/plain")
+                elif path == "/debug/index":
+                    if (outer.tracer is None and outer.timeline is None
+                            and outer.history is None
+                            and outer.profiler is None):
+                        self._respond(404, "no debug surfaces wired")
+                        return
+                    if not self._authorized():
+                        self._respond(403, "forbidden")
+                        return
+                    surfaces = {}
+                    if outer.tracer is not None:
+                        surfaces["traces"] = {
+                            "path": "/debug/traces",
+                            "spans": len(outer.tracer),
+                            "traceIds": len(outer.tracer.trace_ids()),
+                        }
+                    if outer.timeline is not None:
+                        surfaces["timeline"] = {
+                            "path": "/debug/timeline",
+                            "records": len(outer.timeline),
+                            "bytes": outer.timeline.total_bytes(),
+                            "dropped": outer.timeline.dropped(),
+                            "policies": len(outer.timeline.policies()),
+                        }
+                    if outer.history is not None:
+                        surfaces["history"] = {
+                            "path": "/debug/history",
+                            "policies": len(
+                                outer.history.summary().get(
+                                    "policies", {}
+                                )
+                            ),
+                        }
+                    if outer.profiler is not None:
+                        st = outer.profiler.stats()
+                        surfaces["profile"] = {
+                            "path": "/debug/profile",
+                            "samples": st["samples"],
+                            "frames": st["frames"],
+                            "bytes": st["bytes"],
+                            "evictions": st["evictions"],
+                        }
+                    self._respond(
+                        200,
+                        json.dumps({"surfaces": surfaces}),
                         "application/json",
                     )
                 else:

@@ -28,6 +28,7 @@ from ..kube.client import ApiClient, is_openshift
 from ..kube.informer import CachedClient
 from ..kube.retry import RetryingClient
 from ..obs import EventRecorder, HistoryEngine, SloEngine, Timeline, Tracer
+from ..obs import profile as obs_profile
 from ..obs import logging as obs_logging
 from .health import DEFAULT as METRICS, CachedTokenAuthenticator, HealthServer
 from .leader import LeaderElector
@@ -91,6 +92,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "(served from /debug/timeline; oldest records "
                         "evict first; 0 = journal disabled; values "
                         "1-4095 are raised to the 4096 floor)")
+    p.add_argument("--profile-hz", type=float, default=29.0,
+                   help="continuous stack-sampling rate for the "
+                        "self-profiling plane (served from "
+                        "/debug/profile as folded stacks, attributed "
+                        "to reconcile phases; 0 = sampler off; 29 is "
+                        "prime so it cannot phase-lock with periodic "
+                        "work)")
+    p.add_argument("--profile-buffer-bytes", type=int, default=262144,
+                   help="byte budget of the profiler's folded-stack "
+                        "trie; coldest stacks evict first (counts "
+                        "fold into the parent frame, evictions are "
+                        "counted, never silent)")
     p.add_argument("--report-cache-seconds", type=float, default=2.0,
                    help="agent-report Lease list cache window: one "
                         "namespace-wide list serves all policies' status "
@@ -217,6 +230,18 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
         # BACK into the planner (pre-emptive route-around) and the
         # remediation ladder (rung skipping, burn-scaled budgets)
         history = HistoryEngine(timeline, metrics=METRICS, slo=slo)
+    # self-profiling plane: TracedLocks constructed without an
+    # explicit registry (informer Store, sharding coordinator) record
+    # into the process default sink, consulted at record time — wired
+    # here, before the control plane starts taking traffic
+    obs_profile.set_metrics(METRICS)
+    profiler = None
+    if args.profile_hz > 0:
+        profiler = obs_profile.SamplingProfiler(
+            hz=args.profile_hz,
+            byte_budget=args.profile_buffer_bytes,
+            metrics=METRICS,
+        )
 
     # horizontal sharding (controller/sharding.py): per-shard Leases
     # partition the policy set across replicas.  Like leader election,
@@ -288,13 +313,15 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
                     "served over plain HTTP", args.webhook_cert_dir,
                 )
         # the metrics listener also serves /debug/traces,
-        # /debug/timeline and /debug/history (same authn gate): span
-        # attributes, journal records and mined priors carry object
-        # names the unauthenticated probe port must not leak
+        # /debug/timeline, /debug/history, /debug/profile and the
+        # /debug/index directory (same authn gate): span attributes,
+        # journal records, mined priors and sampled stacks carry
+        # object names the unauthenticated probe port must not leak
         servers.append(HealthServer(
             port=_port_of(args.metrics_bind_address),
             metrics=METRICS, metrics_auth=auth, tls_cert_dir=tls_dir,
             tracer=tracer, timeline=timeline, history=history,
+            profiler=profiler,
         ))
 
     webhook_server = None
@@ -344,6 +371,8 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
 
     for s in servers:
         s.start()
+    if profiler is not None:
+        profiler.start()
     if webhook_server:
         webhook_server.start()
     if health:
@@ -366,6 +395,8 @@ def run(argv: Optional[List[str]] = None, client=None) -> int:
     log.info("shutting down")
     if elector:
         elector.stop()
+    if profiler is not None:
+        profiler.stop()
     mgr.stop()
     cached.stop()
     if webhook_server:

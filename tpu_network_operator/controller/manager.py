@@ -172,6 +172,7 @@ class Manager:
         # are pruned on success AND on policy deletion (a deleted
         # permanently-failing CR must not leak its counter forever).
         self._failures: dict = {}
+        # tpunet: allow=T003 requeue-backoff bookkeeping; microsecond dict ops touched only on failures, not on the steady-pass hot path
         self._failures_lock = threading.Lock()
         self._backoff_timers: dict = {}
         self._backoff_base = 0.005

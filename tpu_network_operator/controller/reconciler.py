@@ -17,7 +17,6 @@ import copy
 import heapq
 import logging
 import os.path
-import threading
 from dataclasses import dataclass
 from typing import Any, Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
 
@@ -29,6 +28,7 @@ from ..kube.informer import LIST_PAGE_SIZE   # noqa: F401 — re-exported
 from ..obs import events as obs_events
 from ..obs import history as obs_history
 from ..obs import timeline as obs_tl
+from ..obs.profile import TracedLock, parallel_efficiency
 from ..obs.trace import TRACE_ANNOTATION, current_trace_id
 from ..planner import PlanTracker
 from ..planner import plan as planner_plan
@@ -490,8 +490,10 @@ class NetworkClusterPolicyReconciler:
         self._reports_cache: Optional[Dict[str, List[Any]]] = None
         self._reports_cached_at = 0.0
         # concurrent workers share one reconciler instance; the bucket
-        # cache is its only cross-key mutable state
-        self._reports_lock = threading.Lock()
+        # cache is its only cross-key mutable state.  Traced: this is
+        # the contribution-cache lock every status pass crosses — the
+        # first lock to check when steady-pass p50 drifts.
+        self._reports_lock = TracedLock("contribcache", metrics=metrics)
         # dataplane quarantine bookkeeping per (policy, node):
         # (streak, last_advance_ts).  The streak advances at most once
         # per probe interval of wall time — a burst of reconciles (DS
@@ -500,7 +502,11 @@ class NetworkClusterPolicyReconciler:
         # never runs one policy on two workers, but the dict spans
         # policies — lock it.  _probe_clock is a test seam.
         self._probe_failing: Dict[Any, Any] = {}
-        self._probe_lock = threading.Lock()
+        self._probe_lock = TracedLock("reconciler.probe", metrics=metrics)
+        # effective concurrent cores of the last pooled rebuild fan-out
+        # (0.0 until one runs); also exported as the
+        # tpunet_rebuild_parallel_efficiency{policy} gauge
+        self._last_parallel_efficiency = 0.0
         import time as _time
 
         # monotonic: an NTP step must not fast-forward (or freeze) the
@@ -1694,21 +1700,45 @@ class NetworkClusterPolicyReconciler:
         from concurrent.futures import ThreadPoolExecutor
 
         out: Dict[int, NodeContribution] = {}
+        # per-worker CPU seconds: summed thread_time over wall time is
+        # the fan-out's effective concurrent cores — the measured
+        # number behind the ROADMAP's "GIL-bound on one core" claim
+        # (≈1.0 today), exported as the regression anchor any future
+        # columnar-derivation PR must move
+        cpu_seconds: List[float] = []
 
         def derive_chunk(chunk):
-            return [
+            import time as time_mod
+
+            cpu0 = time_mod.thread_time()
+            result = [
                 (idx, self._contribution(
                     pname, lease_name, rv, rep, renewed, rpt=rpt,
                     **ctx_args,
                 ))
                 for idx, lease_name, rep, renewed, rv in chunk
             ]
+            cpu_seconds.append(time_mod.thread_time() - cpu0)
+            return result
+
+        import time as time_mod
 
         step = -(-len(jobs) // workers)
         chunks = [jobs[i:i + step] for i in range(0, len(jobs), step)]
+        wall0 = time_mod.perf_counter()
         with ThreadPoolExecutor(max_workers=len(chunks)) as pool:
             for result in pool.map(derive_chunk, chunks):
                 out.update(result)
+        wall = time_mod.perf_counter() - wall0
+        self._last_parallel_efficiency = parallel_efficiency(
+            cpu_seconds, wall
+        )
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "tpunet_rebuild_parallel_efficiency",
+                round(self._last_parallel_efficiency, 3),
+                {"policy": pname},
+            )
         return out
 
     def _rebuild_derived(

@@ -40,13 +40,13 @@ import hashlib
 import json
 import logging
 import socket
-import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ..kube import errors as kerr
 from ..obs import timeline as obs_tl
+from ..obs.profile import TracedLock
 from ..probe.topology import stable_hash
 from .leader import LEASE_DURATION, _parse
 
@@ -138,7 +138,7 @@ class ShardCoordinator:
         # took it (sync() uses it to tell a failover takeover from a
         # fresh/clean acquire when journaling the gained edge)
         self._observed_holder: Dict[int, str] = {}
-        self._lock = threading.Lock()
+        self._lock = TracedLock("sharding")
         self._stopped = False
 
     def _journal(self, shard: int, to: str, frm: str = "") -> None:

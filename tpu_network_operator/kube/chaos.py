@@ -137,6 +137,7 @@ class FaultInjector:
         self.inner = inner
         self._rng = random.Random(seed)
         self._sleep = sleep
+        # tpunet: allow=T003 test-infrastructure fault injector, never constructed in the production control plane
         self._lock = threading.Lock()
         self._rules: List[FaultRule] = []
         self._outage = False

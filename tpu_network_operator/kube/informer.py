@@ -35,6 +35,7 @@ import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+from ..obs.profile import TracedLock
 from .fake import match_labels
 
 log = logging.getLogger("tpunet.kube.informer")
@@ -65,7 +66,10 @@ class Store:
     and silently matching nothing would hide exactly that bug)."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        # reentrant like the RLock it replaces: indexed lookups recurse
+        # through list() under the same lock.  Contention-traced —
+        # every informer delta and every cache read crosses it.
+        self._lock = TracedLock("informer.store", reentrant=True)
         self._objs: Dict[Key, Dict[str, Any]] = {}
         self._indexers: Dict[str, Callable] = {}
         # index name -> indexed value -> keys (maintained at insert time,

@@ -28,6 +28,13 @@ traffic at fleet scale):
   SLOs (fleet readiness, fault-detection latency, remediation
   convergence, fast-path hit ratio) exported as ``tpunet_slo_*``
   metrics and the bounded ``status.health`` rollup.
+* :mod:`.profile` — the self-profiling plane: a 29 Hz stack sampler
+  folding ``sys._current_frames()`` into a byte-budgeted trie
+  (attributed to the active trace span per thread, served as
+  folded-stack flamegraph text from ``/debug/profile``), the
+  :class:`~.profile.TracedLock` contention wrapper exporting
+  ``tpunet_lock_wait_seconds``/``tpunet_lock_hold_seconds``, and the
+  rebuild fan-out's measured parallel-efficiency anchor.
 * :mod:`.history` — the history plane: the same journal mined into
   decision-grade priors (flap-frequency penalties with hysteresis,
   per-rung remediation success rates, burn-rate urgency) that feed
@@ -40,6 +47,7 @@ traffic at fleet scale):
 from .events import EventRecorder
 from .history import HistoryEngine
 from .logging import JsonFormatter, setup_logging
+from .profile import SamplingProfiler, StackTrie, TracedLock
 from .slo import SloEngine
 from .timeline import Timeline
 from .trace import (
@@ -55,9 +63,12 @@ __all__ = [
     "HistoryEngine",
     "JsonFormatter",
     "setup_logging",
+    "SamplingProfiler",
     "SloEngine",
     "Span",
+    "StackTrie",
     "Timeline",
+    "TracedLock",
     "Tracer",
     "TRACE_ANNOTATION",
     "current_span",

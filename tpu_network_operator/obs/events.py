@@ -93,6 +93,7 @@ class EventRecorder:
         self.refill_seconds = float(refill_seconds)
         self.aggregation_threshold = max(2, int(aggregation_threshold))
         self._clock = clock
+        # tpunet: allow=T003 event emission is deduped and rate-limited — cold by design; keep the traced set to the hot locks the contention dashboard watches
         self._lock = threading.Lock()
         # dedup key -> (count, first_wall_ts); key includes the message
         self._counts: Dict[Tuple, Tuple[int, float]] = {}

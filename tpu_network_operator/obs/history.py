@@ -156,6 +156,7 @@ class HistoryEngine:
         self.rung_success_floor = float(rung_success_floor)
         self.min_rung_samples = max(int(min_rung_samples), 1)
         self._clock = clock
+        # tpunet: allow=T003 mines only on journal appends and replans — zero acquisitions on a steady pass
         self._lock = threading.Lock()
         # policy -> key -> deque[flap ts] (newest-last, bounded)
         self._flaps: Dict[str, Dict[FlapKey, deque]] = {}

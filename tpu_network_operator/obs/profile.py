@@ -1,0 +1,571 @@
+"""Self-profiling plane: stack sampling + lock-contention attribution.
+
+The ROADMAP's open perf items ("break the GIL ceiling" on the rebuild
+fan-out, the modeled-vs-measured honesty gap) were claims without
+instruments: nothing measured where controller CPU time actually goes,
+and none of the control plane's locks reported contention.  Following
+the always-on-profiling direction of Fathom-style host instrumentation
+(PAPERS.md), this module gives the operator that instrument set —
+cheap enough to leave on (the profile bench gates total overhead at
+≤2% of the 10k-node steady-pass p50):
+
+* :class:`SamplingProfiler` — a daemon thread walking
+  ``sys._current_frames()`` at ``--profile-hz`` (29 Hz by default, a
+  prime so the sampler cannot phase-lock with periodic control-plane
+  work; 0 disables).  Samples fold into a byte-budgeted
+  :class:`StackTrie` (evictions counted, never silent — the timeline
+  ring's discipline), and each sample joins against the active trace
+  span registry (:func:`.trace.active_span_for_thread`) so CPU time
+  attributes to reconcile phases (``contributions`` / ``aggregate`` /
+  ``plan`` / ``remediation`` / ``project``) and agent tick steps.
+  ``/debug/profile`` serves the trie in folded-stack flamegraph
+  format (``flamegraph.pl`` / speedscope consume it directly).
+* :class:`TracedLock` — a drop-in ``threading.Lock`` /
+  ``threading.RLock`` wrapper adopted at the hot control-plane locks,
+  exporting ``tpunet_lock_wait_seconds{lock}`` and
+  ``tpunet_lock_hold_seconds{lock}`` histograms on a sub-ms-biased
+  bucket ladder (uncontended stdlib acquires are ~100ns; a wait that
+  registers at all IS the signal).
+* :func:`parallel_efficiency` — the rebuild fan-out's hard number:
+  summed per-worker ``time.thread_time()`` CPU seconds over the
+  fan-out's wall seconds ≈ effective concurrent cores.  ~1.0 under
+  the GIL; the future columnar-derivation PR must move it.
+
+Recording discipline: a TracedLock records its wait+hold *after*
+release (never while holding — observation cost must not inflate hold
+times), and recording is re-entrancy-guarded per thread so the Metrics
+registry's own lock can itself be a TracedLock without recursing
+(releasing it records into the registry, which re-acquires it; the
+guard stops the chain at depth one).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import trace
+
+# 29 Hz: high enough that a 100ms phase collects ~3 samples, low
+# enough to stay inside the 2% overhead budget at 10k nodes — and
+# prime, so the sampler never phase-locks with 1s/10s periodic work
+DEFAULT_HZ = 29.0
+
+# trie byte budget: ~256 KiB holds tens of thousands of frames —
+# plenty for a control plane with a few dozen distinct code paths —
+# while bounding a pathological stack explosion the way the timeline
+# ring bounds journal growth
+DEFAULT_PROFILE_BYTE_BUDGET = 256 * 1024
+MIN_PROFILE_BYTE_BUDGET = 4096
+
+# frames deeper than this truncate (deepest frames kept): a runaway
+# recursion must not grow unbounded trie paths before eviction kicks in
+MAX_STACK_DEPTH = 64
+
+# /debug/profile?seconds= on-demand capture ceiling — a typo'd
+# seconds=9999 must not pin a server thread for hours
+MAX_CAPTURE_SECONDS = 60.0
+
+# per-trie-node bookkeeping estimate added to len(name): slots,
+# child-dict entry, counts.  An estimate is fine — the budget bounds
+# growth, it does not meter the allocator
+_NODE_OVERHEAD = 48
+
+# the folded root frame for samples with no active span — visible in
+# the flamegraph as its own tower instead of polluting a phase's
+_UNATTRIBUTED = "unattributed"
+
+
+# -- metrics sink ------------------------------------------------------------
+
+# module-default Metrics registry for TracedLocks constructed where no
+# registry is in scope (Timeline, informer Store, ...).  Wired once by
+# controller.main at startup; until then locks are traced but silent.
+_default_metrics = None
+_default_metrics_lock = threading.Lock()   # tpunet: allow=T003 module-init lock guarding the default-sink pointer; tracing it would re-enter the sink it guards
+
+
+def set_metrics(metrics) -> None:
+    """Install the process-default metrics sink for TracedLocks (and
+    profilers) constructed without an explicit registry."""
+    global _default_metrics
+    with _default_metrics_lock:
+        _default_metrics = metrics
+
+
+def get_metrics():
+    return _default_metrics
+
+
+# re-entrancy guard for lock-metric recording, shared by every
+# TracedLock in the process (the recursion it breaks — observe()
+# re-acquiring the traced Metrics lock — is per-thread, not per-lock)
+_record_tls = threading.local()
+
+
+class TracedLock:
+    """Drop-in ``threading.Lock``/``RLock`` exporting wait/hold time.
+
+    ``wait`` is the time :meth:`acquire` blocked; ``hold`` the time
+    from acquire to release.  Both are observed into
+    ``tpunet_lock_wait_seconds{lock=name}`` /
+    ``tpunet_lock_hold_seconds{lock=name}`` **after** the release, so
+    observation cost never inflates a hold and recording into a
+    registry whose own lock is traced cannot deadlock.
+
+    ``reentrant=True`` wraps an RLock (the informer Store's
+    contract): nested acquires are counted but only the outermost
+    acquire/release pair is measured — a re-entrant re-acquire never
+    waits and splitting the hold would double-count it.
+
+    Caveat (same as the stdlib primitive it wraps, but worth naming):
+    wait/hold accounting assumes release happens on the acquiring
+    thread.  A cross-thread release still releases correctly but that
+    cycle goes unrecorded.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        metrics=None,
+        clock: Callable[[], float] = time.perf_counter,
+        reentrant: bool = False,
+    ):
+        self._name = str(name)
+        self._metrics = metrics
+        self._clock = clock
+        self._reentrant = bool(reentrant)
+        self._labels = {"lock": self._name}
+        # tpunet: allow=T003 this IS the instrument — the raw primitive TracedLock wraps
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        self._tls = threading.local()
+
+    # -- threading.Lock protocol ----------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        tls = self._tls
+        depth = getattr(tls, "depth", 0)
+        if depth and self._reentrant:
+            # nested re-acquire: no wait by construction, no new hold
+            ok = self._inner.acquire(blocking, timeout)
+            if ok:
+                tls.depth = depth + 1
+            return ok
+        if getattr(_record_tls, "busy", False):
+            # this acquisition IS the recording of another lock's
+            # cycle (observe() taking the traced Metrics lock): it can
+            # never be recorded, so don't pay the clock reads either —
+            # this keeps the marginal cost of tracing the Metrics lock
+            # at two histogram writes per outer cycle, not six timer
+            # calls on top
+            ok = self._inner.acquire(blocking, timeout)
+            if ok:
+                tls.depth = 1
+                tls.wait = None
+                tls.hold_t0 = None
+            return ok
+        t0 = self._clock()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            now = self._clock()
+            tls.depth = 1
+            tls.wait = now - t0
+            tls.hold_t0 = now
+        return ok
+
+    def release(self) -> None:
+        tls = self._tls
+        depth = getattr(tls, "depth", 0)
+        if depth > 1:
+            tls.depth = depth - 1
+            self._inner.release()
+            return
+        wait = getattr(tls, "wait", None)
+        hold_t0 = getattr(tls, "hold_t0", None)
+        tls.depth = 0
+        tls.wait = None
+        hold = (
+            self._clock() - hold_t0 if hold_t0 is not None else None
+        )
+        tls.hold_t0 = None
+        self._inner.release()
+        if wait is not None and hold is not None:
+            self._observe(wait, hold)
+
+    def locked(self) -> bool:
+        fn = getattr(self._inner, "locked", None)
+        if fn is not None:
+            return bool(fn())
+        # RLock before 3.13 has no locked(); probe non-blocking.  An
+        # RLock this thread already owns reports unlocked — acceptable
+        # for the diagnostic uses locked() has in this codebase.
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        kind = "RLock" if self._reentrant else "Lock"
+        return f"<TracedLock {self._name!r} ({kind})>"
+
+    # -- recording --------------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def _observe(self, wait: float, hold: float) -> None:
+        metrics = self._metrics if self._metrics is not None \
+            else _default_metrics
+        if metrics is None:
+            return
+        if getattr(_record_tls, "busy", False):
+            # already inside another lock's recording on this thread:
+            # the observe() below re-acquires the (traced) Metrics
+            # lock, whose release would recurse right back here
+            return
+        _record_tls.busy = True
+        try:
+            labels = self._labels
+            metrics.observe("tpunet_lock_wait_seconds", wait, labels)
+            metrics.observe("tpunet_lock_hold_seconds", hold, labels)
+        finally:
+            _record_tls.busy = False
+
+
+# -- the folded-stack trie ----------------------------------------------------
+
+
+class _TrieNode:
+    __slots__ = ("name", "parent", "children", "count")
+
+    def __init__(self, name: str, parent: Optional["_TrieNode"]):
+        self.name = name
+        self.parent = parent
+        self.children: Dict[str, "_TrieNode"] = {}
+        # samples ending exactly here, plus counts folded up from
+        # evicted descendants (totals are preserved, detail is not)
+        self.count = 0
+
+
+class StackTrie:
+    """Bounded prefix tree of sampled stacks.
+
+    Costing mirrors the timeline ring: every node charges
+    ``len(name) + overhead`` bytes against the budget; going over
+    evicts the coldest leaf (fewest samples, lexicographic tie-break)
+    and folds its count into its parent — sample totals survive,
+    cold detail truncates, and :meth:`evicted` counts every fold so
+    truncation is never silent.  The leaf just inserted is protected:
+    the newest sample always survives its own insertion.
+
+    Not thread-safe; the owning profiler serializes access.
+    """
+
+    def __init__(self, byte_budget: int = DEFAULT_PROFILE_BYTE_BUDGET):
+        self.byte_budget = max(
+            int(byte_budget), MIN_PROFILE_BYTE_BUDGET
+        )
+        self._root = _TrieNode("", None)
+        self._bytes = 0
+        self._nodes = 0
+        self._samples = 0
+        self._evicted = 0
+
+    def add(self, frames: List[str], n: int = 1) -> None:
+        if not frames:
+            return
+        node = self._root
+        for name in frames[-MAX_STACK_DEPTH:]:
+            child = node.children.get(name)
+            if child is None:
+                child = _TrieNode(name, node)
+                node.children[name] = child
+                self._bytes += len(name) + _NODE_OVERHEAD
+                self._nodes += 1
+            node = child
+        node.count += n
+        self._samples += n
+        if self._bytes > self.byte_budget:
+            self._evict(protect=node)
+
+    def _leaves(self) -> List[Tuple[Tuple[str, ...], "_TrieNode"]]:
+        out: List[Tuple[Tuple[str, ...], _TrieNode]] = []
+        stack: List[Tuple[Tuple[str, ...], _TrieNode]] = [
+            ((), self._root)
+        ]
+        while stack:
+            path, node = stack.pop()
+            if not node.children and node is not self._root:
+                out.append((path, node))
+                continue
+            for name, child in node.children.items():
+                stack.append((path + (name,), child))
+        return out
+
+    def _evict(self, protect: "_TrieNode") -> None:
+        while self._bytes > self.byte_budget:
+            victim: Optional[_TrieNode] = None
+            victim_key: Optional[Tuple[int, Tuple[str, ...]]] = None
+            for path, leaf in self._leaves():
+                if leaf is protect:
+                    continue
+                key = (leaf.count, path)
+                if victim_key is None or key < victim_key:
+                    victim, victim_key = leaf, key
+            if victim is None or victim.parent is None:
+                break   # only the just-inserted path remains
+            parent = victim.parent
+            parent.count += victim.count   # fold: totals preserved
+            del parent.children[victim.name]
+            self._bytes -= len(victim.name) + _NODE_OVERHEAD
+            self._nodes -= 1
+            self._evicted += 1
+
+    # -- reads ------------------------------------------------------------------
+
+    def folded(self) -> str:
+        """The trie in folded-stack format — one ``frame;frame;... N``
+        line per node with samples, root-first frames, sorted for a
+        deterministic body (flamegraph.pl and speedscope both accept
+        any line order)."""
+        lines: List[str] = []
+        stack: List[Tuple[Tuple[str, ...], _TrieNode]] = [
+            ((), self._root)
+        ]
+        while stack:
+            path, node = stack.pop()
+            if node.count and path:
+                lines.append(f"{';'.join(path)} {node.count}")
+            for name, child in node.children.items():
+                stack.append((path + (name,), child))
+        lines.sort()
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def total_bytes(self) -> int:
+        return self._bytes
+
+    def nodes(self) -> int:
+        return self._nodes
+
+    def samples(self) -> int:
+        return self._samples
+
+    def evicted(self) -> int:
+        return self._evicted
+
+
+def _frame_name(code) -> str:
+    """``module.function`` from a code object — the folded format
+    reserves ``;`` (separator) and space (count delimiter), so both
+    are scrubbed from whatever the filename carries."""
+    mod = os.path.splitext(os.path.basename(code.co_filename))[0]
+    name = f"{mod}.{code.co_name}"
+    return name.replace(";", ":").replace(" ", "_")
+
+
+def _fold_stack(top_frame) -> List[str]:
+    """Root-first frame names for one thread's stack, deepest
+    MAX_STACK_DEPTH frames kept (the hot end is the informative end)."""
+    names: List[str] = []
+    frame = top_frame
+    while frame is not None and len(names) < MAX_STACK_DEPTH:
+        names.append(_frame_name(frame.f_code))
+        frame = frame.f_back
+    names.reverse()
+    return names
+
+
+# -- the sampler --------------------------------------------------------------
+
+
+class SamplingProfiler:
+    """Continuous whole-process stack sampler.
+
+    A daemon thread wakes ``hz`` times a second, snapshots every
+    thread's stack via ``sys._current_frames()`` (one C-level dict
+    copy — no tracing hooks, no interpreter slowdown between samples)
+    and folds each stack into the bounded trie, rooted at the
+    thread's active trace span (``phase:<span-name>``) so the
+    flamegraph separates ``contributions`` CPU from ``plan`` CPU from
+    unattributed background work.
+
+    Exports per sweep: ``tpunet_profile_samples_total{phase}``,
+    ``tpunet_profile_stack_bytes``, ``tpunet_profile_evictions_total``.
+
+    ``sample_once(frames=..., spans=...)`` is the deterministic seam
+    tests and the bench drive directly — the daemon thread is just a
+    loop around it.
+    """
+
+    def __init__(
+        self,
+        hz: float = DEFAULT_HZ,
+        byte_budget: int = DEFAULT_PROFILE_BYTE_BUDGET,
+        metrics=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.hz = float(hz)
+        self._metrics = metrics
+        self._clock = clock
+        self._trie = StackTrie(byte_budget)
+        # sampler-internal state lock.  Deliberately NOT a TracedLock:
+        # it is taken 29x/s by the sampler itself and tracing the
+        # observer would put the observer's own noise at the top of
+        # every contention dashboard.
+        self._lock = threading.Lock()   # tpunet: allow=T003 sampler-internal; tracing the profiler's own lock would make the observer the top contention signal
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._exported_evictions = 0
+
+    # -- lifecycle --------------------------------------------------------------
+
+    def start(self) -> "SamplingProfiler":
+        if self._thread is not None or self.hz <= 0:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="tpunet-profiler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        thread = self._thread
+        if thread is None:
+            return
+        self._stop.set()
+        thread.join(timeout=5.0)
+        self._thread = None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _loop(self) -> None:
+        interval = 1.0 / self.hz
+        while not self._stop.wait(interval):
+            try:
+                self.sample_once()
+            except Exception:   # noqa: BLE001 — the profiler must never
+                pass            # take the control plane down with it
+
+    # -- sampling ---------------------------------------------------------------
+
+    def sample_once(
+        self,
+        frames: Optional[Dict[int, Any]] = None,
+        spans: Optional[Dict[int, Any]] = None,
+        trie: Optional[StackTrie] = None,
+    ) -> int:
+        """Take one sweep over every thread; returns stacks folded.
+
+        ``frames`` / ``spans`` inject deterministic inputs (tests, the
+        bench); by default the live interpreter and the trace
+        registry are consulted.  ``trie`` redirects the sweep into a
+        capture buffer (``?seconds=`` on-demand windows)."""
+        if frames is None:
+            frames = sys._current_frames()
+        skip = {threading.get_ident()}
+        if self._thread is not None and self._thread.ident is not None:
+            skip.add(self._thread.ident)
+        folded = 0
+        for tid, top in frames.items():
+            if tid in skip:
+                continue
+            stack = _fold_stack(top) if top is not None else []
+            if not stack:
+                continue
+            if spans is not None:
+                span = spans.get(tid)
+            else:
+                span = trace.active_span_for_thread(tid)
+            phase = getattr(span, "name", "") or _UNATTRIBUTED
+            record = [
+                f"phase:{phase}".replace(";", ":").replace(" ", "_")
+            ] + stack
+            with self._lock:
+                (trie if trie is not None else self._trie).add(record)
+            folded += 1
+            if self._metrics is not None and trie is None:
+                self._metrics.inc(
+                    "tpunet_profile_samples_total", {"phase": phase}
+                )
+        if self._metrics is not None and trie is None:
+            with self._lock:
+                total_bytes = self._trie.total_bytes()
+                evictions = self._trie.evicted()
+                delta = evictions - self._exported_evictions
+                self._exported_evictions = evictions
+            self._metrics.set_gauge(
+                "tpunet_profile_stack_bytes", float(total_bytes)
+            )
+            if delta:
+                self._metrics.inc(
+                    "tpunet_profile_evictions_total", by=delta
+                )
+        return folded
+
+    def capture(self, seconds: float, hz: float = 0.0) -> str:
+        """Blocking on-demand capture into a fresh trie (the
+        continuous buffer keeps accumulating independently); returns
+        the window's folded-stack text.  The window is clamped to
+        ``MAX_CAPTURE_SECONDS``."""
+        seconds = min(max(float(seconds), 0.0), MAX_CAPTURE_SECONDS)
+        rate = hz or self.hz or DEFAULT_HZ
+        interval = 1.0 / max(rate, 0.1)
+        window = StackTrie(self._trie.byte_budget)
+        deadline = self._clock() + seconds
+        while True:
+            self.sample_once(trie=window)
+            if self._clock() >= deadline:
+                break
+            time.sleep(interval)
+        return window.folded()
+
+    # -- reads ------------------------------------------------------------------
+
+    def folded(self) -> str:
+        with self._lock:
+            return self._trie.folded()
+
+    def stats(self) -> Dict[str, Any]:
+        """Counters for ``/debug/index`` and ``tools/prof.py``."""
+        with self._lock:
+            return {
+                "hz": self.hz,
+                "running": self.running,
+                "samples": self._trie.samples(),
+                "frames": self._trie.nodes(),
+                "bytes": self._trie.total_bytes(),
+                "byteBudget": self._trie.byte_budget,
+                "evictions": self._trie.evicted(),
+            }
+
+    def __len__(self) -> int:
+        with self._lock:
+            return self._trie.nodes()
+
+
+# -- rebuild parallel efficiency ----------------------------------------------
+
+
+def parallel_efficiency(
+    cpu_seconds: List[float], wall_seconds: float
+) -> float:
+    """Effective concurrent cores for a fan-out: summed per-worker
+    ``time.thread_time()`` CPU over wall time.  1.0 means the GIL (or
+    a serial path) kept one core busy; the rebuild's regression anchor
+    the columnar-derivation PR must beat."""
+    if wall_seconds <= 0:
+        return 0.0
+    return max(0.0, sum(cpu_seconds)) / wall_seconds

@@ -98,6 +98,7 @@ class SloEngine:
         self.metrics = metrics
         self.objective = min(max(float(objective), 0.0), 0.9999)
         self._clock = clock
+        # tpunet: allow=T003 folds only on journal appends — zero acquisitions on a steady pass, so there is no contention to measure
         self._lock = threading.Lock()
         # policy -> [fast-path passes, total passes]
         self._passes: Dict[str, List[int]] = {}

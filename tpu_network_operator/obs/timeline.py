@@ -32,10 +32,11 @@ burn-rate SLOs by subscribing as a listener.
 from __future__ import annotations
 
 import json
-import threading
 import time
 from collections import Counter, deque
 from typing import Any, Callable, Dict, List, Optional
+
+from .profile import TracedLock
 
 # record kinds — the transition families the reconciler journals
 KIND_READINESS = "readiness"        # per-node provisioning-report ok flips
@@ -83,7 +84,7 @@ class Timeline:
         clock: Callable[[], float] = time.time,
         metrics=None,
     ):
-        self._lock = threading.Lock()
+        self._lock = TracedLock("timeline", metrics=metrics)
         self._budget = max(MIN_POLICY_BYTE_BUDGET, int(policy_byte_budget))
         self._clock = clock
         self._metrics = metrics

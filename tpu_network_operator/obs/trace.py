@@ -53,6 +53,26 @@ _CURRENT: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
     "tpunet_current_span", default=None
 )
 
+# thread-id -> innermost active span.  The sampling profiler
+# (:mod:`.profile`) attributes stack samples to reconcile phases, but
+# ``sys._current_frames()`` keys by thread id and a ContextVar cannot
+# be read from outside its own thread — so span entry/exit ALSO
+# maintains this registry.  Plain dict get/set/del are GIL-atomic, so
+# the sampler thread reads it without a lock (a torn read would at
+# worst misattribute one 34ms sample).
+_ACTIVE_BY_THREAD: Dict[int, "Span"] = {}
+
+
+def active_span_for_thread(thread_id: int) -> Optional["Span"]:
+    """The span currently entered on ``thread_id``, or None — the
+    cross-thread read :func:`current_span` cannot provide."""
+    return _ACTIVE_BY_THREAD.get(thread_id)
+
+
+def active_spans() -> Dict[int, "Span"]:
+    """Snapshot of every thread's innermost active span."""
+    return dict(_ACTIVE_BY_THREAD)
+
 
 def new_trace_id() -> str:
     return secrets.token_hex(_TRACE_ID_BYTES)
@@ -82,6 +102,7 @@ class Span:
     __slots__ = (
         "name", "trace_id", "span_id", "parent_id", "attributes",
         "status", "start_ts", "duration_ms", "_t0", "_tracer", "_token",
+        "_prev_active", "_owner_thread",
     )
 
     def __init__(
@@ -103,6 +124,8 @@ class Span:
         self._t0 = time.perf_counter()
         self._tracer = tracer
         self._token: Optional[contextvars.Token] = None
+        self._prev_active: Optional["Span"] = None
+        self._owner_thread: Optional[int] = None
 
     def set_attribute(self, key: str, value: Any) -> "Span":
         self.attributes[key] = value
@@ -123,6 +146,10 @@ class Span:
 
     def __enter__(self) -> "Span":
         self._token = _CURRENT.set(self)
+        tid = threading.get_ident()
+        self._owner_thread = tid
+        self._prev_active = _ACTIVE_BY_THREAD.get(tid)
+        _ACTIVE_BY_THREAD[tid] = self
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
@@ -132,6 +159,13 @@ class Span:
         if self._token is not None:
             _CURRENT.reset(self._token)
             self._token = None
+        if self._owner_thread is not None:
+            if self._prev_active is not None:
+                _ACTIVE_BY_THREAD[self._owner_thread] = self._prev_active
+            else:
+                _ACTIVE_BY_THREAD.pop(self._owner_thread, None)
+            self._prev_active = None
+            self._owner_thread = None
         self.end()
 
     # -- wire form -------------------------------------------------------------
@@ -160,6 +194,7 @@ class Tracer:
     holds the last ~N operations' worth of evidence, never more."""
 
     def __init__(self, capacity: int = 1024):
+        # tpunet: allow=T003 obs.profile imports this module — tracing the tracer's own ring lock would be a circular dependency
         self._lock = threading.Lock()
         self._spans: deque = deque(maxlen=max(1, int(capacity)))
         # span IDs already recorded/ingested, insertion-ordered for
