@@ -83,6 +83,11 @@ profile-bench: ## Profiling-plane proof: marked tests + the overhead/attribution
 	$(PYTHON) -m pytest tests/ -x -q -m "profile and not slow"
 	$(PYTHON) tools/profile_bench.py --out BENCH_profile.json
 
+.PHONY: scenarios
+scenarios: ## Fleet-scenario suite: marked tests + the six declarative scenarios and three ported benches, SLO-judged, replay-checked
+	$(PYTHON) -m pytest tests/ -x -q -m "scenario and not slow"
+	$(PYTHON) tools/simlab/run.py --replay-check --out BENCH_scenarios.json
+
 .PHONY: test-cluster
 test-cluster: ## kind-cluster e2e + live fuzz (needs kind/docker/kubectl; skips cleanly without — ref test/e2e + test/fuzz)
 	$(PYTHON) -m pytest tests/cluster -x -q

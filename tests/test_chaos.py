@@ -951,3 +951,105 @@ class TestChaosSoak:
             assert converged_rounds == 30   # no round ever wedged
         finally:
             mgr.stop()
+
+class TestScheduledFaults:
+    """The absolute-time fault schedule (schedule_rule / schedule_outage
+    / schedule_watch_drop + pump): the declarative scenario harness
+    (tpu_network_operator/testing, tools/simlab) drives whole fault
+    histories through it, so the contract is pinned here — sim-clock
+    activation, deterministic firing order, exact `injected` accounting
+    untouched by the scheduling machinery itself."""
+
+    def _world(self, start=1000.0):
+        now = [start]
+        inj = chaos.FaultInjector(
+            FakeCluster(), seed=5, sleep=lambda s: None,
+            clock=lambda: now[0],
+        )
+        return now, inj
+
+    def test_rule_activates_and_retires_on_sim_clock(self):
+        now, inj = self._world()
+        inj.schedule_rule(1060.0, chaos.FAULT_503, verb="get",
+                          rate=1.0, duration=120.0)
+        inj.inner.add_node("n0", {})
+        # before `at`: the rule is not live
+        inj.pump()
+        inj.get("v1", "Node", "n0")
+        assert inj.injected == {}
+        # inside [at, at+duration): every matching request faults
+        now[0] = 1060.0
+        inj.pump()
+        with pytest.raises(kerr.ServiceUnavailableError):
+            inj.get("v1", "Node", "n0")
+        assert inj.injected[(chaos.FAULT_503, "get", "Node")] == 1
+        # past the end: retired, clean again
+        now[0] = 1180.0
+        inj.pump()
+        inj.get("v1", "Node", "n0")
+        assert inj.injected[(chaos.FAULT_503, "get", "Node")] == 1
+
+    def test_scheduling_never_counts_as_injected(self):
+        """Arming/firing schedule entries must not touch the `injected`
+        ledger — only request-path firings count, or the benches'
+        exact-accounting gates (retries + gave_up == injected) break."""
+        now, inj = self._world()
+        inj.schedule_rule(1000.0, chaos.FAULT_429, rate=1.0,
+                          duration=50.0)
+        inj.schedule_outage(1100.0, 30.0)
+        inj.schedule_watch_drop(1200.0)
+        assert inj.pending_scheduled() == 5   # rule+end, begin+end, drop
+        now[0] = 1100.0
+        inj.pump()
+        # rule armed+retired and outage began without any request: the
+        # only ledger entries may come from drop_watches (none live)
+        assert all(k[0] != chaos.FAULT_429 for k in inj.injected)
+
+    def test_pump_fires_in_at_then_insertion_order(self):
+        now, inj = self._world()
+        r_late = inj.schedule_rule(1200.0, chaos.FAULT_503)
+        inj.schedule_outage(1100.0, 500.0)
+        r_early = inj.schedule_rule(1100.0, chaos.FAULT_429)
+        now[0] = 1300.0
+        fired = inj.pump()
+        assert [e.at for e in fired] == sorted(e.at for e in fired)
+        ats = [(e.at, e.seq) for e in fired]
+        assert ats == sorted(ats)
+        # everything due fired exactly once; nothing is left behind
+        assert inj.pending_scheduled() == 1   # the outage end at 1600
+        assert r_early in inj._rules and r_late in inj._rules
+        assert inj.in_outage
+
+    def test_outage_window_end_to_end(self):
+        now, inj = self._world()
+        inj.inner.add_node("n0", {})
+        inj.schedule_outage(1050.0, 100.0)
+        now[0] = 1050.0
+        inj.pump()
+        with pytest.raises(kerr.TransportError, match="outage"):
+            inj.list("v1", "Node")
+        n_during = inj.injected[("outage", "list", "Node")]
+        assert n_during == 1
+        now[0] = 1150.0
+        inj.pump()
+        assert len(inj.list("v1", "Node")) == 1
+        assert inj.injected[("outage", "list", "Node")] == n_during
+
+    def test_watch_drop_kills_live_streams(self):
+        now, inj = self._world()
+        w = inj.watch("v1", "Node")
+        inj.schedule_watch_drop(1100.0, expired=True)
+        now[0] = 1100.0
+        inj.pump()
+        with pytest.raises(kerr.ExpiredError):
+            w.next(timeout=0)
+        assert inj.injected[("watch-drop", "watch", "*")] == 1
+
+    def test_duplicate_pump_is_idempotent(self):
+        now, inj = self._world()
+        inj.schedule_rule(1100.0, chaos.FAULT_503, duration=50.0)
+        now[0] = 1100.0
+        first = inj.pump()
+        assert len(first) == 1
+        assert inj.pump() == []
+        assert len(inj._rules) == 1

@@ -500,3 +500,50 @@ class TestDeltaHooks:
         assert relists == [1, 1]
         assert ("delete", NS, "b", False, True) in events
         inf.stop()
+
+
+class TestInjectedBackoffClock:
+    """Regression: the reopen backoff ran on the WALL clock
+    unconditionally.  Under an injected sim clock (the scenario
+    harness), a reopen that failed during an apiserver outage pinned
+    ``_reopen_not_before`` a wall-second ahead — an arbitrary stretch
+    of SIM time during which sync() silently served the stale store as
+    fresh (the long-soak scenario missed an entire degradation wave).
+    The informer and CachedClient now take an injectable clock."""
+
+    def test_sim_clock_drives_reopen_backoff(self):
+        from tpu_network_operator.kube.chaos import FaultInjector
+
+        now = [1000.0]
+        fake = FakeCluster()
+        inj = FaultInjector(fake, seed=1, clock=lambda: now[0])
+        inf = Informer(
+            inj, "v1", "ConfigMap", namespace=NS, clock=lambda: now[0]
+        ).start()
+        fake.create(mk("ConfigMap", "a", NS))
+        inf.sync()
+
+        inj.begin_outage()           # drops the stream AND fails reopen
+        inf.sync()
+        assert inf.restarts == 0
+        inj.end_outage()
+        fake.create(mk("ConfigMap", "b", NS))
+        # wall time has NOT advanced — but the sim clock moving past
+        # the backoff must unblock the reopen, with no test seam
+        now[0] += Informer.REOPEN_BACKOFF + 1.0
+        inf.sync()
+        assert inf.restarts == 1
+        assert inf.store.get("b", NS) is not None
+
+    def test_cached_client_threads_clock_to_informers(self):
+        now = [50.0]
+        fake = FakeCluster()
+        cached = CachedClient(fake, clock=lambda: now[0])
+        inf = cached.cache("v1", "ConfigMap", namespace=NS)
+        assert inf._clock() == 50.0
+
+    def test_default_is_wall_monotonic(self):
+        import time
+
+        inf = Informer(FakeCluster(), "v1", "ConfigMap", namespace=NS)
+        assert abs(inf._clock() - time.monotonic()) < 5.0

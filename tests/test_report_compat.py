@@ -1,0 +1,119 @@
+"""Old-agent report compatibility, table-driven per PR epoch.
+
+``tpu_network_operator.testing.epochs`` is the single source of the
+report payload shape each agent era actually serialized; these tests
+pin ``ProvisioningReport.from_json`` against every one of them — the
+rolling-upgrade contract is that the CONTROLLER of today parses the
+agent of any epoch (and degrades, never crashes, on mangled payloads).
+The live end-to-end version of the same contract runs in
+``tools/simlab`` scenario (b) upgrade_skew.
+"""
+
+import json
+
+import pytest
+
+from tpu_network_operator.agent import report as rpt
+from tpu_network_operator.testing import epochs
+
+
+class TestEpochPayloads:
+    @pytest.mark.parametrize("epoch", epochs.EPOCHS)
+    def test_healthy_payload_parses(self, epoch):
+        payload = epochs.report_payload(epoch, "n0", "p0", nics=4)
+        # the fixture emits EXACTLY that era's fields — nothing newer
+        assert set(payload) == set(epochs.epoch_fields(epoch))
+        rep = rpt.ProvisioningReport.from_json(json.dumps(payload))
+        assert rep.node == "n0" and rep.policy == "p0"
+        assert rep.ok is True
+        assert rep.interfaces_configured == 4
+        # fields the epoch predates come back as dataclass defaults
+        assert rep.agent_version == epochs.epoch_version(epoch)
+        if "remediation" not in payload:
+            assert rep.remediation is None
+        if "telemetry" not in payload:
+            assert rep.telemetry is None
+
+    @pytest.mark.parametrize("epoch", epochs.EPOCHS)
+    def test_degraded_payload_parses(self, epoch):
+        payload = epochs.report_payload(
+            epoch, "n1", "p0", ok=False, error="link ens9 down"
+        )
+        rep = rpt.ProvisioningReport.from_json(json.dumps(payload))
+        assert rep.ok is False
+        assert rep.error == "link ens9 down"
+        assert rep.interfaces_configured == 0
+
+    def test_epoch_versions_ordered(self):
+        """The skew guard keys on version STRINGS: pre-version eras
+        stamp "", versioned eras stamp their own."""
+        assert epochs.epoch_version("pre-telemetry") == ""
+        assert epochs.epoch_version("pre-plan") == "0.4.0"
+        assert epochs.epoch_version("current") == (
+            rpt.agent_version_string()
+        )
+
+    def test_newer_agent_unknown_fields_tolerated(self):
+        """The other direction of skew: an agent NEWER than this
+        controller sends fields we do not know — they must be ignored,
+        not rejected (rejecting flips every upgraded node not-ready)."""
+        payload = epochs.report_payload("current", "n2", "p0")
+        payload["future_field"] = {"x": 1}
+        payload["another"] = [1, 2, 3]
+        rep = rpt.ProvisioningReport.from_json(json.dumps(payload))
+        assert rep.node == "n2"
+        assert not hasattr(rep, "future_field")
+
+
+class TestMalformedPayloads:
+    """Every malformed shape must surface as ValueError — the callers'
+    degrade path — never a foreign exception type from the dataclass
+    or the field validation."""
+
+    def test_missing_node_raises_valueerror(self):
+        # `node` has no dataclass default: without the constructor
+        # guard this raised TypeError straight from __init__
+        payload = epochs.report_payload("current", "n3", "p0")
+        del payload["node"]
+        with pytest.raises(ValueError, match="constructor"):
+            rpt.ProvisioningReport.from_json(json.dumps(payload))
+
+    @pytest.mark.parametrize("field_name,bad", [
+        ("node", 7),
+        ("policy", ["p0"]),
+        ("error", {"msg": "x"}),
+        ("interfaces_total", "four"),
+        ("dcn_interfaces", "ens9"),
+        ("probe", [1]),
+        ("telemetry", "yes"),
+        ("spans", [{"a": 1}, "not-a-dict"]),
+    ])
+    def test_wrong_types_raise_valueerror(self, field_name, bad):
+        payload = epochs.report_payload("current", "n4", "p0")
+        payload[field_name] = bad
+        with pytest.raises(ValueError):
+            rpt.ProvisioningReport.from_json(json.dumps(payload))
+
+    def test_non_object_raises_valueerror(self):
+        with pytest.raises(ValueError):
+            rpt.ProvisioningReport.from_json("[1, 2]")
+
+    def test_truthy_coercion(self):
+        """ok/bootstrap_written from foreign serializers may arrive as
+        1/"true"/etc — anything but literal true reads as False."""
+        payload = epochs.report_payload("pre-probe", "n5", "p0")
+        payload["ok"] = 1
+        payload["bootstrap_written"] = "true"
+        rep = rpt.ProvisioningReport.from_json(json.dumps(payload))
+        assert rep.ok is False
+        assert rep.bootstrap_written is False
+
+
+class TestRoundTrip:
+    def test_current_payload_matches_to_json(self):
+        """The `current` epoch fixture IS this tree's serialization:
+        report_payload(current) and ProvisioningReport.to_json must
+        agree on the key set, or the fixtures have drifted."""
+        payload = epochs.report_payload("current", "n6", "p0")
+        rep = rpt.ProvisioningReport.from_json(json.dumps(payload))
+        assert set(json.loads(rep.to_json())) == set(payload)

@@ -1,0 +1,190 @@
+"""Scenario-harness tier: the declarative fleet simulator as tests.
+
+Two layers:
+
+* a smoke of the ``tpu_network_operator.testing`` world itself —
+  declarative spec in, converged SLO-judged verdict out, byte-identical
+  replay (the contract every scenario in ``tools/simlab`` builds on);
+* distilled tier-1 regressions for bugs the scenario suite found, run
+  small enough for the fast tier.  The full six-scenario suite runs
+  under ``make scenarios`` / ``tools/simlab/run.py``.
+"""
+
+import json
+import math
+
+import pytest
+
+from tpu_network_operator.kube import chaos
+from tpu_network_operator.testing import (
+    FaultEvent,
+    NodeGroup,
+    PolicySpec,
+    ScenarioSpec,
+    SloBudget,
+    World,
+    FAULT_DEGRADE,
+    FAULT_HEAL,
+    FAULT_OUTAGE,
+    verdict,
+)
+
+pytestmark = pytest.mark.scenario
+
+START = 1_000_000.0
+
+
+def _pool(name):
+    return PolicySpec(name=name, selector={"tpunet.dev/pool": name})
+
+
+class TestHarnessSmoke:
+    def _spec(self, ticks=8):
+        t = START
+        return ScenarioSpec(
+            name="smoke", seed=7, start=t, tick_seconds=15.0,
+            ticks=ticks, replicas=2, shards=4,
+            groups=[NodeGroup(name="g0", count=8, policy="p0")],
+            policies=[_pool("p0")],
+            faults=[
+                FaultEvent(at=t + 30, kind=FAULT_DEGRADE, group="g0",
+                           nodes=2),
+                FaultEvent(at=t + 60, kind=FAULT_HEAL, group="g0"),
+            ],
+            budgets=[SloBudget(policy="p0", fast_max=80.0,
+                               require_burn=True)],
+            steady_window=3,
+        )
+
+    def test_spec_to_verdict(self):
+        """Spec in, world out: fleet materialized, faults fire on the
+        sim clock, SLO judge passes the recovered run, steady state is
+        write-free, two-leaders-never holds across every shard round."""
+        with World(self._spec()) as w:
+            w.run()
+            v = verdict(w)
+        assert v["passed"], v
+        assert v["statuses"]["p0"]["ready"] == 8
+        assert v["invariants"]["zero_steady_writes"] is True
+        assert v["budgets"][0]["burn_seen_ok"] is True
+
+    def test_replay_byte_identical(self):
+        """Same (spec, seed) twice -> byte-identical verdict JSON.
+        This is the property every simlab scenario inherits."""
+        outs = []
+        for _ in range(2):
+            with World(self._spec()) as w:
+                w.run()
+                outs.append(json.dumps(verdict(w), sort_keys=True))
+        assert outs[0] == outs[1]
+
+
+class TestShardFailoverMidFault:
+    """Distilled from simlab scenario (a) shard_storm: PR 11's bench
+    only failed over a QUIET fleet; the scenario drives the handoff
+    while >= 10% of the departing replica's nodes are mid-fault AND an
+    API fault storm is live.  The survivor must take over every shard
+    and reconverge."""
+
+    def test_takeover_with_degraded_tenth_under_storm(self):
+        spec = ScenarioSpec(
+            name="failover-mid-fault", seed=11, start=START,
+            tick_seconds=15.0, ticks=12, replicas=2, shards=4,
+            lease_duration=30.0,
+            groups=[NodeGroup(name=f"g{i}", count=10, policy=f"p{i}")
+                    for i in range(2)],
+            policies=[_pool(f"p{i}") for i in range(2)],
+        )
+        with World(spec) as w:
+            for verb in ("get", "list", "update"):
+                w.inj.schedule_rule(
+                    START + 30, chaos.FAULT_503, verb=verb, rate=0.05,
+                    duration=90.0,
+                )
+            w.start()
+            w.tick()
+            w.tick()
+            dying, survivor = w.replicas[0], w.replicas[1]
+            mid_fault = 0
+            for pname in dying.owned_policies(w.policy_names):
+                g = f"g{pname[1:]}"
+                want = max(1, math.ceil(0.10 * len(w.members[g])))
+                mid_fault += len(w.degrade(g, want))
+            departing = sum(
+                len(w.members[f"g{p[1:]}"])
+                for p in dying.owned_policies(w.policy_names)
+            )
+            assert departing > 0 and mid_fault / departing >= 0.10
+            w.tick()
+            dying.stop()
+            w.replicas.remove(dying)
+            w.now[0] += spec.lease_duration
+            for _ in range(4):
+                w.tick()
+            # every shard moved, never co-owned
+            assert set(range(spec.shards)) <= survivor.coord.owned
+            assert w.overlap_violations == 0
+            for g in list(w.members):
+                w.heal_group(g)
+            for _ in range(3):
+                w.tick()
+            from tpu_network_operator.api.v1alpha1.types import (
+                API_VERSION,
+            )
+
+            for p in w.policy_names:
+                st = (
+                    w.fake.get(API_VERSION, "NetworkClusterPolicy", p)
+                    .get("status", {}) or {}
+                )
+                assert st.get("state") == "All good", (p, st)
+                assert int(st.get("ready", 0)) == 10
+
+
+class TestOutageStaleCacheRegression:
+    """The bug the long_soak scenario found: the informer's watch-
+    reopen backoff ran on the WALL clock unconditionally.  Under an
+    injected sim clock a reopen that failed during an apiserver outage
+    pinned ``_reopen_not_before`` a wall-second ahead — an arbitrary
+    stretch of sim time during which sync() silently served the stale
+    store as fresh and the control plane missed whole degradation
+    waves.  The informer clock is now injectable; this drives the
+    exact shape: outage, degrade after it lifts, and the next
+    reconcile pass MUST see the degradation."""
+
+    def test_cache_recovers_on_sim_clock_after_outage(self):
+        t = START
+        spec = ScenarioSpec(
+            name="outage-stale-cache", seed=3, start=t,
+            tick_seconds=60.0, ticks=10, replicas=1, shards=1,
+            groups=[NodeGroup(name="g0", count=6, policy="p0")],
+            policies=[_pool("p0")],
+            faults=[
+                FaultEvent(at=t + 60, kind=FAULT_OUTAGE, duration=90.0),
+                # the wave lands AFTER the outage lifts: a wall-clock
+                # reopen backoff would still be pinning the cache stale
+                FaultEvent(at=t + 240, kind=FAULT_DEGRADE, group="g0",
+                           nodes=2),
+            ],
+        )
+        from tpu_network_operator.api.v1alpha1.types import API_VERSION
+
+        with World(spec) as w:
+            w.arm_schedule()
+            w.start()
+            seen_degraded = None
+            for tick in range(spec.ticks):
+                w.tick()
+                st = (
+                    w.fake.get(API_VERSION, "NetworkClusterPolicy",
+                               "p0").get("status", {}) or {}
+                )
+                if w.now[0] >= t + 240 and seen_degraded is None:
+                    seen_degraded = int(st.get("ready", 0))
+            # the FIRST pass after the degrade event already sees it —
+            # no wall-clock staleness window
+            assert seen_degraded == 4
+            # and the SLO engine recorded the dip (the judge's samples
+            # were the original failure's missing evidence)
+            samples = list(w.slo._samples.get("p0", []))
+            assert any(ratio < 1.0 for _ts, ratio in samples), samples

@@ -695,8 +695,14 @@ def load_artifacts(repo_root: str) -> List[ArtifactSet]:
 
 # usage scan scope: the operator package minus the client plumbing
 # itself (kube/ implements the interface; its internal calls are not
-# privilege usage) and minus the pure-compute packages
-_USAGE_SKIP = ("tpu_network_operator/kube/",)
+# privilege usage), minus the scenario-harness support package
+# (testing/ drives the fakes from test processes and is never deployed,
+# so its client calls are not privilege usage either) and minus the
+# pure-compute packages
+_USAGE_SKIP = (
+    "tpu_network_operator/kube/",
+    "tpu_network_operator/testing/",
+)
 
 
 def check_rbac(

@@ -249,6 +249,18 @@ def main() -> int:
         maybe_run_phase(out, "exec-bench",
                   [py, "tools/exec_bench.py",
                    "--out", "BENCH_exec.json"], timeout=3600)
+        # 18. the composable fleet simulator: six declarative
+        # scenarios (shard churn under a fault storm, rolling-upgrade
+        # version skew, autoscale mid-flight, multi-policy overlap,
+        # heterogeneous fleets, the multi-wave long soak) plus the
+        # chaos/scale/remediation benches ported onto the same
+        # harness — every run judged by the SLO engine's burn budgets
+        # and the standing invariants (two-leaders-never, zero steady
+        # writes), with the in-driver replay gate asserting a second
+        # seeded run is byte-identical (no TPU, in-process sim clock)
+        maybe_run_phase(out, "scenarios",
+                  [py, "tools/simlab/run.py", "--replay-check",
+                   "--out", "BENCH_scenarios.json"], timeout=1800)
     print(f"done -> {args.out}")
     return 0
 

@@ -1,0 +1,7 @@
+"""simlab: the declarative fleet-scenario suite.
+
+``scenarios.py`` holds the six uncovered failure scenarios,
+``ports.py`` the three benches ported onto the shared harness, and
+``run.py`` the BENCH_scenarios.json driver.  The world-building layer
+they all share lives in ``tpu_network_operator/testing/``.
+"""

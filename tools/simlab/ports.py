@@ -1,0 +1,257 @@
+"""Three pre-existing benches, ported onto the scenario harness.
+
+chaos (sustained fault soup), scale (shard failover with churned
+handoff), and remediation (flapping-link convergence) each used to
+rebuild the same world by hand; here they run on
+``tpu_network_operator.testing.World`` so their environments can never
+drift apart again.  Every in-bench gate the originals enforced is
+preserved verbatim as a verdict gate:
+
+* chaos-sustained:   converged under sustained faults, AND
+  ``retries + gave_up == retryable injected`` (exact accounting).
+* scale-failover:    cold restart parses EXACTLY the churned leases,
+  peer takeover parses EXACTLY the churned leases, zero node-label
+  writes, zero duplicate Events, two-leaders-never.
+* remediation-flap:  healed run converges in <= 2 label transitions,
+  never more than the detection-only run, with >= 1 bounce.
+"""
+
+from __future__ import annotations
+
+from tpu_network_operator.kube import chaos
+from tpu_network_operator.testing import (
+    NodeGroup,
+    PolicySpec,
+    ScenarioSpec,
+    SloBudget,
+    World,
+    verdict,
+)
+
+START = 1_000_000.0
+
+
+# -- chaos_bench scenario 1: sustained fault soup -----------------------------
+
+def port_chaos_sustained(seed: int = 1234, n_nodes: int = 24,
+                         rate: float = 0.10) -> dict:
+    """10% mixed retryable faults + ambient latency on every data verb
+    for the whole run; the reconcile loop must converge anyway and the
+    injected-fault ledger must balance against the retry metrics."""
+    spec = ScenarioSpec(
+        name="port-chaos-sustained", seed=seed, start=START,
+        tick_seconds=15.0, ticks=16, replicas=1, shards=1,
+        groups=[NodeGroup(name="g0", count=n_nodes, policy="p0")],
+        policies=[PolicySpec(
+            name="p0", selector={"tpunet.dev/pool": "p0"},
+        )],
+        budgets=[SloBudget(policy="p0", fast_max=40.0)],
+        steady_window=0,   # faults never lift: steady is not write-free
+    )
+    with World(spec) as w:
+        horizon = spec.ticks * spec.tick_seconds
+        for verb in ("get", "list", "create", "update", "patch",
+                     "delete"):
+            for fault in (chaos.FAULT_429, chaos.FAULT_503,
+                          chaos.FAULT_TIMEOUT, chaos.FAULT_CONFLICT):
+                w.inj.schedule_rule(
+                    START, fault, verb=verb, rate=rate / 4.0,
+                    retry_after=0.001 if fault == chaos.FAULT_429
+                    else None,
+                    duration=horizon,
+                )
+            w.inj.schedule_rule(START, chaos.FAULT_LATENCY, verb=verb,
+                                rate=0.5, latency=0.0002,
+                                duration=horizon)
+        w.start()
+        for _ in range(spec.ticks):
+            w.tick()
+
+        from tpu_network_operator.api.v1alpha1.types import API_VERSION
+
+        state = (
+            w.fake.get(API_VERSION, "NetworkClusterPolicy", "p0")
+            .get("status", {}) or {}
+        ).get("state")
+        retries = w.counter("tpunet_client_retries_total")
+        gave_up = w.counter("tpunet_client_gave_up_total")
+        retryable_injected = sum(
+            n for (fault, _v, _k), n in w.inj.injected.items()
+            if fault in (chaos.FAULT_429, chaos.FAULT_503,
+                         chaos.FAULT_TIMEOUT, chaos.FAULT_CONFLICT)
+        )
+        return verdict(w, extra_gates={
+            "converged": state == "All good",
+            "faults_injected": retryable_injected > 0,
+            # the original bench's exact-accounting gate: every
+            # injected retryable fault is either retried or given up
+            "faults_accounted":
+                retries + gave_up == retryable_injected,
+        })
+
+
+# -- scale_bench failover: churned handoff on the harness ---------------------
+
+def port_scale_failover(seed: int = 1234, nodes_per_policy: int = 16,
+                        n_policies: int = 4, churn: int = 12) -> dict:
+    """The O(churn) handoff contract: a replica crash-restarts (same
+    identity) after ``churn`` of its leases moved under it — the cold
+    pass JSON-parses exactly those; then the replica dies for good and
+    the peer's takeover re-derives exactly the same churned set, with
+    zero node-label writes and zero duplicate Events."""
+    spec = ScenarioSpec(
+        name="port-scale-failover", seed=seed, start=START,
+        tick_seconds=15.0, ticks=8, replicas=2, shards=4,
+        lease_duration=30.0,
+        groups=[
+            NodeGroup(name=f"g{i}", count=nodes_per_policy,
+                      policy=f"p{i}")
+            for i in range(n_policies)
+        ],
+        policies=[
+            PolicySpec(name=f"p{i}",
+                       selector={"tpunet.dev/pool": f"p{i}"})
+            for i in range(n_policies)
+        ],
+        budgets=[
+            SloBudget(policy=f"p{i}", fast_max=40.0)
+            for i in range(n_policies)
+        ],
+    )
+    with World(spec) as w:
+        w.start()
+        for _ in range(3):
+            w.tick()
+        w.force_checkpoints()
+
+        a, b = w.replicas[0], w.replicas[1]
+        a_policies = a.owned_policies(w.policy_names)
+        if not a_policies:   # hash landed everything on b: swap roles
+            a, b = b, a
+            a_policies = a.owned_policies(w.policy_names)
+
+        # churn K of a's nodes AFTER its last checkpoint
+        churned = []
+        for pname in a_policies:
+            g = f"g{pname[1:]}"
+            room = churn - len(churned)
+            if room <= 0:
+                break
+            churned += w.degrade(g, room, error="link eth1 down")
+
+        # crash-restart with the same identity: the cold pass parses
+        # exactly the churned leases, resuming the rest undecoded
+        idx = w.replicas.index(a)
+        a2 = w.restart_replica(idx)
+        cold_parsed = a2.counter("tpunet_report_parses_total")
+
+        # flip the same nodes back healthy, then kill a2 for good and
+        # expire its leases: b's takeover must re-derive exactly them
+        for g in list(w.members):
+            w.heal_group(g)
+        parsed_before = b.counter("tpunet_report_parses_total")
+        node_writes_before = {
+            k: v for k, v in w.writes_by_name.items()
+            if k[1] == "Node"
+        }
+        a2.stop()
+        w.replicas.remove(a2)
+        w.now[0] += 120.0
+        b.mgr.shard_sync()
+        takeover_ok = set(range(spec.shards)) <= b.coord.owned
+        b.settle()
+        takeover_parsed = (
+            b.counter("tpunet_report_parses_total") - parsed_before
+        )
+        node_writes = sum(
+            v - node_writes_before.get(k, 0)
+            for k, v in w.writes_by_name.items() if k[1] == "Node"
+        )
+        events = w.fake.list("v1", "Event", namespace="tpunet-system")
+        seen = {}
+        for ev in events:
+            key = (
+                (ev.get("involvedObject", {}) or {}).get("name", ""),
+                ev.get("reason", ""), ev.get("message", ""),
+            )
+            seen[key] = seen.get(key, 0) + 1
+        duplicate_events = sum(n - 1 for n in seen.values() if n > 1)
+
+        return verdict(w, extra_gates={
+            "takeover_clean": takeover_ok,
+            "cold_restart_parses_only_churn":
+                cold_parsed == len(churned),
+            "takeover_parses_only_churn":
+                takeover_parsed == len(churned),
+            "churned_somebody": len(churned) > 0,
+            "no_node_label_writes": node_writes == 0,
+            "no_duplicate_events": duplicate_events == 0,
+        })
+
+
+# -- remediation_bench scenario 1: flapping link ------------------------------
+
+def _flap_leg(remediation: bool, seed: int, ticks: int):
+    """One leg: a REAL agent with a stuck NIC that bursts rx-errors
+    every 4th tick until bounced, over the harness world."""
+    spec = ScenarioSpec(
+        name="port-remediation-flap", seed=seed, start=10_000.0,
+        tick_seconds=60.0, ticks=ticks, replicas=1, shards=1,
+        groups=[NodeGroup(name="g0", count=7, policy="p0",
+                          nics=2, real_agents=1)],
+        policies=[PolicySpec(
+            name="p0", selector={"tpunet.dev/pool": "p0"},
+            telemetry=True, remediation=remediation,
+        )],
+    )
+    w = World(spec)
+    try:
+        w.start()
+        rig = w.rigs[0]
+        stuck = True
+        transitions = 0
+        last_label = rig.has_label()
+        for tick in range(spec.ticks):
+            if stuck and tick % 4 == 0:
+                # the stuck queue corrupts a burst of frames
+                rig.ops.bump_counters("ens9", rx_errors=5000)
+            bounces_before = rig.bounces
+            w.tick()
+            if rig.bounces > bounces_before:
+                # a bounce directive executed — model it clearing the
+                # wedged NIC queue
+                stuck = False
+            label = rig.has_label()
+            if label != last_label:
+                transitions += 1
+                last_label = label
+        return w, transitions, rig.bounces
+    except Exception:
+        w.close()
+        raise
+
+
+def port_remediation_flap(seed: int = 1234, ticks: int = 20) -> dict:
+    w, healed_transitions, bounces = _flap_leg(
+        remediation=True, seed=seed, ticks=ticks
+    )
+    try:
+        w2, detection_transitions, _ = _flap_leg(
+            remediation=False, seed=seed, ticks=ticks
+        )
+        w2.close()
+        return verdict(w, extra_gates={
+            "converged": healed_transitions <= 2,
+            "bounced": bounces >= 1,
+            "no_worse_than_detection":
+                healed_transitions <= detection_transitions,
+        })
+    finally:
+        w.close()
+
+
+PORTS = {
+    "chaos_sustained": port_chaos_sustained,
+    "scale_failover": port_scale_failover,
+    "remediation_flap": port_remediation_flap,
+}

@@ -1,0 +1,113 @@
+#!/usr/bin/env python3
+"""Scenario-suite driver — prints ONE JSON line (BENCH-style).
+
+Runs the six uncovered fleet scenarios plus the three ported benches
+on the declarative harness (``tpu_network_operator.testing``), each
+judged by the SLO engine, and emits per-scenario verdicts::
+
+    {"scenarios": {...}, "ports": {...}, "all_passed": bool,
+     "replay_identical": bool, "wall_seconds": ...}
+
+Determinism is part of the contract: with ``--replay-check`` the
+suite's fastest scenario re-runs and its verdict must be BYTE-identical
+(the CI gate in tests/test_bench.py::TestScenarioBench runs the whole
+driver twice and compares everything except wall_seconds).
+
+Usage: python tools/simlab/run.py [--out BENCH_scenarios.json]
+           [--seed N] [--quick] [--only name,name] [--replay-check]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))
+))
+sys.path.insert(0, ROOT)
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="")
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--quick", action="store_true",
+                    help="smaller fleets / shorter soak (CI sizing)")
+    ap.add_argument("--only", default="",
+                    help="comma-separated scenario/port names")
+    ap.add_argument("--replay-check", action="store_true",
+                    help="re-run one scenario, assert byte-identical")
+    args = ap.parse_args()
+
+    from tools.simlab.ports import PORTS
+    from tools.simlab.scenarios import SCENARIOS, scenario_upgrade_skew
+
+    kw = {}
+    scenario_kw = {
+        # the soak's fault history runs to t+3600 (60s ticks): quick
+        # sizing can trim the converged tail but not the waves
+        "long_soak": {"ticks": 70} if args.quick else {},
+        "shard_storm": {"nodes_per_policy": 8} if args.quick else {},
+    }
+    only = {s for s in args.only.split(",") if s}
+
+    t0 = time.perf_counter()
+    scenarios = {}
+    for name, fn in SCENARIOS.items():
+        if only and name not in only:
+            continue
+        log(f"== scenario: {name}")
+        v = fn(seed=args.seed, **scenario_kw.get(name, kw))
+        scenarios[name] = v
+        log(f"   -> {'PASS' if v['passed'] else 'FAIL'} "
+            f"(gates: {sorted(k for k, ok in v['gates'].items() if not ok) or 'all ok'})")
+
+    ports = {}
+    for name, fn in PORTS.items():
+        if only and name not in only:
+            continue
+        log(f"== port: {name}")
+        v = fn(seed=args.seed)
+        ports[name] = v
+        log(f"   -> {'PASS' if v['passed'] else 'FAIL'}")
+
+    replay_identical = None
+    if args.replay_check and (not only or "upgrade_skew" in only):
+        log("== replay check: upgrade_skew x2")
+        first = json.dumps(scenarios["upgrade_skew"], sort_keys=True)
+        again = json.dumps(
+            scenario_upgrade_skew(seed=args.seed), sort_keys=True
+        )
+        replay_identical = first == again
+        log(f"   -> byte-identical: {replay_identical}")
+
+    row = {
+        "seed": args.seed,
+        "quick": bool(args.quick),
+        "scenarios": scenarios,
+        "ports": ports,
+        "all_passed": all(
+            v["passed"]
+            for v in list(scenarios.values()) + list(ports.values())
+        ) and replay_identical is not False,
+        "replay_identical": replay_identical,
+        "wall_seconds": round(time.perf_counter() - t0, 2),
+    }
+    line = json.dumps(row, sort_keys=True)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0 if row["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

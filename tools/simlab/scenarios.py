@@ -1,0 +1,423 @@
+"""The six fleet scenarios no bespoke bench covers.
+
+Each function builds a declarative :class:`ScenarioSpec`, materializes
+it through ``tpu_network_operator.testing.World``, runs it on the sim
+clock, and returns the SLO-judged verdict dict (replay-stable: two
+runs of the same seed are byte-identical — ``run.py`` asserts it).
+
+(a) shard_storm          — shard-membership churn DURING a fault storm
+(b) upgrade_skew         — rolling-upgrade agent-version skew, end to end
+(c) autoscale_mid_flight — scale up/down while provisioning is in flight
+(d) multi_policy_overlap — two policies sharing nodes, never cross-clobber
+(e) hetero_fleet         — mixed NIC counts/degrees in one policy
+(f) long_soak            — seeded multi-wave soak, burn budgets judge
+"""
+
+from __future__ import annotations
+
+import math
+
+from tpu_network_operator.kube import chaos
+from tpu_network_operator.testing import (
+    CHURN_ADD,
+    CHURN_REMOVE,
+    FAULT_API,
+    FAULT_DEGRADE,
+    FAULT_HEAL,
+    FAULT_OUTAGE,
+    FAULT_WATCH_DROP,
+    ChurnEvent,
+    FaultEvent,
+    NodeGroup,
+    PolicySpec,
+    ScenarioSpec,
+    SloBudget,
+    World,
+    verdict,
+)
+
+START = 1_000_000.0
+
+
+def _pool_policy(name: str, **kw) -> PolicySpec:
+    return PolicySpec(
+        name=name, selector={"tpunet.dev/pool": name}, **kw
+    )
+
+
+# -- (a) shard-membership churn during a fault storm --------------------------
+
+def scenario_shard_storm(seed: int = 1234, nodes_per_policy: int = 12,
+                         n_policies: int = 4) -> dict:
+    """PR 11's failover bench only moves shards on a QUIET fleet.  Here
+    a replica dies while an API fault storm is live and >= 10% of its
+    departing shards' nodes are mid-fault — the survivor must take over
+    every shard (two-leaders-never throughout), absorb the degraded
+    reports, and reconverge once the storm lifts."""
+    spec = ScenarioSpec(
+        name="shard-storm", seed=seed, start=START,
+        tick_seconds=15.0, ticks=20, replicas=2, shards=4,
+        lease_duration=30.0,
+        groups=[
+            NodeGroup(name=f"g{i}", count=nodes_per_policy,
+                      policy=f"p{i}")
+            for i in range(n_policies)
+        ],
+        policies=[_pool_policy(f"p{i}") for i in range(n_policies)],
+        budgets=[
+            SloBudget(policy=f"p{i}", fast_max=80.0)
+            for i in range(n_policies)
+        ],
+        steady_window=4,
+    )
+    with World(spec) as w:
+        # the storm: mixed retryable faults on the data verbs, live
+        # from tick 2 across the replica death and takeover
+        storm_at = START + 2 * spec.tick_seconds
+        storm_len = 8 * spec.tick_seconds
+        for verb in ("get", "list", "update"):
+            w.inj.schedule_rule(storm_at, chaos.FAULT_503, verb=verb,
+                                rate=0.06, duration=storm_len)
+            w.inj.schedule_rule(storm_at, chaos.FAULT_TIMEOUT,
+                                verb=verb, rate=0.04,
+                                duration=storm_len)
+        w.inj.schedule_rule(storm_at, chaos.FAULT_CONFLICT,
+                            verb="update", rate=0.05,
+                            duration=storm_len)
+        w.start()
+        w.tick()
+        w.tick()
+
+        # >= 10% of the departing replica's nodes go mid-fault, then
+        # the replica dies — with the storm already raging
+        dying = w.replicas[0]
+        survivor = w.replicas[1]
+        dying_policies = dying.owned_policies(w.policy_names)
+        mid_fault = 0
+        for pname in dying_policies:
+            g = f"g{pname[1:]}"
+            want = max(1, math.ceil(
+                0.10 * len(w.members[g])
+            ))
+            mid_fault += len(w.degrade(g, want))
+        departing = sum(
+            len(w.members[f"g{p[1:]}"]) for p in dying_policies
+        )
+        w.tick()
+        dying.stop()
+        w.replicas.remove(dying)
+        # lease expiry: the survivor's next shard rounds take over
+        w.now[0] += spec.lease_duration
+        for _ in range(6):
+            w.tick()
+        takeover_complete = (
+            set(range(spec.shards)) <= survivor.coord.owned
+        )
+        # storm is over (duration elapsed); heal and run out the grid
+        for g in w.members:
+            w.heal_group(g)
+        remaining = spec.ticks - 9
+        steady_mark = dict(w.writes_by_name)
+        for t in range(remaining):
+            if t == remaining - spec.steady_window:
+                steady_mark = dict(w.writes_by_name)
+            w.tick()
+        w.steady_writes = w.spurious_writes(
+            steady_mark, w.writes_by_name
+        )
+
+        from tpu_network_operator.api.v1alpha1.types import API_VERSION
+
+        converged = all(
+            (w.fake.get(API_VERSION, "NetworkClusterPolicy", p)
+             .get("status", {}) or {}).get("state") == "All good"
+            for p in w.policy_names
+        )
+        return verdict(w, extra_gates={
+            "takeover_complete": takeover_complete,
+            "mid_fault_fraction_ok":
+                departing > 0 and mid_fault / departing >= 0.10,
+            "storm_injected": sum(w.inj.injected.values()) > 0,
+            "reconverged": converged,
+        })
+
+
+# -- (b) rolling-upgrade agent-version skew -----------------------------------
+
+def scenario_upgrade_skew(seed: int = 1234, per_group: int = 8) -> dict:
+    """Three agent eras live at once (pre-version, 0.4.0, current),
+    each publishing the report JSON its epoch actually emitted.  The
+    controller must parse all of them, roll the skew up into
+    status.agentVersions — and when the rolling upgrade flips the fleet
+    version set, the contribution-cache skew guard must discard every
+    resumed entry LIVE (cold parses == fleet, resumed{persisted} == 0),
+    while a no-upgrade restart resumes everything (parses == 0)."""
+    fleet = 3 * per_group
+    spec = ScenarioSpec(
+        name="upgrade-skew", seed=seed, start=START,
+        tick_seconds=30.0, ticks=6, replicas=1, shards=1,
+        groups=[
+            NodeGroup(name="era0", count=per_group, policy="p0",
+                      epoch="pre-telemetry"),
+            NodeGroup(name="era1", count=per_group, policy="p0",
+                      epoch="pre-plan"),
+            NodeGroup(name="era2", count=per_group, policy="p0",
+                      epoch="current"),
+        ],
+        policies=[_pool_policy("p0")],
+        budgets=[SloBudget(policy="p0", fast_max=1.0)],
+    )
+    with World(spec) as w:
+        w.arm_schedule()
+        w.start()
+        for _ in range(3):
+            w.tick()
+
+        from tpu_network_operator.testing import final_status
+
+        versions_before = final_status(w, "p0")["agent_versions"]
+        w.force_checkpoints()
+
+        # control leg: crash-restart with NO upgrade — the persisted
+        # cache must resume the whole fleet, parsing nothing
+        fresh = w.restart_replica(0)
+        control_parses = fresh.counter("tpunet_report_parses_total")
+        control_resumed = fresh.counter(
+            "tpunet_rebuild_resumed_nodes_total", source="persisted"
+        )
+
+        # the rolling upgrade: every old era re-reports as current,
+        # flipping the fleet version set under the checkpoint
+        w.force_checkpoints()
+        w.set_group_epoch("era0", "current")
+        w.set_group_epoch("era1", "current")
+        fresh = w.restart_replica(0)
+        skew_parses = fresh.counter("tpunet_report_parses_total")
+        skew_resumed = fresh.counter(
+            "tpunet_rebuild_resumed_nodes_total", source="persisted"
+        )
+        for _ in range(3):
+            w.tick()
+        versions_after = final_status(w, "p0")["agent_versions"]
+
+        return verdict(w, extra_gates={
+            "versions_mixed_before": len(versions_before) >= 2,
+            "control_resumes_fleet":
+                control_parses == 0 and control_resumed == fleet,
+            "skew_flip_discards_cache":
+                skew_parses == fleet and skew_resumed == 0,
+            "versions_uniform_after": len(versions_after) == 1,
+        })
+
+
+# -- (c) autoscale churn while provisioning is in flight ----------------------
+
+def scenario_autoscale_mid_flight(seed: int = 1234) -> dict:
+    """Scale-up lands while earlier nodes are still degraded
+    (provisioning in flight), then a scale-down removes nodes while a
+    second wave is mid-fault.  Targets must track membership exactly,
+    and the fleet must end converged with zero steady writes."""
+    t = START
+    spec = ScenarioSpec(
+        name="autoscale-mid-flight", seed=seed, start=t,
+        tick_seconds=20.0, ticks=24, replicas=1, shards=1,
+        groups=[NodeGroup(name="g0", count=12, policy="p0")],
+        policies=[_pool_policy("p0")],
+        faults=[
+            # wave 1: 4 nodes provisioning (degraded) as churn begins
+            FaultEvent(at=t + 40, kind=FAULT_DEGRADE, group="g0",
+                       nodes=4, error="provisioning in flight"),
+            # wave 2 arrives mid-scale-down
+            FaultEvent(at=t + 240, kind=FAULT_DEGRADE, group="g0",
+                       nodes=2, error="link ens9 down"),
+            FaultEvent(at=t + 320, kind=FAULT_HEAL, group="g0"),
+        ],
+        churn=[
+            ChurnEvent(at=t + 60, action=CHURN_ADD, group="g0",
+                       count=8),
+            ChurnEvent(at=t + 160, action=CHURN_ADD, group="g0",
+                       count=4),
+            ChurnEvent(at=t + 260, action=CHURN_REMOVE, group="g0",
+                       count=6),
+        ],
+        budgets=[SloBudget(policy="p0", fast_max=60.0,
+                           require_burn=True)],
+        steady_window=5,
+    )
+    expected = 12 + 8 + 4 - 6
+    with World(spec) as w:
+        w.run()
+        from tpu_network_operator.testing import final_status
+
+        status = final_status(w, "p0")
+        return verdict(w, extra_gates={
+            "targets_track_membership": status["targets"] == expected,
+            "all_ready": status["ready"] == expected,
+            "converged": status["state"] == "All good",
+        })
+
+
+# -- (d) multi-policy overlap on shared nodes ---------------------------------
+
+def scenario_multi_policy_overlap(seed: int = 1234) -> dict:
+    """Two policies whose selectors overlap on a shared node group
+    (the claim-based-sharing precursor): each converges, and once
+    steady NEITHER policy's reconcile loop clobbers the other's
+    labels/plans/directives — any cross-policy fight shows up as
+    endless write churn, so the zero-steady-write invariant IS the
+    cross-clobber detector."""
+    spec = ScenarioSpec(
+        name="multi-policy-overlap", seed=seed, start=START,
+        tick_seconds=30.0, ticks=14, replicas=1, shards=1,
+        groups=[
+            NodeGroup(name="only-a", count=6, policy="p-a"),
+            # shared nodes match BOTH selectors; their agents report
+            # to p-a (one agent, one owning policy)
+            NodeGroup(name="shared", count=6, policy="p-a",
+                      labels={"tpunet.dev/poolb": "b"}),
+            NodeGroup(name="only-b", count=6, policy="p-b"),
+        ],
+        policies=[
+            _pool_policy("p-a", planner=True),
+            PolicySpec(name="p-b",
+                       selector={"tpunet.dev/poolb": "b"},
+                       planner=True),
+        ],
+        budgets=[SloBudget(policy="p-a", fast_max=1.0)],
+        steady_window=6,
+    )
+    with World(spec) as w:
+        w.arm_schedule()
+        w.start()
+        mid_statuses = None
+        steady_mark = None
+        for t in range(spec.ticks):
+            if t == spec.ticks - spec.steady_window:
+                steady_mark = dict(w.writes_by_name)
+                from tpu_network_operator.testing import final_status
+
+                mid_statuses = {
+                    p: final_status(w, p) for p in w.policy_names
+                }
+            w.tick()
+        w.steady_writes = w.spurious_writes(
+            steady_mark, w.writes_by_name
+        )
+        from tpu_network_operator.testing import final_status
+
+        end_statuses = {
+            p: final_status(w, p) for p in w.policy_names
+        }
+        return verdict(w, extra_gates={
+            "owning_policy_converged":
+                end_statuses["p-a"]["state"] == "All good"
+                and end_statuses["p-a"]["ready"] == 12,
+            "overlapping_policy_stable":
+                mid_statuses == end_statuses,
+            "shared_nodes_seen_by_both":
+                end_statuses["p-b"]["targets"] == 12,
+        })
+
+
+# -- (e) heterogeneous fleet --------------------------------------------------
+
+def scenario_hetero_fleet(seed: int = 1234) -> dict:
+    """One policy spanning three hardware shapes (2/4/8 NICs, probe
+    degrees 4/8/8) — the rollup must converge across the mix, a
+    degradation wave on the smallest-NIC group must burn and heal, and
+    steady state must be write-free despite the heterogeneity."""
+    t = START
+    spec = ScenarioSpec(
+        name="hetero-fleet", seed=seed, start=t,
+        tick_seconds=30.0, ticks=16, replicas=1, shards=1,
+        groups=[
+            NodeGroup(name="small", count=6, policy="p0", nics=2,
+                      degree=4, rack_size=4),
+            NodeGroup(name="mid", count=8, policy="p0", nics=4,
+                      degree=8, rack_size=8),
+            NodeGroup(name="big", count=10, policy="p0", nics=8,
+                      degree=8, rack_size=16),
+        ],
+        policies=[_pool_policy("p0")],
+        faults=[
+            FaultEvent(at=t + 90, kind=FAULT_DEGRADE, group="small",
+                       nodes=3, error="nic flapping"),
+            FaultEvent(at=t + 240, kind=FAULT_HEAL, group="small"),
+        ],
+        budgets=[SloBudget(policy="p0", fast_max=60.0,
+                           require_burn=True)],
+        steady_window=5,
+    )
+    with World(spec) as w:
+        w.run()
+        from tpu_network_operator.testing import final_status
+
+        status = final_status(w, "p0")
+        return verdict(w, extra_gates={
+            "all_shapes_ready": status["ready"] == 24,
+            "converged": status["state"] == "All good",
+        })
+
+
+# -- (f) long-horizon seeded soak ---------------------------------------------
+
+def scenario_long_soak(seed: int = 1234, ticks: int = 90) -> dict:
+    """Multi-wave fault history on one seeded timeline — degradation
+    waves, an API storm, a full apiserver outage, a watch drop — with
+    the SLO engine's burn budgets deciding pass/fail and the history
+    plane mining the whole flight recorder as it happens."""
+    t = START
+    spec = ScenarioSpec(
+        name="long-soak", seed=seed, start=t,
+        tick_seconds=60.0, ticks=ticks, replicas=1, shards=1,
+        groups=[NodeGroup(name="g0", count=20, policy="p0")],
+        policies=[_pool_policy("p0")],
+        faults=[
+            # three degradation waves
+            FaultEvent(at=t + 600, kind=FAULT_DEGRADE, group="g0",
+                       nodes=3),
+            FaultEvent(at=t + 1200, kind=FAULT_HEAL, group="g0"),
+            FaultEvent(at=t + 1800, kind=FAULT_DEGRADE, group="g0",
+                       nodes=4, error="link ens10 down"),
+            FaultEvent(at=t + 2400, kind=FAULT_HEAL, group="g0"),
+            FaultEvent(at=t + 3000, kind=FAULT_DEGRADE, group="g0",
+                       nodes=2),
+            FaultEvent(at=t + 3600, kind=FAULT_HEAL, group="g0"),
+            # an API storm riding wave 2
+            FaultEvent(at=t + 1900, kind=FAULT_API,
+                       fault=chaos.FAULT_503, verb="update",
+                       rate=0.05, duration=480.0),
+            # a short full outage and a watch drop, mid-soak
+            FaultEvent(at=t + 2700, kind=FAULT_OUTAGE,
+                       duration=90.0),
+            FaultEvent(at=t + 3300, kind=FAULT_WATCH_DROP),
+        ],
+        budgets=[SloBudget(policy="p0", fast_max=5.0, slow_max=8.0,
+                           require_burn=True)],
+        steady_window=8,
+    )
+    with World(spec) as w:
+        w.run()
+        from tpu_network_operator.testing import final_status
+
+        status = final_status(w, "p0")
+        timeline_kinds = {
+            ev.get("kind") for ev in w.timeline.snapshot("p0")
+        }
+        return verdict(w, extra_gates={
+            "recovered": status["ready"] == 20
+            and status["state"] == "All good",
+            "flight_recorder_mined":
+                len(timeline_kinds) >= 2
+                and w.timeline.appended() > 0,
+        })
+
+
+SCENARIOS = {
+    "shard_storm": scenario_shard_storm,
+    "upgrade_skew": scenario_upgrade_skew,
+    "autoscale_mid_flight": scenario_autoscale_mid_flight,
+    "multi_policy_overlap": scenario_multi_policy_overlap,
+    "hetero_fleet": scenario_hetero_fleet,
+    "long_soak": scenario_long_soak,
+}

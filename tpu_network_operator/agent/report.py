@@ -113,9 +113,16 @@ class ProvisioningReport:
         # must stay parseable by this controller during version skew —
         # rejecting it would flip every upgraded node to not-ready
         known = {f.name for f in fields(ProvisioningReport)}
-        rep = ProvisioningReport(**{
-            k: v for k, v in d.items() if k in known
-        })
+        # every constructor failure must surface as ValueError: ``node``
+        # has no default, so a payload without it raises TypeError from
+        # the dataclass itself — old-agent compat treats *any* malformed
+        # payload as a degraded parse, never a crash with a foreign type
+        try:
+            rep = ProvisioningReport(**{
+                k: v for k, v in d.items() if k in known
+            })
+        except TypeError as exc:
+            raise ValueError(f"report rejected by constructor: {exc}") from exc
         for field_name in ("node", "policy", "backend", "mode",
                            "coordinator", "error", "probe_endpoint",
                            "trace_id", "agent_version", "plan_version"):

@@ -512,6 +512,11 @@ class NetworkClusterPolicyReconciler:
         # monotonic: an NTP step must not fast-forward (or freeze) the
         # once-per-interval streak advance
         self._probe_clock = _time.monotonic
+        # wall-time seam for report staleness, the report cache window
+        # and the SLO sample timestamps — the scenario harness
+        # (tpu_network_operator/testing) injects a sim clock here so
+        # burn rates and replay digests are wall-clock-free
+        self._wall_clock = _time.time
         # scale state (all guarded by _reports_lock — same cross-policy
         # mutable-state rationale as the bucket cache):
         # per-lease parse memo {lease name: (rv, report, renewed_ts)} —
@@ -994,8 +999,6 @@ class NetworkClusterPolicyReconciler:
         bucketed by policy label; cached REPORT_CACHE_SECONDS.  A list
         failure returns (and does not cache) empty buckets — absence =
         no reports yet."""
-        import time as time_mod
-
         from ..agent import report as rpt
 
         # the lock covers only the cache check and the store — the list +
@@ -1004,7 +1007,7 @@ class NetworkClusterPolicyReconciler:
         # may refresh at once; last-writer-wins is fine for a freshness
         # cache and each writer stores a complete, self-consistent map)
         with self._reports_lock:
-            now = time_mod.time()
+            now = self._wall_clock()
             if (
                 self._reports_cache is not None
                 and now - self._reports_cached_at < self.REPORT_CACHE_SECONDS
@@ -3781,7 +3784,7 @@ class NetworkClusterPolicyReconciler:
             # flapper the predecessor had already penalized
             self._ensure_history_loaded(pname)
         ps = self._pass_state.setdefault(pname, PassState())
-        now_wall = time_mod.time()
+        now_wall = self._wall_clock()
         now_probe = self._probe_clock()
         phases = dict.fromkeys(STATUS_PHASES, 0.0)
         t_phase = time_mod.perf_counter
@@ -4480,10 +4483,8 @@ class NetworkClusterPolicyReconciler:
             and ps.ds_rv == ds_rv
         ):
             self.dirty.sync()
-            import time as time_mod
-
             if not self.dirty.peek(name) and ps.quiet(
-                time_mod.time(), self._probe_clock()
+                self._wall_clock(), self._probe_clock()
             ):
                 if self.slo is not None:
                     # counter bump only — a fast-path pass must append

@@ -22,7 +22,14 @@ Determinism: one seeded ``random.Random`` drives every rate roll, so a
 given (seed, request sequence) always injects the same faults — the
 chaos bench and the regression tests are reproducible.  Scheduling is
 explicit (rules added/removed, outages begun/ended by the driver), not
-wall-clock-based, so tests control the timeline.
+wall-clock-based, so tests control the timeline.  For declarative
+scenarios there is additionally an absolute-time *schedule*
+(:meth:`FaultInjector.schedule_rule` / ``schedule_outage`` /
+``schedule_watch_drop``): entries carry sim-clock timestamps against an
+injected ``clock`` and fire when the driver calls
+:meth:`FaultInjector.pump` after advancing it — still nothing
+wall-clock-based, and a (seed, schedule, request sequence) triple
+replays byte-identically.
 
 The injector also counts what it injected (``injected`` Counter keyed by
 ``(fault, verb, kind)``) so tests can assert "the retries the metrics
@@ -93,6 +100,29 @@ class FaultRule:
         )
 
 
+# scheduled-entry actions (see FaultInjector.schedule_* / pump)
+_SCHED_RULE = "rule"                # activate a FaultRule
+_SCHED_RULE_END = "rule-end"        # retire a schedule-activated rule
+_SCHED_OUTAGE_BEGIN = "outage-begin"
+_SCHED_OUTAGE_END = "outage-end"
+_SCHED_WATCH_DROP = "watch-drop"
+
+
+@dataclass
+class ScheduledFault:
+    """One schedule entry: at sim-time ``at`` (against the injector's
+    injected clock), :meth:`FaultInjector.pump` performs ``action``.
+    Scheduling alone never touches the ``injected`` accounting — only
+    the faults that actually fire on the request path count, exactly
+    as with hand-added rules."""
+
+    at: float
+    action: str
+    rule: Optional[FaultRule] = None
+    expired: bool = False           # watch-drop flavor (410 vs reset)
+    seq: int = 0                    # insertion order tiebreak
+
+
 class ChaosWatch:
     """A watch stream under the injector: proxies the inner Watch until
     the injector drops it, after which every ``next()`` raises the drop
@@ -133,15 +163,21 @@ class FaultInjector:
     """
 
     def __init__(self, inner, seed: int = 0,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic):
         self.inner = inner
         self._rng = random.Random(seed)
         self._sleep = sleep
+        # the schedule's time base: tests/scenarios inject a manual
+        # sim clock; the default real clock keeps ad-hoc use working
+        self._clock = clock
         # tpunet: allow=T003 test-infrastructure fault injector, never constructed in the production control plane
         self._lock = threading.Lock()
         self._rules: List[FaultRule] = []
         self._outage = False
         self._watches: List[ChaosWatch] = []
+        self._schedule: List[ScheduledFault] = []
+        self._sched_seq = 0
         # what actually fired: (fault, verb, kind) -> count
         self.injected: Counter = Counter()
 
@@ -167,6 +203,91 @@ class FaultInjector:
     def clear_rules(self) -> None:
         with self._lock:
             self._rules.clear()
+
+    # -- absolute-time schedule ------------------------------------------------
+
+    def _push(self, entry: ScheduledFault) -> ScheduledFault:
+        with self._lock:
+            self._sched_seq += 1
+            entry.seq = self._sched_seq
+            self._schedule.append(entry)
+        return entry
+
+    def schedule_rule(self, at: float, fault: str, verb: str = "*",
+                      kind: str = "*", rate: float = 1.0,
+                      count: Optional[int] = None,
+                      retry_after: Optional[float] = None,
+                      latency: float = 0.0,
+                      duration: float = 0.0) -> FaultRule:
+        """Arm one :class:`FaultRule` to activate at sim-time ``at`` —
+        and, when ``duration`` > 0, to retire at ``at + duration``.
+        The rule fires on the request path exactly like a hand-added
+        one (same seeded rate rolls, same ``injected`` accounting);
+        the schedule only controls WHEN it is live."""
+        if fault not in REQUEST_FAULTS:
+            raise ValueError(f"unknown fault kind {fault!r}")
+        rule = FaultRule(
+            fault=fault, verb=verb, kind=kind, rate=rate, count=count,
+            retry_after=retry_after, latency=latency,
+        )
+        self._push(ScheduledFault(at=at, action=_SCHED_RULE, rule=rule))
+        if duration > 0:
+            self._push(ScheduledFault(
+                at=at + duration, action=_SCHED_RULE_END, rule=rule,
+            ))
+        return rule
+
+    def schedule_outage(self, at: float, duration: float) -> None:
+        """Arm a full apiserver outage window [at, at + duration)."""
+        self._push(ScheduledFault(at=at, action=_SCHED_OUTAGE_BEGIN))
+        self._push(ScheduledFault(
+            at=at + duration, action=_SCHED_OUTAGE_END,
+        ))
+
+    def schedule_watch_drop(self, at: float, expired: bool = False) -> None:
+        """Arm a drop of every live watch stream at sim-time ``at``
+        (``expired=True`` = 410 Expired instead of a stream reset)."""
+        self._push(ScheduledFault(
+            at=at, action=_SCHED_WATCH_DROP, expired=expired,
+        ))
+
+    def pending_scheduled(self) -> int:
+        with self._lock:
+            return len(self._schedule)
+
+    def pump(self, now: Optional[float] = None) -> List[ScheduledFault]:
+        """Fire every schedule entry due at or before ``now`` (default:
+        the injected clock), in (at, insertion) order, and return them.
+        The scenario driver calls this after each clock advance; firing
+        order is deterministic, so a given schedule replays exactly."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            due = sorted(
+                (e for e in self._schedule if e.at <= now),
+                key=lambda e: (e.at, e.seq),
+            )
+            if not due:
+                return []
+            fired = set(id(e) for e in due)
+            self._schedule = [
+                e for e in self._schedule if id(e) not in fired
+            ]
+        for entry in due:
+            if entry.action == _SCHED_RULE:
+                self.add_rule(entry.rule)
+            elif entry.action == _SCHED_RULE_END:
+                with self._lock:
+                    self._rules = [
+                        r for r in self._rules if r is not entry.rule
+                    ]
+            elif entry.action == _SCHED_OUTAGE_BEGIN:
+                self.begin_outage()
+            elif entry.action == _SCHED_OUTAGE_END:
+                self.end_outage()
+            elif entry.action == _SCHED_WATCH_DROP:
+                self.drop_watches(expired=entry.expired)
+        return due
 
     def begin_outage(self) -> None:
         """Full apiserver outage: every verb (and every live watch

@@ -33,6 +33,7 @@ from __future__ import annotations
 import copy
 import logging
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from ..obs.profile import TracedLock
@@ -224,12 +225,20 @@ class Informer:
         kind: str,
         namespace: str = "",
         metrics=None,
+        clock: Optional[Callable[[], float]] = None,
     ):
         self.client = client
         self.api_version = api_version
         self.kind = kind
         self.namespace = namespace
         self.metrics = metrics
+        # the reopen-backoff time base.  Monotonic wall clock by
+        # default; simulated drives MUST inject their clock — a
+        # wall-clock backoff under a sim clock pins _reopen_not_before
+        # ~a wall second ahead, which is an arbitrary stretch of sim
+        # time during which sync() silently serves the stale store as
+        # fresh (the cache missing entire fault waves)
+        self._clock = clock or time.monotonic
         self.store = Store()
         self._watch = None
         self._synced = False
@@ -466,9 +475,7 @@ class Informer:
         410 Expired is the designed path (resume window compacted →
         relist); anything else is a transport death with the same
         remedy."""
-        import time as time_mod
-
-        now = time_mod.monotonic()
+        now = self._clock()
         if now < self._reopen_not_before:
             return
         if err is not None:
@@ -509,9 +516,7 @@ class Informer:
     def _try_resync(self) -> None:
         """One relist attempt for a pending watch-restart catch-up;
         failure keeps the flag so the next sync retries."""
-        import time as time_mod
-
-        if time_mod.monotonic() < self._reopen_not_before:
+        if self._clock() < self._reopen_not_before:
             return
         try:
             self.resync()
@@ -521,7 +526,7 @@ class Informer:
                 self.kind, e,
             )
             self._reopen_not_before = (
-                time_mod.monotonic() + self.REOPEN_BACKOFF
+                self._clock() + self.REOPEN_BACKOFF
             )
             return
         self._needs_resync = False
@@ -614,10 +619,12 @@ class CachedClient:
     through unchanged, so the reconciler keeps one client interface for
     both."""
 
-    def __init__(self, inner, metrics=None, resync_interval: float = 0.0):
+    def __init__(self, inner, metrics=None, resync_interval: float = 0.0,
+                 clock: Optional[Callable[[], float]] = None):
         self.inner = inner
         self.metrics = metrics
         self.resync_interval = resync_interval
+        self._clock = clock
         self._informers: Dict[Tuple[str, str], Informer] = {}
         self._stop = threading.Event()
         self._resync_thread: Optional[threading.Thread] = None
@@ -632,6 +639,7 @@ class CachedClient:
         inf = Informer(
             self.inner, api_version, kind,
             namespace=namespace, metrics=self.metrics,
+            clock=self._clock,
         )
         self._informers[(api_version, kind)] = inf
         if self._started:

@@ -1,0 +1,60 @@
+"""Scenario-harness support package (test infrastructure, never
+deployed): declarative fleet specs, the materialized simulated world,
+per-PR-epoch agent report fixtures, and the SLO-engine verdict judge.
+
+See ``docs/operator-guide.md`` ("Scenario testing") for the model and
+``tools/simlab/`` for the scenario suite built on top.
+"""
+
+from .judge import burn_rates, final_status, judge_budget, verdict
+from .spec import (
+    CHURN_ADD,
+    CHURN_REMOVE,
+    FAULT_API,
+    FAULT_DEGRADE,
+    FAULT_HEAL,
+    FAULT_LINK_DOWN,
+    FAULT_LINK_HEAL,
+    FAULT_OUTAGE,
+    FAULT_WATCH_DROP,
+    ChurnEvent,
+    FaultEvent,
+    NodeGroup,
+    PolicySpec,
+    ScenarioSpec,
+    SloBudget,
+    endpoint_of,
+    node_name,
+    rack_of,
+)
+from .world import NAMESPACE, AgentRig, SimReplica, World, policy_object
+
+__all__ = [
+    "AgentRig",
+    "CHURN_ADD",
+    "CHURN_REMOVE",
+    "ChurnEvent",
+    "FAULT_API",
+    "FAULT_DEGRADE",
+    "FAULT_HEAL",
+    "FAULT_LINK_DOWN",
+    "FAULT_LINK_HEAL",
+    "FAULT_OUTAGE",
+    "FAULT_WATCH_DROP",
+    "FaultEvent",
+    "NAMESPACE",
+    "NodeGroup",
+    "PolicySpec",
+    "ScenarioSpec",
+    "SimReplica",
+    "SloBudget",
+    "World",
+    "burn_rates",
+    "endpoint_of",
+    "final_status",
+    "judge_budget",
+    "node_name",
+    "policy_object",
+    "rack_of",
+    "verdict",
+]

@@ -1,0 +1,151 @@
+"""Report payloads exactly as each PR epoch's agent emitted them.
+
+The controller must accept a report Lease written by ANY agent version
+still running in the fleet — during a rolling upgrade the oldest agent
+can trail the controller by every epoch at once.  This module is the
+single source of those historical payload shapes: the version-skew
+scenario ((b) in ``tools/simlab``) writes them live through the fake
+cluster, and ``tests/test_report_compat.py`` pins ``from_json`` against
+the same fixtures table-driven, so the two can never drift apart.
+
+Each epoch lists the ``ProvisioningReport`` fields that EXISTED at that
+point; an epoch payload contains only those keys (old agents serialize
+nothing else) and the version string that era's agent stamped — ``""``
+for everything before the ``agent_version`` field landed.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+_BASE_FIELDS = (
+    "node", "policy", "ok", "backend", "mode",
+    "interfaces_configured", "interfaces_total", "bootstrap_written",
+    "coordinator", "coordinator_reachable", "dcn_interfaces", "error",
+)
+
+# ordered oldest -> newest; each entry: (epoch name, agent_version the
+# epoch stamps, fields added BY that epoch)
+_EPOCH_STEPS = (
+    ("pre-probe", "", ()),
+    ("pre-trace", "", ("probe_endpoint", "probe")),
+    ("pre-telemetry", "", ("trace_id", "spans")),
+    ("pre-version", "", ("telemetry",)),
+    ("pre-plan", "0.4.0", ("agent_version",)),
+    ("pre-remediation", "0.5.0", ("ici_topology", "plan_version")),
+    ("current", None, ("remediation",)),
+)
+
+
+def _build_tables():
+    epochs: List[str] = []
+    fields: Dict[str, tuple] = {}
+    versions: Dict[str, Optional[str]] = {}
+    acc = list(_BASE_FIELDS)
+    for name, version, added in _EPOCH_STEPS:
+        acc = acc + list(added)
+        epochs.append(name)
+        fields[name] = tuple(acc)
+        versions[name] = version
+    return tuple(epochs), fields, versions
+
+
+EPOCHS, _EPOCH_FIELDS, _EPOCH_VERSIONS = _build_tables()
+
+
+def epoch_fields(epoch: str) -> tuple:
+    return _EPOCH_FIELDS[epoch]
+
+
+def epoch_version(epoch: str) -> str:
+    """The ``agent_version`` agents of this epoch stamp (resolved for
+    ``current`` to this tree's own version string)."""
+    v = _EPOCH_VERSIONS[epoch]
+    if v is None:
+        from ..agent.report import agent_version_string
+
+        return agent_version_string()
+    return v
+
+
+def report_payload(
+    epoch: str,
+    node: str,
+    policy: str,
+    ok: bool = True,
+    error: str = "",
+    nics: int = 4,
+    degree: int = 8,
+    probe_endpoint: str = "",
+    probe_state: str = "Healthy",
+) -> Dict:
+    """The full report dict a healthy (or degraded) agent of ``epoch``
+    would publish — then cut down to exactly that epoch's fields."""
+    reachable = 0 if error else degree
+    full = {
+        "node": node,
+        "policy": policy,
+        "ok": ok,
+        "backend": "tpu",
+        "mode": "L2",
+        "interfaces_configured": 0 if error else nics,
+        "interfaces_total": nics,
+        "bootstrap_written": not error,
+        "coordinator": "",
+        "coordinator_reachable": None,
+        "dcn_interfaces": [f"ens{9 + i}" for i in range(nics)],
+        "error": error,
+        "probe_endpoint": probe_endpoint,
+        "probe": {
+            "peersTotal": degree,
+            "peersReachable": reachable,
+            "unreachable": [],
+            "rttP50Ms": 0.4,
+            "rttP99Ms": 1.1,
+            "lossRatio": 0.0,
+            "state": "Degraded" if error else probe_state,
+        },
+        "trace_id": "",
+        "spans": None,
+        "telemetry": None,
+        "agent_version": epoch_version(epoch),
+        "ici_topology": None,
+        "plan_version": "",
+        "remediation": None,
+    }
+    keep = epoch_fields(epoch)
+    return {k: full[k] for k in keep}
+
+
+def report_json(epoch: str, node: str, policy: str, **kw) -> str:
+    """Wire form, byte-stable: ``sort_keys`` like the real agent."""
+    return json.dumps(report_payload(epoch, node, policy, **kw),
+                      sort_keys=True)
+
+
+def lease_payload(epoch: str, node: str, policy: str,
+                  namespace: str, **kw) -> Dict:
+    """A report Lease carrying an ``epoch``-shaped payload — what that
+    era's agent would ``apply``.  Mirrors ``report.lease_for`` but
+    annotates the historical JSON instead of a current-shape report."""
+    from ..agent import report as rpt
+
+    return {
+        "apiVersion": rpt.LEASE_API,
+        "kind": "Lease",
+        "metadata": {
+            "name": rpt.lease_name(node),
+            "namespace": namespace,
+            "labels": {
+                rpt.AGENT_LABEL: "true",
+                rpt.POLICY_LABEL: policy or "unowned",
+            },
+            "annotations": {
+                rpt.REPORT_ANNOTATION: report_json(
+                    epoch, node, policy, **kw
+                ),
+            },
+        },
+        "spec": {"holderIdentity": node, "renewTime": rpt._now_micro()},
+    }

@@ -1,0 +1,117 @@
+"""Turn a finished :class:`~tpu_network_operator.testing.world.World`
+run into a verdict — the SLO engine is the judge.
+
+A verdict is a plain dict of REPLAY-STABLE values only: gate booleans,
+burn rates integrated on the sim clock (rounded), final policy
+statuses, and invariant counters whose exact value is part of the
+contract (overlap violations, steady-window writes).  Wall-clock
+durations, retry tallies and other run-shaped noise stay OUT — two
+runs of the same (spec, seed) must produce byte-identical verdict JSON
+(``tools/simlab/run.py`` asserts exactly that, and
+``tests/test_bench.py::TestScenarioBench`` gates it in CI).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .spec import ScenarioSpec, SloBudget
+from .world import World
+
+
+def burn_rates(world: World, policy: str) -> Dict[str, float]:
+    from ..obs import slo as slo_mod
+
+    eng = world.slo
+    # anchor both windows at END-OF-RUN sim time: burn_rate's default
+    # asof is the newest SAMPLE timestamp, which after a recovery (no
+    # ratio change since) would re-judge the last fault wave instead
+    # of the healed tail the run actually ended on
+    asof = world.clock()
+    return {
+        "fast": round(
+            eng.burn_rate(policy, slo_mod.WINDOW_FAST_SECONDS,
+                          asof=asof), 6
+        ),
+        "slow": round(
+            eng.burn_rate(policy, slo_mod.WINDOW_SLOW_SECONDS,
+                          asof=asof), 6
+        ),
+    }
+
+
+def final_status(world: World, policy: str) -> Dict:
+    """The policy's converged status, reduced to stable fields."""
+    from ..api.v1alpha1.types import API_VERSION
+
+    obj = world.fake.get(API_VERSION, "NetworkClusterPolicy", policy)
+    status = obj.get("status", {}) or {}
+    return {
+        "state": status.get("state", ""),
+        "ready": int(status.get("ready", 0) or 0),
+        "targets": int(status.get("targets", 0) or 0),
+        "agent_versions": dict(status.get("agentVersions", {}) or {}),
+    }
+
+
+def judge_budget(world: World, budget: SloBudget) -> Dict:
+    """One budget's verdict: measured burns vs the spec's bounds."""
+    burns = burn_rates(world, budget.policy)
+    fast_ok = (
+        budget.fast_max is None or burns["fast"] <= budget.fast_max
+    )
+    slow_ok = (
+        budget.slow_max is None or burns["slow"] <= budget.slow_max
+    )
+    burned = burns["fast"] > 0.0 or burns["slow"] > 0.0
+    burn_seen_ok = (not budget.require_burn) or burned
+    return {
+        "policy": budget.policy,
+        "burn_fast": burns["fast"],
+        "burn_slow": burns["slow"],
+        "fast_max": budget.fast_max,
+        "slow_max": budget.slow_max,
+        "fast_ok": bool(fast_ok),
+        "slow_ok": bool(slow_ok),
+        "require_burn": bool(budget.require_burn),
+        "burn_seen_ok": bool(burn_seen_ok),
+        "ok": bool(fast_ok and slow_ok and burn_seen_ok),
+    }
+
+
+def verdict(world: World, extra_gates: Optional[Dict] = None) -> Dict:
+    """The scenario's full verdict.  ``extra_gates`` lets a scenario
+    contribute its own named booleans (already replay-stable) — they
+    AND into ``passed`` alongside the SLO budgets and the standing
+    invariants."""
+    spec: ScenarioSpec = world.spec
+    budgets: List[Dict] = [
+        judge_budget(world, b) for b in spec.budgets
+    ]
+    statuses = {
+        p.name: final_status(world, p.name) for p in spec.policies
+    }
+    invariants = {
+        "two_leaders_never": world.overlap_violations == 0,
+        "overlap_violations": world.overlap_violations,
+    }
+    if spec.steady_window:
+        invariants["steady_writes"] = world.steady_writes
+        invariants["zero_steady_writes"] = world.steady_writes == 0
+    gates = dict(extra_gates or {})
+    passed = (
+        all(b["ok"] for b in budgets)
+        and invariants["two_leaders_never"]
+        and invariants.get("zero_steady_writes", True)
+        and all(bool(v) for v in gates.values())
+    )
+    return {
+        "scenario": spec.name,
+        "seed": spec.seed,
+        "ticks": spec.ticks,
+        "budgets": budgets,
+        "statuses": statuses,
+        "invariants": invariants,
+        "gates": gates,
+        "passed": bool(passed),
+    }

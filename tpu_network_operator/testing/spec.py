@@ -1,0 +1,256 @@
+"""Declarative scenario specs for the fleet simulator.
+
+A :class:`ScenarioSpec` is the single source of truth for one simulated
+world: node groups (size, NIC heterogeneity, rack layout, agent-version
+epoch, how many run the REAL agent monitor tick), the policy set, the
+replica/shard topology, a seeded fault schedule with absolute sim-clock
+timestamps, an autoscale churn schedule, and the SLO burn budgets that
+judge the run.  ``tpu_network_operator.testing.world`` materializes it;
+``tpu_network_operator.testing.judge`` turns the run into a verdict.
+
+Everything here is plain data — no clocks, no randomness, no I/O — so a
+spec plus a seed fully determines a run (byte-identical verdict replay
+is an executable assertion, see ``tools/simlab/run.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# fault-event kinds understood by the world driver
+FAULT_API = "api"               # FaultInjector request-path rule at T
+FAULT_OUTAGE = "outage"         # full API outage window [T, T+duration)
+FAULT_WATCH_DROP = "watch-drop"  # kill live watches at T
+FAULT_DEGRADE = "degrade"       # flip N nodes of a group degraded at T
+FAULT_HEAL = "heal"             # heal previously degraded nodes at T
+FAULT_LINK_DOWN = "link-down"   # fabric link a<->b down at T
+FAULT_LINK_HEAL = "link-heal"   # fabric link a<->b restored at T
+
+_FAULT_KINDS = (
+    FAULT_API, FAULT_OUTAGE, FAULT_WATCH_DROP, FAULT_DEGRADE,
+    FAULT_HEAL, FAULT_LINK_DOWN, FAULT_LINK_HEAL,
+)
+
+CHURN_ADD = "add"
+CHURN_REMOVE = "remove"
+
+
+@dataclass
+class NodeGroup:
+    """A homogeneous slice of the fleet.
+
+    ``nics``/``degree`` express NIC heterogeneity (scenario (e)):
+    groups with fewer NICs report fewer configured interfaces and a
+    smaller probe degree.  ``epoch`` assigns the agent-version payload
+    shape (see ``testing.epochs``) — ``"current"`` means this
+    controller's own epoch; older names replay the report JSON exactly
+    as that PR's agent emitted it (scenario (b)).  ``real_agents``
+    nodes at the head of the group run the REAL ``_monitor_tick``
+    against fake sysfs + FakeLinkOps instead of synthetic leases.
+    """
+
+    name: str
+    count: int
+    policy: str = ""           # default: first policy in the spec
+    nics: int = 4
+    degree: int = 8
+    rack_size: int = 16
+    epoch: str = "current"
+    real_agents: int = 0
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PolicySpec:
+    """One NetworkClusterPolicy in the simulated cluster."""
+
+    name: str
+    selector: Dict[str, str]
+    probe: bool = True
+    probe_interval: int = 5
+    degree: int = 8
+    quorum: int = 0
+    telemetry: bool = False
+    planner: bool = False
+    remediation: bool = False
+    max_per_window: int = 3
+    window_seconds: int = 300
+    cooldown_seconds: int = 180
+    escalate_after: int = 2
+
+
+@dataclass
+class FaultEvent:
+    """One scheduled fault at absolute sim-time ``at``.
+
+    ``kind=FAULT_API`` maps onto :meth:`FaultInjector.schedule_rule`
+    (fault/verb/obj_kind/rate/count/duration); OUTAGE and WATCH_DROP
+    map onto their schedule counterparts.  DEGRADE/HEAL flip the first
+    ``nodes`` members of ``group`` to a degraded/healthy report payload
+    at ``at`` (the world keeps per-node degraded state so HEAL restores
+    exactly what DEGRADE broke).  LINK_DOWN/LINK_HEAL act on the
+    FakeFabric through FabricChaos between endpoints ``a`` and ``b``.
+    """
+
+    at: float
+    kind: str
+    # FAULT_API knobs (FaultInjector vocabulary)
+    fault: str = ""
+    verb: str = "*"
+    obj_kind: str = "*"
+    rate: float = 1.0
+    count: Optional[int] = None
+    duration: float = 0.0
+    # DEGRADE/HEAL knobs
+    group: str = ""
+    nodes: int = 0
+    error: str = "link ens9 down"
+    # LINK_DOWN/LINK_HEAL knobs
+    a: str = ""
+    b: str = ""
+
+
+@dataclass
+class ChurnEvent:
+    """Autoscale step at absolute sim-time ``at``: grow or shrink
+    ``group`` by ``count`` nodes (removal deletes the youngest members
+    and their report Leases, exactly like a scale-down)."""
+
+    at: float
+    action: str
+    group: str
+    count: int
+
+
+@dataclass
+class SloBudget:
+    """Burn-rate budget for one policy — the run's pass/fail judge.
+
+    ``fast_max``/``slow_max`` bound the SLO engine's 5-minute and
+    1-hour burn rates *at end of run*; ``None`` leaves that window
+    unjudged.  ``require_burn`` asserts the scenario actually exercised
+    the error budget (a fault storm that burns nothing proves
+    nothing)."""
+
+    policy: str
+    fast_max: Optional[float] = None
+    slow_max: Optional[float] = None
+    require_burn: bool = False
+
+
+@dataclass
+class ScenarioSpec:
+    """The whole world, declaratively."""
+
+    name: str
+    groups: List[NodeGroup]
+    policies: List[PolicySpec]
+    seed: int = 1234
+    start: float = 1_000_000.0
+    tick_seconds: float = 5.0
+    ticks: int = 24
+    replicas: int = 1
+    shards: int = 1
+    lease_duration: float = 30.0
+    faults: List[FaultEvent] = field(default_factory=list)
+    churn: List[ChurnEvent] = field(default_factory=list)
+    budgets: List[SloBudget] = field(default_factory=list)
+    # trailing ticks over which the zero-steady-write invariant holds:
+    # once the world stops changing, a converged controller writes
+    # nothing (0 disables the check for scenarios that never go quiet)
+    steady_window: int = 0
+
+    def end(self) -> float:
+        return self.start + self.ticks * self.tick_seconds
+
+    def group(self, name: str) -> NodeGroup:
+        for g in self.groups:
+            if g.name == name:
+                return g
+        raise KeyError(f"no node group named {name!r}")
+
+    def validate(self) -> None:
+        """Reject malformed specs before any world is built — every
+        message names the spec so a suite of scenarios fails legibly."""
+        if not self.groups:
+            raise ValueError(f"{self.name}: at least one node group")
+        if not self.policies:
+            raise ValueError(f"{self.name}: at least one policy")
+        if self.replicas < 1 or self.shards < 1:
+            raise ValueError(f"{self.name}: replicas/shards must be >= 1")
+        if self.ticks < 1 or self.tick_seconds <= 0:
+            raise ValueError(f"{self.name}: need a positive tick grid")
+        pnames = {p.name for p in self.policies}
+        gnames = set()
+        for g in self.groups:
+            if g.name in gnames:
+                raise ValueError(f"{self.name}: duplicate group {g.name!r}")
+            gnames.add(g.name)
+            if g.count < 0 or g.real_agents < 0 or g.real_agents > g.count:
+                raise ValueError(
+                    f"{self.name}: group {g.name!r} has bad counts"
+                )
+            if g.policy and g.policy not in pnames:
+                raise ValueError(
+                    f"{self.name}: group {g.name!r} references unknown "
+                    f"policy {g.policy!r}"
+                )
+        horizon = self.end()
+        for ev in self.faults:
+            if ev.kind not in _FAULT_KINDS:
+                raise ValueError(
+                    f"{self.name}: unknown fault kind {ev.kind!r}"
+                )
+            if not self.start <= ev.at <= horizon:
+                raise ValueError(
+                    f"{self.name}: fault at {ev.at} outside "
+                    f"[{self.start}, {horizon}]"
+                )
+            if ev.kind in (FAULT_DEGRADE, FAULT_HEAL) and (
+                ev.group not in gnames
+            ):
+                raise ValueError(
+                    f"{self.name}: fault references unknown group "
+                    f"{ev.group!r}"
+                )
+        for ev in self.churn:
+            if ev.action not in (CHURN_ADD, CHURN_REMOVE):
+                raise ValueError(
+                    f"{self.name}: unknown churn action {ev.action!r}"
+                )
+            if ev.group not in gnames:
+                raise ValueError(
+                    f"{self.name}: churn references unknown group "
+                    f"{ev.group!r}"
+                )
+            if not self.start <= ev.at <= horizon:
+                raise ValueError(
+                    f"{self.name}: churn at {ev.at} outside the run"
+                )
+        for b in self.budgets:
+            if b.policy not in pnames:
+                raise ValueError(
+                    f"{self.name}: budget references unknown policy "
+                    f"{b.policy!r}"
+                )
+
+
+def endpoint_of(i: int) -> str:
+    """Deterministic probe endpoint for fleet member ``i`` (the
+    scale-bench address plan, shared so ported benches agree)."""
+    return f"10.{i // 65536}.{(i // 256) % 256}.{i % 256}:8477"
+
+
+def rack_of(group: NodeGroup, i: int) -> str:
+    return f"rack-{group.name}-{i // max(group.rack_size, 1):04d}"
+
+
+def node_name(group: NodeGroup, i: int) -> str:
+    return f"{group.name}-n{i:05d}"
+
+
+def split_name(node: str) -> Tuple[str, int]:
+    """Inverse of :func:`node_name`."""
+    stem, _, idx = node.rpartition("-n")
+    return stem, int(idx)

@@ -1,0 +1,735 @@
+"""Materialize a :class:`~tpu_network_operator.testing.spec.ScenarioSpec`.
+
+One :class:`World` owns everything a scenario needs — FakeCluster with
+real admission, FaultInjector (request faults AND the absolute-time
+schedule), FakeFabric + FabricChaos, fake sysfs roots, a shared
+Timeline + SloEngine on the sim clock, N sharded :class:`SimReplica`
+controller replicas, and real agents driven through ``_monitor_tick``
+— and drives it on a deterministic tick grid.  Nothing here reads wall
+time for behavior: every clock seam (fault schedule, shard leases,
+remediation ledger, report staleness, SLO samples, telemetry windows)
+is the one ``world.now`` cell, so a (spec, seed) pair replays exactly.
+
+The bench ports in ``tools/simlab/ports.py`` and the six scenarios in
+``tools/simlab/scenarios.py`` build on these pieces; distilled tier-1
+regressions (``tests/test_scenarios.py``) reuse them directly.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import zlib
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import epochs
+from .spec import (
+    CHURN_ADD,
+    FAULT_API,
+    FAULT_DEGRADE,
+    FAULT_HEAL,
+    FAULT_LINK_DOWN,
+    FAULT_LINK_HEAL,
+    FAULT_OUTAGE,
+    FAULT_WATCH_DROP,
+    NodeGroup,
+    PolicySpec,
+    ScenarioSpec,
+    endpoint_of,
+    node_name,
+    rack_of,
+)
+
+NAMESPACE = "tpunet-system"
+
+_WRITE_VERBS = ("create", "update", "patch", "delete", "apply")
+
+
+def make_fake_cluster():
+    """FakeCluster with the REAL admission chain registered — specs
+    exercise defaulting/validation exactly like the benches do."""
+    from ..api.v1alpha1 import (
+        NetworkClusterPolicy,
+        default_policy,
+        validate_create,
+        validate_update,
+    )
+    from ..api.v1alpha1.types import API_VERSION
+    from ..kube.fake import FakeCluster
+
+    fake = FakeCluster()
+    fake.register_admission(
+        API_VERSION,
+        "NetworkClusterPolicy",
+        mutate=lambda obj: default_policy(
+            NetworkClusterPolicy.from_dict(obj)
+        ).to_dict(),
+        validate=lambda obj, old: (
+            validate_update(NetworkClusterPolicy.from_dict(obj))
+            if old
+            else validate_create(NetworkClusterPolicy.from_dict(obj))
+        ),
+    )
+    return fake
+
+
+def policy_object(p: PolicySpec):
+    """A NetworkClusterPolicy dict from one :class:`PolicySpec`."""
+    from ..api.v1alpha1 import NetworkClusterPolicy, default_policy
+
+    obj = NetworkClusterPolicy()
+    obj.metadata.name = p.name
+    obj.spec.configuration_type = "tpu-so"
+    obj.spec.node_selector = dict(p.selector)
+    so = obj.spec.tpu_scale_out
+    so.probe.enabled = p.probe
+    so.probe.interval_seconds = p.probe_interval
+    so.probe.degree = p.degree
+    so.probe.quorum = p.quorum
+    so.planner.enabled = p.planner
+    r = so.remediation
+    r.enabled = p.remediation
+    r.max_nodes_per_window = p.max_per_window
+    r.window_seconds = p.window_seconds
+    r.cooldown_seconds = p.cooldown_seconds
+    r.escalate_after = p.escalate_after
+    return default_policy(obj).to_dict()
+
+
+def _stable_rng_seed(seed: int, salt: str) -> int:
+    # hash() is process-salted for str; crc32 is not
+    return seed ^ zlib.crc32(salt.encode())
+
+
+class SimReplica:
+    """One sharded controller replica on the simulated world.
+
+    The scale-bench Replica, generalized: the cache and the reconcile
+    loop read/write through the shared FaultInjector behind a
+    RetryingClient (so request faults are felt and retried exactly like
+    production), the shard coordinator and every clock seam run on the
+    sim clock, and :meth:`settle` resolves the manager's async backoff
+    timers deterministically (cancel + sorted re-enqueue) so a drive
+    never depends on wall-time timer firing order.
+    """
+
+    def __init__(self, world: "World", ident: str):
+        import random
+
+        from ..agent import report as rpt
+        from ..api.v1alpha1.types import API_VERSION
+        from ..controller.health import Metrics
+        from ..controller.manager import Manager
+        from ..controller.sharding import ShardAggregator, ShardCoordinator
+        from ..kube.informer import CachedClient
+        from ..kube.retry import RetryingClient
+        from ..obs import EventRecorder
+
+        spec = world.spec
+        self.world = world
+        self.ident = ident
+        self.metrics = Metrics()
+        self.retry = RetryingClient(
+            world.inj,
+            metrics=self.metrics,
+            backoff_base=0.0005,
+            backoff_cap=0.002,
+            sleep=world.absorb_sleep,
+            clock=world.clock,
+            rng=random.Random(_stable_rng_seed(spec.seed, ident)),
+        )
+        # the informer's watch-reopen backoff must run on the SIM
+        # clock: on a wall clock a failed reopen (outage window) pins
+        # the cache stale for a wall second = an unbounded stretch of
+        # sim time (it silently missed whole degradation waves)
+        self.split = CachedClient(self.retry, clock=world.clock)
+        self.split.cache(API_VERSION, "NetworkClusterPolicy")
+        self.split.cache("apps/v1", "DaemonSet", namespace=NAMESPACE)
+        self.split.cache(rpt.LEASE_API, "Lease", namespace=NAMESPACE)
+        # the coordinator shares the retrying client: its heartbeats
+        # feel injected faults exactly like production, and the
+        # retry/give-up metrics keep the injector ledger balanced
+        self.coord = ShardCoordinator(
+            self.retry, NAMESPACE, n_shards=spec.shards, identity=ident,
+            lease_duration=spec.lease_duration, clock=world.clock,
+            metrics=self.metrics,
+        )
+        self.mgr = Manager(
+            self.split, NAMESPACE, metrics=self.metrics,
+            concurrent_reconciles=1,
+            events=EventRecorder(world.fake, NAMESPACE,
+                                 metrics=self.metrics),
+            timeline=world.timeline, slo=world.slo,
+            history=world.history,
+            sharding=self.coord,
+            aggregator=ShardAggregator(
+                world.fake, NAMESPACE, metrics=self.metrics
+            ),
+        )
+        # requeue timers resolve through settle(), not wall time
+        self.mgr._backoff_base = 0.001
+        self.mgr._backoff_max = 0.01
+        self.rec = self.mgr.reconciler
+        self.rec.REPORT_CACHE_SECONDS = 0.0
+        self.rec._wall_clock = world.clock
+        self.rec._rem_clock = world.clock
+        self.rec._probe_clock = world.clock
+
+    def start(self) -> None:
+        # interest BEFORE the informer seed lists, so the Lease store
+        # only ever holds this replica's slice
+        self.coord.sync()
+        self.mgr._install_interest()
+        self.split.start()
+        self.rec.setup()
+
+    def owned_policies(self, names: List[str]) -> List[str]:
+        return [n for n in names if self.coord.owns(n)]
+
+    def enqueue_owned(self, names: List[str]) -> None:
+        for n in self.owned_policies(names):
+            self.mgr.enqueue(n)
+
+    def settle(self, rounds: int = 20) -> int:
+        """Drain to quiescence deterministically.  Backoff requeues
+        normally re-enter via wall-clock ``threading.Timer``s — firing
+        order across near-simultaneous timers is scheduler noise, so a
+        byte-identical replay cannot wait for them.  Each round drains
+        the queue, then claims every pending timer under the manager's
+        own lock (sorted by policy name) and re-enqueues synchronously;
+        a timer that already fired just drained normally."""
+        total = 0
+        for _ in range(rounds):
+            total += self.mgr.drain(max_iters=500)
+            with self.mgr._failures_lock:
+                pending = sorted(self.mgr._backoff_timers)
+                timers = [
+                    self.mgr._backoff_timers.pop(n) for n in pending
+                ]
+            for t in timers:
+                t.cancel()
+            if not pending:
+                if self.mgr.drain(max_iters=500) == 0:
+                    break
+                continue
+            for n in pending:
+                self.mgr.enqueue(n)
+        return total
+
+    def counter(self, name: str, **labels) -> int:
+        total = 0
+        for (metric, lbls), val in self.metrics._counters.items():
+            if metric == name and all(
+                dict(lbls).get(k) == v for k, v in labels.items()
+            ):
+                total += val
+        return int(total)
+
+    def force_checkpoint(self, names: List[str]) -> None:
+        """One checkpointing rebuild per owned policy, so the persisted
+        contribution cache reflects the converged fleet."""
+        for n in self.owned_policies(names):
+            if n in self.rec._pass_state:
+                self.rec._pass_state[n].rebuild_due_probe = 0.0
+            self.mgr.enqueue(n)
+        self.settle()
+
+    def stop(self) -> None:
+        self.mgr.stop()
+        self.split.stop()
+
+
+class AgentRig:
+    """One REAL agent: ``_monitor_tick`` over FakeLinkOps + a fake
+    sysfs/NFD root, clocked by the world.  The rig owns its tempdir;
+    :meth:`close` removes it."""
+
+    def __init__(self, world: "World", node: str, policy: PolicySpec,
+                 nics: int):
+        from tests.fake_ops import FakeLinkOps
+        from .. import nfd
+        from ..agent import cli as agent_cli
+        from ..agent import network as net
+        from ..agent import telemetry as telem
+
+        self.world = world
+        self.node = node
+        self.ops = FakeLinkOps()
+        self.configs = {}
+        self.ifaces = [f"ens{9 + i}" for i in range(nics)]
+        for idx, iface in enumerate(self.ifaces):
+            link = self.ops.add_fake_link(
+                iface, idx + 2, f"02:00:00:00:00:{idx:02x}", up=True
+            )
+            self.ops.bump_counters(
+                iface, rx_packets=10_000, tx_packets=10_000
+            )
+            self.configs[iface] = net.NetworkConfiguration(
+                link=link, orig_flags=link.flags
+            )
+        self.nfd_root = tempfile.mkdtemp(prefix=f"simlab-{node}-")
+        os.makedirs(os.path.join(
+            self.nfd_root,
+            "etc/kubernetes/node-feature-discovery/features.d",
+        ))
+        self.config = agent_cli.CmdConfig(
+            backend="tpu", mode="L2", ops=self.ops,
+            report_namespace=NAMESPACE, policy_name=policy.name,
+            telemetry_enabled=policy.telemetry,
+            remediation_enabled=policy.remediation,
+            nfd_root=self.nfd_root,
+        )
+        self.state = agent_cli._MonitorState()
+        self.state.telemetry = telem.TelemetryMonitor(
+            window=3, clock=world.clock
+        )
+        nfd.write_readiness_label("x", root=self.nfd_root)
+        self.label_file = os.path.join(
+            nfd.labels.features_dir(self.nfd_root),
+            nfd.labels.NFD_FILE_NAME,
+        )
+        self._prev_downs = 0
+        self.bounces = 0
+
+    def has_label(self) -> bool:
+        return os.path.exists(self.label_file)
+
+    def tick(self) -> None:
+        from ..agent import cli as agent_cli
+
+        os.environ["NODE_NAME"] = self.node
+        for iface in self.ifaces:
+            self.ops.bump_counters(iface, rx_packets=1000,
+                                   tx_packets=1000)
+        # the sim compresses ticks into microseconds of wall time:
+        # allow the directive poll every tick instead of the 30s TTL
+        self.state.remediation_fetched_at = -1e9
+        agent_cli._monitor_tick(
+            self.config, self.configs, "", "x", self.state
+        )
+        if len(self.ops.downs) > self._prev_downs:
+            self._prev_downs = len(self.ops.downs)
+            self.bounces += 1
+
+    def close(self) -> None:
+        shutil.rmtree(self.nfd_root, ignore_errors=True)
+
+
+class World:
+    """The materialized scenario — see module docstring."""
+
+    def __init__(self, spec: ScenarioSpec):
+        from ..kube import chaos
+        from ..obs.slo import SloEngine
+        from ..obs.timeline import Timeline
+        from ..probe.transport import FakeFabric
+
+        spec.validate()
+        self.spec = spec
+        self.now = [spec.start]
+        self.clock = lambda: self.now[0]
+        self.slept = [0.0]
+        self.fake = make_fake_cluster()
+        # name-aware write ledger: (verb, kind, name) -> count.  The
+        # fake's request_counts are per-(verb, kind) only; the
+        # zero-steady-write judge must exempt legitimate liveness
+        # writes (shard Lease heartbeats, the driver's own DaemonSet
+        # status recomputes, contribution-cache checkpoint re-cuts) by
+        # NAME, so the world shims the write verbs once here
+        self.writes_by_name: Dict[Tuple[str, str, str], int] = {}
+        self._shim_write_ledger()
+        self.inj = chaos.FaultInjector(
+            self.fake, seed=spec.seed, sleep=self.absorb_sleep,
+            clock=self.clock,
+        )
+        self.fabric = FakeFabric(seed=spec.seed)
+        self.fabric_chaos = chaos.FabricChaos(self.fabric)
+        from ..obs.history import HistoryEngine
+
+        self.timeline = Timeline(clock=self.clock)
+        self.slo = SloEngine(timeline=self.timeline, clock=self.clock)
+        self.history = HistoryEngine(
+            self.timeline, slo=self.slo, clock=self.clock
+        )
+        self.policy_names = [p.name for p in spec.policies]
+        self._policies = {p.name: p for p in spec.policies}
+        # fleet membership: group name -> ordered [(node, index)]
+        self.members: Dict[str, List[Tuple[str, int]]] = {}
+        self._next_index: Dict[str, int] = {}
+        self.degraded: Dict[str, str] = {}   # node -> error string
+        self.overlap_violations = 0
+        self.steady_writes: Optional[int] = None
+        self.write_series: List[int] = []
+        self._applied_events: Set[int] = set()
+        self.rigs: List[AgentRig] = []
+        self._orig_kube_client = None
+        self._patched_cli = False
+
+        for p in spec.policies:
+            self.fake.create(policy_object(p))
+        for g in spec.groups:
+            self.members[g.name] = []
+            self._next_index[g.name] = 0
+            self.grow(g.name, g.count)
+        self.replicas = [
+            SimReplica(self, f"replica-{chr(ord('a') + i)}")
+            for i in range(spec.replicas)
+        ]
+
+    # -- plumbing -------------------------------------------------------------
+
+    def absorb_sleep(self, seconds: float) -> None:
+        """Every injected latency / retry backoff lands here instead of
+        wall time — accounted, never slept."""
+        self.slept[0] += seconds
+
+    def _shim_write_ledger(self) -> None:
+        import copy as copy_mod
+
+        fake = self.fake
+        ledger = self.writes_by_name
+
+        def _note(verb: str, obj) -> None:
+            key = (
+                verb, obj.get("kind", ""),
+                (obj.get("metadata", {}) or {}).get("name", ""),
+            )
+            ledger[key] = ledger.get(key, 0) + 1
+
+        def _sans_obs(obj):
+            o = copy_mod.deepcopy(obj)
+            (o.get("metadata", {}) or {}).pop("resourceVersion", None)
+            st = o.get("status")
+            if isinstance(st, dict):
+                st.pop("health", None)
+            return o
+
+        def _health_only(obj) -> bool:
+            """True when this policy update differs from the stored
+            object ONLY in status.health — the SLO burn / fast-path
+            telemetry decays with the sliding window on a perfectly
+            steady fleet, so those diff-gated rewrites are
+            observability, not reconcile churn."""
+            m = obj.get("metadata", {}) or {}
+            try:
+                cur = fake.get(
+                    obj.get("apiVersion", ""), obj.get("kind", ""),
+                    m.get("name", ""), m.get("namespace", ""),
+                )
+            except Exception:   # noqa: BLE001 — no prior object
+                return False
+            return _sans_obs(cur) == _sans_obs(obj)
+
+        orig_create, orig_update = fake.create, fake.update
+        orig_apply, orig_delete = fake.apply, fake.delete
+
+        def create(obj, **kw):
+            _note("create", obj)
+            return orig_create(obj, **kw)
+
+        def update(obj, **kw):
+            verb = "update"
+            if (
+                obj.get("kind") == "NetworkClusterPolicy"
+                and _health_only(obj)
+            ):
+                verb = "update-obs"
+            _note(verb, obj)
+            return orig_update(obj, **kw)
+
+        def apply(obj, **kw):
+            _note("apply", obj)
+            return orig_apply(obj, **kw)
+
+        def delete(api_version, kind, name, namespace=""):
+            key = ("delete", kind, name)
+            ledger[key] = ledger.get(key, 0) + 1
+            return orig_delete(api_version, kind, name, namespace)
+
+        fake.create, fake.update = create, update
+        fake.apply, fake.delete = apply, delete
+
+    def spurious_writes(self, before: Dict, after: Dict) -> int:
+        """Writes between two :attr:`writes_by_name` snapshots that a
+        converged, unchanging world does NOT justify: policy status,
+        node labels, Events, and non-checkpoint ConfigMaps (peers,
+        plan, directives, ledger — all diff-gated).  Exempt: Lease
+        heartbeats, the driver's DaemonSet status recomputes,
+        contribution-cache checkpoint chunks (persistence cadence),
+        and policy updates whose only diff was the decaying
+        status.health telemetry (ledgered as ``update-obs``)."""
+        from ..controller import contribcache
+
+        total = 0
+        for key, n in after.items():
+            d = n - before.get(key, 0)
+            if d <= 0:
+                continue
+            verb, kind, name = key
+            if verb == "update-obs":
+                continue
+            if kind in ("Lease", "DaemonSet"):
+                continue
+            if kind == "ConfigMap" and name.startswith(
+                contribcache.CM_PREFIX
+            ):
+                continue
+            total += d
+        return total
+
+    def policy_of(self, g: NodeGroup) -> PolicySpec:
+        return self._policies[g.policy or self.policy_names[0]]
+
+    def counter(self, name: str, **labels) -> int:
+        return sum(r.counter(name, **labels) for r in self.replicas)
+
+    def write_counts(self) -> Dict:
+        return {
+            k: v for k, v in self.fake.request_counts.items()
+            if k[0] in _WRITE_VERBS
+        }
+
+    @staticmethod
+    def delta_writes(before: Dict, after: Dict) -> int:
+        return sum(after.get(k, 0) - before.get(k, 0) for k in after)
+
+    # -- fleet mutation (the world's own writes go straight to the fake:
+    # the subject under fault is the control plane, not the scaffolding)
+
+    def _write_lease(self, g: NodeGroup, node: str, index: int) -> None:
+        pol = self.policy_of(g)
+        error = self.degraded.get(node, "")
+        self.fake.apply(epochs.lease_payload(
+            g.epoch, node, pol.name, NAMESPACE,
+            ok=not error, error=error, nics=g.nics,
+            degree=min(g.degree, pol.degree),
+            probe_endpoint=endpoint_of(index) if pol.probe else "",
+        ))
+
+    def grow(self, group: str, count: int) -> List[str]:
+        g = self.spec.group(group)
+        pol = self.policy_of(g)
+        added = []
+        for _ in range(count):
+            i = self._next_index[group]
+            self._next_index[group] = i + 1
+            node = node_name(g, i)
+            labels = dict(pol.selector)
+            labels["tpunet.dev/rack"] = rack_of(g, i)
+            labels.update(g.labels)
+            self.fake.add_node(node, labels)
+            # real-agent nodes publish their own report through
+            # _monitor_tick; synthetic members get an epoch lease
+            if i < g.real_agents:
+                self.rigs.append(AgentRig(self, node, pol, g.nics))
+                self._patch_agent_client()
+            else:
+                self._write_lease(g, node, i)
+            self.members[group].append((node, i))
+            added.append(node)
+        return added
+
+    def shrink(self, group: str, count: int) -> List[str]:
+        from ..agent import report as rpt
+
+        removed = []
+        for _ in range(min(count, len(self.members[group]))):
+            node, _i = self.members[group].pop()
+            self.fake.delete("v1", "Node", node)
+            try:
+                self.fake.delete(
+                    rpt.LEASE_API, "Lease", rpt.lease_name(node),
+                    NAMESPACE,
+                )
+            except Exception:   # noqa: BLE001 — lease never written
+                pass
+            self.degraded.pop(node, None)
+            removed.append(node)
+        return removed
+
+    def degrade(self, group: str, count: int,
+                error: str = "link ens9 down") -> List[str]:
+        """Flip the first ``count`` currently-healthy synthetic members
+        of ``group`` to a degraded report."""
+        g = self.spec.group(group)
+        hit = []
+        for node, i in self.members[group]:
+            if len(hit) >= count:
+                break
+            if node in self.degraded or i < g.real_agents:
+                continue
+            self.degraded[node] = error
+            self._write_lease(g, node, i)
+            hit.append(node)
+        return hit
+
+    def heal_group(self, group: str) -> List[str]:
+        g = self.spec.group(group)
+        healed = []
+        for node, i in self.members[group]:
+            if node in self.degraded:
+                del self.degraded[node]
+                self._write_lease(g, node, i)
+                healed.append(node)
+        return healed
+
+    def set_group_epoch(self, group: str, epoch: str) -> None:
+        """Rolling upgrade/downgrade: re-publish every synthetic member
+        of ``group`` with ``epoch``-shaped payloads (rv bumps, exactly
+        like a fleet of restarted agents re-reporting)."""
+        g = self.spec.group(group)
+        g.epoch = epoch
+        for node, i in self.members[group]:
+            if i >= g.real_agents:
+                self._write_lease(g, node, i)
+
+    def _patch_agent_client(self) -> None:
+        from ..agent import cli as agent_cli
+
+        if not self._patched_cli:
+            self._orig_kube_client = agent_cli._kube_client
+            agent_cli._kube_client = lambda: self.fake
+            self._patched_cli = True
+
+    # -- replica lifecycle ----------------------------------------------------
+
+    def start(self) -> None:
+        for r in self.replicas:
+            r.start()
+        self.shard_round()
+        for r in self.replicas:
+            r.enqueue_owned(self.policy_names)
+            r.settle()
+        self.fake.simulate_daemonset_controller(materialize_pods=False)
+        for r in self.replicas:
+            r.settle()
+
+    def restart_replica(self, idx: int) -> SimReplica:
+        """Crash-restart replica ``idx`` as a fresh process with the
+        same identity (empty parse memo; resumes from the persisted
+        contribution cache)."""
+        old = self.replicas[idx]
+        old.stop()
+        fresh = SimReplica(self, old.ident)
+        self.replicas[idx] = fresh
+        fresh.start()
+        fresh.enqueue_owned(self.policy_names)
+        fresh.settle()
+        return fresh
+
+    def shard_round(self) -> None:
+        """One shard-membership round across every live replica, with
+        the two-leaders-never audit."""
+        for r in self.replicas:
+            try:
+                r.mgr.shard_sync()
+            except Exception:   # noqa: BLE001 — outage window: the
+                # round is lost, exactly like the production shard
+                # loop's catch; the next tick retries
+                pass
+        for i, a in enumerate(self.replicas):
+            for b in self.replicas[i + 1:]:
+                if a.coord.owned & b.coord.owned:
+                    self.overlap_violations += 1
+
+    def force_checkpoints(self) -> None:
+        for r in self.replicas:
+            r.force_checkpoint(self.policy_names)
+
+    # -- the drive ------------------------------------------------------------
+
+    def _apply_due_events(self) -> None:
+        now = self.now[0]
+        for ev in self.spec.faults:
+            if ev.at > now or id(ev) in self._applied_events:
+                continue
+            self._applied_events.add(id(ev))
+            if ev.kind == FAULT_DEGRADE:
+                self.degrade(ev.group, ev.nodes, ev.error)
+            elif ev.kind == FAULT_HEAL:
+                self.heal_group(ev.group)
+            elif ev.kind == FAULT_LINK_DOWN:
+                self.fabric_chaos.link_down(ev.a, ev.b)
+            elif ev.kind == FAULT_LINK_HEAL:
+                self.fabric_chaos.heal_link(ev.a, ev.b)
+        for ch in self.spec.churn:
+            if ch.at > now or id(ch) in self._applied_events:
+                continue
+            self._applied_events.add(id(ch))
+            if ch.action == CHURN_ADD:
+                self.grow(ch.group, ch.count)
+            else:
+                self.shrink(ch.group, ch.count)
+
+    def arm_schedule(self) -> None:
+        """Install the spec's API-level fault events onto the
+        injector's absolute-time schedule (DEGRADE/HEAL/churn are world
+        state, applied by the driver at their tick)."""
+        for ev in self.spec.faults:
+            if ev.kind == FAULT_API:
+                self.inj.schedule_rule(
+                    ev.at, ev.fault, verb=ev.verb, kind=ev.obj_kind,
+                    rate=ev.rate, count=ev.count, duration=ev.duration,
+                )
+            elif ev.kind == FAULT_OUTAGE:
+                self.inj.schedule_outage(ev.at, ev.duration)
+            elif ev.kind == FAULT_WATCH_DROP:
+                self.inj.schedule_watch_drop(ev.at)
+
+    def tick(self) -> None:
+        """One sim step: advance the clock, fire due schedule entries,
+        apply world events, run the agents, one shard round, reconcile
+        to quiescence."""
+        self.now[0] += self.spec.tick_seconds
+        self.fabric.advance(self.spec.tick_seconds)
+        self.inj.pump()
+        self._apply_due_events()
+        for rig in self.rigs:
+            rig.tick()
+        self.shard_round()
+        for r in self.replicas:
+            r.enqueue_owned(self.policy_names)
+            r.settle()
+        self.fake.simulate_daemonset_controller(materialize_pods=False)
+        for r in self.replicas:
+            r.settle()
+
+    def run(self) -> None:
+        """The declarative drive: arm the schedule, start the
+        replicas, run every tick, record the steady-window writes."""
+        self.arm_schedule()
+        self.start()
+        steady_from = self.spec.ticks - self.spec.steady_window
+        writes_at_steady = None
+        for t in range(self.spec.ticks):
+            if self.spec.steady_window and t == steady_from:
+                writes_at_steady = dict(self.writes_by_name)
+            before = self.write_counts()
+            self.tick()
+            self.write_series.append(
+                self.delta_writes(before, self.write_counts())
+            )
+        if writes_at_steady is not None:
+            self.steady_writes = self.spurious_writes(
+                writes_at_steady, self.writes_by_name
+            )
+
+    # -- teardown -------------------------------------------------------------
+
+    def close(self) -> None:
+        from ..agent import cli as agent_cli
+
+        for r in self.replicas:
+            r.stop()
+        for rig in self.rigs:
+            rig.close()
+        if self._patched_cli and self._orig_kube_client is not None:
+            agent_cli._kube_client = self._orig_kube_client
+            self._patched_cli = False
+
+    def __enter__(self) -> "World":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
